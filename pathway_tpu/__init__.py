@@ -1,0 +1,43 @@
+"""pathway_tpu — a TPU-native unified batch/streaming dataflow framework.
+
+Brand-new implementation with the capabilities of the reference Pathway
+framework (see SURVEY.md): a declarative Python API building an incremental
+dataflow graph — tables as keyed update streams (key, row, time, diff) —
+executed by a host-side commit scheduler with the compute path (embedders,
+rerankers, vector search, decode) on TPU via JAX/XLA/Pallas.
+"""
+
+from pathway_tpu.engine.value import (
+    ERROR,
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    Json,
+    Pointer,
+    PyObjectWrapper,
+)
+from pathway_tpu.internals import dtype as _dt
+from pathway_tpu.internals.schema import (
+    Schema,
+    column_definition,
+    schema_builder,
+    schema_from_dict,
+    schema_from_types,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ERROR",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "Json",
+    "Pointer",
+    "PyObjectWrapper",
+    "Schema",
+    "column_definition",
+    "schema_builder",
+    "schema_from_dict",
+    "schema_from_types",
+]

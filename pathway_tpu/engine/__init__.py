@@ -1,0 +1,55 @@
+"""TPU-native engine: incremental keyed update streams + JAX device compute.
+
+Replaces the reference's Rust/timely engine (src/engine/) with a host-side
+commit scheduler and device-side JAX operators.
+"""
+
+from pathway_tpu.engine.batch import DeltaBatch
+from pathway_tpu.engine.graph import (
+    InputSession,
+    JoinKind,
+    Node,
+    Scheduler,
+    Scope,
+)
+from pathway_tpu.engine.reducers import Reducer, ReducerKind, make_reducer
+from pathway_tpu.engine.value import (
+    ERROR,
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    Error,
+    Json,
+    Pointer,
+    PyObjectWrapper,
+    Type,
+    hash_values,
+    is_error,
+    ref_scalar,
+    unsafe_make_pointer,
+)
+
+__all__ = [
+    "DeltaBatch",
+    "InputSession",
+    "JoinKind",
+    "Node",
+    "Scheduler",
+    "Scope",
+    "Reducer",
+    "ReducerKind",
+    "make_reducer",
+    "ERROR",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "Error",
+    "Json",
+    "Pointer",
+    "PyObjectWrapper",
+    "Type",
+    "hash_values",
+    "is_error",
+    "ref_scalar",
+    "unsafe_make_pointer",
+]

@@ -1,0 +1,95 @@
+"""Delta batches — the unit of incremental data movement.
+
+Every engine table is a keyed update stream: batches of ``(key, row, diff)``
+at a logical timestamp (reference: tables as
+``Collection<S, (Key, Value)>`` diffs, src/engine/dataflow.rs:820). A batch is
+consolidated when each (key, row) appears once with a non-zero diff.
+
+Rows are plain tuples of engine values; columnar views (NumPy / DLPack →
+jax.Array) are materialized on demand by the device bridge
+(:mod:`pathway_tpu.engine.device`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator
+
+from pathway_tpu.engine.value import Pointer
+
+Entry = tuple[Pointer, tuple, int]
+
+
+class DeltaBatch:
+    """A consolidatable batch of keyed row updates."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, entries: Iterable[Entry] | None = None) -> None:
+        self.entries: list[Entry] = list(entries) if entries is not None else []
+
+    def append(self, key: Pointer, row: tuple, diff: int) -> None:
+        if diff != 0:
+            self.entries.append((key, row, diff))
+
+    def extend(self, entries: Iterable[Entry]) -> None:
+        for key, row, diff in entries:
+            if diff != 0:
+                self.entries.append((key, row, diff))
+
+    def __iter__(self) -> Iterator[Entry]:
+        return iter(self.entries)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def __bool__(self) -> bool:
+        return bool(self.entries)
+
+    def __repr__(self) -> str:
+        return f"DeltaBatch({self.entries!r})"
+
+    def consolidate(self) -> "DeltaBatch":
+        """Merge duplicate (key, row) entries, dropping zero diffs."""
+        acc: dict[tuple[Pointer, int], list[Any]] = {}
+        order: list[tuple[Pointer, int]] = []
+        for key, row, diff in self.entries:
+            try:
+                slot = (key, hash(row))
+            except TypeError:
+                slot = (key, id(row))
+            found = acc.get(slot)
+            if found is None:
+                acc[slot] = [row, diff]
+                order.append(slot)
+            else:
+                found[1] += diff
+        out = DeltaBatch()
+        for slot in order:
+            row, diff = acc[slot]
+            if diff != 0:
+                out.entries.append((slot[0], row, diff))
+        return out
+
+    def map_rows(self, fn: Callable[[Pointer, tuple], tuple]) -> "DeltaBatch":
+        return DeltaBatch((key, fn(key, row), diff) for key, row, diff in self.entries)
+
+    def negated(self) -> "DeltaBatch":
+        return DeltaBatch((key, row, -diff) for key, row, diff in self.entries)
+
+
+def apply_batch_to_state(state: dict[Pointer, tuple], batch: DeltaBatch) -> None:
+    """Apply a consolidated batch of ±1-style updates to a key→row map.
+
+    A table maps each key to exactly one row; an in-place update arrives as
+    a retraction of the old row and an insertion of the new one.
+    """
+    removed: dict[Pointer, tuple] = {}
+    for key, row, diff in batch:
+        if diff < 0:
+            for _ in range(-diff):
+                prev = state.pop(key, None)
+                if prev is not None:
+                    removed[key] = prev
+    for key, row, diff in batch:
+        if diff > 0:
+            state[key] = row

@@ -1,0 +1,378 @@
+"""Engine value system: runtime types, keys, error poisoning.
+
+TPU-native rebuild of the reference engine's value layer
+(reference: src/engine/value.rs — Key u128 xxh3 at value.rs:41, Value enum at
+value.rs:207, Type at value.rs:507, ShardPolicy at value.rs:94). This is a new
+implementation: keys are 128-bit ints derived from a stable BLAKE2b-128 of a
+deterministic serialization (the contract is "stable 128-bit content hash",
+not the exact xxh3 bit pattern), values are plain Python/NumPy objects tagged
+by :class:`Type`, and ``ERROR`` is a poisoning sentinel that propagates
+through expressions instead of raising (reference: src/engine/error.rs).
+"""
+
+from __future__ import annotations
+
+import datetime
+import enum
+import hashlib
+import json as _json
+import math
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+__all__ = [
+    "Type",
+    "Kind",
+    "Pointer",
+    "Error",
+    "ERROR",
+    "Json",
+    "PyObjectWrapper",
+    "Duration",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "hash_values",
+    "ref_scalar",
+    "unsafe_make_pointer",
+    "value_type_of",
+    "is_error",
+    "SHARD_MASK",
+]
+
+
+class Type(enum.Enum):
+    """Engine column types (reference: src/engine/value.rs:507)."""
+
+    ANY = "Any"
+    NONE = "None"
+    BOOL = "Bool"
+    INT = "Int"
+    FLOAT = "Float"
+    POINTER = "Pointer"
+    STRING = "String"
+    BYTES = "Bytes"
+    DATE_TIME_NAIVE = "DateTimeNaive"
+    DATE_TIME_UTC = "DateTimeUtc"
+    DURATION = "Duration"
+    ARRAY = "Array"
+    JSON = "Json"
+    TUPLE = "Tuple"
+    LIST = "List"
+    PY_OBJECT_WRAPPER = "PyObjectWrapper"
+    FUTURE = "Future"
+
+    def __repr__(self) -> str:
+        return f"Type.{self.name}"
+
+
+class Kind(enum.Enum):
+    """Value kinds as seen by the engine (scalar vs error)."""
+
+    VALUE = 0
+    ERROR = 1
+
+
+class Error:
+    """Singleton poisoning sentinel (reference: Value::Error, src/engine/value.rs:228).
+
+    Any expression evaluated over an ``ERROR`` operand yields ``ERROR`` rather
+    than raising; rows carrying errors are routed to error logs and can be
+    filtered with ``remove_errors``.
+    """
+
+    _instance: "Error | None" = None
+
+    def __new__(cls) -> "Error":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def __bool__(self) -> bool:
+        raise ValueError("cannot convert error value to bool")
+
+    def __hash__(self) -> int:
+        return 0x9E3779B97F4A7C15
+
+    def __reduce__(self):
+        return (Error, ())
+
+
+ERROR = Error()
+
+
+def is_error(value: Any) -> bool:
+    return value is ERROR or isinstance(value, Error)
+
+
+SHARD_MASK = (1 << 64) - 1
+
+
+class Pointer(int):
+    """A 128-bit row key (reference: Key(u128), src/engine/value.rs:41).
+
+    Subclasses ``int`` so it hashes/compares natively; rendering is the
+    compact ``^BASE32``-style form used in printed tables.
+    """
+
+    __slots__ = ()
+
+    _ALPHABET = "0123456789ABCDEFGHIJKLMNOPQRSTUV"
+
+    def __new__(cls, value: int) -> "Pointer":
+        return super().__new__(cls, int(value) & ((1 << 128) - 1))
+
+    def shard(self, nshards: int) -> int:
+        """Shard routing: high 64 bits modulo shard count (data parallelism)."""
+        return (int(self) >> 64) % nshards
+
+    def __repr__(self) -> str:
+        n = int(self)
+        if n == 0:
+            return "^0"
+        digits = []
+        while n:
+            digits.append(self._ALPHABET[n & 31])
+            n >>= 5
+        return "^" + "".join(reversed(digits))
+
+    __str__ = __repr__
+
+
+class Json:
+    """JSON value wrapper (reference: Value::Json)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any) -> None:
+        if isinstance(value, Json):
+            value = value.value
+        self.value = value
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, Json):
+            return self.value == other.value
+        return self.value == other
+
+    def __hash__(self) -> int:
+        return hash(_json.dumps(self.value, sort_keys=True, default=str))
+
+    def __repr__(self) -> str:
+        return _json.dumps(self.value, default=str)
+
+    def as_int(self) -> int | None:
+        return int(self.value) if isinstance(self.value, (int, float)) else None
+
+    def as_float(self) -> float | None:
+        return float(self.value) if isinstance(self.value, (int, float)) else None
+
+    def as_str(self) -> str | None:
+        return self.value if isinstance(self.value, str) else None
+
+    def as_bool(self) -> bool | None:
+        return self.value if isinstance(self.value, bool) else None
+
+    def as_list(self) -> list | None:
+        return self.value if isinstance(self.value, list) else None
+
+    def as_dict(self) -> dict | None:
+        return self.value if isinstance(self.value, dict) else None
+
+    def __getitem__(self, item: Any) -> "Json":
+        return Json(self.value[item])
+
+    def get(self, item: Any, default: Any = None) -> "Json | None":
+        if isinstance(self.value, dict):
+            got = self.value.get(item, _SENTINEL)
+            if got is _SENTINEL:
+                return default
+            return Json(got)
+        if isinstance(self.value, list) and isinstance(item, int):
+            if -len(self.value) <= item < len(self.value):
+                return Json(self.value[item])
+            return default
+        return default
+
+    def __len__(self) -> int:
+        return len(self.value)
+
+    def __iter__(self):
+        for item in self.value:
+            yield Json(item)
+
+
+_SENTINEL = object()
+
+
+class PyObjectWrapper:
+    """Opaque Python object carried through the engine (Value::PyObjectWrapper)."""
+
+    __slots__ = ("value", "_serializer")
+
+    def __init__(self, value: Any, *, serializer: Any = None) -> None:
+        self.value = value
+        self._serializer = serializer
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, PyObjectWrapper) and self.value == other.value
+
+    def __hash__(self) -> int:
+        try:
+            return hash(self.value)
+        except TypeError:
+            return id(self.value)
+
+    def __repr__(self) -> str:
+        return f"pw.wrap_py_object({self.value!r})"
+
+
+# Date/time: thin aliases over stdlib types. Naive vs UTC is tracked at the
+# dtype level (reference keeps separate Value variants, src/engine/time.rs).
+DateTimeNaive = datetime.datetime
+DateTimeUtc = datetime.datetime
+Duration = datetime.timedelta
+
+
+# ---------------------------------------------------------------------------
+# Stable hashing → 128-bit keys
+# ---------------------------------------------------------------------------
+
+_H_NONE = b"\x00"
+_H_BOOL = b"\x01"
+_H_INT = b"\x02"
+_H_FLOAT = b"\x03"
+_H_POINTER = b"\x04"
+_H_STRING = b"\x05"
+_H_BYTES = b"\x06"
+_H_TUPLE = b"\x07"
+_H_ARRAY = b"\x08"
+_H_DT = b"\x09"
+_H_DUR = b"\x0a"
+_H_JSON = b"\x0b"
+_H_PYOBJ = b"\x0c"
+_H_ERROR = b"\x0d"
+
+
+def _feed(h: "hashlib._Hash", value: Any) -> None:
+    if value is None:
+        h.update(_H_NONE)
+    elif isinstance(value, Error):
+        h.update(_H_ERROR)
+    elif isinstance(value, Pointer):
+        h.update(_H_POINTER)
+        h.update(int(value).to_bytes(16, "little"))
+    elif isinstance(value, bool):
+        h.update(_H_BOOL)
+        h.update(b"\x01" if value else b"\x00")
+    elif isinstance(value, (int, np.integer)):
+        h.update(_H_INT)
+        h.update(int(value).to_bytes(16, "little", signed=True))
+    elif isinstance(value, (float, np.floating)):
+        f = float(value)
+        if math.isnan(f) or math.isinf(f):
+            h.update(_H_FLOAT)
+            h.update(struct.pack("<d", f))
+        elif abs(f) < 2**63 and f == int(f):
+            # ints and equal floats hash alike, matching engine semantics
+            h.update(_H_INT)
+            h.update(int(f).to_bytes(16, "little", signed=True))
+        else:
+            h.update(_H_FLOAT)
+            h.update(struct.pack("<d", f))
+    elif isinstance(value, str):
+        b = value.encode()
+        h.update(_H_STRING)
+        h.update(len(b).to_bytes(8, "little"))
+        h.update(b)
+    elif isinstance(value, bytes):
+        h.update(_H_BYTES)
+        h.update(len(value).to_bytes(8, "little"))
+        h.update(value)
+    elif isinstance(value, tuple) or isinstance(value, list):
+        h.update(_H_TUPLE)
+        h.update(len(value).to_bytes(8, "little"))
+        for item in value:
+            _feed(h, item)
+    elif isinstance(value, np.ndarray):
+        h.update(_H_ARRAY)
+        h.update(str(value.dtype).encode())
+        h.update(str(value.shape).encode())
+        h.update(np.ascontiguousarray(value).tobytes())
+    elif isinstance(value, datetime.datetime):
+        h.update(_H_DT)
+        h.update(value.isoformat().encode())
+    elif isinstance(value, datetime.timedelta):
+        h.update(_H_DUR)
+        h.update(struct.pack("<q", round(value.total_seconds() * 1_000_000_000)))
+    elif isinstance(value, Json):
+        h.update(_H_JSON)
+        h.update(_json.dumps(value.value, sort_keys=True, default=str).encode())
+    elif isinstance(value, PyObjectWrapper):
+        h.update(_H_PYOBJ)
+        _feed(h, repr(value.value))
+    else:
+        h.update(_H_PYOBJ)
+        _feed(h, repr(value))
+
+
+def hash_values(values: Iterable[Any], *, salt: bytes = b"") -> Pointer:
+    """Stable 128-bit key from a sequence of values (Key::for_values analog)."""
+    h = hashlib.blake2b(digest_size=16, person=b"pw-tpu-key")
+    if salt:
+        h.update(salt)
+    for value in values:
+        _feed(h, value)
+    return Pointer(int.from_bytes(h.digest(), "little"))
+
+
+def ref_scalar(*values: Any, instance: Any = None) -> Pointer:
+    """Derive a pointer from scalar values (python_api.rs ref_scalar :3373)."""
+    if instance is not None:
+        return hash_values(tuple(values) + (instance,), salt=b"inst")
+    return hash_values(values)
+
+
+def unsafe_make_pointer(value: int) -> Pointer:
+    return Pointer(value)
+
+
+_NUMPY_INT_KINDS = "iu"
+
+
+def value_type_of(value: Any) -> Type:
+    """Runtime type tag of a value."""
+    if value is None:
+        return Type.NONE
+    if isinstance(value, Error):
+        return Type.ANY
+    if isinstance(value, Pointer):
+        return Type.POINTER
+    if isinstance(value, bool) or isinstance(value, np.bool_):
+        return Type.BOOL
+    if isinstance(value, (int, np.integer)):
+        return Type.INT
+    if isinstance(value, (float, np.floating)):
+        return Type.FLOAT
+    if isinstance(value, str):
+        return Type.STRING
+    if isinstance(value, bytes):
+        return Type.BYTES
+    if isinstance(value, datetime.datetime):
+        return Type.DATE_TIME_UTC if value.tzinfo is not None else Type.DATE_TIME_NAIVE
+    if isinstance(value, datetime.timedelta):
+        return Type.DURATION
+    if isinstance(value, np.ndarray):
+        return Type.ARRAY
+    if isinstance(value, Json):
+        return Type.JSON
+    if isinstance(value, tuple):
+        return Type.TUPLE
+    if isinstance(value, list):
+        return Type.LIST
+    if isinstance(value, PyObjectWrapper):
+        return Type.PY_OBJECT_WRAPPER
+    return Type.ANY

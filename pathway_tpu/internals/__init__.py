@@ -1,0 +1,1 @@
+"""Python framework internals (declarative API, graph capture, lowering)."""

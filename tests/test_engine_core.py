@@ -1,0 +1,288 @@
+"""Engine-level tests: direct Scope API (analog of reference test_api.py)."""
+
+import pytest
+
+from pathway_tpu.engine import (
+    DeltaBatch,
+    JoinKind,
+    ReducerKind,
+    Scheduler,
+    Scope,
+    make_reducer,
+    ref_scalar,
+)
+from pathway_tpu.engine import expression as ex
+from pathway_tpu.engine.value import ERROR, Pointer
+
+
+def k(i):
+    return ref_scalar(i)
+
+
+def static(scope, rows):
+    """rows: dict key_int -> tuple"""
+    return scope.static_table([(k(i), row) for i, row in rows.items()], len(next(iter(rows.values()))) if rows else 0)
+
+
+def run(scope):
+    Scheduler(scope).run_static()
+
+
+def test_static_table_state():
+    scope = Scope()
+    t = static(scope, {1: (1, "a"), 2: (2, "b")})
+    run(scope)
+    assert t.current == {k(1): (1, "a"), k(2): (2, "b")}
+
+
+def test_expression_table():
+    scope = Scope()
+    t = static(scope, {1: (1, 2), 2: (10, 20)})
+    out = scope.expression_table(
+        t, [ex.Binary("+", ex.ColumnRef(0), ex.ColumnRef(1)), ex.ColumnRef(0)]
+    )
+    run(scope)
+    assert out.current == {k(1): (3, 1), k(2): (30, 10)}
+
+
+def test_expression_error_poisoning():
+    scope = Scope()
+    t = static(scope, {1: (1, 0), 2: (10, 2)})
+    out = scope.expression_table(t, [ex.Binary("//", ex.ColumnRef(0), ex.ColumnRef(1))])
+    run(scope)
+    assert out.current[k(2)] == (5,)
+    assert out.current[k(1)][0] is ERROR
+    # error was logged
+    assert len(scope.error_log_default.current) == 1
+
+
+def test_filter():
+    scope = Scope()
+    t = static(scope, {1: (5,), 2: (15,), 3: (25,)})
+    cond = scope.expression_table(
+        t, [ex.ColumnRef(0), ex.Binary(">", ex.ColumnRef(0), ex.Const(10))]
+    )
+    out = scope.filter_table(cond, 1)
+    run(scope)
+    assert set(out.current) == {k(2), k(3)}
+
+
+def test_groupby_sum_count():
+    scope = Scope()
+    t = static(scope, {1: ("a", 1), 2: ("a", 2), 3: ("b", 5)})
+    out = scope.group_by_table(
+        t,
+        by_cols=[0],
+        reducers=[
+            (make_reducer(ReducerKind.SUM), [1]),
+            (make_reducer(ReducerKind.COUNT), []),
+        ],
+    )
+    run(scope)
+    rows = set(out.current.values())
+    assert rows == {("a", 3, 2), ("b", 5, 1)}
+
+
+def test_groupby_incremental_retraction():
+    scope = Scope()
+    sess = scope.input_session(2)
+    out = scope.group_by_table(
+        t := sess,
+        by_cols=[0],
+        reducers=[(make_reducer(ReducerKind.SUM), [1])],
+    )
+    sched = Scheduler(scope)
+    sess.insert(k(1), ("a", 1))
+    sess.insert(k(2), ("a", 2))
+    sched.commit()
+    assert set(out.current.values()) == {("a", 3)}
+    sess.remove(k(1), ("a", 1))
+    sched.commit()
+    assert set(out.current.values()) == {("a", 2)}
+    sess.remove(k(2), ("a", 2))
+    sched.commit()
+    assert out.current == {}
+
+
+def test_join_inner_incremental():
+    scope = Scope()
+    left = scope.input_session(2)
+    right = scope.input_session(2)
+    out = scope.join_tables(left, right, [0], [0], kind=JoinKind.INNER)
+    sched = Scheduler(scope)
+    left.insert(k(1), ("x", 1))
+    right.insert(k(10), ("x", 100))
+    sched.commit()
+    assert set(out.current.values()) == {("x", 1, "x", 100)}
+    left.insert(k(2), ("x", 2))
+    sched.commit()
+    assert set(out.current.values()) == {("x", 1, "x", 100), ("x", 2, "x", 100)}
+    right.remove(k(10), ("x", 100))
+    sched.commit()
+    assert out.current == {}
+
+
+def test_join_outer():
+    scope = Scope()
+    left = static(scope, {1: ("a", 1), 2: ("b", 2)})
+    right = scope.static_table([(k(10), ("a", 10.0))], 2)
+    out = scope.join_tables(left, right, [0], [0], kind=JoinKind.OUTER)
+    run(scope)
+    rows = set(out.current.values())
+    assert rows == {("a", 1, "a", 10.0), ("b", 2, None, None)}
+
+
+def test_join_left_match_appears_later():
+    scope = Scope()
+    left = scope.input_session(2)
+    right = scope.input_session(2)
+    out = scope.join_tables(left, right, [0], [0], kind=JoinKind.LEFT)
+    sched = Scheduler(scope)
+    left.insert(k(1), ("a", 1))
+    sched.commit()
+    assert set(out.current.values()) == {("a", 1, None, None)}
+    right.insert(k(10), ("a", 9))
+    sched.commit()
+    assert set(out.current.values()) == {("a", 1, "a", 9)}
+
+
+def test_concat_and_reindex():
+    scope = Scope()
+    a = static(scope, {1: (1,)})
+    b = static(scope, {2: (2,)})
+    out = scope.concat_tables([a, b])
+    run(scope)
+    assert set(out.current.values()) == {(1,), (2,)}
+
+
+def test_intersect_subtract():
+    scope = Scope()
+    a = static(scope, {1: (1,), 2: (2,), 3: (3,)})
+    b = static(scope, {2: ("x",), 3: ("y",)})
+    inter = scope.intersect_tables(a, [b])
+    sub = scope.subtract_table(a, b)
+    run(scope)
+    assert set(inter.current) == {k(2), k(3)}
+    assert set(sub.current) == {k(1)}
+
+
+def test_flatten():
+    scope = Scope()
+    t = static(scope, {1: ((1, 2, 3), "a")})
+    out = scope.flatten_table(t, 0)
+    run(scope)
+    assert sorted(out.current.values()) == [(1, "a"), (2, "a"), (3, "a")]
+
+
+def test_update_rows():
+    scope = Scope()
+    orig = static(scope, {1: (1,), 2: (2,)})
+    upd = scope.static_table([(k(2), (20,)), (k(3), (30,))], 1)
+    out = scope.update_rows_table(orig, upd)
+    run(scope)
+    assert out.current == {k(1): (1,), k(2): (20,), k(3): (30,)}
+
+
+def test_update_cells():
+    scope = Scope()
+    orig = static(scope, {1: (1, "a"), 2: (2, "b")})
+    upd = scope.static_table([(k(1), (100,))], 1)
+    out = scope.update_cells_table(orig, upd, [0, -1])
+    run(scope)
+    assert out.current == {k(1): (100, "a"), k(2): (2, "b")}
+
+
+def test_ix():
+    scope = Scope()
+    source = static(scope, {1: ("one",), 2: ("two",)})
+    keys = scope.static_table([(k(10), (k(1),)), (k(11), (k(2),))], 1)
+    out = scope.ix_table(keys, source, 0)
+    run(scope)
+    assert out.current == {k(10): ("one",), k(11): ("two",)}
+
+
+def test_ix_updates_on_source_change():
+    scope = Scope()
+    source = scope.input_session(1)
+    keys = scope.input_session(1)
+    out = scope.ix_table(keys, source, 0)
+    sched = Scheduler(scope)
+    source.insert(k(1), ("one",))
+    keys.insert(k(10), (k(1),))
+    sched.commit()
+    assert out.current == {k(10): ("one",)}
+    source.remove(k(1), ("one",))
+    source.insert(k(1), ("uno",))
+    sched.commit()
+    assert out.current == {k(10): ("uno",)}
+
+
+def test_sort_prev_next():
+    scope = Scope()
+    t = static(scope, {1: (5,), 2: (1,), 3: (3,)})
+    out = scope.sort_table(t, 0, None)
+    run(scope)
+    # ordering by value: k(2)=1, k(3)=3, k(1)=5
+    assert out.current[k(2)] == (None, k(3))
+    assert out.current[k(3)] == (k(2), k(1))
+    assert out.current[k(1)] == (k(3), None)
+
+
+def test_deduplicate():
+    scope = Scope()
+    sess = scope.input_session(2)
+    out = scope.deduplicate(sess, value_col=1, instance_cols=[0], acceptor=lambda new, old: new > old)
+    sched = Scheduler(scope)
+    sess.insert(k(1), ("a", 5))
+    sched.commit()
+    assert set(out.current.values()) == {("a", 5)}
+    sess.insert(k(2), ("a", 3))  # rejected, 3 < 5
+    sched.commit()
+    assert set(out.current.values()) == {("a", 5)}
+    sess.insert(k(3), ("a", 10))
+    sched.commit()
+    assert set(out.current.values()) == {("a", 10)}
+
+
+def test_reducers_min_max_argmax_tuple():
+    scope = Scope()
+    t = static(scope, {1: ("g", 3, "x"), 2: ("g", 1, "y"), 3: ("g", 7, "z")})
+    out = scope.group_by_table(
+        t,
+        by_cols=[0],
+        reducers=[
+            (make_reducer(ReducerKind.MIN), [1]),
+            (make_reducer(ReducerKind.MAX), [1]),
+            (make_reducer(ReducerKind.ARG_MAX), [1, 2]),
+            (make_reducer(ReducerKind.SORTED_TUPLE), [1]),
+        ],
+    )
+    run(scope)
+    assert set(out.current.values()) == {("g", 1, 7, "z", (1, 3, 7))}
+
+
+def test_subscribe_stream():
+    scope = Scope()
+    sess = scope.input_session(1)
+    seen = []
+    scope.subscribe_table(
+        sess,
+        on_change=lambda key, row, time, diff: seen.append((row, time, diff)),
+    )
+    sched = Scheduler(scope)
+    sess.insert(k(1), ("a",))
+    sched.commit()
+    sess.insert(k(2), ("b",))
+    sess.remove(k(1), ("a",))
+    sched.commit()
+    assert seen == [(("a",), 0, 1), (("b",), 1, 1), (("a",), 1, -1)]
+
+
+def test_error_log_is_table():
+    scope = Scope()
+    t = static(scope, {1: (1, 0)})
+    scope.expression_table(t, [ex.Binary("%", ex.ColumnRef(0), ex.ColumnRef(1))])
+    run(scope)
+    logs = list(scope.error_log_default.current.values())
+    assert len(logs) == 1
+    assert "ZeroDivisionError" in logs[0][0]

@@ -1,0 +1,106 @@
+"""Tests for engine value types, hashing, dtypes, and pw.Schema."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.value import (
+    ERROR,
+    Json,
+    Pointer,
+    hash_values,
+    ref_scalar,
+    unsafe_make_pointer,
+)
+from pathway_tpu.internals import dtype as dt
+
+
+def test_pointer_stability():
+    assert ref_scalar(1, "a") == ref_scalar(1, "a")
+    assert ref_scalar(1, "a") != ref_scalar(1, "b")
+    assert ref_scalar(1) != ref_scalar(1, instance="i")
+
+
+def test_int_float_hash_equal():
+    assert hash_values([1]) == hash_values([1.0])
+    assert hash_values([1]) != hash_values([1.5])
+
+
+def test_pointer_repr():
+    p = unsafe_make_pointer(12345)
+    assert repr(p).startswith("^")
+    assert isinstance(p, int)
+
+
+def test_hash_arrays_and_tuples():
+    a = np.array([1, 2, 3])
+    assert hash_values([a]) == hash_values([np.array([1, 2, 3])])
+    assert hash_values([(1, "a")]) == hash_values([(1, "a")])
+
+
+def test_error_singleton():
+    from pathway_tpu.engine.value import Error
+
+    assert Error() is ERROR
+    with pytest.raises(ValueError):
+        bool(ERROR)
+
+
+def test_json_accessors():
+    j = Json({"a": [1, 2], "b": "x"})
+    assert j.get("a").as_list() == [1, 2]
+    assert j["b"].as_str() == "x"
+    assert j.get("missing") is None
+
+
+def test_schema_basic():
+    class S(pw.Schema):
+        name: str
+        age: int
+
+    assert S.column_names() == ["name", "age"]
+    assert S.columns()["age"].dtype == dt.INT
+    assert S.primary_key_columns() is None
+
+
+def test_schema_primary_key_and_defaults():
+    class S(pw.Schema):
+        ident: int = pw.column_definition(primary_key=True)
+        value: float = pw.column_definition(default_value=0.0)
+
+    assert S.primary_key_columns() == ["ident"]
+    assert S.columns()["value"].has_default()
+
+
+def test_schema_from_types_and_union():
+    A = pw.schema_from_types(x=int)
+    B = pw.schema_from_types(y=str)
+    C = A | B
+    assert C.column_names() == ["x", "y"]
+
+
+def test_schema_optional_types():
+    class S(pw.Schema):
+        a: int | None
+
+    assert S.columns()["a"].dtype == dt.Optional_(dt.INT)
+    assert S.columns()["a"].dtype.strip_optional() == dt.INT
+
+
+def test_dtype_lattice():
+    assert dt.is_subclass(dt.INT, dt.FLOAT)
+    assert dt.is_subclass(dt.BOOL, dt.INT)
+    assert not dt.is_subclass(dt.FLOAT, dt.INT)
+    assert dt.lca(dt.INT, dt.FLOAT) == dt.FLOAT
+    assert dt.lca(dt.INT, dt.NONE) == dt.Optional_(dt.INT)
+    assert dt.lca(dt.STR, dt.INT) == dt.ANY
+
+
+def test_dtype_wrap():
+    assert dt.wrap(int) == dt.INT
+    assert dt.wrap(tuple[int, str]) == dt.Tuple(dt.INT, dt.STR)
+    assert dt.wrap(list[int]) == dt.List(dt.INT)
+    assert dt.wrap(datetime.datetime) == dt.DATE_TIME_NAIVE
+    assert dt.wrap(np.ndarray) == dt.ANY_ARRAY
