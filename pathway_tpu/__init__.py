@@ -15,8 +15,26 @@ from pathway_tpu.engine.value import (
     Json,
     Pointer,
     PyObjectWrapper,
+    unsafe_make_pointer,
 )
 from pathway_tpu.internals import dtype as _dt
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_with_type,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.parse_graph import G, run, run_all
 from pathway_tpu.internals.schema import (
     Schema,
     column_definition,
@@ -24,20 +42,71 @@ from pathway_tpu.internals.schema import (
     schema_from_dict,
     schema_from_types,
 )
+from pathway_tpu.internals.table import JoinMode, Table
+from pathway_tpu.internals.thisclass import left, right, this
+from pathway_tpu.internals import universe as _universe_mod
+
+from pathway_tpu import debug  # noqa: E402  (imports Table)
+
+
+class universes:
+    """Universe promises (reference: pw.universes.*)."""
+
+    @staticmethod
+    def promise_are_equal(*tables: Table) -> None:
+        for other in tables[1:]:
+            _universe_mod.solver.register_equal(
+                tables[0]._universe, other._universe
+            )
+
+    @staticmethod
+    def promise_is_subset_of(sub: Table, sup: Table) -> None:
+        _universe_mod.solver.register_subset(sub._universe, sup._universe)
+
+
+def wrap_py_object(obj: object, **kwargs: object) -> PyObjectWrapper:
+    return PyObjectWrapper(obj)
+
 
 __version__ = "0.1.0"
 
 __all__ = [
     "ERROR",
+    "ColumnExpression",
+    "ColumnReference",
     "DateTimeNaive",
     "DateTimeUtc",
     "Duration",
+    "G",
+    "JoinMode",
     "Json",
     "Pointer",
     "PyObjectWrapper",
     "Schema",
+    "Table",
+    "apply",
+    "apply_async",
+    "apply_with_type",
+    "cast",
+    "coalesce",
     "column_definition",
+    "debug",
+    "declare_type",
+    "fill_error",
+    "if_else",
+    "left",
+    "make_tuple",
+    "reducers",
+    "require",
+    "right",
+    "run",
+    "run_all",
     "schema_builder",
     "schema_from_dict",
     "schema_from_types",
+    "this",
+    "universes",
+    "unsafe_make_pointer",
+    "unwrap",
+    "wrap_py_object",
 ]

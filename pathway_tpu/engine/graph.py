@@ -321,6 +321,44 @@ class OverrideUniverseNode(Node):
         return self.take(0)
 
 
+class ZipNode(Node):
+    """Zip same-universe tables into one storage (column concatenation).
+
+    The reference reaches the same goal by flattening same-universe columns
+    into shared tuple storage (graph_runner/path_evaluator.py); here it is an
+    explicit operator: a row is emitted once every input holds the key, so a
+    base table zipped with tables over a superset universe restricts
+    naturally.
+    """
+
+    def __init__(self, scope: "Scope", sources: Sequence[Node]) -> None:
+        super().__init__(scope, list(sources), sum(s.arity for s in sources))
+
+    def _combined(self, key: Pointer) -> tuple | None:
+        parts = []
+        for inp in self.inputs:
+            row = inp.current.get(key)
+            if row is None:
+                return None
+            parts.append(row)
+        return tuple(v for part in parts for v in part)
+
+    def process(self, time: int) -> DeltaBatch:
+        affected: set[Pointer] = set()
+        for port in range(len(self.inputs)):
+            for key, _row, _diff in self.take(port):
+                affected.add(key)
+        out = DeltaBatch()
+        for key in affected:
+            old = self.current.get(key)
+            new = self._combined(key)
+            if old is not None and old != new:
+                out.append(key, old, -1)
+            if new is not None and old != new:
+                out.append(key, new, 1)
+        return out
+
+
 class JoinKind:
     INNER = "inner"
     LEFT = "left"
@@ -958,6 +996,11 @@ class Scope:
         self, table: Node, expressions: Sequence[EngineExpression]
     ) -> Node:
         return ExpressionNode(self, table, expressions)
+
+    def zip_tables(self, tables: Sequence[Node]) -> Node:
+        if len(tables) == 1:
+            return tables[0]
+        return ZipNode(self, tables)
 
     def filter_table(self, table: Node, condition_col: int) -> Node:
         return FilterNode(self, table, condition_col)

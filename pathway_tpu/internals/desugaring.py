@@ -1,0 +1,110 @@
+"""Expression desugaring: resolve pw.this/pw.left/pw.right placeholders.
+
+(reference: python/pathway/internals/desugaring.py, 353 LoC — here a compact
+structural substitution over the expression tree.)
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import TYPE_CHECKING, Any, Callable
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.thisclass import ThisColumnReference, left, right, this
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.table import Table
+
+_CHILD_ATTRS = (
+    "_left",
+    "_right",
+    "_arg",
+    "_cond",
+    "_then",
+    "_otherwise",
+    "_value",
+    "_fallback",
+    "_index",
+    "_default",
+    "_instance",
+)
+_CHILD_LIST_ATTRS = ("_args", "_deps")
+_CHILD_DICT_ATTRS = ("_kwargs",)
+
+
+def substitute(
+    expression: ColumnExpression,
+    replace: Callable[[ColumnExpression], ColumnExpression | None],
+) -> ColumnExpression:
+    """Rebuild an expression tree, replacing nodes where ``replace`` returns
+    a non-None substitute."""
+    replaced = replace(expression)
+    if replaced is not None:
+        return replaced
+    clone: ColumnExpression | None = None
+
+    def ensure_clone() -> ColumnExpression:
+        nonlocal clone
+        if clone is None:
+            clone = copy.copy(expression)
+        return clone
+
+    for attr in _CHILD_ATTRS:
+        child = getattr(expression, attr, None)
+        if isinstance(child, ColumnExpression):
+            new_child = substitute(child, replace)
+            if new_child is not child:
+                setattr(ensure_clone(), attr, new_child)
+    for attr in _CHILD_LIST_ATTRS:
+        children = getattr(expression, attr, None)
+        if isinstance(children, list):
+            new_children = [
+                substitute(c, replace) if isinstance(c, ColumnExpression) else c
+                for c in children
+            ]
+            if any(a is not b for a, b in zip(children, new_children)):
+                setattr(ensure_clone(), attr, new_children)
+    for attr in _CHILD_DICT_ATTRS:
+        children = getattr(expression, attr, None)
+        if isinstance(children, dict):
+            new_dict = {
+                k: substitute(c, replace) if isinstance(c, ColumnExpression) else c
+                for k, c in children.items()
+            }
+            if any(new_dict[k] is not children[k] for k in children):
+                setattr(ensure_clone(), attr, new_dict)
+    return clone if clone is not None else expression
+
+
+def resolve_this(expression: Any, table: "Table") -> ColumnExpression:
+    """Bind ``pw.this`` placeholders (and bare column names) to ``table``."""
+    if isinstance(expression, str):
+        return ColumnReference(table, expression)
+    expression = expr_mod.wrap_expression(expression)
+
+    def replace(node: ColumnExpression) -> ColumnExpression | None:
+        if isinstance(node, ThisColumnReference):
+            if node._owner is not this:
+                raise ValueError(f"{node!r} cannot be used here; use pw.this")
+            return ColumnReference(table, node.name)
+        return None
+
+    return substitute(expression, replace)
+
+
+def resolve_join_sides(
+    expression: Any, left_table: "Table", right_table: "Table"
+) -> ColumnExpression:
+    """Bind pw.left/pw.right (and pw.this → left) in a join context."""
+    expression = expr_mod.wrap_expression(expression)
+
+    def replace(node: ColumnExpression) -> ColumnExpression | None:
+        if isinstance(node, ThisColumnReference):
+            if node._owner is left or node._owner is this:
+                return ColumnReference(left_table, node.name)
+            if node._owner is right:
+                return ColumnReference(right_table, node.name)
+        return None
+
+    return substitute(expression, replace)

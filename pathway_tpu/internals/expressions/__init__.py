@@ -1,0 +1,6 @@
+"""Method namespaces for expressions: ``.dt``, ``.str``, ``.num``.
+
+(reference: python/pathway/internals/expressions/ — date_time.py 1,613 LoC,
+string.py 931 LoC, numerical.py). Implemented as Apply-lowered library
+functions; the vectorized NumPy fast path applies batch-wise in the engine.
+"""

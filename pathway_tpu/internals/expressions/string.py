@@ -1,0 +1,153 @@
+"""``expr.str.*`` string method namespace (reference: expressions/string.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import (
+    ApplyExpression,
+    ColumnExpression,
+    wrap_expression,
+)
+
+
+def _method(fn, ret, *args, propagate_none=True):
+    return ApplyExpression(fn, ret, args, {}, propagate_none=propagate_none)
+
+
+class StringNamespace:
+    def __init__(self, expression: ColumnExpression) -> None:
+        self._e = expression
+
+    def lower(self) -> ColumnExpression:
+        return _method(lambda s: s.lower(), str, self._e)
+
+    def upper(self) -> ColumnExpression:
+        return _method(lambda s: s.upper(), str, self._e)
+
+    def reversed(self) -> ColumnExpression:
+        return _method(lambda s: s[::-1], str, self._e)
+
+    def len(self) -> ColumnExpression:
+        return _method(len, int, self._e)
+
+    def strip(self, chars: Any = None) -> ColumnExpression:
+        return _method(lambda s, c: s.strip(c), str, self._e, wrap_expression(chars))
+
+    def lstrip(self, chars: Any = None) -> ColumnExpression:
+        return _method(lambda s, c: s.lstrip(c), str, self._e, wrap_expression(chars))
+
+    def rstrip(self, chars: Any = None) -> ColumnExpression:
+        return _method(lambda s, c: s.rstrip(c), str, self._e, wrap_expression(chars))
+
+    def startswith(self, prefix: Any) -> ColumnExpression:
+        return _method(lambda s, p: s.startswith(p), bool, self._e, wrap_expression(prefix))
+
+    def endswith(self, suffix: Any) -> ColumnExpression:
+        return _method(lambda s, p: s.endswith(p), bool, self._e, wrap_expression(suffix))
+
+    def swapcase(self) -> ColumnExpression:
+        return _method(lambda s: s.swapcase(), str, self._e)
+
+    def title(self) -> ColumnExpression:
+        return _method(lambda s: s.title(), str, self._e)
+
+    def count(self, sub: Any, start: Any = None, end: Any = None) -> ColumnExpression:
+        return _method(
+            lambda s, x, b, e: s.count(x, b, e),
+            int,
+            self._e,
+            wrap_expression(sub),
+            wrap_expression(start),
+            wrap_expression(end),
+        )
+
+    def find(self, sub: Any, start: Any = None, end: Any = None) -> ColumnExpression:
+        return _method(
+            lambda s, x, b, e: s.find(x, b, e),
+            int,
+            self._e,
+            wrap_expression(sub),
+            wrap_expression(start),
+            wrap_expression(end),
+        )
+
+    def rfind(self, sub: Any, start: Any = None, end: Any = None) -> ColumnExpression:
+        return _method(
+            lambda s, x, b, e: s.rfind(x, b, e),
+            int,
+            self._e,
+            wrap_expression(sub),
+            wrap_expression(start),
+            wrap_expression(end),
+        )
+
+    def replace(self, old: Any, new: Any, count: Any = -1) -> ColumnExpression:
+        return _method(
+            lambda s, o, n, c: s.replace(o, n, c),
+            str,
+            self._e,
+            wrap_expression(old),
+            wrap_expression(new),
+            wrap_expression(count),
+        )
+
+    def split(self, sep: Any = None, maxsplit: Any = -1) -> ColumnExpression:
+        return ApplyExpression(
+            lambda s, sp, m: tuple(s.split(sp, m)),
+            tuple[str, ...],
+            (self._e, wrap_expression(sep), wrap_expression(maxsplit)),
+            {},
+            propagate_none=True,
+        )
+
+    def slice(self, start: Any, end: Any) -> ColumnExpression:
+        return _method(
+            lambda s, b, e: s[b:e], str, self._e, wrap_expression(start), wrap_expression(end)
+        )
+
+    def parse_int(self, optional: bool = False) -> ColumnExpression:
+        def parse(s: str) -> int | None:
+            try:
+                return int(s)
+            except (ValueError, TypeError):
+                if optional:
+                    return None
+                raise
+
+        return _method(parse, int | None if optional else int, self._e)
+
+    def parse_float(self, optional: bool = False) -> ColumnExpression:
+        def parse(s: str) -> float | None:
+            try:
+                return float(s)
+            except (ValueError, TypeError):
+                if optional:
+                    return None
+                raise
+
+        return _method(parse, float | None if optional else float, self._e)
+
+    def parse_bool(self, optional: bool = False) -> ColumnExpression:
+        def parse(s: str) -> bool | None:
+            low = s.strip().lower()
+            if low in ("true", "1", "yes", "on"):
+                return True
+            if low in ("false", "0", "no", "off"):
+                return False
+            if optional:
+                return None
+            raise ValueError(f"cannot parse {s!r} as bool")
+
+        return _method(parse, bool | None if optional else bool, self._e)
+
+    def to_datetime(self, fmt: Any = None) -> ColumnExpression:
+        import datetime
+
+        def parse(s: str, f: str | None) -> datetime.datetime:
+            if f is not None:
+                return datetime.datetime.strptime(s, f)
+            return datetime.datetime.fromisoformat(s)
+
+        return _method(parse, datetime.datetime, self._e, wrap_expression(fmt))

@@ -1,0 +1,99 @@
+"""JoinResult — `t1.join(t2, t1.a == t2.b).select(...)`.
+
+(reference: python/pathway/internals/joins.py, 1,422 LoC)
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.desugaring import resolve_join_sides
+from pathway_tpu.internals.expression import (
+    BinaryOpExpression,
+    ColumnExpression,
+    ColumnReference,
+)
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.table import Table
+
+
+class JoinResult:
+    """Lazy join; materialized by ``.select`` (or ``.reduce`` after groupby)."""
+
+    def __init__(
+        self,
+        left: "Table",
+        right: "Table",
+        on: tuple,
+        how: str,
+        id: Any = None,  # noqa: A002
+    ) -> None:
+        self._left = left
+        self._right = right
+        self._how = how
+        self._id = id
+        self._on: list[tuple[ColumnExpression, ColumnExpression]] = []
+        for cond in on:
+            resolved = resolve_join_sides(cond, left, right)
+            if not (
+                isinstance(resolved, BinaryOpExpression) and resolved._op == "=="
+            ):
+                raise ValueError(
+                    f"join conditions must be equalities (left_col == right_col), got {cond!r}"
+                )
+            lexpr, rexpr = resolved._left, resolved._right
+            if self._side_of(lexpr) == "right" or self._side_of(rexpr) == "left":
+                lexpr, rexpr = rexpr, lexpr
+            self._on.append((lexpr, rexpr))
+
+    def _side_of(self, expression: ColumnExpression) -> str | None:
+        tables = {ref.table._id for ref in expression._dependencies()}
+        if tables <= self._reachable_ids(self._left):
+            return "left"
+        if tables <= self._reachable_ids(self._right):
+            return "right"
+        return None
+
+    @staticmethod
+    def _reachable_ids(table: "Table") -> set[int]:
+        return {table._id}
+
+    def select(self, *args: Any, **kwargs: Any) -> "Table":
+        from pathway_tpu.internals.table import Table, TableSpec
+
+        exprs: dict[str, ColumnExpression] = {}
+        for arg in args:
+            resolved = resolve_join_sides(arg, self._left, self._right)
+            if not isinstance(resolved, ColumnReference):
+                raise ValueError("positional join-select arguments must be column refs")
+            exprs[resolved.name] = resolved
+        for name, value in kwargs.items():
+            exprs[name] = resolve_join_sides(value, self._left, self._right)
+        dtypes = {n: e._dtype for n, e in exprs.items()}
+        id_from_left = False
+        if self._id is not None:
+            resolved_id = resolve_join_sides(self._id, self._left, self._right)
+            if (
+                isinstance(resolved_id, ColumnReference)
+                and resolved_id.table is self._left
+                and resolved_id.name == "id"
+            ):
+                id_from_left = True
+            else:
+                raise NotImplementedError("join id= supports only left.id for now")
+        return Table(
+            TableSpec(
+                "join_select",
+                [self._left, self._right],
+                {
+                    "on": self._on,
+                    "how": self._how,
+                    "exprs": exprs,
+                    "id_from_left": id_from_left,
+                },
+            ),
+            list(exprs.keys()),
+            dtypes,
+        )

@@ -1,0 +1,95 @@
+"""Global capture graph ``G`` and ``pw.run``.
+
+(reference: python/pathway/internals/parse_graph.py:244 + run.py:12).
+Sinks (io.write / subscribe / debug captures) register here; ``pw.run``
+lowers everything reachable and pumps the scheduler — static sources run in
+one commit; connector-backed sources run the streaming loop.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Callable
+
+from pathway_tpu.engine.graph import Node, Scheduler, Scope
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.table import Table
+
+
+@dataclass
+class SinkSpec:
+    table: "Table"
+    attach: Callable[[Scope, Node], Any]  # returns optional driver
+
+
+class ParseGraph:
+    def __init__(self) -> None:
+        self.sinks: list[SinkSpec] = []
+        self.error_log_tables: list[Table] = []
+
+    def add_sink(self, table: "Table", attach: Callable[[Scope, Node], Any]) -> None:
+        self.sinks.append(SinkSpec(table, attach))
+
+    def clear(self) -> None:
+        self.sinks = []
+        self.error_log_tables = []
+
+
+G = ParseGraph()
+
+
+def run(
+    *,
+    monitoring_level: Any = None,
+    with_http_server: bool = False,
+    debug: bool = False,
+    **kwargs: Any,
+) -> None:
+    """Execute the captured graph (reference: pw.run, internals/run.py:12)."""
+    from pathway_tpu.internals.runner import GraphRunner
+
+    runner = GraphRunner()
+    for sink in G.sinks:
+        node = runner.build(sink.table)
+        driver = sink.attach(runner.scope, node)
+        if driver is not None:
+            runner.drivers.append(driver)
+
+    sched = Scheduler(runner.scope)
+    if not runner.drivers:
+        sched.run_static()
+        G.clear()
+        return
+
+    # streaming loop: poll connector drivers, commit when any produced data
+    # (replaces the reference worker main loop, dataflow.rs:5769-5822)
+    drivers = list(runner.drivers)
+    for node in runner.scope.nodes:
+        from pathway_tpu.engine.graph import StaticSource
+
+        if isinstance(node, StaticSource):
+            batch = node.initial_batch()
+            if batch:
+                node.push(0, batch)
+    sched.propagate(sched.time)
+    sched.time += 1
+    while drivers:
+        produced = False
+        for driver in list(drivers):
+            status = driver.poll()
+            if status == "done":
+                drivers.remove(driver)
+                produced = True
+            elif status == "data":
+                produced = True
+        sched.commit()
+        if not produced:
+            _time.sleep(0.001)
+    sched.finish()
+    G.clear()
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

@@ -1,0 +1,512 @@
+"""GraphRunner — lowers the lazy Table graph onto the engine scope.
+
+New implementation of the reference's graph_runner
+(reference: python/pathway/internals/graph_runner/__init__.py:36 +
+expression_evaluator.py + path_evaluator.py): tree-shakes reachable specs,
+flattens columns into engine tuple positions, compiles the expression DSL to
+engine expressions, and pumps the scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Callable, Sequence
+
+from pathway_tpu.engine import expression as eex
+from pathway_tpu.engine.graph import Node, Scheduler, Scope
+from pathway_tpu.engine.reducers import make_reducer
+from pathway_tpu.engine.value import Pointer
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as pex
+from pathway_tpu.internals.desugaring import substitute
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.internals.universe import solver
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.table import Table
+
+
+class Layout:
+    """Maps (table_id, column_name) → tuple position in a storage node."""
+
+    def __init__(self) -> None:
+        self.columns: dict[tuple[int, str], int] = {}
+        self.key_tables: set[int] = set()  # tables whose id == storage key
+        self.id_columns: dict[int, int] = {}  # table_id -> position of its id col
+
+    def position(self, ref: ColumnReference) -> int | None:
+        if ref.name == "id":
+            return self.id_columns.get(ref.table._id)
+        return self.columns.get((ref.table._id, ref.name))
+
+
+_CAST_NAMES = {
+    dt.INT: "Int",
+    dt.FLOAT: "Float",
+    dt.BOOL: "Bool",
+    dt.STR: "String",
+}
+
+
+def _make_kw_fn(fn: Callable, n_pos: int, kw_names: list[str]) -> Callable:
+    if not kw_names:
+        return fn
+
+    def wrapped(*vals: Any) -> Any:
+        pos = vals[:n_pos]
+        kws = dict(zip(kw_names, vals[n_pos:]))
+        return fn(*pos, **kws)
+
+    return wrapped
+
+
+class GraphRunner:
+    def __init__(self, scope: Scope | None = None) -> None:
+        self.scope = scope if scope is not None else Scope()
+        self.nodes: dict[int, Node] = {}
+        self.drivers: list[Any] = []  # connector drivers (streaming mode)
+        self.monitors: list[Any] = []
+
+    # -- expression compilation --------------------------------------------
+
+    def compile(self, expression: ColumnExpression, layout: Layout) -> eex.EngineExpression:
+        override = getattr(expression, "_engine_override", None)
+        if override is not None:
+            return override
+        c = lambda e: self.compile(e, layout)  # noqa: E731
+        if isinstance(expression, ColumnReference):
+            if expression.name == "id":
+                pos = layout.id_columns.get(expression.table._id)
+                if pos is not None:
+                    return eex.ColumnRef(pos)
+                if expression.table._id in layout.key_tables:
+                    return eex.KeyRef()
+                raise ValueError(
+                    f"cannot reference {expression!r} in this context"
+                )
+            pos = layout.position(expression)
+            if pos is None:
+                raise ValueError(
+                    f"column {expression!r} is not available in this context"
+                )
+            return eex.ColumnRef(pos)
+        if isinstance(expression, pex.ColumnConstExpression):
+            return eex.Const(expression._value)
+        if isinstance(expression, pex.BinaryOpExpression):
+            return eex.Binary(expression._op, c(expression._left), c(expression._right))
+        if isinstance(expression, pex.UnaryOpExpression):
+            return eex.Unary(expression._op, c(expression._arg))
+        if isinstance(expression, pex.BooleanExpression):
+            return eex.BooleanChain(expression._op, [c(a) for a in expression._args])
+        if isinstance(expression, pex.IsNoneExpression):
+            return eex.IsNone(c(expression._arg), expression._negated)
+        if isinstance(expression, pex.IfElseExpression):
+            return eex.IfElse(
+                c(expression._cond), c(expression._then), c(expression._otherwise)
+            )
+        if isinstance(expression, pex.CoalesceExpression):
+            return eex.Coalesce([c(a) for a in expression._args])
+        if isinstance(expression, pex.RequireExpression):
+            return eex.Require(c(expression._value), [c(d) for d in expression._deps])
+        if isinstance(expression, pex.ApplyExpression):
+            args = [c(a) for a in expression._args]
+            kw_names = list(expression._kwargs.keys())
+            args += [c(expression._kwargs[k]) for k in kw_names]
+            fn = _make_kw_fn(expression._fn, len(expression._args), kw_names)
+            return eex.Apply(
+                fn,
+                args,
+                propagate_none=expression._propagate_none,
+                deterministic=expression._deterministic,
+            )
+        if isinstance(expression, pex.CastExpression):
+            target = _CAST_NAMES.get(expression._dtype.strip_optional())
+            if target is None:
+                return c(expression._arg)
+            return eex.Cast(c(expression._arg), target)
+        if isinstance(expression, pex.DeclareTypeExpression):
+            return c(expression._arg)
+        if isinstance(expression, pex.ConvertExpression):
+            return eex.Convert(c(expression._arg), expression._target, expression._unwrap)
+        if isinstance(expression, pex.UnwrapExpression):
+            return eex.Unwrap(c(expression._arg))
+        if isinstance(expression, pex.FillErrorExpression):
+            return eex.FillError(c(expression._arg), c(expression._fallback))
+        if isinstance(expression, pex.MakeTupleExpression):
+            return eex.MakeTuple([c(a) for a in expression._args])
+        if isinstance(expression, pex.GetExpression):
+            return eex.SequenceGet(
+                c(expression._arg),
+                c(expression._index),
+                c(expression._default) if expression._default is not None else None,
+                expression._checked,
+            )
+        if isinstance(expression, pex.PointerExpression):
+            return eex.PointerFrom(
+                [c(a) for a in expression._args],
+                c(expression._instance) if expression._instance is not None else None,
+            )
+        if isinstance(expression, pex.ReducerExpression):
+            raise ValueError("reducers are only allowed inside .reduce(...)")
+        raise NotImplementedError(f"cannot compile expression {expression!r}")
+
+    # -- storage ------------------------------------------------------------
+
+    def storage_for(
+        self, base: "Table", expressions: Sequence[ColumnExpression]
+    ) -> tuple[Node, Layout]:
+        """Build a storage node exposing ``base``'s columns plus any columns
+        of other (universe-related) tables referenced by ``expressions``."""
+        tables: dict[int, "Table"] = {base._id: base}
+        for e in expressions:
+            for ref in e._dependencies():
+                t = ref.table
+                if t._id not in tables:
+                    if not solver.query_related(base._universe, t._universe):
+                        raise ValueError(
+                            f"column {ref!r} belongs to a table with an unrelated "
+                            f"universe; join or use with_universe_of first"
+                        )
+                    tables[t._id] = t
+        ordered = [base] + [t for tid, t in sorted(tables.items()) if tid != base._id]
+        nodes = [self.build(t) for t in ordered]
+        storage = self.scope.zip_tables(nodes)
+        layout = Layout()
+        offset = 0
+        for t in ordered:
+            for i, name in enumerate(t._column_names):
+                layout.columns[(t._id, name)] = offset + i
+            layout.key_tables.add(t._id)
+            offset += len(t._column_names)
+        return storage, layout
+
+    def base_layout(self, table: "Table") -> Layout:
+        layout = Layout()
+        for i, name in enumerate(table._column_names):
+            layout.columns[(table._id, name)] = i
+        layout.key_tables.add(table._id)
+        return layout
+
+    # -- lowering -----------------------------------------------------------
+
+    def build(self, table: "Table") -> Node:
+        if table._id in self.nodes:
+            return self.nodes[table._id]
+        node = self._build(table)
+        node.name = f"{table._spec.kind}<{table._name}>"
+        node.trace = table._trace
+        self.nodes[table._id] = node
+        return node
+
+    def _project(self, node: Node, positions: Sequence[int]) -> Node:
+        return self.scope.expression_table(node, [eex.ColumnRef(i) for i in positions])
+
+    def _build(self, table: "Table") -> Node:
+        spec = table._spec
+        kind = spec.kind
+        scope = self.scope
+
+        if kind == "static":
+            return scope.static_table(spec.params["rows"], len(table._column_names))
+
+        if kind == "input":
+            # connector-backed table: the io layer supplies an attach function
+            attach = spec.params["attach"]
+            node, driver = attach(scope)
+            if driver is not None:
+                self.drivers.append(driver)
+            return node
+
+        if kind == "select":
+            exprs = spec.params["exprs"]
+            expr_list = list(exprs.values())
+            storage, layout = self.storage_for(spec.inputs[0], expr_list)
+            return scope.expression_table(storage, [self.compile(e, layout) for e in expr_list])
+
+        if kind == "filter":
+            base = spec.inputs[0]
+            cond = spec.params["condition"]
+            storage, layout = self.storage_for(base, [cond])
+            n = len(base._column_names)
+            pre = scope.expression_table(
+                storage,
+                [
+                    self.compile(ColumnReference(base, name), layout)
+                    for name in base._column_names
+                ]
+                + [self.compile(cond, layout)],
+            )
+            filtered = scope.filter_table(pre, n)
+            return self._project(filtered, range(n))
+
+        if kind == "remove_errors":
+            return scope.remove_errors_from_table(self.build(spec.inputs[0]))
+
+        if kind == "groupby_reduce":
+            return self._build_groupby(table)
+
+        if kind == "join_select":
+            return self._build_join(table)
+
+        if kind == "concat":
+            aligned = []
+            for t in spec.inputs:
+                node = self.build(t)
+                layout = self.base_layout(t)
+                aligned.append(
+                    scope.expression_table(
+                        node,
+                        [
+                            self.compile(ColumnReference(t, name), layout)
+                            for name in table._column_names
+                        ],
+                    )
+                )
+            return scope.concat_tables(aligned)
+
+        if kind == "update_rows":
+            orig, updates = spec.inputs
+            orig_node = self.build(orig)
+            upd_node = self.build(updates)
+            upd_layout = self.base_layout(updates)
+            upd_aligned = scope.expression_table(
+                upd_node,
+                [
+                    self.compile(ColumnReference(updates, name), upd_layout)
+                    for name in table._column_names
+                ],
+            )
+            return scope.update_rows_table(orig_node, upd_aligned)
+
+        if kind == "update_cells":
+            orig, updates = spec.inputs
+            orig_node = self.build(orig)
+            upd_node = self.build(updates)
+            update_cols = [
+                updates._column_names.index(name) if name in updates._column_names else -1
+                for name in table._column_names
+            ]
+            return scope.update_cells_table(orig_node, upd_node, update_cols)
+
+        if kind == "reindex":
+            base = spec.inputs[0]
+            new_id = spec.params["new_id"]
+            storage, layout = self.storage_for(base, [new_id])
+            n = len(base._column_names)
+            pre = scope.expression_table(
+                storage,
+                [
+                    self.compile(ColumnReference(base, name), layout)
+                    for name in base._column_names
+                ]
+                + [self.compile(new_id, layout)],
+            )
+            reindexed = scope.reindex_table(pre, n)
+            return self._project(reindexed, range(n))
+
+        if kind == "intersect":
+            base, *others = spec.inputs
+            return scope.intersect_tables(
+                self.build(base), [self.build(o) for o in others]
+            )
+
+        if kind == "subtract":
+            base, other = spec.inputs
+            return scope.subtract_table(self.build(base), self.build(other))
+
+        if kind == "restrict":
+            base, other = spec.inputs
+            return scope.restrict_table(self.build(base), self.build(other))
+
+        if kind == "override_universe":
+            base, other = spec.inputs
+            return scope.override_table_universe(self.build(base), self.build(other))
+
+        if kind == "flatten":
+            base = spec.inputs[0]
+            col_idx = base._column_names.index(spec.params["column"])
+            return scope.flatten_table(self.build(base), col_idx)
+
+        if kind == "sort":
+            base = spec.inputs[0]
+            key_expr = spec.params["key"]
+            inst_expr = spec.params["instance"]
+            exprs = [key_expr] + ([inst_expr] if inst_expr is not None else [])
+            storage, layout = self.storage_for(base, exprs)
+            pre = scope.expression_table(storage, [self.compile(e, layout) for e in exprs])
+            return scope.sort_table(pre, 0, 1 if inst_expr is not None else None)
+
+        if kind == "ix":
+            keys_table, source = spec.inputs
+            keys_node = self.build(keys_table)
+            source_node = self.build(source)
+            key_col = keys_table._column_names.index("_pw_ix_key")
+            return scope.ix_table(
+                keys_node,
+                source_node,
+                key_col,
+                optional=spec.params.get("optional", False),
+            )
+
+        if kind == "deduplicate":
+            base = spec.inputs[0]
+            value = spec.params["value"]
+            instance = spec.params["instance"]
+            storage, layout = self.storage_for(base, [value, *instance])
+            n = len(base._column_names)
+            pre_exprs = [
+                self.compile(ColumnReference(base, name), layout)
+                for name in base._column_names
+            ]
+            pre_exprs.append(self.compile(value, layout))
+            for inst in instance:
+                pre_exprs.append(self.compile(inst, layout))
+            pre = scope.expression_table(storage, pre_exprs)
+            dedup = scope.deduplicate(
+                pre,
+                value_col=n,
+                instance_cols=list(range(n + 1, n + 1 + len(instance))),
+                acceptor=spec.params["acceptor"],
+            )
+            return self._project(dedup, range(n))
+
+        if kind == "buffer":
+            raise NotImplementedError("temporal behaviors arrive with the temporal module")
+
+        raise NotImplementedError(f"unknown table spec kind {kind!r}")
+
+    def _build_groupby(self, table: "Table") -> Node:
+        from pathway_tpu.internals.table import Table as TableCls
+
+        spec = table._spec
+        base = spec.inputs[0]
+        by_refs: list[ColumnReference] = spec.params["by"]
+        exprs: dict[str, ColumnExpression] = spec.params["exprs"]
+        set_id: bool = spec.params["set_id"]
+        scope = self.scope
+
+        # collect distinct reducer nodes over all output expressions
+        reducer_nodes: list[pex.ReducerExpression] = []
+
+        def collect(e: ColumnExpression) -> None:
+            if isinstance(e, pex.ReducerExpression):
+                if not any(e is r for r in reducer_nodes):
+                    reducer_nodes.append(e)
+                return
+            for child in e._children():
+                collect(child)
+
+        for e in exprs.values():
+            collect(e)
+
+        arg_exprs: list[ColumnExpression] = []
+        for r in reducer_nodes:
+            arg_exprs.extend(r._args)
+
+        storage, layout = self.storage_for(base, [*by_refs, *arg_exprs])
+        pre_exprs: list[eex.EngineExpression] = [
+            self.compile(b, layout) for b in by_refs
+        ]
+        nb = len(by_refs)
+        reducer_descr = []
+        pos = nb
+        for r in reducer_nodes:
+            arg_cols = list(range(pos, pos + len(r._args)))
+            pre_exprs.extend(self.compile(a, layout) for a in r._args)
+            pos += len(r._args)
+            # ARG_MIN/ARG_MAX take (value, row-id) pairs
+            from pathway_tpu.engine.reducers import ReducerKind
+
+            if r._kind in (ReducerKind.ARG_MIN, ReducerKind.ARG_MAX):
+                pre_exprs.append(eex.KeyRef())
+                arg_cols = [arg_cols[0], pos]
+                pos += 1
+            reducer_descr.append((make_reducer(r._kind, **r._options), arg_cols))
+
+        pre = scope.expression_table(storage, pre_exprs)
+        grouped = scope.group_by_table(
+            pre,
+            by_cols=list(range(nb)),
+            reducers=reducer_descr,
+            set_id=set_id,
+        )
+
+        # post-projection: reducer nodes -> group-row positions; by refs too
+        by_positions = {(b.table._id, b.name): i for i, b in enumerate(by_refs)}
+
+        post_layout = Layout()
+        post_layout.columns.update(by_positions)
+
+        def replace(e: ColumnExpression) -> ColumnExpression | None:
+            for i, r in enumerate(reducer_nodes):
+                if e is r:
+                    marker = pex.ColumnConstExpression(None)
+                    marker._engine_override = eex.ColumnRef(nb + i)  # type: ignore[attr-defined]
+                    return marker
+            return None
+
+        post_exprs = []
+        for e in exprs.values():
+            substituted = substitute(e, replace)
+            post_exprs.append(self.compile(substituted, post_layout))
+        return scope.expression_table(grouped, post_exprs)
+
+    def _build_join(self, table: "Table") -> Node:
+        spec = table._spec
+        left, right = spec.inputs
+        on = spec.params["on"]
+        how = spec.params["how"]
+        exprs: dict[str, ColumnExpression] = spec.params["exprs"]
+        scope = self.scope
+
+        left_node = self.build(left)
+        right_node = self.build(right)
+        llayout = self.base_layout(left)
+        rlayout = self.base_layout(right)
+
+        nl = len(left._column_names)
+        nr = len(right._column_names)
+        k = len(on)
+
+        left_prep = scope.expression_table(
+            left_node,
+            [eex.ColumnRef(i) for i in range(nl)]
+            + [eex.KeyRef()]
+            + [self.compile(le, llayout) for le, _re in on],
+        )
+        right_prep = scope.expression_table(
+            right_node,
+            [eex.ColumnRef(i) for i in range(nr)]
+            + [eex.KeyRef()]
+            + [self.compile(re_, rlayout) for _le, re_ in on],
+        )
+        joined = scope.join_tables(
+            left_prep,
+            right_prep,
+            left_on=list(range(nl + 1, nl + 1 + k)),
+            right_on=list(range(nr + 1, nr + 1 + k)),
+            kind=how,
+            id_from_left=spec.params.get("id_from_left", False),
+        )
+        combined = Layout()
+        for i, name in enumerate(left._column_names):
+            combined.columns[(left._id, name)] = i
+        combined.id_columns[left._id] = nl
+        off = nl + 1 + k
+        for i, name in enumerate(right._column_names):
+            combined.columns[(right._id, name)] = off + i
+        combined.id_columns[right._id] = off + nr
+        return scope.expression_table(
+            joined, [self.compile(e, combined) for e in exprs.values()]
+        )
+
+    # -- execution ----------------------------------------------------------
+
+    def run_static(self) -> Scheduler:
+        sched = Scheduler(self.scope)
+        sched.run_static()
+        return sched
+
+    def capture(self, *tables: "Table") -> list[dict[Pointer, tuple]]:
+        nodes = [self.build(t) for t in tables]
+        self.run_static()
+        return [node.snapshot() for node in nodes]

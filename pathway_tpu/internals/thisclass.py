@@ -1,0 +1,60 @@
+"""``pw.this`` / ``pw.left`` / ``pw.right`` placeholders.
+
+New implementation of the reference's thisclass
+(reference: python/pathway/internals/thisclass.py, 313 LoC). Placeholders are
+resolved eagerly by the consuming method (``select``/``filter``/``join``...)
+via :mod:`pathway_tpu.internals.desugaring`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import ColumnExpression
+
+
+class ThisColumnReference(ColumnExpression):
+    """``pw.this.colname`` — bound to a concrete table at call time."""
+
+    def __init__(self, owner: "ThisMetaclass", name: str) -> None:
+        self._owner = owner
+        self._name = name
+        self._dtype = dt.ANY
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _dependencies(self):
+        raise RuntimeError(
+            f"pw.{self._owner._side}.{self._name} used outside of a table context"
+        )
+
+    def __repr__(self) -> str:
+        return f"pw.{self._owner._side}.{self._name}"
+
+
+class ThisMetaclass:
+    def __init__(self, side: str) -> None:
+        self._side = side
+
+    def __getattr__(self, name: str) -> ThisColumnReference:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ThisColumnReference(self, name)
+
+    def __getitem__(self, name: str) -> ThisColumnReference:
+        return ThisColumnReference(self, name)
+
+    def __repr__(self) -> str:
+        return f"pw.{self._side}"
+
+
+this = ThisMetaclass("this")
+left = ThisMetaclass("left")
+right = ThisMetaclass("right")
+
+
+def is_this_ref(value: Any) -> bool:
+    return isinstance(value, ThisColumnReference)

@@ -1,0 +1,89 @@
+"""Universe (key-set) tracking.
+
+Replaces the reference's SAT-based UniverseSolver
+(reference: python/pathway/internals/universe_solver.py — pysat Glucose4)
+with a union-find over equality promises plus a subset DAG; the engine's
+zip/restrict operators are forgiving enough that full SAT reasoning is not
+needed for correctness, only for early error messages.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+_counter = itertools.count()
+
+
+class Universe:
+    __slots__ = ("id",)
+
+    def __init__(self) -> None:
+        self.id = next(_counter)
+
+    def __repr__(self) -> str:
+        return f"Universe({self.id})"
+
+    def subset(self) -> "Universe":
+        u = Universe()
+        solver.register_subset(u, self)
+        return u
+
+    def superset(self) -> "Universe":
+        u = Universe()
+        solver.register_subset(self, u)
+        return u
+
+
+class UniverseSolver:
+    def __init__(self) -> None:
+        self._parent: dict[int, int] = {}
+        self._subsets: set[tuple[int, int]] = set()  # (sub, sup) pairs on roots
+
+    def _find(self, x: int) -> int:
+        parent = self._parent.get(x, x)
+        if parent == x:
+            return x
+        root = self._find(parent)
+        self._parent[x] = root
+        return root
+
+    def register_equal(self, a: Universe, b: Universe) -> None:
+        ra, rb = self._find(a.id), self._find(b.id)
+        if ra != rb:
+            self._parent[ra] = rb
+
+    def register_subset(self, sub: Universe, sup: Universe) -> None:
+        self._subsets.add((self._find(sub.id), self._find(sup.id)))
+
+    def query_are_equal(self, a: Universe, b: Universe) -> bool:
+        return self._find(a.id) == self._find(b.id)
+
+    def query_is_subset(self, sub: Universe, sup: Universe) -> bool:
+        rs, rp = self._find(sub.id), self._find(sup.id)
+        if rs == rp:
+            return True
+        # BFS over subset edges (roots may drift after unions; normalize)
+        edges: dict[int, set[int]] = {}
+        for s, p in self._subsets:
+            edges.setdefault(self._find(s), set()).add(self._find(p))
+        seen = {rs}
+        frontier = [rs]
+        while frontier:
+            cur = frontier.pop()
+            if cur == rp:
+                return True
+            for nxt in edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        return rp in seen
+
+    def query_related(self, a: Universe, b: Universe) -> bool:
+        return (
+            self.query_are_equal(a, b)
+            or self.query_is_subset(a, b)
+            or self.query_is_subset(b, a)
+        )
+
+
+solver = UniverseSolver()

@@ -1,0 +1,403 @@
+"""High-level Table API golden tests (analog of reference test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    run_tables,
+)
+
+
+def test_table_from_markdown_and_print(capsys):
+    t = T(
+        """
+          | name  | age
+        1 | Alice | 10
+        2 | Bob   | 9
+        """
+    )
+    assert t.column_names() == ["name", "age"]
+    pw.debug.compute_and_print(t)
+    out = capsys.readouterr().out
+    assert "Alice" in out and "Bob" in out
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+          | a | b
+        1 | 1 | 2
+        2 | 3 | 4
+        """
+    )
+    result = t.select(s=t.a + t.b, d=pw.this.b - pw.this.a)
+    expected = T(
+        """
+          | s | d
+        1 | 3 | 1
+        2 | 7 | 1
+        """
+    )
+    assert_table_equality(result, expected)
+
+
+def test_filter_with_this():
+    t = T(
+        """
+          | v
+        1 | 5
+        2 | 15
+        3 | 25
+        """
+    )
+    result = t.filter(pw.this.v > 10).select(v=pw.this.v)
+    expected = T(
+        """
+          | v
+        2 | 15
+        3 | 25
+        """
+    )
+    assert_table_equality(result, expected)
+
+
+def test_with_columns_and_rename():
+    t = T(
+        """
+          | a
+        1 | 1
+        """
+    )
+    result = t.with_columns(b=t.a * 10).rename(c="b")
+    assert set(result.column_names()) == {"a", "c"}
+
+
+def test_groupby_reduce():
+    t = T(
+        """
+          | shop | amount
+        1 | a    | 10
+        2 | a    | 20
+        3 | b    | 5
+        """
+    )
+    result = t.groupby(t.shop).reduce(
+        t.shop,
+        total=pw.reducers.sum(t.amount),
+        cnt=pw.reducers.count(),
+        lo=pw.reducers.min(pw.this.amount),
+    )
+    expected = T(
+        """
+        shop | total | cnt | lo
+        a    | 30    | 2   | 10
+        b    | 5     | 1   | 5
+        """
+    )
+    assert_table_equality_wo_index(result, expected)
+
+
+def test_global_reduce():
+    t = T(
+        """
+          | v
+        1 | 1
+        2 | 2
+        3 | 3
+        """
+    )
+    result = t.reduce(total=pw.reducers.sum(t.v))
+    (snap,) = run_tables(result)
+    assert list(snap.values()) == [(6,)]
+
+
+def test_reducers_avg():
+    t = T(
+        """
+          | g | v
+        1 | x | 1
+        2 | x | 2
+        """
+    )
+    result = t.groupby(t.g).reduce(t.g, mean=pw.reducers.avg(t.v))
+    (snap,) = run_tables(result)
+    assert set(snap.values()) == {("x", 1.5)}
+
+
+def test_argmax_with_ix():
+    t = T(
+        """
+          | name  | score
+        1 | a     | 3
+        2 | b     | 7
+        3 | c     | 5
+        """
+    )
+    best = t.reduce(best_id=pw.reducers.argmax(t.score))
+    best_row = t.ix(best.best_id).select(name=pw.this.name)
+    (snap,) = run_tables(best_row)
+    assert list(snap.values()) == [("b",)]
+
+
+def test_join_inner():
+    t1 = T(
+        """
+          | k | a
+        1 | x | 1
+        2 | y | 2
+        """
+    )
+    t2 = T(
+        """
+          | k | b
+        1 | x | 10
+        2 | z | 30
+        """
+    )
+    joined = t1.join(t2, t1.k == t2.k).select(t1.k, a=pw.left.a, b=pw.right.b)
+    expected = T(
+        """
+        k | a | b
+        x | 1 | 10
+        """
+    )
+    assert_table_equality_wo_index(joined, expected)
+
+
+def test_join_left_with_none():
+    t1 = T(
+        """
+          | k | a
+        1 | x | 1
+        2 | y | 2
+        """
+    )
+    t2 = T(
+        """
+          | k | b
+        1 | x | 10
+        """
+    )
+    joined = t1.join_left(t2, t1.k == t2.k).select(
+        t1.k, b=pw.coalesce(pw.right.b, -1)
+    )
+    expected = T(
+        """
+        k | b
+        x | 10
+        y | -1
+        """
+    )
+    assert_table_equality_wo_index(joined, expected)
+
+
+def test_concat_and_update_rows():
+    t1 = T(
+        """
+          | v
+        1 | 1
+        """
+    )
+    t2 = T(
+        """
+          | v
+        2 | 2
+        """
+    )
+    both = t1.concat(t2)
+    (snap,) = run_tables(both)
+    assert sorted(r[0] for r in snap.values()) == [1, 2]
+
+    upd = T(
+        """
+          | v
+        1 | 100
+        3 | 300
+        """
+    )
+    merged = t1.update_rows(upd)
+    (snap,) = run_tables(merged)
+    assert sorted(r[0] for r in snap.values()) == [100, 300]
+
+
+def test_update_cells_lshift():
+    t = T(
+        """
+          | a | b
+        1 | 1 | x
+        2 | 2 | y
+        """
+    )
+    patch = T(
+        """
+          | a
+        1 | 100
+        """
+    )
+    out = t << patch
+    (snap,) = run_tables(out)
+    assert sorted(snap.values()) == sorted([(100, "x"), (2, "y")])
+
+
+def test_with_id_from():
+    t = T(
+        """
+          | k | v
+        1 | a | 1
+        2 | b | 2
+        """
+    )
+    rekeyed = t.with_id_from(t.k)
+    (snap,) = run_tables(rekeyed)
+    from pathway_tpu.engine.value import ref_scalar
+
+    assert set(snap.keys()) == {ref_scalar("a"), ref_scalar("b")}
+
+
+def test_flatten():
+    t = T(
+        """
+          | text
+        1 | a,b,c
+        """
+    ).select(parts=pw.apply_with_type(lambda s: tuple(s.split(",")), tuple[str, ...], pw.this.text))
+    flat = t.flatten(pw.this.parts)
+    (snap,) = run_tables(flat)
+    assert sorted(r[0] for r in snap.values()) == ["a", "b", "c"]
+
+
+def test_apply_and_if_else():
+    t = T(
+        """
+          | v
+        1 | -2
+        2 | 3
+        """
+    )
+    out = t.select(
+        sign=pw.if_else(t.v >= 0, "pos", "neg"),
+        doubled=pw.apply(lambda x: x * 2, t.v),
+    )
+    (snap,) = run_tables(out)
+    assert sorted(snap.values()) == sorted([("neg", -4), ("pos", 6)])
+
+
+def test_str_namespace():
+    t = T(
+        """
+          | s
+        1 | Hello
+        """
+    )
+    out = t.select(up=t.s.str.upper(), n=t.s.str.len())
+    (snap,) = run_tables(out)
+    assert list(snap.values()) == [("HELLO", 5)]
+
+
+def test_cross_table_same_universe_select():
+    t = T(
+        """
+          | a
+        1 | 1
+        2 | 2
+        """
+    )
+    t2 = t.select(b=t.a * 10)
+    out = t.select(t.a, b=t2.b)
+    (snap,) = run_tables(out)
+    assert sorted(snap.values()) == sorted([(1, 10), (2, 20)])
+
+
+def test_ix_lookup():
+    people = T(
+        """
+          | name  | boss
+        1 | Alice | 2
+        2 | Bob   | 2
+        """
+    )
+    people = people.with_id_from(pw.this.name)
+    bosses = T(
+        """
+          | bname
+        1 | Bob
+        """
+    )
+    refs = people.select(bossref=people.pointer_from(pw.apply_with_type(lambda b: "Bob", str, pw.this.name)))
+    out = people.ix(refs.bossref).select(boss_name=pw.this.name)
+    (snap,) = run_tables(out)
+    assert set(snap.values()) == {("Bob",)}
+
+
+def test_sort_prev_next_api():
+    t = T(
+        """
+          | v
+        1 | 30
+        2 | 10
+        3 | 20
+        """
+    )
+    s = t.sort(key=pw.this.v)
+    (snap,) = run_tables(s)
+    from pathway_tpu.engine.value import ref_scalar
+
+    assert snap[ref_scalar(2)][0] is None  # smallest has no prev
+    assert snap[ref_scalar(1)][1] is None  # largest has no next
+
+
+def test_error_does_not_crash_run():
+    t = T(
+        """
+          | a | b
+        1 | 1 | 0
+        2 | 8 | 2
+        """
+    )
+    out = t.select(q=t.a // t.b)
+    (snap,) = run_tables(out)
+    from pathway_tpu.engine.value import is_error
+
+    vals = {k: v[0] for k, v in snap.items()}
+    assert sorted(str(v) for v in vals.values()) == ["4", "Error"]
+
+
+def test_fill_error():
+    t = T(
+        """
+          | a | b
+        1 | 1 | 0
+        2 | 8 | 2
+        """
+    )
+    out = t.select(q=pw.fill_error(t.a // t.b, -1))
+    (snap,) = run_tables(out)
+    assert sorted(r[0] for r in snap.values()) == [-1, 4]
+
+
+def test_deduplicate_api():
+    t = T(
+        """
+          | g | v
+        1 | x | 5
+        2 | x | 3
+        3 | x | 10
+        """
+    )
+    out = t.deduplicate(value=pw.this.v, instance=pw.this.g, acceptor=lambda new, old: new > old)
+    (snap,) = run_tables(out)
+    assert [r[1] for r in snap.values()] == [10]
+
+
+def test_schema_property():
+    t = T(
+        """
+          | a | b
+        1 | 1 | x
+        """
+    )
+    schema = t.schema
+    assert schema.column_names() == ["a", "b"]

@@ -1,0 +1,42 @@
+"""Test helpers (analog of reference python/pathway/tests/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner
+from pathway_tpu.internals.table import Table
+
+T = pw.debug.table_from_markdown
+
+
+def run_tables(*tables: Table) -> list[dict]:
+    runner = GraphRunner()
+    return runner.capture(*tables)
+
+
+def assert_table_equality(actual: Table, expected: Table) -> None:
+    """Same keys and same rows (column order from each table's own schema)."""
+    a, e = run_tables(actual, expected)
+    a_named = {
+        k: dict(zip(actual.column_names(), row)) for k, row in a.items()
+    }
+    e_named = {
+        k: dict(zip(expected.column_names(), row)) for k, row in e.items()
+    }
+    assert a_named == e_named, f"tables differ:\n actual={a_named}\n expected={e_named}"
+
+
+def assert_table_equality_wo_index(actual: Table, expected: Table) -> None:
+    """Same multiset of rows, ignoring ids."""
+    a, e = run_tables(actual, expected)
+    a_rows = sorted(
+        (tuple(sorted(zip(actual.column_names(), row), key=lambda kv: kv[0])) for row in a.values()),
+        key=repr,
+    )
+    e_rows = sorted(
+        (tuple(sorted(zip(expected.column_names(), row), key=lambda kv: kv[0])) for row in e.values()),
+        key=repr,
+    )
+    assert a_rows == e_rows, f"tables differ:\n actual={a_rows}\n expected={e_rows}"
