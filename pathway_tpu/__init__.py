@@ -47,6 +47,8 @@ from pathway_tpu.internals.thisclass import left, right, this
 from pathway_tpu.internals import universe as _universe_mod
 
 from pathway_tpu import debug  # noqa: E402  (imports Table)
+from pathway_tpu import demo  # noqa: E402
+from pathway_tpu import io  # noqa: E402
 
 
 class universes:

@@ -199,6 +199,56 @@ def compute_and_print(
         print(" | ".join(c.ljust(w) for c, w in zip(cells, widths)))
 
 
+class StreamGenerator:
+    """Emit explicit batches at artificial times for streaming tests
+    (reference: debug/__init__.py:500)."""
+
+    def __init__(self) -> None:
+        self._counter = 0
+
+    def table_from_list_of_batches_by_workers(
+        self,
+        batches: Sequence[dict[int, list[dict[str, Any]]]],
+        schema: schema_mod.SchemaMetaclass,
+    ) -> Table:
+        flat = [[row for rows in batch.values() for row in rows] for batch in batches]
+        return self.table_from_list_of_batches(flat, schema)
+
+    def table_from_list_of_batches(
+        self,
+        batches: Sequence[list[dict[str, Any]]],
+        schema: schema_mod.SchemaMetaclass,
+    ) -> Table:
+        from pathway_tpu.engine.connectors import INSERT, BatchScheduleDriver
+        from pathway_tpu.engine.graph import Scope
+        from pathway_tpu.internals.table import TableSpec
+
+        names = schema.column_names()
+        dtypes = schema.dtypes()
+        seq = iter(range(10**9))
+        schedule = []
+        for batch in batches:
+            entries = []
+            for row in batch:
+                values = tuple(
+                    dt.normalize_value(row.get(n), dtypes[n]) for n in names
+                )
+                entries.append((INSERT, ref_scalar("sg", next(seq)), values))
+            schedule.append(entries)
+
+        def attach(scope: Scope):
+            session = scope.input_session(len(names))
+            driver = BatchScheduleDriver(session, schedule)
+            return session, driver
+
+        return Table(
+            TableSpec("input", [], {"attach": attach}),
+            names,
+            dtypes,
+            name="stream-generator",
+        )
+
+
 def compute_and_print_update_stream(table: Table, **kwargs: Any) -> None:
     runner = GraphRunner()
     node = runner.build(table)

@@ -1,0 +1,178 @@
+"""pw.demo — artificial streams for examples and tests.
+
+(reference: python/pathway/demo/__init__.py, 339 LoC —
+generate_custom_stream :28, noisy_linear_stream, range_stream,
+replay_csv :212, replay_csv_with_time :258.)
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import random
+from typing import Any, Callable, Mapping
+
+from pathway_tpu.engine.connectors import (
+    INSERT,
+    BatchScheduleDriver,
+    DsvParser,
+    FsReader,
+    InputDriver,
+    ParsedEvent,
+    Parser,
+    QueueReader,
+    Reader,
+)
+from pathway_tpu.engine.graph import Scope
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table, TableSpec
+from pathway_tpu.io._utils import converter_for, input_table
+
+
+class _GeneratorReader(Reader):
+    """Emits up to ``batch_size`` generated rows per poll."""
+
+    def __init__(self, nb_rows: int | None, batch_size: int = 1) -> None:
+        self.nb_rows = nb_rows
+        self.batch_size = batch_size
+        self.emitted = 0
+
+    def poll(self):
+        if self.nb_rows is not None and self.emitted >= self.nb_rows:
+            return [], True
+        count = self.batch_size
+        if self.nb_rows is not None:
+            count = min(count, self.nb_rows - self.emitted)
+        entries = [(self.emitted + i, f"gen:{self.emitted + i}", {}) for i in range(count)]
+        self.emitted += count
+        return entries, self.nb_rows is not None and self.emitted >= self.nb_rows
+
+
+class _GeneratorParser(Parser):
+    def __init__(self, column_names, value_generators) -> None:
+        super().__init__(column_names)
+        self.value_generators = value_generators
+
+    def parse(self, payload: int) -> list[ParsedEvent]:
+        values = tuple(self.value_generators[name](payload) for name in self.column_names)
+        return [ParsedEvent(INSERT, values)]
+
+
+def generate_custom_stream(
+    value_generators: Mapping[str, Callable[[int], Any]],
+    *,
+    schema: schema_mod.SchemaMetaclass,
+    nb_rows: int | None = None,
+    autocommit_duration_ms: int = 1000,
+    input_rate: float = 1.0,
+    batch_size: int = 1,
+    **kwargs: Any,
+) -> Table:
+    return input_table(
+        schema,
+        lambda: _GeneratorReader(nb_rows, batch_size),
+        lambda names: _GeneratorParser(names, dict(value_generators)),
+        source_name="demo-stream",
+    )
+
+
+def range_stream(
+    nb_rows: int | None = 30,
+    offset: int = 0,
+    input_rate: float = 1.0,
+    **kwargs: Any,
+) -> Table:
+    schema = schema_mod.schema_from_types(value=int)
+    return generate_custom_stream(
+        {"value": lambda i: i + offset},
+        schema=schema,
+        nb_rows=nb_rows,
+        input_rate=input_rate,
+    )
+
+
+def noisy_linear_stream(
+    nb_rows: int = 10, input_rate: float = 1.0, **kwargs: Any
+) -> Table:
+    schema = schema_mod.schema_from_types(x=float, y=float)
+    rng = random.Random(0)
+    return generate_custom_stream(
+        {
+            "x": lambda i: float(i),
+            "y": lambda i: float(i) + rng.uniform(-1, 1),
+        },
+        schema=schema,
+        nb_rows=nb_rows,
+        input_rate=input_rate,
+    )
+
+
+def replay_csv(
+    path: str,
+    *,
+    schema: schema_mod.SchemaMetaclass,
+    input_rate: float = 1.0,
+    **kwargs: Any,
+) -> Table:
+    """Replay a CSV file as a bounded stream (one commit batch per poll)."""
+    dtypes = schema.dtypes()
+
+    def make_reader():
+        return FsReader(path, mode="static")
+
+    def make_parser(names):
+        return DsvParser(names, converters=[converter_for(dtypes[n]) for n in names])
+
+    return input_table(schema, make_reader, make_parser, source_name=f"replay:{path}")
+
+
+def replay_csv_with_time(
+    path: str,
+    *,
+    schema: schema_mod.SchemaMetaclass,
+    time_column: str,
+    unit: str = "s",
+    autocommit_ms: int = 100,
+    speedup: float = 1,
+    **kwargs: Any,
+) -> Table:
+    """Replay a CSV using its time column to group commit batches: rows with
+    the same (scaled) time value arrive in the same commit."""
+    names = schema.column_names()
+    dtypes = schema.dtypes()
+    convs = [converter_for(dtypes[n]) for n in names]
+    tpos = names.index(time_column)
+
+    with open(path, newline="", encoding="utf-8") as f:
+        reader = _csv.reader(f)
+        header = next(reader)
+        positions = [header.index(n) for n in names]
+        rows = []
+        for row in reader:
+            values = tuple(
+                conv(row[p]) for conv, p in zip(convs, positions)
+            )
+            rows.append(values)
+    rows.sort(key=lambda r: r[tpos])
+
+    batches: list[list] = []
+    current_time = None
+    for i, values in enumerate(rows):
+        t = values[tpos]
+        if t != current_time:
+            batches.append([])
+            current_time = t
+        batches[-1].append((INSERT, ref_scalar(i), values))
+
+    def attach(scope: Scope):
+        session = scope.input_session(len(names))
+        driver = BatchScheduleDriver(session, batches)
+        return session, driver
+
+    return Table(
+        TableSpec("input", [], {"attach": attach}),
+        names,
+        dtypes,
+        name=f"replay:{path}",
+    )

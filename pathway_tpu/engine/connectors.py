@@ -1,0 +1,413 @@
+"""Connector framework: Reader → Parser → InputSession and
+Subscribe → Formatter → Writer.
+
+New implementation of the reference connector subsystem
+(reference: src/connectors/mod.rs:428 `Connector::run` pull loop,
+data_storage.rs Reader/Writer traits :372/:600, data_format.rs
+Parser/Formatter traits :262/:452). The reference spawns one thread per
+source plus a poller closure stepped by the worker loop; here each source is
+an :class:`InputDriver` polled by the streaming run loop between commits —
+same contract (bounded batches per commit, commit timestamps), simpler
+machinery. Python push-sources use a thread + queue like the reference's
+PythonSubject (python_api.rs PythonSubject).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import io as _io
+import json as _json
+import os
+import queue
+import threading
+import time as _time
+from typing import Any, Callable, Iterable, Sequence
+
+from pathway_tpu.engine.graph import InputSession, Node, Scope
+from pathway_tpu.engine.value import Json, Pointer, hash_values, ref_scalar
+
+# -- parsed events ----------------------------------------------------------
+
+INSERT = "insert"
+DELETE = "delete"
+
+
+class ParsedEvent:
+    __slots__ = ("kind", "values")
+
+    def __init__(self, kind: str, values: tuple) -> None:
+        self.kind = kind
+        self.values = values
+
+
+# -- parsers ----------------------------------------------------------------
+
+
+class Parser:
+    """payload (str/bytes) → list of ParsedEvent with values in schema order."""
+
+    def __init__(self, column_names: Sequence[str]) -> None:
+        self.column_names = list(column_names)
+
+    def parse(self, payload: Any) -> list[ParsedEvent]:
+        raise NotImplementedError
+
+
+class DsvParser(Parser):
+    """Delimiter-separated values with a header row (reference: DsvParser
+    data_format.rs:500)."""
+
+    def __init__(
+        self,
+        column_names: Sequence[str],
+        converters: Sequence[Callable[[str], Any]] | None = None,
+        delimiter: str = ",",
+    ) -> None:
+        super().__init__(column_names)
+        self.delimiter = delimiter
+        self.converters = list(converters) if converters else None
+        self._header: list[str] | None = None
+
+    def reset(self) -> None:
+        self._header = None
+
+    def parse(self, payload: str) -> list[ParsedEvent]:
+        rows = list(_csv.reader(_io.StringIO(payload), delimiter=self.delimiter))
+        if not rows:
+            return []
+        events = []
+        start = 0
+        if self._header is None:
+            self._header = [h.strip() for h in rows[0]]
+            start = 1
+        positions = [self._header.index(c) for c in self.column_names]
+        for row in rows[start:]:
+            if not row:
+                continue
+            raw = tuple(row[p] if p < len(row) else "" for p in positions)
+            if self.converters:
+                values = tuple(conv(v) for conv, v in zip(self.converters, raw))
+            else:
+                values = raw
+            events.append(ParsedEvent(INSERT, values))
+        return events
+
+
+class JsonLinesParser(Parser):
+    """One JSON object per line (reference: JsonLinesParser data_format.rs:1439)."""
+
+    def __init__(
+        self, column_names: Sequence[str], defaults: dict[str, Any] | None = None
+    ) -> None:
+        super().__init__(column_names)
+        self.defaults = defaults or {}
+
+    def parse(self, payload: str) -> list[ParsedEvent]:
+        events = []
+        for line in payload.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            obj = _json.loads(line)
+            values = []
+            for name in self.column_names:
+                if name in obj:
+                    v = obj[name]
+                    values.append(Json(v) if isinstance(v, (dict, list)) else v)
+                elif name in self.defaults:
+                    values.append(self.defaults[name])
+                else:
+                    values.append(None)
+            events.append(ParsedEvent(INSERT, tuple(values)))
+        return events
+
+
+class IdentityParser(Parser):
+    """Whole payload → one `data` column (plaintext/binary,
+    reference: IdentityParser data_format.rs:831)."""
+
+    def __init__(self, binary: bool = False, split_lines: bool = False) -> None:
+        super().__init__(["data"])
+        self.binary = binary
+        self.split_lines = split_lines
+
+    def parse(self, payload: Any) -> list[ParsedEvent]:
+        if self.split_lines:
+            return [
+                ParsedEvent(INSERT, (line,))
+                for line in payload.splitlines()
+                if line.strip()
+            ]
+        return [ParsedEvent(INSERT, (payload,))]
+
+
+# -- readers ----------------------------------------------------------------
+
+
+class Reader:
+    """Produces (payload, source_id, metadata) tuples per poll."""
+
+    #: True when a later payload with the same source_id REPLACES the earlier
+    #: one (file re-read) — the driver then retracts the old rows first.
+    replaces_sources = False
+
+    def poll(self) -> tuple[list[tuple[Any, str, dict]], bool]:
+        """Returns (entries, done)."""
+        raise NotImplementedError
+
+
+class FsReader(Reader):
+    """File/directory/glob scanner with static and streaming modes
+    (reference: posix_like.rs + scanner/filesystem.rs — streaming mode diffs
+    the directory on each poll: new files insert, changed files replace,
+    deleted files retract)."""
+
+    replaces_sources = True
+
+    def __init__(self, path: str | os.PathLike, mode: str = "static", binary: bool = False) -> None:
+        self.path = os.fspath(path)
+        self.mode = mode
+        self.binary = binary
+        self._seen: dict[str, tuple[float, int]] = {}  # path -> (mtime, size)
+        self._done_static = False
+
+    def _list_files(self) -> list[str]:
+        if os.path.isdir(self.path):
+            out = []
+            for root, _dirs, files in os.walk(self.path):
+                out.extend(os.path.join(root, f) for f in sorted(files))
+            return sorted(out)
+        matches = sorted(_glob.glob(self.path))
+        return matches
+
+    def _read_file(self, path: str) -> Any:
+        if self.binary:
+            with open(path, "rb") as f:
+                return f.read()
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            return f.read()
+
+    def poll(self) -> tuple[list[tuple[Any, str, dict]], bool]:
+        if self.mode == "static":
+            if self._done_static:
+                return [], True
+            self._done_static = True
+            entries = []
+            for path in self._list_files():
+                entries.append(
+                    (self._read_file(path), path, {"path": path, "deleted": False})
+                )
+            return entries, True
+        # streaming: diff the directory
+        entries = []
+        current: dict[str, tuple[float, int]] = {}
+        for path in self._list_files():
+            try:
+                stat = os.stat(path)
+            except FileNotFoundError:
+                continue
+            current[path] = (stat.st_mtime, stat.st_size)
+        for path, sig in current.items():
+            if self._seen.get(path) != sig:
+                entries.append(
+                    (self._read_file(path), path, {"path": path, "deleted": False})
+                )
+        for path in set(self._seen) - set(current):
+            entries.append((None, path, {"path": path, "deleted": True}))
+        self._seen = current
+        return entries, False
+
+
+class QueueReader(Reader):
+    """Thread-fed queue (python ConnectorSubject, demo streams)."""
+
+    def __init__(self) -> None:
+        self.queue: "queue.Queue[Any]" = queue.Queue()
+        self.closed = False
+
+    def push(self, payload: Any, source_id: str = "q", metadata: dict | None = None) -> None:
+        self.queue.put((payload, source_id, metadata or {}))
+
+    def close(self) -> None:
+        self.closed = True
+
+    def poll(self) -> tuple[list[tuple[Any, str, dict]], bool]:
+        entries = []
+        while True:
+            try:
+                entries.append(self.queue.get_nowait())
+            except queue.Empty:
+                break
+        return entries, self.closed and self.queue.empty()
+
+
+# -- input driver -----------------------------------------------------------
+
+
+class InputDriver:
+    """Pumps one Reader+Parser into an InputSession; polled between commits
+    (the analog of the reference's poller closure, connectors/mod.rs:720)."""
+
+    def __init__(
+        self,
+        session: InputSession,
+        reader: Reader,
+        parser: Parser,
+        *,
+        primary_key_indices: Sequence[int] | None = None,
+        source_name: str = "input",
+        append_metadata: bool = False,
+    ) -> None:
+        self.session = session
+        self.reader = reader
+        self.parser = parser
+        self.pk = list(primary_key_indices) if primary_key_indices else None
+        self.source_name = source_name
+        self.append_metadata = append_metadata
+        self._per_source_rows: dict[str, list[tuple[Pointer, tuple]]] = {}
+        self._seq = 0
+        self.done = False
+
+    def _key_for(self, values: tuple, source_id: str, index: int) -> Pointer:
+        if self.pk is not None:
+            return ref_scalar(*[values[i] for i in self.pk])
+        self._seq += 1
+        return hash_values(
+            (self.source_name, source_id, index, self._seq), salt=b"connector"
+        )
+
+    def poll(self) -> str:
+        if self.done:
+            return "done"
+        entries, done = self.reader.poll()
+        produced = False
+        replaces = self.reader.replaces_sources
+        for payload, source_id, metadata in entries:
+            # retract previously-emitted rows of a replaced/deleted source
+            old_rows = self._per_source_rows.pop(source_id, None) if replaces else None
+            if old_rows:
+                for key, row in old_rows:
+                    self.session.remove(key, row)
+                produced = True
+            if metadata.get("deleted"):
+                continue
+            if hasattr(self.parser, "reset"):
+                self.parser.reset()
+            events = self.parser.parse(payload)
+            new_rows: list[tuple[Pointer, tuple]] = []
+            for i, event in enumerate(events):
+                values = event.values
+                if self.append_metadata:
+                    values = values + (Json(dict(metadata)),)
+                key = self._key_for(values, source_id, i)
+                if event.kind == INSERT:
+                    self.session.insert(key, values)
+                    new_rows.append((key, values))
+                else:
+                    self.session.remove(key, values)
+                produced = True
+            if new_rows and replaces:
+                self._per_source_rows[source_id] = new_rows
+        if done:
+            self.done = True
+            return "done"
+        return "data" if produced else "idle"
+
+
+class BatchScheduleDriver:
+    """Feeds predefined batches, one per commit (debug.StreamGenerator)."""
+
+    def __init__(self, session: InputSession, batches: list[list[tuple[str, Pointer, tuple]]]):
+        self.session = session
+        self.batches = list(batches)
+
+    def poll(self) -> str:
+        if not self.batches:
+            return "done"
+        batch = self.batches.pop(0)
+        for kind, key, values in batch:
+            if kind == INSERT:
+                self.session.insert(key, values)
+            else:
+                self.session.remove(key, values)
+        return "data" if batch or self.batches else "done"
+
+
+# -- formatters / writers ---------------------------------------------------
+
+
+class Formatter:
+    def header(self, column_names: Sequence[str]) -> str | None:
+        return None
+
+    def format(
+        self, key: Pointer, values: tuple, column_names: Sequence[str], time: int, diff: int
+    ) -> str:
+        raise NotImplementedError
+
+
+def _plain(value: Any) -> Any:
+    if isinstance(value, Json):
+        return value.value
+    if isinstance(value, Pointer):
+        return repr(value)
+    if isinstance(value, tuple):
+        return [_plain(v) for v in value]
+    return value
+
+
+class JsonLinesFormatter(Formatter):
+    """(reference: JsonLinesFormatter data_format.rs:1822 — row + diff + time)"""
+
+    def format(self, key, values, column_names, time, diff):
+        obj = {name: _plain(v) for name, v in zip(column_names, values)}
+        obj["diff"] = diff
+        obj["time"] = time
+        return _json.dumps(obj, default=str)
+
+
+class DsvFormatter(Formatter):
+    """(reference: DsvFormatter data_format.rs:938 — row + time + diff cols)"""
+
+    def __init__(self, delimiter: str = ",") -> None:
+        self.delimiter = delimiter
+
+    def header(self, column_names: Sequence[str]) -> str:
+        out = _io.StringIO()
+        _csv.writer(out, delimiter=self.delimiter, lineterminator="").writerow(
+            list(column_names) + ["time", "diff"]
+        )
+        return out.getvalue()
+
+    def format(self, key, values, column_names, time, diff):
+        out = _io.StringIO()
+        _csv.writer(out, delimiter=self.delimiter, lineterminator="").writerow(
+            [_plain(v) for v in values] + [time, diff]
+        )
+        return out.getvalue()
+
+
+class FileWriter:
+    """Line-oriented file sink (reference: FileWriter data_storage.rs:630)."""
+
+    def __init__(self, path: str | os.PathLike, formatter: Formatter, column_names: Sequence[str]):
+        self.path = os.fspath(path)
+        self.formatter = formatter
+        self.column_names = list(column_names)
+        self._file = open(self.path, "w", encoding="utf-8")
+        header = formatter.header(self.column_names)
+        if header:
+            self._file.write(header + "\n")
+
+    def on_change(self, key: Pointer, values: tuple, time: int, diff: int) -> None:
+        self._file.write(
+            self.formatter.format(key, values, self.column_names, time, diff) + "\n"
+        )
+
+    def on_time_end(self, time: int) -> None:
+        self._file.flush()
+
+    def on_end(self) -> None:
+        self._file.flush()
+        self._file.close()

@@ -56,38 +56,7 @@ def run(
         driver = sink.attach(runner.scope, node)
         if driver is not None:
             runner.drivers.append(driver)
-
-    sched = Scheduler(runner.scope)
-    if not runner.drivers:
-        sched.run_static()
-        G.clear()
-        return
-
-    # streaming loop: poll connector drivers, commit when any produced data
-    # (replaces the reference worker main loop, dataflow.rs:5769-5822)
-    drivers = list(runner.drivers)
-    for node in runner.scope.nodes:
-        from pathway_tpu.engine.graph import StaticSource
-
-        if isinstance(node, StaticSource):
-            batch = node.initial_batch()
-            if batch:
-                node.push(0, batch)
-    sched.propagate(sched.time)
-    sched.time += 1
-    while drivers:
-        produced = False
-        for driver in list(drivers):
-            status = driver.poll()
-            if status == "done":
-                drivers.remove(driver)
-                produced = True
-            elif status == "data":
-                produced = True
-        sched.commit()
-        if not produced:
-            _time.sleep(0.001)
-    sched.finish()
+    runner.run()
     G.clear()
 
 

@@ -506,7 +506,44 @@ class GraphRunner:
         sched.run_static()
         return sched
 
+    def run(self) -> Scheduler:
+        """Run to completion: static commit if no drivers, else the streaming
+        loop (poll drivers, commit, until all report done)."""
+        import time as _time
+
+        from pathway_tpu.engine.graph import StaticSource
+
+        if not self.drivers:
+            return self.run_static()
+        sched = Scheduler(self.scope)
+        for node in self.scope.nodes:
+            if isinstance(node, StaticSource):
+                batch = node.initial_batch()
+                if batch:
+                    node.push(0, batch)
+        sched.propagate(sched.time)
+        sched.time += 1
+        drivers = list(self.drivers)
+        idle_spins = 0
+        while drivers:
+            produced = False
+            for driver in list(drivers):
+                status = driver.poll()
+                if status == "done":
+                    drivers.remove(driver)
+                    produced = True
+                elif status == "data":
+                    produced = True
+            if produced:
+                sched.commit()
+                idle_spins = 0
+            else:
+                idle_spins += 1
+                _time.sleep(min(0.001 * idle_spins, 0.05))
+        sched.finish()
+        return sched
+
     def capture(self, *tables: "Table") -> list[dict[Pointer, tuple]]:
         nodes = [self.build(t) for t in tables]
-        self.run_static()
+        self.run()
         return [node.snapshot() for node in nodes]

@@ -1,0 +1,19 @@
+"""pw.io — connectors (reference: python/pathway/io/, 27 modules).
+
+Implemented natively: fs, csv, jsonlines, plaintext, python, null,
+subscribe. Remote-service connectors (kafka, s3, deltalake, ...) are gated on
+their client libraries being present.
+"""
+
+from pathway_tpu.io import csv, fs, jsonlines, null, plaintext, python
+from pathway_tpu.io._subscribe import subscribe
+
+__all__ = [
+    "csv",
+    "fs",
+    "jsonlines",
+    "null",
+    "plaintext",
+    "python",
+    "subscribe",
+]

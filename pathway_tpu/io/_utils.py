@@ -1,0 +1,80 @@
+"""Shared io plumbing (reference: python/pathway/io/_utils.py)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Sequence
+
+from pathway_tpu.engine.connectors import InputDriver, Parser, Reader
+from pathway_tpu.engine.graph import Scope
+from pathway_tpu.engine.value import Json
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table, TableSpec
+
+METADATA_COLUMN = "_metadata"
+
+
+def converter_for(dtype: dt.DType) -> Callable[[str], Any]:
+    base = dtype.strip_optional()
+    optional = dtype.is_optional()
+
+    def conv(text: str) -> Any:
+        if text == "" and optional:
+            return None
+        if base == dt.INT:
+            return int(text)
+        if base == dt.FLOAT:
+            return float(text)
+        if base == dt.BOOL:
+            return text.strip().lower() in ("true", "1", "yes", "on")
+        if base == dt.STR:
+            return text
+        if base == dt.JSON:
+            return Json(json.loads(text))
+        return text
+
+    return conv
+
+
+def input_table(
+    schema: schema_mod.SchemaMetaclass,
+    make_reader: Callable[[], Reader],
+    make_parser: Callable[[Sequence[str]], Parser],
+    *,
+    source_name: str = "input",
+    with_metadata: bool = False,
+) -> Table:
+    """Create a connector-backed table (spec kind "input")."""
+    column_names = schema.column_names()
+    dtypes = dict(schema.dtypes())
+    if with_metadata:
+        dtypes[METADATA_COLUMN] = dt.JSON
+    all_names = list(dtypes.keys())
+    pk = schema.primary_key_columns()
+    pk_indices = [column_names.index(p) for p in pk] if pk else None
+
+    def attach(scope: Scope):
+        session = scope.input_session(len(all_names))
+        driver = InputDriver(
+            session,
+            make_reader(),
+            make_parser(column_names),
+            primary_key_indices=pk_indices,
+            source_name=source_name,
+            append_metadata=with_metadata,
+        )
+        return session, driver
+
+    return Table(
+        TableSpec("input", [], {"attach": attach}),
+        all_names,
+        dtypes,
+        name=source_name,
+    )
+
+
+def assert_schema_or_value_columns(schema: Any) -> schema_mod.SchemaMetaclass:
+    if schema is None:
+        raise ValueError("schema= is required for this connector")
+    return schema

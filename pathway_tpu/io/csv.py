@@ -1,0 +1,39 @@
+"""pw.io.csv (reference: python/pathway/io/csv/__init__.py)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import fs as _fs
+
+
+class CsvParserSettings:
+    def __init__(self, delimiter: str = ",", **kwargs: Any) -> None:
+        self.delimiter = delimiter
+
+
+def read(
+    path: str | os.PathLike,
+    *,
+    schema: schema_mod.SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    csv_settings: CsvParserSettings | None = None,
+    with_metadata: bool = False,
+    **kwargs: Any,
+) -> Table:
+    return _fs.read(
+        path,
+        format="csv",
+        schema=schema,
+        mode=mode,
+        csv_settings=csv_settings,
+        with_metadata=with_metadata,
+        **kwargs,
+    )
+
+
+def write(table: Table, filename: str | os.PathLike, **kwargs: Any) -> None:
+    _fs.write(table, filename, format="csv", **kwargs)

@@ -1,0 +1,91 @@
+"""Filesystem connector: pw.io.fs.read / write
+(reference: python/pathway/io/fs/__init__.py, 369 LoC)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from pathway_tpu.engine.connectors import (
+    DsvFormatter,
+    DsvParser,
+    FileWriter,
+    FsReader,
+    IdentityParser,
+    JsonLinesFormatter,
+    JsonLinesParser,
+)
+from pathway_tpu.engine.graph import Node, Scope
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import converter_for, input_table
+
+
+def read(
+    path: str | os.PathLike,
+    *,
+    format: str = "csv",  # noqa: A002
+    schema: schema_mod.SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    csv_settings: Any = None,
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Table:
+    if format in ("plaintext", "plaintext_by_file", "binary"):
+        schema = schema_mod.schema_from_types(
+            data=bytes if format == "binary" else str
+        )
+    if schema is None:
+        raise ValueError("schema= is required for csv/json formats")
+    column_names = schema.column_names()
+    dtypes = schema.dtypes()
+    binary = format == "binary"
+
+    def make_reader():
+        return FsReader(path, mode=mode, binary=binary)
+
+    def make_parser(names):
+        if format == "csv":
+            delimiter = ","
+            if csv_settings is not None:
+                delimiter = getattr(csv_settings, "delimiter", ",")
+            return DsvParser(
+                names,
+                converters=[converter_for(dtypes[n]) for n in names],
+                delimiter=delimiter,
+            )
+        if format == "json":
+            return JsonLinesParser(names)
+        if format == "plaintext":
+            return IdentityParser(split_lines=True)
+        if format in ("plaintext_by_file", "binary"):
+            return IdentityParser(binary=binary, split_lines=False)
+        raise ValueError(f"unknown format {format!r}")
+
+    return input_table(
+        schema,
+        make_reader,
+        make_parser,
+        source_name=f"fs:{path}",
+        with_metadata=with_metadata,
+    )
+
+
+def write(table: Table, filename: str | os.PathLike, *, format: str = "json", **kwargs: Any) -> None:  # noqa: A002
+    column_names = table.column_names()
+
+    def attach(scope: Scope, node: Node):
+        formatter = DsvFormatter() if format == "csv" else JsonLinesFormatter()
+        writer = FileWriter(filename, formatter, column_names)
+        scope.subscribe_table(
+            node,
+            on_change=writer.on_change,
+            on_time_end=writer.on_time_end,
+            on_end=writer.on_end,
+        )
+        return None
+
+    G.add_sink(table, attach)

@@ -1,0 +1,18 @@
+"""pw.io.null — consume a table without writing anywhere
+(reference: python/pathway/io/null/__init__.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.graph import Node, Scope
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def write(table: Table, **kwargs: Any) -> None:
+    def attach(scope: Scope, node: Node):
+        scope.subscribe_table(node)
+        return None
+
+    G.add_sink(table, attach)

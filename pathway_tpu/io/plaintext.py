@@ -1,0 +1,13 @@
+"""pw.io.plaintext (reference: python/pathway/io/plaintext/__init__.py)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import fs as _fs
+
+
+def read(path: str | os.PathLike, *, mode: str = "streaming", with_metadata: bool = False, **kwargs: Any) -> Table:
+    return _fs.read(path, format="plaintext", mode=mode, with_metadata=with_metadata, **kwargs)
