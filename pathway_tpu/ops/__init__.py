@@ -1,0 +1,27 @@
+"""Device ops: the JAX/XLA/Pallas compute kernels of the framework.
+
+The reference keeps per-row interpreted math in Rust (src/engine/expression.rs)
+and vector search in CPU libraries (usearch / brute-force loops,
+src/external_integration/). Here the hot ops live in HBM and run on the MXU:
+fixed-capacity masked KNN (ops/knn.py), attention (parallel/ring_attention.py),
+and the model layers (models/). Everything is jit-compiled with static shapes
+— dynamic row counts are bucket-padded by the callers.
+"""
+
+from pathway_tpu.ops.knn import (
+    DeviceKnnState,
+    knn_init,
+    knn_search,
+    knn_search_sharded,
+    knn_update,
+    shard_state,
+)
+
+__all__ = [
+    "DeviceKnnState",
+    "knn_init",
+    "knn_search",
+    "knn_search_sharded",
+    "knn_update",
+    "shard_state",
+]

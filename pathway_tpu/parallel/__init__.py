@@ -1,0 +1,63 @@
+"""Parallelism & distribution layer (TPU-native).
+
+The reference scales via timely dataflow workers exchanging records over TCP
+(reference: src/engine/dataflow/config.rs:63-120, SURVEY.md §2.9/§2.10). The
+TPU-native design replaces that substrate with a `jax.sharding.Mesh` over the
+ICI/DCN fabric: device-resident state (vector indexes, model params,
+microbatched UDF compute) is sharded with `NamedSharding`s and exchanged via
+XLA collectives (all_gather / psum / ppermute / reduce_scatter) instead of
+TCP exchange channels. The host-side commit scheduler stays the control
+plane; everything that touches numbers rides the mesh.
+
+Axes (fixed vocabulary, used by shardings throughout the framework):
+- ``data``  — data parallelism: rows/keys/documents are hash-partitioned
+  across this axis, the TPU analog of the reference's worker key-sharding
+  (src/engine/value.rs:94-130).
+- ``model`` — tensor parallelism for model weights (attention heads / mlp
+  columns) and the vector-index feature dimension.
+- ``seq``   — sequence/context parallelism: long sequences are split across
+  devices and attention runs as ring attention (ppermute over this axis).
+- ``expert`` — expert parallelism for MoE blocks.
+"""
+
+from pathway_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    MeshConfig,
+    current_mesh,
+    get_mesh,
+    make_mesh,
+    set_mesh,
+)
+from pathway_tpu.parallel.sharding import (
+    named_sharding,
+    replicated,
+    shard_batch,
+    shard_params,
+    tree_specs,
+)
+from pathway_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "EXPERT_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "MeshConfig",
+    "current_mesh",
+    "get_mesh",
+    "make_mesh",
+    "named_sharding",
+    "replicated",
+    "ring_attention",
+    "ring_attention_sharded",
+    "set_mesh",
+    "shard_batch",
+    "shard_params",
+    "tree_specs",
+]

@@ -1,0 +1,110 @@
+"""Device mesh construction and the process-global mesh registry.
+
+The reference sizes its worker pool from env (PATHWAY_THREADS × PATHWAY_PROCESSES,
+reference: src/engine/dataflow/config.rs:88-120). Here the analogous resource is
+the TPU device mesh: ``make_mesh`` factors the available devices over the named
+axes (data, model, seq, expert) and the rest of the framework picks shardings
+against those names. A process-global current mesh plays the role the timely
+worker config plays in the reference — one fabric per run, consulted by every
+device-touching operator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+_AXIS_ORDER = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Requested axis sizes; ``None`` means absorb the remaining devices."""
+
+    data: int | None = None
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        fixed = self.model * self.seq * self.expert
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"cannot factor {n_devices} devices over model={self.model} "
+                f"seq={self.seq} expert={self.expert}"
+            )
+        data = self.data if self.data is not None else n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.expert}x{self.seq}x{self.model} = {total} "
+                f"!= {n_devices} devices"
+            )
+        return {
+            DATA_AXIS: data,
+            EXPERT_AXIS: self.expert,
+            SEQ_AXIS: self.seq,
+            MODEL_AXIS: self.model,
+        }
+
+
+def make_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all) with the standard axes.
+
+    Axes of size 1 are still present in the mesh so shardings written against
+    the full axis vocabulary work unchanged on any topology — a 1-chip dev run
+    and a v5e-256 pod use the same PartitionSpecs.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes)
+    elif axis_sizes:
+        raise TypeError("pass either a MeshConfig or axis sizes, not both")
+    if devices is None:
+        devices = jax.devices()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in _AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, _AXIS_ORDER)
+
+
+_current_mesh: Mesh | None = None
+
+
+def set_mesh(mesh: Mesh | None) -> None:
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh() -> Mesh | None:
+    return _current_mesh
+
+
+def get_mesh() -> Mesh:
+    """The mesh in effect, creating a default all-data-parallel one lazily."""
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = make_mesh()
+    return _current_mesh
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape[axis]) if axis in mesh.shape else 1
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return int(math.ceil(n / multiple) * multiple) if multiple > 1 else n

@@ -1,0 +1,133 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+Long-context path of the framework. Sequences are split over the ``seq`` mesh
+axis; each device holds a local block of Q/K/V and K/V blocks rotate around
+the ring via `lax.ppermute` while a flash-style online softmax accumulates the
+output — so memory stays O(T/n) per device and the collective rides ICI.
+(The reference has no model math at all — SURVEY.md §5.7; this is new
+TPU-first design, following the blockwise-attention recipe from the public
+ring-attention literature, see PAPERS.md.)
+
+`ring_attention` is the inside-shard_map kernel; `ring_attention_sharded`
+wraps it in shard_map over a mesh for direct use.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pathway_tpu.parallel.mesh import SEQ_AXIS, axis_size as mesh_axis_size
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    scale: float | None = None,
+    bias: jax.Array | None = None,
+) -> jax.Array:
+    """Attention over a sequence-sharded ring. Call inside shard_map.
+
+    Args:
+      q, k, v: local blocks ``[batch, t_local, heads, head_dim]``.
+      axis_name: mesh axis the sequence is sharded over.
+      axis_size: static size of that axis (devices in the ring).
+      causal: apply a causal mask using *global* positions.
+      bias: optional local additive bias ``[batch, heads, t_local, t_local]``
+        applied only to the diagonal (self) block — used for local masks.
+
+    Returns the local output block ``[batch, t_local, heads, head_dim]``.
+    """
+    b, t_loc, h, d = q.shape
+    s_loc = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    my_idx = lax.axis_index(axis_name)
+
+    q32 = q.astype(jnp.float32) * scale
+    o = jnp.zeros((b, t_loc, h, d), jnp.float32)
+    m = jnp.full((b, h, t_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t_loc), jnp.float32)
+
+    q_pos = my_idx * t_loc + jnp.arange(t_loc)
+
+    def accumulate(o, m, l, k_blk, v_blk, step):
+        # K/V block currently held arrived from device (my_idx - step) mod n.
+        src = (my_idx - step) % axis_size
+        s = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        if bias is not None:
+            s = jnp.where(step == 0, s + bias, s)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # Rows with no valid key yet keep m == -inf; exp(-inf - -inf) guards.
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhts,bshd->bthd", p, v_blk.astype(jnp.float32)
+        )
+        return o, m_new, l
+
+    def block(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        # Rotate first (steps 1..n-1) so the last block needs no ppermute.
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        o, m, l = accumulate(o, m, l, k_blk, v_blk, step)
+        return (o, m, l, k_blk, v_blk), None
+
+    o, m, l = accumulate(o, m, l, k, v, 0)
+    if axis_size > 1:
+        (o, m, l, _, _), _ = lax.scan(
+            block, (o, m, l, k, v), jnp.arange(1, axis_size)
+        )
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    seq_axis: str = SEQ_AXIS,
+    batch_spec: Any = None,
+    head_spec: Any = None,
+) -> jax.Array:
+    """shard_map wrapper: global ``[B, T, H, D]`` in, same out.
+
+    T is sharded over ``seq_axis``; batch/heads may additionally be sharded
+    via ``batch_spec`` / ``head_spec`` (e.g. "data" / "model").
+    """
+    n = mesh_axis_size(mesh, seq_axis)
+    spec = P(batch_spec, seq_axis if n > 1 else None, head_spec, None)
+    fn = functools.partial(
+        ring_attention,
+        axis_name=seq_axis,
+        axis_size=n,
+        causal=causal,
+        scale=scale,
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

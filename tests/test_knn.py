@@ -1,0 +1,106 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.ops import knn_init, knn_search, knn_update
+from pathway_tpu.ops.knn import knn_search_sharded
+from pathway_tpu.parallel import MeshConfig, make_mesh
+
+
+def _update(state, slots, vecs, set_valid=None, enabled=None):
+    b = len(slots)
+    if set_valid is None:
+        set_valid = [True] * b
+    if enabled is None:
+        enabled = [True] * b
+    return knn_update(
+        state,
+        jnp.asarray(slots, jnp.int32),
+        jnp.asarray(vecs, jnp.float32),
+        jnp.asarray(set_valid),
+        jnp.asarray(enabled),
+    )
+
+
+def test_add_search_remove():
+    state = knn_init(capacity=16, dim=4)
+    vecs = np.eye(4, dtype=np.float32)
+    state = _update(state, [0, 1, 2, 3], vecs)
+    q = np.asarray([[1.0, 0.1, 0, 0]], np.float32)
+    scores, slots = knn_search(state, jnp.asarray(q), k=2, metric="cos")
+    assert int(slots[0, 0]) == 0
+    assert int(slots[0, 1]) == 1
+    # remove best hit; next best becomes slot 1
+    state = _update(state, [0], vecs[:1], set_valid=[False])
+    scores, slots = knn_search(state, jnp.asarray(q), k=2, metric="cos")
+    assert int(slots[0, 0]) == 1
+
+
+def test_empty_index_returns_sentinels():
+    state = knn_init(capacity=8, dim=4)
+    scores, slots = knn_search(state, jnp.ones((1, 4)), k=3)
+    assert np.all(np.asarray(slots) == 8)
+    assert np.all(np.isneginf(np.asarray(scores)))
+
+
+def test_disabled_rows_do_not_write():
+    state = knn_init(capacity=8, dim=4)
+    state = _update(
+        state, [0, 1], np.ones((2, 4), np.float32), enabled=[True, False]
+    )
+    assert bool(state.valid[0]) and not bool(state.valid[1])
+
+
+@pytest.mark.parametrize("metric", ["cos", "l2sq", "dot"])
+def test_metrics_match_numpy(metric):
+    rng = np.random.default_rng(0)
+    db = rng.normal(size=(32, 8)).astype(np.float32)
+    q = rng.normal(size=(5, 8)).astype(np.float32)
+    state = knn_init(capacity=64, dim=8)
+    state = _update(state, list(range(32)), db)
+    scores, slots = knn_search(state, jnp.asarray(q), k=4, metric=metric)
+    if metric == "dot":
+        ref = q @ db.T
+    elif metric == "cos":
+        ref = (q / np.linalg.norm(q, axis=1, keepdims=True)) @ (
+            db / np.linalg.norm(db, axis=1, keepdims=True)
+        ).T
+    else:
+        ref = -(
+            (q**2).sum(1)[:, None] + (db**2).sum(1)[None, :] - 2 * q @ db.T
+        )
+    exp = np.argsort(-ref, axis=1)[:, :4]
+    np.testing.assert_array_equal(np.asarray(slots), exp)
+
+
+def test_sharded_search_matches_local():
+    mesh = make_mesh(MeshConfig())  # all 8 devices on data axis
+    rng = np.random.default_rng(1)
+    db = rng.normal(size=(100, 16)).astype(np.float32)
+    q = rng.normal(size=(7, 16)).astype(np.float32)
+
+    local_state = knn_init(capacity=128, dim=16)
+    local_state = _update(local_state, list(range(100)), db)
+    ls, li = knn_search(local_state, jnp.asarray(q), k=5)
+
+    sh_state = knn_init(capacity=128, dim=16, mesh=mesh)
+    sh_state = _update(sh_state, list(range(100)), db)
+    ss, si = knn_search_sharded(sh_state, jnp.asarray(q), k=5, mesh=mesh)
+
+    np.testing.assert_allclose(np.asarray(ss), np.asarray(ls), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(li))
+
+
+def test_sharded_search_k_exceeds_shard_capacity():
+    # capacity 32 over 8 shards -> 4 rows per shard; k=6 > 4 must still work
+    mesh = make_mesh(MeshConfig())
+    rng = np.random.default_rng(2)
+    db = rng.normal(size=(20, 8)).astype(np.float32)
+    q = rng.normal(size=(3, 8)).astype(np.float32)
+    local_state = knn_init(capacity=32, dim=8)
+    local_state = _update(local_state, list(range(20)), db)
+    ls, li = knn_search(local_state, jnp.asarray(q), k=6)
+    sh_state = knn_init(capacity=32, dim=8, mesh=mesh)
+    sh_state = _update(sh_state, list(range(20)), db)
+    ss, si = knn_search_sharded(sh_state, jnp.asarray(q), k=6, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(li))
