@@ -1,0 +1,242 @@
+"""Causal decoder LM in pure JAX: RoPE + RMSNorm + SwiGLU + GQA.
+
+The chat path of the LLM xpack. The reference's local chat wraps a HF
+``pipeline`` on CPU/GPU torch (reference: python/pathway/xpacks/llm/llms.py:441
+HFPipelineChat); here decode is native JAX on TPU: Mistral-style architecture,
+static-shape KV cache for generation, and tensor-parallel weight specs over
+the ``model`` mesh axis. (Attention is dense; wiring prefill to the ring
+kernel in parallel/ring_attention.py is future work.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pathway_tpu.parallel.mesh import MODEL_AXIS
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 8
+    intermediate: int = 14336
+    max_len: int = 8192
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+def mistral_7b() -> DecoderConfig:
+    return DecoderConfig()
+
+
+def tiny_decoder(vocab_size: int = 512) -> DecoderConfig:
+    """Small config for tests/dry runs."""
+    return DecoderConfig(
+        vocab_size=vocab_size,
+        hidden=64,
+        layers=2,
+        heads=4,
+        kv_heads=2,
+        intermediate=128,
+        max_len=128,
+    )
+
+
+def init_decoder_params(rng: jax.Array, cfg: DecoderConfig) -> Params:
+    def dense(key, shape):
+        scale = 1.0 / math.sqrt(shape[0])
+        return scale * jax.random.normal(key, shape, jnp.float32)
+
+    keys = iter(jax.random.split(rng, 3 + 7 * cfg.layers))
+    hd, kvd = cfg.heads * cfg.head_dim, cfg.kv_heads * cfg.head_dim
+    p: Params = {
+        "tok_emb": 0.02
+        * jax.random.normal(next(keys), (cfg.vocab_size, cfg.hidden), jnp.float32),
+        "final_norm": jnp.ones((cfg.hidden,), jnp.float32),
+        "lm_head": dense(next(keys), (cfg.hidden, cfg.vocab_size)),
+        "layers": [],
+    }
+    for _ in range(cfg.layers):
+        p["layers"].append(
+            {
+                "q_w": dense(next(keys), (cfg.hidden, hd)),
+                "kv_w": dense(next(keys), (cfg.hidden, 2 * kvd)),
+                "o_w": dense(next(keys), (hd, cfg.hidden)),
+                "attn_norm": jnp.ones((cfg.hidden,), jnp.float32),
+                "gate_w": dense(next(keys), (cfg.hidden, 2 * cfg.intermediate)),
+                "down_w": dense(next(keys), (cfg.intermediate, cfg.hidden)),
+                "mlp_norm": jnp.ones((cfg.hidden,), jnp.float32),
+            }
+        )
+    return p
+
+
+def decoder_param_spec(path: tuple, leaf: Any) -> P:
+    name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+    if name in ("q_w", "kv_w", "gate_w"):
+        return P(None, MODEL_AXIS)
+    if name in ("o_w", "down_w"):
+        return P(MODEL_AXIS, None)
+    if name in ("tok_emb",):
+        return P(MODEL_AXIS, None)
+    if name in ("lm_head",):
+        return P(None, MODEL_AXIS)
+    return P()
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    out = x32 * lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (out * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding: x ``[b, t, h, d]``, positions ``[b, t]``."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, t, d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class KVCache(NamedTuple):
+    """Static-shape per-layer cache ``[b, max_len, kv_heads, head_dim]``."""
+
+    k: list
+    v: list
+    length: jax.Array  # [] int32 — filled prefix
+
+
+def init_cache(cfg: DecoderConfig, batch: int, max_len: int) -> KVCache:
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return KVCache(
+        k=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.layers)],
+        v=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.layers)],
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _attend(q, k, v, q_pos, k_valid, cfg: DecoderConfig):
+    """GQA attention; q ``[b,t,h,d]``, k/v ``[b,s,kvh,d]``; causal by
+    absolute position with ``k_valid`` masking unfilled cache slots."""
+    g = cfg.heads // cfg.kv_heads
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    qg = q.reshape(b, t, cfg.kv_heads, g, d)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32)
+    scores = scores / math.sqrt(d)
+    k_pos = jnp.arange(s)
+    causal = q_pos[:, :, None] >= k_pos[None, None, :]  # [b, t, s]
+    mask = causal & k_valid[:, None, :]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(b, t, h * d)
+
+
+def decoder_forward(
+    params: Params,
+    token_ids: jax.Array,  # [b, t]
+    cfg: DecoderConfig,
+    cache: KVCache | None = None,
+) -> tuple[jax.Array, KVCache | None]:
+    """Logits ``[b, t, vocab]``; appends to ``cache`` when given.
+
+    Without a cache this is plain causal training/scoring forward. With a
+    cache, ``token_ids`` is the next chunk (often t=1) starting at
+    ``cache.length``.
+    """
+    b, t = token_ids.shape
+    x = params["tok_emb"][token_ids].astype(cfg.dtype)
+    start = cache.length if cache is not None else jnp.zeros((), jnp.int32)
+    q_pos = start + jnp.arange(t)[None, :].astype(jnp.int32)
+    q_pos = jnp.broadcast_to(q_pos, (b, t))
+    new_k, new_v = [], []
+    for i, lp in enumerate(params["layers"]):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = (h @ lp["q_w"].astype(cfg.dtype)).reshape(
+            b, t, cfg.heads, cfg.head_dim
+        )
+        kv = h @ lp["kv_w"].astype(cfg.dtype)
+        k, v = jnp.split(kv, 2, axis=-1)
+        k = k.reshape(b, t, cfg.kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.kv_heads, cfg.head_dim)
+        q = rope(q, q_pos, cfg.rope_theta)
+        k = rope(k, q_pos, cfg.rope_theta)
+        if cache is not None:
+            # scatter the chunk at positions [start, start+t)
+            idx = start + jnp.arange(t)
+            k_full = cache.k[i].at[:, idx].set(k)
+            v_full = cache.v[i].at[:, idx].set(v)
+            new_k.append(k_full)
+            new_v.append(v_full)
+            s = k_full.shape[1]
+            k_valid = jnp.arange(s)[None, :] < (start + t)
+            k_valid = jnp.broadcast_to(k_valid, (b, s))
+            a = _attend(q, k_full, v_full, q_pos, k_valid, cfg)
+        else:
+            k_valid = jnp.ones((b, t), bool)
+            a = _attend(q, k, v, q_pos, k_valid, cfg)
+        x = x + (a @ lp["o_w"].astype(cfg.dtype))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        gate_up = h @ lp["gate_w"].astype(cfg.dtype)
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        x = x + (jax.nn.silu(gate) * up) @ lp["down_w"].astype(cfg.dtype)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    if cache is not None:
+        cache = KVCache(k=new_k, v=new_v, length=start + t)
+    return logits, cache
+
+
+def greedy_generate(
+    params: Params,
+    prompt_ids: jax.Array,  # [b, t_prompt]
+    cfg: DecoderConfig,
+    max_new_tokens: int,
+    eos_id: int | None = None,
+) -> jax.Array:
+    """Greedy decode with a static-shape cache; returns ``[b, max_new]``.
+
+    Tokens after EOS are padded with ``eos_id``.
+    """
+    b, t_prompt = prompt_ids.shape
+    max_len = t_prompt + max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = decoder_forward(params, prompt_ids, cfg, cache)
+    next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    done = jnp.zeros((b,), bool)
+
+    def step(carry, _):
+        cache, tok, done = carry
+        logits, cache = decoder_forward(params, tok[:, None], cfg, cache)
+        new_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        if eos_id is not None:
+            done = done | (tok == eos_id)
+            new_tok = jnp.where(done, eos_id, new_tok)
+        return (cache, new_tok, done), tok
+
+    (_, _, _), toks = lax.scan(
+        step, (cache, next_tok, done), None, length=max_new_tokens
+    )
+    return toks.transpose(1, 0)  # [b, max_new]

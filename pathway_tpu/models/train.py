@@ -1,0 +1,120 @@
+"""Contrastive training for the embedder — the framework's full train step.
+
+The reference never trains models (SURVEY.md §5.7); this is TPU-first new
+design: in-batch-negative InfoNCE over (query, positive-doc) pairs, the
+standard recipe behind the retrieval encoders the RAG stack serves. The step
+is jit-compiled over the mesh with data-parallel batches, tensor-parallel
+weights (encoder_param_spec) and — when the mesh has a ``seq`` axis — ring
+attention for the token dimension, so dp/tp/sp all compose in one step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pathway_tpu.models.transformer import (
+    EncoderConfig,
+    Params,
+    dense_attention,
+    embed,
+    encoder_param_spec,
+    init_encoder_params,
+)
+from pathway_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, axis_size
+from pathway_tpu.parallel.ring_attention import ring_attention_sharded
+from pathway_tpu.parallel.sharding import shard_params, tree_specs
+
+
+class ContrastiveBatch(NamedTuple):
+    """(query, positive) token batches; in-batch negatives."""
+
+    q_ids: jax.Array  # [b, t] int32
+    q_mask: jax.Array  # [b, t] bool
+    d_ids: jax.Array  # [b, t] int32
+    d_mask: jax.Array  # [b, t] bool
+
+
+def _mesh_attn(mesh: Mesh) -> Callable:
+    """Attention impl for the mesh: ring over ``seq`` when sharded, else
+    dense. Note the ring path reads batch sharded over ``data``."""
+    if axis_size(mesh, SEQ_AXIS) > 1:
+
+        def attn(q, k, v, mask):
+            # heads stay model-sharded so attention isn't recomputed per
+            # model shard (q/k/v arrive with heads split by encoder_param_spec)
+            return ring_attention_sharded(
+                q, k, v, mesh, k_valid=mask,
+                batch_spec=DATA_AXIS, head_spec=MODEL_AXIS,
+            )
+
+        return attn
+    return dense_attention
+
+
+def info_nce_loss(
+    params: Params,
+    batch: ContrastiveBatch,
+    cfg: EncoderConfig,
+    temperature: float = 0.05,
+    attn_fn: Callable = dense_attention,
+) -> jax.Array:
+    q = embed(params, batch.q_ids, batch.q_mask, cfg, attn_fn)
+    d = embed(params, batch.d_ids, batch.d_mask, cfg, attn_fn)
+    logits = (q @ d.T) / temperature  # [b, b] — in-batch negatives
+    labels = jnp.arange(logits.shape[0])
+    l_qd = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    l_dq = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+    return (l_qd.mean() + l_dq.mean()) / 2.0
+
+
+class TrainState(NamedTuple):
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+
+def make_train_step(
+    cfg: EncoderConfig,
+    mesh: Mesh,
+    learning_rate: float = 1e-4,
+    temperature: float = 0.05,
+):
+    """Returns ``(init_fn, step_fn, batch_sharding)`` jitted over the mesh.
+
+    ``init_fn(rng) -> TrainState`` places params with encoder_param_spec.
+    ``step_fn(state, batch) -> (state, loss)``. ``batch_sharding`` is a
+    ContrastiveBatch of NamedShardings — device_put batches with it so they
+    arrive data-sharded.
+    """
+    tx = optax.adamw(learning_rate)
+    attn_fn = _mesh_attn(mesh)
+
+    def init_fn(rng: jax.Array) -> TrainState:
+        params = init_encoder_params(rng, cfg)
+        params = shard_params(mesh, params, encoder_param_spec)
+        opt_state = tx.init(params)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    batch_sharding = jax.tree.map(
+        lambda _: NamedSharding(mesh, P(DATA_AXIS, None)),
+        ContrastiveBatch(None, None, None, None),
+        is_leaf=lambda x: x is None,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step_fn(state: TrainState, batch: ContrastiveBatch):
+        def loss_fn(p):
+            return info_nce_loss(p, batch, cfg, temperature, attn_fn)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return init_fn, step_fn, batch_sharding

@@ -14,7 +14,6 @@ wraps it in shard_map over a mesh for direct use.
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -35,6 +34,7 @@ def ring_attention(
     causal: bool = False,
     scale: float | None = None,
     bias: jax.Array | None = None,
+    k_valid: jax.Array | None = None,
 ) -> jax.Array:
     """Attention over a sequence-sharded ring. Call inside shard_map.
 
@@ -45,6 +45,8 @@ def ring_attention(
       causal: apply a causal mask using *global* positions.
       bias: optional local additive bias ``[batch, heads, t_local, t_local]``
         applied only to the diagonal (self) block — used for local masks.
+      k_valid: optional key padding mask ``[batch, s_local]`` (True = attend);
+        rotates around the ring together with its K/V block.
 
     Returns the local output block ``[batch, t_local, heads, head_dim]``.
     """
@@ -61,7 +63,7 @@ def ring_attention(
 
     q_pos = my_idx * t_loc + jnp.arange(t_loc)
 
-    def accumulate(o, m, l, k_blk, v_blk, step):
+    def accumulate(o, m, l, k_blk, v_blk, valid_blk, step):
         # K/V block currently held arrived from device (my_idx - step) mod n.
         src = (my_idx - step) % axis_size
         s = jnp.einsum("bthd,bshd->bhts", q32, k_blk.astype(jnp.float32))
@@ -69,6 +71,8 @@ def ring_attention(
             k_pos = src * s_loc + jnp.arange(s_loc)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, -jnp.inf)
+        if valid_blk is not None:
+            s = jnp.where(valid_blk[:, None, None, :], s, -jnp.inf)
         if bias is not None:
             s = jnp.where(step == 0, s + bias, s)
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -83,18 +87,20 @@ def ring_attention(
         return o, m_new, l
 
     def block(carry, step):
-        o, m, l, k_blk, v_blk = carry
+        o, m, l, k_blk, v_blk, valid_blk = carry
         # Rotate first (steps 1..n-1) so the last block needs no ppermute.
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        o, m, l = accumulate(o, m, l, k_blk, v_blk, step)
-        return (o, m, l, k_blk, v_blk), None
+        if valid_blk is not None:
+            valid_blk = lax.ppermute(valid_blk, axis_name, perm)
+        o, m, l = accumulate(o, m, l, k_blk, v_blk, valid_blk, step)
+        return (o, m, l, k_blk, v_blk, valid_blk), None
 
-    o, m, l = accumulate(o, m, l, k, v, 0)
+    o, m, l = accumulate(o, m, l, k, v, k_valid, 0)
     if axis_size > 1:
-        (o, m, l, _, _), _ = lax.scan(
-            block, (o, m, l, k, v), jnp.arange(1, axis_size)
+        (o, m, l, _, _, _), _ = lax.scan(
+            block, (o, m, l, k, v, k_valid), jnp.arange(1, axis_size)
         )
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]
@@ -109,6 +115,7 @@ def ring_attention_sharded(
     *,
     causal: bool = False,
     scale: float | None = None,
+    k_valid: jax.Array | None = None,
     seq_axis: str = SEQ_AXIS,
     batch_spec: Any = None,
     head_spec: Any = None,
@@ -117,17 +124,27 @@ def ring_attention_sharded(
 
     T is sharded over ``seq_axis``; batch/heads may additionally be sharded
     via ``batch_spec`` / ``head_spec`` (e.g. "data" / "model").
+    ``k_valid`` is a global ``[B, T]`` key padding mask.
     """
     n = mesh_axis_size(mesh, seq_axis)
-    spec = P(batch_spec, seq_axis if n > 1 else None, head_spec, None)
-    fn = functools.partial(
-        ring_attention,
-        axis_name=seq_axis,
-        axis_size=n,
-        causal=causal,
-        scale=scale,
-    )
+    t_spec = seq_axis if n > 1 else None
+    spec = P(batch_spec, t_spec, head_spec, None)
+    mask_spec = P(batch_spec, t_spec)
+
+    def fn(q, k, v, valid):
+        return ring_attention(
+            q, k, v,
+            axis_name=seq_axis,
+            axis_size=n,
+            causal=causal,
+            scale=scale,
+            k_valid=valid,
+        )
+
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, None if k_valid is None else mask_spec),
+        out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(q, k, v, k_valid)
