@@ -1,0 +1,296 @@
+"""External index operator: as-of-now retrieval against device-resident state.
+
+Engine-side equivalent of the reference's `UseExternalIndexAsOfNow` timely
+operator (reference: src/engine/dataflow/operators/external_index.rs:38 and
+the `ExternalIndex` trait src/external_integration/mod.rs:40): the index is
+mutable operator state *outside* the incremental collections; queries are
+answered against the index state at arrival time and answers are never
+revised when the index later changes — only query-row deletions retract
+their answers (Appendix B of SURVEY.md).
+
+The TPU implementation keeps the index in HBM (ops/knn.py): adds/removes are
+bucket-padded scatter batches, searches are bucket-padded masked matmul +
+top-k. Host state is only the key<->slot mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.batch import DeltaBatch
+from pathway_tpu.engine.graph import Node, Scope
+from pathway_tpu.engine.value import Pointer, is_error
+
+
+class ExternalIndex(Protocol):
+    """Host-facing index contract (add/remove by key, batched search)."""
+
+    def add(self, keys: Sequence[Pointer], vectors: Sequence[Any]) -> None: ...
+
+    def remove(self, keys: Sequence[Pointer]) -> None: ...
+
+    def search(
+        self, queries: Sequence[Any], k: int
+    ) -> list[list[tuple[Pointer, float]]]: ...
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class DeviceKnnIndex:
+    """HBM-resident brute-force KNN with a host slot allocator.
+
+    Replaces the reference's CPU brute-force/usearch indexes with the
+    fixed-capacity masked slot array of ops/knn.py. Capacity doubles by
+    device-side copy when the free list runs dry; update and query batches
+    are padded to power-of-two buckets so jit caches stay small.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "cos",
+        capacity: int = 1024,
+        dtype: Any = None,
+        mesh: Any = None,
+    ) -> None:
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops import knn_init
+
+        self.dim = dim
+        self.metric = metric
+        self.capacity = capacity
+        self.dtype = dtype if dtype is not None else jnp.float32
+        self.mesh = mesh
+        self.state = knn_init(capacity, dim, self.dtype, mesh=mesh)
+        self.key_to_slot: dict[Pointer, int] = {}
+        self.slot_to_key: dict[int, Pointer] = {}
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+
+    def __len__(self) -> int:
+        return len(self.key_to_slot)
+
+    # -- mutation ------------------------------------------------------------
+
+    def _grow(self) -> None:
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops import knn_init
+        from pathway_tpu.ops.knn import DeviceKnnState
+
+        old = self.state
+        new_capacity = self.capacity * 2
+        fresh = knn_init(new_capacity, self.dim, self.dtype, mesh=self.mesh)
+        self.state = DeviceKnnState(
+            vectors=fresh.vectors.at[: self.capacity].set(old.vectors),
+            valid=fresh.valid.at[: self.capacity].set(old.valid),
+            norms=fresh.norms.at[: self.capacity].set(old.norms),
+        )
+        self._free = list(range(new_capacity - 1, self.capacity - 1, -1)) + self._free
+        self.capacity = new_capacity
+
+    def _apply(
+        self, slots: list[int], vecs: np.ndarray, set_valid: list[bool]
+    ) -> None:
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops import knn_update
+
+        n = len(slots)
+        if n == 0:
+            return
+        b = _bucket(n)
+        slots_arr = np.full((b,), 0, np.int32)
+        slots_arr[:n] = slots
+        vec_arr = np.zeros((b, self.dim), np.float32)
+        vec_arr[:n] = vecs
+        valid_arr = np.zeros((b,), bool)
+        valid_arr[:n] = set_valid
+        enabled = np.zeros((b,), bool)
+        enabled[:n] = True
+        self.state = knn_update(
+            self.state,
+            jnp.asarray(slots_arr),
+            jnp.asarray(vec_arr),
+            jnp.asarray(valid_arr),
+            jnp.asarray(enabled),
+        )
+
+    def add(self, keys: Sequence[Pointer], vectors: Sequence[Any]) -> None:
+        slots, vecs, valid = [], [], []
+        deferred_free: list[int] = []  # freed only after the batch lands, so
+        # a replaced key's old slot can't be reused (= written twice) in it
+        for key, vec in zip(keys, vectors):
+            if key in self.key_to_slot:
+                old_slot = self.key_to_slot.pop(key)
+                self.slot_to_key.pop(old_slot, None)
+                slots.append(old_slot)
+                vecs.append(np.zeros((self.dim,), np.float32))
+                valid.append(False)
+                deferred_free.append(old_slot)
+            if not self._free:
+                self._apply(slots, np.asarray(vecs, np.float32), valid)
+                self._free.extend(deferred_free)
+                slots, vecs, valid, deferred_free = [], [], [], []
+                if not self._free:
+                    self._grow()
+            slot = self._free.pop()
+            self.key_to_slot[key] = slot
+            self.slot_to_key[slot] = key
+            slots.append(slot)
+            vecs.append(np.asarray(vec, np.float32).reshape(self.dim))
+            valid.append(True)
+        self._apply(slots, np.asarray(vecs, np.float32), valid)
+        self._free.extend(deferred_free)
+
+    def remove(self, keys: Sequence[Pointer]) -> None:
+        slots, vecs, valid = [], [], []
+        for key in keys:
+            slot = self.key_to_slot.pop(key, None)
+            if slot is None:
+                continue
+            self.slot_to_key.pop(slot, None)
+            self._free.append(slot)
+            slots.append(slot)
+            vecs.append(np.zeros((self.dim,), np.float32))
+            valid.append(False)
+        self._apply(slots, np.asarray(vecs, np.float32), valid)
+
+    # -- search --------------------------------------------------------------
+
+    def search(
+        self, queries: Sequence[Any], k: int
+    ) -> list[list[tuple[Pointer, float]]]:
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops import knn_search
+        from pathway_tpu.ops.knn import knn_search_sharded
+
+        n = len(queries)
+        if n == 0:
+            return []
+        k_eff = min(k, self.capacity)
+        b = _bucket(n)
+        q = np.zeros((b, self.dim), np.float32)
+        for i, vec in enumerate(queries):
+            q[i] = np.asarray(vec, np.float32).reshape(self.dim)
+        if self.mesh is not None:
+            scores, slots = knn_search_sharded(
+                self.state, jnp.asarray(q), k_eff, self.mesh, self.metric
+            )
+        else:
+            scores, slots = knn_search(
+                self.state, jnp.asarray(q), k_eff, self.metric
+            )
+        scores = np.asarray(scores)[:n]
+        slots = np.asarray(slots)[:n]
+        out: list[list[tuple[Pointer, float]]] = []
+        for i in range(n):
+            hits = []
+            for score, slot in zip(scores[i], slots[i]):
+                key = self.slot_to_key.get(int(slot))
+                if key is not None and np.isfinite(score):
+                    hits.append((key, float(score)))
+            out.append(hits)
+        return out
+
+
+class ExternalIndexNode(Node):
+    """As-of-now index operator: port 0 = indexed data, port 1 = queries.
+
+    Output: keyed by query id, row = (result_ids: tuple[Pointer],
+    result_scores: tuple[float]). Index-side updates within a commit are
+    applied before queries of the same commit are answered. Answers stick
+    until their query row is deleted.
+    """
+
+    def __init__(
+        self,
+        scope: Scope,
+        index_table: Node,
+        query_table: Node,
+        index: ExternalIndex,
+        index_col: int,
+        query_col: int,
+        k: int,
+        limit_col: int | None = None,
+    ) -> None:
+        super().__init__(scope, [index_table, query_table], 2)
+        self.index = index
+        self.index_col = index_col
+        self.query_col = query_col
+        self.k = k
+        self.limit_col = limit_col
+
+    def process(self, time: int) -> DeltaBatch:
+        index_batch = self.take(0)
+        query_batch = self.take(1)
+
+        # 1. fold index-side deltas into device state
+        add_keys: list[Pointer] = []
+        add_vecs: list[Any] = []
+        rm_keys: list[Pointer] = []
+        for key, row, diff in index_batch:
+            vec = row[self.index_col]
+            if diff > 0:
+                if is_error(vec) or vec is None:
+                    self.report(key, "error/None vector in index input")
+                    continue
+                add_keys.append(key)
+                add_vecs.append(vec)
+            else:
+                rm_keys.append(key)
+        # removes first so a same-commit delete+insert of a key nets to add
+        if rm_keys:
+            add_set = set(add_keys)
+            self.index.remove([k_ for k_ in rm_keys if k_ not in add_set])
+        if add_keys:
+            self.index.add(add_keys, add_vecs)
+
+        # 2. answer new queries as-of-now; retract answers of deleted queries
+        out = DeltaBatch()
+        pending: list[tuple[Pointer, Any, int]] = []
+        retracted: set[Pointer] = set()
+        for key, row, diff in query_batch:
+            if diff < 0:
+                prev = self.current.get(key)
+                if prev is not None and key not in retracted:
+                    out.append(key, prev, -1)
+                    retracted.add(key)
+                continue
+            vec = row[self.query_col]
+            if is_error(vec) or vec is None:
+                self.report(key, "error/None vector in query input")
+                continue
+            limit = self.k
+            if self.limit_col is not None:
+                lv = row[self.limit_col]
+                if lv is not None and not is_error(lv):
+                    limit = int(lv)
+            pending.append((key, vec, limit))
+        if pending:
+            max_k = max(limit for _k, _v, limit in pending)
+            results = self.index.search([v for _k, v, _l in pending], max_k)
+            for (key, _vec, limit), hits in zip(pending, results):
+                hits = hits[:limit]
+                # re-query of a live key replaces its previous answer (unless
+                # the deletion pass of this commit already retracted it)
+                prev = self.current.get(key)
+                if prev is not None and key not in retracted:
+                    out.append(key, prev, -1)
+                out.append(
+                    key,
+                    (
+                        tuple(hk for hk, _s in hits),
+                        tuple(s for _hk, s in hits),
+                    ),
+                    1,
+                )
+        return out.consolidate()

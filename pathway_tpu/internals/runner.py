@@ -369,6 +369,34 @@ class GraphRunner:
             )
             return self._project(dedup, range(n))
 
+        if kind == "external_index":
+            from pathway_tpu.engine.external_index import ExternalIndexNode
+
+            data_t, query_t = spec.inputs
+            data_node = self.build(data_t)
+            query_node = self.build(query_t)
+            data_prep = scope.expression_table(
+                data_node,
+                [self.compile(spec.params["index_expr"], self.base_layout(data_t))],
+            )
+            query_layout = self.base_layout(query_t)
+            q_exprs = [self.compile(spec.params["query_expr"], query_layout)]
+            limit_col = None
+            if spec.params["limit_expr"] is not None:
+                q_exprs.append(self.compile(spec.params["limit_expr"], query_layout))
+                limit_col = 1
+            query_prep = scope.expression_table(query_node, q_exprs)
+            return ExternalIndexNode(
+                scope,
+                data_prep,
+                query_prep,
+                spec.params["factory"](),
+                index_col=0,
+                query_col=0,
+                k=spec.params["k"],
+                limit_col=limit_col,
+            )
+
         if kind == "buffer":
             raise NotImplementedError("temporal behaviors arrive with the temporal module")
 

@@ -436,6 +436,50 @@ class Table:
             universe=other._universe,
         )
 
+    def _external_index_as_of_now(
+        self,
+        query_table: "Table",
+        index_column: ColumnExpression,
+        query_column: ColumnExpression,
+        index_factory: Any,
+        number_of_matches: Any = 3,
+    ) -> "Table":
+        """As-of-now external-index lookup (reference: Table._external_index_
+        _as_of_now internals/table.py:584 → use_external_index_as_of_now).
+
+        ``self`` is the indexed data table. Returns a table keyed by query id
+        with columns ``_pw_index_reply_ids`` / ``_pw_index_reply_scores``.
+        ``number_of_matches`` is an int or a ColumnExpression on the query
+        table (per-query limit).
+        """
+        index_expr = resolve_this(index_column, self)
+        query_expr = resolve_this(query_column, query_table)
+        limit_expr: ColumnExpression | None = None
+        k = 3
+        if isinstance(number_of_matches, ColumnExpression):
+            limit_expr = resolve_this(number_of_matches, query_table)
+            k = 16
+        else:
+            k = int(number_of_matches)
+        return self._derived(
+            TableSpec(
+                "external_index",
+                [self, query_table],
+                {
+                    "index_expr": index_expr,
+                    "query_expr": query_expr,
+                    "limit_expr": limit_expr,
+                    "k": k,
+                    "factory": index_factory,
+                },
+            ),
+            {
+                "_pw_index_reply_ids": dt.ANY,
+                "_pw_index_reply_scores": dt.ANY,
+            },
+            universe=query_table._universe.subset(),
+        )
+
     # -- re-keying ----------------------------------------------------------
 
     def with_id_from(self, *args: Any, instance: Any = None) -> "Table":

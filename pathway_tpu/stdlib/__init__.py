@@ -1,0 +1,9 @@
+"""Standard library: indexing, temporal, ml, graphs, stateful, utils.
+
+Mirrors the capability surface of the reference's ``pathway.stdlib``
+(reference: python/pathway/stdlib/) with TPU-native internals.
+"""
+
+from pathway_tpu.stdlib import indexing  # noqa: F401
+
+__all__ = ["indexing"]

@@ -1,0 +1,26 @@
+"""Indexing: DataIndex over device-resident retrieval engines.
+
+Mirrors the reference's ``pathway.stdlib.indexing``
+(reference: stdlib/indexing/data_index.py:278 DataIndex;
+nearest_neighbors.py BruteForceKnnFactory/USearchKnnFactory;
+bm25.py TantivyBM25Factory) with the vector path running in TPU HBM
+(engine/external_index.py over ops/knn.py). The ``query_as_of_now``
+contract matches Appendix B of SURVEY.md: answers reflect index state at
+query arrival and are revised only when the query row itself changes.
+"""
+
+from pathway_tpu.stdlib.indexing.data_index import (
+    BruteForceKnnFactory,
+    DataIndex,
+    InnerIndexFactory,
+    TpuKnnFactory,
+)
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+
+__all__ = [
+    "BruteForceKnnFactory",
+    "DataIndex",
+    "InnerIndexFactory",
+    "TantivyBM25Factory",
+    "TpuKnnFactory",
+]

@@ -1,0 +1,202 @@
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.engine.graph import Scheduler, Scope
+from pathway_tpu.engine.external_index import DeviceKnnIndex, ExternalIndexNode
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals.runner import GraphRunner
+from pathway_tpu.stdlib.indexing import (
+    BruteForceKnnFactory,
+    DataIndex,
+    TantivyBM25Factory,
+)
+
+
+def _vec(*xs):
+    return tuple(float(x) for x in xs)
+
+
+class TestEngineOperator:
+    def _setup(self, k=2):
+        scope = Scope()
+        index_in = scope.input_session(arity=1)
+        query_in = scope.input_session(arity=1)
+        node = ExternalIndexNode(
+            scope, index_in, query_in,
+            DeviceKnnIndex(dim=2, capacity=4), index_col=0, query_col=0, k=k,
+        )
+        return scope, index_in, query_in, node, Scheduler(scope)
+
+    def test_as_of_now_no_revision(self):
+        scope, index_in, query_in, node, sched = self._setup()
+        d1, d2, d3 = ref_scalar(1), ref_scalar(2), ref_scalar(3)
+        q1 = ref_scalar("q1")
+        index_in.insert(d1, (_vec(1, 0),))
+        index_in.insert(d2, (_vec(0, 1),))
+        sched.commit()
+        query_in.insert(q1, (_vec(1, 0.1),))
+        sched.commit()
+        ids, scores = node.current[q1]
+        assert ids[0] == d1
+        # adding a better doc later must NOT revise the old answer
+        index_in.insert(d3, (_vec(1, 0.1),))
+        sched.commit()
+        assert node.current[q1][0][0] == d1
+        # but a new identical query sees the new doc
+        q2 = ref_scalar("q2")
+        query_in.insert(q2, (_vec(1, 0.1),))
+        sched.commit()
+        assert node.current[q2][0][0] == d3
+
+    def test_query_deletion_retracts_answer(self):
+        scope, index_in, query_in, node, sched = self._setup()
+        index_in.insert(ref_scalar(1), (_vec(1, 0),))
+        sched.commit()
+        q = ref_scalar("q")
+        query_in.insert(q, (_vec(1, 0),))
+        sched.commit()
+        assert q in node.current
+        query_in.remove(q, (_vec(1, 0),))
+        sched.commit()
+        assert q not in node.current
+
+    def test_index_delete_affects_new_queries_only(self):
+        scope, index_in, query_in, node, sched = self._setup(k=1)
+        d1 = ref_scalar(1)
+        index_in.insert(d1, (_vec(1, 0),))
+        sched.commit()
+        q1 = ref_scalar("q1")
+        query_in.insert(q1, (_vec(1, 0),))
+        sched.commit()
+        index_in.remove(d1, (_vec(1, 0),))
+        sched.commit()
+        assert node.current[q1][0][0] == d1  # sticky answer
+        q2 = ref_scalar("q2")
+        query_in.insert(q2, (_vec(1, 0),))
+        sched.commit()
+        assert node.current[q2] == ((), ())  # empty index now
+
+    def test_same_commit_query_update_single_retraction(self):
+        scope, index_in, query_in, node, sched = self._setup(k=1)
+        index_in.insert(ref_scalar(1), (_vec(1, 0),))
+        sched.commit()
+        q = ref_scalar("q")
+        query_in.insert(q, (_vec(1, 0),))
+        sched.commit()
+        seen = []
+        out_node = scope.subscribe_table(
+            node, on_change=lambda key, row, t, d: seen.append((key, row, d))
+        )
+        # same-commit delete+insert (query row update)
+        query_in.remove(q, (_vec(1, 0),))
+        query_in.insert(q, (_vec(0, 1),))
+        sched.commit()
+        diffs = [d for k, _r, d in seen if k == q]
+        assert sorted(diffs) == [-1, 1]  # exactly one retract + one insert
+        assert q in node.current
+
+    def test_capacity_growth(self):
+        scope, index_in, query_in, node, sched = self._setup(k=1)
+        for i in range(20):  # > initial capacity of 4 -> forces growth
+            index_in.insert(ref_scalar(i), (_vec(np.cos(i), np.sin(i)),))
+        sched.commit()
+        q = ref_scalar("q")
+        query_in.insert(q, (_vec(np.cos(7), np.sin(7)),))
+        sched.commit()
+        assert node.current[q][0][0] == ref_scalar(7)
+
+
+class TestDataIndex:
+    def _tables(self):
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(text=str, emb=tuple),
+            [
+                ("apple pie recipe", _vec(1, 0, 0)),
+                ("car engine manual", _vec(0, 1, 0)),
+                ("fruit tart baking", _vec(0.9, 0.1, 0)),
+            ],
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(qtext=str, qemb=tuple),
+            [("baking", _vec(1, 0.05, 0))],
+        )
+        return docs, queries
+
+    def test_query_as_of_now_collapsed(self):
+        docs, queries = self._tables()
+        index = DataIndex(
+            docs, BruteForceKnnFactory(dimensions=3, capacity=8), docs.emb
+        )
+        res = index.query_as_of_now(queries, queries.qemb, number_of_matches=2)
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert len(rows) == 1
+        qtext, _qemb, ids, scores = rows[0]
+        assert qtext == "baking"
+        assert len(ids) == 2
+        assert scores[0] >= scores[1]
+
+    def test_query_docs_returns_ranked_texts(self):
+        docs, queries = self._tables()
+        index = DataIndex(
+            docs, BruteForceKnnFactory(dimensions=3, capacity=8), docs.emb
+        )
+        res = index.query_docs_as_of_now(
+            queries, queries.qemb, doc_columns=["text"], number_of_matches=2
+        )
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert len(rows) == 1
+        (texts, scores) = rows[0]
+        assert texts == ("apple pie recipe", "fruit tart baking")
+        assert len(scores) == 2
+
+
+    def test_zero_hit_query_kept_with_empty_tuples(self):
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(text=str), [("apple pie",)]
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(qtext=str),
+            [("apple",), ("zzz qqq xxyy",)],  # second query matches nothing
+        )
+        index = DataIndex(docs, TantivyBM25Factory(), docs.text)
+        res = index.query_docs_as_of_now(
+            queries, queries.qtext, doc_columns=["text"], number_of_matches=2
+        )
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert len(rows) == 2
+        empties = [r for r in rows if r[0] == ()]
+        assert len(empties) == 1 and empties[0][1] == ()
+
+
+class TestBM25:
+    def test_bm25_ranking(self):
+        idx = TantivyBM25Factory().build()
+        k1, k2, k3 = ref_scalar(1), ref_scalar(2), ref_scalar(3)
+        idx.add(
+            [k1, k2, k3],
+            [
+                "the quick brown fox",
+                "quick quick fox jumps",
+                "lazy dog sleeps",
+            ],
+        )
+        res = idx.search(["quick fox"], k=2)[0]
+        assert [k for k, _s in res] == [k2, k1]
+        idx.remove([k2])
+        res = idx.search(["quick fox"], k=2)[0]
+        assert res[0][0] == k1
+
+    def test_bm25_in_data_index(self):
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(text=str),
+            [("apple pie recipe",), ("car engine manual",)],
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(qtext=str), [("pie recipe",)]
+        )
+        index = DataIndex(docs, TantivyBM25Factory(), docs.text)
+        res = index.query_docs_as_of_now(
+            queries, queries.qtext, doc_columns=["text"], number_of_matches=1
+        )
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert rows[0][0] == ("apple pie recipe",)
