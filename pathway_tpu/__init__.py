@@ -49,6 +49,9 @@ from pathway_tpu.internals import universe as _universe_mod
 from pathway_tpu import debug  # noqa: E402  (imports Table)
 from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import io  # noqa: E402
+from pathway_tpu import stdlib  # noqa: E402
+from pathway_tpu.internals import udfs  # noqa: E402
+from pathway_tpu.internals.udfs import UDF, udf  # noqa: E402
 
 
 class universes:
@@ -106,7 +109,11 @@ __all__ = [
     "schema_builder",
     "schema_from_dict",
     "schema_from_types",
+    "stdlib",
     "this",
+    "udf",
+    "UDF",
+    "udfs",
     "universes",
     "unsafe_make_pointer",
     "unwrap",

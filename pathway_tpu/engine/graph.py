@@ -198,6 +198,66 @@ class ExpressionNode(Node):
         return out
 
 
+class BatchApplyNode(Node):
+    """Batched UDF execution over the arg-prep table (arity 1 output).
+
+    The engine-side analog of the reference's async row map
+    (map_named_async / MapWithConsistentDeletions,
+    src/engine/dataflow/operators.rs:182,308): all rows inserted in a commit
+    are handed to ``rows_fn`` at once — the executor decides concurrency
+    (async) or fusion into one jit call (device microbatch). Deletions
+    retract the memoized current value, so nondeterministic UDF outputs
+    always cancel correctly.
+    """
+
+    def __init__(
+        self,
+        scope: "Scope",
+        source: Node,
+        rows_fn: Callable[[list], list],
+        arg_cols: Sequence[int],
+        propagate_none: bool = False,
+    ) -> None:
+        super().__init__(scope, [source], 1)
+        self.rows_fn = rows_fn
+        self.arg_cols = list(arg_cols)
+        self.propagate_none = propagate_none
+
+    def process(self, time: int) -> DeltaBatch:
+        batch = self.take(0)
+        out = DeltaBatch()
+        for key, row, diff in batch:
+            if diff < 0:
+                prev = self.current.get(key)
+                if prev is not None:
+                    out.append(key, prev, diff)
+        pending: list[tuple[Pointer, tuple, int]] = []
+        for key, row, diff in batch:
+            if diff <= 0:
+                continue
+            args = tuple(row[c] for c in self.arg_cols)
+            if any(is_error(a) for a in args):
+                self.report(key, "error value in UDF argument")
+                out.append(key, (ERROR,), diff)
+                continue
+            if self.propagate_none and any(a is None for a in args):
+                out.append(key, (None,), diff)
+                continue
+            pending.append((key, args, diff))
+        if pending:
+            try:
+                results = self.rows_fn([args for _k, args, _d in pending])
+            except Exception as e:  # noqa: BLE001 — whole-batch failure
+                results = [(False, e)] * len(pending)
+            for (key, _args, diff), (ok, value) in zip(pending, results):
+                if ok:
+                    out.append(key, (value,), diff)
+                else:
+                    self.report(key, f"UDF error: {value!r}")
+                    out.append(key, (ERROR,), diff)
+        return out
+
+
 class FilterNode(Node):
     def __init__(self, scope: "Scope", source: Node, condition_col: int) -> None:
         super().__init__(scope, [source], source.arity)
@@ -1004,6 +1064,15 @@ class Scope:
 
     def filter_table(self, table: Node, condition_col: int) -> Node:
         return FilterNode(self, table, condition_col)
+
+    def batch_apply_table(
+        self,
+        table: Node,
+        rows_fn: Callable[[list], list],
+        arg_cols: Sequence[int],
+        propagate_none: bool = False,
+    ) -> Node:
+        return BatchApplyNode(self, table, rows_fn, arg_cols, propagate_none)
 
     def concat_tables(self, tables: Sequence[Node]) -> Node:
         return ConcatNode(self, tables)

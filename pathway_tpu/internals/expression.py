@@ -339,8 +339,34 @@ class ApplyExpression(ColumnExpression):
         return (*self._args, *self._kwargs.values())
 
 
-class AsyncApplyExpression(ApplyExpression):
-    pass
+class BatchApplyExpression(ColumnExpression):
+    """A UDF call executed by the engine in commit-batches (BatchApplyNode).
+
+    ``rows_fn`` is ``UDF.execute_rows``: list of arg tuples in, list of
+    (ok, value) out. Must appear as a top-level select expression.
+    """
+
+    def __init__(
+        self,
+        rows_fn: Callable[[list], list],
+        return_type: Any,
+        args: tuple,
+        kwargs: dict,
+        *,
+        propagate_none: bool = False,
+        deterministic: bool = False,
+        name: str = "udf",
+    ) -> None:
+        self._rows_fn = rows_fn
+        self._args = [wrap_expression(a) for a in args]
+        self._kwargs = {k: wrap_expression(v) for k, v in kwargs.items()}
+        self._dtype = dt.wrap(return_type) if return_type is not None else dt.ANY
+        self._propagate_none = propagate_none
+        self._deterministic = deterministic
+        self._name = name
+
+    def _children(self) -> Iterable[ColumnExpression]:
+        return (*self._args, *self._kwargs.values())
 
 
 class CastExpression(ColumnExpression):
@@ -504,7 +530,21 @@ def apply_with_type(
 
 
 def apply_async(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> ColumnExpression:
-    return AsyncApplyExpression(fn, None, args, kwargs)
+    """Concurrent per-row apply (reference: pw.apply_async) — lowered to the
+    engine batch node with an async executor."""
+    import inspect
+
+    from pathway_tpu.internals.udfs import UDF
+    from pathway_tpu.internals.udfs.executors import AsyncExecutor
+
+    if not inspect.iscoroutinefunction(fn):
+        sync_fn = fn
+
+        async def async_fn(*a: Any, **kw: Any) -> Any:
+            return sync_fn(*a, **kw)
+
+        fn = async_fn
+    return UDF(fn, executor=AsyncExecutor())(*args, **kwargs)
 
 
 def cast(target: Any, expr: Any) -> ColumnExpression:

@@ -145,6 +145,11 @@ class GraphRunner:
                 [c(a) for a in expression._args],
                 c(expression._instance) if expression._instance is not None else None,
             )
+        if isinstance(expression, pex.BatchApplyExpression):
+            raise NotImplementedError(
+                "async/batched UDF calls are only supported as top-level "
+                "select columns"
+            )
         if isinstance(expression, pex.ReducerExpression):
             raise ValueError("reducers are only allowed inside .reduce(...)")
         raise NotImplementedError(f"cannot compile expression {expression!r}")
@@ -220,7 +225,11 @@ class GraphRunner:
             exprs = spec.params["exprs"]
             expr_list = list(exprs.values())
             storage, layout = self.storage_for(spec.inputs[0], expr_list)
-            return scope.expression_table(storage, [self.compile(e, layout) for e in expr_list])
+            if not any(isinstance(e, pex.BatchApplyExpression) for e in expr_list):
+                return scope.expression_table(
+                    storage, [self.compile(e, layout) for e in expr_list]
+                )
+            return self._build_select_with_udfs(expr_list, storage, layout)
 
         if kind == "filter":
             base = spec.inputs[0]
@@ -401,6 +410,63 @@ class GraphRunner:
             raise NotImplementedError("temporal behaviors arrive with the temporal module")
 
         raise NotImplementedError(f"unknown table spec kind {kind!r}")
+
+    def _build_select_with_udfs(
+        self,
+        expr_list: list[ColumnExpression],
+        storage: Node,
+        layout: Layout,
+    ) -> Node:
+        """Select with UDF (BatchApply) columns: plain columns evaluate in one
+        expression node; each UDF column becomes a BatchApplyNode over the
+        same prep node; results zip back together in output order.
+
+        UDF calls nested inside other expressions are rejected — the engine
+        batches them per commit, so they must be whole select columns
+        (matching the reference's async_apply_table contract,
+        src/engine/dataflow.rs:1757)."""
+        scope = self.scope
+
+        def check_no_nested(e: ColumnExpression) -> None:
+            for child in e._children():
+                if isinstance(child, pex.BatchApplyExpression):
+                    raise NotImplementedError(
+                        "async/batched UDF calls must be top-level select "
+                        "columns, not nested inside other expressions"
+                    )
+                check_no_nested(child)
+
+        pre_exprs: list[eex.EngineExpression] = []
+        plan: list[tuple[str, Any]] = []
+        for e in expr_list:
+            check_no_nested(e)
+            if isinstance(e, pex.BatchApplyExpression):
+                arg_positions = []
+                for a in (*e._args, *e._kwargs.values()):
+                    pre_exprs.append(self.compile(a, layout))
+                    arg_positions.append(len(pre_exprs) - 1)
+                plan.append(("batch", (e, arg_positions)))
+            else:
+                pre_exprs.append(self.compile(e, layout))
+                plan.append(("plain", len(pre_exprs) - 1))
+        pre = scope.expression_table(storage, pre_exprs)
+        parts: list[Node] = [pre]
+        col_map: list[int] = []
+        offset = len(pre_exprs)
+        for tag, payload in plan:
+            if tag == "plain":
+                col_map.append(payload)
+            else:
+                e, arg_positions = payload
+                node = scope.batch_apply_table(
+                    pre, e._rows_fn, arg_positions, e._propagate_none
+                )
+                node.name = f"udf<{e._name}>"
+                parts.append(node)
+                col_map.append(offset)
+                offset += 1
+        zipped = scope.zip_tables(parts)
+        return self._project(zipped, col_map)
 
     def _build_groupby(self, table: "Table") -> Node:
         from pathway_tpu.internals.table import Table as TableCls

@@ -1,0 +1,204 @@
+"""UDF subsystem: ``pw.udf`` with executors, caching and retries.
+
+Reference: python/pathway/internals/udfs/__init__.py:68 (UDF class),
+executors.py, caches.py, retries.py. A UDF call inside ``select`` lowers to
+the engine's BatchApplyNode, which hands whole commit-batches of rows to the
+executor — so async UDFs (LLM calls) run concurrently and device UDFs
+(jit embedders/rerankers) get microbatches instead of rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+from pathway_tpu.internals.expression import (
+    BatchApplyExpression,
+    ColumnExpression,
+)
+from pathway_tpu.internals.udfs.caches import (
+    CacheStrategy,
+    DefaultCache,
+    DiskCache,
+    InMemoryCache,
+)
+from pathway_tpu.internals.udfs.executors import (
+    AsyncExecutor,
+    BatchExecutor,
+    Executor,
+    SyncExecutor,
+    async_executor,
+    auto_executor,
+    batch_executor,
+    sync_executor,
+)
+from pathway_tpu.internals.udfs.retries import (
+    AsyncRetryStrategy,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    NoRetryStrategy,
+)
+from pathway_tpu.internals.udfs.caches import _digest, fn_cache_name
+
+
+class UDF:
+    """A callable lowered to engine batch execution when used in ``select``.
+
+    Subclass with ``__wrapped__`` or pass ``fn``; calling it with column
+    expressions builds the expression node.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[..., Any] | None = None,
+        *,
+        return_type: Any = None,
+        deterministic: bool = False,
+        propagate_none: bool = False,
+        executor: Executor | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        max_batch_size: int | None = None,
+    ) -> None:
+        if fn is None:
+            fn = getattr(self, "__wrapped__", None)
+        if fn is None:
+            raise TypeError("UDF needs a function")
+        self._fn = fn
+        self._name = getattr(fn, "__name__", "udf")
+        self._return_type = return_type
+        self._deterministic = deterministic
+        self._propagate_none = propagate_none
+        if executor is None:
+            executor = auto_executor(fn)
+        if max_batch_size is not None:
+            if not isinstance(executor, BatchExecutor):
+                raise ValueError(
+                    "max_batch_size requires a batch executor "
+                    "(pw.udfs.batch_executor())"
+                )
+            # fresh instance: never mutate a caller-shared executor
+            executor = BatchExecutor(max_batch_size=max_batch_size)
+        self._executor = executor
+        self._cache = cache_strategy
+        self._retry = retry_strategy
+        self._cache_name = fn_cache_name(fn)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> ColumnExpression:
+        rows_fn = functools.partial(
+            self.execute_rows, n_pos=len(args), kw_names=tuple(kwargs)
+        )
+        return BatchApplyExpression(
+            rows_fn,
+            self._return_type,
+            args,
+            kwargs,
+            propagate_none=self._propagate_none,
+            deterministic=self._deterministic,
+            name=self._name,
+        )
+
+    def _call_fn(self, n_pos: int, kw_names: tuple) -> Callable[..., Any]:
+        if not kw_names:
+            return self._fn
+        fn = self._fn
+
+        def wrapped(*vals: Any) -> Any:
+            return fn(*vals[:n_pos], **dict(zip(kw_names, vals[n_pos:])))
+
+        return wrapped
+
+    # -- engine entry point --------------------------------------------------
+
+    def execute_rows(
+        self,
+        rows: list[tuple],
+        n_pos: int | None = None,
+        kw_names: tuple = (),
+    ) -> list[tuple[bool, Any]]:
+        """(ok, value) per row; cache consulted before the executor runs."""
+        fn = self._call_fn(n_pos if n_pos is not None else len(rows[0]), kw_names)
+        if self._cache is None:
+            return self._executor.run(fn, rows, self._retry)
+        results: list[tuple[bool, Any] | None] = [None] * len(rows)
+        missing: list[int] = []
+        keys: list[str] = []
+        for i, args in enumerate(rows):
+            key = _digest(self._cache_name, args)
+            keys.append(key)
+            hit = self._cache.get(key)
+            if CacheStrategy.missing(hit):
+                missing.append(i)
+            else:
+                results[i] = (True, hit)
+        if missing:
+            # dedupe identical pending args within the batch: one compute
+            # per distinct cache key
+            unique: dict[str, list[int]] = {}
+            for i in missing:
+                unique.setdefault(keys[i], []).append(i)
+            reps = [idxs[0] for idxs in unique.values()]
+            computed = self._executor.run(
+                fn, [rows[i] for i in reps], self._retry
+            )
+            for rep, res in zip(reps, computed):
+                for i in unique[keys[rep]]:
+                    results[i] = res
+                if res[0]:
+                    self._cache.put(keys[rep], res[1])
+        return [r for r in results if r is not None]
+
+
+def udf(
+    fn: Callable[..., Any] | None = None,
+    /,
+    *,
+    return_type: Any = None,
+    deterministic: bool = False,
+    propagate_none: bool = False,
+    executor: Executor | None = None,
+    cache_strategy: CacheStrategy | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+    max_batch_size: int | None = None,
+) -> Any:
+    """``@pw.udf`` decorator (reference: udfs/__init__.py:68)."""
+
+    def make(f: Callable[..., Any]) -> UDF:
+        u = UDF(
+            f,
+            return_type=return_type,
+            deterministic=deterministic,
+            propagate_none=propagate_none,
+            executor=executor,
+            cache_strategy=cache_strategy,
+            retry_strategy=retry_strategy,
+            max_batch_size=max_batch_size,
+        )
+        functools.update_wrapper(u, f, updated=())
+        return u
+
+    if fn is not None:
+        return make(fn)
+    return make
+
+
+__all__ = [
+    "AsyncExecutor",
+    "AsyncRetryStrategy",
+    "BatchExecutor",
+    "CacheStrategy",
+    "DefaultCache",
+    "DiskCache",
+    "ExponentialBackoffRetryStrategy",
+    "Executor",
+    "FixedDelayRetryStrategy",
+    "InMemoryCache",
+    "NoRetryStrategy",
+    "SyncExecutor",
+    "UDF",
+    "async_executor",
+    "auto_executor",
+    "batch_executor",
+    "sync_executor",
+    "udf",
+]

@@ -1,0 +1,105 @@
+"""UDF result caches.
+
+Reference: python/pathway/internals/udfs/caches.py:35,120 (DiskCache via the
+diskcache lib, InMemoryCache). Here DiskCache is a dependency-free
+content-addressed pickle directory, so it doubles as the UDF-caching
+persistence mode (reference PersistenceMode::UdfCaching).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Callable  # noqa: F401 — Callable used in fn_cache_name
+
+_SENTINEL = object()
+
+
+def _digest(name: str, args: tuple) -> str:
+    """``name`` must uniquely identify the UDF (see UDF._cache_name: it
+    includes module, qualname and a code hash so same-named UDFs or edited
+    code never collide in a shared disk cache)."""
+    try:
+        payload = pickle.dumps((name, args), protocol=4)
+    except Exception:  # unpicklable args — hash reprs
+        payload = repr((name, args)).encode()
+    return hashlib.sha256(payload).hexdigest()
+
+
+def fn_cache_name(fn: Callable) -> str:
+    """Stable-across-runs identifier for a function: module + qualname +
+    bytecode digest (invalidates cached results when the code changes)."""
+    module = getattr(fn, "__module__", "?")
+    qualname = getattr(fn, "__qualname__", getattr(fn, "__name__", "udf"))
+    code = getattr(fn, "__code__", None)
+    code_hash = (
+        hashlib.sha256(code.co_code).hexdigest()[:16] if code is not None else ""
+    )
+    return f"{module}.{qualname}#{code_hash}"
+
+
+class CacheStrategy:
+    def get(self, key: str) -> Any:
+        return _SENTINEL
+
+    def put(self, key: str, value: Any) -> None:
+        pass
+
+    @staticmethod
+    def missing(value: Any) -> bool:
+        return value is _SENTINEL
+
+
+class InMemoryCache(CacheStrategy):
+    def __init__(self, max_size: int | None = None) -> None:
+        self._data: dict[str, Any] = {}
+        self._max_size = max_size
+
+    def get(self, key: str) -> Any:
+        return self._data.get(key, _SENTINEL)
+
+    def put(self, key: str, value: Any) -> None:
+        if self._max_size is not None and len(self._data) >= self._max_size:
+            self._data.pop(next(iter(self._data)))
+        self._data[key] = value
+
+
+class DiskCache(CacheStrategy):
+    """Pickle-per-key directory cache; ``directory`` defaults to the env
+    hook used by persistence-backed UDF caching."""
+
+    def __init__(self, directory: str | None = None) -> None:
+        self._dir = directory or os.environ.get(
+            "PATHWAY_TPU_UDF_CACHE", os.path.join(".pathway", "udf-cache")
+        )
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self._dir, key[:2], key)
+
+    def get(self, key: str) -> Any:
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+            return _SENTINEL
+
+    def put(self, key: str, value: Any) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(value, f, protocol=4)
+            os.replace(tmp, path)
+        except Exception:  # unpicklable result — skip caching
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+class DefaultCache(DiskCache):
+    """Reference-compatible alias (udfs.DefaultCache == disk-backed)."""

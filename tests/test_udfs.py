@@ -1,0 +1,228 @@
+import asyncio
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner
+from pathway_tpu.internals.udfs import (
+    FixedDelayRetryStrategy,
+    InMemoryCache,
+    async_executor,
+    batch_executor,
+)
+
+
+def run_rows(table):
+    return sorted(GraphRunner().capture(table)[0].values(), key=repr)
+
+
+def make_table():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(1,), (2,), (3,)]
+    )
+
+
+def test_sync_udf():
+    @pw.udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    t = make_table()
+    assert run_rows(t.select(y=double(t.x))) == [(2,), (4,), (6,)]
+
+
+def test_async_udf_concurrent():
+    calls = {"max_live": 0, "live": 0}
+
+    @pw.udf
+    async def slow(x: int) -> int:
+        calls["live"] += 1
+        calls["max_live"] = max(calls["max_live"], calls["live"])
+        await asyncio.sleep(0.02)
+        calls["live"] -= 1
+        return x + 10
+
+    t = make_table()
+    assert run_rows(t.select(y=slow(t.x))) == [(11,), (12,), (13,)]
+    assert calls["max_live"] > 1  # rows of one commit ran concurrently
+
+
+def test_async_capacity_bound():
+    seen = {"max_live": 0, "live": 0}
+
+    @pw.udf(executor=async_executor(capacity=1))
+    async def slow(x: int) -> int:
+        seen["live"] += 1
+        seen["max_live"] = max(seen["max_live"], seen["live"])
+        await asyncio.sleep(0.01)
+        seen["live"] -= 1
+        return x
+
+    t = make_table()
+    run_rows(t.select(y=slow(t.x)))
+    assert seen["max_live"] == 1
+
+
+def test_async_timeout_poisons_row():
+    @pw.udf(executor=async_executor(timeout=0.01))
+    async def hang(x: int) -> int:
+        if x == 2:
+            await asyncio.sleep(1.0)
+        return x
+
+    t = make_table()
+    rows = run_rows(t.select(y=hang(t.x)))
+    assert (1,) in rows and (3,) in rows
+    assert any(v is pw.ERROR for (v,) in rows)
+
+
+def test_batch_udf_receives_columns():
+    batches = []
+
+    @pw.udf(executor=batch_executor())
+    def embed(xs: list) -> list:
+        batches.append(list(xs))
+        return [x * 100 for x in xs]
+
+    t = make_table()
+    assert run_rows(t.select(y=embed(t.x))) == [(100,), (200,), (300,)]
+    assert len(batches) == 1 and sorted(batches[0]) == [1, 2, 3]
+
+
+def test_batch_udf_max_batch_size():
+    batches = []
+
+    @pw.udf(executor=batch_executor(max_batch_size=2))
+    def embed(xs: list) -> list:
+        batches.append(len(xs))
+        return xs
+
+    t = make_table()
+    run_rows(t.select(y=embed(t.x)))
+    assert sorted(batches) == [1, 2]
+
+
+def test_cache_skips_recompute():
+    count = {"n": 0}
+
+    @pw.udf(cache_strategy=InMemoryCache())
+    def f(x: int) -> int:
+        count["n"] += 1
+        return x * 3
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(5,), (5,), (7,)]
+    )
+    rows = run_rows(t.select(y=f(t.x)))
+    assert rows == [(15,), (15,), (21,)]
+    assert count["n"] == 2  # 5 computed once, 7 once
+
+
+def test_retry_strategy_recovers():
+    attempts = {"n": 0}
+
+    @pw.udf(retry_strategy=FixedDelayRetryStrategy(max_retries=3, delay_ms=1))
+    def flaky(x: int) -> int:
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return x
+
+    t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(9,)])
+    assert run_rows(t.select(y=flaky(t.x))) == [(9,)]
+    assert attempts["n"] == 3
+
+
+def test_udf_error_poisons_and_logs():
+    @pw.udf
+    def boom(x: int) -> int:
+        if x == 2:
+            raise ValueError("bad row")
+        return x
+
+    t = make_table()
+    runner = GraphRunner()
+    result = t.select(y=boom(t.x))
+    rows = sorted(runner.capture(result)[0].values(), key=repr)
+    assert any(v is pw.ERROR for (v,) in rows)
+    errors = list(runner.scope.error_log_default.current.values())
+    assert any("bad row" in msg for (msg,) in errors)
+
+
+def test_nested_udf_rejected():
+    @pw.udf
+    async def f(x: int) -> int:
+        return x
+
+    t = make_table()
+    with pytest.raises(NotImplementedError):
+        GraphRunner().capture(t.select(y=f(t.x) + 1))
+
+
+def test_udf_with_kwargs():
+    @pw.udf
+    def scale(x: int, factor: int = 1) -> int:
+        return x * factor
+
+    t = make_table()
+    assert run_rows(t.select(y=scale(t.x, factor=10))) == [
+        (10,),
+        (20,),
+        (30,),
+    ]
+
+
+def test_apply_async_sync_fn():
+    t = make_table()
+    rows = run_rows(t.select(y=pw.apply_async(lambda x: 2 * x, t.x)))
+    assert rows == [(2,), (4,), (6,)]
+
+
+def test_batch_node_preserves_multiplicity():
+    from pathway_tpu.engine.graph import Scheduler, Scope
+    from pathway_tpu.engine.value import ref_scalar
+
+    scope = Scope()
+    sess = scope.input_session(arity=1)
+    node = scope.batch_apply_table(
+        sess, lambda rows: [(True, a[0] * 2) for a in rows], [0]
+    )
+    sched = Scheduler(scope)
+    k = ref_scalar(1)
+    sess.insert(k, (5,))
+    sess.insert(k, (5,))  # multiplicity 2
+    sched.commit()
+    sess.remove(k, (5,))
+    sess.remove(k, (5,))
+    sched.commit()
+    assert k not in node.current  # net zero, not -1
+
+
+def test_deletion_retracts_udf_output():
+    from pathway_tpu.engine.graph import Scheduler, Scope
+    from pathway_tpu.engine.value import ref_scalar
+
+    import random
+
+    scope = Scope()
+    sess = scope.input_session(arity=1)
+
+    calls = {"n": 0}
+
+    def rows_fn(rows):
+        calls["n"] += 1
+        return [(True, random.random()) for _ in rows]
+
+    node = scope.batch_apply_table(sess, rows_fn, [0])
+    sched = Scheduler(scope)
+    k = ref_scalar(1)
+    sess.insert(k, ("a",))
+    sched.commit()
+    value = node.current[k]
+    sess.remove(k, ("a",))
+    sched.commit()
+    # nondeterministic output: deletion must retract the memoized value,
+    # not recompute
+    assert k not in node.current
+    assert calls["n"] == 1
