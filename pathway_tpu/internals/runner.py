@@ -47,16 +47,7 @@ _CAST_NAMES = {
 }
 
 
-def _make_kw_fn(fn: Callable, n_pos: int, kw_names: list[str]) -> Callable:
-    if not kw_names:
-        return fn
-
-    def wrapped(*vals: Any) -> Any:
-        pos = vals[:n_pos]
-        kws = dict(zip(kw_names, vals[n_pos:]))
-        return fn(*pos, **kws)
-
-    return wrapped
+from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 
 
 class GraphRunner:

@@ -30,6 +30,7 @@ from pathway_tpu.internals.udfs.executors import (
     async_executor,
     auto_executor,
     batch_executor,
+    make_kw_fn,
     sync_executor,
 )
 from pathway_tpu.internals.udfs.retries import (
@@ -59,7 +60,12 @@ class UDF:
         cache_strategy: CacheStrategy | None = None,
         retry_strategy: AsyncRetryStrategy | None = None,
         max_batch_size: int | None = None,
+        cache_name: str | None = None,
     ) -> None:
+        """``cache_name`` qualifies cache keys for closure-configured UDFs:
+        two instances wrapping the same closure code but different captured
+        config (model name, params) MUST pass distinct cache_names or they
+        will share cached results."""
         if fn is None:
             fn = getattr(self, "__wrapped__", None)
         if fn is None:
@@ -82,7 +88,7 @@ class UDF:
         self._executor = executor
         self._cache = cache_strategy
         self._retry = retry_strategy
-        self._cache_name = fn_cache_name(fn)
+        self._cache_name = cache_name or fn_cache_name(fn)
 
     def __call__(self, *args: Any, **kwargs: Any) -> ColumnExpression:
         rows_fn = functools.partial(
@@ -99,14 +105,7 @@ class UDF:
         )
 
     def _call_fn(self, n_pos: int, kw_names: tuple) -> Callable[..., Any]:
-        if not kw_names:
-            return self._fn
-        fn = self._fn
-
-        def wrapped(*vals: Any) -> Any:
-            return fn(*vals[:n_pos], **dict(zip(kw_names, vals[n_pos:])))
-
-        return wrapped
+        return make_kw_fn(self._fn, n_pos, list(kw_names))
 
     # -- engine entry point --------------------------------------------------
 

@@ -23,6 +23,19 @@ from pathway_tpu.internals.udfs.retries import AsyncRetryStrategy
 RowResult = tuple[bool, Any]  # (ok, value-or-exception)
 
 
+def make_kw_fn(fn: Callable, n_pos: int, kw_names: list[str]) -> Callable:
+    """Rebind a flat positional arg tuple to ``fn(*pos, **kw)``."""
+    if not kw_names:
+        return fn
+
+    def wrapped(*vals: Any) -> Any:
+        pos = vals[:n_pos]
+        kws = dict(zip(kw_names, vals[n_pos:]))
+        return fn(*pos, **kws)
+
+    return wrapped
+
+
 class Executor:
     kind = "sync"
 

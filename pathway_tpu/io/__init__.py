@@ -5,12 +5,13 @@ subscribe. Remote-service connectors (kafka, s3, deltalake, ...) are gated on
 their client libraries being present.
 """
 
-from pathway_tpu.io import csv, fs, jsonlines, null, plaintext, python
+from pathway_tpu.io import csv, fs, http, jsonlines, null, plaintext, python
 from pathway_tpu.io._subscribe import subscribe
 
 __all__ = [
     "csv",
     "fs",
+    "http",
     "jsonlines",
     "null",
     "plaintext",

@@ -1,0 +1,202 @@
+"""pw.io.http — REST ingress: webserver + request/response connector pair.
+
+Reference: python/pathway/io/http/_server.py — PathwayWebserver (aiohttp,
+:329) and rest_connector (:624): each HTTP request becomes a row in a query
+table; a response writer subscribed to the result table resolves the pending
+HTTP future when the row's answer is produced. This is the serving path of
+VectorStoreServer / the RAG QA servers (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import threading
+import uuid
+from typing import Any, Callable, Sequence
+
+from pathway_tpu.engine.connectors import INSERT, DELETE, ParsedEvent, QueueReader
+from pathway_tpu.engine.value import Json, Pointer, ref_scalar
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import input_table
+
+_REQUEST_ID = "_pw_request_id"
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by any number of rest_connector routes."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8080) -> None:
+        self.host = host
+        self.port = port
+        self._routes: dict[str, Callable] = {}
+        self._started = False
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._ready = threading.Event()
+
+    def add_route(self, route: str, handler: Callable) -> None:
+        if self._started:
+            raise RuntimeError("cannot add routes after the server started")
+        self._routes[route] = handler
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+
+        def serve() -> None:
+            from aiohttp import web
+
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            app = web.Application()
+            for route, handler in self._routes.items():
+                app.router.add_post(route, handler)
+                app.router.add_get(route, handler)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._ready.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(
+            target=serve, name="pw-webserver", daemon=True
+        )
+        self._thread.start()
+        self._ready.wait(timeout=10)
+
+
+class RestResponseWriter:
+    """Resolves pending HTTP futures from the result table's update stream."""
+
+    def __init__(self, futures: dict[Pointer, concurrent.futures.Future]):
+        self._futures = futures
+
+    def attach(self, result_table: Table, runner: Any) -> None:
+        node = runner.build(result_table)
+
+        def on_change(key: Pointer, row: tuple, time: int, diff: int) -> None:
+            if diff <= 0:
+                return
+            fut = self._futures.pop(key, None)
+            if fut is not None and not fut.done():
+                names = result_table.column_names()
+                fut.set_result({n: v for n, v in zip(names, row)})
+
+        runner.scope.subscribe_table(node, on_change=on_change)
+
+
+def rest_connector(
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    *,
+    schema: schema_mod.SchemaMetaclass,
+    route: str = "/",
+    webserver: PathwayWebserver | None = None,
+    delete_completed_queries: bool = True,
+    request_timeout: float = 30.0,
+) -> tuple[Table, Callable[[Table, Any], None]]:
+    """Returns ``(query_table, attach_response)``.
+
+    ``attach_response(result_table, runner)`` must be called (directly or via
+    ``pw.io.http.PathwayRestServer``) before the streaming run starts; the
+    result table must be keyed by the query table's ids.
+    """
+    server = webserver or PathwayWebserver(host, port)
+    reader = QueueReader()
+    futures: dict[Pointer, concurrent.futures.Future] = {}
+    columns = schema.column_names()
+    dtypes = dict(schema.dtypes())
+
+    class _RestParser:
+        def parse(self, payload: Any) -> list[ParsedEvent]:
+            kind, rid, data = payload
+            values = [rid]
+            for name in columns:
+                v = data.get(name)
+                if dtypes[name].strip_optional() == dt.JSON and v is not None:
+                    v = Json(v)
+                values.append(v)
+            return [ParsedEvent(kind, tuple(values))]
+
+    full_schema = schema_mod.schema_from_dict(
+        {
+            _REQUEST_ID: {"dtype": dt.STR, "primary_key": True},
+            **{n: dtypes[n] for n in columns},
+        },
+        name="RestRequestSchema",
+    )
+
+    async def handler(request: Any):
+        from aiohttp import web
+
+        try:
+            if request.method == "GET":
+                data = dict(request.query)
+            else:
+                data = await request.json()
+        except (json.JSONDecodeError, ValueError):
+            return web.json_response({"error": "invalid json"}, status=400)
+        if not isinstance(data, dict):
+            return web.json_response(
+                {"error": "request body must be a JSON object"}, status=400
+            )
+        rid = uuid.uuid4().hex
+        key = ref_scalar(rid)
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        futures[key] = fut
+        reader.push(("insert", rid, data), source_id=rid)
+        try:
+            result = await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=request_timeout
+            )
+        except asyncio.TimeoutError:
+            futures.pop(key, None)
+            return web.json_response({"error": "timeout"}, status=504)
+        finally:
+            if delete_completed_queries:
+                reader.push(("delete", rid, data), source_id=rid)
+        if isinstance(result, dict) and set(result) == {"result"}:
+            result = result["result"]
+        return web.json_response(_jsonable(result))
+
+    server.add_route(route, handler)
+
+    table = input_table(
+        full_schema,
+        make_reader=lambda: reader,
+        make_parser=lambda _cols: _RestParser(),
+        source_name=f"rest:{route}",
+    )
+    # start the webserver lazily at attach time so the port opens only when
+    # a graph is actually run
+    writer = RestResponseWriter(futures)
+
+    def attach_response(result_table: Table, runner: Any) -> None:
+        writer.attach(result_table, runner)
+        server.start()
+
+    return table, attach_response
+
+
+def _jsonable(value: Any) -> Any:
+    import numpy as np
+
+    if isinstance(value, Json):
+        return value.value
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, (np.floating, np.integer)):
+        return value.item()
+    if isinstance(value, Pointer):
+        return str(value)
+    return value

@@ -21,6 +21,7 @@ from pathway_tpu.internals.expression import (
 )
 from pathway_tpu.internals.reducers import sorted_tuple
 from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import solver
 
 
 class InnerIndexFactory:
@@ -175,4 +176,9 @@ class DataIndex:
                 )
             ),
         )
-        return grouped.reduce(**agg)
+        result = grouped.reduce(**agg)
+        # group keys ARE query ids (groupby id=_pw_query_id), so the result
+        # universe is a subset of the query table's — teach the solver so
+        # callers can select query columns next to the reply columns
+        solver.register_subset(result._universe, query_table._universe)
+        return result

@@ -1,0 +1,45 @@
+"""LLM xpack: embedders, chats, rerankers, splitters, parsers, stores,
+RAG pipelines and REST servers (reference: python/pathway/xpacks/llm/).
+
+The local model path (embedders / rerankers / chats) is TPU-native JAX
+(models/), jit-compiled and microbatched by the engine's batch executor;
+the vector store lives in TPU HBM (stdlib/indexing over ops/knn.py).
+"""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    mocks,
+    parsers,
+    prompts,
+    rerankers,
+    splitters,
+)
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    RAGClient,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm.vector_store import (
+    VectorStoreClient,
+    VectorStoreServer,
+)
+
+__all__ = [
+    "AdaptiveRAGQuestionAnswerer",
+    "BaseRAGQuestionAnswerer",
+    "DocumentStore",
+    "RAGClient",
+    "VectorStoreClient",
+    "VectorStoreServer",
+    "answer_with_geometric_rag_strategy",
+    "embedders",
+    "llms",
+    "mocks",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "splitters",
+]

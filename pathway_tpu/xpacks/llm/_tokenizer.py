@@ -1,0 +1,129 @@
+"""Tokenization for local TPU models.
+
+The reference delegates to HF tokenizers downloaded from the hub
+(xpacks/llm/embedders.py:270). This environment has no egress, so the
+default is a deterministic hashing tokenizer (stable across runs and
+processes); a locally cached HF tokenizer object can be passed anywhere a
+tokenizer is accepted — the contract is just ``encode_batch``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Protocol, Sequence
+
+import numpy as np
+
+CLS_ID = 1
+SEP_ID = 2
+
+
+class Tokenizer(Protocol):
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """-> (token_ids [b, t] int32, mask [b, t] bool), t <= max_len."""
+        ...
+
+
+def _hash_token(word: str, vocab_size: int) -> int:
+    h = hashlib.blake2s(word.encode(), digest_size=4).digest()
+    # ids 0..3 reserved (pad/cls/sep/unk)
+    return 4 + int.from_bytes(h, "little") % (vocab_size - 4)
+
+
+class HashTokenizer:
+    """Whitespace+punctuation split, blake2s-hashed ids, CLS/SEP framing."""
+
+    def __init__(self, vocab_size: int = 30522) -> None:
+        self.vocab_size = vocab_size
+
+    def _words(self, text: str) -> list[str]:
+        out, cur = [], []
+        for ch in str(text).lower():
+            if ch.isalnum():
+                cur.append(ch)
+            else:
+                if cur:
+                    out.append("".join(cur))
+                    cur = []
+                if not ch.isspace():
+                    out.append(ch)
+        if cur:
+            out.append("".join(cur))
+        return out
+
+    def encode(self, text: str, max_len: int) -> list[int]:
+        words = self._words(text)[: max_len - 2]
+        return (
+            [CLS_ID]
+            + [_hash_token(w, self.vocab_size) for w in words]
+            + [SEP_ID]
+        )
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        encoded = [self.encode(t, max_len) for t in texts]
+        t = max((len(e) for e in encoded), default=2)
+        ids = np.zeros((len(texts), t), np.int32)
+        mask = np.zeros((len(texts), t), bool)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = True
+        return ids, mask
+
+    def encode_pair_batch(
+        self, left: Sequence[str], right: Sequence[str], max_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """[CLS] left [SEP] right [SEP] — the cross-encoder input shape."""
+        texts = []
+        encoded = []
+        for l_txt, r_txt in zip(left, right):
+            lw = self._words(l_txt)
+            rw = self._words(r_txt)
+            budget = max_len - 3
+            lw = lw[: budget // 2]
+            rw = rw[: budget - len(lw)]
+            encoded.append(
+                [CLS_ID]
+                + [_hash_token(w, self.vocab_size) for w in lw]
+                + [SEP_ID]
+                + [_hash_token(w, self.vocab_size) for w in rw]
+                + [SEP_ID]
+            )
+        t = max((len(e) for e in encoded), default=3)
+        ids = np.zeros((len(encoded), t), np.int32)
+        mask = np.zeros((len(encoded), t), bool)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = True
+        return ids, mask
+
+    def count_tokens(self, text: str) -> int:
+        return len(self._words(text))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return " ".join(f"<{i}>" for i in ids if i > 3)
+
+
+def pad_to_buckets(
+    ids: np.ndarray, mask: np.ndarray, batch_bucket_min: int = 8
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Pad batch and seq dims up to powers of two so jit caches stay small.
+
+    Returns (ids, mask, real_batch). Sequence is padded to the next power of
+    two; batch likewise (min ``batch_bucket_min``).
+    """
+    b, t = ids.shape
+    bt = batch_bucket_min
+    while bt < b:
+        bt *= 2
+    tt = 8
+    while tt < t:
+        tt *= 2
+    out_ids = np.zeros((bt, tt), np.int32)
+    out_mask = np.zeros((bt, tt), bool)
+    out_ids[:b, :t] = ids
+    out_mask[:b, :t] = mask
+    return out_ids, out_mask, b

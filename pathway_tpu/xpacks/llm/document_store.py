@@ -1,0 +1,181 @@
+"""DocumentStore: docs -> parse -> split -> index, with retrieval queries.
+
+Reference: xpacks/llm/document_store.py:32 (DocumentStore over a pluggable
+DocumentIndexFactory; retrieve/inputs/statistics query methods). The
+pipeline runs as engine dataflow: parser/splitter/embedder are UDF nodes,
+the index is the as-of-now external-index operator in TPU HBM (or host BM25).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import apply as pw_apply
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing import DataIndex, TantivyBM25Factory, TpuKnnFactory
+from pathway_tpu.xpacks.llm.parsers import ParseUtf8
+from pathway_tpu.xpacks.llm.splitters import NullSplitter
+
+
+class DocumentStore:
+    """Indexes documents and serves retrieval queries as dataflow.
+
+    ``docs`` tables need a ``data`` column (bytes/str) and may carry a
+    ``_metadata`` dict column. Retrieval with ``retriever_factory='knn'``
+    requires ``embedder`` (any text->vector UDF).
+    """
+
+    def __init__(
+        self,
+        docs: Table | Sequence[Table],
+        *,
+        embedder: Any = None,
+        parser: Any = None,
+        splitter: Any = None,
+        retriever_factory: str | Any = "knn",
+        dimensions: int | None = None,
+        index_capacity: int = 1024,
+        metric: str = "cos",
+    ) -> None:
+        if isinstance(docs, Table):
+            docs = [docs]
+        self.parser = parser or ParseUtf8()
+        self.splitter = splitter or NullSplitter()
+        self.embedder = embedder
+
+        tables = []
+        for d in docs:
+            cols = d.column_names()
+            meta = d["_metadata"] if "_metadata" in cols else None
+            t = d.select(
+                data=d["data"],
+                _metadata=meta if meta is not None else pw_apply(lambda _x: {}, d["data"]),
+            )
+            tables.append(t)
+        raw = tables[0].concat_reindex(*tables[1:]) if len(tables) > 1 else tables[0]
+        self.input_docs = raw
+
+        parsed = raw.select(_parts=self.parser(raw["data"]), _metadata=raw["_metadata"])
+        parsed = parsed.flatten(parsed["_parts"])
+        parsed = parsed.select(
+            text=parsed["_parts"].get(0),
+            _metadata=pw_apply(
+                lambda part, meta: {**dict(meta or {}), **dict(part[1] or {})},
+                parsed["_parts"],
+                parsed["_metadata"],
+            ),
+        )
+        chunked = parsed.select(
+            _chunks=self.splitter(parsed["text"]), _metadata=parsed["_metadata"]
+        )
+        chunked = chunked.flatten(chunked["_chunks"])
+        self.chunks = chunked.select(
+            text=chunked["_chunks"].get(0), _metadata=chunked["_metadata"]
+        )
+
+        if retriever_factory == "knn":
+            if self.embedder is None:
+                raise ValueError("knn retrieval needs an embedder")
+            if dimensions is None:
+                get_dim = getattr(self.embedder, "get_embedding_dimension", None)
+                if get_dim is None:
+                    raise ValueError("pass dimensions= for this embedder")
+                dimensions = get_dim()
+            data = self.chunks.select(
+                text=self.chunks.text,
+                _metadata=self.chunks["_metadata"],
+                emb=self.embedder(self.chunks.text),
+            )
+            factory = TpuKnnFactory(
+                dimensions=dimensions, metric=metric, capacity=index_capacity
+            )
+            self.indexed = data
+            self.index = DataIndex(data, factory, data.emb)
+            self._query_is_vector = True
+        elif retriever_factory == "bm25":
+            self.indexed = self.chunks
+            self.index = DataIndex(
+                self.chunks, TantivyBM25Factory(), self.chunks.text
+            )
+            self._query_is_vector = False
+        else:
+            # custom InnerIndexFactory over the text column
+            self.indexed = self.chunks
+            self.index = DataIndex(
+                self.chunks, retriever_factory, self.chunks.text
+            )
+            self._query_is_vector = False
+
+    # -- queries -------------------------------------------------------------
+
+    def retrieve_query(self, query_table: Table) -> Table:
+        """``query_table(query: str, k: int)`` -> ``result`` column: tuple of
+        ``{"text", "metadata", "dist"}`` dicts (reference DocumentStore
+        retrieve format)."""
+        if self._query_is_vector:
+            prepped = query_table.select(
+                query=query_table.query,
+                k=query_table.k,
+                _qv=self.embedder(query_table.query),
+            )
+            qcol = prepped["_qv"]
+        else:
+            prepped = query_table.select(
+                query=query_table.query, k=query_table.k
+            )
+            qcol = prepped["query"]
+        hits = self.index.query_docs_as_of_now(
+            prepped,
+            qcol,
+            doc_columns=["text", "_metadata"],
+            number_of_matches=prepped.k,
+        )
+
+        def to_result(texts: tuple, metas: tuple, scores: tuple) -> tuple:
+            return tuple(
+                {"text": t, "metadata": dict(m or {}), "dist": -float(s)}
+                for t, m, s in zip(texts, metas, scores)
+            )
+
+        return hits.select(
+            result=pw_apply(
+                to_result,
+                hits["text"],
+                hits["_metadata"],
+                hits["_pw_index_reply_scores"],
+            )
+        )
+
+    def _broadcast_to_queries(
+        self, query_table: Table, singleton: Table, **cols: Any
+    ) -> Table:
+        """Left-join every query row against a single aggregate row."""
+        first_col = query_table.column_names()[0]
+        one_q = query_table.select(
+            _one=pw_apply(lambda *_a: 1, query_table[first_col])
+        )
+        agg_k = singleton.select(
+            _one=pw_apply(lambda *_a: 1, singleton[singleton.column_names()[0]]),
+            **{n: singleton[n] for n in singleton.column_names()},
+        )
+        joined = one_q.join_left(
+            agg_k, one_q["_one"] == agg_k["_one"], id=one_q.id
+        )
+        return joined.select(**{n: agg_k[n] for n in cols})
+
+    def statistics_query(self, query_table: Table) -> Table:
+        """Indexed chunk count per request (reference statistics endpoint)."""
+        from pathway_tpu.internals.reducers import count
+
+        stats = self.chunks.reduce(count=count())
+        return self._broadcast_to_queries(query_table, stats, count=stats.count)
+
+    def inputs_query(self, query_table: Table) -> Table:
+        """Metadata of all input documents (reference /v1/inputs)."""
+        from pathway_tpu.internals.reducers import tuple as tuple_reducer
+
+        docs = self.input_docs
+        metas = docs.select(m=pw_apply(lambda m: dict(m or {}), docs["_metadata"]))
+        agg = metas.reduce(result=tuple_reducer(metas.m))
+        return self._broadcast_to_queries(query_table, agg, result=agg.result)

@@ -1,0 +1,173 @@
+"""Embedders: text -> vector UDFs.
+
+Reference: python/pathway/xpacks/llm/embedders.py — SentenceTransformerEmbedder
+(:270, local torch), OpenAIEmbedder (:85), LiteLLMEmbedder (:180),
+GeminiEmbedder (:330). The local embedder here is the TPU-native JAX encoder
+(models/transformer.py) jit-compiled and driven by the engine's batch
+executor, so every commit becomes one padded MXU call instead of a torch
+row loop. Remote embedders are async UDFs with capacity/retry/cache knobs;
+in this zero-egress environment they require an injected ``client`` callable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals.udfs import (
+    UDF,
+    AsyncRetryStrategy,
+    CacheStrategy,
+    async_executor,
+    batch_executor,
+)
+from pathway_tpu.xpacks.llm._tokenizer import HashTokenizer, Tokenizer, pad_to_buckets
+
+_ENCODER_PRESETS = {
+    "all-MiniLM-L6-v2": "minilm_l6",
+    "sentence-transformers/all-MiniLM-L6-v2": "minilm_l6",
+    "BAAI/bge-base-en": "bge_base",
+    "BAAI/bge-base-en-v1.5": "bge_base",
+    "BAAI/bge-small-en-v1.5": "bge_small",
+}
+
+
+class TpuEncoderEmbedder(UDF):
+    """Local sentence embedder running on TPU.
+
+    ``model`` picks the architecture preset (weights are randomly
+    initialised unless ``params`` is given — pass imported checkpoint
+    pytrees for real semantics; throughput and the full pipeline shape are
+    identical either way).
+    """
+
+    def __init__(
+        self,
+        model: str = "all-MiniLM-L6-v2",
+        *,
+        max_len: int = 128,
+        max_batch_size: int = 256,
+        tokenizer: Tokenizer | None = None,
+        params: Any = None,
+        seed: int = 0,
+        cache_strategy: CacheStrategy | None = None,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from pathway_tpu.models import (
+            bge_base,
+            bge_small,
+            embed,
+            init_encoder_params,
+            minilm_l6,
+        )
+
+        preset = _ENCODER_PRESETS.get(model, model)
+        cfg_fn = {
+            "minilm_l6": minilm_l6,
+            "bge_base": bge_base,
+            "bge_small": bge_small,
+        }.get(preset)
+        if cfg_fn is None:
+            raise ValueError(
+                f"unknown encoder preset {model!r}; "
+                f"known: {sorted(_ENCODER_PRESETS)} + minilm_l6/bge_base/bge_small"
+            )
+        self.config = cfg_fn()
+        self.max_len = max_len
+        self.tokenizer = tokenizer or HashTokenizer(self.config.vocab_size)
+        if params is None:
+            params = init_encoder_params(jax.random.key(seed), self.config)
+        self._params = params
+        cfg = self.config
+        self._jit_embed = jax.jit(
+            lambda ids, mask: embed(params, ids, mask, cfg)
+        )
+
+        def embed_batch(texts: list) -> list:
+            ids, mask = self.tokenizer.encode_batch(
+                [str(t) for t in texts], self.max_len
+            )
+            ids, mask, real = pad_to_buckets(ids, mask)
+            vecs = np.asarray(
+                self._jit_embed(jnp.asarray(ids), jnp.asarray(mask)),
+                np.float32,
+            )
+            return [vecs[i] for i in range(real)]
+
+        super().__init__(
+            embed_batch,
+            executor=batch_executor(max_batch_size=max_batch_size),
+            deterministic=True,
+            cache_strategy=cache_strategy,
+            cache_name=f"TpuEncoderEmbedder:{preset}:{max_len}:seed{seed}",
+        )
+
+    def get_embedding_dimension(self) -> int:
+        return self.config.hidden
+
+
+class SentenceTransformerEmbedder(TpuEncoderEmbedder):
+    """Reference-compatible name (embedders.py:270); TPU-native engine."""
+
+
+class _RemoteEmbedder(UDF):
+    """Shared shape of OpenAI/LiteLLM/Gemini embedders: an async UDF over an
+    injected client (``client(model=..., input=[text]) -> list[float]``)."""
+
+    def __init__(
+        self,
+        model: str,
+        client: Callable[..., Any] | None = None,
+        *,
+        capacity: int | None = None,
+        timeout: float | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        **client_kwargs: Any,
+    ) -> None:
+        self.model = model
+        self.kwargs = client_kwargs
+        if client is None:
+            raise ValueError(
+                f"{type(self).__name__} needs an async `client` callable "
+                "(this environment has no network egress); use "
+                "xpacks.llm.mocks.fake_embeddings_model for offline runs"
+            )
+
+        async def call(text: str) -> Any:
+            result = client(model=self.model, input=str(text), **self.kwargs)
+            if hasattr(result, "__await__"):
+                result = await result
+            return np.asarray(result, np.float32)
+
+        super().__init__(
+            call,
+            executor=async_executor(capacity=capacity, timeout=timeout),
+            cache_strategy=cache_strategy,
+            retry_strategy=retry_strategy,
+            cache_name=f"{type(self).__name__}:{model}",
+        )
+
+
+class OpenAIEmbedder(_RemoteEmbedder):
+    """Reference: embedders.py:85."""
+
+    def __init__(self, model: str = "text-embedding-3-small", **kw: Any):
+        super().__init__(model, **kw)
+
+
+class LiteLLMEmbedder(_RemoteEmbedder):
+    """Reference: embedders.py:180."""
+
+    def __init__(self, model: str = "", **kw: Any):
+        super().__init__(model, **kw)
+
+
+class GeminiEmbedder(_RemoteEmbedder):
+    """Reference: embedders.py:330."""
+
+    def __init__(self, model: str = "models/text-embedding-004", **kw: Any):
+        super().__init__(model, **kw)

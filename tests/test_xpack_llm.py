@@ -1,0 +1,197 @@
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner
+from pathway_tpu.xpacks.llm import (
+    BaseRAGQuestionAnswerer,
+    DocumentStore,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm._tokenizer import HashTokenizer
+from pathway_tpu.xpacks.llm.embedders import TpuEncoderEmbedder
+from pathway_tpu.xpacks.llm.llms import TpuPipelineChat, prompt_chat_single_qa
+from pathway_tpu.xpacks.llm.mocks import FakeChatModel, FakeEmbedder, IdentityMockChat
+from pathway_tpu.xpacks.llm.rerankers import CrossEncoderReranker, rerank_topk_filter
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+
+def docs_table():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(data=str),
+        [
+            ("pathway is a streaming dataflow framework",),
+            ("the tpu has a systolic array matrix unit",),
+            ("bread baking needs flour water salt yeast",),
+        ],
+    )
+
+
+class TestTokenizer:
+    def test_deterministic_and_padded(self):
+        tok = HashTokenizer(1000)
+        ids1, mask1 = tok.encode_batch(["hello world", "hi"], 16)
+        ids2, _ = tok.encode_batch(["hello world", "hi"], 16)
+        np.testing.assert_array_equal(ids1, ids2)
+        assert mask1[0].sum() == 4  # CLS + 2 words + SEP
+        assert mask1[1].sum() == 3
+
+
+class TestEmbedder:
+    def test_embeds_and_dimension(self):
+        emb = TpuEncoderEmbedder(max_len=32)
+        assert emb.get_embedding_dimension() == 384
+        out = emb.execute_rows([("hello world",), ("tpu",)])
+        assert all(ok for ok, _v in out)
+        vecs = [v for _ok, v in out]
+        assert vecs[0].shape == (384,)
+        np.testing.assert_allclose(np.linalg.norm(vecs[0]), 1.0, atol=1e-4)
+
+    def test_same_text_same_vector(self):
+        emb = TpuEncoderEmbedder(max_len=32)
+        out = emb.execute_rows([("same text",), ("same text",)])
+        np.testing.assert_allclose(out[0][1], out[1][1], atol=1e-6)
+
+
+class TestSplitter:
+    def test_token_count_splitter(self):
+        sp = TokenCountSplitter(min_tokens=2, max_tokens=4)
+        out = sp.execute_rows([("one two three four five six seven eight",)])
+        (ok, chunks) = out[0]
+        assert ok
+        assert len(chunks) >= 2
+        joined = " ".join(c[0] for c in chunks)
+        assert joined == "one two three four five six seven eight"
+
+
+class TestReranker:
+    def test_cross_encoder_scores(self):
+        rr = CrossEncoderReranker(max_len=64)
+        out = rr.execute_rows([("doc one", "query"), ("doc two", "query")])
+        assert all(ok for ok, _v in out)
+        assert all(isinstance(v, float) for _ok, v in out)
+
+    def test_rerank_topk_filter(self):
+        docs = ("a", "b", "c")
+        scores = (0.1, 0.9, 0.5)
+        top_docs, top_scores = rerank_topk_filter(docs, scores, 2)
+        assert top_docs == ("b", "c")
+        assert top_scores == (0.9, 0.5)
+
+
+class TestChat:
+    def test_tpu_pipeline_chat_generates(self):
+        chat = TpuPipelineChat(model="tiny", max_new_tokens=4)
+        out = chat.execute_rows([("hello",), (prompt_chat_single_qa("hi"),)])
+        assert all(ok for ok, _v in out)
+        assert all(isinstance(v, str) for _ok, v in out)
+
+
+class TestDocumentStore:
+    def _store(self, **kw):
+        return DocumentStore(
+            docs_table(), embedder=FakeEmbedder(dim=16), index_capacity=32, **kw
+        )
+
+    def test_retrieve_returns_relevant_doc(self):
+        store = self._store()
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(query=str, k=int),
+            [("systolic array tpu", 2)],
+        )
+        res = store.retrieve_query(queries)
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert len(rows) == 1
+        (result,) = rows[0]
+        assert len(result) == 2
+        assert all({"text", "metadata", "dist"} <= set(r) for r in result)
+
+    def test_bm25_store(self):
+        store = DocumentStore(docs_table(), retriever_factory="bm25")
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(query=str, k=int), [("flour yeast bread", 1)]
+        )
+        res = store.retrieve_query(queries)
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert "bread" in rows[0][0][0]["text"]
+
+    def test_statistics_query(self):
+        store = self._store()
+        q = pw.debug.table_from_rows(pw.schema_from_types(dummy=str), [("x",)])
+        res = store.statistics_query(q)
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert rows == [(3,)]
+
+
+class TestRAG:
+    def test_base_rag_answer(self):
+        store = DocumentStore(
+            docs_table(), embedder=FakeEmbedder(dim=16), index_capacity=32
+        )
+        rag = BaseRAGQuestionAnswerer(
+            IdentityMockChat(), store, search_topk=2
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(prompt=str), [("what is a tpu?",)]
+        )
+        res = rag.answer_query(queries)
+        rows = list(GraphRunner().capture(res)[0].values())
+        assert len(rows) == 1
+        answer, ctx = rows[0]
+        assert answer.startswith("mock:")
+        assert "what is a tpu?" in answer
+        assert len(ctx) == 2
+
+    def test_geometric_strategy_expands(self):
+        calls = []
+
+        def llm(prompt):
+            calls.append(prompt)
+            # only answers when it sees >= 3 documents in the prompt
+            if prompt.count("doc-") >= 3:
+                return "the answer"
+            return "No information found."
+
+        docs = [f"doc-{i}" for i in range(8)]
+        out = answer_with_geometric_rag_strategy(
+            "q?", docs, llm, n_starting_documents=1, factor=2, max_iterations=5
+        )
+        assert out == "the answer"
+        assert len(calls) == 3  # 1 doc -> 2 docs -> 4 docs
+
+
+class TestRestServer:
+    def test_document_store_server_roundtrip(self):
+        import json
+        import time
+        import urllib.request
+
+        from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
+
+        store = DocumentStore(
+            docs_table(), embedder=FakeEmbedder(dim=16), index_capacity=32
+        )
+        port = 18754
+        server = DocumentStoreServer("127.0.0.1", port, store)
+        server.run(threaded=True)
+        time.sleep(0.5)
+
+        payload = json.dumps({"query": "tpu systolic", "k": 1}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/retrieve",
+            data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            result = json.loads(resp.read())
+        assert len(result) == 1
+        assert "systolic" in result[0]["text"]
+
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/statistics",
+            data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2, timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["count"] == 3
