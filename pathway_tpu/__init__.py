@@ -50,6 +50,7 @@ from pathway_tpu import debug  # noqa: E402  (imports Table)
 from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
+from pathway_tpu.stdlib import temporal  # noqa: E402
 from pathway_tpu.internals import udfs  # noqa: E402
 from pathway_tpu.internals.udfs import UDF, udf  # noqa: E402
 
@@ -110,6 +111,7 @@ __all__ = [
     "schema_from_dict",
     "schema_from_types",
     "stdlib",
+    "temporal",
     "this",
     "udf",
     "UDF",

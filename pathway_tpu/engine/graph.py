@@ -1009,6 +1009,25 @@ class ErrorLogNode(Node):
         return self.take(0)
 
 
+def emit_local_group_diffs(
+    out: DeltaBatch,
+    old_groups: dict,
+    local_fn: Callable[[Any], dict],
+) -> None:
+    """Shared incremental-recompute tail: for each touched group, diff the
+    snapshot taken before the batch against the recomputed local output and
+    emit retract/insert pairs. Used by the group-local operators (joins,
+    sort, sessions, temporal joins)."""
+    for inst, old_rows in old_groups.items():
+        new_rows = local_fn(inst)
+        for k, r in old_rows.items():
+            if new_rows.get(k) != r:
+                out.append(k, r, -1)
+        for k, r in new_rows.items():
+            if old_rows.get(k) != r:
+                out.append(k, r, 1)
+
+
 class Scope:
     """The engine graph builder + owner of all nodes.
 
@@ -1222,6 +1241,15 @@ class Scheduler:
         for node in scope.nodes:
             node.on_time_end(time)
 
+    def _end_nodes(self) -> None:
+        """Run on_end hooks; they may inject final batches (buffer flush) —
+        propagate those as one more commit."""
+        for node in self.scope.nodes:
+            node.on_end()
+        if any(n.has_pending() for n in self.scope.nodes):
+            self.propagate(self.time)
+            self.time += 1
+
     def run_static(self) -> None:
         """Batch mode: all static sources at time 0, one commit, then end."""
         for node in self.scope.nodes:
@@ -1231,8 +1259,7 @@ class Scheduler:
                     node.push(0, batch)
         self.propagate(0)
         self.time = 1
-        for node in self.scope.nodes:
-            node.on_end()
+        self._end_nodes()
 
     def commit(self) -> int:
         """Streaming mode: flush all input sessions as one commit."""
@@ -1252,5 +1279,4 @@ class Scheduler:
 
     def finish(self) -> None:
         self.commit()
-        for node in self.scope.nodes:
-            node.on_end()
+        self._end_nodes()

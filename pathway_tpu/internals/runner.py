@@ -397,10 +397,119 @@ class GraphRunner:
                 limit_col=limit_col,
             )
 
-        if kind == "buffer":
-            raise NotImplementedError("temporal behaviors arrive with the temporal module")
+        if kind in ("buffer", "forget", "freeze"):
+            from pathway_tpu.engine import temporal as tmp
+
+            base_node = self.build(spec.inputs[0])
+            cls = {
+                "buffer": tmp.BufferNode,
+                "forget": tmp.ForgetNode,
+                "freeze": tmp.FreezeNode,
+            }[kind]
+            return cls(
+                scope,
+                base_node,
+                spec.params["threshold_col"],
+                spec.params["time_col"],
+            )
+
+        if kind == "session_assign":
+            from pathway_tpu.engine.temporal import SessionAssignNode
+
+            return SessionAssignNode(
+                scope,
+                self.build(spec.inputs[0]),
+                spec.params["time_col"],
+                spec.params["instance_col"],
+                spec.params["max_gap"],
+            )
+
+        if kind in ("interval_join", "asof_join", "asof_now_join"):
+            return self._build_temporal_join(table)
 
         raise NotImplementedError(f"unknown table spec kind {kind!r}")
+
+    def _build_temporal_join(self, table: "Table") -> Node:
+        from pathway_tpu.engine import temporal as tmp
+
+        spec = table._spec
+        kind = spec.kind
+        left, right = spec.inputs
+        on = spec.params["on"]
+        how = spec.params["how"]
+        exprs: dict[str, ColumnExpression] = spec.params["exprs"]
+        scope = self.scope
+
+        left_node = self.build(left)
+        right_node = self.build(right)
+        llayout = self.base_layout(left)
+        rlayout = self.base_layout(right)
+        nl = len(left._column_names)
+        nr = len(right._column_names)
+        k = len(on)
+
+        def prep(node, side, layout, n, time_expr):
+            extras: list[eex.EngineExpression] = [eex.KeyRef()]
+            if time_expr is not None:
+                extras.append(self.compile(time_expr, layout))
+            for pair in on:
+                # explicit side index: `base is left` would misfire on
+                # self-joins where left and right are the same table
+                extras.append(self.compile(pair[side], layout))
+            return scope.expression_table(
+                node, [eex.ColumnRef(i) for i in range(n)] + extras
+            )
+
+        has_time = kind in ("interval_join", "asof_join")
+        lt_expr = spec.params.get("left_time")
+        rt_expr = spec.params.get("right_time")
+        left_prep = prep(left_node, 0, llayout, nl, lt_expr if has_time else None)
+        right_prep = prep(right_node, 1, rlayout, nr, rt_expr if has_time else None)
+
+        t_off = 1 if has_time else 0
+        l_inst = list(range(nl + 1 + t_off, nl + 1 + t_off + k))
+        r_inst = list(range(nr + 1 + t_off, nr + 1 + t_off + k))
+
+        if kind == "interval_join":
+            node = tmp.IntervalJoinNode(
+                scope,
+                left_prep,
+                right_prep,
+                left_time_col=nl + 1,
+                right_time_col=nr + 1,
+                lower_bound=spec.params["lower_bound"],
+                upper_bound=spec.params["upper_bound"],
+                left_instance_col=l_inst[0] if k == 1 else None,
+                right_instance_col=r_inst[0] if k == 1 else None,
+                kind=how,
+            )
+        elif kind == "asof_join":
+            node = tmp.AsofJoinNode(
+                scope,
+                left_prep,
+                right_prep,
+                left_time_col=nl + 1,
+                right_time_col=nr + 1,
+                left_instance_col=l_inst[0] if k == 1 else None,
+                right_instance_col=r_inst[0] if k == 1 else None,
+                direction=spec.params["direction"],
+                kind=how,
+            )
+        else:
+            node = tmp.AsofNowJoinNode(
+                scope, left_prep, right_prep, l_inst, r_inst, kind=how
+            )
+        combined = Layout()
+        for i, name in enumerate(left._column_names):
+            combined.columns[(left._id, name)] = i
+        combined.id_columns[left._id] = nl
+        off = nl + 1 + t_off + k
+        for i, name in enumerate(right._column_names):
+            combined.columns[(right._id, name)] = off + i
+        combined.id_columns[right._id] = off + nr
+        return scope.expression_table(
+            node, [self.compile(e, combined) for e in exprs.values()]
+        )
 
     def _build_select_with_udfs(
         self,

@@ -480,6 +480,57 @@ class Table:
             universe=query_table._universe.subset(),
         )
 
+    # -- temporal -----------------------------------------------------------
+
+    def windowby(
+        self,
+        time_expr: Any,
+        *,
+        window: Any,
+        instance: Any = None,
+        behavior: Any = None,
+    ) -> Any:
+        from pathway_tpu.stdlib.temporal import windowby as _windowby
+
+        return _windowby(
+            self, time_expr, window=window, instance=instance, behavior=behavior
+        )
+
+    def interval_join(
+        self,
+        other: "Table",
+        self_time: Any,
+        other_time: Any,
+        interval: Any,
+        *on: Any,
+        how: str = JoinMode.INNER,
+    ) -> Any:
+        from pathway_tpu.stdlib.temporal import interval_join as _ij
+
+        return _ij(self, other, self_time, other_time, interval, *on, how=how)
+
+    def asof_join(
+        self,
+        other: "Table",
+        self_time: Any,
+        other_time: Any,
+        *on: Any,
+        how: str = JoinMode.INNER,
+        direction: str = "backward",
+    ) -> Any:
+        from pathway_tpu.stdlib.temporal import asof_join as _aj
+
+        return _aj(
+            self, other, self_time, other_time, *on, how=how, direction=direction
+        )
+
+    def asof_now_join(
+        self, other: "Table", *on: Any, how: str = JoinMode.INNER
+    ) -> Any:
+        from pathway_tpu.stdlib.temporal import asof_now_join as _anj
+
+        return _anj(self, other, *on, how=how)
+
     # -- re-keying ----------------------------------------------------------
 
     def with_id_from(self, *args: Any, instance: Any = None) -> "Table":

@@ -1,0 +1,424 @@
+"""Temporal stdlib: windows, temporal behaviors, interval/asof joins.
+
+Reference: python/pathway/stdlib/temporal/ — `windowby` with tumbling/
+sliding/session windows (_window.py:593-863), CommonBehavior /
+ExactlyOnceBehavior (temporal_behavior.py:21,79), interval_join
+(_interval_join.py), asof_join (_asof_join.py), asof_now_join
+(_asof_now_join.py). Behaviors lower to the engine's event-time
+buffer/forget operators (engine/temporal.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    apply as pw_apply,
+    make_tuple,
+    wrap_expression,
+)
+from pathway_tpu.internals.table import Table, TableSpec
+from pathway_tpu.internals.desugaring import resolve_this
+
+
+# -- behaviors ---------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CommonBehavior:
+    """delay: emit window results only once its end is `delay` old;
+    cutoff: forget windows whose end passed watermark - cutoff;
+    keep_results: whether forgotten windows keep their final output
+    (reference temporal_behavior.py:21)."""
+
+    delay: Any = None
+    cutoff: Any = None
+    keep_results: bool = True
+
+
+def common_behavior(
+    delay: Any = None, cutoff: Any = None, keep_results: bool = True
+) -> CommonBehavior:
+    return CommonBehavior(delay, cutoff, keep_results)
+
+
+def exactly_once_behavior(shift: Any = None) -> CommonBehavior:
+    """Each window emitted exactly once, then frozen
+    (reference temporal_behavior.py:79)."""
+    shift = shift if shift is not None else 0
+    return CommonBehavior(delay=shift, cutoff=shift, keep_results=True)
+
+
+ExactlyOnceBehavior = exactly_once_behavior
+
+
+# -- windows -----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TumblingWindow:
+    duration: Any
+    origin: Any = 0
+
+    def assign(self, t: Any) -> tuple:
+        start = ((t - self.origin) // self.duration) * self.duration + self.origin
+        return ((start, start + self.duration),)
+
+
+@dataclasses.dataclass(frozen=True)
+class SlidingWindow:
+    hop: Any
+    duration: Any
+    origin: Any = 0
+
+    def assign(self, t: Any) -> tuple:
+        # windows [s, s+duration) with s ≡ origin (mod hop) containing t
+        out = []
+        s = ((t - self.origin - self.duration) // self.hop) * self.hop + self.origin
+        while s <= t:
+            if s <= t < s + self.duration:
+                out.append((s, s + self.duration))
+            s += self.hop
+        return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionWindow:
+    max_gap: Any
+
+
+def tumbling(duration: Any, origin: Any = 0) -> TumblingWindow:
+    return TumblingWindow(duration, origin)
+
+
+def sliding(hop: Any, duration: Any, origin: Any = 0) -> SlidingWindow:
+    return SlidingWindow(hop, duration, origin)
+
+
+def session(max_gap: Any) -> SessionWindow:
+    return SessionWindow(max_gap)
+
+
+class WindowedTable:
+    """`t.windowby(...)`; materialize with `.reduce(**aggregations)`.
+
+    Inside reduce, ``pw.this['_pw_window_start'] / ['_pw_window_end'] /
+    ['_pw_instance']`` reference the window bounds (reference exposes the
+    same columns)."""
+
+    def __init__(
+        self,
+        table: Table,
+        time_expr: ColumnExpression,
+        window: Any,
+        instance: ColumnExpression | None,
+        behavior: CommonBehavior | None,
+    ) -> None:
+        self.table = table
+        self.time_expr = time_expr
+        self.window = window
+        self.instance = instance
+        self.behavior = behavior
+
+    def _assigned(self) -> Table:
+        t = self.table
+        base_cols = {n: t[n] for n in t.column_names()}
+        inst_expr = (
+            self.instance
+            if self.instance is not None
+            else pw_apply(lambda _t: 0, self.time_expr)
+        )
+        if isinstance(self.window, SessionWindow):
+            pre = t.select(
+                **base_cols, _pw_time=self.time_expr, _pw_instance=inst_expr
+            )
+            n = len(pre.column_names())
+            assigned = pre._derived(
+                TableSpec(
+                    "session_assign",
+                    [pre],
+                    {
+                        "time_col": n - 2,
+                        "instance_col": n - 1,
+                        "max_gap": self.window.max_gap,
+                    },
+                ),
+                {
+                    **{c: pre._dtypes[c] for c in pre.column_names()},
+                    "_pw_window_start": dt.ANY,
+                    "_pw_window_end": dt.ANY,
+                },
+            )
+            return assigned
+        window = self.window
+        pre = t.select(
+            **base_cols,
+            _pw_time=self.time_expr,
+            _pw_instance=inst_expr,
+            _pw_windows=pw_apply(lambda tv: window.assign(tv), self.time_expr),
+        )
+        flat = pre.flatten(pre["_pw_windows"])
+        return flat.select(
+            **{n: flat[n] for n in t.column_names()},
+            _pw_time=flat["_pw_time"],
+            _pw_instance=flat["_pw_instance"],
+            _pw_window_start=flat["_pw_windows"].get(0),
+            _pw_window_end=flat["_pw_windows"].get(1),
+        )
+
+    def _behaved(self, assigned: Table) -> Table:
+        if self.behavior is None:
+            return assigned
+        cols = assigned.column_names()
+        time_col = cols.index("_pw_time")
+        out = assigned
+        if self.behavior.delay is not None:
+            delay = self.behavior.delay
+            out = out.select(
+                **{n: out[n] for n in cols},
+                _pw_threshold=pw_apply(
+                    lambda e: e + delay, out["_pw_window_end"]
+                ),
+            )
+            out = out._derived(
+                TableSpec(
+                    "buffer",
+                    [out],
+                    {
+                        "threshold_col": len(cols),
+                        "time_col": time_col,
+                    },
+                ),
+                {n: out._dtypes[n] for n in out.column_names()},
+            )[cols]
+        if self.behavior.cutoff is not None:
+            cutoff = self.behavior.cutoff
+            out = out.select(
+                **{n: out[n] for n in cols},
+                _pw_threshold=pw_apply(
+                    lambda e: e + cutoff, out["_pw_window_end"]
+                ),
+            )
+            kind = "forget" if not self.behavior.keep_results else "freeze"
+            out = out._derived(
+                TableSpec(
+                    kind,
+                    [out],
+                    {
+                        "threshold_col": len(cols),
+                        "time_col": time_col,
+                    },
+                ),
+                {n: out._dtypes[n] for n in out.column_names()},
+            )[cols]
+        return out
+
+    def reduce(self, *args: Any, **kwargs: Any) -> Table:
+        assigned = self._behaved(self._assigned())
+        grouped = assigned.groupby(
+            assigned["_pw_window_start"],
+            assigned["_pw_window_end"],
+            assigned["_pw_instance"],
+        )
+        resolved_kwargs = {}
+        for name, value in kwargs.items():
+            resolved_kwargs[name] = _retarget(value, self.table, assigned)
+        for arg in args:
+            resolved = _retarget(arg, self.table, assigned)
+            resolved_kwargs[resolved.name] = resolved
+        return grouped.reduce(**resolved_kwargs)
+
+
+def _retarget(expression: Any, source: Table, target: Table) -> Any:
+    """Rewrite references from the pre-window table onto the assigned table
+    (same column names survive the window assignment select)."""
+    from pathway_tpu.internals import expression as pex
+    from pathway_tpu.internals.desugaring import substitute
+    from pathway_tpu.internals.expression import ColumnReference
+
+    expression = resolve_this(expression, target)
+
+    def replace(e: Any) -> Any:
+        if isinstance(e, ColumnReference) and e.table is source:
+            return ColumnReference(target, e.name)
+        return None
+
+    return substitute(wrap_expression(expression), replace)
+
+
+def windowby(
+    table: Table,
+    time_expr: Any,
+    *,
+    window: Any,
+    instance: Any = None,
+    behavior: CommonBehavior | None = None,
+) -> WindowedTable:
+    time_resolved = resolve_this(time_expr, table)
+    inst_resolved = resolve_this(instance, table) if instance is not None else None
+    return WindowedTable(table, time_resolved, window, inst_resolved, behavior)
+
+
+# -- temporal joins ----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    lower_bound: Any
+    upper_bound: Any
+
+
+def interval(lower_bound: Any, upper_bound: Any) -> Interval:
+    return Interval(lower_bound, upper_bound)
+
+
+class _TemporalJoinResult:
+    def __init__(
+        self,
+        kind: str,
+        left: Table,
+        right: Table,
+        params: dict,
+        on: Sequence[Any],
+        how: str,
+    ) -> None:
+        self._kind = kind
+        self._left = left
+        self._right = right
+        self._params = params
+        self._how = how
+        from pathway_tpu.internals.desugaring import resolve_join_sides
+        from pathway_tpu.internals.expression import BinaryOpExpression
+
+        if left is right:
+            raise ValueError(
+                "temporal self-joins need distinct table objects; derive a "
+                "copy first (e.g. right = left.select(*left))"
+            )
+        self._on = []
+        for cond in on:
+            resolved = resolve_join_sides(cond, left, right)
+            if not (
+                isinstance(resolved, BinaryOpExpression) and resolved._op == "=="
+            ):
+                raise ValueError("temporal join conditions must be equalities")
+            self._on.append((resolved._left, resolved._right))
+        if kind in ("interval_join", "asof_join"):
+            if len(self._on) > 1:
+                raise NotImplementedError(
+                    "interval/asof joins support at most one equality condition"
+                )
+            direction = params.get("direction")
+            if direction is not None and direction not in (
+                "backward",
+                "forward",
+                "nearest",
+            ):
+                raise ValueError(
+                    f"asof direction must be backward/forward/nearest, "
+                    f"got {direction!r}"
+                )
+
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        from pathway_tpu.internals.desugaring import resolve_join_sides
+        from pathway_tpu.internals.expression import ColumnReference
+
+        exprs: dict[str, ColumnExpression] = {}
+        for arg in args:
+            resolved = resolve_join_sides(arg, self._left, self._right)
+            if not isinstance(resolved, ColumnReference):
+                raise ValueError("positional args must be column references")
+            exprs[resolved.name] = resolved
+        for name, value in kwargs.items():
+            exprs[name] = resolve_join_sides(value, self._left, self._right)
+        dtypes = {n: e._dtype for n, e in exprs.items()}
+        return Table(
+            TableSpec(
+                self._kind,
+                [self._left, self._right],
+                {
+                    **self._params,
+                    "on": self._on,
+                    "how": self._how,
+                    "exprs": exprs,
+                },
+            ),
+            list(exprs.keys()),
+            dtypes,
+        )
+
+
+def interval_join(
+    left: Table,
+    right: Table,
+    left_time: Any,
+    right_time: Any,
+    interval: Interval,
+    *on: Any,
+    how: str = "inner",
+) -> _TemporalJoinResult:
+    return _TemporalJoinResult(
+        "interval_join",
+        left,
+        right,
+        {
+            "left_time": resolve_this(left_time, left),
+            "right_time": resolve_this(right_time, right),
+            "lower_bound": interval.lower_bound,
+            "upper_bound": interval.upper_bound,
+        },
+        on,
+        how,
+    )
+
+
+def interval_join_left(left, right, lt, rt, iv, *on):
+    return interval_join(left, right, lt, rt, iv, *on, how="left")
+
+
+def interval_join_right(left, right, lt, rt, iv, *on):
+    return interval_join(left, right, lt, rt, iv, *on, how="right")
+
+
+def interval_join_outer(left, right, lt, rt, iv, *on):
+    return interval_join(left, right, lt, rt, iv, *on, how="outer")
+
+
+def asof_join(
+    left: Table,
+    right: Table,
+    left_time: Any,
+    right_time: Any,
+    *on: Any,
+    how: str = "inner",
+    direction: str = "backward",
+) -> _TemporalJoinResult:
+    return _TemporalJoinResult(
+        "asof_join",
+        left,
+        right,
+        {
+            "left_time": resolve_this(left_time, left),
+            "right_time": resolve_this(right_time, right),
+            "direction": direction,
+        },
+        on,
+        how,
+    )
+
+
+def asof_join_left(left, right, lt, rt, *on, direction="backward"):
+    return asof_join(left, right, lt, rt, *on, how="left", direction=direction)
+
+
+def asof_now_join(
+    left: Table, right: Table, *on: Any, how: str = "inner"
+) -> _TemporalJoinResult:
+    return _TemporalJoinResult("asof_now_join", left, right, {}, on, how)
+
+
+def asof_now_join_left(left, right, *on):
+    return asof_now_join(left, right, *on, how="left")

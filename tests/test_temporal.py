@@ -1,0 +1,244 @@
+import pathway_tpu as pw
+from pathway_tpu.engine.graph import Scheduler, Scope
+from pathway_tpu.engine.temporal import BufferNode, ForgetNode, FreezeNode
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals.runner import GraphRunner
+from pathway_tpu.stdlib import temporal as tmp
+
+
+def rows_of(table):
+    return sorted(GraphRunner().capture(table)[0].values())
+
+
+def events(rows):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(t=int, k=str, v=int), rows
+    )
+
+
+class TestWindows:
+    def test_tumbling_window_counts(self):
+        t = events(
+            [(0, "a", 1), (3, "a", 2), (5, "a", 3), (11, "a", 4), (13, "b", 5)]
+        )
+        win = t.windowby(t.t, window=tmp.tumbling(duration=10), instance=t.k)
+        res = win.reduce(
+            instance=pw.this["_pw_instance"],
+            start=pw.this["_pw_window_start"],
+            cnt=pw.reducers.count(),
+            total=pw.reducers.sum(pw.this.v),
+        )
+        assert rows_of(res) == [("a", 0, 3, 6), ("a", 10, 1, 4), ("b", 10, 1, 5)]
+
+    def test_sliding_window_membership(self):
+        t = events([(4, "a", 1)])
+        win = t.windowby(t.t, window=tmp.sliding(hop=2, duration=6))
+        res = win.reduce(
+            start=pw.this["_pw_window_start"], cnt=pw.reducers.count()
+        )
+        # t=4 belongs to windows starting at 0, 2, 4
+        assert rows_of(res) == [(0, 1), (2, 1), (4, 1)]
+
+    def test_session_window(self):
+        t = events(
+            [(1, "a", 1), (2, "a", 2), (10, "a", 3), (11, "a", 4), (2, "b", 5)]
+        )
+        win = t.windowby(
+            t.t, window=tmp.session(max_gap=3), instance=t.k
+        )
+        res = win.reduce(
+            inst=pw.this["_pw_instance"],
+            start=pw.this["_pw_window_start"],
+            end=pw.this["_pw_window_end"],
+            cnt=pw.reducers.count(),
+        )
+        assert rows_of(res) == [
+            ("a", 1, 2, 2),
+            ("a", 10, 11, 2),
+            ("b", 2, 2, 1),
+        ]
+
+
+class TestBehaviorNodes:
+    def _scope(self, cls, **kw):
+        scope = Scope()
+        sess = scope.input_session(arity=3)  # (value, threshold, time)
+        node = cls(scope, sess, threshold_col=1, time_col=2, **kw)
+        return scope, sess, node, Scheduler(scope)
+
+    def test_buffer_postpones_until_watermark(self):
+        scope, sess, node, sched = self._scope(BufferNode)
+        k1, k2 = ref_scalar(1), ref_scalar(2)
+        sess.insert(k1, ("early", 5, 0))  # release at watermark >= 5
+        sched.commit()
+        assert k1 not in node.current
+        sess.insert(k2, ("later", 5, 7))  # watermark jumps to 7
+        sched.commit()
+        assert k1 in node.current and k2 in node.current
+
+    def test_buffer_flushes_on_end(self):
+        scope, sess, node, sched = self._scope(BufferNode)
+        k1 = ref_scalar(1)
+        sess.insert(k1, ("pending", 100, 0))
+        sched.commit()
+        assert k1 not in node.current
+        sched.finish()
+        assert k1 in node.current
+
+    def test_forget_retracts_expired(self):
+        scope, sess, node, sched = self._scope(ForgetNode)
+        k1, k2 = ref_scalar(1), ref_scalar(2)
+        sess.insert(k1, ("a", 5, 1))
+        sched.commit()
+        assert k1 in node.current
+        sess.insert(k2, ("b", 20, 10))  # watermark 10 > 5: k1 forgotten
+        sched.commit()
+        assert k1 not in node.current and k2 in node.current
+        # late arrival below watermark is dropped
+        k3 = ref_scalar(3)
+        sess.insert(k3, ("late", 7, 6))
+        sched.commit()
+        assert k3 not in node.current
+
+    def test_freeze_drops_late_updates_keeps_results(self):
+        scope, sess, node, sched = self._scope(FreezeNode)
+        k1, k2 = ref_scalar(1), ref_scalar(2)
+        sess.insert(k1, ("a", 5, 1))
+        sched.commit()
+        sess.insert(k2, ("b", 20, 10))
+        sched.commit()
+        assert k1 in node.current  # frozen but kept
+        sess.remove(k1, ("a", 5, 1))  # deletion of frozen row ignored
+        sched.commit()
+        assert k1 in node.current
+
+
+class TestTemporalJoins:
+    def test_interval_join(self):
+        left = pw.debug.table_from_rows(
+            pw.schema_from_types(lt=int, inst=str), [(10, "x"), (20, "x")]
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(rt=int, inst=str, val=int),
+            [(8, "x", 1), (11, "x", 2), (19, "x", 3), (11, "y", 4)],
+        )
+        res = left.interval_join(
+            right,
+            left.lt,
+            right.rt,
+            tmp.interval(-2, 1),
+            left.inst == right.inst,
+        ).select(lt=left.lt, rt=right.rt, val=right.val)
+        assert rows_of(res) == [(10, 8, 1), (10, 11, 2), (20, 19, 3)]
+
+    def test_interval_join_incremental_retraction(self):
+        # streaming: removing a right row retracts its matches
+        from pathway_tpu.engine.temporal import IntervalJoinNode
+
+        scope = Scope()
+        l_in = scope.input_session(arity=2)  # (key passthrough, time)
+        r_in = scope.input_session(arity=2)
+        node = IntervalJoinNode(
+            scope, l_in, r_in, left_time_col=1, right_time_col=1,
+            lower_bound=-2, upper_bound=2,
+        )
+        sched = Scheduler(scope)
+        lk, rk = ref_scalar("l"), ref_scalar("r")
+        l_in.insert(lk, ("L", 10))
+        r_in.insert(rk, ("R", 11))
+        sched.commit()
+        assert len(node.current) == 1
+        r_in.remove(rk, ("R", 11))
+        sched.commit()
+        assert len(node.current) == 0
+
+    def test_asof_join_backward(self):
+        trades = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, sym=str), [(10, "A"), (20, "A")]
+        )
+        quotes = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, sym=str, px=float),
+            [(8, "A", 1.0), (15, "A", 2.0), (25, "A", 3.0)],
+        )
+        res = trades.asof_join(
+            quotes, trades.t, quotes.t, trades.sym == quotes.sym
+        ).select(t=trades.t, px=quotes.px)
+        assert rows_of(res) == [(10, 1.0), (20, 2.0)]
+
+    def test_asof_now_join_sticky(self):
+        from pathway_tpu.engine.temporal import AsofNowJoinNode
+
+        scope = Scope()
+        l_in = scope.input_session(arity=2)  # (name, key)
+        r_in = scope.input_session(arity=2)
+        node = AsofNowJoinNode(scope, l_in, r_in, [1], [1])
+        sched = Scheduler(scope)
+        r_in.insert(ref_scalar("r1"), ("old", "k"))
+        sched.commit()
+        lk = ref_scalar("l1")
+        l_in.insert(lk, ("q", "k"))
+        sched.commit()
+        assert len(node.current) == 1
+        first = list(node.current.values())[0]
+        assert first[2] == "old"
+        # right side changes: existing answer must NOT change
+        r_in.insert(ref_scalar("r2"), ("new", "k"))
+        sched.commit()
+        assert list(node.current.values()) == [first]
+        # left deletion retracts
+        l_in.remove(lk, ("q", "k"))
+        sched.commit()
+        assert len(node.current) == 0
+
+
+class TestWindowBehavior:
+    def test_exactly_once_tumbling_stream(self):
+        # streaming commits with increasing time; delayed emission
+        import pathway_tpu.engine.temporal  # noqa: F401
+
+        scope = Scope()
+        runner = GraphRunner(scope)
+        rows = [(1, "a"), (2, "a"), (12, "a"), (25, "a")]
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, k=str), rows
+        )
+        win = t.windowby(
+            t.t,
+            window=tmp.tumbling(duration=10),
+            behavior=tmp.common_behavior(delay=0, cutoff=0),
+        )
+        res = win.reduce(
+            start=pw.this["_pw_window_start"], cnt=pw.reducers.count()
+        )
+        out = sorted(runner.capture(res)[0].values())
+        # window [0,10) closed by t=12; [10,20) closed by 25; [20,30)
+        # flushed by the end-of-stream buffer flush (also in batch mode)
+        assert out == [(0, 2), (10, 1), (20, 1)]
+
+    def test_asof_join_outer_pads_unmatched_right(self):
+        trades = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, sym=str), [(10, "A")]
+        )
+        quotes = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, sym=str, px=float),
+            [(8, "A", 1.0), (25, "B", 3.0)],
+        )
+        res = trades.asof_join(
+            quotes,
+            trades.t,
+            quotes.t,
+            trades.sym == quotes.sym,
+            how=pw.JoinMode.OUTER,
+        ).select(t=trades.t, px=quotes.px)
+        rows = sorted(
+            GraphRunner().capture(res)[0].values(), key=repr
+        )
+        assert rows == [(10, 1.0), (None, 3.0)]
+
+    def test_asof_bad_direction_rejected(self):
+        import pytest
+
+        t = events([(1, "a", 1)])
+        u = events([(1, "a", 1)])
+        with pytest.raises(ValueError):
+            t.asof_join(u, t.t, u.t, direction="backwards")
