@@ -49,6 +49,7 @@ from pathway_tpu.internals import universe as _universe_mod
 from pathway_tpu import debug  # noqa: E402  (imports Table)
 from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import io  # noqa: E402
+from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
 from pathway_tpu.stdlib import temporal  # noqa: E402
 from pathway_tpu.internals import udfs  # noqa: E402
@@ -102,6 +103,7 @@ __all__ = [
     "if_else",
     "left",
     "make_tuple",
+    "persistence",
     "reducers",
     "require",
     "right",

@@ -195,6 +195,14 @@ class FsReader(Reader):
             self._done_static = True
             entries = []
             for path in self._list_files():
+                try:
+                    stat = os.stat(path)
+                except FileNotFoundError:
+                    continue
+                sig = (stat.st_mtime, stat.st_size)
+                if self._seen.get(path) == sig:
+                    continue  # consumed before a resume; journal replays it
+                self._seen[path] = sig
                 entries.append(
                     (self._read_file(path), path, {"path": path, "deleted": False})
                 )
@@ -217,6 +225,17 @@ class FsReader(Reader):
             entries.append((None, path, {"path": path, "deleted": True}))
         self._seen = current
         return entries, False
+
+    # -- persistence (engine/persistence.py PersistentDriver) ---------------
+
+    def state(self) -> dict:
+        return {"seen": dict(self._seen), "done_static": self._done_static}
+
+    def restore_state(self, state: dict) -> None:
+        self._seen = dict(state.get("seen", {}))
+        # a resumed static read re-scans once: already-consumed files are
+        # skipped via _seen, files that appeared/changed while down are read
+        self._done_static = False
 
 
 class QueueReader(Reader):
@@ -283,7 +302,10 @@ class InputDriver:
         entries, done = self.reader.poll()
         produced = False
         replaces = self.reader.replaces_sources
+        notify_source = getattr(self.session, "on_source", None)
         for payload, source_id, metadata in entries:
+            if notify_source is not None:
+                notify_source(source_id)
             # retract previously-emitted rows of a replaced/deleted source
             old_rows = self._per_source_rows.pop(source_id, None) if replaces else None
             if old_rows:

@@ -1,0 +1,211 @@
+"""Engine persistence: backends, input-event journals, resumable drivers.
+
+Reference: src/persistence/ — PersistenceBackend trait (backends/mod.rs:50),
+InputSnapshotWriter/Reader event journal (input_snapshot.rs), metadata
+threshold protocol (state.rs), connector rewind (connectors/mod.rs:223-341).
+
+The journal for a persistent source is a sequence of pickled *segments*, one
+per commit: ``{"events": [(kind, key, row), ...], "reader": state,
+"driver": state}``. A crash mid-write leaves a truncated tail segment that
+replay detects and discards — so restarts resume from the last complete
+commit (the reference's "last finalized time" threshold, state.rs:129-150).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+from typing import Any, Iterator
+
+from pathway_tpu.engine.graph import InputSession
+
+
+class PersistenceBackend:
+    """Append/overwrite/read named binary streams
+    (reference backends/mod.rs:50)."""
+
+    def append(self, name: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def write(self, name: str, payload: bytes) -> None:
+        """Atomic overwrite."""
+        raise NotImplementedError
+
+    def read(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+
+class FileBackend(PersistenceBackend):
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        # collision-free escaping: distinct names never share a file
+        from urllib.parse import quote
+
+        return os.path.join(self.root, quote(name, safe=""))
+
+    def append(self, name: str, payload: bytes) -> None:
+        with open(self._path(name), "ab") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def write(self, name: str, payload: bytes) -> None:
+        path = self._path(name)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read(self, name: str) -> bytes:
+        try:
+            with open(self._path(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return b""
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+
+class MemoryBackend(PersistenceBackend):
+    def __init__(self) -> None:
+        self._data: dict[str, bytes] = {}
+
+    def append(self, name: str, payload: bytes) -> None:
+        self._data[name] = self._data.get(name, b"") + payload
+
+    def write(self, name: str, payload: bytes) -> None:
+        self._data[name] = payload
+
+    def read(self, name: str) -> bytes:
+        return self._data.get(name, b"")
+
+    def exists(self, name: str) -> bool:
+        return name in self._data
+
+
+def _segments(raw: bytes) -> Iterator[dict]:
+    """Yield complete pickled segments; stop silently at a truncated tail."""
+    buf = io.BytesIO(raw)
+    while True:
+        try:
+            yield pickle.load(buf)
+        except EOFError:
+            return
+        except (pickle.UnpicklingError, AttributeError, ValueError):
+            return  # truncated/corrupt tail from a crash mid-append
+
+
+class RecordingSession:
+    """Proxy in front of an InputSession journaling
+    (kind, key, row, source_id) — the source attribution lets replay rebuild
+    the driver's per-source row map without persisting it per commit."""
+
+    def __init__(self, session: InputSession) -> None:
+        self._session = session
+        self.buffer: list[tuple[str, Any, Any, str | None]] = []
+        self._source: str | None = None
+
+    def on_source(self, source_id: str) -> None:
+        self._source = source_id
+
+    def insert(self, key: Any, row: tuple) -> None:
+        self.buffer.append(("insert", key, row, self._source))
+        self._session.insert(key, row)
+
+    def remove(self, key: Any, row: tuple | None = None) -> None:
+        self.buffer.append(("remove", key, row, self._source))
+        self._session.remove(key, row)
+
+    def take(self) -> list[tuple[str, Any, Any, str | None]]:
+        out = self.buffer
+        self.buffer = []
+        return out
+
+
+class PersistentDriver:
+    """Wraps an InputDriver with journaling + replay-on-startup.
+
+    ``replay()`` must run before the first poll: it pushes the journaled
+    events of every complete commit back into the session and restores the
+    reader's and driver's positional state so re-reads don't double-emit.
+    """
+
+    def __init__(
+        self, driver: Any, backend: PersistenceBackend, persistent_id: str
+    ) -> None:
+        self.driver = driver
+        self.backend = backend
+        self.name = f"journal-{persistent_id}"
+        self.recorder = RecordingSession(driver.session)
+        driver.session = self.recorder
+        self.replayed = False
+
+    # -- replay --------------------------------------------------------------
+
+    def replay(self) -> None:
+        self.replayed = True
+        raw = self.backend.read(self.name)
+        reader_state = None
+        seq = None
+        per_source: dict[str, list] = {}
+        for segment in _segments(raw):
+            for kind, key, row, source_id in segment["events"]:
+                # replay bypasses the recorder: replayed events are already
+                # journaled
+                if kind == "insert":
+                    self.recorder._session.insert(key, row)
+                    if source_id is not None:
+                        per_source.setdefault(source_id, []).append((key, row))
+                else:
+                    self.recorder._session.remove(key, row)
+                    if source_id is not None and source_id in per_source:
+                        per_source[source_id] = [
+                            (k, r)
+                            for k, r in per_source[source_id]
+                            if k != key
+                        ]
+            reader_state = segment.get("reader", reader_state)
+            seq = segment.get("seq", seq)
+        if reader_state is not None and hasattr(self.driver.reader, "restore_state"):
+            self.driver.reader.restore_state(reader_state)
+        if seq is not None:
+            self.driver._seq = seq
+        if self.driver.reader.replaces_sources:
+            self.driver._per_source_rows = {
+                s: rows for s, rows in per_source.items() if rows
+            }
+
+    # -- driver protocol -----------------------------------------------------
+
+    def poll(self) -> str:
+        assert self.replayed, "PersistentDriver.replay() must run before poll"
+        return self.driver.poll()
+
+    def on_commit(self, time: int) -> None:
+        events = self.recorder.take()
+        if not events:
+            # no events => reader/driver state unchanged; nothing to persist
+            return
+        # one atomic segment per commit with data: events + positional state
+        # (reader state is O(sources), events are the deltas — the journal
+        # grows with data volume, not with commit count)
+        segment = {
+            "events": events,
+            "reader": (
+                self.driver.reader.state()
+                if hasattr(self.driver.reader, "state")
+                else None
+            ),
+            "seq": self.driver._seq,
+        }
+        self.backend.append(self.name, pickle.dumps(segment, protocol=4))

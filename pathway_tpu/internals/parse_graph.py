@@ -45,12 +45,13 @@ def run(
     monitoring_level: Any = None,
     with_http_server: bool = False,
     debug: bool = False,
+    persistence_config: Any = None,
     **kwargs: Any,
 ) -> None:
     """Execute the captured graph (reference: pw.run, internals/run.py:12)."""
     from pathway_tpu.internals.runner import GraphRunner
 
-    runner = GraphRunner()
+    runner = GraphRunner(persistence_config=persistence_config)
     for sink in G.sinks:
         node = runner.build(sink.table)
         driver = sink.attach(runner.scope, node)

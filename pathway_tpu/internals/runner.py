@@ -51,11 +51,29 @@ from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 
 
 class GraphRunner:
-    def __init__(self, scope: Scope | None = None) -> None:
+    def __init__(
+        self, scope: Scope | None = None, persistence_config: Any = None
+    ) -> None:
         self.scope = scope if scope is not None else Scope()
         self.nodes: dict[int, Node] = {}
         self.drivers: list[Any] = []  # connector drivers (streaming mode)
         self.monitors: list[Any] = []
+        self.persistence = persistence_config
+        if persistence_config is not None:
+            self._wire_udf_cache(persistence_config)
+
+    @staticmethod
+    def _wire_udf_cache(config: Any) -> None:
+        """Route default DiskCaches at the persistence backend (reference:
+        PersistenceMode::UdfCaching, servers.py:62-81 with_cache)."""
+        import os as _os
+
+        from pathway_tpu.engine.persistence import FileBackend
+        from pathway_tpu.internals.udfs.caches import set_udf_cache_root
+
+        backend = getattr(config, "backend", None)
+        if isinstance(backend, FileBackend):
+            set_udf_cache_root(_os.path.join(backend.root, "udf-cache"))
 
     # -- expression compilation --------------------------------------------
 
@@ -209,6 +227,18 @@ class GraphRunner:
             attach = spec.params["attach"]
             node, driver = attach(scope)
             if driver is not None:
+                persistent_id = spec.params.get("persistent_id")
+                if persistent_id is not None and self.persistence is not None:
+                    from pathway_tpu.engine.persistence import PersistentDriver
+                    from pathway_tpu.persistence import PersistenceMode
+
+                    if (
+                        self.persistence.persistence_mode
+                        == PersistenceMode.PERSISTING
+                    ):
+                        driver = PersistentDriver(
+                            driver, self.persistence.backend, persistent_id
+                        )
                 self.drivers.append(driver)
             return node
 
@@ -710,6 +740,13 @@ class GraphRunner:
         if not self.drivers:
             return self.run_static()
         sched = Scheduler(self.scope)
+        persistent = [d for d in self.drivers if hasattr(d, "replay")]
+        for driver in persistent:
+            driver.replay()
+        if persistent:
+            # flush replayed events as the first commit so downstream state
+            # is rebuilt even if no new input arrives
+            sched.commit()
         for node in self.scope.nodes:
             if isinstance(node, StaticSource):
                 batch = node.initial_batch()
@@ -729,12 +766,16 @@ class GraphRunner:
                 elif status == "data":
                     produced = True
             if produced:
-                sched.commit()
+                time = sched.commit()
+                for driver in persistent:
+                    driver.on_commit(time)
                 idle_spins = 0
             else:
                 idle_spins += 1
                 _time.sleep(min(0.001 * idle_spins, 0.05))
         sched.finish()
+        for driver in persistent:
+            driver.on_commit(sched.time)
         return sched
 
     def capture(self, *tables: "Table") -> list[dict[Pointer, tuple]]:

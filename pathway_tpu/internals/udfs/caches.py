@@ -65,18 +65,41 @@ class InMemoryCache(CacheStrategy):
         self._data[key] = value
 
 
+_udf_cache_root: str | None = None
+
+
+def set_udf_cache_root(path: str | None) -> None:
+    """Wire persistence-config UDF caching (PersistenceMode.UDF_CACHING):
+    DiskCaches constructed without an explicit directory resolve here."""
+    global _udf_cache_root
+    _udf_cache_root = path
+
+
 class DiskCache(CacheStrategy):
-    """Pickle-per-key directory cache; ``directory`` defaults to the env
-    hook used by persistence-backed UDF caching."""
+    """Pickle-per-key directory cache. The directory resolves lazily at
+    first use: explicit ``directory`` > persistence-config root
+    (set_udf_cache_root) > PATHWAY_TPU_UDF_CACHE env > ./.pathway/udf-cache
+    — so a cache declared at UDF-definition time honors a persistence
+    config passed later to pw.run."""
 
     def __init__(self, directory: str | None = None) -> None:
-        self._dir = directory or os.environ.get(
-            "PATHWAY_TPU_UDF_CACHE", os.path.join(".pathway", "udf-cache")
+        self._explicit = directory
+        self._resolved: str | None = None
+
+    def _base(self) -> str:
+        resolved = (
+            self._explicit
+            or _udf_cache_root
+            or os.environ.get("PATHWAY_TPU_UDF_CACHE")
+            or os.path.join(".pathway", "udf-cache")
         )
-        os.makedirs(self._dir, exist_ok=True)
+        if resolved != self._resolved:
+            os.makedirs(resolved, exist_ok=True)
+            self._resolved = resolved
+        return resolved
 
     def _path(self, key: str) -> str:
-        return os.path.join(self._dir, key[:2], key)
+        return os.path.join(self._base(), key[:2], key)
 
     def get(self, key: str) -> Any:
         path = self._path(key)

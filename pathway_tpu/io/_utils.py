@@ -44,6 +44,7 @@ def input_table(
     *,
     source_name: str = "input",
     with_metadata: bool = False,
+    persistent_id: str | None = None,
 ) -> Table:
     """Create a connector-backed table (spec kind "input")."""
     column_names = schema.column_names()
@@ -67,7 +68,7 @@ def input_table(
         return session, driver
 
     return Table(
-        TableSpec("input", [], {"attach": attach}),
+        TableSpec("input", [], {"attach": attach, "persistent_id": persistent_id}),
         all_names,
         dtypes,
         name=source_name,

@@ -32,6 +32,7 @@ def read(
     with_metadata: bool = False,
     csv_settings: Any = None,
     autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     if format in ("plaintext", "plaintext_by_file", "binary"):
@@ -71,6 +72,7 @@ def read(
         make_parser,
         source_name=f"fs:{path}",
         with_metadata=with_metadata,
+        persistent_id=persistent_id,
     )
 
 

@@ -90,6 +90,7 @@ def read(
     *,
     schema: schema_mod.SchemaMetaclass,
     autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     dtypes = schema.dtypes()
@@ -107,5 +108,9 @@ def read(
         return _SubjectParser(names, dtypes)
 
     return input_table(
-        schema, make_reader, make_parser, source_name="python-connector"
+        schema,
+        make_reader,
+        make_parser,
+        source_name="python-connector",
+        persistent_id=persistent_id,
     )

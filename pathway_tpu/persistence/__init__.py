@@ -1,0 +1,58 @@
+"""Persistence API: pw.persistence.Backend / Config.
+
+Reference: python/pathway/persistence/__init__.py (Backend.filesystem/mock
+:13, Config :88) over the Rust persistence subsystem (src/persistence/ —
+metadata store, input snapshots, rewind on startup; SURVEY.md §5.4).
+
+Model: every persistent input source journals its (key, row, diff) events
+with commit markers plus its reader/driver state. On restart the journal is
+replayed into the input session up to the last complete commit, the reader
+seeks past consumed input, and processing continues — at-least-once
+end-to-end, exactly-once for the replayed prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any
+
+from pathway_tpu.engine.persistence import (
+    FileBackend,
+    MemoryBackend,
+    PersistenceBackend,
+)
+
+
+class PersistenceMode(enum.Enum):
+    PERSISTING = "persisting"  # input-event journal replay (default)
+    UDF_CACHING = "udf_caching"  # only wire the UDF disk cache
+    OPERATOR_PERSISTING = "operator_persisting"  # reserved (operator snapshots)
+
+
+class Backend:
+    """Factory namespace (reference: persistence/__init__.py:13)."""
+
+    @staticmethod
+    def filesystem(path: Any) -> PersistenceBackend:
+        return FileBackend(str(path))
+
+    @staticmethod
+    def mock(events: Any = None) -> PersistenceBackend:
+        return MemoryBackend()
+
+
+@dataclasses.dataclass
+class Config:
+    backend: PersistenceBackend
+    snapshot_interval_ms: int = 0
+    persistence_mode: PersistenceMode = PersistenceMode.PERSISTING
+    continue_after_replay: bool = True
+
+    @staticmethod
+    def simple_config(
+        backend: PersistenceBackend,
+        snapshot_interval_ms: int = 0,
+        persistence_mode: PersistenceMode = PersistenceMode.PERSISTING,
+    ) -> "Config":
+        return Config(backend, snapshot_interval_ms, persistence_mode)

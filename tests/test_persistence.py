@@ -1,0 +1,202 @@
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner
+from pathway_tpu.persistence import Backend, Config
+
+
+def _write(dirpath, name, lines):
+    p = pathlib.Path(dirpath) / name
+    p.write_text("\n".join(lines) + "\n")
+
+
+def _build(data_dir, pstore):
+    words = pw.io.plaintext.read(
+        data_dir, mode="streaming", persistent_id="words"
+    )
+    counts = words.groupby(words.data).reduce(
+        word=words.data, cnt=pw.reducers.count()
+    )
+    runner = GraphRunner(
+        persistence_config=Config(Backend.filesystem(pstore))
+    )
+    node = runner.build(counts)
+    return runner, node
+
+
+def _drive(runner, iterations):
+    """Mimic GraphRunner.run for a bounded number of poll+commit rounds."""
+    from pathway_tpu.engine.graph import Scheduler
+
+    sched = Scheduler(runner.scope)
+    persistent = [d for d in runner.drivers if hasattr(d, "replay")]
+    for d in persistent:
+        d.replay()
+    if persistent:
+        sched.commit()
+    for _ in range(iterations):
+        produced = False
+        for d in runner.drivers:
+            if d.poll() == "data":
+                produced = True
+        if produced:
+            t = sched.commit()
+            for d in persistent:
+                d.on_commit(t)
+        else:
+            time.sleep(0.01)
+    return sched
+
+
+class TestKillAndResume:
+    def test_resume_no_double_counting(self, tmp_path):
+        data = tmp_path / "data"
+        store = tmp_path / "pstore"
+        data.mkdir()
+        _write(data, "a.txt", ["apple", "banana", "apple"])
+
+        # run 1: process first file, then "crash" (no clean finish)
+        runner1, node1 = _build(str(data), str(store))
+        _drive(runner1, 3)
+        state1 = {row[0]: row[1] for row in node1.current.values()}
+        assert state1 == {"apple": 2, "banana": 1}
+        del runner1  # crash: nothing flushed beyond the journaled commits
+
+        # more data arrives while "down"
+        _write(data, "b.txt", ["banana", "cherry"])
+
+        # run 2: fresh graph + runner over the same store
+        runner2, node2 = _build(str(data), str(store))
+        _drive(runner2, 3)
+        state2 = {row[0]: row[1] for row in node2.current.values()}
+        assert state2 == {"apple": 2, "banana": 2, "cherry": 1}
+
+    def test_resume_handles_file_update(self, tmp_path):
+        data = tmp_path / "data"
+        store = tmp_path / "pstore"
+        data.mkdir()
+        _write(data, "a.txt", ["x", "y"])
+        runner1, node1 = _build(str(data), str(store))
+        _drive(runner1, 3)
+        del runner1
+
+        # file replaced while down: old rows must be retracted on resume
+        _write(data, "a.txt", ["x"])
+        runner2, node2 = _build(str(data), str(store))
+        _drive(runner2, 3)
+        state = {row[0]: row[1] for row in node2.current.values()}
+        assert state == {"x": 1}
+
+    def test_journal_tail_corruption_ignored(self, tmp_path):
+        data = tmp_path / "data"
+        store = tmp_path / "pstore"
+        data.mkdir()
+        _write(data, "a.txt", ["p", "q"])
+        runner1, node1 = _build(str(data), str(store))
+        _drive(runner1, 3)
+        del runner1
+        # simulate crash mid-append: garbage at the journal tail
+        (journal,) = [p for p in store.iterdir() if "journal" in p.name]
+        with open(journal, "ab") as f:
+            f.write(b"\x80\x04GARBAGE-TRUNCATED")
+        runner2, node2 = _build(str(data), str(store))
+        _drive(runner2, 2)
+        state = {row[0]: row[1] for row in node2.current.values()}
+        assert state == {"p": 1, "q": 1}
+
+
+class TestStaticResume:
+    def test_new_file_while_down_static_mode(self, tmp_path):
+        data = tmp_path / "data"
+        store = tmp_path / "pstore"
+        data.mkdir()
+        _write(data, "a.txt", ["alpha", "beta", "alpha"])
+
+        def build():
+            words = pw.io.plaintext.read(
+                str(data), mode="static", persistent_id="w"
+            )
+            counts = words.groupby(words.data).reduce(
+                word=words.data, cnt=pw.reducers.count()
+            )
+            runner = GraphRunner(
+                persistence_config=Config(Backend.filesystem(str(store)))
+            )
+            return runner, runner.build(counts)
+
+        runner1, node1 = build()
+        runner1.run()
+        assert {r[0]: r[1] for r in node1.current.values()} == {
+            "alpha": 2,
+            "beta": 1,
+        }
+        _write(data, "b.txt", ["beta", "gamma"])
+        runner2, node2 = build()
+        runner2.run()
+        assert {r[0]: r[1] for r in node2.current.values()} == {
+            "alpha": 2,
+            "beta": 2,
+            "gamma": 1,
+        }
+
+
+_WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config
+
+data_dir, store, out, crash_after = sys.argv[1:5]
+words = pw.io.plaintext.read(data_dir, mode="static", persistent_id="w")
+counts = words.groupby(words.data).reduce(word=words.data, cnt=pw.reducers.count())
+pw.io.jsonlines.write(counts, out)
+
+if int(crash_after):
+    # kill the process the moment the output file appears
+    import threading, time
+    def killer():
+        while not os.path.exists(out):
+            time.sleep(0.005)
+        os.kill(os.getpid(), 9)
+    threading.Thread(target=killer, daemon=True).start()
+pw.run(persistence_config=Config(Backend.filesystem(store)))
+"""
+
+
+class TestSubprocessKill:
+    def test_sigkill_then_resume(self, tmp_path):
+        repo = str(pathlib.Path(__file__).resolve().parent.parent)
+        data = tmp_path / "data"
+        data.mkdir()
+        _write(data, "a.txt", ["dog", "cat", "dog"])
+        store = tmp_path / "store"
+        out = tmp_path / "out.jsonl"
+        script = tmp_path / "worker.py"
+        script.write_text(_WORKER.format(repo=repo))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        # first run: killed hard at some point (may or may not finish)
+        subprocess.run(
+            [sys.executable, str(script), str(data), str(store), str(out), "1"],
+            env=env,
+            timeout=120,
+        )
+        if out.exists():
+            out.unlink()
+
+        # resume run: must complete with correct, non-duplicated counts
+        res = subprocess.run(
+            [sys.executable, str(script), str(data), str(store), str(out), "0"],
+            env=env,
+            timeout=120,
+        )
+        assert res.returncode == 0
+        import json
+
+        rows = [json.loads(l) for l in out.read_text().splitlines() if l.strip()]
+        final = {r["word"]: r["cnt"] for r in rows if r.get("diff", 1) > 0}
+        assert final == {"dog": 2, "cat": 1}
