@@ -53,6 +53,8 @@ from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
 from pathway_tpu.stdlib import temporal  # noqa: E402
 from pathway_tpu.internals import udfs  # noqa: E402
+from pathway_tpu.internals.iterate import iterate  # noqa: E402
+from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals.udfs import UDF, udf  # noqa: E402
 
 
@@ -101,6 +103,7 @@ __all__ = [
     "declare_type",
     "fill_error",
     "if_else",
+    "iterate",
     "left",
     "make_tuple",
     "persistence",
@@ -112,6 +115,7 @@ __all__ = [
     "schema_builder",
     "schema_from_dict",
     "schema_from_types",
+    "sql",
     "stdlib",
     "temporal",
     "this",

@@ -1,0 +1,60 @@
+"""Fixed-point iteration (pw.iterate).
+
+Reference: `Graph::iterate` builds a nested timely scope with product
+timestamps (src/engine/dataflow.rs:3912-3977, iterate subscopes). The
+TPU-native engine replaces scope nesting with a *host-driven loop* (the
+strategy flagged in SURVEY.md §7): on every outer commit the node reruns the
+iteration body over the current input state until the iterated tables stop
+changing (or the step limit hits), then emits the delta against its previous
+output. Output streams are identical to the reference's; the inner loop is
+recomputed per affected commit rather than incrementally nested — the right
+trade for a scheduler whose heavy math lives on the device anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from pathway_tpu.engine.batch import DeltaBatch
+from pathway_tpu.engine.graph import Node, Scope
+from pathway_tpu.engine.value import Pointer
+
+
+class IterateNode(Node):
+    """Recompute-on-change host loop.
+
+    ``compute(input_states) -> output_state`` runs the full fixed point;
+    ``input_states`` are the current key->row dicts of the inputs, the
+    return value is the final key->row dict of the designated output table.
+    """
+
+    def __init__(
+        self,
+        scope: Scope,
+        inputs: Sequence[Node],
+        arity: int,
+        compute: Callable[[list[dict]], dict],
+    ) -> None:
+        super().__init__(scope, list(inputs), arity)
+        self.compute = compute
+
+    def process(self, time: int) -> DeltaBatch:
+        changed = False
+        for port in range(len(self.inputs)):
+            if self.take(port):
+                changed = True
+        out = DeltaBatch()
+        if not changed:
+            return out
+        try:
+            new_state = self.compute([inp.current for inp in self.inputs])
+        except Exception as e:  # noqa: BLE001
+            self.report(None, f"iterate error: {e!r}")
+            return out
+        for key, row in self.current.items():
+            if new_state.get(key) != row:
+                out.append(key, row, -1)
+        for key, row in new_state.items():
+            if self.current.get(key) != row:
+                out.append(key, row, 1)
+        return out

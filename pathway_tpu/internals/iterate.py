@@ -1,0 +1,120 @@
+"""pw.iterate — fixed-point iteration over the Table API.
+
+Reference: pw.iterate / IterateOperator (internals/operator.py:316) lowering
+to Graph::iterate (SURVEY.md §3.6). The body function is called once with
+*parameter tables* to capture the inner spec graph; execution is the
+host-driven loop of engine/iterate.py: bind parameters to the current
+state, run the captured subgraph statically, feed results back, repeat
+until convergence or ``iteration_limit``.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.table import Table, TableSpec
+
+
+class _IterationEngine:
+    def __init__(
+        self,
+        func: Callable,
+        outer: dict[str, Table],
+        iteration_limit: int | None,
+    ) -> None:
+        self.outer_names = list(outer)
+        self.outer_tables = list(outer.values())
+        self.limit = iteration_limit
+        # parameter tables: stand-ins bound per iteration
+        self.params: dict[str, Table] = {}
+        for slot, (name, t) in enumerate(outer.items()):
+            self.params[name] = Table(
+                TableSpec("iterate_param", [], {"slot": slot}),
+                t.column_names(),
+                {n: t._dtypes[n] for n in t.column_names()},
+                name=f"iterate_param_{name}",
+            )
+        result = func(**self.params)
+        if isinstance(result, Table):
+            result = {"result": result}
+        elif not isinstance(result, dict):
+            result = dict(result._asdict()) if hasattr(result, "_asdict") else dict(result)
+        self.results: dict[str, Table] = result
+        # names fed back into the next iteration
+        self.feedback = [n for n in self.results if n in self.params]
+        self._cache_inputs: list[dict] | None = None
+        self._cache_out: dict[str, dict] | None = None
+
+    def compute_all(self, input_states: list[dict]) -> dict[str, dict]:
+        if self._cache_inputs is not None and all(
+            a == b for a, b in zip(self._cache_inputs, input_states)
+        ):
+            assert self._cache_out is not None
+            return self._cache_out
+        from pathway_tpu.internals.runner import GraphRunner
+
+        state = {
+            name: dict(input_states[i])
+            for i, name in enumerate(self.outer_names)
+        }
+        steps = 0
+        while True:
+            runner = GraphRunner()
+            runner.iterate_params = [
+                list(state[name].items()) for name in self.outer_names
+            ]
+            nodes = {n: runner.build(t) for n, t in self.results.items()}
+            runner.run_static()
+            out = {n: dict(node.current) for n, node in nodes.items()}
+            steps += 1
+            converged = all(out[n] == state[n] for n in self.feedback)
+            for n in self.feedback:
+                state[n] = out[n]
+            if converged or (self.limit is not None and steps >= self.limit):
+                break
+        self._cache_inputs = [dict(s) for s in input_states]
+        self._cache_out = out
+        return out
+
+
+class IterationResult:
+    """Holds the iterated tables; attribute access mirrors the reference."""
+
+    def __init__(self, tables: dict[str, Table]) -> None:
+        self._tables = tables
+        for name, t in tables.items():
+            setattr(self, name, t)
+
+    def __getitem__(self, name: str) -> Table:
+        return self._tables[name]
+
+
+def iterate(
+    func: Callable,
+    iteration_limit: int | None = None,
+    **kwargs: Table,
+) -> IterationResult:
+    """Iterate ``func`` to fixed point (reference: pw.iterate).
+
+    ``func(**tables) -> dict[str, Table] | Table`` — returned names that
+    match parameter names are fed back each round; all returned tables are
+    exposed on the result.
+    """
+    if not kwargs:
+        raise ValueError("pw.iterate needs at least one input table")
+    engine = _IterationEngine(func, kwargs, iteration_limit)
+    out_tables: dict[str, Table] = {}
+    for name, spec_table in engine.results.items():
+        out_tables[name] = Table(
+            TableSpec(
+                "iterate_result",
+                list(kwargs.values()),
+                {"engine": engine, "name": name},
+            ),
+            spec_table.column_names(),
+            {c: spec_table._dtypes[c] for c in spec_table.column_names()},
+            name=f"iterate_{name}",
+        )
+    return IterationResult(out_tables)

@@ -457,6 +457,42 @@ class GraphRunner:
         if kind in ("interval_join", "asof_join", "asof_now_join"):
             return self._build_temporal_join(table)
 
+        if kind == "iterate_param":
+            rows = getattr(self, "iterate_params", None)
+            if rows is None:
+                raise ValueError(
+                    "iterate parameter table used outside pw.iterate"
+                )
+            return scope.static_table(
+                rows[spec.params["slot"]], len(table._column_names)
+            )
+
+        if kind == "table_transform":
+            from pathway_tpu.engine.iterate import IterateNode
+
+            fn = spec.params["fn"]
+            node = self.build(spec.inputs[0])
+            return IterateNode(
+                scope,
+                [node],
+                len(table._column_names),
+                lambda states, _fn=fn: _fn(states[0]),
+            )
+
+        if kind == "iterate_result":
+            from pathway_tpu.engine.iterate import IterateNode
+
+            engine = spec.params["engine"]
+            name = spec.params["name"]
+            input_nodes = [self.build(t) for t in spec.inputs]
+
+            def compute(states: list[dict], _engine=engine, _name=name) -> dict:
+                return _engine.compute_all(states)[_name]
+
+            return IterateNode(
+                scope, input_nodes, len(table._column_names), compute
+            )
+
         raise NotImplementedError(f"unknown table spec kind {kind!r}")
 
     def _build_temporal_join(self, table: "Table") -> Node:

@@ -1,0 +1,435 @@
+"""pw.sql — SQL over Tables.
+
+Reference: python/pathway/internals/sql.py (726 LoC) parses with sqlglot and
+lowers onto Table ops. sqlglot is not in this image, so the same subset is
+parsed with a small recursive-descent parser and lowered identically:
+SELECT expressions (+aliases, arithmetic, comparisons, AND/OR/NOT, literals),
+FROM, INNER JOIN ... ON equalities, WHERE, GROUP BY with aggregates
+(count/sum/min/max/avg), HAVING, UNION ALL.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    apply as pw_apply,
+    wrap_expression,
+)
+from pathway_tpu.internals.table import Table
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<str>'(?:[^']|'')*')"
+    r"|(?P<op><=|>=|<>|!=|==|[(),*+\-/<>=.%])"
+    r"|(?P<name>[A-Za-z_][A-Za-z_0-9]*))"
+)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "as", "and", "or",
+    "not", "join", "inner", "left", "on", "union", "all", "count", "sum",
+    "min", "max", "avg", "null", "true", "false", "is",
+}
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise ValueError(f"pw.sql: cannot tokenize at {text[pos:pos+20]!r}")
+        pos = m.end()
+        if m.group("num") is not None:
+            out.append(("num", m.group("num")))
+        elif m.group("str") is not None:
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("op") is not None:
+            out.append(("op", m.group("op")))
+        else:
+            name = m.group("name")
+            kind = "kw" if name.lower() in _KEYWORDS else "name"
+            out.append((kind, name.lower() if kind == "kw" else name))
+    out.append(("end", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]) -> None:
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self) -> tuple[str, str]:
+        return self.tokens[self.i]
+
+    def next(self) -> tuple[str, str]:
+        tok = self.tokens[self.i]
+        self.i += 1
+        return tok
+
+    def accept(self, kind: str, value: str | None = None) -> bool:
+        k, v = self.peek()
+        if k == kind and (value is None or v == value):
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kind: str, value: str | None = None) -> str:
+        k, v = self.next()
+        if k != kind or (value is not None and v != value):
+            raise ValueError(f"pw.sql: expected {value or kind}, got {v!r}")
+        return v
+
+    # -- grammar -------------------------------------------------------------
+
+    def parse_query(self) -> dict:
+        q = self.parse_select()
+        while self.accept("kw", "union"):
+            self.expect("kw", "all")
+            q = {"kind": "union", "left": q, "right": self.parse_select()}
+        self.expect("end")
+        return q
+
+    def parse_select(self) -> dict:
+        self.expect("kw", "select")
+        items: list[tuple[Any, str | None]] = []
+        if self.accept("op", "*"):
+            items.append(("*", None))
+        else:
+            while True:
+                e = self.parse_expr()
+                alias = None
+                if self.accept("kw", "as"):
+                    alias = self.expect("name")
+                elif self.peek()[0] == "name":
+                    alias = self.next()[1]
+                items.append((e, alias))
+                if not self.accept("op", ","):
+                    break
+        self.expect("kw", "from")
+        base = self.expect("name")
+        joins = []
+        while self.peek() == ("kw", "join") or self.peek() == ("kw", "inner") or self.peek() == ("kw", "left"):
+            how = "inner"
+            if self.accept("kw", "left"):
+                how = "left"
+            self.accept("kw", "inner")
+            self.expect("kw", "join")
+            other = self.expect("name")
+            self.expect("kw", "on")
+            cond = self.parse_expr()
+            joins.append({"table": other, "on": cond, "how": how})
+        where = None
+        if self.accept("kw", "where"):
+            where = self.parse_expr()
+        group_by = None
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            group_by = [self.parse_expr()]
+            while self.accept("op", ","):
+                group_by.append(self.parse_expr())
+        having = None
+        if self.accept("kw", "having"):
+            having = self.parse_expr()
+        return {
+            "kind": "select",
+            "items": items,
+            "from": base,
+            "joins": joins,
+            "where": where,
+            "group_by": group_by,
+            "having": having,
+        }
+
+    def parse_expr(self) -> Any:
+        return self.parse_or()
+
+    def parse_or(self) -> Any:
+        e = self.parse_and()
+        while self.accept("kw", "or"):
+            e = ("or", e, self.parse_and())
+        return e
+
+    def parse_and(self) -> Any:
+        e = self.parse_not()
+        while self.accept("kw", "and"):
+            e = ("and", e, self.parse_not())
+        return e
+
+    def parse_not(self) -> Any:
+        if self.accept("kw", "not"):
+            return ("not", self.parse_not())
+        return self.parse_cmp()
+
+    def parse_cmp(self) -> Any:
+        e = self.parse_add()
+        k, v = self.peek()
+        if k == "op" and v in ("=", "==", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            op = {"=": "==", "<>": "!="}.get(v, v)
+            return (op, e, self.parse_add())
+        if self.accept("kw", "is"):
+            negated = self.accept("kw", "not")
+            self.expect("kw", "null")
+            return ("is_not_null" if negated else "is_null", e)
+        return e
+
+    def parse_add(self) -> Any:
+        e = self.parse_mul()
+        while True:
+            if self.accept("op", "+"):
+                e = ("+", e, self.parse_mul())
+            elif self.accept("op", "-"):
+                e = ("-", e, self.parse_mul())
+            else:
+                return e
+
+    def parse_mul(self) -> Any:
+        e = self.parse_atom()
+        while True:
+            if self.accept("op", "*"):
+                e = ("*", e, self.parse_atom())
+            elif self.accept("op", "/"):
+                e = ("/", e, self.parse_atom())
+            elif self.accept("op", "%"):
+                e = ("%", e, self.parse_atom())
+            else:
+                return e
+
+    def parse_atom(self) -> Any:
+        k, v = self.next()
+        if k == "num":
+            return ("lit", float(v) if "." in v else int(v))
+        if k == "str":
+            return ("lit", v)
+        if k == "kw" and v in ("count", "sum", "min", "max", "avg"):
+            self.expect("op", "(")
+            if v == "count" and self.accept("op", "*"):
+                self.expect("op", ")")
+                return ("agg", "count", None)
+            arg = self.parse_expr()
+            self.expect("op", ")")
+            return ("agg", v, arg)
+        if k == "kw" and v == "null":
+            return ("lit", None)
+        if k == "kw" and v == "true":
+            return ("lit", True)
+        if k == "kw" and v == "false":
+            return ("lit", False)
+        if k == "op" and v == "(":
+            e = self.parse_expr()
+            self.expect("op", ")")
+            return e
+        if k == "op" and v == "-":
+            return ("neg", self.parse_atom())
+        if k == "name":
+            if self.accept("op", "."):
+                col = self.expect("name")
+                return ("col", v, col)
+            return ("col", None, v)
+        raise ValueError(f"pw.sql: unexpected token {v!r}")
+
+
+class _Lowerer:
+    def __init__(self, tables: dict[str, Table]) -> None:
+        self.tables = tables
+
+    def lower(self, q: dict) -> Table:
+        if q["kind"] == "union":
+            left = self.lower(q["left"])
+            right = self.lower(q["right"])
+            return left.concat_reindex(right)
+        return self.lower_select(q)
+
+    def _resolve_col(self, tname: str | None, col: str, scope: dict[str, Table]):
+        if tname is not None:
+            if tname not in scope:
+                raise ValueError(f"pw.sql: unknown table {tname!r}")
+            return scope[tname][col]
+        unique = {id(t): t for t in scope.values()}
+        matches = [t for t in unique.values() if col in t.column_names()]
+        if not matches:
+            raise ValueError(f"pw.sql: unknown column {col!r}")
+        if len(matches) > 1:
+            raise ValueError(f"pw.sql: ambiguous column {col!r}")
+        return matches[0][col]
+
+    def expr(self, node: Any, scope: dict[str, Table]) -> Any:
+        op = node[0]
+        if op == "lit":
+            return wrap_expression(node[1])
+        if op == "col":
+            return self._resolve_col(node[1], node[2], scope)
+        if op == "neg":
+            return -self.expr(node[1], scope)
+        if op == "not":
+            return ~self.expr(node[1], scope)
+        if op in ("and", "or"):
+            left = self.expr(node[1], scope)
+            right = self.expr(node[2], scope)
+            return (left & right) if op == "and" else (left | right)
+        if op == "is_null":
+            e = self.expr(node[1], scope)
+            return e.is_none()
+        if op == "is_not_null":
+            e = self.expr(node[1], scope)
+            return e.is_not_none()
+        if op == "agg":
+            raise ValueError("pw.sql: aggregate used outside GROUP BY select")
+        left = self.expr(node[1], scope)
+        right = self.expr(node[2], scope)
+        return {
+            "+": lambda: left + right,
+            "-": lambda: left - right,
+            "*": lambda: left * right,
+            "/": lambda: left / right,
+            "%": lambda: left % right,
+            "==": lambda: left == right,
+            "!=": lambda: left != right,
+            "<": lambda: left < right,
+            "<=": lambda: left <= right,
+            ">": lambda: left > right,
+            ">=": lambda: left >= right,
+        }[op]()
+
+    def _has_agg(self, node: Any) -> bool:
+        if not isinstance(node, tuple):
+            return False
+        if node[0] == "agg":
+            return True
+        return any(self._has_agg(c) for c in node[1:] if isinstance(c, tuple))
+
+    def _agg_expr(self, node: Any, scope: dict[str, Table]) -> Any:
+        """Expression where ('agg', fn, arg) becomes a reducer expression."""
+        if isinstance(node, tuple) and node[0] == "agg":
+            fn, arg = node[1], node[2]
+            if fn == "count":
+                return reducers.count()
+            inner = self.expr(arg, scope)
+            return {
+                "sum": reducers.sum,
+                "min": reducers.min,
+                "max": reducers.max,
+                "avg": reducers.avg,
+            }[fn](inner)
+        if isinstance(node, tuple) and node[0] not in ("lit", "col"):
+            parts = [self._agg_expr(c, scope) for c in node[1:]]
+            return self._combine(node[0], parts)
+        return self.expr(node, scope)
+
+    def _combine(self, op: str, parts: list) -> Any:
+        if op == "neg":
+            return -parts[0]
+        if op == "not":
+            return ~parts[0]
+        if op == "and":
+            return parts[0] & parts[1]
+        if op == "or":
+            return parts[0] | parts[1]
+        left, right = parts
+        return {
+            "+": lambda: left + right,
+            "-": lambda: left - right,
+            "*": lambda: left * right,
+            "/": lambda: left / right,
+            "%": lambda: left % right,
+            "==": lambda: left == right,
+            "!=": lambda: left != right,
+            "<": lambda: left < right,
+            "<=": lambda: left <= right,
+            ">": lambda: left > right,
+            ">=": lambda: left >= right,
+        }[op]()
+
+    def _item_name(self, node: Any, alias: str | None, idx: int) -> str:
+        if alias:
+            return alias
+        if isinstance(node, tuple) and node[0] == "col":
+            return node[2]
+        if isinstance(node, tuple) and node[0] == "agg":
+            return node[1]
+        return f"col_{idx}"
+
+    def lower_select(self, q: dict) -> Table:
+        scope: dict[str, Table] = {}
+        base = self.tables.get(q["from"])
+        if base is None:
+            raise ValueError(f"pw.sql: unknown table {q['from']!r}")
+        scope[q["from"]] = base
+        current = base
+        for j in q["joins"]:
+            other = self.tables.get(j["table"])
+            if other is None:
+                raise ValueError(f"pw.sql: unknown table {j['table']!r}")
+            scope[j["table"]] = other
+            cond_ast = j["on"]
+            if not (isinstance(cond_ast, tuple) and cond_ast[0] == "=="):
+                raise ValueError("pw.sql: JOIN ON must be an equality")
+            lcond = self.expr(cond_ast[1], scope)
+            rcond = self.expr(cond_ast[2], scope)
+            joined = current.join(other, lcond == rcond, how=j["how"])
+            # materialize all columns of both sides for further stages
+            cols: dict[str, Any] = {}
+            for t in scope.values():
+                for name in t.column_names():
+                    if name not in cols:
+                        cols[name] = t[name]
+            current = joined.select(**cols)
+            scope = {name: current for name in scope}
+            scope["__joined__"] = current
+        if q["where"] is not None:
+            current = current.filter(self.expr(q["where"], scope))
+            scope = {name: current for name in scope}
+        if q["group_by"] is not None:
+            from pathway_tpu.internals.expression import ColumnReference
+
+            by_exprs = [self.expr(g, scope) for g in q["group_by"]]
+            if not all(isinstance(b, ColumnReference) for b in by_exprs):
+                # group by computed expressions: materialize them first
+                aux = {
+                    f"_pw_gb_{i}": b
+                    for i, b in enumerate(by_exprs)
+                    if not isinstance(b, ColumnReference)
+                }
+                keep = {n: current[n] for n in current.column_names()}
+                current = current.select(**keep, **aux)
+                scope = {name: current for name in scope}
+                by_exprs = [
+                    b
+                    if isinstance(b, ColumnReference)
+                    else current[f"_pw_gb_{i}"]
+                    for i, b in enumerate(by_exprs)
+                ]
+            grouped = current.groupby(*by_exprs)
+            out: dict[str, Any] = {}
+            for idx, (node, alias) in enumerate(q["items"]):
+                if node == "*":
+                    raise ValueError("pw.sql: SELECT * with GROUP BY")
+                name = self._item_name(node, alias, idx)
+                out[name] = self._agg_expr(node, scope)
+            if q["having"] is not None:
+                out["_pw_having"] = self._agg_expr(q["having"], scope)
+            result = grouped.reduce(**out)
+            if q["having"] is not None:
+                result = result.filter(result["_pw_having"])[
+                    [n for n in out if n != "_pw_having"]
+                ]
+            return result
+        out = {}
+        for idx, (node, alias) in enumerate(q["items"]):
+            if node == "*":
+                for name in current.column_names():
+                    out[name] = current[name]
+                continue
+            out[self._item_name(node, alias, idx)] = self.expr(node, scope)
+        return current.select(**out)
+
+
+def sql(query: str, **tables: Table) -> Table:
+    """Run a SQL query over the given tables (reference: pw.sql)."""
+    ast = _Parser(_tokenize(query)).parse_query()
+    return _Lowerer(tables).lower(ast)

@@ -4,6 +4,6 @@ Mirrors the capability surface of the reference's ``pathway.stdlib``
 (reference: python/pathway/stdlib/) with TPU-native internals.
 """
 
-from pathway_tpu.stdlib import indexing, temporal  # noqa: F401
+from pathway_tpu.stdlib import graphs, indexing, temporal  # noqa: F401
 
-__all__ = ["indexing", "temporal"]
+__all__ = ["graphs", "indexing", "temporal"]

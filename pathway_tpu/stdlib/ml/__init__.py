@@ -1,0 +1,59 @@
+"""ML helpers (reference: stdlib/ml/ — index.KNNIndex, classifiers,
+smart_table_ops)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory, DataIndex
+
+
+class KNNIndex:
+    """Reference-compatible wrapper (stdlib/ml/index.py:301 KNNIndex) over
+    the TPU HBM brute-force index."""
+
+    def __init__(
+        self,
+        data_embedding: Any,
+        data: Table,
+        n_dimensions: int,
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+        distance_type: str = "cosine",
+        metadata: Any = None,
+    ) -> None:
+        metric = {"cosine": "cos", "euclidean": "l2sq"}.get(
+            distance_type, distance_type
+        )
+        self._index = DataIndex(
+            data,
+            BruteForceKnnFactory(dimensions=n_dimensions, metric=metric),
+            data_embedding,
+            metadata_column=metadata,
+        )
+        self.data = data
+
+    def get_nearest_items(
+        self,
+        query_embedding: Any,
+        k: int = 3,
+        collapse_rows: bool = True,
+    ) -> Table:
+        deps = list(query_embedding._dependencies())
+        query_table = deps[0].table
+        if collapse_rows:
+            return self._index.query_docs_as_of_now(
+                query_table,
+                query_embedding,
+                doc_columns=self.data.column_names(),
+                number_of_matches=k,
+            )
+        return self._index.query_as_of_now(
+            query_table, query_embedding, number_of_matches=k,
+            collapse_rows=False,
+        )
+
+    def get_nearest_items_asof_now(self, *args: Any, **kwargs: Any) -> Table:
+        return self.get_nearest_items(*args, **kwargs)

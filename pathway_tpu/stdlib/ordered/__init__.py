@@ -1,0 +1,55 @@
+"""Ordered-table helpers (reference: stdlib/ordered/diff.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.table import Table, TableSpec
+
+
+def diff(
+    table: Table, timestamp: Any, *value_columns: Any, instance: Any = None
+) -> Table:
+    """Per-row difference vs the previous row in ``timestamp`` order
+    (reference: pw.ordered.diff — built on prev/next pointers). Output has
+    ``diff_<col>`` columns; the first row per instance gets None."""
+    from pathway_tpu.internals.desugaring import resolve_this
+
+    cols = table.column_names()
+    t_idx = cols.index(resolve_this(timestamp, table).name)
+    names = [resolve_this(v, table).name for v in value_columns]
+    v_idx = [cols.index(n) for n in names]
+    i_idx = (
+        cols.index(resolve_this(instance, table).name)
+        if instance is not None
+        else None
+    )
+
+    def transform(state: dict) -> dict:
+        groups: dict[Any, list] = {}
+        for key, row in state.items():
+            inst = row[i_idx] if i_idx is not None else None
+            groups.setdefault(inst, []).append((key, row))
+        out = {}
+        for rows in groups.values():
+            rows.sort(key=lambda kv: (kv[1][t_idx], int(kv[0])))
+            prev = None
+            for key, row in rows:
+                diffs = tuple(
+                    (row[vi] - prev[vi]) if prev is not None else None
+                    for vi in v_idx
+                )
+                out[key] = tuple(row) + diffs
+                prev = row
+        return out
+
+    dtypes = dict(table._dtypes)
+    out_types = {n: dtypes[n] for n in cols}
+    for n in names:
+        out_types[f"diff_{n}"] = dt.ANY
+    return table._derived(
+        TableSpec("table_transform", [table], {"fn": transform}),
+        out_types,
+        universe=table._universe,
+    )

@@ -1,0 +1,22 @@
+"""Stateful helpers (reference: stdlib/stateful/deduplicate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals.table import Table
+
+
+def deduplicate(
+    table: Table,
+    *,
+    value: Any,
+    instance: Any = None,
+    acceptor: Callable[[Any, Any], bool],
+    name: str | None = None,
+) -> Table:
+    """Keep one accepted row per instance (reference:
+    pw.stateful.deduplicate — engine DeduplicateNode)."""
+    return table.deduplicate(
+        value=value, instance=instance, acceptor=acceptor, name=name
+    )

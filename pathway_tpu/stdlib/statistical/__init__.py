@@ -1,0 +1,67 @@
+"""Statistical helpers (reference: stdlib/statistical/_interpolate.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.table import Table, TableSpec
+
+
+def interpolate(
+    table: Table, timestamp: Any, *value_columns: Any, mode: str = "linear"
+) -> Table:
+    """Linearly interpolate None values of ``value_columns`` over the series
+    ordered by ``timestamp`` (reference: pw.statistical.interpolate).
+
+    Boundary Nones take the nearest known value. Recomputed per affected
+    commit over the table's current state (the host-loop strategy the
+    engine uses for order-dependent operators).
+    """
+    from pathway_tpu.internals.desugaring import resolve_this
+
+    t_ref = resolve_this(timestamp, table)
+    cols = table.column_names()
+    t_idx = cols.index(t_ref.name)
+    v_idx = [cols.index(resolve_this(v, table).name) for v in value_columns]
+
+    def transform(state: dict) -> dict:
+        items = sorted(state.items(), key=lambda kv: (kv[1][t_idx], int(kv[0])))
+        out = {}
+        for vi in v_idx:
+            known = [
+                (i, row[t_idx], row[vi])
+                for i, (_k, row) in enumerate(items)
+                if row[vi] is not None
+            ]
+            filled: list = []
+            for i, (_key, row) in enumerate(items):
+                if row[vi] is not None:
+                    filled.append(row[vi])
+                    continue
+                before = [k for k in known if k[0] < i]
+                after = [k for k in known if k[0] > i]
+                if before and after:
+                    _i0, t0, v0 = before[-1]
+                    _i1, t1, v1 = after[0]
+                    t = row[t_idx]
+                    frac = (t - t0) / (t1 - t0) if t1 != t0 else 0.0
+                    filled.append(v0 + (v1 - v0) * frac)
+                elif before:
+                    filled.append(before[-1][2])
+                elif after:
+                    filled.append(after[0][2])
+                else:
+                    filled.append(None)
+            for (key, row), value in zip(items, filled):
+                base = out.get(key, list(row))
+                base = list(base)
+                base[vi] = value
+                out[key] = base
+        return {k: tuple(v) for k, v in out.items()}
+
+    return table._derived(
+        TableSpec("table_transform", [table], {"fn": transform}),
+        {n: (dt.ANY if i in v_idx else table._dtypes[n]) for i, n in enumerate(cols)},
+        universe=table._universe,
+    )

@@ -1,0 +1,88 @@
+"""Utility transforms (reference: stdlib/utils/ — pandas_transformer, col,
+async_transformer, filtering)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.table import Table, TableSpec
+from pathway_tpu.internals.expression import apply as pw_apply
+
+
+def pandas_transformer(
+    output_schema: Any = None,
+) -> Callable:
+    """Whole-table pandas UDF (reference: stdlib/utils/pandas_transformer).
+
+    Decorates ``fn(df: pandas.DataFrame) -> pandas.DataFrame``; the result
+    table is re-keyed by the output frame's positional index per recompute.
+    """
+
+    def wrap(fn: Callable) -> Callable[[Table], Table]:
+        def apply_to(table: Table) -> Table:
+            import pandas as pd
+
+            from pathway_tpu.engine.value import hash_values
+
+            cols = table.column_names()
+
+            def transform(state: dict) -> dict:
+                keys = list(state)
+                df = pd.DataFrame(
+                    [state[k] for k in keys], columns=cols,
+                    index=[int(k) for k in keys],
+                )
+                out = fn(df)
+                result = {}
+                for i, (_idx, row) in enumerate(out.iterrows()):
+                    key = hash_values((fn.__name__, i), salt=b"pandas")
+                    result[key] = tuple(row[c] for c in out.columns)
+                return result
+
+            if output_schema is not None:
+                out_types = dict(output_schema.dtypes())
+            else:
+                out_types = {n: dt.ANY for n in cols}
+            return table._derived(
+                TableSpec("table_transform", [table], {"fn": transform}),
+                out_types,
+            )
+
+        return apply_to
+
+    return wrap
+
+
+def unpack_col(column: Any, *names: str) -> Table:
+    """Explode a tuple column into named columns
+    (reference: stdlib/utils/col.py unpack_col)."""
+    table = column.table
+    return table.select(
+        **{
+            name: pw_apply(lambda t, i=i: t[i] if t is not None else None, column)
+            for i, name in enumerate(names)
+        }
+    )
+
+
+def argmax_rows(table: Table, *on: Any, what: Any) -> Table:
+    """Rows holding the per-group maximum of ``what``
+    (reference: stdlib/utils/filtering.py argmax_rows)."""
+    from pathway_tpu.internals import reducers
+    from pathway_tpu.internals.desugaring import resolve_this
+
+    what_ref = resolve_this(what, table)
+    grouped = table.groupby(*[resolve_this(o, table) for o in on])
+    best = grouped.reduce(_pw_best=reducers.argmax(what_ref))
+    return table.ix(best["_pw_best"])
+
+
+def argmin_rows(table: Table, *on: Any, what: Any) -> Table:
+    from pathway_tpu.internals import reducers
+    from pathway_tpu.internals.desugaring import resolve_this
+
+    what_ref = resolve_this(what, table)
+    grouped = table.groupby(*[resolve_this(o, table) for o in on])
+    best = grouped.reduce(_pw_best=reducers.argmin(what_ref))
+    return table.ix(best["_pw_best"])

@@ -1,0 +1,80 @@
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner
+
+
+def rows_of(table):
+    return sorted(GraphRunner().capture(table)[0].values(), key=repr)
+
+
+def people():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, age=int, city=str),
+        [
+            ("alice", 30, "paris"),
+            ("bob", 25, "london"),
+            ("carol", 35, "paris"),
+            ("dave", 20, "london"),
+        ],
+    )
+
+
+def test_select_where():
+    t = people()
+    res = pw.sql("SELECT name, age + 1 AS next_age FROM t WHERE age > 24", t=t)
+    assert rows_of(res) == [("alice", 31), ("bob", 26), ("carol", 36)]
+
+
+def test_select_star():
+    t = people()
+    res = pw.sql("SELECT * FROM t WHERE city = 'paris'", t=t)
+    assert len(rows_of(res)) == 2
+
+
+def test_group_by_having():
+    t = people()
+    res = pw.sql(
+        "SELECT city, count(*) AS n, avg(age) AS mean_age FROM t "
+        "GROUP BY city HAVING count(*) >= 2",
+        t=t,
+    )
+    assert rows_of(res) == [("london", 2, 22.5), ("paris", 2, 32.5)]
+
+
+def test_join():
+    t = people()
+    cities = pw.debug.table_from_rows(
+        pw.schema_from_types(cname=str, country=str),
+        [("paris", "fr"), ("london", "uk")],
+    )
+    res = pw.sql(
+        "SELECT name, country FROM t JOIN cities ON t.city = cities.cname "
+        "WHERE age >= 30",
+        t=t,
+        cities=cities,
+    )
+    assert rows_of(res) == [("alice", "fr"), ("carol", "fr")]
+
+
+def test_union_all():
+    t = people()
+    res = pw.sql(
+        "SELECT name FROM t WHERE age > 30 UNION ALL "
+        "SELECT name FROM t WHERE age < 21",
+        t=t,
+    )
+    assert rows_of(res) == [("carol",), ("dave",)]
+
+
+def test_and_or_not():
+    t = people()
+    res = pw.sql(
+        "SELECT name FROM t WHERE city = 'paris' AND NOT age < 32",
+        t=t,
+    )
+    assert rows_of(res) == [("carol",)]
+
+
+def test_arith_and_aliases():
+    t = people()
+    res = pw.sql("SELECT name, age * 2 - 10 AS x FROM t WHERE name = 'bob'", t=t)
+    assert rows_of(res) == [("bob", 40)]
