@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable, Iterator
 
 from pathway_tpu.engine.value import Pointer
+from pathway_tpu.native import kernels as _native
 
 Entry = tuple[Pointer, tuple, int]
 
@@ -22,19 +23,28 @@ Entry = tuple[Pointer, tuple, int]
 class DeltaBatch:
     """A consolidatable batch of keyed row updates."""
 
-    __slots__ = ("entries",)
+    __slots__ = ("entries", "_consolidated", "_insert_only")
 
     def __init__(self, entries: Iterable[Entry] | None = None) -> None:
         self.entries: list[Entry] = list(entries) if entries is not None else []
+        self._consolidated = False
+        self._insert_only = False  # set by consolidate(): unique-key inserts
 
     def append(self, key: Pointer, row: tuple, diff: int) -> None:
         if diff != 0:
             self.entries.append((key, row, diff))
+            self._consolidated = False
+            self._insert_only = False
 
     def extend(self, entries: Iterable[Entry]) -> None:
+        appended = False
         for key, row, diff in entries:
             if diff != 0:
                 self.entries.append((key, row, diff))
+                appended = True
+        if appended:
+            self._consolidated = False
+            self._insert_only = False
 
     def __iter__(self) -> Iterator[Entry]:
         return iter(self.entries)
@@ -50,11 +60,39 @@ class DeltaBatch:
 
     def consolidate(self) -> "DeltaBatch":
         """Merge duplicate (key, row) entries, dropping zero diffs."""
-        acc: dict[tuple[Pointer, int], list[Any]] = {}
-        order: list[tuple[Pointer, int]] = []
+        if self._consolidated:
+            return self
+        if _native is not None:
+            merged, insert_only = _native.consolidate(self.entries)
+            if merged is None:  # precheck passed: already consolidated
+                self._consolidated = True
+                self._insert_only = insert_only
+                return self
+            out = DeltaBatch()
+            out.entries = merged
+            out._consolidated = True
+            return out
+        # Cheap precheck for the dominant shape — insert-only with unique
+        # keys (connector ingest, expression outputs): key uniqueness alone
+        # implies (key, row) uniqueness, so the batch is already consolidated.
+        seen: set = set()
+        seen_add = seen.add
+        clean = True
+        for key, _row, diff in self.entries:
+            if diff <= 0 or key in seen:
+                clean = False
+                break
+            seen_add(key)
+        if clean:
+            self._consolidated = True
+            self._insert_only = True
+            return self
+        acc: dict[tuple[Pointer, Any], list[Any]] = {}
+        order: list[tuple[Pointer, Any]] = []
         for key, row, diff in self.entries:
             try:
-                slot = (key, hash(row))
+                hash(row)
+                slot = (key, row)  # dict handles hash + equality correctly
             except TypeError:
                 slot = (key, id(row))
             found = acc.get(slot)
@@ -68,6 +106,7 @@ class DeltaBatch:
             row, diff = acc[slot]
             if diff != 0:
                 out.entries.append((slot[0], row, diff))
+        out._consolidated = True
         return out
 
     def map_rows(self, fn: Callable[[Pointer, tuple], tuple]) -> "DeltaBatch":
@@ -83,13 +122,16 @@ def apply_batch_to_state(state: dict[Pointer, tuple], batch: DeltaBatch) -> None
     A table maps each key to exactly one row; an in-place update arrives as
     a retraction of the old row and an insertion of the new one.
     """
-    removed: dict[Pointer, tuple] = {}
+    if _native is not None:
+        _native.apply_state(state, batch.entries, batch._insert_only)
+        return
+    if batch._insert_only:
+        # C-speed bulk store: no retraction pass needed
+        state.update((key, row) for key, row, _d in batch.entries)
+        return
     for key, row, diff in batch:
         if diff < 0:
-            for _ in range(-diff):
-                prev = state.pop(key, None)
-                if prev is not None:
-                    removed[key] = prev
+            state.pop(key, None)
     for key, row, diff in batch:
         if diff > 0:
             state[key] = row

@@ -26,6 +26,7 @@ import itertools
 from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
+from pathway_tpu.engine.device import VECTOR_THRESHOLD
 from pathway_tpu.engine.expression import EngineExpression, EvalContext
 from pathway_tpu.engine.reducers import Reducer
 from pathway_tpu.engine.value import ERROR, Error, Pointer, hash_values, is_error, ref_scalar
@@ -114,16 +115,24 @@ class InputSession(Node):
         super().__init__(scope, [], arity)
         self.upsert = upsert
         self._buffer: list[tuple[Pointer, tuple | None, int]] = []
+        self._has_removals = False
 
     def insert(self, key: Pointer, row: tuple) -> None:
         self._buffer.append((key, row, 1))
 
     def remove(self, key: Pointer, row: tuple | None = None) -> None:
         self._buffer.append((key, row, -1))
+        self._has_removals = True
 
     def flush(self) -> DeltaBatch | None:
         if not self._buffer:
             return None
+        if not self.upsert and not self._has_removals:
+            # dominant connector shape: plain inserts need no overlay logic
+            out = DeltaBatch(self._buffer)
+            self._buffer = []
+            self._has_removals = False
+            return out.consolidate()
         out = DeltaBatch()
         # overlay of keys touched this commit: key -> row | None (absent row)
         overlay: dict[Pointer, tuple | None] = {}
@@ -158,6 +167,7 @@ class InputSession(Node):
                     overlay[key] = None
                 out.append(key, row, diff)  # type: ignore[arg-type]
         self._buffer.clear()
+        self._has_removals = False
         return out.consolidate()
 
     def process(self, time: int) -> DeltaBatch:
@@ -184,15 +194,50 @@ class ExpressionNode(Node):
         batch = self.take(0)
         out = DeltaBatch()
         ctx = EvalContext()
-        for key, row, diff in batch:
-            if diff < 0:
-                prev = self.current.get(key)
-                if prev is not None:
-                    out.append(key, prev, diff)
-        for key, row, diff in batch:
-            if diff > 0:
-                new_row = tuple(expr.evaluate(key, row, ctx) for expr in self.expressions)
-                out.append(key, new_row, diff)
+        if not batch._insert_only:
+            for key, row, diff in batch:
+                if diff < 0:
+                    prev = self.current.get(key)
+                    if prev is not None:
+                        out.append(key, prev, diff)
+        inserts = (
+            batch.entries
+            if batch._insert_only
+            else [e for e in batch if e[2] > 0]
+        )
+        if len(inserts) >= VECTOR_THRESHOLD:
+            # columnar fast path: whole-batch NumPy eval (engine/device.py);
+            # falls back row-wise on mixed/None/error columns
+            from pathway_tpu.engine.device import (
+                eval_expressions_columnar_cols,
+            )
+            from pathway_tpu.native import kernels as _native
+
+            cols = eval_expressions_columnar_cols(
+                self.expressions, [row for _k, row, _d in inserts]
+            )
+            if cols is not None:
+                fresh = not out.entries
+                if _native is not None:
+                    out.entries.extend(_native.build_entries(inserts, cols))
+                elif not cols:  # arity-0 select: one () row per key
+                    out.entries.extend(
+                        (key, (), diff) for key, _row, diff in inserts
+                    )
+                else:
+                    out.entries.extend(
+                        (key, new_row, diff)
+                        for (key, _row, diff), new_row in zip(
+                            inserts, zip(*cols)
+                        )
+                    )
+                if fresh and batch._insert_only:
+                    out._consolidated = True
+                    out._insert_only = True
+                return out
+        for key, row, diff in inserts:
+            new_row = tuple(expr.evaluate(key, row, ctx) for expr in self.expressions)
+            out.append(key, new_row, diff)
         for key, message in ctx.errors:
             self.report(key, message)
         return out
@@ -265,6 +310,25 @@ class FilterNode(Node):
 
     def process(self, time: int) -> DeltaBatch:
         batch = self.take(0)
+        c = self.condition_col
+        if batch._insert_only:
+            from pathway_tpu.native import kernels as _native
+
+            if _native is not None:
+                kept = _native.filter_truthy(batch.entries, c)
+                if kept is not None:  # all-bool conditions, no errors
+                    out = DeltaBatch()
+                    out.entries = kept
+                    out._consolidated = True
+                    out._insert_only = True
+                    return out
+            if not any(is_error(e[1][c]) for e in batch.entries):
+                # C-speed comprehension: no retractions, no error conditions
+                out = DeltaBatch()
+                out.entries = [e for e in batch.entries if e[1][c]]
+                out._consolidated = True
+                out._insert_only = True
+                return out
         out = DeltaBatch()
         for key, row, diff in batch:
             if diff < 0:
@@ -587,8 +651,102 @@ class GroupbyNode(Node):
             vals.append(reducer.compute(state))
         return tuple(by_vals) + tuple(vals)
 
+    def _process_columnar(self, batch: DeltaBatch) -> DeltaBatch | None:
+        """Vectorized path for count/sum groupbys over a single clean by
+        column: per-row work collapses to np.unique + segment reductions
+        (engine/device.py), leaving only per-group Python. Falls back (None)
+        whenever semantics would differ from the row-wise loop."""
+        from pathway_tpu.engine import device
+        from pathway_tpu.engine.reducers import ReducerKind
+
+        if self.set_id or len(self.by_cols) != 1:
+            return None
+        for reducer, cols in self.reducers:
+            if reducer.kind not in (ReducerKind.COUNT, ReducerKind.SUM):
+                return None
+        import numpy as np
+
+        entries = batch.entries
+        rows = [row for _k, row, _d in entries]
+        view = device.ColumnarView(rows)
+        by = view.column(self.by_cols[0])
+        if by is None:
+            return None
+        sum_arrays: dict[int, Any] = {}
+        for ri, (reducer, cols) in enumerate(self.reducers):
+            if reducer.kind == ReducerKind.SUM:
+                col = view.column(cols[0])
+                if col is None or col.dtype.kind not in "bif":
+                    return None  # non-numeric sums keep row-wise semantics
+                sum_arrays[ri] = col
+        diffs = np.fromiter(
+            (d for _k, _r, d in entries), np.int64, len(entries)
+        )
+        if sum_arrays and len(entries):
+            # int64 segment sums wrap silently while the row-wise path
+            # computes exact Python ints; reject batches whose worst-case
+            # |group sum| <= max|v| * n * max|diff| could leave int64.
+            dmax = int(np.abs(diffs).max())
+            for col in sum_arrays.values():
+                if col.dtype.kind != "i":
+                    continue
+                amax = int(np.abs(col).max())
+                if amax < 0 or dmax < 0:  # abs(INT64_MIN) wraps
+                    return None
+                if amax * len(entries) * dmax > (1 << 62):
+                    return None
+        uniques, inverse = device.factorize(by)
+        n_groups = len(uniques)
+        gdiffs = device.segment_count(inverse, diffs, n_groups)
+        aggs: list[Any] = []
+        for ri, (reducer, cols) in enumerate(self.reducers):
+            if reducer.kind == ReducerKind.COUNT:
+                aggs.append(None)
+            else:
+                aggs.append(
+                    device.segment_sum(
+                        inverse, sum_arrays[ri], diffs, n_groups
+                    )
+                )
+        out = DeltaBatch()
+        for gi, val in enumerate(uniques):
+            by_vals = (val,)
+            gkey = self._group_key(by_vals)
+            entry = self.groups.get(gkey)
+            old_row = self._group_row(entry) if entry is not None else None
+            if entry is None:
+                entry = [
+                    by_vals,
+                    [reducer.make_state() for reducer, _c in self.reducers],
+                    0,
+                ]
+                self.groups[gkey] = entry
+            gdiff = int(gdiffs[gi])
+            entry[2] += gdiff
+            for ri, ((reducer, _cols), state) in enumerate(
+                zip(self.reducers, entry[1])
+            ):
+                state.count += gdiff
+                if reducer.kind == ReducerKind.SUM:
+                    delta = aggs[ri][gi].item()
+                    state.acc = delta if state.acc is None else state.acc + delta
+            new_row: tuple | None = None
+            if entry[2] <= 0:
+                del self.groups[gkey]
+            else:
+                new_row = self._group_row(entry)
+            if old_row is not None and old_row != new_row:
+                out.append(gkey, old_row, -1)
+            if new_row is not None and old_row != new_row:
+                out.append(gkey, new_row, 1)
+        return out.consolidate()
+
     def process(self, time: int) -> DeltaBatch:
         batch = self.take(0)
+        if len(batch) >= VECTOR_THRESHOLD:
+            fast = self._process_columnar(batch)
+            if fast is not None:
+                return fast
         touched: dict[Pointer, tuple | None] = {}
         for key, row, diff in batch:
             by_vals = tuple(row[c] for c in self.by_cols)

@@ -1,0 +1,79 @@
+"""Native (C++) engine-core kernels with transparent build + fallback.
+
+The reference's hot loop is native (Rust, src/engine/dataflow.rs); this
+package provides the equivalent native floor for the TPU build's host
+control plane: CPython C++ kernels for per-row object plumbing
+(enginecore.cpp), compiled on first import with g++ and cached next to the
+source. Everything degrades gracefully to the pure-Python implementations
+when no toolchain is available — behavior is identical, only slower.
+
+Public surface:
+- ``available()`` — True when the compiled kernels are loaded.
+- ``kernels`` — the extension module or None.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "enginecore.cpp")
+
+kernels = None
+
+
+def _so_path() -> str:
+    tag = f"cpython-{sys.version_info.major}{sys.version_info.minor}"
+    return os.path.join(_DIR, f"_enginecore.{tag}.so")
+
+
+def _build() -> str | None:
+    so = _so_path()
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+        return so
+    include = sysconfig.get_path("include")
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        f"-I{include}",
+        _SRC,
+        "-o",
+        so + ".tmp",
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+        os.replace(so + ".tmp", so)
+        return so
+    except (subprocess.SubprocessError, OSError):
+        return None
+
+
+def _load():
+    global kernels
+    so = _build()
+    if so is None:
+        return
+    try:
+        spec = importlib.util.spec_from_file_location("_enginecore", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        kernels = mod
+    except Exception:  # noqa: BLE001 — any load failure -> pure Python
+        kernels = None
+
+
+if os.environ.get("PATHWAY_TPU_DISABLE_NATIVE") != "1":
+    _load()
+
+
+def available() -> bool:
+    return kernels is not None

@@ -1,0 +1,312 @@
+/* Native engine-core kernels for the hot per-row paths.
+ *
+ * The reference implements its entire dataflow hot loop natively (Rust,
+ * src/engine/dataflow.rs); here the control plane stays in Python but the
+ * per-row floors — entry construction, consolidation, state-map
+ * application, filter sweeps — run as CPython C++ kernels over the same
+ * object representation (list of (key, row, diff) tuples). Columnar math
+ * lives in engine/device.py (NumPy/XLA); these kernels cover the object
+ * plumbing numpy cannot.
+ *
+ * Built on demand by pathway_tpu/native/__init__.py (g++ -O3); the engine
+ * transparently falls back to the pure-Python implementations when the
+ * toolchain or the .so is unavailable.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+namespace {
+
+/* consolidate(entries) -> (new_entries | None, insert_only)
+ *
+ * None as first element means "already consolidated as-is" (the cheap
+ * precheck passed); insert_only reports unique-key all-positive shape. */
+PyObject *consolidate(PyObject *, PyObject *args) {
+  PyObject *entries;
+  if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &entries)) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+
+  /* Precheck: all diffs > 0 and keys unique. */
+  PyObject *seen = PySet_New(nullptr);
+  if (!seen) return nullptr;
+  bool clean = true;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 3) {
+      clean = false;
+      break;
+    }
+    PyObject *key = PyTuple_GET_ITEM(e, 0);
+    PyObject *diff = PyTuple_GET_ITEM(e, 2);
+    long d = PyLong_AsLong(diff);
+    if (d == -1 && PyErr_Occurred()) {
+      Py_DECREF(seen);
+      return nullptr;
+    }
+    if (d <= 0) {
+      clean = false;
+      break;
+    }
+    int contains = PySet_Contains(seen, key);
+    if (contains < 0) {
+      Py_DECREF(seen);
+      return nullptr;
+    }
+    if (contains) {
+      clean = false;
+      break;
+    }
+    if (PySet_Add(seen, key) < 0) {
+      Py_DECREF(seen);
+      return nullptr;
+    }
+  }
+  Py_DECREF(seen);
+  if (clean) {
+    return Py_BuildValue("(OO)", Py_None, Py_True);
+  }
+
+  /* Full path: merge duplicate (key, row) entries preserving first-seen
+   * order, drop zero diffs. acc maps (key, row) -> [row, diff] — the dict
+   * resolves hash collisions by row equality; unhashable rows fall back to
+   * identity. */
+  PyObject *acc = PyDict_New();
+  PyObject *order = PyList_New(0);
+  if (!acc || !order) {
+    Py_XDECREF(acc);
+    Py_XDECREF(order);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    PyObject *key = PyTuple_GET_ITEM(e, 0);
+    PyObject *row = PyTuple_GET_ITEM(e, 1);
+    PyObject *diff = PyTuple_GET_ITEM(e, 2);
+    Py_hash_t rh = PyObject_Hash(row);
+    PyObject *slot;
+    if (rh == -1) {
+      PyErr_Clear(); /* unhashable row: fall back to identity */
+      slot = Py_BuildValue("(On)", key, (Py_ssize_t)(uintptr_t)row);
+    } else {
+      slot = PyTuple_Pack(2, key, row);
+    }
+    if (!slot) goto fail;
+    {
+      PyObject *found = PyDict_GetItemWithError(acc, slot);
+      if (!found && PyErr_Occurred()) {
+        Py_DECREF(slot);
+        goto fail;
+      }
+      if (!found) {
+        PyObject *pair = PyList_New(2);
+        if (!pair) {
+          Py_DECREF(slot);
+          goto fail;
+        }
+        Py_INCREF(row);
+        PyList_SET_ITEM(pair, 0, row);
+        Py_INCREF(diff);
+        PyList_SET_ITEM(pair, 1, diff);
+        if (PyDict_SetItem(acc, slot, pair) < 0) {
+          Py_DECREF(pair);
+          Py_DECREF(slot);
+          goto fail;
+        }
+        Py_DECREF(pair);
+        if (PyList_Append(order, slot) < 0) {
+          Py_DECREF(slot);
+          goto fail;
+        }
+      } else {
+        PyObject *old = PyList_GET_ITEM(found, 1);
+        PyObject *sum = PyNumber_Add(old, diff);
+        if (!sum) {
+          Py_DECREF(slot);
+          goto fail;
+        }
+        PyList_SetItem(found, 1, sum); /* steals sum */
+      }
+      Py_DECREF(slot);
+    }
+  }
+  {
+    PyObject *out = PyList_New(0);
+    if (!out) goto fail;
+    Py_ssize_t m = PyList_GET_SIZE(order);
+    for (Py_ssize_t i = 0; i < m; i++) {
+      PyObject *slot = PyList_GET_ITEM(order, i);
+      PyObject *pair = PyDict_GetItemWithError(acc, slot);
+      if (!pair) {
+        Py_DECREF(out);
+        goto fail;
+      }
+      PyObject *row = PyList_GET_ITEM(pair, 0);
+      PyObject *diff = PyList_GET_ITEM(pair, 1);
+      long d = PyLong_AsLong(diff);
+      if (d == -1 && PyErr_Occurred()) {
+        Py_DECREF(out);
+        goto fail;
+      }
+      if (d != 0) {
+        PyObject *entry =
+            PyTuple_Pack(3, PyTuple_GET_ITEM(slot, 0), row, diff);
+        if (!entry || PyList_Append(out, entry) < 0) {
+          Py_XDECREF(entry);
+          Py_DECREF(out);
+          goto fail;
+        }
+        Py_DECREF(entry);
+      }
+    }
+    Py_DECREF(acc);
+    Py_DECREF(order);
+    PyObject *res = Py_BuildValue("(NO)", out, Py_False);
+    return res;
+  }
+fail:
+  Py_DECREF(acc);
+  Py_DECREF(order);
+  return nullptr;
+}
+
+/* apply_state(state_dict, entries, insert_only) -> None
+ * Mirrors batch.apply_batch_to_state. */
+PyObject *apply_state(PyObject *, PyObject *args) {
+  PyObject *state, *entries;
+  int insert_only;
+  if (!PyArg_ParseTuple(args, "O!O!p", &PyDict_Type, &state, &PyList_Type,
+                        &entries, &insert_only))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  if (insert_only) {
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *e = PyList_GET_ITEM(entries, i);
+      if (PyDict_SetItem(state, PyTuple_GET_ITEM(e, 0),
+                         PyTuple_GET_ITEM(e, 1)) < 0)
+        return nullptr;
+    }
+    Py_RETURN_NONE;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    long d = PyLong_AsLong(PyTuple_GET_ITEM(e, 2));
+    if (d == -1 && PyErr_Occurred()) return nullptr;
+    if (d < 0) {
+      if (PyDict_DelItem(state, PyTuple_GET_ITEM(e, 0)) < 0) PyErr_Clear();
+    }
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    long d = PyLong_AsLong(PyTuple_GET_ITEM(e, 2));
+    if (d == -1 && PyErr_Occurred()) return nullptr;
+    if (d > 0) {
+      if (PyDict_SetItem(state, PyTuple_GET_ITEM(e, 0),
+                         PyTuple_GET_ITEM(e, 1)) < 0)
+        return nullptr;
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+/* build_entries(entries, columns) -> list
+ * New entries with rows rebuilt from per-column Python lists (the tail of
+ * the columnar expression path): row_i = (columns[0][i], columns[1][i],…),
+ * keys/diffs reused from the input entries. */
+PyObject *build_entries(PyObject *, PyObject *args) {
+  PyObject *entries, *columns;
+  if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &entries, &PyList_Type,
+                        &columns))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  Py_ssize_t ncols = PyList_GET_SIZE(columns);
+  for (Py_ssize_t c = 0; c < ncols; c++) {
+    PyObject *col = PyList_GET_ITEM(columns, c);
+    if (!PyList_Check(col) || PyList_GET_SIZE(col) != n) {
+      PyErr_SetString(PyExc_ValueError, "column length mismatch");
+      return nullptr;
+    }
+  }
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    PyObject *row = PyTuple_New(ncols);
+    if (!row) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    for (Py_ssize_t c = 0; c < ncols; c++) {
+      PyObject *v = PyList_GET_ITEM(PyList_GET_ITEM(columns, c), i);
+      Py_INCREF(v);
+      PyTuple_SET_ITEM(row, c, v);
+    }
+    PyObject *entry =
+        PyTuple_Pack(3, PyTuple_GET_ITEM(e, 0), row, PyTuple_GET_ITEM(e, 2));
+    Py_DECREF(row);
+    if (!entry) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, entry);
+  }
+  return out;
+}
+
+/* filter_truthy(entries, col) -> (list | None)
+ * Keep entries whose row[col] is truthy. Returns None (for Python-side
+ * fallback) if any condition value is not a plain bool — error poisoning
+ * and odd truthiness keep their row-wise semantics. */
+PyObject *filter_truthy(PyObject *, PyObject *args) {
+  PyObject *entries;
+  Py_ssize_t col;
+  if (!PyArg_ParseTuple(args, "O!n", &PyList_Type, &entries, &col))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  PyObject *out = PyList_New(0);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    PyObject *row = PyTuple_GET_ITEM(e, 1);
+    if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) <= col) {
+      Py_DECREF(out);
+      Py_RETURN_NONE;
+    }
+    PyObject *v = PyTuple_GET_ITEM(row, col);
+    if (v == Py_True) {
+      if (PyList_Append(out, e) < 0) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+    } else if (v != Py_False) {
+      Py_DECREF(out);
+      Py_RETURN_NONE; /* non-bool condition: row-wise semantics */
+    }
+  }
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"consolidate", consolidate, METH_VARARGS,
+     "consolidate(entries) -> (entries|None, insert_only)"},
+    {"apply_state", apply_state, METH_VARARGS,
+     "apply_state(state, entries, insert_only)"},
+    {"build_entries", build_entries, METH_VARARGS,
+     "build_entries(entries, columns) -> entries"},
+    {"filter_truthy", filter_truthy, METH_VARARGS,
+     "filter_truthy(entries, col) -> entries|None"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
+                         "_enginecore",
+                         "Native engine-core kernels",
+                         -1,
+                         methods,
+                         nullptr,
+                         nullptr,
+                         nullptr,
+                         nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__enginecore(void) { return PyModule_Create(&moduledef); }
