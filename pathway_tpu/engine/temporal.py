@@ -157,7 +157,11 @@ class ForgetNode(Node):
 
     def process(self, time: int) -> DeltaBatch:
         batch = self.take(0)
-        self.watermark = _watermark_update(self.watermark, batch, self.time_col)
+        # Lateness is judged against the watermark as of the *previous*
+        # commit: entries simultaneous with the watermark-advancing row are
+        # processed using the last recorded time (reference
+        # temporal_behavior.py docstring; ADVICE r1). The watermark advances
+        # after the row loop, before the expiry sweep.
         out = DeltaBatch()
         for key, row, diff in batch:
             threshold = row[self.threshold_col]
@@ -178,6 +182,7 @@ class ForgetNode(Node):
             if threshold is not None and not is_error(threshold):
                 heapq.heappush(self._heap, (threshold, next(self._seq), key))
             self._emit(out, key, row, diff, False)
+        self.watermark = _watermark_update(self.watermark, batch, self.time_col)
         # forget everything whose threshold passed (lazy heap: stale entries
         # for deleted/re-added keys are skipped via the live-row check)
         if self.watermark is not None:

@@ -237,6 +237,9 @@ class _Parser:
 class _Lowerer:
     def __init__(self, tables: dict[str, Table]) -> None:
         self.tables = tables
+        # after a JOIN, alias -> {original column name -> materialized name};
+        # duplicate names across join sides are qualified as f"{alias}_{name}"
+        self.colmap: dict[str, dict[str, str]] = {}
 
     def lower(self, q: dict) -> Table:
         if q["kind"] == "union":
@@ -249,7 +252,35 @@ class _Lowerer:
         if tname is not None:
             if tname not in scope:
                 raise ValueError(f"pw.sql: unknown table {tname!r}")
-            return scope[tname][col]
+            actual = self.colmap.get(tname, {}).get(col, col)
+            t = scope[tname]
+            if actual not in t.column_names():
+                raise ValueError(f"pw.sql: unknown column {tname}.{col}")
+            return t[actual]
+        if self.colmap:
+            # post-join: resolve against per-alias original names so
+            # same-named columns from both sides stay distinguishable;
+            # tables in scope but not yet joined (no colmap entry) also
+            # count as candidate owners
+            owners = [a for a, m in self.colmap.items() if col in m]
+            others = [
+                a
+                for a, t in scope.items()
+                if a != "__joined__"
+                and a not in self.colmap
+                and col in t.column_names()
+            ]
+            if len(owners) + len(others) > 1:
+                raise ValueError(
+                    f"pw.sql: ambiguous column {col!r} "
+                    f"(qualify as one of: "
+                    f"{', '.join(f'{a}.{col}' for a in owners + others)})"
+                )
+            if owners:
+                return scope[owners[0]][self.colmap[owners[0]][col]]
+            if others:
+                return scope[others[0]][col]
+            # fall through: columns introduced after the join (e.g. aux)
         unique = {id(t): t for t in scope.values()}
         matches = [t for t in unique.values() if col in t.column_names()]
         if not matches:
@@ -349,12 +380,18 @@ class _Lowerer:
         if alias:
             return alias
         if isinstance(node, tuple) and node[0] == "col":
-            return node[2]
+            tname, name = node[1], node[2]
+            if tname is not None and name in self.colmap.get(tname, {}):
+                # qualified ref to a join-duplicated column: keep the
+                # qualified output name (e.g. b_val) to avoid collisions
+                return self.colmap[tname][name]
+            return name
         if isinstance(node, tuple) and node[0] == "agg":
             return node[1]
         return f"col_{idx}"
 
     def lower_select(self, q: dict) -> Table:
+        self.colmap = {}  # per-SELECT: a UNION branch must not see the other's joins
         scope: dict[str, Table] = {}
         base = self.tables.get(q["from"])
         if base is None:
@@ -372,13 +409,32 @@ class _Lowerer:
             lcond = self.expr(cond_ast[1], scope)
             rcond = self.expr(cond_ast[2], scope)
             joined = current.join(other, lcond == rcond, how=j["how"])
-            # materialize all columns of both sides for further stages
+            # materialize all columns of both sides for further stages;
+            # duplicate names across sides are qualified f"{alias}_{name}"
+            # so `SELECT a.val, b.val` returns both (first alias keeps the
+            # bare name; unqualified refs to a duplicate raise 'ambiguous')
             cols: dict[str, Any] = {}
-            for t in scope.values():
-                for name in t.column_names():
-                    if name not in cols:
-                        cols[name] = t[name]
+            newmap: dict[str, dict[str, str]] = {}
+            for alias, t in scope.items():
+                if alias == "__joined__":
+                    continue
+                visible = self.colmap.get(
+                    alias, {n: n for n in t.column_names()}
+                )
+                amap: dict[str, str] = {}
+                for name, actual in visible.items():
+                    target = name
+                    if target in cols:
+                        target = f"{alias}_{name}"
+                        k = 2
+                        while target in cols:
+                            target = f"{alias}_{name}_{k}"
+                            k += 1
+                    cols[target] = t[actual]
+                    amap[name] = target
+                newmap[alias] = amap
             current = joined.select(**cols)
+            self.colmap = newmap
             scope = {name: current for name in scope}
             scope["__joined__"] = current
         if q["where"] is not None:

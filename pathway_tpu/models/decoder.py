@@ -120,11 +120,16 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 class KVCache(NamedTuple):
-    """Static-shape per-layer cache ``[b, max_len, kv_heads, head_dim]``."""
+    """Static-shape per-layer cache ``[b, max_len, kv_heads, head_dim]``.
+
+    ``valid`` marks usable slots: left-pad positions of shorter prompts in a
+    batch stay False forever, so generated tokens never attend to pads.
+    """
 
     k: list
     v: list
     length: jax.Array  # [] int32 — filled prefix
+    valid: jax.Array  # [b, max_len] bool — non-pad filled slots
 
 
 def init_cache(cfg: DecoderConfig, batch: int, max_len: int) -> KVCache:
@@ -133,6 +138,7 @@ def init_cache(cfg: DecoderConfig, batch: int, max_len: int) -> KVCache:
         k=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.layers)],
         v=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.layers)],
         length=jnp.zeros((), jnp.int32),
+        valid=jnp.zeros((batch, max_len), bool),
     )
 
 
@@ -159,19 +165,36 @@ def decoder_forward(
     token_ids: jax.Array,  # [b, t]
     cfg: DecoderConfig,
     cache: KVCache | None = None,
+    *,
+    attn_mask: jax.Array | None = None,  # [b, t] True = real (non-pad) token
+    pos_offset: jax.Array | None = None,  # [b] per-row left-pad count
 ) -> tuple[jax.Array, KVCache | None]:
     """Logits ``[b, t, vocab]``; appends to ``cache`` when given.
 
     Without a cache this is plain causal training/scoring forward. With a
     cache, ``token_ids`` is the next chunk (often t=1) starting at
-    ``cache.length``.
+    ``cache.length``. Left-padded batches pass ``attn_mask`` (False on pads,
+    which are excluded from attention forever) and ``pos_offset`` (pad count
+    per row, subtracted from RoPE positions so token 0 of every prompt sits
+    at rotary position 0).
     """
     b, t = token_ids.shape
     x = params["tok_emb"][token_ids].astype(cfg.dtype)
     start = cache.length if cache is not None else jnp.zeros((), jnp.int32)
-    q_pos = start + jnp.arange(t)[None, :].astype(jnp.int32)
-    q_pos = jnp.broadcast_to(q_pos, (b, t))
+    # slot index (causal order) vs rotary position (logical, pad-corrected)
+    q_slot = start + jnp.arange(t)[None, :].astype(jnp.int32)
+    q_slot = jnp.broadcast_to(q_slot, (b, t))
+    if pos_offset is not None:
+        q_pos = jnp.maximum(q_slot - pos_offset[:, None].astype(jnp.int32), 0)
+    else:
+        q_pos = q_slot
     new_k, new_v = [], []
+    valid_full = None
+    if cache is not None:
+        chunk_valid = (
+            attn_mask if attn_mask is not None else jnp.ones((b, t), bool)
+        )
+        valid_full = cache.valid.at[:, start + jnp.arange(t)].set(chunk_valid)
     for i, lp in enumerate(params["layers"]):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q = (h @ lp["q_w"].astype(cfg.dtype)).reshape(
@@ -190,13 +213,14 @@ def decoder_forward(
             v_full = cache.v[i].at[:, idx].set(v)
             new_k.append(k_full)
             new_v.append(v_full)
-            s = k_full.shape[1]
-            k_valid = jnp.arange(s)[None, :] < (start + t)
-            k_valid = jnp.broadcast_to(k_valid, (b, s))
-            a = _attend(q, k_full, v_full, q_pos, k_valid, cfg)
+            a = _attend(q, k_full, v_full, q_slot, valid_full, cfg)
         else:
-            k_valid = jnp.ones((b, t), bool)
-            a = _attend(q, k, v, q_pos, k_valid, cfg)
+            k_valid = (
+                attn_mask
+                if attn_mask is not None
+                else jnp.ones((b, t), bool)
+            )
+            a = _attend(q, k, v, q_slot, k_valid, cfg)
         x = x + (a @ lp["o_w"].astype(cfg.dtype))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         gate_up = h @ lp["gate_w"].astype(cfg.dtype)
@@ -205,7 +229,7 @@ def decoder_forward(
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
     if cache is not None:
-        cache = KVCache(k=new_k, v=new_v, length=start + t)
+        cache = KVCache(k=new_k, v=new_v, length=start + t, valid=valid_full)
     return logits, cache
 
 
@@ -215,21 +239,39 @@ def greedy_generate(
     cfg: DecoderConfig,
     max_new_tokens: int,
     eos_id: int | None = None,
+    prompt_mask: jax.Array | None = None,  # [b, t_prompt] True = real token
 ) -> jax.Array:
     """Greedy decode with a static-shape cache; returns ``[b, max_new]``.
 
-    Tokens after EOS are padded with ``eos_id``.
+    ``prompt_mask`` handles left-padded batches of unequal-length prompts:
+    pad slots are never attended to and RoPE positions are shifted so every
+    prompt starts at rotary position 0 (ADVICE r1). Tokens after EOS are
+    padded with ``eos_id``.
     """
     b, t_prompt = prompt_ids.shape
     max_len = t_prompt + max_new_tokens
     cache = init_cache(cfg, b, max_len)
-    logits, cache = decoder_forward(params, prompt_ids, cfg, cache)
+    if prompt_mask is not None:
+        # left-padding: pad count = leading False run = t_prompt - true count
+        pos_offset = t_prompt - prompt_mask.sum(axis=1).astype(jnp.int32)
+    else:
+        pos_offset = jnp.zeros((b,), jnp.int32)
+    logits, cache = decoder_forward(
+        params,
+        prompt_ids,
+        cfg,
+        cache,
+        attn_mask=prompt_mask,
+        pos_offset=pos_offset,
+    )
     next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
     done = jnp.zeros((b,), bool)
 
     def step(carry, _):
         cache, tok, done = carry
-        logits, cache = decoder_forward(params, tok[:, None], cfg, cache)
+        logits, cache = decoder_forward(
+            params, tok[:, None], cfg, cache, pos_offset=pos_offset
+        )
         new_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         if eos_id is not None:
             done = done | (tok == eos_id)

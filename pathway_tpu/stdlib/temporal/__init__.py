@@ -29,10 +29,11 @@ from pathway_tpu.internals.desugaring import resolve_this
 
 @dataclasses.dataclass(frozen=True)
 class CommonBehavior:
-    """delay: emit window results only once its end is `delay` old;
-    cutoff: forget windows whose end passed watermark - cutoff;
-    keep_results: whether forgotten windows keep their final output
-    (reference temporal_behavior.py:21)."""
+    """delay: emit window results only once the watermark passes
+    window *start* + delay (reference anchors initial output at the
+    beginning of the window, _window.py:396); cutoff: forget windows
+    whose end passed watermark - cutoff; keep_results: whether forgotten
+    windows keep their final output (reference temporal_behavior.py:21)."""
 
     delay: Any = None
     cutoff: Any = None
@@ -45,14 +46,17 @@ def common_behavior(
     return CommonBehavior(delay, cutoff, keep_results)
 
 
-def exactly_once_behavior(shift: Any = None) -> CommonBehavior:
-    """Each window emitted exactly once, then frozen
-    (reference temporal_behavior.py:79)."""
-    shift = shift if shift is not None else 0
-    return CommonBehavior(delay=shift, cutoff=shift, keep_results=True)
+@dataclasses.dataclass(frozen=True)
+class ExactlyOnceBehavior:
+    """Each window emitted exactly once, then frozen. Lowered per-window to
+    ``CommonBehavior(delay=duration + shift, cutoff=shift)`` at materialize
+    time (reference temporal_behavior.py:79, _window.py:371-387)."""
+
+    shift: Any = None
 
 
-ExactlyOnceBehavior = exactly_once_behavior
+def exactly_once_behavior(shift: Any = None) -> ExactlyOnceBehavior:
+    return ExactlyOnceBehavior(shift)
 
 
 # -- windows -----------------------------------------------------------------
@@ -169,18 +173,41 @@ class WindowedTable:
             _pw_window_end=flat["_pw_windows"].get(1),
         )
 
+    def _lowered_behavior(self) -> CommonBehavior | None:
+        """ExactlyOnce → CommonBehavior(duration + shift, shift, True), as
+        the reference does per-window (_window.py:371-387)."""
+        b = self.behavior
+        if not isinstance(b, ExactlyOnceBehavior):
+            return b
+        if isinstance(self.window, TumblingWindow):
+            duration = self.window.duration
+        elif isinstance(self.window, SlidingWindow):
+            duration = self.window.duration
+        else:
+            raise ValueError(
+                "exactly_once_behavior is unsupported for session windows"
+            )
+        shift = b.shift if b.shift is not None else 0
+        return CommonBehavior(
+            delay=duration + shift, cutoff=shift, keep_results=True
+        )
+
     def _behaved(self, assigned: Table) -> Table:
-        if self.behavior is None:
+        behavior = self._lowered_behavior()
+        if behavior is None:
             return assigned
         cols = assigned.column_names()
         time_col = cols.index("_pw_time")
         out = assigned
-        if self.behavior.delay is not None:
-            delay = self.behavior.delay
+        if behavior.delay is not None:
+            # anchored at window *start* (reference _window.py:396-398:
+            # "delays initial output ... with respect to the beginning of
+            # the window")
+            delay = behavior.delay
             out = out.select(
                 **{n: out[n] for n in cols},
                 _pw_threshold=pw_apply(
-                    lambda e: e + delay, out["_pw_window_end"]
+                    lambda s: s + delay, out["_pw_window_start"]
                 ),
             )
             out = out._derived(
@@ -194,15 +221,15 @@ class WindowedTable:
                 ),
                 {n: out._dtypes[n] for n in out.column_names()},
             )[cols]
-        if self.behavior.cutoff is not None:
-            cutoff = self.behavior.cutoff
+        if behavior.cutoff is not None:
+            cutoff = behavior.cutoff
             out = out.select(
                 **{n: out[n] for n in cols},
                 _pw_threshold=pw_apply(
                     lambda e: e + cutoff, out["_pw_window_end"]
                 ),
             )
-            kind = "forget" if not self.behavior.keep_results else "freeze"
+            kind = "forget" if not behavior.keep_results else "freeze"
             out = out._derived(
                 TableSpec(
                     kind,

@@ -40,6 +40,7 @@ class DocumentStore:
     ) -> None:
         if isinstance(docs, Table):
             docs = [docs]
+        self.metric = metric
         self.parser = parser or ParseUtf8()
         self.splitter = splitter or NullSplitter()
         self.embedder = embedder
@@ -132,9 +133,17 @@ class DocumentStore:
             number_of_matches=prepped.k,
         )
 
+        # Map higher-is-better scores to the reference's distance scale per
+        # metric (ADVICE r1): cos similarity -> 1 - sim in [0, 2]; l2sq score
+        # is -distance² -> distance² = -score; dot/bm25 -> -score.
+        if self._query_is_vector and self.metric == "cos":
+            to_dist = lambda s: 1.0 - float(s)  # noqa: E731
+        else:
+            to_dist = lambda s: -float(s)  # noqa: E731
+
         def to_result(texts: tuple, metas: tuple, scores: tuple) -> tuple:
             return tuple(
-                {"text": t, "metadata": dict(m or {}), "dist": -float(s)}
+                {"text": t, "metadata": dict(m or {}), "dist": to_dist(s)}
                 for t, m, s in zip(texts, metas, scores)
             )
 

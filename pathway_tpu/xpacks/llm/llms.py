@@ -71,10 +71,17 @@ class TpuPipelineChat(UDF):
             ]
             t_max = max(len(e) for e in encoded)
             ids = np.zeros((len(texts), t_max), np.int32)
+            mask = np.zeros((len(texts), t_max), bool)
             for i, e in enumerate(encoded):
                 ids[i, t_max - len(e) :] = e  # left-pad: generation is at end
+                mask[i, t_max - len(e) :] = True
             toks = greedy_generate(
-                params, jnp.asarray(ids), cfg, max_new_tokens=mnt, eos_id=2
+                params,
+                jnp.asarray(ids),
+                cfg,
+                max_new_tokens=mnt,
+                eos_id=2,
+                prompt_mask=jnp.asarray(mask),
             )
             toks = np.asarray(toks)
             return [self.tokenizer.decode(list(row)) for row in toks]

@@ -122,3 +122,22 @@ def test_contrastive_train_step_dp_tp_sp():
     assert int(state.step) == 3
     assert losses[2] < losses[0]  # optimizing in-batch classification
     assert np.isfinite(losses).all()
+
+
+def test_greedy_generate_left_pad_invariance():
+    # ADVICE r1: a short prompt in a left-padded batch must generate the
+    # same tokens as the same prompt alone (pads masked, RoPE re-based).
+    import jax.numpy as jnp
+
+    cfg = tiny_decoder()
+    params = init_decoder_params(jax.random.key(3), cfg)
+    short = jnp.asarray([[5, 6, 7]], jnp.int32)
+    alone = greedy_generate(params, short, cfg, max_new_tokens=4)
+    padded = jnp.asarray([[0, 0, 0, 5, 6, 7], [9, 8, 7, 6, 5, 4]], jnp.int32)
+    mask = jnp.asarray(
+        [[False, False, False, True, True, True]] + [[True] * 6], bool
+    )
+    batched = greedy_generate(
+        params, padded, cfg, max_new_tokens=4, prompt_mask=mask
+    )
+    assert jnp.array_equal(batched[0], alone[0])

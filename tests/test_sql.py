@@ -78,3 +78,30 @@ def test_arith_and_aliases():
     t = people()
     res = pw.sql("SELECT name, age * 2 - 10 AS x FROM t WHERE name = 'bob'", t=t)
     assert rows_of(res) == [("bob", 40)]
+
+
+def test_join_duplicate_columns_qualified():
+    # ADVICE r1: same-named columns from both join sides must stay
+    # distinguishable, not silently collapse to the left side's value.
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, val=int), [("x", 1), ("y", 2)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, val=int), [("x", 10), ("y", 20)]
+    )
+    res = pw.sql("SELECT a.val, b.val FROM a JOIN b ON a.k = b.k", a=a, b=b)
+    assert set(res.column_names()) == {"val", "b_val"}
+    assert rows_of(res) == [(1, 10), (2, 20)]
+
+
+def test_join_duplicate_columns_unqualified_ambiguous():
+    import pytest
+
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, val=int), [("x", 1)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(k=str, val=int), [("x", 10)]
+    )
+    with pytest.raises(ValueError, match="ambiguous"):
+        pw.sql("SELECT val FROM a JOIN b ON a.k = b.k", a=a, b=b)
