@@ -31,21 +31,36 @@ from pathway_tpu.engine.value import Json, Pointer, hash_values, ref_scalar
 
 INSERT = "insert"
 DELETE = "delete"
+UPSERT = "upsert"
 
 
 class ParsedEvent:
-    __slots__ = ("kind", "values")
+    """``key`` is an optional tuple of key values (CDC streams carry the row
+    identity explicitly); ``values`` may be None for an upsert deletion
+    (reference ParsedEvent Insert/Delete/Upsert, data_format.rs:175)."""
 
-    def __init__(self, kind: str, values: tuple) -> None:
+    __slots__ = ("kind", "values", "key")
+
+    def __init__(
+        self, kind: str, values: tuple | None, key: tuple | None = None
+    ) -> None:
         self.kind = kind
         self.values = values
+        self.key = key
 
 
 # -- parsers ----------------------------------------------------------------
 
 
 class Parser:
-    """payload (str/bytes) → list of ParsedEvent with values in schema order."""
+    """payload (str/bytes) → list of ParsedEvent with values in schema order.
+
+    ``session_type`` mirrors the reference's Parser::session_type
+    (data_format.rs:262): "native" feeds insert/remove diffs, "upsert"
+    feeds an overlay session keyed by the event key.
+    """
+
+    session_type = "native"
 
     def __init__(self, column_names: Sequence[str]) -> None:
         self.column_names = list(column_names)
@@ -320,10 +335,23 @@ class InputDriver:
             new_rows: list[tuple[Pointer, tuple]] = []
             for i, event in enumerate(events):
                 values = event.values
-                if self.append_metadata:
+                if values is not None and self.append_metadata:
                     values = values + (Json(dict(metadata)),)
-                key = self._key_for(values, source_id, i)
-                if event.kind == INSERT:
+                if event.key is not None:
+                    key = ref_scalar(*event.key)
+                elif values is not None:
+                    key = self._key_for(values, source_id, i)
+                else:
+                    raise ValueError(
+                        "connector event without values needs an explicit key"
+                    )
+                if event.kind == UPSERT:
+                    # upsert session: insert overlays, None deletes by key
+                    if values is None:
+                        self.session.remove(key)
+                    else:
+                        self.session.insert(key, values)
+                elif event.kind == INSERT:
                     self.session.insert(key, values)
                     new_rows.append((key, values))
                 else:

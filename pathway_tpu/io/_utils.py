@@ -56,11 +56,15 @@ def input_table(
     pk_indices = [column_names.index(p) for p in pk] if pk else None
 
     def attach(scope: Scope):
-        session = scope.input_session(len(all_names))
+        parser = make_parser(column_names)
+        session = scope.input_session(
+            len(all_names),
+            upsert=getattr(parser, "session_type", "native") == "upsert",
+        )
         driver = InputDriver(
             session,
             make_reader(),
-            make_parser(column_names),
+            parser,
             primary_key_indices=pk_indices,
             source_name=source_name,
             append_metadata=with_metadata,
@@ -79,3 +83,40 @@ def assert_schema_or_value_columns(schema: Any) -> schema_mod.SchemaMetaclass:
     if schema is None:
         raise ValueError("schema= is required for this connector")
     return schema
+
+
+def attach_writer(
+    table: Table, make_writer: Callable[[Sequence[str]], Any]
+) -> None:
+    """Wire a writer (on_change/on_time_end/on_end) as a sink of ``table``."""
+    from pathway_tpu.internals.parse_graph import G
+
+    column_names = table.column_names()
+
+    def attach(scope: Scope, node: Any):
+        writer = make_writer(column_names)
+        scope.subscribe_table(
+            node,
+            on_change=writer.on_change,
+            on_time_end=writer.on_time_end,
+            on_end=writer.on_end,
+        )
+        return None
+
+    G.add_sink(table, attach)
+
+
+def require(module_names: str, feature: str, injected: Any = None) -> Any:
+    """Gate a connector on its client library unless a client is injected."""
+    if injected is not None:
+        return injected
+    import importlib
+
+    try:
+        return importlib.import_module(module_names)
+    except ImportError as e:
+        raise ImportError(
+            f"{feature} needs the {module_names!r} client library, which is "
+            f"not installed; pass an explicit client/transport object to run "
+            f"without it"
+        ) from e

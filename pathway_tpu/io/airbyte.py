@@ -1,0 +1,41 @@
+"""pw.io.airbyte — run Airbyte source connectors (reference:
+python/pathway/io/airbyte/__init__.py:107 — executes connector images via
+Docker or Cloud Run). Requires Docker, which this image cannot assume; the
+entry point is kept and gated. A pre-captured Airbyte stream (list of
+record dicts) can be replayed through ``read_records``."""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+
+
+def read(
+    config_file_path: str,
+    streams: Sequence[str],
+    *,
+    mode: str = "streaming",
+    execution_type: str = "local",
+    **kwargs: Any,
+) -> Table:
+    raise NotImplementedError(
+        "pw.io.airbyte runs connector docker images (reference "
+        "io/airbyte/__init__.py:107); no docker runtime is available here. "
+        "Replay captured records with pw.io.airbyte.read_records."
+    )
+
+
+def read_records(records: Iterable[dict], stream: str = "stream") -> Table:
+    """Replay a captured Airbyte record stream (each record a dict with the
+    stream's fields) as a static table."""
+    import pathway_tpu as pw
+
+    records = [r for r in records]
+    if not records:
+        raise ValueError("no records")
+    names = sorted({k for r in records for k in r})
+    schema = schema_mod.schema_from_types(**{n: Any for n in names})
+    rows = [tuple(r.get(n) for n in names) for r in records]
+    return pw.debug.table_from_rows(schema, rows)

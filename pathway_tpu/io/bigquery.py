@@ -1,0 +1,62 @@
+"""pw.io.bigquery — write via the streaming insert API (reference:
+python/pathway/io/bigquery/__init__.py). Client seam:
+``insert_rows_json(table_id, [rows])``; google-cloud-bigquery adapts
+directly, tests inject a recorder."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.formats import DocumentFormatter
+from pathway_tpu.engine.value import Pointer
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import attach_writer, require
+
+
+class _BigQueryWriter:
+    def __init__(self, client: Any, table_id: str, formatter: DocumentFormatter):
+        self.client = client
+        self.table_id = table_id
+        self.formatter = formatter
+        self._batch: list[dict] = []
+
+    def on_change(self, key: Pointer, values: tuple, time: int, diff: int) -> None:
+        self._batch.append(self.formatter.format(key, values, time, diff))
+
+    def on_time_end(self, time: int) -> None:
+        if self._batch:
+            self.client.insert_rows_json(self.table_id, self._batch)
+            self._batch = []
+
+    def on_end(self) -> None:
+        self.on_time_end(-1)
+
+
+def write(
+    table: Table,
+    dataset_name: str | None = None,
+    table_name: str | None = None,
+    service_user_credentials_file: str | None = None,
+    *,
+    client: Any = None,
+    **kwargs: Any,
+) -> None:
+    if client is None:
+        bq = require("google.cloud.bigquery", "pw.io.bigquery")
+        creds_client = bq.Client.from_service_account_json(
+            service_user_credentials_file
+        )
+
+        class _Adapter:
+            def insert_rows_json(self, table_id: str, rows: list) -> None:
+                errors = creds_client.insert_rows_json(table_id, rows)
+                if errors:
+                    raise RuntimeError(f"bigquery insert errors: {errors}")
+
+        client = _Adapter()
+    table_id = f"{dataset_name}.{table_name}"
+
+    def make_writer(column_names):
+        return _BigQueryWriter(client, table_id, DocumentFormatter(column_names))
+
+    attach_writer(table, make_writer)

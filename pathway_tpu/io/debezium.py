@@ -1,0 +1,43 @@
+"""pw.io.debezium — CDC change streams in Debezium envelope format
+(reference: python/pathway/io/debezium/__init__.py:20; DebeziumMessageParser
+src/connectors/data_format.rs:1053)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from pathway_tpu.engine.formats import DebeziumParser
+from pathway_tpu.engine.storage import MessageQueueReader
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import input_table
+
+
+def read(
+    rdkafka_settings: dict | None = None,
+    topic_name: str | None = None,
+    *,
+    schema: schema_mod.SchemaMetaclass,
+    db_type: str = "postgres",
+    transport: Any = None,
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Consume a Debezium CDC topic. ``db_type``: 'postgres' (full
+    before/after images -> native diffs) or 'mongodb' (after images only ->
+    upsert stream). Keys come from the message-key payload using the
+    schema's primary key columns."""
+    if transport is None:
+        from pathway_tpu.io.kafka import _default_transport
+
+        transport = _default_transport(rdkafka_settings or {}, topic_name)
+    pk: Sequence[str] | None = schema.primary_key_columns() or None
+
+    return input_table(
+        schema,
+        lambda: MessageQueueReader(transport),
+        lambda names: DebeziumParser(names, key_field_names=pk, db_type=db_type),
+        source_name=f"debezium:{topic_name}",
+        persistent_id=persistent_id,
+    )

@@ -1,0 +1,77 @@
+"""pw.io.elasticsearch — index update streams into Elasticsearch
+(reference: python/pathway/io/elasticsearch/__init__.py:52;
+ElasticSearchWriter src/connectors/data_storage.rs:1317)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.formats import DocumentFormatter
+from pathway_tpu.engine.storage import ElasticsearchWriter
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import attach_writer, require
+
+
+class ElasticSearchAuth:
+    """Auth config holder (reference ElasticSearchAuth: basic/bearer/apikey)."""
+
+    def __init__(self, kind: str, **params: Any) -> None:
+        self.kind = kind
+        self.params = params
+
+    @classmethod
+    def basic(cls, username: str, password: str) -> "ElasticSearchAuth":
+        return cls("basic", username=username, password=password)
+
+    @classmethod
+    def bearer(cls, token: str) -> "ElasticSearchAuth":
+        return cls("bearer", token=token)
+
+    @classmethod
+    def apikey(cls, apikey_id: str, apikey: str) -> "ElasticSearchAuth":
+        return cls("apikey", apikey_id=apikey_id, apikey=apikey)
+
+
+def write(
+    table: Table,
+    host: str | None = None,
+    auth: ElasticSearchAuth | None = None,
+    index_name: str | None = None,
+    *,
+    client: Any = None,
+    **kwargs: Any,
+) -> None:
+    """Index one document (row + time + diff) per change. ``client`` needs
+    ``index(index_name, document)``; elasticsearch-py adapts directly."""
+    if client is None:
+        es_mod = require("elasticsearch", "pw.io.elasticsearch")
+        es_kwargs: dict[str, Any] = {}
+        if auth is not None:
+            if auth.kind == "basic":
+                es_kwargs["basic_auth"] = (
+                    auth.params["username"],
+                    auth.params["password"],
+                )
+            elif auth.kind == "bearer":
+                es_kwargs["bearer_auth"] = auth.params["token"]
+            elif auth.kind == "apikey":
+                es_kwargs["api_key"] = (
+                    auth.params["apikey_id"],
+                    auth.params["apikey"],
+                )
+            else:
+                raise ValueError(f"unknown auth kind {auth.kind!r}")
+        es = es_mod.Elasticsearch(host, **es_kwargs)
+
+        class _Adapter:
+            def index(self, index_name: str, document: dict) -> None:
+                es.index(index=index_name, document=document)
+
+        client = _Adapter()
+
+    def make_writer(column_names):
+        return ElasticsearchWriter(
+            client, index_name, DocumentFormatter(column_names)
+        )
+
+    attach_writer(table, make_writer)

@@ -200,3 +200,153 @@ def _jsonable(value: Any) -> Any:
     if isinstance(value, Pointer):
         return str(value)
     return value
+
+
+# -- generic HTTP reader / writer --------------------------------------------
+
+
+class RetryPolicy:
+    """Retry delays for the HTTP writer (reference io/http RetryPolicy)."""
+
+    def __init__(self, first_delay_ms: int = 1000, backoff_factor: float = 2.0):
+        self.first_delay_ms = first_delay_ms
+        self.backoff_factor = backoff_factor
+
+    @classmethod
+    def default(cls) -> "RetryPolicy":
+        return cls()
+
+
+class _HttpWriter:
+    """POST one flat-JSON object (row + time + diff) per change (reference
+    io/http/__init__.py:158 write). ``request_fn(url, payload_dict)`` is
+    injectable; the default uses `requests`."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        column_names: Sequence[str],
+        request_fn: Callable[[str, dict], Any] | None,
+        n_retries: int,
+        retry_policy: RetryPolicy,
+    ) -> None:
+        self.endpoint = endpoint
+        self.column_names = list(column_names)
+        if request_fn is None:
+            import requests
+
+            request_fn = lambda url, payload: requests.post(  # noqa: E731
+                url, json=payload, timeout=30
+            ).raise_for_status()
+        self.request_fn = request_fn
+        self.n_retries = n_retries
+        self.retry_policy = retry_policy
+
+    def on_change(self, key: Pointer, values: tuple, time: int, diff: int) -> None:
+        import time as _time
+
+        payload = {}
+        for name, v in zip(self.column_names, values):
+            payload[name] = v.value if isinstance(v, Json) else v
+        payload["time"] = time
+        payload["diff"] = diff
+        delay = self.retry_policy.first_delay_ms / 1000.0
+        for attempt in range(self.n_retries + 1):
+            try:
+                self.request_fn(self.endpoint, payload)
+                return
+            except Exception:
+                if attempt == self.n_retries:
+                    raise
+                _time.sleep(delay)
+                delay *= self.retry_policy.backoff_factor
+
+    def on_time_end(self, time: int) -> None:
+        pass
+
+    def on_end(self) -> None:
+        pass
+
+
+def write(
+    table: Table,
+    url: str,
+    *,
+    n_retries: int = 0,
+    retry_policy: RetryPolicy | None = None,
+    request_fn: Callable[[str, dict], Any] | None = None,
+    **kwargs: Any,
+) -> None:
+    from pathway_tpu.io._utils import attach_writer
+
+    policy = retry_policy or RetryPolicy.default()
+
+    def make_writer(column_names):
+        return _HttpWriter(url, column_names, request_fn, n_retries, policy)
+
+    attach_writer(table, make_writer)
+
+
+def read(
+    url: str,
+    *,
+    schema: schema_mod.SchemaMetaclass | None = None,
+    format: str = "json",  # noqa: A002
+    poll_interval_ms: int = 1000,
+    request_fn: Callable[[str], Any] | None = None,
+    n_retries: int = 0,
+    **kwargs: Any,
+) -> Table:
+    """Poll ``url`` and parse each response body as JSON lines / plaintext
+    (reference io/http/__init__.py read: polling streaming reader).
+    ``request_fn(url) -> str`` is injectable for offline use."""
+    import time as _time
+
+    from pathway_tpu.engine.connectors import JsonLinesParser, IdentityParser, Reader
+
+    if request_fn is None:
+        def request_fn(u):  # pragma: no cover - needs network
+            import requests
+
+            resp = requests.get(u, timeout=30)
+            resp.raise_for_status()
+            return resp.text
+
+    if format == "plaintext":
+        schema = schema_mod.schema_from_types(data=str)
+    if schema is None:
+        raise ValueError("schema= is required for json format")
+
+    class _HttpPollReader(Reader):
+        def __init__(self) -> None:
+            self._last_poll = 0.0
+            self._seq = 0
+
+        def poll(self):
+            now = _time.monotonic()
+            if now - self._last_poll < poll_interval_ms / 1000.0 and self._seq:
+                return [], False
+            self._last_poll = now
+            delay = 0.5
+            for attempt in range(n_retries + 1):
+                try:
+                    body = request_fn(url)
+                    break
+                except Exception:
+                    if attempt == n_retries:
+                        raise
+                    _time.sleep(delay)
+                    delay *= 2
+            self._seq += 1
+            if not body:
+                return [], False
+            return [(body, f"http:{self._seq}", {})], False
+
+    make_parser = (
+        (lambda names: JsonLinesParser(names))
+        if format == "json"
+        else (lambda names: IdentityParser(split_lines=True))
+    )
+    return input_table(
+        schema, _HttpPollReader, make_parser, source_name=f"http:{url}"
+    )

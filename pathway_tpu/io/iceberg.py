@@ -1,0 +1,36 @@
+"""pw.io.iceberg — Apache Iceberg connector (reference:
+python/pathway/io/iceberg/__init__.py; src/connectors/data_lake/iceberg.rs
+— REST catalog + iceberg-rust). Requires a live REST catalog service, which
+this image cannot reach; the API surface is kept and gated. Local lakehouse
+workflows are served by pw.io.deltalake, which is fully implemented."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import require
+
+
+def read(
+    catalog_uri: str,
+    namespace: list[str],
+    table_name: str,
+    schema: Any = None,
+    *,
+    mode: str = "streaming",
+    **kwargs: Any,
+) -> Table:
+    require("pyiceberg", "pw.io.iceberg")
+    raise NotImplementedError("iceberg needs a reachable REST catalog")
+
+
+def write(
+    table: Table,
+    catalog_uri: str,
+    namespace: list[str],
+    table_name: str,
+    **kwargs: Any,
+) -> None:
+    require("pyiceberg", "pw.io.iceberg")
+    raise NotImplementedError("iceberg needs a reachable REST catalog")

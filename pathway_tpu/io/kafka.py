@@ -1,0 +1,191 @@
+"""pw.io.kafka — Kafka-shaped message-queue connector
+(reference: python/pathway/io/kafka/__init__.py; KafkaReader
+src/connectors/data_storage.rs:673, KafkaWriter :1239).
+
+No Kafka client library ships in this image, so the broker is reached
+through an injectable **transport** (``MessageTransport``: poll_messages /
+finished / produce). ``transport=None`` tries confluent-kafka and raises a
+clear error when absent; tests and demos inject
+:class:`pathway_tpu.engine.storage.InMemoryTransport`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from pathway_tpu.engine.connectors import (
+    INSERT,
+    UPSERT,
+    JsonLinesFormatter,
+    Parser,
+    ParsedEvent,
+)
+from pathway_tpu.engine.storage import (
+    InMemoryTransport,
+    MessageQueueReader,
+    MessageQueueWriter,
+)
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import attach_writer, input_table
+
+__all__ = ["read", "write", "simple_read", "InMemoryTransport"]
+
+
+class _KafkaJsonParser(Parser):
+    """value bytes -> JSON object -> schema columns; keyed by primary key
+    columns when given (upsert session, like the reference's Kafka+json
+    upsert path), else plain inserts."""
+
+    def __init__(
+        self, column_names: Sequence[str], primary_key: Sequence[str] | None
+    ) -> None:
+        super().__init__(column_names)
+        self.primary_key = list(primary_key) if primary_key else None
+        self.session_type = "upsert" if self.primary_key else "native"
+
+    def parse(self, payload: Any) -> list[ParsedEvent]:
+        import json
+
+        from pathway_tpu.engine.value import Json
+
+        msg_key, value = payload
+        if value is None:
+            # compacted-topic tombstone: with a primary key it deletes the
+            # row whose key matches the message key (JSON-decoded when
+            # possible, raw string otherwise)
+            if not self.primary_key or msg_key is None:
+                return []
+            if isinstance(msg_key, bytes):
+                msg_key = msg_key.decode("utf-8")
+            try:
+                decoded = json.loads(msg_key)
+            except (ValueError, TypeError):
+                decoded = msg_key
+            if isinstance(decoded, dict):
+                key = tuple(decoded.get(k) for k in self.primary_key)
+            elif len(self.primary_key) == 1:
+                key = (decoded,)
+            else:
+                raise ValueError(
+                    "tombstone key must be a JSON object for a composite "
+                    "primary key"
+                )
+            return [ParsedEvent(UPSERT, None, key=key)]
+        if isinstance(value, bytes):
+            value = value.decode("utf-8")
+        obj = json.loads(value)
+        values = tuple(
+            Json(v) if isinstance(v, (dict, list)) else v
+            for v in (obj.get(name) for name in self.column_names)
+        )
+        if self.primary_key:
+            key = tuple(obj.get(k) for k in self.primary_key)
+            return [ParsedEvent(UPSERT, values, key=key)]
+        return [ParsedEvent(INSERT, values)]
+
+
+class _KafkaRawParser(Parser):
+    """value bytes -> single `data` column (format='raw'/'plaintext')."""
+
+    def __init__(self, binary: bool) -> None:
+        super().__init__(["data"])
+        self.binary = binary
+
+    def parse(self, payload: Any) -> list[ParsedEvent]:
+        _key, value = payload
+        if value is None:
+            return []
+        if self.binary and isinstance(value, str):
+            value = value.encode("utf-8")
+        if not self.binary and isinstance(value, bytes):
+            value = value.decode("utf-8")
+        return [ParsedEvent(INSERT, (value,))]
+
+
+def _default_transport(rdkafka_settings: dict, topic: str, **kwargs: Any):
+    try:
+        import confluent_kafka  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pw.io.kafka needs confluent-kafka (not installed here); pass "
+            "transport=<MessageTransport> to read without it"
+        ) from e
+    raise NotImplementedError(
+        "confluent-kafka transport wiring requires a live broker"
+    )
+
+
+def read(
+    rdkafka_settings: dict | None = None,
+    topic: str | list[str] | None = None,
+    *,
+    schema: schema_mod.SchemaMetaclass | None = None,
+    format: str = "raw",  # noqa: A002
+    autocommit_duration_ms: int | None = 1500,
+    primary_key: Sequence[str] | None = None,
+    transport: Any = None,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Read a topic. ``format``: 'raw'/'plaintext' (single ``data``
+    column), or 'json' (schema columns; with ``primary_key`` the stream is
+    an upsert stream — later messages for a key replace earlier ones,
+    reference SessionType::Upsert adaptors.rs:48)."""
+    if transport is None:
+        transport = _default_transport(rdkafka_settings or {}, topic)
+
+    if format in ("raw", "plaintext"):
+        schema = schema_mod.schema_from_types(
+            data=bytes if format == "raw" else str
+        )
+        make_parser = lambda names: _KafkaRawParser(binary=format == "raw")  # noqa: E731
+    elif format == "json":
+        if schema is None:
+            raise ValueError("format='json' needs schema=")
+        pk = primary_key or schema.primary_key_columns() or None
+        make_parser = lambda names: _KafkaJsonParser(names, pk)  # noqa: E731
+    else:
+        raise ValueError(f"unknown kafka format {format!r}")
+
+    return input_table(
+        schema,
+        lambda: MessageQueueReader(transport),
+        make_parser,
+        source_name=f"kafka:{topic}",
+        persistent_id=persistent_id,
+    )
+
+
+def simple_read(
+    server: str, topic: str, *, transport: Any = None, **kwargs: Any
+) -> Table:
+    """Reference simple_read (kafka/__init__.py:299): bare-bones raw read."""
+    return read(
+        {"bootstrap.servers": server}, topic, transport=transport, **kwargs
+    )
+
+
+def write(
+    table: Table,
+    rdkafka_settings: dict | None = None,
+    topic_name: str | None = None,
+    *,
+    format: str = "json",  # noqa: A002
+    key: str | None = None,
+    transport: Any = None,
+    **kwargs: Any,
+) -> None:
+    """Produce one message per change (JSON row + time + diff)."""
+    if transport is None:
+        transport = _default_transport(rdkafka_settings or {}, topic_name)
+    if format != "json":
+        raise ValueError(f"unsupported kafka write format {format!r}")
+
+    def make_writer(column_names):
+        key_index = column_names.index(key) if key else None
+        return MessageQueueWriter(
+            transport, JsonLinesFormatter(), column_names, key_index=key_index
+        )
+
+    attach_writer(table, make_writer)

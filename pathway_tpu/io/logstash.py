@@ -1,0 +1,28 @@
+"""pw.io.logstash — stream updates into Logstash's HTTP input plugin
+(reference: python/pathway/io/logstash/__init__.py — a thin wrapper over
+the HTTP writer)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io.http import RetryPolicy, write as _http_write
+
+
+def write(
+    table: Table,
+    endpoint: str,
+    n_retries: int = 0,
+    retry_policy: RetryPolicy | None = None,
+    *,
+    request_fn: Callable[[str, dict], Any] | None = None,
+    **kwargs: Any,
+) -> None:
+    _http_write(
+        table,
+        endpoint,
+        n_retries=n_retries,
+        retry_policy=retry_policy,
+        request_fn=request_fn,
+    )

@@ -1,0 +1,39 @@
+"""pw.io.mongodb — write update streams into MongoDB
+(reference: python/pathway/io/mongodb/__init__.py:14; documents carry
+time/diff like BsonFormatter data_format.rs:1975)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.formats import DocumentFormatter
+from pathway_tpu.engine.storage import MongoWriter
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import attach_writer, require
+
+
+def write(
+    table: Table,
+    connection_string: str | None = None,
+    database: str | None = None,
+    collection: str | None = None,
+    *,
+    client: Any = None,
+    **kwargs: Any,
+) -> None:
+    """Insert one document (row + time + diff) per change. ``client`` needs
+    ``insert_many(collection, docs)``; pymongo adapts in two lines."""
+    if client is None:
+        pymongo = require("pymongo", "pw.io.mongodb")
+        mongo = pymongo.MongoClient(connection_string)[database]
+
+        class _Adapter:
+            def insert_many(self, coll: str, docs: list) -> None:
+                mongo[coll].insert_many(docs)
+
+        client = _Adapter()
+
+    def make_writer(column_names):
+        return MongoWriter(client, collection, DocumentFormatter(column_names))
+
+    attach_writer(table, make_writer)

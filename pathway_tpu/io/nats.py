@@ -1,0 +1,43 @@
+"""pw.io.nats — NATS subject connector (reference:
+python/pathway/io/nats/__init__.py, 277 LoC). Message-queue shaped: same
+transport seam as kafka; default transport gated on nats-py."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import kafka as _kafka
+from pathway_tpu.io._utils import require
+
+
+def read(
+    uri: str | None = None,
+    topic: str | None = None,
+    *,
+    schema: schema_mod.SchemaMetaclass | None = None,
+    format: str = "json",  # noqa: A002
+    transport: Any = None,
+    **kwargs: Any,
+) -> Table:
+    if transport is None:
+        require("nats", "pw.io.nats")
+        raise NotImplementedError("nats transport wiring requires a live server")
+    return _kafka.read(
+        None, topic, schema=schema, format=format, transport=transport, **kwargs
+    )
+
+
+def write(
+    table: Table,
+    uri: str | None = None,
+    topic: str | None = None,
+    *,
+    transport: Any = None,
+    **kwargs: Any,
+) -> None:
+    if transport is None:
+        require("nats", "pw.io.nats")
+        raise NotImplementedError("nats transport wiring requires a live server")
+    _kafka.write(table, None, topic, transport=transport, **kwargs)

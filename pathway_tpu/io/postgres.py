@@ -1,0 +1,89 @@
+"""pw.io.postgres — write update streams / snapshots into Postgres
+(reference: python/pathway/io/postgres/__init__.py; PsqlWriter
+src/connectors/data_storage.rs:1061, Psql formatters data_format.rs:1625,
+:1684).
+
+The database is reached through an injected ``connection`` object with
+``execute(statement, params)`` (and optionally ``commit()``). psycopg2's
+cursor adapts directly (after $N -> %s placeholder translation); tests use
+a recording executor.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.formats import PsqlSnapshotFormatter, PsqlUpdatesFormatter
+from pathway_tpu.engine.storage import PsqlWriter
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import attach_writer, require
+
+
+def _executor(postgres_settings: dict | None, connection: Any) -> Any:
+    if connection is not None:
+        return connection
+    psycopg2 = require("psycopg2", "pw.io.postgres")
+    conn = psycopg2.connect(
+        **{k: v for k, v in (postgres_settings or {}).items()}
+    )
+
+    class _Adapter:
+        def execute(self, statement: str, params):
+            import re
+
+            # $N placeholders repeat (snapshot upsert reuses $1 in VALUES,
+            # SET and WHERE) — translate to psycopg2's *named* pyformat so
+            # each occurrence binds the same parameter
+            stmt = re.sub(r"\$(\d+)", r"%(p\1)s", statement)
+            named = {f"p{i + 1}": v for i, v in enumerate(params)}
+            with conn.cursor() as cur:
+                cur.execute(stmt, named)
+
+        def commit(self):
+            conn.commit()
+
+    return _Adapter()
+
+
+def write(
+    table: Table,
+    postgres_settings: dict | None = None,
+    table_name: str | None = None,
+    *,
+    connection: Any = None,
+    **kwargs: Any,
+) -> None:
+    """Append every change as a row (values..., time, diff) — the update-log
+    shape (reference postgres.write)."""
+    executor = _executor(postgres_settings, connection)
+
+    def make_writer(column_names):
+        return PsqlWriter(
+            executor, PsqlUpdatesFormatter(table_name, column_names)
+        )
+
+    attach_writer(table, make_writer)
+
+
+def write_snapshot(
+    table: Table,
+    postgres_settings: dict | None = None,
+    table_name: str | None = None,
+    primary_key: list[str] | None = None,
+    *,
+    connection: Any = None,
+    **kwargs: Any,
+) -> None:
+    """Maintain ``table_name`` as the current snapshot: upsert on insert,
+    DELETE on retraction (reference postgres.write_snapshot :113)."""
+    if not primary_key:
+        raise ValueError("write_snapshot needs primary_key=[...]")
+    executor = _executor(postgres_settings, connection)
+
+    def make_writer(column_names):
+        return PsqlWriter(
+            executor,
+            PsqlSnapshotFormatter(table_name, primary_key, column_names),
+        )
+
+    attach_writer(table, make_writer)

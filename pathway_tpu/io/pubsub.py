@@ -1,0 +1,58 @@
+"""pw.io.pubsub — publish update streams to Google Pub/Sub (reference:
+python/pathway/io/pubsub/__init__.py). Publisher seam:
+``publish(topic, data: bytes, **attrs)``."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from pathway_tpu.engine.connectors import JsonLinesFormatter
+from pathway_tpu.engine.value import Pointer
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import attach_writer, require
+
+
+class _PubSubWriter:
+    def __init__(self, publisher: Any, topic: str, column_names):
+        self.publisher = publisher
+        self.topic = topic
+        self.formatter = JsonLinesFormatter()
+        self.column_names = list(column_names)
+
+    def on_change(self, key: Pointer, values: tuple, time: int, diff: int) -> None:
+        payload = self.formatter.format(
+            key, values, self.column_names, time, diff
+        )
+        self.publisher.publish(self.topic, payload.encode("utf-8"))
+
+    def on_time_end(self, time: int) -> None:
+        pass
+
+    def on_end(self) -> None:
+        pass
+
+
+def write(
+    table: Table,
+    publisher: Any = None,
+    project_id: str | None = None,
+    topic_id: str | None = None,
+    **kwargs: Any,
+) -> None:
+    if publisher is None:
+        pubsub = require("google.cloud.pubsub_v1", "pw.io.pubsub")
+        client = pubsub.PublisherClient()
+        topic = client.topic_path(project_id, topic_id)
+
+        class _Adapter:
+            def publish(self, _topic, data: bytes, **attrs):
+                client.publish(topic, data, **attrs).result()
+
+        publisher = _Adapter()
+    topic = topic_id or ""
+
+    def make_writer(column_names):
+        return _PubSubWriter(publisher, topic, column_names)
+
+    attach_writer(table, make_writer)

@@ -1,0 +1,55 @@
+"""pw.io.pyfilesystem — read any PyFilesystem2 filesystem (reference:
+python/pathway/io/pyfilesystem/__init__.py). Accepts any object with the
+PyFilesystem ``walk.files()`` / ``readbytes`` / ``getinfo`` surface — an
+``fs.open_fs(...)`` result, or a compatible fake in tests."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.connectors import IdentityParser
+from pathway_tpu.engine.storage import ObjectStoreReader
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import input_table
+
+
+class _FsStore:
+    def __init__(self, source: Any, path: str) -> None:
+        self.source = source
+        self.path = path
+
+    def list_objects(self, prefix: str):
+        out = []
+        for fpath in self.source.walk.files(self.path or "/"):
+            info = self.source.getinfo(fpath, namespaces=["details"])
+            sig = f"{getattr(info, 'size', 0)}:{getattr(info, 'modified', '')}"
+            out.append((fpath, sig))
+        return out
+
+    def get_object(self, key: str) -> bytes:
+        return self.source.readbytes(key)
+
+
+def read(
+    source: Any,
+    path: str = "",
+    *,
+    mode: str = "streaming",
+    format: str = "binary",  # noqa: A002
+    with_metadata: bool = False,
+    **kwargs: Any,
+) -> Table:
+    schema = schema_mod.schema_from_types(
+        data=bytes if format == "binary" else str
+    )
+    store = _FsStore(source, path)
+    return input_table(
+        schema,
+        lambda: ObjectStoreReader(
+            store, "", mode=mode, binary=format == "binary"
+        ),
+        lambda names: IdentityParser(binary=format == "binary"),
+        source_name=f"pyfilesystem:{path}",
+        with_metadata=with_metadata,
+    )

@@ -1,0 +1,7 @@
+"""pw.io.redpanda — Redpanda speaks the Kafka protocol; this module is the
+kafka connector under the compatible name (reference:
+python/pathway/io/redpanda/__init__.py, 294 LoC of re-exports)."""
+
+from pathway_tpu.io.kafka import read, simple_read, write  # noqa: F401
+
+__all__ = ["read", "simple_read", "write"]

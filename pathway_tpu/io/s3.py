@@ -1,0 +1,152 @@
+"""pw.io.s3 — object-store connector (reference: python/pathway/io/s3/,
+570 LoC; S3 scanner src/connectors/scanner/s3.rs).
+
+The store is reached through an injected ``client`` implementing
+``list_objects(prefix) -> [(key, etag)]`` / ``get_object(key) -> bytes``
+(plus ``put_object`` for writes). boto3 adapts in a few lines;
+tests/demos use :class:`pathway_tpu.engine.storage.DictObjectStore`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.connectors import (
+    DsvParser,
+    IdentityParser,
+    JsonLinesFormatter,
+    JsonLinesParser,
+)
+from pathway_tpu.engine.storage import (
+    DictObjectStore,
+    ObjectStoreReader,
+    ObjectStoreWriter,
+)
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import attach_writer, converter_for, input_table, require
+
+__all__ = ["read", "write", "AwsS3Settings", "DictObjectStore"]
+
+
+class AwsS3Settings:
+    """Bucket + credentials (reference io/s3 AwsS3Settings)."""
+
+    def __init__(
+        self,
+        bucket_name: str | None = None,
+        access_key: str | None = None,
+        secret_access_key: str | None = None,
+        region: str | None = None,
+        endpoint: str | None = None,
+        with_path_style: bool = False,
+    ) -> None:
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.region = region
+        self.endpoint = endpoint
+        self.with_path_style = with_path_style
+
+    def create_client(self) -> Any:
+        boto3 = require("boto3", "pw.io.s3")
+        s3 = boto3.client(
+            "s3",
+            aws_access_key_id=self.access_key,
+            aws_secret_access_key=self.secret_access_key,
+            region_name=self.region,
+            endpoint_url=self.endpoint,
+        )
+        bucket = self.bucket_name
+
+        class _Adapter:
+            def list_objects(self, prefix: str):
+                out = []
+                resp = s3.list_objects_v2(Bucket=bucket, Prefix=prefix)
+                for item in resp.get("Contents", []):
+                    out.append((item["Key"], item["ETag"]))
+                return out
+
+            def get_object(self, key: str) -> bytes:
+                return s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+
+            def put_object(self, key: str, data) -> None:
+                if isinstance(data, str):
+                    data = data.encode("utf-8")
+                s3.put_object(Bucket=bucket, Key=key, Body=data)
+
+        return _Adapter()
+
+
+def _client_of(aws_s3_settings: Any, client: Any) -> Any:
+    if client is not None:
+        return client
+    if aws_s3_settings is None:
+        raise ValueError("pass aws_s3_settings= or client=")
+    return aws_s3_settings.create_client()
+
+
+def read(
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    format: str = "json",  # noqa: A002
+    schema: schema_mod.SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    client: Any = None,
+    with_metadata: bool = False,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Scan objects under ``path`` (a key prefix). Each object is parsed per
+    ``format`` (csv/json/plaintext/binary); object rewrites replace their
+    previous rows, deletions retract them."""
+    store = _client_of(aws_s3_settings, client)
+    if format in ("plaintext", "binary", "plaintext_by_object"):
+        schema = schema_mod.schema_from_types(
+            data=bytes if format == "binary" else str
+        )
+    if schema is None:
+        raise ValueError("schema= is required for csv/json formats")
+    dtypes = schema.dtypes()
+    binary = format == "binary"
+
+    def make_parser(names):
+        if format == "csv":
+            return DsvParser(
+                names, converters=[converter_for(dtypes[n]) for n in names]
+            )
+        if format == "json":
+            return JsonLinesParser(names)
+        if format == "plaintext":
+            return IdentityParser(split_lines=True)
+        return IdentityParser(binary=binary, split_lines=False)
+
+    return input_table(
+        schema,
+        lambda: ObjectStoreReader(store, path, mode=mode, binary=binary),
+        make_parser,
+        source_name=f"s3:{path}",
+        with_metadata=with_metadata,
+        persistent_id=persistent_id,
+    )
+
+
+def write(
+    table: Table,
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    format: str = "json",  # noqa: A002
+    client: Any = None,
+    **kwargs: Any,
+) -> None:
+    """Write one JSON-lines object per commit under ``path``."""
+    if format != "json":
+        raise ValueError(f"unsupported s3 write format {format!r}")
+    store = _client_of(aws_s3_settings, client)
+
+    def make_writer(column_names):
+        return ObjectStoreWriter(store, path, JsonLinesFormatter(), column_names)
+
+    attach_writer(table, make_writer)

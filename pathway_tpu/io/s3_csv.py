@@ -1,0 +1,12 @@
+"""pw.io.s3_csv (reference: python/pathway/io/s3_csv/__init__.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io import s3 as _s3
+
+
+def read(path: str, **kwargs: Any):
+    kwargs.setdefault("format", "csv")
+    return _s3.read(path, **kwargs)

@@ -1,0 +1,35 @@
+"""pw.io.sqlite — read a SQLite table as a change stream
+(reference: python/pathway/io/sqlite/__init__.py, SqliteReader
+src/connectors/data_storage.rs:1396)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from pathway_tpu.engine.storage import SqliteReader, TransparentParser
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import input_table
+
+
+def read(
+    path: str | os.PathLike,
+    table_name: str,
+    schema: schema_mod.SchemaMetaclass,
+    *,
+    mode: str = "streaming",
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Table:
+    """Poll ``table_name`` in the SQLite database at ``path``; inserts,
+    updates and deletions of rows (keyed by rowid) become engine diffs."""
+    column_names = schema.column_names()
+    path = os.fspath(path)
+
+    return input_table(
+        schema,
+        lambda: SqliteReader(path, table_name, column_names, mode=mode),
+        lambda names: TransparentParser(names),
+        source_name=f"sqlite:{path}:{table_name}",
+    )
