@@ -163,6 +163,35 @@ class DeviceKnnIndex:
             valid.append(False)
         self._apply(slots, np.asarray(vecs, np.float32), valid)
 
+    # -- operator persistence -------------------------------------------------
+
+    def op_state(self) -> dict:
+        """Device arrays come back as numpy so snapshots pickle (the HBM
+        copy is rebuilt on restore)."""
+        return {
+            "vectors": np.asarray(self.state.vectors),
+            "valid": np.asarray(self.state.valid),
+            "norms": np.asarray(self.state.norms),
+            "key_to_slot": dict(self.key_to_slot),
+            "free": list(self._free),
+            "capacity": self.capacity,
+        }
+
+    def restore_op_state(self, state: dict) -> None:
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops.knn import DeviceKnnState
+
+        self.capacity = state["capacity"]
+        self.state = DeviceKnnState(
+            vectors=jnp.asarray(state["vectors"]),
+            valid=jnp.asarray(state["valid"]),
+            norms=jnp.asarray(state["norms"]),
+        )
+        self.key_to_slot = dict(state["key_to_slot"])
+        self.slot_to_key = {s: k for k, s in self.key_to_slot.items()}
+        self._free = list(state["free"])
+
     # -- search --------------------------------------------------------------
 
     def search(
@@ -228,6 +257,25 @@ class ExternalIndexNode(Node):
         self.query_col = query_col
         self.k = k
         self.limit_col = limit_col
+
+    def op_state(self) -> dict:
+        state = super().op_state()
+        index_state = getattr(self.index, "op_state", None)
+        if index_state is None:
+            # silently skipping would resume with an empty index while the
+            # reader has already seeked past the rows that populated it
+            raise TypeError(
+                f"{type(self.index).__name__} does not implement "
+                "op_state/restore_op_state, so it cannot be used with "
+                "PersistenceMode.OPERATOR_PERSISTING"
+            )
+        state["index"] = index_state()
+        return state
+
+    def restore_op_state(self, state: dict) -> None:
+        super().restore_op_state(state)
+        if "index" in state and hasattr(self.index, "restore_op_state"):
+            self.index.restore_op_state(state["index"])
 
     def process(self, time: int) -> DeltaBatch:
         index_batch = self.take(0)

@@ -208,10 +208,10 @@ class PsqlSnapshotFormatter:
             placeholders = ",".join(
                 f"${i}" for i in range(1, len(values) + 1)
             )
-            update_pairs = ",".join(
+            set_items = [
                 f"{self.value_field_names[p]}=${p + 1}"
                 for p in self.value_field_positions
-            )
+            ] + [f"time={time}", f"diff={diff}"]
             condition = " AND ".join(
                 f"{self.table_name}.{self.value_field_names[p]}=${p + 1}"
                 for p in self.key_field_positions
@@ -221,7 +221,7 @@ class PsqlSnapshotFormatter:
                 f"({','.join(self.value_field_names)},time,diff) "
                 f"VALUES ({placeholders},{time},{diff}) "
                 f"ON CONFLICT ({','.join(self.key_field_names)}) "
-                f"DO UPDATE SET {update_pairs},time={time},diff={diff} "
+                f"DO UPDATE SET {','.join(set_items)} "
                 f"WHERE {condition}"
             )
             return stmt, [_sql_value(v) for v in values]

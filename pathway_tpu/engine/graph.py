@@ -29,7 +29,7 @@ from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
 from pathway_tpu.engine.device import VECTOR_THRESHOLD
 from pathway_tpu.engine.expression import EngineExpression, EvalContext
 from pathway_tpu.engine.reducers import Reducer
-from pathway_tpu.engine.value import ERROR, Error, Pointer, hash_values, is_error, ref_scalar
+from pathway_tpu.engine.value import ERROR, Error, Pointer, hash_values, is_error, ref_scalar, rows_differ
 
 
 class Node:
@@ -84,9 +84,31 @@ class Node:
     def snapshot(self) -> dict[Pointer, tuple]:
         return dict(self.current)
 
+    # -- operator persistence (reference: operator_snapshot.rs) --------------
+
+    #: mutable attributes beyond ``current`` that define operator state;
+    #: captured at commit boundaries by OperatorSnapshotManager
+    STATE_ATTRS: tuple = ()
+
+    def op_state(self) -> dict:
+        state: dict = {"current": dict(self.current)}
+        for name in self.STATE_ATTRS:
+            state[name] = getattr(self, name)
+        return state
+
+    def restore_op_state(self, state: dict) -> None:
+        self.current = dict(state["current"])
+        for name in self.STATE_ATTRS:
+            if name in state:
+                setattr(self, name, state[name])
+
 
 class StaticSource(Node):
     """A table fully known at graph build time."""
+
+    #: restored snapshots already contain these rows — a resumed run must
+    #: not re-emit them (operator persistence)
+    STATE_ATTRS = ("_emitted",)
 
     def __init__(self, scope: "Scope", rows: Iterable[tuple[Pointer, tuple]], arity: int):
         super().__init__(scope, [], arity)
@@ -476,9 +498,9 @@ class ZipNode(Node):
         for key in affected:
             old = self.current.get(key)
             new = self._combined(key)
-            if old is not None and old != new:
+            if old is not None and rows_differ(old, new):
                 out.append(key, old, -1)
-            if new is not None and old != new:
+            if new is not None and rows_differ(old, new):
                 out.append(key, new, 1)
         return out
 
@@ -513,6 +535,8 @@ class JoinNode(Node):
     dataflow.rs:2320+). ``id_from_left`` keeps the left row id (used by
     id-preserving joins such as ``ix``-style lookups and asof_now joins).
     """
+
+    STATE_ATTRS = ("left_arr", "right_arr")
 
     def __init__(
         self,
@@ -607,10 +631,10 @@ class JoinNode(Node):
             old = old_local[jk]
             new = self._local_output(jk)
             for okey, orow in old.items():
-                if okey not in new or new[okey] != orow:
+                if okey not in new or rows_differ(new[okey], orow):
                     out.append(okey, orow, -1)
             for okey, orow in new.items():
-                if okey not in old or old[okey] != orow:
+                if okey not in old or rows_differ(old[okey], orow):
                     out.append(okey, orow, 1)
         return out.consolidate()
 
@@ -622,6 +646,8 @@ class GroupbyNode(Node):
     id is ``ref_scalar(*grouping values)`` unless ``set_id`` names a pointer
     column to use directly (reference: group_by_table python_api.rs:2922).
     """
+
+    STATE_ATTRS = ("groups",)
 
     def __init__(
         self,
@@ -791,6 +817,8 @@ class DeduplicateNode(Node):
     arriving row replaces the current one.
     """
 
+    STATE_ATTRS = ("accepted",)
+
     def __init__(
         self,
         scope: "Scope",
@@ -831,7 +859,7 @@ class DeduplicateNode(Node):
                     self.accepted[gkey] = row
                     out.append(gkey, row, 1)
             else:
-                if prev is not None and prev == row:
+                if prev is not None and not rows_differ(prev, row):
                     out.append(gkey, prev, -1)
                     del self.accepted[gkey]
         return out.consolidate()
@@ -880,6 +908,8 @@ class SortNode(Node):
     src/engine/dataflow/operators/prev_next.rs:770 — here recomputed per
     affected instance group, which preserves the output contract).
     """
+
+    STATE_ATTRS = ("members",)
 
     def __init__(
         self, scope: "Scope", source: Node, key_col: int, instance_col: int | None
@@ -947,10 +977,10 @@ class SortNode(Node):
         for inst, old_rows in old.items():
             new_rows = self._local(inst)
             for k, r in old_rows.items():
-                if new_rows.get(k) != r:
+                if rows_differ(new_rows.get(k), r):
                     out.append(k, r, -1)
             for k, r in new_rows.items():
-                if old_rows.get(k) != r:
+                if rows_differ(old_rows.get(k), r):
                     out.append(k, r, 1)
         return out.consolidate()
 
@@ -959,6 +989,8 @@ class IxNode(Node):
     """Pointer-lookup join: for each input row, fetch the source row its
     key column points to (reference: ix_table python_api.rs:2963).
     """
+
+    STATE_ATTRS = ("forward", "reverse")
 
     def __init__(
         self,
@@ -1003,9 +1035,9 @@ class IxNode(Node):
             for ikey in self.reverse.get(skey, set()) - handled:
                 old = self.current.get(ikey)
                 new = self._lookup(ikey, self.forward.get(ikey))
-                if old is not None and old != new:
+                if old is not None and rows_differ(old, new):
                     out.append(ikey, old, -1)
-                if new is not None and old != new:
+                if new is not None and rows_differ(old, new):
                     out.append(ikey, new, 1)
         # Input-side changes
         for key, row, diff in keys_batch:
@@ -1056,9 +1088,9 @@ class UpdateRowsNode(Node):
         for key in affected:
             old = self.current.get(key)
             new = self._effective(key)
-            if old is not None and old != new:
+            if old is not None and rows_differ(old, new):
                 out.append(key, old, -1)
-            if new is not None and old != new:
+            if new is not None and rows_differ(old, new):
                 out.append(key, new, 1)
         return out
 
@@ -1096,9 +1128,9 @@ class UpdateCellsNode(Node):
         for key in affected:
             old = self.current.get(key)
             new = self._effective(key)
-            if old is not None and old != new:
+            if old is not None and rows_differ(old, new):
                 out.append(key, old, -1)
-            if new is not None and old != new:
+            if new is not None and rows_differ(old, new):
                 out.append(key, new, 1)
         return out
 
@@ -1179,10 +1211,10 @@ def emit_local_group_diffs(
     for inst, old_rows in old_groups.items():
         new_rows = local_fn(inst)
         for k, r in old_rows.items():
-            if new_rows.get(k) != r:
+            if rows_differ(new_rows.get(k), r):
                 out.append(k, r, -1)
         for k, r in new_rows.items():
-            if old_rows.get(k) != r:
+            if rows_differ(old_rows.get(k), r):
                 out.append(k, r, 1)
 
 

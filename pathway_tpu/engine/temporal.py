@@ -19,7 +19,6 @@ import bisect
 from typing import Any, Callable, Sequence
 
 import heapq
-import itertools
 
 from pathway_tpu.engine.batch import DeltaBatch
 from pathway_tpu.engine.graph import (
@@ -68,8 +67,14 @@ class BufferNode(Node):
         # release heap (threshold, seq, key) with lazy invalidation, so each
         # commit costs O(released·log n), not O(held)
         self._heap: list[tuple[Any, int, Pointer]] = []
-        self._seq = itertools.count()
+        self._seq = 0
         self._ended = False
+
+    STATE_ATTRS = ("watermark", "held", "_heap", "_seq", "_ended")
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
 
     def process(self, time: int) -> DeltaBatch:
         batch = self.take(0)
@@ -93,7 +98,7 @@ class BufferNode(Node):
             else:
                 self.held[key] = row
                 heapq.heappush(
-                    self._heap, (threshold, next(self._seq), key)
+                    self._heap, (threshold, self._next_seq(), key)
                 )
         if self.watermark is not None:
             while self._heap and self._heap[0][0] <= self.watermark:
@@ -148,7 +153,13 @@ class ForgetNode(Node):
         self.watermark: Any = None
         self.live: dict[Pointer, tuple] = {}
         self._heap: list[tuple[Any, int, Pointer]] = []
-        self._seq = itertools.count()
+        self._seq = 0
+
+    STATE_ATTRS = ("watermark", "live", "_heap", "_seq")
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
 
     def _emit(self, out: DeltaBatch, key: Pointer, row: tuple, diff: int, forgetting: bool) -> None:
         if self.mark:
@@ -180,7 +191,7 @@ class ForgetNode(Node):
                 continue  # dropped: arrived after its cutoff
             self.live[key] = row
             if threshold is not None and not is_error(threshold):
-                heapq.heappush(self._heap, (threshold, next(self._seq), key))
+                heapq.heappush(self._heap, (threshold, self._next_seq(), key))
             self._emit(out, key, row, diff, False)
         self.watermark = _watermark_update(self.watermark, batch, self.time_col)
         # forget everything whose threshold passed (lazy heap: stale entries
@@ -199,6 +210,8 @@ class FreezeNode(Node):
     """Drop updates (inserts and deletes) to frozen times: once the
     watermark passes a row's threshold, that region is immutable
     (reference: TimeColumnFreeze time_column.rs:631)."""
+
+    STATE_ATTRS = ("watermark",)
 
     def __init__(
         self, scope: Scope, source: Node, threshold_col: int, time_col: int
@@ -230,6 +243,8 @@ class SessionAssignNode(Node):
     whose gap exceeds ``max_gap`` start a new session. Output row =
     input row + (start, end), keyed by source key; affected instances are
     recomputed locally (reference: session windows _window.py:593+)."""
+
+    STATE_ATTRS = ("members",)
 
     def __init__(
         self,
@@ -311,6 +326,8 @@ class IntervalJoinNode(Node):
     Output = left_row + right_row (+ padding on outer kinds), keyed like the
     hash join. Per-instance local recomputation keeps it incremental.
     """
+
+    STATE_ATTRS = ("left_rows", "right_rows")
 
     def __init__(
         self,
@@ -417,6 +434,8 @@ class AsofJoinNode(Node):
     (per instance; ``direction`` backward/forward/nearest). Keyed by the
     left row id (reference: stdlib/temporal/_asof_join.py)."""
 
+    STATE_ATTRS = ("left_rows", "right_rows")
+
     def __init__(
         self,
         scope: Scope,
@@ -495,6 +514,8 @@ class AsofNowJoinNode(Node):
     never revise when the right side changes later — deletion of the left
     row retracts its result (reference: _asof_now_join.py:403, built on the
     gradual-broadcast machinery; same contract as the external index)."""
+
+    STATE_ATTRS = ("right_index", "answered")
 
     def __init__(
         self,

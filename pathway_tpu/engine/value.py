@@ -376,3 +376,32 @@ def value_type_of(value: Any) -> Type:
     if isinstance(value, PyObjectWrapper):
         return Type.PY_OBJECT_WRAPPER
     return Type.ANY
+
+
+def rows_differ(a: "tuple | None", b: "tuple | None") -> bool:
+    """Row inequality that tolerates numpy-array cells (plain ``!=`` raises
+    'truth value is ambiguous' on arrays). None = absent row. The common
+    all-scalar row stays on the C tuple compare; only rows actually holding
+    arrays take the per-cell path."""
+    if a is b:
+        return False
+    if a is None or b is None:
+        return True
+    try:
+        return a != b
+    except ValueError:  # some cell is a numpy array
+        pass
+    if len(a) != len(b):
+        return True
+    for x, y in zip(a, b):
+        if x is y:
+            continue
+        try:
+            if x != y:
+                return True
+        except ValueError:  # numpy broadcast comparison
+            import numpy as np
+
+            if not np.array_equal(x, y):
+                return True
+    return False

@@ -783,6 +783,14 @@ class GraphRunner:
             # flush replayed events as the first commit so downstream state
             # is rebuilt even if no new input arrives
             sched.commit()
+        snapshot_mgr = self._operator_snapshot_manager()
+        if snapshot_mgr is not None:
+            # operator persistence: restore state directly, no event replay;
+            # resume the clock after the snapshotted commit so sink
+            # timestamps / part names stay monotonic across restarts
+            restored_time = snapshot_mgr.restore(self.scope, self.drivers)
+            if restored_time is not None:
+                sched.time = max(sched.time, restored_time + 1)
         for node in self.scope.nodes:
             if isinstance(node, StaticSource):
                 batch = node.initial_batch()
@@ -805,6 +813,8 @@ class GraphRunner:
                 time = sched.commit()
                 for driver in persistent:
                     driver.on_commit(time)
+                if snapshot_mgr is not None:
+                    snapshot_mgr.on_commit(self.scope, self.drivers, time)
                 idle_spins = 0
             else:
                 idle_spins += 1
@@ -812,7 +822,25 @@ class GraphRunner:
         sched.finish()
         for driver in persistent:
             driver.on_commit(sched.time)
+        if snapshot_mgr is not None:
+            snapshot_mgr.snapshot(self.scope, self.drivers, sched.time)
         return sched
+
+    def _operator_snapshot_manager(self):
+        if self.persistence is None:
+            return None
+        from pathway_tpu.engine.persistence import OperatorSnapshotManager
+        from pathway_tpu.persistence import PersistenceMode
+
+        if (
+            getattr(self.persistence, "persistence_mode", None)
+            != PersistenceMode.OPERATOR_PERSISTING
+        ):
+            return None
+        return OperatorSnapshotManager(
+            self.persistence.backend,
+            getattr(self.persistence, "snapshot_interval_ms", 0),
+        )
 
     def capture(self, *tables: "Table") -> list[dict[Pointer, tuple]]:
         nodes = [self.build(t) for t in tables]

@@ -318,13 +318,20 @@ def read(
         raise ValueError("schema= is required for json format")
 
     class _HttpPollReader(Reader):
+        # a re-poll returning the same body is a re-read of the same
+        # source: rows replace the previous poll's instead of accumulating
+        replaces_sources = True
+
         def __init__(self) -> None:
             self._last_poll = 0.0
-            self._seq = 0
+            self._last_body: str | None = None
 
         def poll(self):
             now = _time.monotonic()
-            if now - self._last_poll < poll_interval_ms / 1000.0 and self._seq:
+            if (
+                now - self._last_poll < poll_interval_ms / 1000.0
+                and self._last_body is not None
+            ):
                 return [], False
             self._last_poll = now
             delay = 0.5
@@ -337,10 +344,10 @@ def read(
                         raise
                     _time.sleep(delay)
                     delay *= 2
-            self._seq += 1
-            if not body:
+            if not body or body == self._last_body:
                 return [], False
-            return [(body, f"http:{self._seq}", {})], False
+            self._last_body = body
+            return [(body, url, {})], False
 
     make_parser = (
         (lambda names: JsonLinesParser(names))

@@ -62,10 +62,15 @@ class AwsS3Settings:
         class _Adapter:
             def list_objects(self, prefix: str):
                 out = []
-                resp = s3.list_objects_v2(Bucket=bucket, Prefix=prefix)
-                for item in resp.get("Contents", []):
-                    out.append((item["Key"], item["ETag"]))
-                return out
+                kwargs = {"Bucket": bucket, "Prefix": prefix}
+                while True:  # paginate: one page holds at most 1000 keys
+                    resp = s3.list_objects_v2(**kwargs)
+                    for item in resp.get("Contents", []):
+                        out.append((item["Key"], item["ETag"]))
+                    token = resp.get("NextContinuationToken")
+                    if not token:
+                        return out
+                    kwargs["ContinuationToken"] = token
 
             def get_object(self, key: str) -> bytes:
                 return s3.get_object(Bucket=bucket, Key=key)["Body"].read()

@@ -20,6 +20,7 @@ from typing import Any
 from pathway_tpu.engine.persistence import (
     FileBackend,
     MemoryBackend,
+    ObjectStoreBackend,
     PersistenceBackend,
 )
 
@@ -27,7 +28,10 @@ from pathway_tpu.engine.persistence import (
 class PersistenceMode(enum.Enum):
     PERSISTING = "persisting"  # input-event journal replay (default)
     UDF_CACHING = "udf_caching"  # only wire the UDF disk cache
-    OPERATOR_PERSISTING = "operator_persisting"  # reserved (operator snapshots)
+    #: snapshot operator state at commit boundaries; resume restores state
+    #: and seeks readers — O(state) resume instead of O(history) replay
+    #: (reference operator_snapshot.rs)
+    OPERATOR_PERSISTING = "operator_persisting"
 
 
 class Backend:
@@ -40,6 +44,31 @@ class Backend:
     @staticmethod
     def mock(events: Any = None) -> PersistenceBackend:
         return MemoryBackend()
+
+    @staticmethod
+    def s3(root_path: Any = None, bucket_settings: Any = None, *, client: Any = None) -> PersistenceBackend:
+        """S3-shaped object-store backend (reference backends/s3.rs). Pass
+        ``client`` (get/put/list seam — boto3 adapter from pw.io.s3, or an
+        in-memory store) or AwsS3Settings as ``bucket_settings``."""
+        from pathway_tpu.engine.persistence import ObjectStoreBackend
+
+        if client is None:
+            if bucket_settings is None:
+                raise ValueError("pass client= or bucket_settings=")
+            client = bucket_settings.create_client()
+        return ObjectStoreBackend(client, str(root_path or "pathway-persistence"))
+
+    @staticmethod
+    def azure(root_path: Any = None, account: Any = None, *, client: Any = None) -> PersistenceBackend:
+        """Azure blob backend through the same object-store seam."""
+        from pathway_tpu.engine.persistence import ObjectStoreBackend
+
+        if client is None:
+            raise ImportError(
+                "pw.persistence.Backend.azure needs an injected blob client "
+                "(get_object/put_object/list_objects seam)"
+            )
+        return ObjectStoreBackend(client, str(root_path or "pathway-persistence"))
 
 
 @dataclasses.dataclass

@@ -60,6 +60,22 @@ class BM25Index:
                     if not tok_docs:
                         del self.postings[tok]
 
+    def op_state(self) -> dict:
+        return {
+            "postings": {t: dict(d) for t, d in self.postings.items()},
+            "doc_tokens": dict(self.doc_tokens),
+            "doc_len": dict(self.doc_len),
+            "total_len": self.total_len,
+        }
+
+    def restore_op_state(self, state: dict) -> None:
+        self.postings = defaultdict(dict)
+        for t, d in state["postings"].items():
+            self.postings[t] = dict(d)
+        self.doc_tokens = dict(state["doc_tokens"])
+        self.doc_len = dict(state["doc_len"])
+        self.total_len = state["total_len"]
+
     def search(
         self, queries: Sequence[Any], k: int
     ) -> list[list[tuple[Pointer, float]]]:

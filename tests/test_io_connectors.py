@@ -497,3 +497,44 @@ class TestReviewRegressions:
         named = {f"p{i + 1}": v for i, v in enumerate(params)}
         rendered = translated % {k: repr(v) for k, v in named.items()}
         assert "$" not in rendered and "%(" not in rendered
+
+
+class TestReviewRegressions2:
+    def test_psql_snapshot_all_key_columns_valid_sql(self):
+        f = PsqlSnapshotFormatter("t", ["id"], ["id"])
+        stmt, params = f.format(None, (1,), 5, 1)
+        assert "SET ,time" not in stmt
+        assert "DO UPDATE SET time=5,diff=1" in stmt
+
+    def test_http_poll_replaces_instead_of_accumulating(self):
+        bodies = ['{"a": 1}\n{"a": 2}', '{"a": 1}\n{"a": 2}', '{"a": 7}']
+        calls = {"n": 0}
+
+        def fake_get(url):
+            i = min(calls["n"], len(bodies) - 1)
+            calls["n"] += 1
+            return bodies[i]
+
+        class S(pw.Schema):
+            a: int
+
+        t = pw.io.http.read(
+            "http://x/feed",
+            schema=S,
+            poll_interval_ms=0,
+            request_fn=fake_get,
+        )
+        from pathway_tpu.internals.parse_graph import G
+        from pathway_tpu.engine.graph import Scheduler
+        from pathway_tpu.internals.runner import GraphRunner as GR
+
+        runner = GR()
+        node = runner.build(t)
+        sched = Scheduler(runner.scope)
+        for _ in range(3):
+            for d in runner.drivers:
+                d.poll()
+            sched.commit()
+        # same body re-polled: no duplicates; new body: replaces old rows
+        assert sorted(v[0] for v in node.current.values()) == [7]
+        G.clear()
