@@ -52,6 +52,7 @@ from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
 from pathway_tpu.stdlib import temporal  # noqa: E402
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.internals import udfs  # noqa: E402
 from pathway_tpu.internals.iterate import iterate  # noqa: E402
 from pathway_tpu.internals.sql import sql  # noqa: E402
@@ -118,6 +119,7 @@ __all__ = [
     "sql",
     "stdlib",
     "temporal",
+    "AsyncTransformer",
     "this",
     "udf",
     "UDF",

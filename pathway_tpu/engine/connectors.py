@@ -338,7 +338,10 @@ class InputDriver:
                 if values is not None and self.append_metadata:
                     values = values + (Json(dict(metadata)),)
                 if event.key is not None:
-                    key = ref_scalar(*event.key)
+                    if len(event.key) == 1 and isinstance(event.key[0], Pointer):
+                        key = event.key[0]  # loopback streams keep row ids
+                    else:
+                        key = ref_scalar(*event.key)
                 elif values is not None:
                     key = self._key_for(values, source_id, i)
                 else:

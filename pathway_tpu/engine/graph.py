@@ -866,11 +866,20 @@ class DeduplicateNode(Node):
 
 
 class FlattenNode(Node):
-    """Explode a sequence column into one row per element."""
+    """Explode a sequence column into one row per element; with
+    ``with_origin`` the source row id is appended as a final column
+    (reference flatten origin_id)."""
 
-    def __init__(self, scope: "Scope", source: Node, flat_col: int) -> None:
-        super().__init__(scope, [source], source.arity)
+    def __init__(
+        self,
+        scope: "Scope",
+        source: Node,
+        flat_col: int,
+        with_origin: bool = False,
+    ) -> None:
+        super().__init__(scope, [source], source.arity + (1 if with_origin else 0))
         self.flat_col = flat_col
+        self.with_origin = with_origin
 
     def _explode(self, key: Pointer, row: tuple) -> list[tuple[Pointer, tuple]]:
         value = row[self.flat_col]
@@ -888,6 +897,8 @@ class FlattenNode(Node):
         for i, element in enumerate(elements):
             new_key = hash_values((key, i), salt=b"flatten")
             new_row = row[: self.flat_col] + (element,) + row[self.flat_col + 1 :]
+            if self.with_origin:
+                new_row = new_row + (key,)
             out.append((new_key, new_row))
         return out
 
@@ -1332,8 +1343,10 @@ class Scope:
     ) -> Node:
         return DeduplicateNode(self, table, value_col, instance_cols, acceptor)
 
-    def flatten_table(self, table: Node, flat_col: int) -> Node:
-        return FlattenNode(self, table, flat_col)
+    def flatten_table(
+        self, table: Node, flat_col: int, with_origin: bool = False
+    ) -> Node:
+        return FlattenNode(self, table, flat_col, with_origin=with_origin)
 
     def sort_table(self, table: Node, key_col: int, instance_col: int | None) -> Node:
         return SortNode(self, table, key_col, instance_col)

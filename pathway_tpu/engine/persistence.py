@@ -358,7 +358,8 @@ class ObjectStoreBackend(PersistenceBackend):
             return b"".join(self.client.get_object(k) for k in chunks)
         try:
             return self.client.get_object(direct)
-        except KeyError:
+        except Exception:  # noqa: BLE001 — dict stores raise KeyError, boto3
+            # raises botocore ClientError(NoSuchKey); either way: cold start
             return b""
 
     def exists(self, name: str) -> bool:
@@ -367,5 +368,5 @@ class ObjectStoreBackend(PersistenceBackend):
         try:
             self.client.get_object(self._key(name))
             return True
-        except KeyError:
+        except Exception:  # noqa: BLE001 — see read()
             return False

@@ -22,6 +22,11 @@ if TYPE_CHECKING:
 class SinkSpec:
     table: "Table"
     attach: Callable[[Scope, Node], Any]  # returns optional driver
+    #: internal sinks (AsyncTransformer loopback subscriptions) are part of
+    #: the dataflow itself: debug captures must attach them to make their
+    #: loopback sources progress, while user output sinks stay registered
+    #: for the eventual pw.run()
+    internal: bool = False
 
 
 class ParseGraph:
@@ -29,8 +34,13 @@ class ParseGraph:
         self.sinks: list[SinkSpec] = []
         self.error_log_tables: list[Table] = []
 
-    def add_sink(self, table: "Table", attach: Callable[[Scope, Node], Any]) -> None:
-        self.sinks.append(SinkSpec(table, attach))
+    def add_sink(
+        self,
+        table: "Table",
+        attach: Callable[[Scope, Node], Any],
+        internal: bool = False,
+    ) -> None:
+        self.sinks.append(SinkSpec(table, attach, internal))
 
     def clear(self) -> None:
         self.sinks = []

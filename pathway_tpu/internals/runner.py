@@ -354,7 +354,11 @@ class GraphRunner:
         if kind == "flatten":
             base = spec.inputs[0]
             col_idx = base._column_names.index(spec.params["column"])
-            return scope.flatten_table(self.build(base), col_idx)
+            return scope.flatten_table(
+                self.build(base),
+                col_idx,
+                with_origin=spec.params.get("origin_id") is not None,
+            )
 
         if kind == "sort":
             base = spec.inputs[0]
@@ -817,14 +821,57 @@ class GraphRunner:
                     snapshot_mgr.on_commit(self.scope, self.drivers, time)
                 idle_spins = 0
             else:
-                idle_spins += 1
-                _time.sleep(min(0.001 * idle_spins, 0.05))
+                # only passive loopback sources left (AsyncTransformer):
+                # notify one whose subscribed upstream no live driver can
+                # still feed, so chained loopbacks drain upstream-first
+                notified = False
+                if drivers and all(
+                    getattr(d, "upstream_done", None) is not None
+                    for d in drivers
+                ):
+                    for d in drivers:
+                        if getattr(d, "_upstream_notified", False):
+                            continue
+                        if self._loopback_upstream_live(d, drivers):
+                            continue
+                        d._upstream_notified = True
+                        d.upstream_done()
+                        notified = True
+                        break
+                if not notified:
+                    idle_spins += 1
+                    _time.sleep(min(0.001 * idle_spins, 0.05))
         sched.finish()
         for driver in persistent:
             driver.on_commit(sched.time)
         if snapshot_mgr is not None:
             snapshot_mgr.snapshot(self.scope, self.drivers, sched.time)
         return sched
+
+    def _loopback_upstream_live(self, driver, remaining) -> bool:
+        """True when another still-running driver's input session can reach
+        this loopback's subscribed table — its results may yet produce new
+        rows for the subscription, so the loopback must stay open."""
+        upstream = getattr(driver, "upstream_table", None)
+        if upstream is None:
+            return False
+        node = self.build(upstream)
+        ancestors: set[int] = set()
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if id(n) in ancestors:
+                continue
+            ancestors.add(id(n))
+            stack.extend(n.inputs)
+        for other in remaining:
+            if other is driver:
+                continue
+            session = getattr(other, "session", None)
+            inner = getattr(session, "_session", session)
+            if inner is not None and id(inner) in ancestors:
+                return True
+        return False
 
     def _operator_snapshot_manager(self):
         if self.persistence is None:
@@ -843,6 +890,21 @@ class GraphRunner:
         )
 
     def capture(self, *tables: "Table") -> list[dict[Pointer, tuple]]:
+        from pathway_tpu.internals import parse_graph
+
         nodes = [self.build(t) for t in tables]
+        # attach + consume INTERNAL sinks only (AsyncTransformer loopback
+        # subscriptions — a capture without them would deadlock); user
+        # output sinks stay registered for the eventual pw.run()
+        remaining = []
+        for sink in parse_graph.G.sinks:
+            if not sink.internal:
+                remaining.append(sink)
+                continue
+            node = self.build(sink.table)
+            driver = sink.attach(self.scope, node)
+            if driver is not None:
+                self.drivers.append(driver)
+        parse_graph.G.sinks = remaining
         self.run()
         return [node.snapshot() for node in nodes]

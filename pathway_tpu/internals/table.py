@@ -573,7 +573,11 @@ class Table:
 
     # -- misc ops -----------------------------------------------------------
 
-    def flatten(self, to_flatten: Any, **kwargs: Any) -> "Table":
+    def flatten(
+        self, to_flatten: Any, *, origin_id: str | None = None, **kwargs: Any
+    ) -> "Table":
+        """Explode a sequence column; ``origin_id`` names an extra column
+        holding the source row's id (reference flatten origin_id)."""
         ref = resolve_this(to_flatten, self)
         assert isinstance(ref, ColumnReference)
         inner = self._dtypes.get(ref.name, dt.ANY)
@@ -590,8 +594,12 @@ class Table:
             n: (flat_dtype if n == ref.name else self._dtypes[n])
             for n in self._column_names
         }
+        if origin_id is not None:
+            dtypes[origin_id] = dt.Pointer()
         return self._derived(
-            TableSpec("flatten", [self], {"column": ref.name}),
+            TableSpec(
+                "flatten", [self], {"column": ref.name, "origin_id": origin_id}
+            ),
             dtypes,
         )
 

@@ -17,6 +17,7 @@ def subscribe(
     on_time_end: Callable[[int], Any] | None = None,
     *,
     skip_errors: bool = True,
+    _internal: bool = False,
 ) -> None:
     """Call ``on_change(key, row: dict, time, is_addition)`` for every update."""
     column_names = table.column_names()
@@ -36,4 +37,4 @@ def subscribe(
         )
         return None
 
-    G.add_sink(table, attach)
+    G.add_sink(table, attach, internal=_internal)

@@ -45,8 +45,15 @@ def input_table(
     source_name: str = "input",
     with_metadata: bool = False,
     persistent_id: str | None = None,
+    upstream_done: Callable[[], None] | None = None,
+    upstream_table: Table | None = None,
 ) -> Table:
-    """Create a connector-backed table (spec kind "input")."""
+    """Create a connector-backed table (spec kind "input").
+
+    ``upstream_done`` marks a *loopback* source (AsyncTransformer): its
+    reader only closes after the rest of the graph's inputs finish; the run
+    loop calls the hook at that point (in build order, so chained loopbacks
+    drain upstream-first)."""
     column_names = schema.column_names()
     dtypes = dict(schema.dtypes())
     if with_metadata:
@@ -69,6 +76,9 @@ def input_table(
             source_name=source_name,
             append_metadata=with_metadata,
         )
+        if upstream_done is not None:
+            driver.upstream_done = upstream_done
+            driver.upstream_table = upstream_table
         return session, driver
 
     return Table(

@@ -324,16 +324,18 @@ def read(
 
         def __init__(self) -> None:
             self._last_poll = 0.0
+            self._polled_once = False
             self._last_body: str | None = None
 
         def poll(self):
             now = _time.monotonic()
             if (
                 now - self._last_poll < poll_interval_ms / 1000.0
-                and self._last_body is not None
+                and self._polled_once
             ):
                 return [], False
             self._last_poll = now
+            self._polled_once = True
             delay = 0.5
             for attempt in range(n_retries + 1):
                 try:

@@ -16,9 +16,15 @@ from pathway_tpu.stdlib.indexing.data_index import (
     TpuKnnFactory,
 )
 from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25Factory
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    LshKnnFactory,
+    USearchKnnFactory,
+)
 
 __all__ = [
     "BruteForceKnnFactory",
+    "LshKnnFactory",
+    "USearchKnnFactory",
     "DataIndex",
     "InnerIndexFactory",
     "TantivyBM25Factory",

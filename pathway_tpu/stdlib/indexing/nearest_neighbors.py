@@ -1,0 +1,111 @@
+"""Inner-index factories with the dual query contract.
+
+Reference: stdlib/indexing/nearest_neighbors.py — ``query`` (fully
+incremental: index changes retract + update old answers; only LshKnn
+implements it, :262) vs ``query_as_of_now`` (answers frozen at arrival;
+USearch/BruteForce route through the engine as-of-now operator, :65/:170).
+Here the as-of-now path runs on the TPU HBM index (ops/knn.py); the
+incremental path is the pure-dataflow LSH pipeline
+(stdlib/ml/classifiers.py), which keeps revising answers because it is
+made of ordinary joins and groupbys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnReference, apply as pw_apply
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import (
+    BruteForceKnnFactory,
+    DataIndex,
+    InnerIndexFactory,
+    TpuKnnFactory,
+)
+
+USearchKnnFactory = BruteForceKnnFactory  # same HBM engine on TPU
+
+
+@dataclasses.dataclass
+class LshKnnFactory(InnerIndexFactory):
+    """Pure-dataflow LSH KNN supporting the incremental ``query`` contract
+    (reference LshKnn nearest_neighbors.py:262)."""
+
+    dimensions: int
+    L: int = 8
+    M: int = 8
+    A: float = 1.0
+    metric: str = "euclidean"  # or "cosine"
+
+    def build(self) -> Any:  # as-of-now engine path is not provided
+        raise NotImplementedError(
+            "LshKnn implements the incremental `query` contract; use "
+            "DataIndex.query(...) (reference: USearchKnn.query raises the "
+            "mirror error for query_as_of_now-only indexes)"
+        )
+
+
+def data_index_query(
+    index: DataIndex,
+    query_table: Table,
+    query_column: ColumnReference,
+    number_of_matches: int = 3,
+    metadata_filter_column: ColumnReference | None = None,
+) -> Table:
+    """Incremental retrieval: the result table updates when the *data*
+    changes, not only when queries arrive (SURVEY Appendix B `query`)."""
+    factory = index.factory
+    if not isinstance(factory, LshKnnFactory):
+        raise NotImplementedError(
+            "incremental query needs an LshKnnFactory index; as-of-now "
+            "indexes never revise answers (reference "
+            "nearest_neighbors.py:113-122)"
+        )
+    from pathway_tpu.stdlib.ml.classifiers import knn_lsh_classifier_train
+
+    data = index.data_table.select(
+        data=index.data_column,
+        **(
+            {"metadata": index.metadata_column}
+            if index.metadata_column is not None
+            else {}
+        ),
+    )
+    model = knn_lsh_classifier_train(
+        data,
+        L=factory.L,
+        type=factory.metric,
+        d=factory.dimensions,
+        M=factory.M,
+        A=factory.A,
+    )
+    qsel = {"data": query_column}
+    if metadata_filter_column is not None:
+        qsel["metadata_filter"] = metadata_filter_column
+    queries = query_table.select(
+        **qsel, k=pw_apply(lambda _d: number_of_matches, query_column)
+    )
+    result = model(queries, with_distances=True)
+    return result.select(
+        _pw_index_reply_ids=pw_apply(
+            lambda pairs: tuple(p for p, _d in pairs),
+            result["knns_ids_with_dists"],
+        ),
+        _pw_index_reply_scores=pw_apply(
+            # scores are negated distances: higher is better, like the
+            # engine index replies
+            lambda pairs: tuple(-d for _p, d in pairs),
+            result["knns_ids_with_dists"],
+        ),
+    )
+
+
+# surface the incremental contract as a DataIndex method
+def _query(self, query_table, query_column, number_of_matches=3, metadata_filter_column=None):
+    return data_index_query(
+        self, query_table, query_column, number_of_matches, metadata_filter_column
+    )
+
+
+DataIndex.query = _query

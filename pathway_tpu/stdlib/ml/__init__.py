@@ -57,3 +57,16 @@ class KNNIndex:
 
     def get_nearest_items_asof_now(self, *args: Any, **kwargs: Any) -> Table:
         return self.get_nearest_items(*args, **kwargs)
+
+
+from pathway_tpu.stdlib.ml import classifiers, hmm, smart_table_ops  # noqa: E402
+from pathway_tpu.stdlib.ml.classifiers import (  # noqa: E402
+    knn_lsh_classifier_train,
+    knn_lsh_classify,
+    knn_lsh_generic_classifier_train,
+)
+from pathway_tpu.stdlib.ml.smart_table_ops import (  # noqa: E402
+    fuzzy_match_tables,
+    fuzzy_self_match,
+    smart_fuzzy_match,
+)

@@ -1,0 +1,50 @@
+"""LSH bucketers: map vectors to L band-bucket ids.
+
+Reference: stdlib/ml/classifiers/_lsh.py — random projections, M ANDs per
+band, L ORs (bands), fingerprinted to one integer per band.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def _fingerprint(arr: np.ndarray) -> int:
+    digest = hashlib.blake2s(
+        np.ascontiguousarray(arr, dtype=np.int64).tobytes(), digest_size=4
+    ).digest()
+    return int.from_bytes(digest, "little", signed=True)
+
+
+def generate_euclidean_lsh_bucketer(d: int, M: int, L: int, A: float = 1.0, seed: int = 0):
+    """Euclidean LSH: project on M*L random unit lines, bucketize by length
+    A, fingerprint each band of M lines (reference _lsh.py:31)."""
+    gen = np.random.default_rng(seed=seed)
+    total = M * L
+    lines = gen.standard_normal((d, total))
+    lines = lines / np.linalg.norm(lines, axis=0)
+    shift = gen.random(size=total) * A
+
+    def bucketify(x) -> tuple:
+        x = np.asarray(x, dtype=np.float64).reshape(d)
+        buckets = np.floor_divide(x @ lines + shift, A).astype(np.int64)
+        return tuple(_fingerprint(band) for band in np.split(buckets, L))
+
+    return bucketify
+
+
+def generate_cosine_lsh_bucketer(d: int, M: int, L: int, seed: int = 0):
+    """Cosine LSH: sign patterns of M*L random hyperplanes
+    (reference _lsh.py:59)."""
+    gen = np.random.default_rng(seed=seed)
+    total = M * L
+    planes = gen.standard_normal((d, total))
+
+    def bucketify(x) -> tuple:
+        x = np.asarray(x, dtype=np.float64).reshape(d)
+        signs = (x @ planes >= 0).astype(np.int64)
+        return tuple(_fingerprint(band) for band in np.split(signs, L))
+
+    return bucketify

@@ -1,0 +1,241 @@
+"""LSH k-approximate nearest neighbors — pure dataflow, fully incremental.
+
+Reference: stdlib/ml/classifiers/_knn_lsh.py:136-320. Because the whole
+pipeline is ordinary joins/groupbys/UDFs, answers to *old* queries are
+retracted and re-emitted whenever the data changes — this is the
+incremental ``query`` contract the engine's as-of-now index deliberately
+does not provide (SURVEY Appendix B).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals import jmespath_lite
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import (
+    apply as pw_apply,
+    coalesce,
+)
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.ml._lsh import (
+    generate_cosine_lsh_bucketer,
+    generate_euclidean_lsh_bucketer,
+)
+
+
+def _euclidean_distance(candidates: np.ndarray, query: np.ndarray) -> np.ndarray:
+    return np.sum((candidates - query) ** 2, axis=1).astype(float)
+
+
+def _cosine_distance(candidates: np.ndarray, query: np.ndarray) -> np.ndarray:
+    return 1 - candidates @ query / (
+        np.linalg.norm(candidates, axis=1) * np.linalg.norm(query)
+    )
+
+
+def knn_lsh_classifier_train(
+    data: Table,
+    L: int,
+    type: str = "euclidean",  # noqa: A002
+    **kwargs: Any,
+) -> Callable:
+    """Index ``data`` (column ``data``: vector; optional ``metadata``);
+    returns ``lsh_perform_query(queries, k=None, with_distances=False)``
+    (reference _knn_lsh.py:64)."""
+    if type == "euclidean":
+        bucketer = generate_euclidean_lsh_bucketer(
+            kwargs["d"], kwargs["M"], L, kwargs.get("A", 1.0)
+        )
+        return knn_lsh_generic_classifier_train(
+            data, bucketer, _euclidean_distance, L
+        )
+    if type == "cosine":
+        bucketer = generate_cosine_lsh_bucketer(kwargs["d"], kwargs["M"], L)
+        return knn_lsh_generic_classifier_train(
+            data, bucketer, _cosine_distance, L
+        )
+    raise ValueError(f"unsupported LSH distance type {type!r}")
+
+
+def knn_lsh_generic_classifier_train(
+    data: Table, lsh_projection: Callable, distance_function: Callable, L: int
+) -> Callable:
+    has_meta = "metadata" in data.column_names()
+    indexed = data.select(
+        data=data.data,
+        _pw_meta=data["metadata"]
+        if has_meta
+        else pw_apply(lambda _d: None, data.data),
+        _pw_buckets=pw_apply(lsh_projection, data.data),
+    )
+
+    # per band: bucket -> sorted tuple of member row ids
+    bands = []
+    for b in range(L):
+        banded = indexed.select(
+            _pw_band=indexed["_pw_buckets"].get(b),
+        )
+        bands.append(
+            banded.groupby(banded["_pw_band"]).reduce(
+                _pw_band=banded["_pw_band"],
+                items=reducers.sorted_tuple(banded.id),
+            )
+        )
+
+    def lsh_perform_query(
+        queries: Table, k: int | None = None, with_distances: bool = False
+    ) -> Table:
+        qcols = queries.column_names()
+        q = queries.select(
+            data=queries.data,
+            _pw_k=queries["k"] if k is None else pw_apply(lambda _d: k, queries.data),
+            _pw_filter=queries["metadata_filter"]
+            if "metadata_filter" in qcols
+            else pw_apply(lambda _d: None, queries.data),
+            _pw_buckets=pw_apply(lsh_projection, queries.data),
+        )
+        # per band, look up the query's bucket members (empty when absent)
+        merged = q
+        for b, band_tbl in enumerate(bands):
+            qb = merged.select(
+                **{n: merged[n] for n in merged.column_names()},
+                _pw_band=merged["_pw_buckets"].get(b),
+            )
+            hit = qb.join(
+                band_tbl,
+                qb["_pw_band"] == band_tbl["_pw_band"],
+                id=qb.id,
+            ).select(
+                **{n: qb[n] for n in merged.column_names()},
+                **{f"_pw_items_{b}": band_tbl.items},
+            )
+            base = qb.select(
+                **{n: qb[n] for n in merged.column_names()},
+                **{f"_pw_items_{b}": pw_apply(lambda _d: (), qb.data)},
+            )
+            merged = base.update_rows(hit)
+
+        def merge_buckets(*tuples: tuple) -> tuple:
+            seen: dict = {}
+            for t in tuples:
+                for p in t:
+                    seen[p] = None
+            return tuple(seen)
+
+        flattened = merged.select(
+            data=merged.data,
+            _pw_k=merged["_pw_k"],
+            _pw_filter=merged["_pw_filter"],
+            _pw_ids=pw_apply(
+                merge_buckets,
+                *[merged[f"_pw_items_{b}"] for b in range(L)],
+            ),
+        )
+        nonempty = flattened.filter(
+            pw_apply(lambda ids: ids != (), flattened["_pw_ids"])
+        )
+        exploded = nonempty.flatten(nonempty["_pw_ids"], origin_id="_pw_origin")
+        fetched = indexed.ix(exploded["_pw_ids"])
+        cands = exploded.select(
+            _pw_origin=exploded["_pw_origin"],
+            _pw_cand_id=exploded["_pw_ids"],
+            _pw_cand_data=fetched.data,
+            _pw_cand_meta=fetched["_pw_meta"],
+        )
+        regrouped = cands.groupby(id=cands["_pw_origin"]).reduce(
+            _pw_cand_ids=reducers.tuple(cands["_pw_cand_id"]),
+            _pw_cand_datas=reducers.tuple(cands["_pw_cand_data"]),
+            _pw_cand_metas=reducers.tuple(cands["_pw_cand_meta"]),
+        )
+        from pathway_tpu.internals.universe import solver
+
+        # group keys are nonempty's row ids (groupby id=origin)
+        solver.register_subset(regrouped._universe, nonempty._universe)
+
+        def knns(query_vec, cand_ids, cand_datas, cand_metas, meta_filter, kk):
+            try:
+                picked = [
+                    (cid, cdata)
+                    for cid, cdata, cmeta in zip(cand_ids, cand_datas, cand_metas)
+                    if meta_filter is None
+                    or jmespath_lite.search(
+                        meta_filter,
+                        cmeta.value if hasattr(cmeta, "value") else cmeta,
+                    )
+                    is True
+                ]
+            except jmespath_lite.JMESPathError:
+                picked = []
+            if not picked:
+                return ()
+            ids, vecs = zip(*picked)
+            arr = np.asarray(vecs, dtype=np.float64)
+            dists = distance_function(arr, np.asarray(query_vec, np.float64))
+            order = np.argsort(dists, kind="stable")[: int(kk)]
+            return tuple((ids[i], float(dists[i])) for i in order)
+
+        answered = nonempty.restrict(regrouped).select(
+            _pw_knns=pw_apply(
+                knns,
+                nonempty.data,
+                regrouped["_pw_cand_ids"],
+                regrouped["_pw_cand_datas"],
+                regrouped["_pw_cand_metas"],
+                nonempty["_pw_filter"],
+                nonempty["_pw_k"],
+            ),
+        )
+        result = q.join(
+            answered, q.id == answered.id, id=q.id, how="left"
+        ).select(
+            query_id=q.id,
+            knns_ids_with_dists=coalesce(answered["_pw_knns"], ()),
+        )
+        if with_distances:
+            return result
+        return result.select(
+            query_id=result["query_id"],
+            knns_ids=pw_apply(
+                lambda pairs: tuple(p for p, _d in pairs),
+                result["knns_ids_with_dists"],
+            ),
+        )
+
+    return lsh_perform_query
+
+
+def knn_lsh_classify(
+    knn_model: Callable, data_labels: Table, queries: Table, k: int
+) -> Table:
+    """Majority label among the k approximate neighbors
+    (reference _knn_lsh.py:309 knn_lsh_classify)."""
+    knns = knn_model(queries, k)
+    exploded = knns.filter(
+        pw_apply(lambda ids: ids != (), knns["knns_ids"])
+    )
+    flat = exploded.flatten(exploded["knns_ids"], origin_id="_pw_origin")
+    labels = data_labels.ix(flat["knns_ids"])
+    pairs = flat.select(
+        _pw_origin=flat["_pw_origin"],
+        label=labels[data_labels.column_names()[0]],
+    )
+
+    def majority(labels_tuple: tuple):
+        from statistics import mode
+
+        return mode(labels_tuple)
+
+    return (
+        pairs.groupby(id=pairs["_pw_origin"])
+        .reduce(_pw_labels=reducers.tuple(pairs.label))
+        .select(predicted_label=pw_apply(majority, pw_this_labels()))
+    )
+
+
+def pw_this_labels():
+    from pathway_tpu.internals.thisclass import this
+
+    return this["_pw_labels"]

@@ -1,0 +1,75 @@
+"""Hidden Markov Model decoding as a stateful reducer.
+
+Reference: stdlib/ml/hmm.py:11 create_hmm_reducer — the HMM is a networkx
+DiGraph whose nodes carry ``calc_emission_log_ppb(observation)`` and whose
+edges carry ``log_transition_ppb``; the reducer Viterbi-decodes the
+observation stream of each group and emits the most likely state path.
+Use with ``pw.reducers.stateful_single``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def create_hmm_reducer(
+    graph: Any,
+    beam_size: int | None = None,
+    num_results_kept: int | None = None,
+) -> Callable[[list], tuple]:
+    """Returns ``decode(observations) -> tuple[state, ...]`` for use as a
+    stateful reducer combine function."""
+    states = list(graph.nodes)
+    emission = {
+        s: graph.nodes[s]["calc_emission_log_ppb"] for s in states
+    }
+    transitions: dict[Any, list[tuple[Any, float]]] = {s: [] for s in states}
+    for u, v, attrs in graph.edges(data=True):
+        transitions[v].append((u, attrs["log_transition_ppb"]))
+    start_nodes = list(graph.graph.get("start_nodes", states))
+
+    def decode(observations: list) -> tuple:
+        if not observations:
+            return ()
+        # Viterbi over the observation sequence
+        neg_inf = float("-inf")
+        scores: dict[Any, float] = {}
+        paths: dict[Any, tuple] = {}
+        first = observations[0]
+        for s in start_nodes:
+            e = emission[s](first)
+            if e is not None:
+                scores[s] = e
+                paths[s] = (s,)
+        for obs in observations[1:]:
+            new_scores: dict[Any, float] = {}
+            new_paths: dict[Any, tuple] = {}
+            for s in states:
+                best_prev, best_score = None, neg_inf
+                for prev, logp in transitions[s]:
+                    prev_score = scores.get(prev, neg_inf)
+                    if prev_score + logp > best_score:
+                        best_prev, best_score = prev, prev_score + logp
+                if best_prev is None:
+                    continue
+                e = emission[s](obs)
+                if e is None:
+                    continue
+                new_scores[s] = best_score + e
+                new_paths[s] = paths[best_prev] + (s,)
+            if beam_size is not None and len(new_scores) > beam_size:
+                kept = sorted(
+                    new_scores, key=lambda st: new_scores[st], reverse=True
+                )[:beam_size]
+                new_scores = {st: new_scores[st] for st in kept}
+                new_paths = {st: new_paths[st] for st in kept}
+            scores, paths = new_scores, new_paths
+            if not scores:
+                return ()
+        best = max(scores, key=lambda st: scores[st])
+        path = paths[best]
+        if num_results_kept is not None:
+            path = path[-num_results_kept:]
+        return path
+
+    return decode

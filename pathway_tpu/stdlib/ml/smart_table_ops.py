@@ -1,0 +1,187 @@
+"""Fuzzy joins: feature-weighted record matching as incremental dataflow.
+
+Reference: stdlib/ml/smart_table_ops/_fuzzy_join.py — rows are tokenized
+into features, features weighted by inverse frequency, pair weight = sum
+of shared-feature weights, and the returned matching keeps mutual-best
+pairs (each kept pair is the heaviest for both its left and its right
+row). Being plain joins/groupbys, matches revise automatically as rows
+arrive or leave.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import re
+from typing import Any, Callable
+
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import apply as pw_apply, make_tuple
+from pathway_tpu.internals.table import Table
+
+
+class FuzzyJoinFeatureGeneration(enum.IntEnum):
+    AUTO = 0
+    TOKENIZE = 1
+    LETTERS = 2
+
+
+class FuzzyJoinNormalization(enum.IntEnum):
+    WEIGHT = 0
+    LOG_WEIGHT = 1
+    NONE = 2
+
+
+def _tokenize(obj: Any) -> tuple:
+    return tuple(re.findall(r"\w+", str(obj).lower()))
+
+
+def _letters(obj: Any) -> tuple:
+    return tuple(c for c in str(obj).lower() if c.isalnum())
+
+
+def _discrete_weight(cnt: float) -> float:
+    """Reference _fuzzy_join.py:60: rare features dominate, very common
+    features contribute nothing."""
+    if cnt <= 1:
+        return 10.0
+    if cnt <= 3:
+        return 5.0
+    if cnt <= 100:
+        return 1.0
+    return 0.0
+
+
+def _log_weight(cnt: float) -> float:
+    return 1.0 / math.log(1.0 + cnt) if cnt > 0 else 0.0
+
+
+_GENERATORS: dict[int, Callable[[Any], tuple]] = {
+    FuzzyJoinFeatureGeneration.AUTO: _tokenize,
+    FuzzyJoinFeatureGeneration.TOKENIZE: _tokenize,
+    FuzzyJoinFeatureGeneration.LETTERS: _letters,
+}
+
+_WEIGHTS: dict[int, Callable[[float], float]] = {
+    FuzzyJoinNormalization.WEIGHT: _discrete_weight,
+    FuzzyJoinNormalization.LOG_WEIGHT: _log_weight,
+    FuzzyJoinNormalization.NONE: lambda _c: 1.0,
+}
+
+
+def _features_of(table: Table, generator: Callable[[Any], tuple]) -> Table:
+    cols = table.column_names()
+
+    def concat_row(*values: Any) -> tuple:
+        return tuple(
+            tok for v in values if v is not None for tok in generator(v)
+        )
+
+    feats = table.select(
+        _pw_feats=pw_apply(concat_row, *[table[c] for c in cols])
+    )
+    flat = feats.flatten(feats["_pw_feats"], origin_id="_pw_node")
+    return flat.select(
+        feature=flat["_pw_feats"], node=flat["_pw_node"]
+    )
+
+
+def fuzzy_match_tables(
+    left_table: Table,
+    right_table: Table,
+    *,
+    by_hand_match: Table | None = None,
+    feature_generation: FuzzyJoinFeatureGeneration = FuzzyJoinFeatureGeneration.AUTO,
+    normalization: FuzzyJoinNormalization = FuzzyJoinNormalization.WEIGHT,
+) -> Table:
+    """-> table(left: Pointer, right: Pointer, weight: float) of
+    mutual-best fuzzy matches (reference fuzzy_match_tables :106)."""
+    generator = _GENERATORS[feature_generation]
+    weight_fn = _WEIGHTS[normalization]
+
+    lf = _features_of(left_table, generator)
+    rf = _features_of(right_table, generator)
+
+    both = lf.select(feature=lf.feature).concat_reindex(
+        rf.select(feature=rf.feature)
+    )
+    counts = both.groupby(both.feature).reduce(
+        feature=both.feature, cnt=reducers.count()
+    )
+
+    lw = lf.join(counts, lf.feature == counts.feature).select(
+        feature=lf.feature,
+        node=lf.node,
+        w=pw_apply(weight_fn, counts.cnt),
+    )
+    pairs = lw.join(rf, lw.feature == rf.feature).select(
+        left=lw.node, right=rf.node, w=lw.w
+    )
+    scored = pairs.groupby(pairs.left, pairs.right).reduce(
+        left=pairs.left,
+        right=pairs.right,
+        weight=reducers.sum(pairs.w),
+    )
+    # mutual-best: a pair survives when it is the heaviest (deterministic
+    # tie-break by pair id) for both endpoints
+    ranked = scored.select(
+        left=scored.left,
+        right=scored.right,
+        weight=scored.weight,
+        _pw_rank=make_tuple(scored.weight, scored.id),
+    )
+    best_l = ranked.groupby(ranked.left).reduce(
+        left=ranked.left, best=reducers.max(ranked["_pw_rank"])
+    )
+    best_r = ranked.groupby(ranked.right).reduce(
+        right=ranked.right, best=reducers.max(ranked["_pw_rank"])
+    )
+    with_l = ranked.join(best_l, ranked.left == best_l.left, id=ranked.id).select(
+        left=ranked.left,
+        right=ranked.right,
+        weight=ranked.weight,
+        _pw_rank=ranked["_pw_rank"],
+        _pw_best_l=best_l.best,
+    )
+    with_lr = with_l.join(
+        best_r, with_l.right == best_r.right, id=with_l.id
+    ).select(
+        left=with_l.left,
+        right=with_l.right,
+        weight=with_l.weight,
+        _pw_ok=pw_apply(
+            lambda rank, bl, br: rank == bl and rank == br,
+            with_l["_pw_rank"],
+            with_l["_pw_best_l"],
+            best_r.best,
+        ),
+    )
+    return with_lr.filter(with_lr["_pw_ok"])[["left", "right", "weight"]]
+
+
+def fuzzy_self_match(
+    table: Table,
+    **kwargs: Any,
+) -> Table:
+    """Match a table against itself (reference fuzzy_self_match :249)."""
+    other = table.select(**{c: table[c] for c in table.column_names()})
+    matched = fuzzy_match_tables(table, other, **kwargs)
+    # drop self-pairs: same source row matched to its own copy
+    copies = other.select(_pw_orig=pw_apply(lambda *_a: None, *[other[c] for c in other.column_names()]))
+    return matched.filter(
+        pw_apply(lambda l, r: l != r, matched.left, matched.right)
+    )
+
+
+def smart_fuzzy_match(
+    left_column: Any,
+    right_column: Any,
+    **kwargs: Any,
+) -> Table:
+    """Column-level convenience wrapper (reference smart_fuzzy_match :199)."""
+    left = left_column.table.select(data=left_column)
+    right = right_column.table.select(data=right_column)
+    return fuzzy_match_tables(left, right, **kwargs)
+
+
+fuzzy_match = fuzzy_match_tables

@@ -14,6 +14,7 @@ import dataclasses
 from typing import Any, Sequence
 
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import reducers as _reducers
 from pathway_tpu.internals.expression import (
     ColumnExpression,
     apply as pw_apply,
@@ -106,6 +107,53 @@ def session(max_gap: Any) -> SessionWindow:
     return SessionWindow(max_gap)
 
 
+def _assign_windows(
+    table: Table, time_expr: Any, window: Any, instance: Any
+) -> Table:
+    """Window assignment: every row gains ``_pw_time``/``_pw_instance``/
+    ``_pw_window_start``/``_pw_window_end`` (sliding windows flatten rows
+    into one copy per containing window). Shared by ``windowby`` and
+    ``window_join``."""
+    t = table
+    base_cols = {n: t[n] for n in t.column_names()}
+    inst_expr = (
+        instance if instance is not None else pw_apply(lambda _t: 0, time_expr)
+    )
+    if isinstance(window, SessionWindow):
+        pre = t.select(**base_cols, _pw_time=time_expr, _pw_instance=inst_expr)
+        n = len(pre.column_names())
+        return pre._derived(
+            TableSpec(
+                "session_assign",
+                [pre],
+                {
+                    "time_col": n - 2,
+                    "instance_col": n - 1,
+                    "max_gap": window.max_gap,
+                },
+            ),
+            {
+                **{c: pre._dtypes[c] for c in pre.column_names()},
+                "_pw_window_start": dt.ANY,
+                "_pw_window_end": dt.ANY,
+            },
+        )
+    pre = t.select(
+        **base_cols,
+        _pw_time=time_expr,
+        _pw_instance=inst_expr,
+        _pw_windows=pw_apply(lambda tv: window.assign(tv), time_expr),
+    )
+    flat = pre.flatten(pre["_pw_windows"])
+    return flat.select(
+        **{n: flat[n] for n in t.column_names()},
+        _pw_time=flat["_pw_time"],
+        _pw_instance=flat["_pw_instance"],
+        _pw_window_start=flat["_pw_windows"].get(0),
+        _pw_window_end=flat["_pw_windows"].get(1),
+    )
+
+
 class WindowedTable:
     """`t.windowby(...)`; materialize with `.reduce(**aggregations)`.
 
@@ -128,49 +176,8 @@ class WindowedTable:
         self.behavior = behavior
 
     def _assigned(self) -> Table:
-        t = self.table
-        base_cols = {n: t[n] for n in t.column_names()}
-        inst_expr = (
-            self.instance
-            if self.instance is not None
-            else pw_apply(lambda _t: 0, self.time_expr)
-        )
-        if isinstance(self.window, SessionWindow):
-            pre = t.select(
-                **base_cols, _pw_time=self.time_expr, _pw_instance=inst_expr
-            )
-            n = len(pre.column_names())
-            assigned = pre._derived(
-                TableSpec(
-                    "session_assign",
-                    [pre],
-                    {
-                        "time_col": n - 2,
-                        "instance_col": n - 1,
-                        "max_gap": self.window.max_gap,
-                    },
-                ),
-                {
-                    **{c: pre._dtypes[c] for c in pre.column_names()},
-                    "_pw_window_start": dt.ANY,
-                    "_pw_window_end": dt.ANY,
-                },
-            )
-            return assigned
-        window = self.window
-        pre = t.select(
-            **base_cols,
-            _pw_time=self.time_expr,
-            _pw_instance=inst_expr,
-            _pw_windows=pw_apply(lambda tv: window.assign(tv), self.time_expr),
-        )
-        flat = pre.flatten(pre["_pw_windows"])
-        return flat.select(
-            **{n: flat[n] for n in t.column_names()},
-            _pw_time=flat["_pw_time"],
-            _pw_instance=flat["_pw_instance"],
-            _pw_window_start=flat["_pw_windows"].get(0),
-            _pw_window_end=flat["_pw_windows"].get(1),
+        return _assign_windows(
+            self.table, self.time_expr, self.window, self.instance
         )
 
     def _lowered_behavior(self) -> CommonBehavior | None:
@@ -244,6 +251,8 @@ class WindowedTable:
         return out
 
     def reduce(self, *args: Any, **kwargs: Any) -> Table:
+        if isinstance(self.window, IntervalsOverWindow):
+            return self._reduce_intervals_over(*args, **kwargs)
         assigned = self._behaved(self._assigned())
         grouped = assigned.groupby(
             assigned["_pw_window_start"],
@@ -257,6 +266,70 @@ class WindowedTable:
             resolved = _retarget(arg, self.table, assigned)
             resolved_kwargs[resolved.name] = resolved
         return grouped.reduce(**resolved_kwargs)
+
+    def _reduce_intervals_over(self, *args: Any, **kwargs: Any) -> Table:
+        """intervals_over windows: one group per value of ``at`` containing
+        rows with time in [at + lower, at + upper]; with is_outer, empty
+        windows surface with None aggregates (reference _window.py:771)."""
+        w = self.window
+        if self.instance is not None:
+            raise NotImplementedError(
+                "intervals_over does not support instance="
+            )
+        at_ref = w.at
+        at_table = at_ref.table
+        lb, ub = w.lower_bound, w.upper_bound
+        probe = at_table.select(_pw_at=at_ref)
+        joined = interval_join(
+            probe,
+            self.table,
+            probe["_pw_at"],
+            self.time_expr,
+            interval(lb, ub),
+            how="inner",
+        )
+        flat = joined.select(
+            *[self.table[n] for n in self.table.column_names()],
+            _pw_window_start=pw_apply(lambda p: p + lb, probe["_pw_at"]),
+            _pw_window_end=pw_apply(lambda p: p + ub, probe["_pw_at"]),
+        )
+        grouped = flat.groupby(
+            flat["_pw_window_start"], flat["_pw_window_end"]
+        )
+        resolved_kwargs = {}
+        for arg in args:
+            resolved = _retarget(arg, self.table, flat)
+            resolved_kwargs[resolved.name] = resolved
+        for name, value in kwargs.items():
+            resolved_kwargs[name] = _retarget(value, self.table, flat)
+        user_names = list(resolved_kwargs)
+        # the bounds always ride along (needed to match empty windows back)
+        resolved_kwargs.setdefault("_pw_window_start", flat["_pw_window_start"])
+        resolved_kwargs.setdefault("_pw_window_end", flat["_pw_window_end"])
+        reduced = grouped.reduce(**resolved_kwargs)
+        if not w.is_outer:
+            return reduced[user_names]
+        # outer: every at-value yields a window even when empty
+        windows = probe.groupby(probe["_pw_at"]).reduce(
+            _pw_at=probe["_pw_at"]
+        )
+        windows = windows.select(
+            _pw_window_start=pw_apply(lambda p: p + lb, windows["_pw_at"]),
+            _pw_window_end=pw_apply(lambda p: p + ub, windows["_pw_at"]),
+        )
+        join = windows.join(
+            reduced,
+            windows["_pw_window_start"] == reduced["_pw_window_start"],
+            windows["_pw_window_end"] == reduced["_pw_window_end"],
+            how="left",
+        )
+        out_cols = {}
+        for n in user_names:
+            if n in ("_pw_window_start", "_pw_window_end"):
+                out_cols[n] = windows[n]
+            else:
+                out_cols[n] = reduced[n]
+        return join.select(**out_cols)
 
 
 def _retarget(expression: Any, source: Table, target: Table) -> Any:
@@ -449,3 +522,222 @@ def asof_now_join(
 
 def asof_now_join_left(left, right, *on):
     return asof_now_join(left, right, *on, how="left")
+
+
+# -- window join --------------------------------------------------------------
+
+
+class WindowJoinResult:
+    """Result of ``window_join``: records of both sides sharing a window
+    (and satisfying the ``on`` equalities) are joined; ``.select()``
+    accepts references to the original tables plus pw.left/pw.right
+    (reference: _window_join.py:24 WindowJoinResult)."""
+
+    def __init__(
+        self,
+        orig_left: Table,
+        orig_right: Table,
+        left_assigned: Table,
+        right_assigned: Table,
+        conds: list,
+        how: str,
+    ) -> None:
+        from pathway_tpu.internals.joins import JoinResult
+
+        self._orig_left = orig_left
+        self._orig_right = orig_right
+        self._left_assigned = left_assigned
+        self._right_assigned = right_assigned
+        self._join = JoinResult(left_assigned, right_assigned, tuple(conds), how)
+
+    def _retarget_both(self, expression: Any) -> Any:
+        e = _retarget(expression, self._orig_left, self._left_assigned)
+        # second pass: rewrite right-table refs (left pass left them alone)
+        from pathway_tpu.internals.desugaring import substitute
+        from pathway_tpu.internals.expression import ColumnReference
+
+        def replace(x: Any) -> Any:
+            if isinstance(x, ColumnReference) and x.table is self._orig_right:
+                return ColumnReference(self._right_assigned, x.name)
+            return None
+
+        return substitute(e, replace)
+
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        from pathway_tpu.internals.expression import ColumnReference
+
+        out_args = []
+        for arg in args:
+            r = self._retarget_both(arg)
+            if not isinstance(r, ColumnReference):
+                raise ValueError("positional args must be column references")
+            out_args.append(r)
+        out_kwargs = {
+            name: self._retarget_both(v) for name, v in kwargs.items()
+        }
+        return self._join.select(*out_args, **out_kwargs)
+
+
+def _session_window_sides(
+    left: Table,
+    right: Table,
+    left_time: Any,
+    right_time: Any,
+    window: SessionWindow,
+    on_pairs: list,
+    linst: Any,
+    rinst: Any,
+) -> tuple[Table, Table]:
+    """Sessions span the *union* of both sides' records per (instance,
+    on-values) group (reference _window_join.py session path)."""
+    lt = resolve_this(left_time, left)
+    rt = resolve_this(right_time, right)
+    lgrp = make_tuple(
+        linst if linst is not None else wrap_expression(0),
+        *[lexpr for lexpr, _r in on_pairs],
+    )
+    rgrp = make_tuple(
+        rinst if rinst is not None else wrap_expression(0),
+        *[rexpr for _l, rexpr in on_pairs],
+    )
+    lg = left.select(_pw_t=lt, _pw_grp=lgrp)
+    rg = right.select(_pw_t=rt, _pw_grp=rgrp)
+    merged = lg.concat_reindex(rg)
+    n = len(merged.column_names())
+    assigned = merged._derived(
+        TableSpec(
+            "session_assign",
+            [merged],
+            {"time_col": 0, "instance_col": 1, "max_gap": window.max_gap},
+        ),
+        {
+            **{c: merged._dtypes[c] for c in merged.column_names()},
+            "_pw_window_start": dt.ANY,
+            "_pw_window_end": dt.ANY,
+        },
+    )
+    sess = assigned.groupby(assigned["_pw_t"], assigned["_pw_grp"]).reduce(
+        _pw_t=assigned["_pw_t"],
+        _pw_grp=assigned["_pw_grp"],
+        _pw_window_start=_reducers.min(assigned["_pw_window_start"]),
+        _pw_window_end=_reducers.min(assigned["_pw_window_end"]),
+    )
+
+    def attach(table: Table, t_expr: Any, grp_expr: Any) -> Table:
+        base = table.select(
+            **{n_: table[n_] for n_ in table.column_names()},
+            _pw_t=t_expr,
+            _pw_grp=grp_expr,
+        )
+        joined = base.join(
+            sess,
+            base["_pw_t"] == sess["_pw_t"],
+            base["_pw_grp"] == sess["_pw_grp"],
+            id=base.id,
+        )
+        return joined.select(
+            *[base[n_] for n_ in table.column_names()],
+            _pw_instance=base["_pw_grp"],
+            _pw_window_start=sess["_pw_window_start"],
+            _pw_window_end=sess["_pw_window_end"],
+        )
+
+    return attach(left, lt, lgrp), attach(right, rt, rgrp)
+
+
+def window_join(
+    left: Table,
+    right: Table,
+    left_time: Any,
+    right_time: Any,
+    window: Any,
+    *on: Any,
+    how: str = "inner",
+    left_instance: Any = None,
+    right_instance: Any = None,
+) -> WindowJoinResult:
+    """Join records that fall into the same window (reference:
+    _window_join.py:156). Sliding windows join matching pairs once per
+    shared window; session windows build sessions over the union of both
+    sides."""
+    from pathway_tpu.internals.desugaring import resolve_join_sides
+    from pathway_tpu.internals.expression import BinaryOpExpression
+
+    if left is right:
+        raise ValueError(
+            "window self-joins need distinct table objects; derive a copy "
+            "first (e.g. right = left.select(*left))"
+        )
+    on_pairs = []
+    for cond in on:
+        resolved = resolve_join_sides(cond, left, right)
+        if not (
+            isinstance(resolved, BinaryOpExpression) and resolved._op == "=="
+        ):
+            raise ValueError("window_join conditions must be equalities")
+        on_pairs.append((resolved._left, resolved._right))
+    linst = resolve_this(left_instance, left) if left_instance is not None else None
+    rinst = (
+        resolve_this(right_instance, right) if right_instance is not None else None
+    )
+
+    if isinstance(window, SessionWindow):
+        la, ra = _session_window_sides(
+            left, right, left_time, right_time, window, on_pairs, linst, rinst
+        )
+        conds = [
+            la["_pw_window_start"] == ra["_pw_window_start"],
+            la["_pw_window_end"] == ra["_pw_window_end"],
+            la["_pw_instance"] == ra["_pw_instance"],
+        ]
+        return WindowJoinResult(left, right, la, ra, conds, how)
+
+    la = _assign_windows(left, resolve_this(left_time, left), window, linst)
+    ra = _assign_windows(right, resolve_this(right_time, right), window, rinst)
+    conds = [
+        la["_pw_window_start"] == ra["_pw_window_start"],
+        la["_pw_window_end"] == ra["_pw_window_end"],
+        la["_pw_instance"] == ra["_pw_instance"],
+    ]
+    for lexpr, rexpr in on_pairs:
+        conds.append(
+            _retarget(lexpr, left, la) == _retarget(rexpr, right, ra)
+        )
+    return WindowJoinResult(left, right, la, ra, conds, how)
+
+
+def window_join_inner(left, right, lt, rt, window, *on, **kw):
+    return window_join(left, right, lt, rt, window, *on, how="inner", **kw)
+
+
+def window_join_left(left, right, lt, rt, window, *on, **kw):
+    return window_join(left, right, lt, rt, window, *on, how="left", **kw)
+
+
+def window_join_right(left, right, lt, rt, window, *on, **kw):
+    return window_join(left, right, lt, rt, window, *on, how="right", **kw)
+
+
+def window_join_outer(left, right, lt, rt, window, *on, **kw):
+    return window_join(left, right, lt, rt, window, *on, how="outer", **kw)
+
+
+# -- intervals_over -----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalsOverWindow:
+    """Windows anchored at each value of ``at`` (a column, possibly of
+    another table): [t + lower_bound, t + upper_bound]
+    (reference _window.py:771 intervals_over)."""
+
+    at: Any
+    lower_bound: Any
+    upper_bound: Any
+    is_outer: bool = True
+
+
+def intervals_over(
+    *, at: Any, lower_bound: Any, upper_bound: Any, is_outer: bool = True
+) -> IntervalsOverWindow:
+    return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)

@@ -86,3 +86,6 @@ def argmin_rows(table: Table, *on: Any, what: Any) -> Table:
     grouped = table.groupby(*[resolve_this(o, table) for o in on])
     best = grouped.reduce(_pw_best=reducers.argmin(what_ref))
     return table.ix(best["_pw_best"])
+
+
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402

@@ -1,0 +1,270 @@
+"""AsyncTransformer — fully-async row→row transformation of a table.
+
+Reference: python/pathway/stdlib/utils/async_transformer.py:61-267 — the
+input table is subscribed, every insertion schedules ``invoke`` on a
+dedicated asyncio loop, and completions loop back into the graph through a
+Python-connector source as an upsert stream keyed by the input row id (so
+late results revise, deletions retract, and nondeterministic outputs stay
+consistent).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+from typing import Any, ClassVar
+
+from pathway_tpu.engine.connectors import (
+    UPSERT,
+    ParsedEvent,
+    Parser,
+    QueueReader,
+)
+from pathway_tpu.engine.value import Json, Pointer
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.udfs.retries import AsyncRetryStrategy
+from pathway_tpu.io._utils import input_table
+
+_STATUS_COLUMN = "_async_status"
+SUCCESS = "-SUCCESS-"
+FAILURE = "-FAILURE-"
+
+
+class _ResultParser(Parser):
+    session_type = "upsert"
+
+    def __init__(self, column_names, dtypes) -> None:
+        super().__init__(column_names)
+        self.dtypes = dtypes
+
+    def parse(self, payload: Any) -> list[ParsedEvent]:
+        kind, key, fields = payload
+        if kind == "remove":
+            return [ParsedEvent(UPSERT, None, key=(key,))]
+        values = []
+        for name in self.column_names:
+            v = fields.get(name)
+            if isinstance(v, (dict, list)):
+                v = Json(v)
+            values.append(v)
+        return [ParsedEvent(UPSERT, tuple(values), key=(key,))]
+
+
+class AsyncTransformer:
+    """Subclass with ``output_schema=...`` and an async ``invoke(**cols)``
+    returning a dict matching the schema; read ``.successful`` (alias
+    ``.result``), ``.failed``, or ``.finished`` (all rows + status)."""
+
+    output_schema: ClassVar[type]
+
+    def __init_subclass__(cls, /, output_schema: type | None = None, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if output_schema is not None:
+            cls.output_schema = output_schema
+
+    def __init__(
+        self,
+        input_table: Table,
+        *,
+        autocommit_duration_ms: int | None = 1500,
+        instance: Any = None,
+    ) -> None:
+        if getattr(self, "output_schema", None) is None:
+            raise TypeError(
+                "define the subclass with "
+                "`class T(AsyncTransformer, output_schema=Schema)`"
+            )
+        sig = inspect.signature(self.invoke)
+        try:
+            sig.bind(**{c: None for c in input_table.column_names()})
+        except TypeError as e:
+            raise TypeError(
+                f"invoke() signature does not match the input table columns "
+                f"({', '.join(input_table.column_names())}): {e}"
+            ) from e
+
+        self._input_table = input_table
+        self._column_names = list(input_table.column_names())
+        self._reader = QueueReader()
+        self._capacity: int | None = None
+        self._timeout: float | None = None
+        self._retry_strategy: AsyncRetryStrategy | None = None
+        self._pending = 0
+        self._input_done = False
+        self._lock = threading.Lock()
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True
+        )
+        self._loop_started = False
+        self._tasks: dict[Pointer, Any] = {}
+        # per-key generation: a removal (or newer insertion) bumps it, so a
+        # stale in-flight invoke can never resurrect a deleted/replaced row
+        self._gen: dict[Pointer, int] = {}
+
+        from pathway_tpu.io import subscribe
+
+        subscribe(input_table, on_change=self._on_change, _internal=True)
+
+        out_dtypes = dict(self.output_schema.dtypes())
+        out_dtypes[_STATUS_COLUMN] = dt.STR
+        result_schema = schema_mod.schema_from_types(
+            **{n: Any for n in out_dtypes}
+        )
+        self._finished = input_table_from_reader(
+            self._reader,
+            result_schema,
+            list(out_dtypes),
+            self._on_end,
+            input_table,
+        )
+
+    # -- configuration --------------------------------------------------------
+
+    def with_options(
+        self,
+        capacity: int | None = None,
+        timeout: float | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: Any = None,
+    ) -> "AsyncTransformer":
+        self._capacity = capacity
+        self._timeout = timeout
+        self._retry_strategy = retry_strategy
+        return self
+
+    # -- lifecycle hooks (reference :371-383) ---------------------------------
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    async def invoke(self, *args: Any, **kwargs: Any) -> dict:
+        raise NotImplementedError
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _ensure_loop(self) -> None:
+        if not self._loop_started:
+            self._loop_started = True
+            self.open()
+            self._loop_thread.start()
+
+    def _on_change(self, key: Pointer, row: dict, time: int, is_addition: bool):
+        self._ensure_loop()
+        with self._lock:
+            gen = self._gen.get(key, 0) + 1
+            self._gen[key] = gen
+        if not is_addition:
+            task = self._tasks.pop(key, None)
+            if task is not None:
+                self._loop.call_soon_threadsafe(task.cancel)
+            self._reader.push(("remove", key, None))
+            return
+        with self._lock:
+            self._pending += 1
+
+        async def run() -> None:
+            try:
+                async def call():
+                    coro = self.invoke(**row)
+                    if self._timeout is not None:
+                        return await asyncio.wait_for(coro, self._timeout)
+                    return await coro
+
+                if self._retry_strategy is not None:
+                    result = await self._retry_strategy.invoke(call)
+                else:
+                    result = await call()
+                if not isinstance(result, dict):
+                    raise TypeError(
+                        f"invoke() must return a dict, got {type(result).__name__}"
+                    )
+                payload = {**result, _STATUS_COLUMN: SUCCESS}
+            except asyncio.CancelledError:
+                with self._lock:
+                    self._pending -= 1
+                    self._maybe_finish()
+                raise
+            except Exception as e:  # noqa: BLE001 — failure rows carry status
+                payload = {
+                    **{c: None for c in self.output_schema.column_names()},
+                    _STATUS_COLUMN: f"{FAILURE}{e!r}",
+                }
+            with self._lock:
+                if self._gen.get(key) == gen:
+                    # only the latest generation may publish: a removal or
+                    # replacement that raced this invoke wins
+                    self._reader.push(("upsert", key, payload))
+                if self._tasks.get(key) is asyncio.current_task():
+                    self._tasks.pop(key, None)  # release finished task
+                self._pending -= 1
+                self._maybe_finish()
+
+        def schedule() -> None:
+            self._tasks[key] = self._loop.create_task(run())
+
+        self._loop.call_soon_threadsafe(schedule)
+
+    def _on_end(self) -> None:
+        self._ensure_loop()
+        with self._lock:
+            self._input_done = True
+            self._maybe_finish()
+
+    def _maybe_finish(self) -> None:
+        if self._input_done and self._pending == 0:
+            self._reader.close()
+            try:
+                self.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- results --------------------------------------------------------------
+
+    @property
+    def finished(self) -> Table:
+        """All invoked rows, with the raw ``_async_status`` column."""
+        return self._finished
+
+    @property
+    def successful(self) -> Table:
+        """Rows whose invoke() completed, in the output schema."""
+        t = self._finished
+        ok = t.filter(t[_STATUS_COLUMN] == SUCCESS)
+        return ok[list(self.output_schema.column_names())]
+
+    @property
+    def failed(self) -> Table:
+        t = self._finished
+        return t.filter(t[_STATUS_COLUMN] != SUCCESS)
+
+    @property
+    def result(self) -> Table:
+        return self.successful
+
+
+def input_table_from_reader(
+    reader, schema, column_names, upstream_done, upstream_table
+) -> Table:
+    dtypes = schema.dtypes()
+
+    def make_reader():
+        return reader
+
+    def make_parser(_names):
+        return _ResultParser(column_names, dtypes)
+
+    return input_table(
+        schema,
+        make_reader,
+        make_parser,
+        source_name="async-transformer",
+        upstream_done=upstream_done,
+        upstream_table=upstream_table,
+    )
