@@ -26,6 +26,18 @@ class Tokenizer(Protocol):
         ...
 
 
+def _is_cjk(ch: str) -> bool:
+    cp = ord(ch)
+    return (
+        0x4E00 <= cp <= 0x9FFF
+        or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF
+        or 0x2A700 <= cp <= 0x2CEAF
+        or 0xF900 <= cp <= 0xFAFF
+        or 0x2F800 <= cp <= 0x2FA1F
+    )
+
+
 def _hash_token(word: str, vocab_size: int) -> int:
     h = hashlib.blake2s(word.encode(), digest_size=4).digest()
     # ids 0..3 reserved (pad/cls/sep/unk)
@@ -127,3 +139,135 @@ def pad_to_buckets(
     out_ids[:b, :t] = ids
     out_mask[:b, :t] = mask
     return out_ids, out_mask, b
+
+
+class WordPieceTokenizer:
+    """BERT WordPiece over a real vocab (reference models load HF
+    tokenizers, embedders.py:270; this is the native implementation of the
+    same algorithm: basic tokenization, then greedy longest-match-first
+    subwords with ``##`` continuations).
+
+    ``vocab``: path to a vocab.txt (one token per line, HF layout) or a
+    dict token -> id. Special tokens follow BERT conventions.
+    """
+
+    def __init__(
+        self,
+        vocab: "str | dict[str, int]",
+        *,
+        lowercase: bool = True,
+        unk_token: str = "[UNK]",
+        cls_token: str = "[CLS]",
+        sep_token: str = "[SEP]",
+        pad_token: str = "[PAD]",
+        max_chars_per_word: int = 100,
+    ) -> None:
+        if isinstance(vocab, str):
+            with open(vocab, encoding="utf-8") as f:
+                vocab = {line.rstrip("\n"): i for i, line in enumerate(f)}
+        self.vocab = dict(vocab)
+        self.ids_to_tokens = {i: t for t, i in self.vocab.items()}
+        self.lowercase = lowercase
+        self.unk_id = self.vocab[unk_token]
+        self.cls_id = self.vocab[cls_token]
+        self.sep_id = self.vocab[sep_token]
+        self.pad_id = self.vocab[pad_token]
+        self._special_tokens = {cls_token, sep_token, pad_token}
+        self.max_chars_per_word = max_chars_per_word
+        self.vocab_size = max(self.vocab.values()) + 1
+
+    # -- basic tokenization (BERT BasicTokenizer) ----------------------------
+
+    def _basic_tokens(self, text: str) -> list[str]:
+        import unicodedata
+
+        if self.lowercase:
+            text = text.lower()
+            text = unicodedata.normalize("NFD", text)
+            text = "".join(
+                c for c in text if unicodedata.category(c) != "Mn"
+            )
+        out: list[str] = []
+        word: list[str] = []
+
+        def flush() -> None:
+            if word:
+                out.append("".join(word))
+                word.clear()
+
+        for ch in text:
+            cat = unicodedata.category(ch)
+            if cat in ("Cc", "Cf") and ch not in ("\t", "\n", "\r"):
+                continue  # strip control chars (BERT BasicTokenizer)
+            if ch.isspace():
+                flush()
+            elif _is_cjk(ch):
+                # every CJK character is its own token, as in HF's
+                # BasicTokenizer — multilingual vocabs are built that way
+                flush()
+                out.append(ch)
+            elif cat.startswith("P") or ch in "$+<=>^`|~":
+                flush()
+                out.append(ch)
+            else:
+                word.append(ch)
+        flush()
+        return out
+
+    # -- wordpiece ------------------------------------------------------------
+
+    def _wordpiece(self, word: str) -> list[int]:
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_id]
+        ids: list[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = self.vocab[piece]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_id]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def encode(self, text: str, max_len: int | None = None) -> list[int]:
+        ids = [self.cls_id]
+        for word in self._basic_tokens(str(text)):
+            ids.extend(self._wordpiece(word))
+        budget = (max_len - 1) if max_len is not None else None
+        if budget is not None and len(ids) > budget:
+            ids = ids[:budget]
+        ids.append(self.sep_id)
+        return ids
+
+    def encode_batch(
+        self, texts: Sequence[str], max_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        encoded = [self.encode(t, max_len) for t in texts]
+        t = max(len(e) for e in encoded) if encoded else 1
+        ids = np.full((len(encoded), t), self.pad_id, np.int32)
+        mask = np.zeros((len(encoded), t), bool)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = True
+        return ids, mask
+
+    def decode(self, ids: Sequence[int]) -> str:
+        words: list[str] = []
+        for i in ids:
+            tok = self.ids_to_tokens.get(int(i), "")
+            if tok in self._special_tokens:
+                continue
+            if tok.startswith("##") and words:
+                words[-1] += tok[2:]
+            else:
+                words.append(tok)
+        return " ".join(words)

@@ -64,18 +64,54 @@ class TpuEncoderEmbedder(UDF):
             minilm_l6,
         )
 
-        preset = _ENCODER_PRESETS.get(model, model)
-        cfg_fn = {
-            "minilm_l6": minilm_l6,
-            "bge_base": bge_base,
-            "bge_small": bge_small,
-        }.get(preset)
-        if cfg_fn is None:
-            raise ValueError(
-                f"unknown encoder preset {model!r}; "
-                f"known: {sorted(_ENCODER_PRESETS)} + minilm_l6/bge_base/bge_small"
-            )
-        self.config = cfg_fn()
+        import os
+
+        weights_tag = None
+        if os.path.isdir(model):
+            # locally cached HF / sentence-transformers directory: import
+            # real weights + WordPiece vocab (models/hf_import.py)
+            from pathway_tpu.models.hf_import import load_sentence_transformer
+
+            if params is None or tokenizer is None:
+                loaded_params, cfg, wp_tokenizer = load_sentence_transformer(
+                    model
+                )
+                self.config = cfg
+                if params is None:
+                    params = loaded_params
+                if tokenizer is None:
+                    tokenizer = wp_tokenizer
+            else:
+                # both params and tokenizer given: the dir would contribute
+                # nothing but a (large) deserialization — reject ambiguity
+                raise ValueError(
+                    "pass either a checkpoint dir or explicit "
+                    "params+tokenizer, not both"
+                )
+            # cache key must identify the WEIGHTS, not the dir name: two
+            # different checkpoints can share a basename
+            import hashlib
+
+            h = hashlib.blake2s(digest_size=8)
+            for entry in sorted(os.listdir(model)):
+                st = os.stat(os.path.join(model, entry))
+                h.update(f"{entry}:{st.st_size}:{st.st_mtime_ns}".encode())
+            weights_tag = h.hexdigest()
+            preset = os.path.basename(os.path.normpath(model))
+        else:
+            preset = _ENCODER_PRESETS.get(model, model)
+            cfg_fn = {
+                "minilm_l6": minilm_l6,
+                "bge_base": bge_base,
+                "bge_small": bge_small,
+            }.get(preset)
+            if cfg_fn is None:
+                raise ValueError(
+                    f"unknown encoder preset {model!r}; "
+                    f"known: {sorted(_ENCODER_PRESETS)} + "
+                    f"minilm_l6/bge_base/bge_small, or a local checkpoint dir"
+                )
+            self.config = cfg_fn()
         self.max_len = max_len
         self.tokenizer = tokenizer or HashTokenizer(self.config.vocab_size)
         if params is None:
@@ -102,7 +138,10 @@ class TpuEncoderEmbedder(UDF):
             executor=batch_executor(max_batch_size=max_batch_size),
             deterministic=True,
             cache_strategy=cache_strategy,
-            cache_name=f"TpuEncoderEmbedder:{preset}:{max_len}:seed{seed}",
+            cache_name=(
+                f"TpuEncoderEmbedder:{preset}:{max_len}:"
+                + (f"ckpt{weights_tag}" if weights_tag else f"seed{seed}")
+            ),
         )
 
     def get_embedding_dimension(self) -> int:
