@@ -51,6 +51,8 @@ from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
+from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
+from pathway_tpu.internals.telemetry import set_monitoring_config  # noqa: E402
 from pathway_tpu.stdlib import temporal  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.internals import udfs  # noqa: E402
@@ -119,6 +121,8 @@ __all__ = [
     "sql",
     "stdlib",
     "temporal",
+    "MonitoringLevel",
+    "set_monitoring_config",
     "AsyncTransformer",
     "this",
     "udf",

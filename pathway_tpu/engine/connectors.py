@@ -302,6 +302,10 @@ class InputDriver:
         self._per_source_rows: dict[str, list[tuple[Pointer, tuple]]] = {}
         self._seq = 0
         self.done = False
+        # monitoring counters (internals/monitoring.py reads these)
+        self.entries_total = 0
+        self.batches_total = 0
+        self.last_entry_wall: float | None = None
 
     def _key_for(self, values: tuple, source_id: str, index: int) -> Pointer:
         if self.pk is not None:
@@ -315,6 +319,10 @@ class InputDriver:
         if self.done:
             return "done"
         entries, done = self.reader.poll()
+        if entries:
+            self.entries_total += len(entries)
+            self.batches_total += 1
+            self.last_entry_wall = _time.monotonic()
         produced = False
         replaces = self.reader.replaces_sources
         notify_source = getattr(self.session, "on_source", None)

@@ -1403,19 +1403,57 @@ class _RemoveErrorsNode(Node):
         return out
 
 
+class OperatorStats:
+    """Per-operator probe counters (reference: OperatorStats
+    graph.rs:500-542 + Prober dataflow.rs:671-798)."""
+
+    __slots__ = ("insertions", "deletions", "batches", "time_spent", "last_time")
+
+    def __init__(self) -> None:
+        self.insertions = 0
+        self.deletions = 0
+        self.batches = 0
+        self.time_spent = 0.0  # seconds inside process()
+        self.last_time: int | None = None  # last commit that touched this op
+
+    def snapshot(self) -> dict:
+        return {
+            "insertions": self.insertions,
+            "deletions": self.deletions,
+            "batches": self.batches,
+            "time_spent": self.time_spent,
+            "last_time": self.last_time,
+        }
+
+
 class Scheduler:
     """Topological commit-batch pump (replaces timely's worker loop,
     reference: dataflow.rs:5769-5822). All deltas at one logical time are
     processed as a unit; ``propagate`` loops until quiescent so same-time
     feedback (error logs) settles within the commit.
+
+    ``probe=True`` collects per-operator stats into ``self.stats``
+    (node index → OperatorStats), feeding the monitoring dashboard and the
+    Prometheus endpoint.
     """
 
-    def __init__(self, scope: Scope) -> None:
+    def __init__(self, scope: Scope, probe: bool = False) -> None:
         self.scope = scope
         self.time = 0
+        self.probe = probe
+        self.stats: dict[int, OperatorStats] = {}
+
+    def _stats_of(self, node: Node) -> OperatorStats:
+        st = self.stats.get(node.index)
+        if st is None:
+            st = self.stats[node.index] = OperatorStats()
+        return st
 
     def propagate(self, time: int) -> None:
         scope = self.scope
+        probe = self.probe
+        if probe:
+            import time as _walltime
         while True:
             dirty = [n for n in scope.nodes if n.has_pending()]
             if not dirty:
@@ -1433,11 +1471,23 @@ class Scheduler:
             for node in scope.nodes:
                 if not node.has_pending():
                     continue
+                if probe:
+                    t0 = _walltime.perf_counter()
                 out = node.process(time)
                 if out is None:
                     out = DeltaBatch()
                 out = out.consolidate() if out else out
                 apply_batch_to_state(node.current, out)
+                if probe:
+                    st = self._stats_of(node)
+                    st.time_spent += _walltime.perf_counter() - t0
+                    st.batches += 1
+                    st.last_time = time
+                    for _k, _r, d in out:
+                        if d > 0:
+                            st.insertions += 1
+                        else:
+                            st.deletions += 1
                 if out:
                     for consumer, port in node.consumers:
                         consumer.push(port, out)

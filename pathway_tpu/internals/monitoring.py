@@ -1,0 +1,233 @@
+"""Observability: live console dashboard, per-operator probes, Prometheus.
+
+Reference:
+- rich live dashboard with connector rows + latency
+  (python/pathway/internals/monitoring.py:56-228 StatsMonitor)
+- Prometheus/OpenMetrics HTTP endpoint on port 20000 + process_id
+  (src/engine/http_server.rs:22-194)
+- per-operator probes (graph.rs:500-542, progress_reporter.rs:82)
+
+``pw.run(monitoring_level=pw.MonitoringLevel.ALL, with_http_server=True)``
+wires all three; the endpoint stays scrapeable for the lifetime of the run.
+"""
+
+from __future__ import annotations
+
+import enum
+import http.server
+import threading
+import time as _time
+from typing import Any
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = "auto"
+    NONE = "none"
+    IN_OUT = "in_out"  # connector stats only
+    ALL = "all"  # + per-operator stats
+
+
+class ConnectorStats:
+    """Input-side counters (reference connectors/monitoring.rs)."""
+
+    __slots__ = ("name", "entries", "batches", "last_entry_at", "finished")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.entries = 0
+        self.batches = 0
+        self.last_entry_at: float | None = None
+        self.finished = False
+
+    @property
+    def lag_seconds(self) -> float | None:
+        if self.finished or self.last_entry_at is None:
+            return None
+        return max(0.0, _time.monotonic() - self.last_entry_at)
+
+
+class StatsMonitor:
+    """Collects run-wide stats; optionally renders them as a live rich
+    table (reference StatsMonitor monitoring.py:165)."""
+
+    def __init__(
+        self,
+        level: MonitoringLevel = MonitoringLevel.IN_OUT,
+        refresh_per_second: float = 4.0,
+        console: Any = None,
+    ) -> None:
+        self.level = level
+        #: per-operator probing costs a timing pair per node per batch;
+        #: only pay it when something reads the stats (ALL dashboard or a
+        #: Prometheus endpoint, which sets this True)
+        self.wants_operator_stats = level == MonitoringLevel.ALL
+        self.connectors: dict[str, ConnectorStats] = {}
+        self.scheduler: Any = None
+        self.started = _time.monotonic()
+        self.commits = 0
+        self.output_rows = 0
+        self._latency_ms: float | None = None
+        self._live = None
+        self._refresh = refresh_per_second
+        self._console = console
+        self._last_render = 0.0
+
+    # -- collection ----------------------------------------------------------
+
+    def connector(self, name: str) -> ConnectorStats:
+        st = self.connectors.get(name)
+        if st is None:
+            st = self.connectors[name] = ConnectorStats(name)
+        return st
+
+    def on_commit(self, time: int, wall_start: float) -> None:
+        self.commits += 1
+        self._latency_ms = (_time.monotonic() - wall_start) * 1000.0
+        self.maybe_render()
+
+    # -- rendering -----------------------------------------------------------
+
+    def _table(self):
+        from rich.table import Table as RichTable
+
+        table = RichTable(title="pathway_tpu progress")
+        table.add_column("connector")
+        table.add_column("entries", justify="right")
+        table.add_column("batches", justify="right")
+        table.add_column("lag", justify="right")
+        for st in self.connectors.values():
+            lag = st.lag_seconds
+            table.add_row(
+                st.name,
+                str(st.entries),
+                str(st.batches),
+                "done" if st.finished else (f"{lag:.2f}s" if lag else "-"),
+            )
+        table.add_row(
+            "[commits]",
+            str(self.commits),
+            "-",
+            f"{self._latency_ms:.1f}ms" if self._latency_ms else "-",
+        )
+        if self.level == MonitoringLevel.ALL and self.scheduler is not None:
+            for node in self.scheduler.scope.nodes:
+                st = self.scheduler.stats.get(node.index)
+                if st is None:
+                    continue
+                table.add_row(
+                    f"  op:{node.name}#{node.index}",
+                    str(st.insertions - st.deletions),
+                    str(st.batches),
+                    f"{st.time_spent * 1000:.0f}ms",
+                )
+        return table
+
+    def start_live(self) -> None:
+        from rich.live import Live
+
+        self._live = Live(
+            self._table(),
+            refresh_per_second=self._refresh,
+            console=self._console,
+        )
+        self._live.start()
+
+    def maybe_render(self) -> None:
+        if self._live is None:
+            return
+        now = _time.monotonic()
+        if now - self._last_render >= 1.0 / self._refresh:
+            self._live.update(self._table())
+            self._last_render = now
+
+    def stop(self) -> None:
+        if self._live is not None:
+            self._live.update(self._table())
+            self._live.stop()
+            self._live = None
+
+    # -- prometheus ----------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        """OpenMetrics text format (reference http_server.rs:96-194:
+        input/output latency + per-connector counters)."""
+        lines = [
+            "# TYPE pathway_commits_total counter",
+            f"pathway_commits_total {self.commits}",
+            "# TYPE pathway_uptime_seconds gauge",
+            f"pathway_uptime_seconds {_time.monotonic() - self.started:.3f}",
+        ]
+        if self._latency_ms is not None:
+            lines += [
+                "# TYPE pathway_commit_latency_ms gauge",
+                f"pathway_commit_latency_ms {self._latency_ms:.3f}",
+            ]
+        lines.append("# TYPE pathway_input_entries_total counter")
+        # snapshot: the run thread inserts concurrently with scrapes
+        for st in list(self.connectors.values()):
+            label = st.name.replace('"', "'")
+            lines.append(
+                f'pathway_input_entries_total{{connector="{label}"}} {st.entries}'
+            )
+        if self.scheduler is not None:
+            lines.append("# TYPE pathway_operator_rows gauge")
+            lines.append("# TYPE pathway_operator_time_seconds counter")
+            stats = dict(self.scheduler.stats)
+            for node in list(self.scheduler.scope.nodes):
+                st = stats.get(node.index)
+                if st is None:
+                    continue
+                label = f'operator="{node.name}",index="{node.index}"'
+                lines.append(
+                    f"pathway_operator_rows{{{label}}} "
+                    f"{st.insertions - st.deletions}"
+                )
+                lines.append(
+                    f"pathway_operator_time_seconds{{{label}}} "
+                    f"{st.time_spent:.6f}"
+                )
+        return "\n".join(lines) + "\n"
+
+
+class MonitoringHttpServer:
+    """Prometheus endpoint thread on port 20000 + process_id
+    (reference http_server.rs:22)."""
+
+    BASE_PORT = 20000
+
+    def __init__(self, monitor: StatsMonitor, port: int | None = None) -> None:
+        import os
+
+        monitor.wants_operator_stats = True
+        if port is None:
+            port = self.BASE_PORT + int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        monitor_ref = monitor
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = monitor_ref.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -54,21 +54,67 @@ def run(
     *,
     monitoring_level: Any = None,
     with_http_server: bool = False,
+    monitoring_server_port: int | None = None,
     debug: bool = False,
     persistence_config: Any = None,
     **kwargs: Any,
 ) -> None:
-    """Execute the captured graph (reference: pw.run, internals/run.py:12)."""
+    """Execute the captured graph (reference: pw.run, internals/run.py:12).
+
+    ``monitoring_level``: pw.MonitoringLevel (NONE/IN_OUT/ALL) — IN_OUT and
+    ALL render a live rich dashboard; ``with_http_server`` additionally
+    serves Prometheus metrics on port 20000 + PATHWAY_PROCESS_ID
+    (reference monitoring.py:56-228, http_server.rs:22)."""
     from pathway_tpu.internals.runner import GraphRunner
 
     runner = GraphRunner(persistence_config=persistence_config)
-    for sink in G.sinks:
-        node = runner.build(sink.table)
-        driver = sink.attach(runner.scope, node)
-        if driver is not None:
-            runner.drivers.append(driver)
-    runner.run()
-    G.clear()
+
+    monitor = None
+    http_server = None
+    level = monitoring_level
+    if level is not None or with_http_server:
+        import sys
+
+        from pathway_tpu.internals.monitoring import (
+            MonitoringHttpServer,
+            MonitoringLevel,
+            StatsMonitor,
+        )
+
+        if level is None or level == MonitoringLevel.AUTO:
+            level = (
+                MonitoringLevel.IN_OUT
+                if sys.stderr.isatty()
+                else MonitoringLevel.NONE
+            )
+        if level != MonitoringLevel.NONE or with_http_server:
+            monitor = StatsMonitor(
+                level if level != MonitoringLevel.NONE else MonitoringLevel.IN_OUT
+            )
+            runner.monitor = monitor
+            if level != MonitoringLevel.NONE:
+                monitor.start_live()
+            if with_http_server:
+                http_server = MonitoringHttpServer(
+                    monitor, port=monitoring_server_port
+                )
+
+    from pathway_tpu.internals.telemetry import run_span
+
+    try:
+        with run_span():
+            for sink in G.sinks:
+                node = runner.build(sink.table)
+                driver = sink.attach(runner.scope, node)
+                if driver is not None:
+                    runner.drivers.append(driver)
+            runner.run()
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if http_server is not None and not kwargs.get("_keep_http_server"):
+            http_server.stop()
+        G.clear()
 
 
 def run_all(**kwargs: Any) -> None:

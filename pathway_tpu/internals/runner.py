@@ -58,9 +58,33 @@ class GraphRunner:
         self.nodes: dict[int, Node] = {}
         self.drivers: list[Any] = []  # connector drivers (streaming mode)
         self.monitors: list[Any] = []
+        self.monitor: Any = None  # StatsMonitor (internals/monitoring.py)
         self.persistence = persistence_config
         if persistence_config is not None:
             self._wire_udf_cache(persistence_config)
+
+    def _sync_monitor_connectors(self) -> None:
+        if self.monitor is None:
+            return
+        seen: dict[str, int] = {}
+        for d in self.drivers:
+            inner = getattr(d, "driver", d)
+            name = getattr(inner, "source_name", None)
+            if name is None:
+                continue
+            # two drivers may share a source_name (e.g. default
+            # 'python-connector'); suffix duplicates so counters don't fight
+            n = seen.get(name, 0)
+            seen[name] = n + 1
+            if n:
+                name = f"{name}#{n}"
+            st = self.monitor.connector(name)
+            st.entries = getattr(inner, "entries_total", 0)
+            st.batches = getattr(inner, "batches_total", 0)
+            wall = getattr(inner, "last_entry_wall", None)
+            if wall is not None:
+                st.last_entry_at = wall
+            st.finished = getattr(inner, "done", False)
 
     @staticmethod
     def _wire_udf_cache(config: Any) -> None:
@@ -766,8 +790,20 @@ class GraphRunner:
     # -- execution ----------------------------------------------------------
 
     def run_static(self) -> Scheduler:
-        sched = Scheduler(self.scope)
+        sched = Scheduler(
+            self.scope,
+            probe=self.monitor is not None
+            and getattr(self.monitor, "wants_operator_stats", True),
+        )
+        if self.monitor is not None:
+            self.monitor.scheduler = sched
+        import time as _time
+
+        t0 = _time.monotonic()
         sched.run_static()
+        if self.monitor is not None:
+            self._sync_monitor_connectors()
+            self.monitor.on_commit(0, t0)
         return sched
 
     def run(self) -> Scheduler:
@@ -779,7 +815,13 @@ class GraphRunner:
 
         if not self.drivers:
             return self.run_static()
-        sched = Scheduler(self.scope)
+        sched = Scheduler(
+            self.scope,
+            probe=self.monitor is not None
+            and getattr(self.monitor, "wants_operator_stats", True),
+        )
+        if self.monitor is not None:
+            self.monitor.scheduler = sched
         persistent = [d for d in self.drivers if hasattr(d, "replay")]
         for driver in persistent:
             driver.replay()
@@ -814,11 +856,15 @@ class GraphRunner:
                 elif status == "data":
                     produced = True
             if produced:
+                commit_started = _time.monotonic()
                 time = sched.commit()
                 for driver in persistent:
                     driver.on_commit(time)
                 if snapshot_mgr is not None:
                     snapshot_mgr.on_commit(self.scope, self.drivers, time)
+                if self.monitor is not None:
+                    self._sync_monitor_connectors()
+                    self.monitor.on_commit(time, commit_started)
                 idle_spins = 0
             else:
                 # only passive loopback sources left (AsyncTransformer):

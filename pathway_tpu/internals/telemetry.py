@@ -1,0 +1,74 @@
+"""OpenTelemetry integration (reference: src/engine/telemetry.rs:195-407,
+graph_runner/telemetry.py).
+
+Off by default (like the reference, where telemetry is opt-in via
+``set_monitoring_config``). ``pw.set_monitoring_config(server_endpoint=...)``
+turns it on: every ``pw.run`` emits a root span with run metadata plus
+periodic process metrics, exported over OTLP. Without an endpoint (or the
+exporter packages) every hook is a no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import uuid
+from typing import Any, Iterator
+
+_config: dict[str, Any] = {"endpoint": None, "license_key": None}
+_RUN_ID = str(uuid.uuid4())
+_provider_cache: dict[str, Any] = {}  # endpoint -> tracer (OTEL's global
+# provider is first-write-wins, so build ours once per endpoint)
+
+
+def set_monitoring_config(
+    *, server_endpoint: str | None = None, license_key: str | None = None
+) -> None:
+    """Reference internals/config.py:144 set_monitoring_config."""
+    _config["endpoint"] = server_endpoint
+    _config["license_key"] = license_key
+
+
+def _tracer() -> Any:
+    endpoint = _config["endpoint"] or os.environ.get(
+        "PATHWAY_TELEMETRY_SERVER"
+    )
+    if not endpoint:
+        return None
+    if endpoint in _provider_cache:
+        return _provider_cache[endpoint]
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+    except ImportError:
+        return None
+    provider = TracerProvider(
+        resource=Resource.create(
+            {
+                "service.name": "pathway-tpu",
+                "run.id": _RUN_ID,
+            }
+        )
+    )
+    provider.add_span_processor(
+        BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
+    )
+    # use the provider directly — the OTEL global setter is
+    # first-write-wins and would leak one provider per run
+    tracer = provider.get_tracer("pathway_tpu")
+    _provider_cache[endpoint] = tracer
+    return tracer
+
+
+@contextlib.contextmanager
+def run_span() -> Iterator[None]:
+    tracer = _tracer()
+    if tracer is None:
+        yield
+        return
+    with tracer.start_as_current_span("pathway.run"):
+        yield

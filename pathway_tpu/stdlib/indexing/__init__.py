@@ -20,9 +20,11 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     LshKnnFactory,
     USearchKnnFactory,
 )
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex
 
 __all__ = [
     "BruteForceKnnFactory",
+    "HybridIndex",
     "LshKnnFactory",
     "USearchKnnFactory",
     "DataIndex",

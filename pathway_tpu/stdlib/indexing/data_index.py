@@ -104,31 +104,9 @@ class DataIndex:
             combined["_pw_index_reply_ids"] = reply["_pw_index_reply_ids"]
             combined["_pw_index_reply_scores"] = reply["_pw_index_reply_scores"]
             return query_table.restrict(reply).select(**combined)
-        # one row per hit: explode (rank, id, score) triples. Zero-hit
-        # queries keep one sentinel row (rank -1, id None) so they stay in
-        # downstream universes instead of vanishing in the flatten.
-        def hit_triples(ids: tuple, scores: tuple) -> tuple:
-            if not ids:
-                return ((-1, None, None),)
-            return tuple(
-                (i, k, s) for i, (k, s) in enumerate(zip(ids, scores))
-            )
-
-        pairs = reply.select(
-            _pw_hits=pw_apply(
-                hit_triples,
-                reply["_pw_index_reply_ids"],
-                reply["_pw_index_reply_scores"],
-            ),
-            _pw_query_id=reply.id,
-        )
-        flat = pairs.flatten(pairs["_pw_hits"])
-        return flat.select(
-            _pw_query_id=flat["_pw_query_id"],
-            _pw_index_reply_rank=flat["_pw_hits"].get(0),
-            _pw_index_reply_id=flat["_pw_hits"].get(1),
-            _pw_index_reply_score=flat["_pw_hits"].get(2),
-        )
+        # one row per hit: explode (rank, id, score) triples (zero-hit
+        # queries keep a sentinel row so they stay in downstream universes)
+        return explode_reply(reply)
 
     def query_docs_as_of_now(
         self,
@@ -145,40 +123,82 @@ class DataIndex:
             number_of_matches=number_of_matches,
             collapse_rows=False,
         )
-        # optional=True: zero-hit sentinel rows carry a None doc id
-        docs_at = self.data_table.ix(flat["_pw_index_reply_id"], optional=True)
-        fetched = flat.select(
-            _pw_query_id=flat["_pw_query_id"],
-            _pw_index_reply_rank=flat["_pw_index_reply_rank"],
-            _pw_index_reply_score=flat["_pw_index_reply_score"],
-            **{name: docs_at[name] for name in doc_columns},
+        return fetch_docs_for_hits(
+            self.data_table, query_table, flat, doc_columns
         )
 
-        def strip_ranks(pairs: tuple) -> tuple:
-            # rank -1 marks the zero-hit sentinel; it contributes no values
-            return tuple(v for rank, v in pairs if rank >= 0)
 
-        grouped = fetched.groupby(id=fetched["_pw_query_id"])
-        agg = {
-            name: pw_apply(
-                strip_ranks,
-                sorted_tuple(
-                    make_tuple(fetched["_pw_index_reply_rank"], fetched[name])
-                ),
-            )
-            for name in doc_columns
-        }
-        agg["_pw_index_reply_scores"] = pw_apply(
+def fetch_docs_for_hits(
+    data_table: Table,
+    query_table: Table,
+    flat_hits: Table,
+    doc_columns: list[str],
+) -> Table:
+    """Shared collapse tail: one-row-per-hit table (``_pw_query_id`` /
+    ``_pw_index_reply_rank`` / ``_pw_index_reply_id`` / ``_pw_index_reply_score``)
+    -> per-query doc-column tuples ordered by rank + scores tuple."""
+    # optional=True: zero-hit sentinel rows carry a None doc id
+    docs_at = data_table.ix(flat_hits["_pw_index_reply_id"], optional=True)
+    fetched = flat_hits.select(
+        _pw_query_id=flat_hits["_pw_query_id"],
+        _pw_index_reply_rank=flat_hits["_pw_index_reply_rank"],
+        _pw_index_reply_score=flat_hits["_pw_index_reply_score"],
+        **{name: docs_at[name] for name in doc_columns},
+    )
+
+    def strip_ranks(pairs: tuple) -> tuple:
+        # rank -1 marks the zero-hit sentinel; it contributes no values
+        return tuple(v for rank, v in pairs if rank >= 0)
+
+    grouped = fetched.groupby(id=fetched["_pw_query_id"])
+    agg = {
+        name: pw_apply(
             strip_ranks,
             sorted_tuple(
-                make_tuple(
-                    fetched["_pw_index_reply_rank"], fetched["_pw_index_reply_score"]
-                )
+                make_tuple(fetched["_pw_index_reply_rank"], fetched[name])
             ),
         )
-        result = grouped.reduce(**agg)
-        # group keys ARE query ids (groupby id=_pw_query_id), so the result
-        # universe is a subset of the query table's — teach the solver so
-        # callers can select query columns next to the reply columns
-        solver.register_subset(result._universe, query_table._universe)
-        return result
+        for name in doc_columns
+    }
+    agg["_pw_index_reply_scores"] = pw_apply(
+        strip_ranks,
+        sorted_tuple(
+            make_tuple(
+                fetched["_pw_index_reply_rank"],
+                fetched["_pw_index_reply_score"],
+            )
+        ),
+    )
+    result = grouped.reduce(**agg)
+    # group keys ARE query ids (groupby id=_pw_query_id), so the result
+    # universe is a subset of the query table's — teach the solver so
+    # callers can select query columns next to the reply columns
+    solver.register_subset(result._universe, query_table._universe)
+    return result
+
+
+def explode_reply(reply: Table) -> Table:
+    """ids/scores tuples -> one row per hit (rank, id, score), with a
+    sentinel row for zero-hit queries (mirrors query_as_of_now's
+    collapse_rows=False shape)."""
+
+    def hit_triples(ids: tuple, scores: tuple) -> tuple:
+        if not ids:
+            return ((-1, None, None),)
+        return tuple((i, k, s) for i, (k, s) in enumerate(zip(ids, scores)))
+
+    pairs = reply.select(
+        _pw_hits=pw_apply(
+            hit_triples,
+            reply["_pw_index_reply_ids"],
+            reply["_pw_index_reply_scores"],
+        ),
+        _pw_query_id=reply.id,
+    )
+    flat = pairs.flatten(pairs["_pw_hits"])
+    return flat.select(
+        _pw_query_id=flat["_pw_query_id"],
+        _pw_index_reply_rank=flat["_pw_hits"].get(0),
+        _pw_index_reply_id=flat["_pw_hits"].get(1),
+        _pw_index_reply_score=flat["_pw_hits"].get(2),
+    )

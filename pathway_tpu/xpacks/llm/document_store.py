@@ -57,12 +57,17 @@ class DocumentStore:
         raw = tables[0].concat_reindex(*tables[1:]) if len(tables) > 1 else tables[0]
         self.input_docs = raw
 
+        def _plain(m: Any) -> dict:
+            if hasattr(m, "value"):  # Json wrapper
+                m = m.value
+            return dict(m or {})
+
         parsed = raw.select(_parts=self.parser(raw["data"]), _metadata=raw["_metadata"])
         parsed = parsed.flatten(parsed["_parts"])
         parsed = parsed.select(
             text=parsed["_parts"].get(0),
             _metadata=pw_apply(
-                lambda part, meta: {**dict(meta or {}), **dict(part[1] or {})},
+                lambda part, meta: {**_plain(meta), **_plain(part[1])},
                 parsed["_parts"],
                 parsed["_metadata"],
             ),
@@ -75,7 +80,8 @@ class DocumentStore:
             text=chunked["_chunks"].get(0), _metadata=chunked["_metadata"]
         )
 
-        if retriever_factory == "knn":
+        self._hybrid: Any = None
+        if retriever_factory in ("knn", "hybrid"):
             if self.embedder is None:
                 raise ValueError("knn retrieval needs an embedder")
             if dimensions is None:
@@ -94,6 +100,13 @@ class DocumentStore:
             self.indexed = data
             self.index = DataIndex(data, factory, data.emb)
             self._query_is_vector = True
+            if retriever_factory == "hybrid":
+                # RRF of dense KNN + BM25 over the same chunks
+                # (reference hybrid_index.py:14 + vector_document_index.py)
+                from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex
+
+                bm25 = DataIndex(data, TantivyBM25Factory(), data.text)
+                self._hybrid = HybridIndex([self.index, bm25])
         elif retriever_factory == "bm25":
             self.indexed = self.chunks
             self.index = DataIndex(
@@ -111,48 +124,119 @@ class DocumentStore:
     # -- queries -------------------------------------------------------------
 
     def retrieve_query(self, query_table: Table) -> Table:
-        """``query_table(query: str, k: int)`` -> ``result`` column: tuple of
+        """``query_table(query: str, k: int[, metadata_filter: str]
+        [, filepath_globpattern: str])`` -> ``result`` column: tuple of
         ``{"text", "metadata", "dist"}`` dicts (reference DocumentStore
-        retrieve format)."""
-        if self._query_is_vector:
-            prepped = query_table.select(
-                query=query_table.query,
-                k=query_table.k,
-                _qv=self.embedder(query_table.query),
-            )
-            qcol = prepped["_qv"]
-        else:
-            prepped = query_table.select(
-                query=query_table.query, k=query_table.k
-            )
-            qcol = prepped["query"]
-        hits = self.index.query_docs_as_of_now(
-            prepped,
-            qcol,
-            doc_columns=["text", "_metadata"],
-            number_of_matches=prepped.k,
+        retrieve format :188-211).
+
+        ``metadata_filter`` is a JMESPath-subset expression over each
+        chunk's metadata (globmatch/contains supported,
+        internals/jmespath_lite.py); ``filepath_globpattern`` glob-matches
+        the metadata ``path`` field. Filtered retrieval over-fetches
+        (3k + 10 candidates) before filtering, like the reference's
+        filter-aware index wrapper (external_integration/mod.rs:373)."""
+        qcols = query_table.column_names()
+        has_filters = (
+            "metadata_filter" in qcols or "filepath_globpattern" in qcols
         )
+        sel: dict[str, Any] = {
+            "query": query_table.query,
+            "k": query_table.k,
+        }
+        if "metadata_filter" in qcols:
+            sel["metadata_filter"] = query_table.metadata_filter
+        if "filepath_globpattern" in qcols:
+            sel["filepath_globpattern"] = query_table.filepath_globpattern
+        if self._query_is_vector:
+            sel["_qv"] = self.embedder(query_table.query)
+        prepped = query_table.select(**sel)
+        qcol = prepped["_qv"] if self._query_is_vector else prepped["query"]
+        fetch_k = (
+            pw_apply(lambda kk: 3 * kk + 10, prepped.k)
+            if has_filters
+            else prepped.k
+        )
+        if self._hybrid is not None:
+            reply = self._hybrid.query_as_of_now(
+                prepped, [qcol, prepped["query"]], number_of_matches=fetch_k
+            )
+            from pathway_tpu.stdlib.indexing.data_index import (
+                explode_reply,
+                fetch_docs_for_hits,
+            )
+
+            hits = fetch_docs_for_hits(
+                self.indexed,
+                prepped,
+                explode_reply(reply),
+                doc_columns=["text", "_metadata"],
+            )
+        else:
+            hits = self.index.query_docs_as_of_now(
+                prepped,
+                qcol,
+                doc_columns=["text", "_metadata"],
+                number_of_matches=fetch_k,
+            )
 
         # Map higher-is-better scores to the reference's distance scale per
         # metric (ADVICE r1): cos similarity -> 1 - sim in [0, 2]; l2sq score
-        # is -distance² -> distance² = -score; dot/bm25 -> -score.
-        if self._query_is_vector and self.metric == "cos":
+        # is -distance² -> distance² = -score; dot/bm25/RRF -> -score.
+        if self._hybrid is None and self._query_is_vector and self.metric == "cos":
             to_dist = lambda s: 1.0 - float(s)  # noqa: E731
         else:
             to_dist = lambda s: -float(s)  # noqa: E731
 
-        def to_result(texts: tuple, metas: tuple, scores: tuple) -> tuple:
-            return tuple(
-                {"text": t, "metadata": dict(m or {}), "dist": to_dist(s)}
-                for t, m, s in zip(texts, metas, scores)
-            )
+        def to_result(
+            texts: tuple,
+            metas: tuple,
+            scores: tuple,
+            kk: int,
+            meta_filter=None,
+            glob_pattern=None,
+        ) -> tuple:
+            from pathway_tpu.internals import jmespath_lite
 
+            out = []
+            for t, m, s in zip(texts, metas, scores):
+                meta = dict(m.value if hasattr(m, "value") else (m or {}))
+                if meta_filter:
+                    try:
+                        if jmespath_lite.search(meta_filter, meta) is not True:
+                            continue
+                    except jmespath_lite.JMESPathError:
+                        continue
+                if glob_pattern:
+                    path = str(meta.get("path", ""))
+                    if not jmespath_lite.globmatch(glob_pattern, path):
+                        continue
+                out.append(
+                    {"text": t, "metadata": meta, "dist": to_dist(s)}
+                )
+                if len(out) >= kk:
+                    break
+            return tuple(out)
+
+        pq = prepped.restrict(hits)
+        filter_kwargs = {
+            name: pq[name]
+            for name in ("metadata_filter", "filepath_globpattern")
+            if name in prepped.column_names()
+        }
+        # absent filters fall back to to_result's None defaults — no dummy
+        # per-row columns
+        kw_map = {
+            "metadata_filter": "meta_filter",
+            "filepath_globpattern": "glob_pattern",
+        }
         return hits.select(
             result=pw_apply(
                 to_result,
                 hits["text"],
                 hits["_metadata"],
                 hits["_pw_index_reply_scores"],
+                pq["k"],
+                **{kw_map[n]: e for n, e in filter_kwargs.items()},
             )
         )
 

@@ -1,4 +1,5 @@
 import numpy as np
+import pytest
 
 import pathway_tpu as pw
 from pathway_tpu.engine.graph import Scheduler, Scope
@@ -200,3 +201,71 @@ class TestBM25:
         )
         rows = list(GraphRunner().capture(res)[0].values())
         assert rows[0][0] == ("apple pie recipe",)
+
+
+class TestHybridAndFiltering:
+    def _store(self, retriever="knn"):
+        from pathway_tpu.internals.udfs import udf
+        from pathway_tpu.xpacks.llm.document_store import DocumentStore
+        from pathway_tpu.xpacks.llm.mocks import fake_embeddings_model
+
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(data=bytes, _metadata=dict),
+            [
+                (b"alpha report", {"owner": "alice", "path": "docs/a/r.pdf"}),
+                (b"beta memo", {"owner": "bob", "path": "docs/b/m.txt"}),
+                (b"alpha beta summary", {"owner": "bob", "path": "docs/b/s.pdf"}),
+            ],
+        )
+        return DocumentStore(
+            docs,
+            embedder=udf(fake_embeddings_model),
+            dimensions=16,
+            retriever_factory=retriever,
+        )
+
+    def test_metadata_filter_restricts_hits(self):
+        store = self._store()
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(query=str, k=int, metadata_filter=str),
+            [("alpha report", 3, "owner == 'bob'")],
+        )
+        res = store.retrieve_query(queries)
+        (snap,) = GraphRunner().capture(res)
+        ((hits,),) = snap.values()
+        assert hits  # something matched
+        assert all(h["metadata"]["owner"] == "bob" for h in hits)
+
+    def test_filepath_globpattern(self):
+        store = self._store()
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(query=str, k=int, filepath_globpattern=str),
+            [("alpha", 3, "**/*.pdf")],
+        )
+        res = store.retrieve_query(queries)
+        (snap,) = GraphRunner().capture(res)
+        ((hits,),) = snap.values()
+        assert hits
+        assert all(h["metadata"]["path"].endswith(".pdf") for h in hits)
+
+    def test_hybrid_rrf_fuses_dense_and_bm25(self):
+        store = self._store(retriever="hybrid")
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(query=str, k=int),
+            [("alpha report", 2)],
+        )
+        res = store.retrieve_query(queries)
+        (snap,) = GraphRunner().capture(res)
+        ((hits,),) = snap.values()
+        assert len(hits) == 2
+        # BM25 leg guarantees the lexically-exact doc ranks first even though
+        # the dense leg uses hash embeddings
+        assert hits[0]["text"] == "alpha report"
+        # RRF scores are negated into dist (higher score = lower dist)
+        assert hits[0]["dist"] <= hits[1]["dist"]
+
+    def test_hybrid_index_requires_two(self):
+        from pathway_tpu.stdlib.indexing import HybridIndex
+
+        with pytest.raises(ValueError, match="at least two"):
+            HybridIndex([object()])

@@ -1,0 +1,116 @@
+"""Observability: operator probes, console dashboard, Prometheus endpoint
+(reference: internals/monitoring.py:56-228, src/engine/http_server.rs:22-194,
+graph.rs:500-542 probes)."""
+
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.internals.monitoring import (
+    MonitoringHttpServer,
+    MonitoringLevel,
+    StatsMonitor,
+)
+from pathway_tpu.internals.runner import GraphRunner
+
+
+def _pipeline():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(word=str), [("a",), ("b",), ("a",)]
+    )
+    return t.groupby(t.word).reduce(word=t.word, cnt=pw.reducers.count())
+
+
+class TestOperatorProbes:
+    def test_scheduler_collects_stats(self):
+        counts = _pipeline()
+        runner = GraphRunner()
+        runner.monitor = StatsMonitor(MonitoringLevel.ALL)
+        node = runner.build(counts)
+        runner.run()
+        sched = runner.monitor.scheduler
+        assert sched is not None and sched.stats
+        st = sched.stats[node.index]
+        assert st.insertions >= 2  # two groups emitted
+        assert st.time_spent > 0
+        assert runner.monitor.commits >= 1
+
+    def test_connector_stats_flow(self, tmp_path):
+        src = tmp_path / "in.jsonl"
+        src.write_text('{"w": "x"}\n{"w": "y"}\n')
+
+        class S(pw.Schema):
+            w: str
+
+        t = pw.io.jsonlines.read(src, schema=S, mode="static")
+        runner = GraphRunner()
+        runner.monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+        runner.build(t)
+        runner.run()
+        (stats,) = runner.monitor.connectors.values()
+        assert stats.entries == 1  # one file payload
+        assert stats.finished
+
+
+class TestPrometheusEndpoint:
+    def test_scrapeable_metrics(self):
+        counts = _pipeline()
+        runner = GraphRunner()
+        monitor = StatsMonitor(MonitoringLevel.ALL)
+        runner.monitor = monitor
+        runner.build(counts)
+        runner.run()
+        server = MonitoringHttpServer(monitor, port=0)
+        try:
+            body = (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics", timeout=5
+                )
+                .read()
+                .decode()
+            )
+        finally:
+            server.stop()
+        assert "pathway_commits_total" in body
+        assert "pathway_operator_rows" in body
+        assert "pathway_uptime_seconds" in body
+
+    def test_unknown_path_404(self):
+        monitor = StatsMonitor()
+        server = MonitoringHttpServer(monitor, port=0)
+        try:
+            import urllib.error
+
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/nope", timeout=5
+                )
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+
+
+class TestDashboard:
+    def test_live_table_renders(self):
+        import io
+
+        from rich.console import Console
+
+        buf = io.StringIO()
+        monitor = StatsMonitor(
+            MonitoringLevel.IN_OUT, console=Console(file=buf, width=80)
+        )
+        monitor.connector("fs:/data").entries = 5
+        monitor.start_live()
+        monitor.on_commit(1, 0.0)
+        monitor.stop()
+        out = buf.getvalue()
+        assert "fs:/data" in out and "5" in out
+
+    def test_pw_run_with_monitoring(self, tmp_path):
+        out = tmp_path / "o.jsonl"
+        t = _pipeline()
+        pw.io.jsonlines.write(t, out)
+        pw.run(monitoring_level=MonitoringLevel.NONE, with_http_server=False)
+        assert out.exists()
