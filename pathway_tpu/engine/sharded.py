@@ -1,0 +1,269 @@
+"""Multi-worker execution: key-sharded scopes with inter-operator exchange.
+
+Reference worker model (src/engine/dataflow/config.rs:63-120,
+value.rs:94-130 Key::shard, docs worker-architecture.md:36-47): every
+worker runs the IDENTICAL dataflow over a hash-partition of the key space;
+records cross workers at exchange points before stateful operators, and
+single-threaded sinks run on worker 0.
+
+Here each logical worker owns a full engine Scope built from the same
+graph logic (the reference re-executes the Python logic per worker,
+python_api.rs:3329). The sharded scheduler propagates all scopes in
+lockstep; when operator A on worker w emits a batch for consumer B, the
+batch is partitioned by B's co-location key and delivered to B's replica
+on the owning worker:
+
+- groupby/deduplicate: by grouping/instance values
+- join: per side, by the join-key columns
+- ix: lookups route to the owner of the pointed-at row
+- temporal/iterate/external-index/subscribe/output: worker 0 (their state
+  is global — watermarks, fixed-points, as-of-now indexes; the reference
+  similarly pins non-partitionable sinks to one worker)
+- everything else: by row key
+
+In-process today; the exchange seam is where ICI/DCN collectives slot in
+for multi-host (SURVEY §2.10 mapping).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
+from pathway_tpu.engine.graph import (
+    DeduplicateNode,
+    ErrorLogNode,
+    GroupbyNode,
+    InputSession,
+    IxNode,
+    JoinNode,
+    Node,
+    Scope,
+    SortNode,
+    StaticSource,
+    SubscribeNode,
+)
+from pathway_tpu.engine.value import Pointer, hash_values
+
+Entry = tuple
+
+
+def _shard_of(value: Any, n: int) -> int:
+    if isinstance(value, Pointer):
+        return int(value) % n
+    try:
+        return int(hash_values((value,), salt=b"shard")) % n
+    except TypeError:
+        return int(hash_values((repr(value),), salt=b"shard")) % n
+
+
+def partitioner(
+    consumer: Node, port: int, n_workers: int
+) -> Callable[[Pointer, tuple], int] | None:
+    """How entries entering ``consumer`` on ``port`` pick their worker;
+    None = worker 0 (globally-stateful operator)."""
+    from pathway_tpu.engine import temporal as _temporal
+    from pathway_tpu.engine.external_index import ExternalIndexNode
+    from pathway_tpu.engine.iterate import IterateNode
+
+    if isinstance(consumer, GroupbyNode):
+        cols = consumer.by_cols
+
+        def by_group(key: Pointer, row: tuple) -> int:
+            return _shard_of(tuple(row[c] for c in cols), n_workers)
+
+        return by_group
+    if isinstance(consumer, DeduplicateNode):
+        cols = consumer.instance_cols
+
+        def by_instance(key: Pointer, row: tuple) -> int:
+            return _shard_of(tuple(row[c] for c in cols), n_workers)
+
+        return by_instance
+    if isinstance(consumer, JoinNode):
+        cols = consumer.left_on if port == 0 else consumer.right_on
+
+        def by_join_key(key: Pointer, row: tuple) -> int:
+            return _shard_of(tuple(row[c] for c in cols), n_workers)
+
+        return by_join_key
+    if isinstance(consumer, SortNode):
+        inst = consumer.instance_col
+
+        def by_sort_instance(key: Pointer, row: tuple) -> int:
+            return _shard_of(row[inst] if inst is not None else None, n_workers)
+
+        return by_sort_instance
+    if isinstance(consumer, IxNode):
+        if port == 0:
+            col = consumer.key_col
+
+            def by_lookup(key: Pointer, row: tuple) -> int:
+                return _shard_of(row[col], n_workers)
+
+            return by_lookup
+
+        def by_row_key(key: Pointer, row: tuple) -> int:
+            return _shard_of(key, n_workers)
+
+        return by_row_key
+    if isinstance(
+        consumer,
+        (
+            SubscribeNode,
+            ErrorLogNode,
+            ExternalIndexNode,
+            IterateNode,
+            _temporal.BufferNode,
+            _temporal.ForgetNode,
+            _temporal.FreezeNode,
+            _temporal.SessionAssignNode,
+            _temporal.IntervalJoinNode,
+            _temporal.AsofJoinNode,
+            _temporal.AsofNowJoinNode,
+        ),
+    ):
+        return None  # global state: pin to worker 0
+
+    def by_key(key: Pointer, row: tuple) -> int:
+        return _shard_of(key, n_workers)
+
+    return by_key
+
+
+class ShardedScheduler:
+    """Lockstep commit pump over N identically-built scopes."""
+
+    def __init__(self, scopes: Sequence[Scope]) -> None:
+        self.scopes = list(scopes)
+        self.n = len(self.scopes)
+        self.time = 0
+        sigs = [
+            [type(node).__name__ for node in scope.nodes]
+            for scope in self.scopes
+        ]
+        # worker 0 may carry extra TRAILING nodes: sinks attach there only
+        # (single-threaded sinks, reference data_storage.rs:611)
+        for w, sig in enumerate(sigs[1:], start=1):
+            if sigs[0][: len(sig)] != sig:
+                raise ValueError(
+                    f"worker {w} scope diverged: the graph logic must build "
+                    "the identical operator sequence on every worker"
+                )
+        # partition function cache per (consumer index, port)
+        self._parts: dict[tuple[int, int], Any] = {}
+
+    def _partition_fn(self, consumer: Node, port: int):
+        key = (consumer.index, port)
+        fn = self._parts.get(key, False)
+        if fn is False:
+            fn = partitioner(consumer, port, self.n)
+            self._parts[key] = fn
+        return fn
+
+    def _deliver(
+        self, worker: int, producer: Node, out: DeltaBatch
+    ) -> None:
+        """Exchange step: split ``out`` per consumer and push each part to
+        the consumer's replica on the owning worker. The consumer topology
+        comes from worker 0's scope — the superset, since sinks attach
+        there only."""
+        for consumer, port in self.scopes[0].nodes[producer.index].consumers:
+            fn = self._partition_fn(consumer, port)
+            if fn is None:
+                target = self.scopes[0].nodes[consumer.index]
+                target.push(port, out)
+                continue
+            parts: list[list[Entry]] = [[] for _ in range(self.n)]
+            for key, row, diff in out:
+                parts[fn(key, row)].append((key, row, diff))
+            for w, entries in enumerate(parts):
+                if entries:
+                    batch = DeltaBatch(entries)
+                    batch._consolidated = out._consolidated
+                    self.scopes[w].nodes[consumer.index].push(port, batch)
+
+    def propagate(self, time: int) -> None:
+        while True:
+            busy = False
+            for w, scope in enumerate(self.scopes):
+                for node in scope.nodes:
+                    if not node.has_pending():
+                        continue
+                    busy = True
+                    out = node.process(time)
+                    if out is None:
+                        out = DeltaBatch()
+                    out = out.consolidate() if out else out
+                    apply_batch_to_state(node.current, out)
+                    if out:
+                        self._deliver(w, node, out)
+            if busy:
+                continue
+            flushed = False
+            for scope in self.scopes:
+                for node in scope.nodes:
+                    if isinstance(node, ErrorLogNode):
+                        batch = node.flush_buffer()
+                        if batch:
+                            node.push(0, batch)
+                            flushed = True
+            if not flushed:
+                break
+        for scope in self.scopes:
+            for node in scope.nodes:
+                node.on_time_end(time)
+
+    def commit(self) -> int:
+        for w, scope in enumerate(self.scopes):
+            for node in scope.nodes:
+                if isinstance(node, StaticSource):
+                    # the same static rows exist on every worker replica;
+                    # only worker 0 emits, the exchange spreads them
+                    batch = node.initial_batch() if w == 0 else None
+                    if w != 0:
+                        node._emitted = True
+                    if batch:
+                        self._route_source(w, node, batch)
+                elif isinstance(node, InputSession):
+                    batch = node.flush()
+                    if batch:
+                        self._route_source(w, node, batch)
+        time = self.time
+        self.propagate(time)
+        self.time += 1
+        return time
+
+    def _route_source(self, worker: int, node: Node, batch: DeltaBatch) -> None:
+        """Source batches partition by row key into the source's replicas
+        (the reference reads non-partitioned sources on one worker and
+        reshards, dataflow.rs:3492)."""
+        parts: list[list[Entry]] = [[] for _ in range(self.n)]
+        for key, row, diff in batch:
+            parts[_shard_of(key, self.n)].append((key, row, diff))
+        for w, entries in enumerate(parts):
+            if entries:
+                replica = self.scopes[w].nodes[node.index]
+                b = DeltaBatch(entries)
+                apply_batch_to_state(replica.current, b)
+                self._deliver(w, replica, b)
+
+    def finish(self) -> None:
+        self.commit()
+        for scope in self.scopes:
+            for node in scope.nodes:
+                node.on_end()
+        if any(
+            n.has_pending() for s in self.scopes for n in s.nodes
+        ):
+            self.propagate(self.time)
+            self.time += 1
+
+    # -- results --------------------------------------------------------------
+
+    def merged_state(self, index: int) -> dict[Pointer, tuple]:
+        """Union of one operator's state across workers (for captures)."""
+        out: dict[Pointer, tuple] = {}
+        for scope in self.scopes:
+            out.update(scope.nodes[index].current)
+        return out

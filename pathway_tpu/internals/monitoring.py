@@ -162,12 +162,18 @@ class StatsMonitor:
                 "# TYPE pathway_commit_latency_ms gauge",
                 f"pathway_commit_latency_ms {self._latency_ms:.3f}",
             ]
+        def esc(v: str) -> str:
+            # Prometheus exposition label escaping: \ " and newline
+            return (
+                v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+            )
+
         lines.append("# TYPE pathway_input_entries_total counter")
         # snapshot: the run thread inserts concurrently with scrapes
         for st in list(self.connectors.values()):
-            label = st.name.replace('"', "'")
             lines.append(
-                f'pathway_input_entries_total{{connector="{label}"}} {st.entries}'
+                f'pathway_input_entries_total{{connector="{esc(st.name)}"}} '
+                f"{st.entries}"
             )
         if self.scheduler is not None:
             lines.append("# TYPE pathway_operator_rows gauge")
@@ -177,7 +183,7 @@ class StatsMonitor:
                 st = stats.get(node.index)
                 if st is None:
                     continue
-                label = f'operator="{node.name}",index="{node.index}"'
+                label = f'operator="{esc(node.name)}",index="{node.index}"'
                 lines.append(
                     f"pathway_operator_rows{{{label}}} "
                     f"{st.insertions - st.deletions}"

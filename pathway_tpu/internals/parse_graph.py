@@ -101,14 +101,31 @@ def run(
 
     from pathway_tpu.internals.telemetry import run_span
 
+    import os as _os
+
+    threads = kwargs.get("threads") or int(
+        _os.environ.get("PATHWAY_THREADS", "1")
+    )
     try:
         with run_span():
-            for sink in G.sinks:
-                node = runner.build(sink.table)
-                driver = sink.attach(runner.scope, node)
-                if driver is not None:
-                    runner.drivers.append(driver)
-            runner.run()
+            if threads > 1:
+                # multi-worker: identical graph per worker, key-sharded
+                # exchange (engine/sharded.py; reference PATHWAY_THREADS)
+                from pathway_tpu.internals.runner import ShardedGraphRunner
+
+                sharded = ShardedGraphRunner(
+                    threads, persistence_config=persistence_config
+                )
+                sharded.monitor = monitor
+                sharded.attach_sinks()
+                sharded.run()
+            else:
+                for sink in G.sinks:
+                    node = runner.build(sink.table)
+                    driver = sink.attach(runner.scope, node)
+                    if driver is not None:
+                        runner.drivers.append(driver)
+                runner.run()
     finally:
         if monitor is not None:
             monitor.stop()

@@ -954,3 +954,143 @@ class GraphRunner:
         parse_graph.G.sinks = remaining
         self.run()
         return [node.snapshot() for node in nodes]
+
+
+class ShardedGraphRunner:
+    """N logical workers, each owning a replica of the graph; batches
+    exchange between operator replicas by co-location key
+    (engine/sharded.py; reference worker model config.rs:63-120).
+
+    Input connectors poll on worker 0 and reshard (reference
+    dataflow.rs:3492 `scope.index() < parallel_readers`); subscribe/output
+    sinks attach on worker 0 only (single-threaded sinks,
+    data_storage.rs:611).
+    """
+
+    def __init__(self, n_workers: int, persistence_config: Any = None) -> None:
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        from pathway_tpu.persistence import PersistenceMode
+
+        if (
+            persistence_config is not None
+            and getattr(persistence_config, "persistence_mode", None)
+            == PersistenceMode.OPERATOR_PERSISTING
+        ):
+            raise NotImplementedError(
+                "operator snapshots are single-worker for now; use "
+                "input-journal persistence (PersistenceMode.PERSISTING) "
+                "with threads>1"
+            )
+        self.workers = [
+            GraphRunner(persistence_config=persistence_config)
+            for _ in range(n_workers)
+        ]
+        self.n = n_workers
+        self.monitor: Any = None
+
+    def build(self, table: "Table") -> list[Node]:
+        return [w.build(table) for w in self.workers]
+
+    def _make_scheduler(self):
+        from pathway_tpu.engine.sharded import ShardedScheduler
+
+        return ShardedScheduler([w.scope for w in self.workers])
+
+    def run(self, sched=None):
+        import time as _time
+
+        sched = sched or self._make_scheduler()
+        w0 = self.workers[0]
+        drivers = list(w0.drivers)  # inputs read on worker 0
+        persistent = [d for d in drivers if hasattr(d, "replay")]
+        for d in persistent:
+            d.replay()
+        if self.monitor is not None:
+            # operator stats live per worker scope; surface worker 0's
+            self.monitor.scheduler = None
+        sched.commit()
+        idle_spins = 0
+        live = list(drivers)
+        while live:
+            produced = False
+            for d in list(live):
+                status = d.poll()
+                if status == "done":
+                    live.remove(d)
+                    produced = True
+                elif status == "data":
+                    produced = True
+            if produced:
+                started = _time.monotonic()
+                time = sched.commit()
+                for d in persistent:
+                    d.on_commit(time)
+                if self.monitor is not None:
+                    w0.monitor = self.monitor
+                    w0._sync_monitor_connectors()
+                    self.monitor.on_commit(time, started)
+                idle_spins = 0
+            else:
+                # passive loopback sources (AsyncTransformer) wait for
+                # their upstream to finish — same drain as GraphRunner.run
+                notified = False
+                if live and all(
+                    getattr(d, "upstream_done", None) is not None
+                    for d in live
+                ):
+                    for d in live:
+                        if getattr(d, "_upstream_notified", False):
+                            continue
+                        if w0._loopback_upstream_live(d, live):
+                            continue
+                        d._upstream_notified = True
+                        d.upstream_done()
+                        notified = True
+                        break
+                if not notified:
+                    idle_spins += 1
+                    _time.sleep(min(0.001 * idle_spins, 0.05))
+        sched.finish()
+        for d in persistent:
+            d.on_commit(sched.time)
+        return sched
+
+    def capture(self, *tables: "Table") -> list[dict[Pointer, tuple]]:
+        from pathway_tpu.internals import parse_graph
+
+        replicas = [self.build(t) for t in tables]
+        # internal sinks: worker 0 only; build every sink table first so
+        # SubscribeNodes land after all shared nodes (index alignment)
+        remaining = [s for s in parse_graph.G.sinks if not s.internal]
+        internal = [s for s in parse_graph.G.sinks if s.internal]
+        nodes = [self.workers[0].build(s.table) for s in internal]
+        for w in self.workers[1:]:
+            for s in internal:
+                w.build(s.table)
+        for sink, node in zip(internal, nodes):
+            driver = sink.attach(self.workers[0].scope, node)
+            if driver is not None:
+                self.workers[0].drivers.append(driver)
+        parse_graph.G.sinks = remaining
+        sched = self.run()
+        return [
+            sched.merged_state(reps[0].index) for reps in replicas
+        ]
+
+    def attach_sinks(self) -> None:
+        """Attach ALL registered sinks on worker 0 (pw.run path). All sink
+        tables build FIRST so SubscribeNodes land after every shared node
+        and worker replicas stay index-aligned."""
+        from pathway_tpu.internals import parse_graph
+
+        sinks = list(parse_graph.G.sinks)
+        nodes = [self.workers[0].build(s.table) for s in sinks]
+        for w in self.workers[1:]:
+            for s in sinks:
+                w.build(s.table)
+        for sink, node in zip(sinks, nodes):
+            driver = sink.attach(self.workers[0].scope, node)
+            if driver is not None:
+                self.workers[0].drivers.append(driver)
+        parse_graph.G.sinks = []
