@@ -51,9 +51,18 @@ from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
+from pathway_tpu.internals.config import PathwayConfig, get_pathway_config, set_license_key  # noqa: E402
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu.internals.telemetry import set_monitoring_config  # noqa: E402
 from pathway_tpu.stdlib import temporal  # noqa: E402
+
+
+def load_yaml(stream):
+    """Declarative app templates (reference yaml_loader.py:214). Imported
+    lazily so pyyaml stays an optional dependency."""
+    from pathway_tpu.internals.yaml_loader import load_yaml as _load_yaml
+
+    return _load_yaml(stream)
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.internals import udfs  # noqa: E402
 from pathway_tpu.internals.iterate import iterate  # noqa: E402
@@ -122,6 +131,10 @@ __all__ = [
     "stdlib",
     "temporal",
     "MonitoringLevel",
+    "PathwayConfig",
+    "get_pathway_config",
+    "set_license_key",
+    "load_yaml",
     "set_monitoring_config",
     "AsyncTransformer",
     "this",

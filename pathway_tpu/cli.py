@@ -1,0 +1,106 @@
+"""The ``pathway`` CLI (reference: python/pathway/cli.py).
+
+``python -m pathway_tpu.cli spawn --threads N --processes M prog.py args``
+launches M processes of the program with the worker-topology env vars the
+runtime reads (PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT/RUN_ID,
+reference cli.py:93-107). Threads shard the dataflow in-process
+(pw.run threads=N → ShardedGraphRunner); processes partition input at the
+connector, as with the reference's per-worker partitioned reads.
+
+``spawn-from-env`` re-reads the full command from PATHWAY_SPAWN_ARGS —
+the container-deployment entry point (reference spawn_from_env).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import uuid
+from typing import Sequence
+
+
+def spawn(
+    program: str,
+    arguments: Sequence[str],
+    *,
+    threads: int = 1,
+    processes: int = 1,
+    first_port: int = 10000,
+    env: dict | None = None,
+) -> int:
+    env_base = dict(os.environ if env is None else env)
+    run_id = str(uuid.uuid4())
+    print(
+        f"Preparing {processes} process(es) "
+        f"({processes * threads} total workers)",
+        file=sys.stderr,
+    )
+    handles = []
+    try:
+        for process_id in range(processes):
+            proc_env = env_base.copy()
+            proc_env["PATHWAY_THREADS"] = str(threads)
+            proc_env["PATHWAY_PROCESSES"] = str(processes)
+            proc_env["PATHWAY_FIRST_PORT"] = str(first_port)
+            proc_env["PATHWAY_PROCESS_ID"] = str(process_id)
+            proc_env["PATHWAY_RUN_ID"] = run_id
+            handles.append(
+                subprocess.Popen([program, *arguments], env=proc_env)
+            )
+        for handle in handles:
+            handle.wait()
+    finally:
+        for handle in handles:
+            if handle.poll() is None:
+                handle.terminate()
+    for handle in handles:
+        rc = handle.returncode
+        if rc is None:
+            return 1  # never finished: failure
+        if rc != 0:
+            # negative = killed by signal; report 128+signal like the shell
+            return rc if rc > 0 else 128 - rc
+    return 0
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="pathway")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_spawn = sub.add_parser(
+        "spawn", help="run a pathway program over N threads × M processes"
+    )
+    p_spawn.add_argument("--threads", "-t", type=int, default=1)
+    p_spawn.add_argument("--processes", "-n", type=int, default=1)
+    p_spawn.add_argument("--first-port", type=int, default=10000)
+    p_spawn.add_argument("program")
+    p_spawn.add_argument("arguments", nargs=argparse.REMAINDER)
+
+    sub.add_parser(
+        "spawn-from-env",
+        help="run the command from the PATHWAY_SPAWN_ARGS env variable",
+    )
+
+    args = parser.parse_args(argv)
+    if args.command == "spawn":
+        return spawn(
+            args.program,
+            args.arguments,
+            threads=args.threads,
+            processes=args.processes,
+            first_port=args.first_port,
+        )
+    if args.command == "spawn-from-env":
+        spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")
+        if not spawn_args:
+            print("PATHWAY_SPAWN_ARGS is not set", file=sys.stderr)
+            return 2
+        return main(["spawn", *shlex.split(spawn_args)])
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

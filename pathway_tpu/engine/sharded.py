@@ -134,10 +134,14 @@ def partitioner(
 class ShardedScheduler:
     """Lockstep commit pump over N identically-built scopes."""
 
-    def __init__(self, scopes: Sequence[Scope]) -> None:
+    def __init__(self, scopes: Sequence[Scope], probe: bool = False) -> None:
         self.scopes = list(scopes)
         self.n = len(self.scopes)
         self.time = 0
+        self.probe = probe
+        #: node index -> OperatorStats aggregated ACROSS workers (the
+        #: monitoring surface reads .scope/.stats like the single Scheduler)
+        self.stats: dict[int, Any] = {}
         sigs = [
             [type(node).__name__ for node in scope.nodes]
             for scope in self.scopes
@@ -183,7 +187,23 @@ class ShardedScheduler:
                     batch._consolidated = out._consolidated
                     self.scopes[w].nodes[consumer.index].push(port, batch)
 
+    @property
+    def scope(self) -> Scope:
+        """Canonical scope for monitoring (worker 0 carries the superset)."""
+        return self.scopes[0]
+
+    def _stats_of(self, node: Node):
+        from pathway_tpu.engine.graph import OperatorStats
+
+        st = self.stats.get(node.index)
+        if st is None:
+            st = self.stats[node.index] = OperatorStats()
+        return st
+
     def propagate(self, time: int) -> None:
+        probe = self.probe
+        if probe:
+            import time as _walltime
         while True:
             busy = False
             for w, scope in enumerate(self.scopes):
@@ -191,11 +211,23 @@ class ShardedScheduler:
                     if not node.has_pending():
                         continue
                     busy = True
+                    if probe:
+                        t0 = _walltime.perf_counter()
                     out = node.process(time)
                     if out is None:
                         out = DeltaBatch()
                     out = out.consolidate() if out else out
                     apply_batch_to_state(node.current, out)
+                    if probe:
+                        st = self._stats_of(node)
+                        st.time_spent += _walltime.perf_counter() - t0
+                        st.batches += 1
+                        st.last_time = time
+                        for _k, _r, d in out:
+                            if d > 0:
+                                st.insertions += 1
+                            else:
+                                st.deletions += 1
                     if out:
                         self._deliver(w, node, out)
             if busy:
@@ -224,29 +256,38 @@ class ShardedScheduler:
                     if w != 0:
                         node._emitted = True
                     if batch:
-                        self._route_source(w, node, batch)
+                        self._route_source(node, batch)
                 elif isinstance(node, InputSession):
                     batch = node.flush()
                     if batch:
-                        self._route_source(w, node, batch)
+                        self._route_source(node, batch)
         time = self.time
         self.propagate(time)
         self.time += 1
         return time
 
-    def _route_source(self, worker: int, node: Node, batch: DeltaBatch) -> None:
-        """Source batches partition by row key into the source's replicas
-        (the reference reads non-partitioned sources on one worker and
-        reshards, dataflow.rs:3492)."""
-        parts: list[list[Entry]] = [[] for _ in range(self.n)]
-        for key, row, diff in batch:
-            parts[_shard_of(key, self.n)].append((key, row, diff))
-        for w, entries in enumerate(parts):
-            if entries:
-                replica = self.scopes[w].nodes[node.index]
-                b = DeltaBatch(entries)
-                apply_batch_to_state(replica.current, b)
-                self._deliver(w, replica, b)
+    def _route_source(self, node: Node, batch: DeltaBatch) -> None:
+        """Sources read whole on worker 0 and reshard at the exchange
+        (reference dataflow.rs:3492).
+
+        State bookkeeping serves two invariants at once:
+        - the worker-0 replica keeps the FULL source state, so
+          upsert/remove flushes resolve against complete history and emit
+          retractions for rows whose shard lives elsewhere;
+        - replicas w>0 keep their row-key shard, so consumers that peek at
+          an input's ``current`` (zip/update/ix source side) find exactly
+          the rows whose downstream parts they receive."""
+        replica0 = self.scopes[0].nodes[node.index]
+        apply_batch_to_state(replica0.current, batch)
+        if self.n > 1:
+            parts: list[list[Entry]] = [[] for _ in range(self.n)]
+            for key, row, diff in batch:
+                parts[_shard_of(key, self.n)].append((key, row, diff))
+            for w in range(1, self.n):
+                if parts[w]:
+                    replica = self.scopes[w].nodes[node.index]
+                    apply_batch_to_state(replica.current, DeltaBatch(parts[w]))
+        self._deliver(0, replica0, batch)
 
     def finish(self) -> None:
         self.commit()

@@ -65,9 +65,22 @@ def run(
     ALL render a live rich dashboard; ``with_http_server`` additionally
     serves Prometheus metrics on port 20000 + PATHWAY_PROCESS_ID
     (reference monitoring.py:56-228, http_server.rs:22)."""
-    from pathway_tpu.internals.runner import GraphRunner
+    from pathway_tpu.internals.config import get_pathway_config
+    from pathway_tpu.internals.runner import GraphRunner, ShardedGraphRunner
 
-    runner = GraphRunner(persistence_config=persistence_config)
+    if persistence_config is None:
+        # env-driven persistence (PATHWAY_PERSISTENT_STORAGE etc.,
+        # reference PathwayConfig.replay_config)
+        persistence_config = get_pathway_config().replay_config
+    threads = kwargs.get("threads") or get_pathway_config().threads
+    if threads > 1:
+        # multi-worker: identical graph per worker, key-sharded exchange
+        # (engine/sharded.py; reference PATHWAY_THREADS)
+        runner: Any = ShardedGraphRunner(
+            threads, persistence_config=persistence_config
+        )
+    else:
+        runner = GraphRunner(persistence_config=persistence_config)
 
     monitor = None
     http_server = None
@@ -101,24 +114,11 @@ def run(
 
     from pathway_tpu.internals.telemetry import run_span
 
-    import os as _os
-
-    threads = kwargs.get("threads") or int(
-        _os.environ.get("PATHWAY_THREADS", "1")
-    )
     try:
         with run_span():
-            if threads > 1:
-                # multi-worker: identical graph per worker, key-sharded
-                # exchange (engine/sharded.py; reference PATHWAY_THREADS)
-                from pathway_tpu.internals.runner import ShardedGraphRunner
-
-                sharded = ShardedGraphRunner(
-                    threads, persistence_config=persistence_config
-                )
-                sharded.monitor = monitor
-                sharded.attach_sinks()
-                sharded.run()
+            if isinstance(runner, ShardedGraphRunner):
+                runner.attach_sinks()
+                runner.run()
             else:
                 for sink in G.sinks:
                     node = runner.build(sink.table)

@@ -52,10 +52,14 @@ from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 
 class GraphRunner:
     def __init__(
-        self, scope: Scope | None = None, persistence_config: Any = None
+        self,
+        scope: Scope | None = None,
+        persistence_config: Any = None,
+        attach_drivers: bool = True,
     ) -> None:
         self.scope = scope if scope is not None else Scope()
         self.nodes: dict[int, Node] = {}
+        self.attach_drivers = attach_drivers  # False on sharded replicas >0
         self.drivers: list[Any] = []  # connector drivers (streaming mode)
         self.monitors: list[Any] = []
         self.monitor: Any = None  # StatsMonitor (internals/monitoring.py)
@@ -250,6 +254,8 @@ class GraphRunner:
             # connector-backed table: the io layer supplies an attach function
             attach = spec.params["attach"]
             node, driver = attach(scope)
+            if driver is not None and not self.attach_drivers:
+                driver = None  # replica scopes never poll; worker 0 reads
             if driver is not None:
                 persistent_id = spec.params.get("persistent_id")
                 if persistent_id is not None and self.persistence is not None:
@@ -983,8 +989,11 @@ class ShardedGraphRunner:
                 "with threads>1"
             )
         self.workers = [
-            GraphRunner(persistence_config=persistence_config)
-            for _ in range(n_workers)
+            GraphRunner(
+                persistence_config=persistence_config,
+                attach_drivers=(i == 0),
+            )
+            for i in range(n_workers)
         ]
         self.n = n_workers
         self.monitor: Any = None
@@ -995,7 +1004,12 @@ class ShardedGraphRunner:
     def _make_scheduler(self):
         from pathway_tpu.engine.sharded import ShardedScheduler
 
-        return ShardedScheduler([w.scope for w in self.workers])
+        probe = self.monitor is not None and getattr(
+            self.monitor, "wants_operator_stats", True
+        )
+        return ShardedScheduler(
+            [w.scope for w in self.workers], probe=probe
+        )
 
     def run(self, sched=None):
         import time as _time
@@ -1007,8 +1021,8 @@ class ShardedGraphRunner:
         for d in persistent:
             d.replay()
         if self.monitor is not None:
-            # operator stats live per worker scope; surface worker 0's
-            self.monitor.scheduler = None
+            # aggregated cross-worker operator stats (ShardedScheduler.stats)
+            self.monitor.scheduler = sched
         sched.commit()
         idle_spins = 0
         live = list(drivers)

@@ -1,0 +1,139 @@
+"""Declarative app templates: YAML with ``!pw...`` object tags + ``$var``
+variables.
+
+Reference: python/pathway/internals/yaml_loader.py:214 load_yaml — the RAG
+app templates instantiate embedders/stores/servers straight from YAML:
+
+    $llm: !pw.xpacks.llm.llms.TpuPipelineChat
+      model: tiny
+    question_answerer: !pw.xpacks.llm.question_answering.BaseRAGQuestionAnswerer
+      llm: $llm
+      indexer: $document_store
+
+Tags resolve against this package (``pw.`` →  ``pathway_tpu.``) or any
+importable dotted path; ``$name`` keys declare variables, ``$name`` values
+reference them (each constructed exactly once).
+"""
+
+from __future__ import annotations
+
+import importlib
+import io
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+@dataclass(frozen=True)
+class Variable:
+    name: str
+
+    def __str__(self) -> str:
+        return f"${self.name}"
+
+
+@dataclass(eq=False)
+class Value:
+    constructor: Any
+    kwargs: Any
+    constructed: bool = False
+    value: Any = None
+
+
+def import_object(path: str) -> Any:
+    """``pw.x.y.Z`` / ``pathway_tpu.x.y.Z`` / any importable dotted path."""
+    if path.startswith("pw.") or path.startswith("pw:"):
+        path = "pathway_tpu." + path[3:]
+    path = path.replace(":", ".")
+    parts = path.split(".")
+    for split in range(len(parts), 0, -1):
+        module_name = ".".join(parts[:split])
+        try:
+            obj: Any = importlib.import_module(module_name)
+        except ImportError:
+            continue
+        try:
+            for attr in parts[split:]:
+                obj = getattr(obj, attr)
+        except AttributeError:
+            break
+        return obj
+    raise ValueError(f"cannot import {path!r}")
+
+
+class PathwayYamlLoader(yaml.SafeLoader):
+    pass
+
+
+def _construct_variable(loader: PathwayYamlLoader, node: yaml.Node) -> Variable:
+    name = loader.construct_yaml_str(node)
+    if not name.startswith("$"):
+        raise yaml.YAMLError(f"variable {name!r} must start with '$'")
+    return Variable(name[1:])
+
+
+def _construct_value(
+    loader: PathwayYamlLoader, tag_suffix: str, node: yaml.Node
+) -> Value:
+    constructor = import_object(tag_suffix)
+    if isinstance(node, yaml.MappingNode):
+        kwargs = loader.construct_mapping(node, deep=True)
+    elif isinstance(node, yaml.ScalarNode) and not node.value:
+        kwargs = {}
+    else:
+        raise yaml.YAMLError(
+            f"!{tag_suffix} expects a mapping of keyword arguments"
+        )
+    if not callable(constructor):
+        if kwargs:
+            raise yaml.YAMLError(
+                f"{tag_suffix!r} is not callable but was given arguments"
+            )
+        return Value(None, {}, constructed=True, value=constructor)
+    return Value(constructor, kwargs)
+
+
+PathwayYamlLoader.add_implicit_resolver(
+    "!pw_variable", __import__("re").compile(r"^\$[A-Za-z_][A-Za-z0-9_]*$"), "$"
+)
+PathwayYamlLoader.add_constructor("!pw_variable", _construct_variable)
+# any "!dotted.path" tag constructs the imported object (reference
+# import_object yaml_loader.py:46 — pw.* plus arbitrary importable paths)
+PathwayYamlLoader.add_multi_constructor(
+    "!", lambda loader, suffix, node: _construct_value(loader, suffix, node)
+)
+
+
+@dataclass
+class _Resolver:
+    variables: dict[Variable, Any] = field(default_factory=dict)
+    used: set = field(default_factory=set)
+
+    def resolve(self, obj: Any) -> Any:
+        if isinstance(obj, Variable):
+            if obj not in self.variables:
+                raise ValueError(f"undefined variable {obj}")
+            self.used.add(obj)
+            return self.resolve(self.variables[obj])
+        if isinstance(obj, Value):
+            if not obj.constructed:
+                kwargs = {
+                    k: self.resolve(v) for k, v in obj.kwargs.items()
+                }
+                obj.value = obj.constructor(**kwargs)
+                obj.constructed = True
+            return obj.value
+        if isinstance(obj, dict):
+            declared = [k for k in obj if isinstance(k, Variable)]
+            for var in declared:
+                self.variables[var] = obj.pop(var)
+            return {k: self.resolve(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [self.resolve(v) for v in obj]
+        return obj
+
+
+def load_yaml(stream: "str | bytes | io.IOBase") -> Any:
+    parsed = yaml.load(stream, PathwayYamlLoader)
+    return _Resolver().resolve(parsed)

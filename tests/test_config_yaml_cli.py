@@ -1,0 +1,154 @@
+"""Config env flags, YAML app templates, CLI spawn
+(reference: internals/config.py:58, yaml_loader.py:214, cli.py)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+import pathway_tpu as pw
+
+
+class TestPathwayConfig:
+    def test_env_flags_read(self, monkeypatch):
+        from pathway_tpu.internals.config import PathwayConfig
+
+        monkeypatch.setenv("PATHWAY_IGNORE_ASSERTS", "true")
+        monkeypatch.setenv("PATHWAY_THREADS", "4")
+        monkeypatch.setenv("PATHWAY_PROCESS_ID", "2")
+        cfg = PathwayConfig()
+        assert cfg.ignore_asserts is True
+        assert cfg.threads == 4
+        assert cfg.process_id == "2"
+
+    def test_replay_config_from_env(self, monkeypatch, tmp_path):
+        from pathway_tpu.internals.config import PathwayConfig
+        from pathway_tpu.persistence import PersistenceMode
+
+        monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(tmp_path / "s"))
+        monkeypatch.setenv("PATHWAY_PERSISTENCE_MODE", "operator_persisting")
+        cfg = PathwayConfig().replay_config
+        assert cfg is not None
+        assert cfg.persistence_mode == PersistenceMode.OPERATOR_PERSISTING
+
+    def test_env_persistence_drives_pw_run(self, monkeypatch, tmp_path):
+        """pw.run() with no explicit config persists via the env (reference
+        PathwayConfig.replay_config)."""
+        data = tmp_path / "data"
+        data.mkdir()
+        (data / "a.txt").write_text("x\ny\n")
+        store = tmp_path / "store"
+        monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(store))
+        t = pw.io.plaintext.read(data, mode="static", persistent_id="w")
+        out = tmp_path / "o.jsonl"
+        pw.io.jsonlines.write(t, out)
+        pw.run()
+        assert store.exists() and any(store.iterdir())  # journal written
+
+
+class TestYamlLoader:
+    def test_construct_objects_with_variables(self):
+        text = """
+$splitter: !pw.xpacks.llm.splitters.NullSplitter {}
+chain:
+  splitter: $splitter
+  again: $splitter
+  name: plain
+"""
+        out = pw.load_yaml(text)
+        from pathway_tpu.xpacks.llm.splitters import NullSplitter
+
+        assert isinstance(out["chain"]["splitter"], NullSplitter)
+        # constructed exactly once: both references share the instance
+        assert out["chain"]["splitter"] is out["chain"]["again"]
+        assert out["chain"]["name"] == "plain"
+
+    def test_nested_kwargs(self):
+        text = """
+tok: !pw.xpacks.llm._tokenizer.HashTokenizer
+  vocab_size: 128
+"""
+        out = pw.load_yaml(text)
+        assert out["tok"].vocab_size == 128
+
+    def test_non_pw_dotted_path(self):
+        text = "d: !collections.OrderedDict {}\n"
+        import collections
+
+        out = pw.load_yaml(text)
+        assert isinstance(out["d"], collections.OrderedDict)
+
+    def test_undefined_variable_raises(self):
+        with pytest.raises(ValueError, match="undefined variable"):
+            pw.load_yaml("a: $missing\n")
+
+
+class TestCli:
+    def test_spawn_sets_worker_env(self, tmp_path):
+        worker = tmp_path / "w.py"
+        worker.write_text(
+            "import json, os, sys\n"
+            "out = {k: os.environ.get(k) for k in ("
+            "'PATHWAY_THREADS','PATHWAY_PROCESSES','PATHWAY_PROCESS_ID',"
+            "'PATHWAY_RUN_ID')}\n"
+            "open(sys.argv[1] + os.environ['PATHWAY_PROCESS_ID'], 'w')"
+            ".write(json.dumps(out))\n"
+        )
+        from pathway_tpu.cli import spawn
+
+        rc = spawn(
+            sys.executable,
+            [str(worker), str(tmp_path / "out")],
+            threads=3,
+            processes=2,
+        )
+        assert rc == 0
+        envs = [
+            json.loads((tmp_path / f"out{i}").read_text()) for i in range(2)
+        ]
+        assert all(e["PATHWAY_THREADS"] == "3" for e in envs)
+        assert all(e["PATHWAY_PROCESSES"] == "2" for e in envs)
+        assert {e["PATHWAY_PROCESS_ID"] for e in envs} == {"0", "1"}
+        assert len({e["PATHWAY_RUN_ID"] for e in envs}) == 1
+
+    def test_spawn_from_env(self, tmp_path, monkeypatch):
+        worker = tmp_path / "w.py"
+        worker.write_text(
+            "import os, sys\n"
+            "open(sys.argv[1], 'w').write(os.environ['PATHWAY_THREADS'])\n"
+        )
+        out = tmp_path / "flag"
+        monkeypatch.setenv(
+            "PATHWAY_SPAWN_ARGS",
+            f"--threads 2 {sys.executable} {worker} {out}",
+        )
+        from pathway_tpu.cli import main
+
+        assert main(["spawn-from-env"]) == 0
+        assert out.read_text() == "2"
+
+    def test_module_entrypoint(self, tmp_path):
+        worker = tmp_path / "w.py"
+        worker.write_text("print('hi')\n")
+        res = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pathway_tpu.cli",
+                "spawn",
+                "--processes",
+                "1",
+                sys.executable,
+                str(worker),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=str(pathlib.Path(__file__).resolve().parent.parent),
+        )
+        assert res.returncode == 0
+        assert "hi" in res.stdout

@@ -175,3 +175,43 @@ class TestShardedReviewRegressions:
         )
         with pytest.raises(NotImplementedError, match="single-worker"):
             ShardedGraphRunner(2, persistence_config=cfg)
+
+    def test_upsert_stream_retractions(self):
+        """Upsert replacements must retract the old row even when its
+        downstream shard lives on another worker (review regression)."""
+        from pathway_tpu.engine.storage import InMemoryTransport
+
+        def build(transport):
+            class S(pw.Schema):
+                k: str = pw.column_definition(primary_key=True)
+                v: int
+
+            t = pw.io.kafka.read(
+                None, "topic", format="json", schema=S, transport=transport
+            )
+            return t.groupby().reduce(total=pw.reducers.sum(t.v))
+
+        def make_transport():
+            tp = InMemoryTransport()
+            tp.produce(json.dumps({"k": "a", "v": 1}))
+            tp.produce(json.dumps({"k": "b", "v": 10}))
+            tp.close2 = None
+            return tp
+
+        tp1 = make_transport(); tp1.close()
+        (base,) = GraphRunner().capture(build(tp1))
+        tp2 = make_transport()
+        # second batch replaces k=a AFTER the first commit
+        (sharded_runner := ShardedGraphRunner(4))
+        reps = sharded_runner.build(build(tp2))
+        sched = sharded_runner._make_scheduler()
+        for d in sharded_runner.workers[0].drivers:
+            d.poll()
+        sched.commit()
+        tp2.produce(json.dumps({"k": "a", "v": 100}))
+        tp2.close()
+        for d in sharded_runner.workers[0].drivers:
+            d.poll()
+        sched.commit()
+        merged = sched.merged_state(reps[0].index)
+        assert sorted(merged.values()) == [(110,)]  # not 111: old row retracted
