@@ -52,6 +52,16 @@ from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
 from pathway_tpu.internals.config import PathwayConfig, get_pathway_config, set_license_key  # noqa: E402
+from pathway_tpu.internals.export_import import export_table, import_table  # noqa: E402
+from pathway_tpu.internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu.internals.telemetry import set_monitoring_config  # noqa: E402
 from pathway_tpu.stdlib import temporal  # noqa: E402
@@ -135,6 +145,15 @@ __all__ = [
     "get_pathway_config",
     "set_license_key",
     "load_yaml",
+    "export_table",
+    "import_table",
+    "ClassArg",
+    "attribute",
+    "input_attribute",
+    "input_method",
+    "method",
+    "output_attribute",
+    "transformer",
     "set_monitoring_config",
     "AsyncTransformer",
     "this",

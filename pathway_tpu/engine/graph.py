@@ -1343,6 +1343,24 @@ class Scope:
     ) -> Node:
         return DeduplicateNode(self, table, value_col, instance_cols, acceptor)
 
+    def recompute_table(
+        self, sources: Sequence[Node], compute: Callable[[list], dict], arity: int
+    ) -> Node:
+        return RecomputeNode(self, sources, compute, arity)
+
+    def export_table(
+        self, table: Node, handle: "ExportedTable | None" = None
+    ) -> "ExportedTable":
+        """Reference graph.rs:609 export_table: subscribe the node into a
+        cross-graph handle (pass ``handle`` to fill a pre-created one)."""
+        exported = handle if handle is not None else ExportedTable(table.arity)
+        self.subscribe_table(
+            table,
+            on_change=exported._on_change,
+            on_end=exported._on_end,
+        )
+        return exported
+
     def flatten_table(
         self, table: Node, flat_col: int, with_origin: bool = False
     ) -> Node:
@@ -1533,3 +1551,70 @@ class Scheduler:
     def finish(self) -> None:
         self.commit()
         self._end_nodes()
+
+
+class RecomputeNode(Node):
+    """Whole-recompute operator: ``compute(input_states) -> {key: row}``,
+    diffed against the previous output. Backs row transformers
+    (reference complex_columns.rs — demand-driven there, local recompute
+    here, same results)."""
+
+    def __init__(
+        self,
+        scope: "Scope",
+        sources: Sequence[Node],
+        compute: Callable[[list], dict],
+        arity: int,
+    ) -> None:
+        super().__init__(scope, list(sources), arity)
+        self.compute = compute
+
+    def process(self, time: int) -> DeltaBatch:
+        for port in range(len(self.inputs)):
+            self.take(port)
+        try:
+            new = self.compute([inp.current for inp in self.inputs])
+        except Exception as e:  # noqa: BLE001
+            self.report(None, f"row transformer error: {e!r}")
+            return DeltaBatch()
+        out = DeltaBatch()
+        for key, row in self.current.items():
+            if rows_differ(new.get(key), row):
+                out.append(key, row, -1)
+        for key, row in new.items():
+            if rows_differ(self.current.get(key), row):
+                out.append(key, row, 1)
+        return out.consolidate()
+
+
+class ExportedTable:
+    """Cross-graph table handle (reference: ExportedTable graph.rs:609,
+    export.rs): a live snapshot plus update callbacks, consumable by
+    ``import_table`` in another graph."""
+
+    def __init__(self, arity: int) -> None:
+        self.arity = arity
+        self.current: dict[Pointer, tuple] = {}
+        self._callbacks: list = []
+        self.finished = False
+
+    # producer side --------------------------------------------------------
+    def _on_change(self, key: Pointer, row: tuple, time: int, diff: int) -> None:
+        if diff > 0:
+            self.current[key] = row
+        else:
+            self.current.pop(key, None)
+        for cb in self._callbacks:
+            cb(key, row, time, diff)
+
+    def _on_end(self) -> None:
+        self.finished = True
+        for cb in self._callbacks:
+            cb(None, None, None, 0)
+
+    # consumer side --------------------------------------------------------
+    def snapshot(self) -> dict[Pointer, tuple]:
+        return dict(self.current)
+
+    def subscribe(self, callback) -> None:
+        self._callbacks.append(callback)

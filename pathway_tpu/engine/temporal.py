@@ -581,3 +581,75 @@ class AsofNowJoinNode(Node):
                     out.append(okey, orow, -1)
             self.answered[key] = emitted
         return out.consolidate()
+
+
+class GradualBroadcastNode(Node):
+    """Attach an ``apx_value`` column that moves between ``lower`` and
+    ``upper`` per row as the broadcast value advances.
+
+    Reference: operators/gradual_broadcast.rs — the threshold stream's
+    (lower, value, upper) triplet maps to a key-space cutoff at fraction
+    (value - lower) / (upper - lower); rows whose key falls below the
+    cutoff see ``upper``, the rest see ``lower``. As ``value`` moves, only
+    the rows crossing the moving cutoff re-emit — a gradual, incremental
+    broadcast instead of an all-at-once update (used by louvain's
+    randomized move acceptance).
+    """
+
+    STATE_ATTRS = ("triplet",)
+
+    _KEY_SPACE = float(2**64)
+
+    def __init__(self, scope: Scope, source: Node, threshold: Node) -> None:
+        super().__init__(scope, [source, threshold], source.arity + 1)
+        self.triplet: tuple | None = None  # (lower, value, upper)
+
+    def _fraction(self, key: Pointer) -> float:
+        return (int(key) % 2**64) / self._KEY_SPACE
+
+    def _apx(self, key: Pointer) -> Any:
+        if self.triplet is None:
+            return None
+        lower, value, upper = self.triplet
+        if upper == lower:
+            return lower
+        cutoff = (value - lower) / (upper - lower)
+        return upper if self._fraction(key) <= cutoff else lower
+
+    def process(self, time: int) -> DeltaBatch:
+        source = self.inputs[0]
+        src_batch = self.take(0)
+        thr_batch = self.take(1)
+        out = DeltaBatch()
+        retracted: set[Pointer] = set()
+
+        def retract(key: Pointer) -> None:
+            # each key's previous output may be retracted at most once per
+            # commit, however many branches touch it
+            prev = self.current.get(key)
+            if prev is not None and key not in retracted:
+                out.append(key, prev, -1)
+                retracted.add(key)
+
+        old_triplet = self.triplet
+        for _key, row, diff in thr_batch:
+            if diff > 0:
+                self.triplet = (row[0], row[1], row[2])
+        handled = {key for key, _r, _d in src_batch}
+        if self.triplet != old_triplet:
+            # re-evaluate rows already emitted; only cutoff-crossers change;
+            # keys updated in this commit are covered by the source loop
+            for key, cur in list(self.current.items()):
+                if key in handled:
+                    continue
+                new_apx = self._apx(key)
+                if cur[-1] != new_apx:
+                    retract(key)
+                    src_row = source.current.get(key)
+                    if src_row is not None:
+                        out.append(key, src_row + (new_apx,), 1)
+        for key, row, diff in src_batch:
+            retract(key)
+            if diff > 0:
+                out.append(key, row + (self._apx(key),), 1)
+        return out.consolidate()

@@ -1,0 +1,76 @@
+"""Cross-graph table exchange: ``pw.export_table`` / ``pw.import_table``.
+
+Reference: src/engine/dataflow/export.rs + ExportedTable (graph.rs:609),
+surfaced in Python through ImportDataSource/ExportDataSink
+(graph_runner/operator_handler.py:151-206). A producing graph exports a
+table as a live handle (snapshot + update callbacks); a separate consuming
+graph imports the handle as an input source — the snapshot replays first,
+then updates stream through while both graphs run.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.connectors import INSERT, DELETE, ParsedEvent, Parser, QueueReader
+from pathway_tpu.engine.graph import ExportedTable
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import input_table
+
+
+def export_table(table: Table) -> ExportedTable:
+    """Register ``table`` for export; the handle fills when its graph runs
+    (reference export_table python_api.rs:3205)."""
+    exported = ExportedTable(len(table.column_names()))
+    exported.column_names = table.column_names()  # type: ignore[attr-defined]
+
+    def attach(scope: Any, node: Any):
+        scope.export_table(node, handle=exported)
+        return None
+
+    G.add_sink(table, attach)
+    return exported
+
+
+class _ExportedParser(Parser):
+    def parse(self, payload: Any) -> list[ParsedEvent]:
+        kind, key, row = payload
+        return [ParsedEvent(kind, row, key=(key,))]
+
+
+def import_table(exported: ExportedTable) -> Table:
+    """Bring an exported handle into THIS graph as an input source
+    (reference import_table python_api.rs:3217)."""
+    names = getattr(
+        exported, "column_names", None
+    ) or [f"c{i}" for i in range(exported.arity)]
+    schema = schema_mod.schema_from_types(**{n: Any for n in names})
+
+    def make_reader():
+        # fresh reader per graph build: snapshot first, then live updates;
+        # a shared reader would be drained by whichever build ran first
+        reader = QueueReader()
+        for key, row in exported.snapshot().items():
+            reader.push((INSERT, key, row), source_id="import")
+
+        def on_update(key, row, time, diff):
+            if key is None:  # producer finished
+                reader.close()
+                return
+            reader.push(
+                (INSERT if diff > 0 else DELETE, key, row), source_id="import"
+            )
+
+        exported.subscribe(on_update)
+        if exported.finished:
+            reader.close()
+        return reader
+
+    return input_table(
+        schema,
+        make_reader,
+        lambda _names: _ExportedParser(names),
+        source_name="import",
+    )

@@ -1,0 +1,278 @@
+"""Row transformers — the legacy ``@pw.transformer`` class syntax.
+
+Reference: python/pathway/internals/row_transformer.py (294) over the
+engine's demand-driven complex columns
+(src/engine/dataflow/complex_columns.rs:489, Computer/ComplexColumn
+graph.rs:302-343). A transformer class declares one inner class per input
+table with ``input_attribute``s and computed ``output_attribute``s;
+computations can follow pointers into sibling tables
+(``self.transformer.other[ptr].attr``) and into other computed outputs —
+including recursively (linked-list walks).
+
+The reference resolves demand through a dataflow request/response loop;
+here each output table is an engine node that recomputes affected rows
+with memoised recursive evaluation per commit — same results, host-side
+recursion instead of dataflow loops (the engine's usual local-recompute
+strategy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine.value import Pointer
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.table import Table, TableSpec
+
+
+class _InputAttribute:
+    def __init__(self) -> None:
+        self.name: str | None = None
+
+
+class _OutputAttribute:
+    def __init__(self, fn: Callable, internal: bool = False) -> None:
+        self.fn = fn
+        self.name = fn.__name__
+        #: internal computed attributes (@pw.attribute) are usable in other
+        #: computations but are NOT output columns (reference semantics)
+        self.internal = internal
+
+
+class _Method:
+    def __init__(self, fn: Callable) -> None:
+        self.fn = fn
+        self.name = fn.__name__
+
+
+def input_attribute(type: Any = None) -> Any:  # noqa: A002
+    return _InputAttribute()
+
+
+def output_attribute(fn: Callable | None = None, **_kwargs: Any) -> Any:
+    if fn is None:
+        return lambda f: _OutputAttribute(f)
+    return _OutputAttribute(fn)
+
+
+def method(fn: Callable | None = None, **_kwargs: Any) -> Any:
+    if fn is None:
+        return lambda f: _Method(f)
+    return _Method(fn)
+
+
+def attribute(fn: Callable | None = None, **_kwargs: Any) -> Any:
+    """Internal computed attribute: usable from other computations, not an
+    output column (reference row_transformer.py attribute)."""
+    if fn is None:
+        return lambda f: _OutputAttribute(f, internal=True)
+    return _OutputAttribute(fn, internal=True)
+
+
+input_method = input_attribute
+
+
+class ClassArg:
+    """Base for a transformer's per-table inner class (reference
+    row_transformer.py ClassArg)."""
+
+    _output_schema: Any = None
+
+    def __init_subclass__(cls, output: Any = None, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        cls._output_schema = output
+
+
+def _class_args(cls: type) -> dict[str, type]:
+    return {
+        name: value
+        for name, value in vars(cls).items()
+        if isinstance(value, type) and issubclass(value, ClassArg)
+    }
+
+
+class RowReference:
+    """``self`` inside an output computation: input attributes, computed
+    outputs of any table, ``self.id``, ``self.transformer``, methods."""
+
+    __slots__ = ("_evaluator", "_arg", "_key")
+
+    def __init__(self, evaluator: "_Evaluator", arg: str, key: Pointer):
+        self._evaluator = evaluator
+        self._arg = arg
+        self._key = key
+
+    @property
+    def id(self) -> Pointer:
+        return self._key
+
+    @property
+    def transformer(self) -> "_TransformerNamespace":
+        return _TransformerNamespace(self._evaluator)
+
+    def pointer_from(self, *args: Any) -> Pointer:
+        from pathway_tpu.engine.value import ref_scalar
+
+        return ref_scalar(*args)
+
+    def __getattr__(self, name: str) -> Any:
+        return self._evaluator.value(self._arg, self._key, name)
+
+
+class _TableNamespace:
+    __slots__ = ("_evaluator", "_arg")
+
+    def __init__(self, evaluator: "_Evaluator", arg: str):
+        self._evaluator = evaluator
+        self._arg = arg
+
+    def __getitem__(self, key: Pointer) -> RowReference:
+        return RowReference(self._evaluator, self._arg, key)
+
+
+class _TransformerNamespace:
+    __slots__ = ("_evaluator",)
+
+    def __init__(self, evaluator: "_Evaluator"):
+        self._evaluator = evaluator
+
+    def __getattr__(self, name: str) -> _TableNamespace:
+        return _TableNamespace(self._evaluator, name)
+
+
+class _Evaluator:
+    """One evaluation epoch: memoised recursive output computation over the
+    current input states (the host analog of complex_columns' demand loop)."""
+
+    def __init__(self, spec: "RowTransformer", states: dict[str, dict]):
+        self.spec = spec
+        self.states = states  # arg name -> {key: row tuple}
+        self.memo: dict[tuple[str, Pointer, str], Any] = {}
+        self.in_flight: set[tuple[str, Pointer, str]] = set()
+
+    def value(self, arg: str, key: Pointer, attr: str) -> Any:
+        cls = self.spec.args[arg]
+        member = getattr(cls, attr, None)
+        if isinstance(member, _InputAttribute):
+            row = self.states[arg].get(key)
+            if row is None:
+                raise KeyError(f"{arg}[{key!r}] has no row")
+            pos = self.spec.input_positions[arg][attr]
+            return row[pos]
+        if isinstance(member, _OutputAttribute):
+            slot = (arg, key, attr)
+            if slot in self.memo:
+                return self.memo[slot]
+            if slot in self.in_flight:
+                raise RecursionError(
+                    f"cyclic output attribute {arg}.{attr} at {key!r}"
+                )
+            self.in_flight.add(slot)
+            try:
+                out = member.fn(RowReference(self, arg, key))
+            finally:
+                self.in_flight.discard(slot)
+            self.memo[slot] = out
+            return out
+        if isinstance(member, _Method):
+            fn = member.fn
+            me = RowReference(self, arg, key)
+            return lambda *a, **kw: fn(me, *a, **kw)
+        raise AttributeError(f"{arg} has no attribute {attr!r}")
+
+
+class RowTransformer:
+    def __init__(self, name: str, args: dict[str, type]):
+        self.name = name
+        self.args = args
+        self.input_positions: dict[str, dict[str, int]] = {}
+        self.output_attrs: dict[str, list[_OutputAttribute]] = {}
+        for arg_name, cls in args.items():
+            inputs = [
+                n
+                for n, v in vars(cls).items()
+                if isinstance(v, _InputAttribute)
+            ]
+            self.input_positions[arg_name] = {n: i for i, n in enumerate(inputs)}
+            self.output_attrs[arg_name] = [
+                v
+                for v in vars(cls).values()
+                if isinstance(v, _OutputAttribute) and not v.internal
+            ]
+
+    @classmethod
+    def from_class(cls, transformer_cls: type) -> "RowTransformer":
+        return cls(transformer_cls.__name__, _class_args(transformer_cls))
+
+    def __call__(self, *tables: Table, **named: Table) -> Any:
+        matched = dict(zip(self.args, tables))
+        matched.update(named)
+        if set(matched) != set(self.args):
+            raise TypeError(
+                f"transformer {self.name} expects tables "
+                f"{sorted(self.args)}, got {sorted(matched)}"
+            )
+        # project each input table onto its declared input attributes so
+        # positions are stable
+        projected = {
+            arg: matched[arg].select(
+                **{
+                    n: matched[arg][n]
+                    for n in self.input_positions[arg]
+                }
+            )
+            for arg in self.args
+        }
+        spec = self
+        ordered_args = list(self.args)
+
+        class _Result:
+            pass
+
+        result = _Result()
+        for arg in self.args:
+            outputs = self.output_attrs[arg]
+            out_names = [o.name for o in outputs]
+
+            def make_compute(arg_name: str, outs: list[_OutputAttribute]):
+                def compute(states_list: list[dict]) -> dict:
+                    from pathway_tpu.engine.value import ERROR
+
+                    states = dict(zip(ordered_args, states_list))
+                    evaluator = _Evaluator(spec, states)
+                    out: dict[Pointer, tuple] = {}
+                    for key in states[arg_name]:
+                        # per-row isolation: one bad row (dangling pointer,
+                        # user exception) poisons its own outputs only
+                        # (reference fails per-row with Value::Error too)
+                        try:
+                            out[key] = tuple(
+                                evaluator.value(arg_name, key, o.name)
+                                for o in outs
+                            )
+                        except Exception:  # noqa: BLE001
+                            out[key] = (ERROR,) * len(outs)
+                    return out
+
+                return compute
+
+            out_table = Table(
+                TableSpec(
+                    "row_transformer",
+                    [projected[a] for a in ordered_args],
+                    {
+                        "compute": make_compute(arg, outputs),
+                        "arity": len(out_names),
+                    },
+                ),
+                out_names,
+                {n: dt.ANY for n in out_names},
+                universe=matched[arg]._universe,
+            )
+            setattr(result, arg, out_table)
+        return result
+
+
+def transformer(cls: type) -> RowTransformer:
+    """Decorator: ``@pw.transformer`` (reference row_transformer.py)."""
+    return RowTransformer.from_class(cls)

@@ -477,6 +477,21 @@ class GraphRunner:
                 spec.params["time_col"],
             )
 
+        if kind == "row_transformer":
+            sources = [self.build(t) for t in spec.inputs]
+            return scope.recompute_table(
+                sources, spec.params["compute"], spec.params["arity"]
+            )
+
+        if kind == "gradual_broadcast":
+            from pathway_tpu.engine.temporal import GradualBroadcastNode
+
+            base_node = self.build(spec.inputs[0])
+            # threshold table lowered to a 3-column (lower, value, upper)
+            # storage by Table._gradual_broadcast
+            thr_node = self.build(spec.inputs[1])
+            return GradualBroadcastNode(scope, base_node, thr_node)
+
         if kind == "session_assign":
             from pathway_tpu.engine.temporal import SessionAssignNode
 

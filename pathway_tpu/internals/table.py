@@ -603,6 +603,31 @@ class Table:
             dtypes,
         )
 
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column: Any,
+        value_column: Any,
+        upper_column: Any,
+    ) -> "Table":
+        """Attach ``apx_value`` moving between lower and upper per row as
+        the broadcast value advances (reference table.py:631 over
+        operators/gradual_broadcast.rs; used by louvain)."""
+        lower = resolve_this(lower_column, threshold_table)
+        value = resolve_this(value_column, threshold_table)
+        upper = resolve_this(upper_column, threshold_table)
+        triplet = threshold_table.select(
+            _pw_lower=lower, _pw_value=value, _pw_upper=upper
+        )
+        return self._derived(
+            TableSpec("gradual_broadcast", [self, triplet], {}),
+            {
+                **{n: self._dtypes[n] for n in self._column_names},
+                "apx_value": dt.ANY,
+            },
+            universe=self._universe,
+        )
+
     def sort(self, key: Any, instance: Any = None) -> "Table":
         key_expr = resolve_this(key, self)
         inst_expr = resolve_this(instance, self) if instance is not None else None

@@ -1,0 +1,276 @@
+"""Row transformers, gradual_broadcast, export/import
+(reference: test_transformers.py, complex_columns.rs, export.rs,
+gradual_broadcast.rs)."""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner
+
+
+def rows(t):
+    return sorted(GraphRunner().capture(t)[0].values())
+
+
+class TestRowTransformer:
+    def test_simple_transformer_reference_doctest(self):
+        @pw.transformer
+        class foo_transformer:
+            class table(pw.ClassArg):
+                arg = pw.input_attribute()
+
+                @pw.output_attribute
+                def ret(self) -> int:
+                    return self.arg + 1
+
+        table = pw.debug.table_from_rows(
+            pw.schema_from_types(arg=int), [(1,), (2,), (3,)]
+        )
+        ret = foo_transformer(table).table
+        assert rows(ret) == [(2,), (3,), (4,)]
+        # output keyed by the input row ids
+        (snap,) = GraphRunner().capture(foo_transformer(table).table)
+        (base,) = GraphRunner().capture(table)
+        assert set(snap.keys()) == set(base.keys())
+
+    def test_cross_table_pointer_access(self):
+        """reference test_transformers.py:677: read another table via
+        self.transformer.<table>[pointer].<attr>."""
+
+        @pw.transformer
+        class enrich:
+            class params(pw.ClassArg):
+                a = pw.input_attribute()
+
+            class queries(pw.ClassArg):
+                a_ref = pw.input_attribute()
+
+                @pw.output_attribute
+                def doubled(self) -> int:
+                    return self.transformer.params[self.a_ref].a * 2
+
+        params = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int), [(10,), (20,)]
+        )
+        (psnap,) = GraphRunner().capture(params)
+        keys = sorted(psnap.keys(), key=lambda k: psnap[k])
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(a_ref=pw.Pointer), [(keys[0],), (keys[1],)]
+        )
+        out = enrich(params, queries).queries
+        assert rows(out) == [(20,), (40,)]
+
+    def test_recursive_linked_list(self):
+        """reference test_transformers.py:127: recursion through output
+        attributes of other rows (list length via next pointers)."""
+
+        @pw.transformer
+        class list_len:
+            class nodes(pw.ClassArg):
+                next = pw.input_attribute()
+
+                @pw.output_attribute
+                def length(self) -> int:
+                    if self.next is None:
+                        return 1
+                    return self.transformer.nodes[self.next].length + 1
+
+        base = pw.debug.table_from_rows(
+            pw.schema_from_types(tag=str), [("n0",), ("n1",), ("n2",)]
+        )
+        (bsnap,) = GraphRunner().capture(base)
+        ordered = sorted(bsnap, key=lambda k: bsnap[k])
+        nodes = pw.debug.table_from_rows(
+            pw.schema_from_types(next=pw.Pointer),
+            [(ordered[1],), (ordered[2],), (None,)],
+        )
+        out = list_len(nodes).nodes
+        assert sorted(rows(out)) == [(1,), (2,), (3,)]
+
+    def test_methods(self):
+        @pw.transformer
+        class calc:
+            class t(pw.ClassArg):
+                v = pw.input_attribute()
+
+                @pw.method
+                def add(self, x) -> int:
+                    return self.v + x
+
+                @pw.output_attribute
+                def plus_ten(self) -> int:
+                    return self.add(10)
+
+        t = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(5,)])
+        assert rows(calc(t).t) == [(15,)]
+
+
+class TestGradualBroadcast:
+    def test_apx_value_splits_key_space(self):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str), [(f"r{i}",) for i in range(30)]
+        )
+        thr = pw.debug.table_from_rows(
+            pw.schema_from_types(lo=float, v=float, hi=float),
+            [(0.0, 0.5, 1.0)],
+        )
+        out = t._gradual_broadcast(thr, thr.lo, thr.v, thr.hi)
+        (snap,) = GraphRunner().capture(out)
+        vals = [r[-1] for r in snap.values()]
+        assert set(vals) <= {0.0, 1.0}
+        assert 0 < vals.count(1.0) < 30
+
+    def test_monotone_in_value(self):
+        """A higher broadcast value flips strictly more rows to upper."""
+
+        def count_upper(v):
+            t = pw.debug.table_from_rows(
+                pw.schema_from_types(name=str), [(f"r{i}",) for i in range(40)]
+            )
+            thr = pw.debug.table_from_rows(
+                pw.schema_from_types(lo=float, v=float, hi=float),
+                [(0.0, v, 1.0)],
+            )
+            out = t._gradual_broadcast(thr, thr.lo, thr.v, thr.hi)
+            (snap,) = GraphRunner().capture(out)
+            return sum(1 for r in snap.values() if r[-1] == 1.0)
+
+        counts = [count_upper(v) for v in (0.1, 0.5, 0.9)]
+        assert counts[0] <= counts[1] <= counts[2]
+        assert counts[0] < counts[2]
+
+    def test_gradual_update_emits_only_crossers(self):
+        from pathway_tpu.engine.graph import Scheduler, Scope
+        from pathway_tpu.engine.temporal import GradualBroadcastNode
+        from pathway_tpu.engine.value import ref_scalar
+
+        scope = Scope()
+        main = scope.input_session(1)
+        thr = scope.input_session(3)
+        node = GradualBroadcastNode(scope, main, thr)
+        sched = Scheduler(scope)
+        for i in range(50):
+            main.insert(ref_scalar(i), (i,))
+        thr.insert(ref_scalar("t"), (0.0, 0.2, 1.0))
+        sched.commit()
+        before = dict(node.current)
+        thr.insert(ref_scalar("t2"), (0.0, 0.4, 1.0))
+        sched.commit()
+        after = dict(node.current)
+        flipped = [k for k in before if before[k] != after[k]]
+        unchanged = [k for k in before if before[k] == after[k]]
+        assert flipped and unchanged  # only cutoff-crossers changed
+
+
+class TestExportImport:
+    def test_cross_graph_exchange(self):
+        # producer graph
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(word=str, n=int), [("a", 1), ("b", 2)]
+        )
+        counts = t.select(word=t.word, n2=t.n * 10)
+        exported = pw.export_table(counts)
+        pw.run()
+        assert len(exported.snapshot()) == 2
+        assert exported.finished
+
+        # consumer graph: a separate runner continues from the export
+        imported = pw.import_table(exported)
+        total = imported.reduce(s=pw.reducers.sum(imported.n2))
+        (snap,) = GraphRunner().capture(total)
+        assert list(snap.values()) == [(30,)]
+
+    def test_import_preserves_keys_and_columns(self):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(x=int), [(7,), (8,)]
+        )
+        exported = pw.export_table(t)
+        pw.run()
+        imported = pw.import_table(exported)
+        assert imported.column_names() == ["x"]
+        (snap,) = GraphRunner().capture(imported)
+        assert set(snap.keys()) == set(exported.snapshot().keys())
+
+
+class TestReviewRegressions:
+    def test_gradual_broadcast_no_double_retract(self):
+        """In-place source update + triplet change in one commit must emit
+        clean ±1 diffs (review regression)."""
+        from pathway_tpu.engine.graph import Scheduler, Scope
+        from pathway_tpu.engine.temporal import GradualBroadcastNode
+        from pathway_tpu.engine.value import ref_scalar
+
+        scope = Scope()
+        main = scope.input_session(1)
+        thr = scope.input_session(3)
+        node = GradualBroadcastNode(scope, main, thr)
+        seen = []
+        scope.subscribe_table(
+            node, on_change=lambda key, values, time, diff: seen.append(diff)
+        )
+        sched = Scheduler(scope)
+        for i in range(10):
+            main.insert(ref_scalar(i), (i,))
+        thr.insert(ref_scalar("t"), (0.0, 0.2, 1.0))
+        sched.commit()
+        seen.clear()
+        # same commit: update one row in place AND move the threshold
+        main.remove(ref_scalar(3), (3,))
+        main.insert(ref_scalar(3), (33,))
+        thr.insert(ref_scalar("t2"), (0.0, 0.9, 1.0))
+        sched.commit()
+        assert all(d in (-1, 1) for d in seen), seen
+        # node state stays one row per key
+        assert len(node.current) == 10
+
+    def test_import_table_survives_two_builds(self):
+        t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,), (2,)])
+        exported = pw.export_table(t)
+        pw.run()
+        imported = pw.import_table(exported)
+        (a,) = GraphRunner().capture(imported)
+        (b,) = GraphRunner().capture(imported)
+        assert len(a) == 2 and len(b) == 2
+
+    def test_internal_attribute_not_in_output(self):
+        @pw.transformer
+        class calc:
+            class t(pw.ClassArg):
+                v = pw.input_attribute()
+
+                @pw.attribute
+                def helper(self) -> int:
+                    return self.v * 10
+
+                @pw.output_attribute
+                def final(self) -> int:
+                    return self.helper + 1
+
+        t = pw.debug.table_from_rows(pw.schema_from_types(v=int), [(4,)])
+        out = calc(t).t
+        assert out.column_names() == ["final"]
+        assert rows(out) == [(41,)]
+
+    def test_bad_row_poisons_only_itself(self):
+        from pathway_tpu.engine.value import is_error
+
+        @pw.transformer
+        class follow:
+            class t(pw.ClassArg):
+                ptr = pw.input_attribute()
+
+                @pw.output_attribute
+                def val(self) -> int:
+                    if self.ptr is None:
+                        return 7
+                    return self.transformer.t[self.ptr].val
+
+        from pathway_tpu.engine.value import ref_scalar
+
+        dangling = ref_scalar("nowhere")
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(ptr=pw.Pointer), [(None,), (dangling,)]
+        )
+        (snap,) = GraphRunner().capture(follow(t).t)
+        vals = sorted(snap.values(), key=repr)
+        ok = [v for (v,) in vals if not is_error(v)]
+        bad = [v for (v,) in vals if is_error(v)]
+        assert ok == [7] and len(bad) == 1
