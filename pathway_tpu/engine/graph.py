@@ -1559,6 +1559,8 @@ class RecomputeNode(Node):
     (reference complex_columns.rs — demand-driven there, local recompute
     here, same results)."""
 
+    STATE_ATTRS = ("_input_states",)
+
     def __init__(
         self,
         scope: "Scope",
@@ -1568,12 +1570,18 @@ class RecomputeNode(Node):
     ) -> None:
         super().__init__(scope, list(sources), arity)
         self.compute = compute
+        # own mirror of each input built from received batches — under
+        # sharded execution the local replicas' `current` only holds one
+        # shard, while this node (pinned to worker 0) sees every batch
+        self._input_states: list[dict[Pointer, tuple]] = [
+            {} for _ in sources
+        ]
 
     def process(self, time: int) -> DeltaBatch:
         for port in range(len(self.inputs)):
-            self.take(port)
+            apply_batch_to_state(self._input_states[port], self.take(port))
         try:
-            new = self.compute([inp.current for inp in self.inputs])
+            new = self.compute(self._input_states)
         except Exception as e:  # noqa: BLE001
             self.report(None, f"row transformer error: {e!r}")
             return DeltaBatch()
@@ -1593,28 +1601,50 @@ class ExportedTable:
     ``import_table`` in another graph."""
 
     def __init__(self, arity: int) -> None:
+        import threading
+
         self.arity = arity
         self.current: dict[Pointer, tuple] = {}
         self._callbacks: list = []
         self.finished = False
+        self._lock = threading.Lock()
 
     # producer side --------------------------------------------------------
     def _on_change(self, key: Pointer, row: tuple, time: int, diff: int) -> None:
-        if diff > 0:
-            self.current[key] = row
-        else:
-            self.current.pop(key, None)
-        for cb in self._callbacks:
+        with self._lock:
+            if diff > 0:
+                self.current[key] = row
+            else:
+                self.current.pop(key, None)
+            callbacks = list(self._callbacks)
+        for cb in callbacks:
             cb(key, row, time, diff)
 
     def _on_end(self) -> None:
-        self.finished = True
-        for cb in self._callbacks:
+        with self._lock:
+            self.finished = True
+            callbacks = list(self._callbacks)
+        for cb in callbacks:
             cb(None, None, None, 0)
 
     # consumer side --------------------------------------------------------
     def snapshot(self) -> dict[Pointer, tuple]:
-        return dict(self.current)
+        with self._lock:
+            return dict(self.current)
 
     def subscribe(self, callback) -> None:
-        self._callbacks.append(callback)
+        with self._lock:
+            self._callbacks.append(callback)
+
+    def subscribe_with_snapshot(self, callback) -> tuple[dict, bool]:
+        """Atomically: register the callback and return (snapshot,
+        finished). No update committed after the snapshot can be missed,
+        and none in the snapshot is re-delivered."""
+        with self._lock:
+            self._callbacks.append(callback)
+            return dict(self.current), self.finished
+
+    def unsubscribe(self, callback) -> None:
+        with self._lock:
+            if callback in self._callbacks:
+                self._callbacks.remove(callback)

@@ -107,6 +107,8 @@ def partitioner(
             return _shard_of(key, n_workers)
 
         return by_row_key
+    from pathway_tpu.engine.graph import RecomputeNode
+
     if isinstance(
         consumer,
         (
@@ -114,6 +116,8 @@ def partitioner(
             ErrorLogNode,
             ExternalIndexNode,
             IterateNode,
+            RecomputeNode,  # row transformers consume whole input states
+            _temporal.GradualBroadcastNode,  # needs the threshold triplet
             _temporal.BufferNode,
             _temporal.ForgetNode,
             _temporal.FreezeNode,

@@ -617,7 +617,6 @@ class GradualBroadcastNode(Node):
         return upper if self._fraction(key) <= cutoff else lower
 
     def process(self, time: int) -> DeltaBatch:
-        source = self.inputs[0]
         src_batch = self.take(0)
         thr_batch = self.take(1)
         out = DeltaBatch()
@@ -645,9 +644,9 @@ class GradualBroadcastNode(Node):
                 new_apx = self._apx(key)
                 if cur[-1] != new_apx:
                     retract(key)
-                    src_row = source.current.get(key)
-                    if src_row is not None:
-                        out.append(key, src_row + (new_apx,), 1)
+                    # own stored row (minus apx) — the input replica's
+                    # current may hold only one shard under multi-worker
+                    out.append(key, cur[:-1] + (new_apx,), 1)
         for key, row, diff in src_batch:
             retract(key)
             if diff > 0:

@@ -49,23 +49,27 @@ def import_table(exported: ExportedTable) -> Table:
     schema = schema_mod.schema_from_types(**{n: Any for n in names})
 
     def make_reader():
-        # fresh reader per graph build: snapshot first, then live updates;
-        # a shared reader would be drained by whichever build ran first
+        # fresh reader per graph build (a shared one would be drained by
+        # whichever build ran first)
         reader = QueueReader()
-        for key, row in exported.snapshot().items():
-            reader.push((INSERT, key, row), source_id="import")
 
         def on_update(key, row, time, diff):
             if key is None:  # producer finished
                 reader.close()
+                exported.unsubscribe(on_update)  # no leak across builds
                 return
             reader.push(
                 (INSERT if diff > 0 else DELETE, key, row), source_id="import"
             )
 
-        exported.subscribe(on_update)
-        if exported.finished:
+        # atomic subscribe+snapshot: updates committed after the snapshot
+        # arrive via the callback, none are lost or duplicated
+        snapshot, finished = exported.subscribe_with_snapshot(on_update)
+        for key, row in snapshot.items():
+            reader.push((INSERT, key, row), source_id="import")
+        if finished:
             reader.close()
+            exported.unsubscribe(on_update)
         return reader
 
     return input_table(

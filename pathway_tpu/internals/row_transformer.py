@@ -205,6 +205,11 @@ class RowTransformer:
         return cls(transformer_cls.__name__, _class_args(transformer_cls))
 
     def __call__(self, *tables: Table, **named: Table) -> Any:
+        if len(tables) > len(self.args):
+            raise TypeError(
+                f"transformer {self.name} takes {len(self.args)} table(s), "
+                f"got {len(tables)}"
+            )
         matched = dict(zip(self.args, tables))
         matched.update(named)
         if set(matched) != set(self.args):

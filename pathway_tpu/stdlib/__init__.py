@@ -4,6 +4,26 @@ Mirrors the capability surface of the reference's ``pathway.stdlib``
 (reference: python/pathway/stdlib/) with TPU-native internals.
 """
 
-from pathway_tpu.stdlib import graphs, indexing, temporal  # noqa: F401
+from pathway_tpu.stdlib import (  # noqa: F401
+    graphs,
+    indexing,
+    ml,
+    ordered,
+    stateful,
+    statistical,
+    temporal,
+    utils,
+    viz,
+)
 
-__all__ = ["graphs", "indexing", "temporal"]
+__all__ = [
+    "graphs",
+    "indexing",
+    "ml",
+    "ordered",
+    "stateful",
+    "statistical",
+    "temporal",
+    "utils",
+    "viz",
+]

