@@ -306,6 +306,16 @@ class InputDriver:
         self.entries_total = 0
         self.batches_total = 0
         self.last_entry_wall: float | None = None
+        # synchronization group pacing (io/_synchronization.py): events
+        # whose sync column runs ahead of the group wait here in order
+        self.sync_group: Any = None
+        self.sync_col: int | None = None
+        # (kind, key, values, track, source_id) held back by the group;
+        # deque: drains are O(1) per released event
+        import collections as _collections
+
+        self._sync_backlog: Any = _collections.deque()
+        self._done_pending = False
 
     def _key_for(self, values: tuple, source_id: str, index: int) -> Pointer:
         if self.pk is not None:
@@ -315,15 +325,66 @@ class InputDriver:
             (self.source_name, source_id, index, self._seq), salt=b"connector"
         )
 
+    def _feed(self, kind: str, key: Pointer, values: tuple | None, track: list | None) -> None:
+        if kind == UPSERT:
+            # upsert session: insert overlays, None deletes by key
+            if values is None:
+                self.session.remove(key)
+            else:
+                self.session.insert(key, values)
+        elif kind == INSERT:
+            self.session.insert(key, values)
+            if track is not None:
+                track.append((key, values))
+        else:
+            self.session.remove(key, values)
+
+    def _sync_admit(self, values: tuple | None) -> bool:
+        """Synchronization-group gate: once anything is backlogged, later
+        events queue behind it to preserve order. Events without a usable
+        sync time (None) are not paced."""
+        if self.sync_group is None:
+            return True
+        if self._sync_backlog:
+            return False
+        if values is None or values[self.sync_col] is None:
+            return True
+        return self.sync_group.admit(self, values[self.sync_col])
+
+    def _drain_backlog(self) -> bool:
+        produced = False
+        while self._sync_backlog:
+            kind, key, values, track, _src = self._sync_backlog[0]
+            t = values[self.sync_col] if values is not None else None
+            if t is not None and not self.sync_group.admit(self, t):
+                break
+            self._sync_backlog.popleft()
+            self._feed(kind, key, values, track)
+            produced = True
+        self._note_pending()
+        return produced
+
+    def _note_pending(self) -> None:
+        if self.sync_group is None:
+            return
+        head_t = None
+        if self._sync_backlog:
+            head_values = self._sync_backlog[0][2]
+            if head_values is not None:
+                head_t = head_values[self.sync_col]
+        self.sync_group.note_pending(self, head_t)
+
     def poll(self) -> str:
         if self.done:
             return "done"
-        entries, done = self.reader.poll()
+        produced = False
+        if self._sync_backlog:
+            produced = self._drain_backlog()
+        entries, done = ([], self._done_pending) if self._done_pending else self.reader.poll()
         if entries:
             self.entries_total += len(entries)
             self.batches_total += 1
             self.last_entry_wall = _time.monotonic()
-        produced = False
         replaces = self.reader.replaces_sources
         notify_source = getattr(self.session, "on_source", None)
         for payload, source_id, metadata in entries:
@@ -335,6 +396,12 @@ class InputDriver:
                 for key, row in old_rows:
                     self.session.remove(key, row)
                 produced = True
+            if replaces and self._sync_backlog:
+                # held-back events of the replaced source version must not
+                # surface later: they were superseded before emission
+                self._sync_backlog = type(self._sync_backlog)(
+                    e for e in self._sync_backlog if e[4] != source_id
+                )
             if metadata.get("deleted"):
                 continue
             if hasattr(self.parser, "reset"):
@@ -356,22 +423,27 @@ class InputDriver:
                     raise ValueError(
                         "connector event without values needs an explicit key"
                     )
-                if event.kind == UPSERT:
-                    # upsert session: insert overlays, None deletes by key
-                    if values is None:
-                        self.session.remove(key)
-                    else:
-                        self.session.insert(key, values)
-                elif event.kind == INSERT:
-                    self.session.insert(key, values)
-                    new_rows.append((key, values))
+                track = new_rows if (event.kind == INSERT and replaces) else None
+                if self._sync_admit(values):
+                    self._feed(event.kind, key, values, track)
+                    produced = True
                 else:
-                    self.session.remove(key, values)
-                produced = True
-            if new_rows and replaces:
+                    self._sync_backlog.append(
+                        (event.kind, key, values, track, source_id)
+                    )
+            if replaces and events:
+                # backlogged inserts append into this same list when released
                 self._per_source_rows[source_id] = new_rows
+        self._note_pending()
         if done:
+            if self._sync_backlog:
+                # the group still holds events back; report idle until the
+                # other sources release them
+                self._done_pending = True
+                return "data" if produced else "idle"
             self.done = True
+            if self.sync_group is not None:
+                self.sync_group.mark_done(self)
             return "done"
         return "data" if produced else "idle"
 

@@ -257,6 +257,14 @@ class GraphRunner:
             if driver is not None and not self.attach_drivers:
                 driver = None  # replica scopes never poll; worker 0 reads
             if driver is not None:
+                sync_group = spec.params.get("sync_group")
+                if sync_group is not None:
+                    sync_group.ensure_run(id(self))
+                    driver.sync_group = sync_group
+                    driver.sync_col = table._column_names.index(
+                        spec.params["sync_column"]
+                    )
+                    sync_group.register(driver)
                 persistent_id = spec.params.get("persistent_id")
                 if persistent_id is not None and self.persistence is not None:
                     from pathway_tpu.engine.persistence import PersistentDriver
@@ -991,6 +999,9 @@ class ShardedGraphRunner:
     def __init__(self, n_workers: int, persistence_config: Any = None) -> None:
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
+        from pathway_tpu.internals.license import check_worker_count
+
+        check_worker_count(n_workers)
         from pathway_tpu.persistence import PersistenceMode
 
         if (

@@ -39,6 +39,7 @@ from pathway_tpu.io import (
     sqlite,
 )
 from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io._synchronization import register_input_synchronization_group
 
 __all__ = [
     "airbyte",
@@ -69,4 +70,5 @@ __all__ = [
     "slack",
     "sqlite",
     "subscribe",
+    "register_input_synchronization_group",
 ]

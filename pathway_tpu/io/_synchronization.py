@@ -1,0 +1,111 @@
+"""Input synchronization groups: sources advance together.
+
+Reference: connector synchronization groups (SURVEY §2.2 —
+``connector_group`` registration in src/connectors/mod.rs +
+ConnectorGroupDescriptor in python_api.rs): sources registered in one
+group hold back rows whose designated time column runs more than
+``max_difference`` ahead of the slowest source, so joins over multiple
+live streams see aligned time ranges instead of whichever source happens
+to read faster.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnReference
+
+
+class InputSynchronizationGroup:
+    """Shared pacing state for a set of input drivers."""
+
+    def __init__(self, max_difference: Any) -> None:
+        self.max_difference = max_difference
+        self._members: list = []
+        self._done: set[int] = set()
+        self._owner: int | None = None  # current run's GraphRunner id
+        #: driver -> time of its next *held* event (None = no backlog)
+        self.pending_head: dict[int, Any] = {}
+        #: driver -> max admitted time
+        self.admitted: dict[int, Any] = {}
+
+    def ensure_run(self, owner: int) -> None:
+        """Membership is per run: a rebuild (retry after a failed run,
+        repeated capture) starts from a clean slate instead of being
+        blocked by stale drivers."""
+        if self._owner != owner:
+            self._owner = owner
+            self._members = []
+            self._done = set()
+            self.pending_head = {}
+            self.admitted = {}
+
+    def register(self, driver: Any) -> None:
+        self._members.append(driver)
+        self.pending_head[id(driver)] = None
+        self.admitted[id(driver)] = None
+
+    def _frontier(self, member: Any) -> Any:
+        """A member's frontier: its next waiting event, else its last
+        admitted time (a source with no backlog doesn't hold others back
+        once it has caught up)."""
+        head = self.pending_head[id(member)]
+        if head is not None:
+            return head
+        return self.admitted[id(member)]
+
+    def mark_done(self, driver: Any) -> None:
+        """A finished source stops capping the others."""
+        self._done.add(id(driver))
+
+    def admit(self, driver: Any, t: Any) -> bool:
+        """May ``driver`` emit an event at time ``t`` now? Allowed while
+        ``t <= min(other frontiers) + max_difference``; a member that has
+        produced nothing yet blocks everyone (all sources start aligned)."""
+        for member in self._members:
+            if member is driver or id(member) in self._done:
+                continue
+            frontier = self._frontier(member)
+            if frontier is None:
+                return False  # member hasn't produced anything yet
+            try:
+                if t > frontier + self.max_difference:
+                    return False
+            except TypeError:
+                # non-comparable mix: fail OPEN — denying forever would
+                # deadlock the run on a single malformed row
+                continue
+        prev = self.admitted[id(driver)]
+        try:
+            newer = prev is None or t > prev
+        except TypeError:
+            newer = True
+        if newer:
+            self.admitted[id(driver)] = t
+        return True
+
+    def note_pending(self, driver: Any, t: Any | None) -> None:
+        self.pending_head[id(driver)] = t
+
+
+def register_input_synchronization_group(
+    *columns: ColumnReference, max_difference: Any
+) -> InputSynchronizationGroup:
+    """Each column designates (input table, time column); the tables'
+    connectors then advance in lockstep within ``max_difference``."""
+    if len(columns) < 2:
+        raise ValueError("a synchronization group needs at least two sources")
+    group = InputSynchronizationGroup(max_difference)
+    for ref in columns:
+        if not isinstance(ref, ColumnReference):
+            raise TypeError("pass column references (table.time_column)")
+        table = ref.table
+        spec = table._spec
+        if spec.kind != "input":
+            raise ValueError(
+                f"synchronization groups apply to connector input tables; "
+                f"{table._name} is {spec.kind!r}"
+            )
+        spec.params["sync_group"] = group
+        spec.params["sync_column"] = ref.name
+    return group

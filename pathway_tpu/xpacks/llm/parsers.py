@@ -46,6 +46,113 @@ def _gated(name: str, dep: str) -> type:
 
 ParseUnstructured = _gated("ParseUnstructured", "unstructured")
 OpenParse = _gated("OpenParse", "openparse")
-ImageParser = _gated("ImageParser", "openai-vision")
-SlideParser = _gated("SlideParser", "openai-vision")
-PypdfParser = _gated("PypdfParser", "pypdf")
+
+
+class PypdfParser(UDF):
+    """PDF bytes -> ((page_text, metadata),) (reference PypdfParser
+    parsers.py:746). Uses the native extractor in ``_pdf.py`` — covers
+    machine-generated PDFs with Flate text streams; scanned decks need the
+    vision path."""
+
+    def __init__(self, apply_text_cleanup: bool = True) -> None:
+        from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
+
+        def parse(contents: Any) -> tuple:
+            data = (
+                contents
+                if isinstance(contents, bytes)
+                else str(contents).encode("latin-1", errors="replace")
+            )
+            text = extract_pdf_text(data)
+            if apply_text_cleanup:
+                text = "\n".join(
+                    line.strip() for line in text.splitlines() if line.strip()
+                )
+            return ((text, {"format": "pdf"}),)
+
+        super().__init__(parse, executor=SyncExecutor(), deterministic=True)
+
+
+class ImageParser(UDF):
+    """Image bytes -> ((description, metadata),) (reference ImageParser
+    parsers.py:396: a vision LLM schema-parses the image).
+
+    ``llm``: callable(image: PIL.Image, prompt: str) -> str — the vision
+    model seam (remote vision chat in a deployment, a mock offline).
+    Without it the parser still emits deterministic image metadata text so
+    pipelines run end-to-end."""
+
+    def __init__(
+        self,
+        llm: Any = None,
+        parse_prompt: str = "Describe the image contents.",
+        downsize_horizontal_width: int | None = None,
+    ) -> None:
+        import io as _io
+
+        from PIL import Image
+
+        def parse(contents: Any) -> tuple:
+            img = Image.open(_io.BytesIO(contents))
+            if (
+                downsize_horizontal_width
+                and img.width > downsize_horizontal_width
+            ):
+                ratio = downsize_horizontal_width / img.width
+                img = img.resize(
+                    (downsize_horizontal_width, max(1, int(img.height * ratio)))
+                )
+            meta = {
+                "format": (img.format or "").lower(),
+                "width": img.width,
+                "height": img.height,
+                "mode": img.mode,
+            }
+            if llm is not None:
+                text = str(llm(img, parse_prompt))
+            else:
+                text = (
+                    f"image {meta['format']} {img.width}x{img.height} "
+                    f"{img.mode}"
+                )
+            return ((text, meta),)
+
+        super().__init__(
+            parse, executor=SyncExecutor(), deterministic=llm is None
+        )
+
+
+class SlideParser(UDF):
+    """Slide-deck images -> one (text, metadata) part per frame (reference
+    SlideParser parsers.py:569 — OCR+vision over decks). Multi-frame
+    images (TIFF/GIF) yield one part per page; the vision seam matches
+    ImageParser."""
+
+    def __init__(self, llm: Any = None, parse_prompt: str = "Describe the slide.") -> None:
+        import io as _io
+
+        from PIL import Image, ImageSequence
+
+        def parse(contents: Any) -> tuple:
+            img = Image.open(_io.BytesIO(contents))
+            parts = []
+            for page, frame in enumerate(ImageSequence.Iterator(img)):
+                meta = {
+                    "format": (img.format or "").lower(),
+                    "page": page,
+                    "width": frame.width,
+                    "height": frame.height,
+                }
+                if llm is not None:
+                    text = str(llm(frame.copy(), parse_prompt))
+                else:
+                    text = (
+                        f"slide {page}: {meta['format']} "
+                        f"{frame.width}x{frame.height}"
+                    )
+                parts.append((text, meta))
+            return tuple(parts)
+
+        super().__init__(
+            parse, executor=SyncExecutor(), deterministic=llm is None
+        )

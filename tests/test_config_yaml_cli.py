@@ -152,3 +152,104 @@ class TestCli:
         )
         assert res.returncode == 0
         assert "hi" in res.stdout
+
+
+class TestParsers:
+    def _tiny_pdf(self, text):
+        import zlib
+
+        content = f"BT /F1 12 Tf 72 700 Td ({text}) Tj ET".encode()
+        compressed = zlib.compress(content)
+        return (
+            b"%PDF-1.4\n"
+            b"1 0 obj\n<< /Length " + str(len(compressed)).encode()
+            + b" /Filter /FlateDecode >>\nstream\n"
+            + compressed
+            + b"\nendstream\nendobj\n%%EOF"
+        )
+
+    def test_pypdf_parser_extracts_text(self):
+        from pathway_tpu.xpacks.llm.parsers import PypdfParser
+
+        parser = PypdfParser()
+        ((text, meta),) = parser._fn(self._tiny_pdf("Hello pathway PDF"))
+        assert text == "Hello pathway PDF"
+        assert meta["format"] == "pdf"
+
+    def test_pdf_tj_array_and_escapes(self):
+        from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
+
+        content = rb"BT [(Hel) -30 (lo)] TJ T* (wor\(ld\)) Tj ET"
+        pdf = (
+            b"%PDF-1.4\n1 0 obj\n<< /Length "
+            + str(len(content)).encode()
+            + b" >>\nstream\n"
+            + content
+            + b"\nendstream\nendobj"
+        )
+        assert extract_pdf_text(pdf) == "Hello\nwor(ld)"
+
+    def test_image_parser_with_vision_seam(self):
+        import io
+
+        from PIL import Image
+
+        from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+        buf = io.BytesIO()
+        Image.new("RGB", (64, 32), "red").save(buf, format="PNG")
+        parser = ImageParser(llm=lambda img, prompt: f"a {img.width}px thing")
+        ((text, meta),) = parser._fn(buf.getvalue())
+        assert text == "a 64px thing"
+        assert meta["width"] == 64 and meta["format"] == "png"
+
+    def test_slide_parser_multiframe(self):
+        import io
+
+        from PIL import Image
+
+        from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+        frames = [
+            Image.new("RGB", (20, 20), c) for c in ("red", "green", "blue")
+        ]
+        buf = io.BytesIO()
+        frames[0].save(
+            buf,
+            format="GIF",
+            save_all=True,
+            append_images=frames[1:],
+            optimize=False,
+        )
+        parser = SlideParser()
+        parts = parser._fn(buf.getvalue())
+        assert len(parts) == 3
+        assert [m["page"] for _t, m in parts] == [0, 1, 2]
+
+
+class TestLicense:
+    def test_free_tier_caps_workers(self):
+        from pathway_tpu.internals.license import LicenseError
+        from pathway_tpu.internals.runner import ShardedGraphRunner
+
+        with pytest.raises(LicenseError, match="free tier"):
+            ShardedGraphRunner(9)
+        ShardedGraphRunner(8)  # at the cap: fine
+
+    def test_entitlement_unlocks(self, monkeypatch):
+        monkeypatch.setenv(
+            "PATHWAY_LICENSE_KEY", "pathway-tpu:unlimited-workers"
+        )
+        from pathway_tpu.internals.runner import ShardedGraphRunner
+
+        ShardedGraphRunner(9)
+
+    def test_check_entitlements(self, monkeypatch):
+        from pathway_tpu.internals import license as lic
+
+        with pytest.raises(lic.LicenseError, match="does not grant"):
+            lic.check_entitlements("xpack-sharepoint")
+        monkeypatch.setenv(
+            "PATHWAY_LICENSE_KEY", "pathway-tpu:xpack-sharepoint"
+        )
+        lic.check_entitlements("xpack-sharepoint")

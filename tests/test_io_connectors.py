@@ -538,3 +538,109 @@ class TestReviewRegressions2:
         # same body re-polled: no duplicates; new body: replaces old rows
         assert sorted(v[0] for v in node.current.values()) == [7]
         G.clear()
+
+
+class TestSynchronizationGroups:
+    def test_sources_advance_together(self):
+        """Two python sources with skewed time columns: the fast one's rows
+        wait until the slow one catches up within max_difference (reference
+        connector synchronization groups, SURVEY §2.2)."""
+        import pathway_tpu.io.python as pwio_python
+
+        class Fast(pwio_python.ConnectorSubject):
+            def run(self):
+                for t in (0, 1, 2, 50, 51):
+                    self.next(t=t, src="fast")
+
+        class Slow(pwio_python.ConnectorSubject):
+            def run(self):
+                for t in (0, 10, 48):
+                    self.next(t=t, src="slow")
+
+        class S(pw.Schema):
+            t: int
+            src: str
+
+        fast = pwio_python.read(Fast(), schema=S)
+        slow = pwio_python.read(Slow(), schema=S)
+        pw.io.register_input_synchronization_group(
+            fast.t, slow.t, max_difference=10
+        )
+        arrivals = []
+        both = fast.concat_reindex(slow)
+        pw.io.subscribe(
+            both,
+            on_change=lambda key, row, time, is_addition: arrivals.append(
+                (time, row["t"], row["src"])
+            ),
+        )
+        pw.run()
+        # all rows eventually arrive
+        assert sorted((t, s) for _c, t, s in arrivals) == sorted(
+            [(0, "fast"), (1, "fast"), (2, "fast"), (50, "fast"), (51, "fast"),
+             (0, "slow"), (10, "slow"), (48, "slow")]
+        )
+        # pacing: fast's t=50 row must not be admitted before slow's t=48
+        commit_of = {}
+        for commit, t, s in arrivals:
+            commit_of[(t, s)] = commit
+        assert commit_of[(50, "fast")] >= commit_of[(48, "slow")]
+
+    def test_deterministic_pacing_at_engine_level(self):
+        """Drive polls by hand: the fast source's far-future row is held
+        until the slow source reaches within max_difference."""
+        from pathway_tpu.engine.connectors import (
+            InputDriver,
+            JsonLinesParser,
+            QueueReader,
+        )
+        from pathway_tpu.engine.graph import Scheduler, Scope
+        from pathway_tpu.io._synchronization import InputSynchronizationGroup
+
+        scope = Scope()
+        group = InputSynchronizationGroup(max_difference=10)
+        drivers = []
+        readers = []
+        sessions = []
+        for _ in range(2):
+            session = scope.input_session(1)
+            reader = QueueReader()
+            driver = InputDriver(session, reader, JsonLinesParser(["t"]))
+            driver.sync_group = group
+            driver.sync_col = 0
+            group.register(driver)
+            drivers.append(driver)
+            readers.append(reader)
+            sessions.append(session)
+        fast, slow = drivers
+        sched = Scheduler(scope)
+
+        readers[0].push('{"t": 0}\n{"t": 50}')
+        readers[1].push('{"t": 0}')
+        # two poll rounds: round 1 establishes both frontiers (a source
+        # that has produced nothing blocks everyone), round 2 releases
+        # what the group admits
+        for _ in range(2):
+            for d in drivers:
+                d.poll()
+        sched.commit()
+        # fast's t=50 is held: slow's frontier is 0, 50 > 0 + 10
+        assert sorted(v[0] for v in sessions[0].current.values()) == [0]
+
+        readers[1].push('{"t": 45}')
+        for d in drivers:
+            d.poll()
+        for d in drivers:
+            d.poll()  # drain backlog after slow advanced
+        sched.commit()
+        assert sorted(v[0] for v in sessions[0].current.values()) == [0, 50]
+
+    def test_group_needs_two_sources(self):
+        t = pw.debug.table_from_rows(pw.schema_from_types(t=int), [(1,)])
+        with pytest.raises(ValueError):
+            pw.io.register_input_synchronization_group(
+                t.t, max_difference=5
+            )
+        from pathway_tpu.internals import parse_graph
+
+        parse_graph.G.clear()
