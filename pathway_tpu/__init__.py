@@ -39,6 +39,8 @@ from pathway_tpu.internals.schema import (
     Schema,
     column_definition,
     schema_builder,
+    assert_table_has_schema,
+    schema_from_csv,
     schema_from_dict,
     schema_from_types,
 )
@@ -52,6 +54,7 @@ from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import stdlib  # noqa: E402
 from pathway_tpu.internals.config import PathwayConfig, get_pathway_config, set_license_key  # noqa: E402
+from pathway_tpu.internals.errors import global_error_log, local_error_log  # noqa: E402
 from pathway_tpu.internals.export_import import export_table, import_table  # noqa: E402
 from pathway_tpu.internals.row_transformer import (  # noqa: E402
     ClassArg,
@@ -146,6 +149,10 @@ __all__ = [
     "set_license_key",
     "load_yaml",
     "export_table",
+    "global_error_log",
+    "local_error_log",
+    "schema_from_csv",
+    "assert_table_has_schema",
     "import_table",
     "ClassArg",
     "attribute",

@@ -1248,7 +1248,9 @@ class Scope:
 
     def report_error(self, node: Node, key: Pointer | None, message: str) -> None:
         trace = f" at {node.trace}" if node.trace else ""
-        self._error_log_stack[-1].log(f"{node.name}{trace}: {message}")
+        # nodes built inside `with pw.local_error_log()` carry their own log
+        log = getattr(node, "error_log", None) or self._error_log_stack[-1]
+        log.log(f"{node.name}{trace}: {message}")
 
     def error_log(self) -> ErrorLogNode:
         return ErrorLogNode(self)

@@ -63,6 +63,7 @@ class GraphRunner:
         self.drivers: list[Any] = []  # connector drivers (streaming mode)
         self.monitors: list[Any] = []
         self.monitor: Any = None  # StatsMonitor (internals/monitoring.py)
+        self._local_logs: dict[int, Node] = {}  # local error logs by id
         self.persistence = persistence_config
         if persistence_config is not None:
             self._wire_udf_cache(persistence_config)
@@ -230,10 +231,21 @@ class GraphRunner:
 
     # -- lowering -----------------------------------------------------------
 
+    def _error_log_node(self, log_id):
+        if log_id is None:
+            return self.scope.error_log_default
+        node = self._local_logs.get(log_id)
+        if node is None:
+            node = self._local_logs[log_id] = self.scope.error_log()
+        return node
+
     def build(self, table: "Table") -> Node:
         if table._id in self.nodes:
             return self.nodes[table._id]
         node = self._build(table)
+        log_id = getattr(table, "_error_log_id", None)
+        if log_id is not None:
+            node.error_log = self._error_log_node(log_id)
         node.name = f"{table._spec.kind}<{table._name}>"
         node.trace = table._trace
         self.nodes[table._id] = node
@@ -246,6 +258,9 @@ class GraphRunner:
         spec = table._spec
         kind = spec.kind
         scope = self.scope
+
+        if kind == "error_log":
+            return self._error_log_node(spec.params.get("log_id"))
 
         if kind == "static":
             return scope.static_table(spec.params["rows"], len(table._column_names))

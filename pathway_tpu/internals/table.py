@@ -66,6 +66,9 @@ class Table:
         self._id = next(_table_counter)
         self._name = name or f"table_{self._id}"
         self._trace = current_trace()
+        from pathway_tpu.internals import errors as _errors
+
+        self._error_log_id = _errors.current_log_id()
 
     # -- introspection ------------------------------------------------------
 
@@ -627,6 +630,40 @@ class Table:
             },
             universe=self._universe,
         )
+
+    def window_join(
+        self,
+        other: "Table",
+        self_time: Any,
+        other_time: Any,
+        window: Any,
+        *on: Any,
+        how: str = "inner",
+        **kwargs: Any,
+    ) -> Any:
+        """Reference Table.window_join (_window_join.py:156)."""
+        from pathway_tpu.stdlib.temporal import window_join as _wj
+
+        return _wj(
+            self, other, self_time, other_time, window, *on, how=how, **kwargs
+        )
+
+    @property
+    def slice(self) -> "Table":
+        """Reference Table.slice — a column-access view; our tables already
+        support ``t[...]`` slicing directly."""
+        return self
+
+    def having(self, *indexers: Any) -> "Table":
+        """Restrict to rows whose id appears among each indexer expression's
+        pointer values (reference Table.having, used with ix_ref)."""
+        out = self
+        for ix in indexers:
+            resolved = resolve_this(ix, self)
+            keys = resolved.table.select(_pw_p=resolved)
+            keys = keys.with_id(keys["_pw_p"])
+            out = out.intersect(keys)
+        return out
 
     def sort(self, key: Any, instance: Any = None) -> "Table":
         key_expr = resolve_this(key, self)

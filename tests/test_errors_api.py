@@ -1,0 +1,83 @@
+"""Error-log tables + schema helpers + Table.having
+(reference: test_errors.py error-log semantics, pw.assert_table_has_schema,
+schema_from_csv, Table.having)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.runner import GraphRunner
+
+
+class TestErrorLogs:
+    def test_global_error_log_collects_messages(self):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=int), [(1, 0), (4, 2)]
+        )
+        bad = t.select(q=t.a // t.b)
+        log = pw.global_error_log()
+        r = GraphRunner()
+        n_bad, n_log = r.build(bad), r.build(log)
+        r.run()
+        msgs = [row[0] for row in n_log.current.values()]
+        assert any("zero" in m.lower() for m in msgs)
+        # good row still flows; bad row poisoned
+        assert len(n_bad.current) == 2
+
+    def test_local_error_log_scopes_operators(self):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=int), [(1, 0)]
+        )
+        with pw.local_error_log() as local_log:
+            inside = t.select(q=t.a // t.b)
+        outside = t.select(q=pw.apply(lambda a: 1 // 0, t.a))
+        glog = pw.global_error_log()
+        r = GraphRunner()
+        nodes = [r.build(x) for x in (inside, outside, local_log, glog)]
+        r.run()
+        local_msgs = [row[0] for row in nodes[2].current.values()]
+        global_msgs = [row[0] for row in nodes[3].current.values()]
+        assert len(local_msgs) == 1 and len(global_msgs) == 1
+        assert "apply" in global_msgs[0]
+
+
+class TestSchemaHelpers:
+    def test_schema_from_csv_infers_types(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_text("name,age,score\nbob,3,1.5\nal,4,2\n")
+        S = pw.schema_from_csv(str(p))
+        hints = {n: d.typehint for n, d in S.dtypes().items()}
+        assert hints == {"name": str, "age": int, "score": float}
+
+    def test_assert_table_has_schema(self):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=str), [(1, "x")]
+        )
+        pw.assert_table_has_schema(t, pw.schema_from_types(a=int, b=str))
+        with pytest.raises(AssertionError, match="column sets differ"):
+            pw.assert_table_has_schema(t, pw.schema_from_types(a=int))
+        pw.assert_table_has_schema(
+            t, pw.schema_from_types(a=int), allow_superset=True
+        )
+        with pytest.raises(AssertionError, match="dtype"):
+            pw.assert_table_has_schema(t, pw.schema_from_types(a=str, b=str))
+
+
+class TestHaving:
+    def test_having_restricts_by_pointer_values(self):
+        base = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str), [("x",), ("y",), ("z",)]
+        )
+        refs = base.filter(base.name != "y").select(p=base.id)
+        (snap,) = GraphRunner().capture(base.having(refs.p))
+        assert sorted(v[0] for v in snap.values()) == ["x", "z"]
+
+    def test_window_join_method_on_table(self):
+        import pathway_tpu.stdlib.temporal as temporal
+
+        t1 = pw.debug.table_from_rows(pw.schema_from_types(t=int), [(1,), (7,)])
+        t2 = pw.debug.table_from_rows(pw.schema_from_types(t=int), [(2,), (6,)])
+        res = t1.window_join(t2, t1.t, t2.t, temporal.tumbling(2)).select(
+            lt=t1.t, rt=t2.t
+        )
+        (snap,) = GraphRunner().capture(res)
+        assert sorted(snap.values()) == [(7, 6)]

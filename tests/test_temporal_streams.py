@@ -7,7 +7,7 @@ import pathway_tpu.stdlib.temporal as temporal
 from pathway_tpu.internals.runner import GraphRunner
 
 
-def run_stream(table, batches_table):
+def run_stream(table):
     """Capture the full update stream [(commit, row, diff)] of ``table``."""
     updates = []
     pw.io.subscribe(
@@ -40,7 +40,7 @@ class TestWindowStreamBehavior:
             start=pw.this["_pw_window_start"],
             total=pw.reducers.sum(pw.this.v),
         )
-        updates = run_stream(res, t)
+        updates = run_stream(res)
         # first commit: window [0,10) total 10
         # second commit: retract 10, insert 30
         # third commit: new window [10,20) total 5
@@ -106,7 +106,7 @@ class TestWindowStreamBehavior:
             start=pw.this["_pw_window_start"],
             total=pw.reducers.sum(pw.this.v),
         )
-        updates = run_stream(res, t)
+        updates = run_stream(res)
         final = {}
         for c, r, d in updates:
             final[r] = final.get(r, 0) + d
@@ -129,7 +129,7 @@ class TestWindowStreamBehavior:
             start=pw.this["_pw_window_start"],
             total=pw.reducers.sum(pw.this.v),
         )
-        updates = run_stream(res, t)
+        updates = run_stream(res)
         retractions = [u for u in updates if u[2] < 0]
         assert retractions == []  # exactly-once: nothing revised
         emitted = [r for _c, r, d in updates if d > 0]
@@ -152,7 +152,7 @@ class TestWindowStreamBehavior:
             start=pw.this["_pw_window_start"],
             total=pw.reducers.sum(pw.this.v),
         )
-        updates = run_stream(res, t)
+        updates = run_stream(res)
         final = {}
         for _c, r, d in updates:
             final[r] = final.get(r, 0) + d
@@ -179,7 +179,7 @@ class TestIntervalJoinStream:
         res = temporal.interval_join(
             left, right, left.t, right.t, temporal.interval(-3, 3)
         ).select(lt=left.tag, rt=right.tag)
-        updates = run_stream(res, left)
+        updates = run_stream(res)
         live = {}
         for _c, r, d in updates:
             live[r] = live.get(r, 0) + d
