@@ -431,6 +431,19 @@ class Table:
             universe=other._universe,
         )
 
+    def promise_universes_are_equal(self, other: "Table") -> "Table":
+        """Assert both tables share a key set (reference
+        Table.promise_universes_are_equal)."""
+        solver.register_equal(self._universe, other._universe)
+        return self
+
+    def promise_universe_is_subset_of(self, other: "Table") -> "Table":
+        solver.register_subset(self._universe, other._universe)
+        return self
+
+    def promise_universe_is_equal_to(self, other: "Table") -> "Table":
+        return self.promise_universes_are_equal(other)
+
     def with_universe_of(self, other: "Table") -> "Table":
         solver.register_equal(self._universe, other._universe)
         return self._derived(

@@ -401,3 +401,14 @@ def test_schema_property():
     )
     schema = t.schema
     assert schema.column_names() == ["a", "b"]
+
+
+def test_universe_promises_enable_cross_table_select():
+    from pathway_tpu.internals.runner import GraphRunner
+
+    a = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,), (2,)])
+    b = pw.debug.table_from_rows(pw.schema_from_types(y=int), [(10,), (20,)])
+    a.promise_universes_are_equal(b)
+    z = a.select(x=a.x, y=b.y)
+    (snap,) = GraphRunner().capture(z)
+    assert sorted(snap.values()) == [(1, 10), (2, 20)]
