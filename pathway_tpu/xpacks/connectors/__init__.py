@@ -1,0 +1,3 @@
+"""Licensed product connectors (reference: python/pathway/xpacks/connectors/)."""
+
+from pathway_tpu.xpacks.connectors import sharepoint  # noqa: F401

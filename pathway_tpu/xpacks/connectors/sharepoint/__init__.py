@@ -1,0 +1,50 @@
+"""SharePoint file source (reference: xpacks/connectors/sharepoint/, 376
+LoC — a licensed connector polling a SharePoint document library).
+
+Entitlement-gated like the reference (license.rs XPACK_SHAREPOINT). The
+site is reached through an injected ``client`` with the object-store seam
+(``list_objects(prefix) -> [(path, version)]`` / ``get_object(path) ->
+bytes``) — an Office365/Graph adapter in deployments, a fake in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.connectors import IdentityParser
+from pathway_tpu.engine.storage import ObjectStoreReader
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.license import (
+    ENTITLEMENT_XPACK_SHAREPOINT,
+    check_entitlements,
+)
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._utils import input_table
+
+
+def read(
+    url: str | None = None,
+    *,
+    root_path: str = "",
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    refresh_interval: int = 30,
+    client: Any = None,
+    **kwargs: Any,
+) -> Table:
+    """Each library file becomes one binary ``data`` row; edits replace,
+    deletions retract (the ObjectStore scanner's diffing)."""
+    check_entitlements(ENTITLEMENT_XPACK_SHAREPOINT)
+    if client is None:
+        raise ValueError(
+            "pw.xpacks.connectors.sharepoint.read needs an injected client "
+            "(list_objects/get_object seam) — no Office365 SDK ships here"
+        )
+    schema = schema_mod.schema_from_types(data=bytes)
+    return input_table(
+        schema,
+        lambda: ObjectStoreReader(client, root_path, mode=mode, binary=True),
+        lambda names: IdentityParser(binary=True),
+        source_name=f"sharepoint:{url or root_path}",
+        with_metadata=with_metadata,
+    )

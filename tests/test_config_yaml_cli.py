@@ -253,3 +253,26 @@ class TestLicense:
             "PATHWAY_LICENSE_KEY", "pathway-tpu:xpack-sharepoint"
         )
         lic.check_entitlements("xpack-sharepoint")
+
+
+class TestSharePoint:
+    def test_entitlement_gated(self):
+        from pathway_tpu.internals.license import LicenseError
+        from pathway_tpu.xpacks.connectors import sharepoint
+
+        with pytest.raises(LicenseError, match="does not grant"):
+            sharepoint.read("https://site", client=object())
+
+    def test_reads_with_entitlement_and_client(self, monkeypatch):
+        monkeypatch.setenv(
+            "PATHWAY_LICENSE_KEY", "pathway-tpu:xpack-sharepoint"
+        )
+        from pathway_tpu.engine.storage import DictObjectStore
+        from pathway_tpu.internals.runner import GraphRunner
+        from pathway_tpu.xpacks.connectors import sharepoint
+
+        store = DictObjectStore()
+        store.put_object("docs/a.txt", b"hello sharepoint")
+        t = sharepoint.read("https://site", mode="static", client=store)
+        (snap,) = GraphRunner().capture(t)
+        assert list(snap.values()) == [(b"hello sharepoint",)]
