@@ -285,3 +285,125 @@ def rows_to_device_matrix(rows: Sequence[tuple], col: int, dtype=np.float32):
     device array — the ingest feed for the HBM KNN index."""
     mat = np.asarray([np.asarray(r[col], dtype) for r in rows], dtype)
     return to_device(mat)
+
+
+# -- device-resident row cells ------------------------------------------------
+
+
+def _identity(arr: np.ndarray) -> np.ndarray:
+    return arr
+
+
+class DeviceBatchHandle:
+    """A ``[n, dim]`` device array with a lazily-downloaded host twin —
+    produced by device UDF batches (the embedder), consumed directly by
+    device operators (the HBM index) without a host round trip.
+
+    Memory note: rows retained in engine state keep their batch alive, so
+    batches live in HBM until first host use (after which the device copy
+    is RELEASED and only the host twin remains). A pipeline that indexes
+    embeddings and also stores them in table rows therefore holds ~one
+    corpus copy in HBM (the index) plus per-batch arrays until/unless the
+    rows are touched host-side — comparable to the host-RAM copy the
+    eager path kept.
+    """
+
+    __slots__ = ("dev", "_host")
+
+    def __init__(self, dev: Any) -> None:
+        self.dev = dev
+        self._host = None
+
+    def host(self) -> np.ndarray:
+        if self._host is None:
+            self._host = np.asarray(self.dev)
+            self.dev = None  # free the HBM copy; host twin serves from now
+        return self._host
+
+
+class LazyDeviceVector:
+    """One row of a DeviceBatchHandle. Behaves like the host ndarray on any
+    host-side use (``__array__`` downloads the parent batch once), while
+    device consumers slice ``batch.dev`` with zero transfers.
+
+    Like ndarrays, instances are unhashable and compare elementwise, so the
+    engine's consolidation/diff fallbacks treat them identically.
+    """
+
+    __slots__ = ("batch", "index")
+
+    def __init__(self, batch: DeviceBatchHandle, index: int) -> None:
+        self.batch = batch
+        self.index = index
+
+    # -- host materialisation -------------------------------------------------
+
+    def __array__(self, dtype: Any = None, copy: Any = None) -> np.ndarray:
+        row = self.batch.host()[self.index]
+        if dtype is not None and row.dtype != dtype:
+            row = row.astype(dtype)
+        return np.array(row, copy=True) if copy else row
+
+    def _parent_array(self) -> Any:
+        dev = self.batch.dev
+        return dev if dev is not None else self.batch.host()
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self._parent_array().shape[1:])
+
+    @property
+    def dtype(self) -> Any:
+        return np.dtype(str(self._parent_array().dtype))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def reshape(self, *shape: Any) -> np.ndarray:
+        return np.asarray(self).reshape(*shape)
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def __iter__(self):
+        return iter(np.asarray(self))
+
+    def __getitem__(self, item: Any) -> Any:
+        return np.asarray(self)[item]
+
+    def __eq__(self, other: Any) -> Any:
+        return np.asarray(self) == other
+
+    def __ne__(self, other: Any) -> Any:
+        return np.asarray(self) != other
+
+    __hash__ = None  # type: ignore[assignment]  # like np.ndarray
+
+    def __repr__(self) -> str:
+        return repr(np.asarray(self))
+
+    def __reduce__(self):
+        return (_identity, (np.array(np.asarray(self)),))
+
+
+def lazy_rows(dev_batch: Any, n: int) -> list:
+    """Wrap a device ``[b, dim]`` result as ``n`` lazy per-row cells."""
+    handle = DeviceBatchHandle(dev_batch)
+    return [LazyDeviceVector(handle, i) for i in range(n)]
+
+
+def common_device_parent(vectors: Sequence[Any]) -> tuple[Any, list[int]] | None:
+    """When every vector is a LazyDeviceVector of one batch, return
+    (device array, row indices) for a transfer-free gather."""
+    if not vectors or not isinstance(vectors[0], LazyDeviceVector):
+        return None
+    parent = vectors[0].batch
+    if parent.dev is None:
+        return None  # already downloaded+released: host path
+    indices = [vectors[0].index]
+    for v in vectors[1:]:
+        if not isinstance(v, LazyDeviceVector) or v.batch is not parent:
+            return None
+        indices.append(v.index)
+    return parent.dev, indices

@@ -43,6 +43,27 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+_gather_pad_jit = None
+
+
+def _gather_pad(dev, idx_pad, enabled):
+    """Bucketed device gather: [B, dim] batch + padded indices -> [b, dim]
+    float32 rows, zeroed where disabled. One module-level jit — jax caches
+    the compilation per input shape, and all shapes here are bucketed."""
+    global _gather_pad_jit
+    if _gather_pad_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def gather(d, i, e):
+            rows = jnp.take(d, i, axis=0).astype(jnp.float32)
+            return jnp.where(e[:, None], rows, 0.0)
+
+        _gather_pad_jit = gather
+    return _gather_pad_jit(dev, idx_pad, enabled)
+
+
 class DeviceKnnIndex:
     """HBM-resident brute-force KNN with a host slot allocator.
 
@@ -124,6 +145,8 @@ class DeviceKnnIndex:
         )
 
     def add(self, keys: Sequence[Pointer], vectors: Sequence[Any]) -> None:
+        if self._try_add_device(keys, vectors):
+            return
         slots, vecs, valid = [], [], []
         deferred_free: list[int] = []  # freed only after the batch lands, so
         # a replaced key's old slot can't be reused (= written twice) in it
@@ -149,6 +172,60 @@ class DeviceKnnIndex:
             valid.append(True)
         self._apply(slots, np.asarray(vecs, np.float32), valid)
         self._free.extend(deferred_free)
+
+    def _try_add_device(
+        self, keys: Sequence[Pointer], vectors: Sequence[Any]
+    ) -> bool:
+        """Transfer-free ingest: when the whole batch is lazy rows of one
+        device array (the embedder's jit output), gather on device and
+        scatter straight into HBM — no device→host→device round trip
+        (the bench pipeline's hot path)."""
+        from pathway_tpu.engine.device import common_device_parent
+
+        parent = common_device_parent(list(vectors))
+        if parent is None:
+            return False
+        if any(key in self.key_to_slot for key in keys):
+            return False  # replacements take the general path
+        if len(self._free) < len(keys):
+            return False  # growth takes the general path
+        dev, indices = parent
+        if tuple(dev.shape[1:]) != (self.dim,):
+            return False
+
+        import jax.numpy as jnp
+
+        from pathway_tpu.ops import knn_update
+
+        n = len(keys)
+        slots = []
+        for key in keys:
+            slot = self._free.pop()
+            self.key_to_slot[key] = slot
+            self.slot_to_key[slot] = key
+            slots.append(slot)
+        # every device-side shape is bucketed — otherwise each distinct
+        # batch length would trigger a fresh compile (deadly over a
+        # remote-device link)
+        b = _bucket(n)
+        slots_arr = np.zeros((b,), np.int32)
+        slots_arr[:n] = slots
+        enabled = np.zeros((b,), bool)
+        enabled[:n] = True
+        idx_pad = np.zeros((b,), np.int32)
+        idx_pad[:n] = indices
+        enabled_dev = jnp.asarray(enabled)
+        gathered = _gather_pad(
+            dev, jnp.asarray(idx_pad), enabled_dev
+        )
+        self.state = knn_update(
+            self.state,
+            jnp.asarray(slots_arr),
+            gathered,
+            enabled_dev,
+            enabled_dev,
+        )
+        return True
 
     def remove(self, keys: Sequence[Pointer]) -> None:
         slots, vecs, valid = [], [], []

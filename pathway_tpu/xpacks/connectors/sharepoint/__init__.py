@@ -28,7 +28,6 @@ def read(
     root_path: str = "",
     mode: str = "streaming",
     with_metadata: bool = False,
-    refresh_interval: int = 30,
     client: Any = None,
     **kwargs: Any,
 ) -> Table:

@@ -123,15 +123,17 @@ class TpuEncoderEmbedder(UDF):
         )
 
         def embed_batch(texts: list) -> list:
+            from pathway_tpu.engine.device import lazy_rows
+
             ids, mask = self.tokenizer.encode_batch(
                 [str(t) for t in texts], self.max_len
             )
             ids, mask, real = pad_to_buckets(ids, mask)
-            vecs = np.asarray(
-                self._jit_embed(jnp.asarray(ids), jnp.asarray(mask)),
-                np.float32,
-            )
-            return [vecs[i] for i in range(real)]
+            vecs_dev = self._jit_embed(jnp.asarray(ids), jnp.asarray(mask))
+            # lazy per-row cells: device consumers (the HBM index) gather
+            # straight from this batch with no host round trip; any host
+            # use downloads the batch once
+            return lazy_rows(vecs_dev, real)
 
         super().__init__(
             embed_batch,

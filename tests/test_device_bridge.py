@@ -262,3 +262,79 @@ class TestPerf:
         finally:
             graph_mod.VECTOR_THRESHOLD = old
         assert t_slow / t_fast > 2.5, (t_slow, t_fast)
+
+
+class TestLazyDeviceVectors:
+    def test_transfer_free_ingest_path(self):
+        """Embedder-shaped batches reach the index without materializing a
+        host copy (the device→host→device round trip is gone)."""
+        import jax.numpy as jnp
+
+        from pathway_tpu.engine.device import DeviceBatchHandle, lazy_rows
+        from pathway_tpu.engine.external_index import DeviceKnnIndex
+        from pathway_tpu.engine.value import ref_scalar
+
+        dev = jnp.eye(8, 16)
+        rows = lazy_rows(dev, 5)
+        handle = rows[0].batch
+        idx = DeviceKnnIndex(dim=16, capacity=64)
+        keys = [ref_scalar(i) for i in range(5)]
+        idx.add(keys, rows)
+        # the fast path consumed the device parent: no host twin appeared
+        assert handle._host is None and handle.dev is not None
+        # search still finds the right rows
+        res = idx.search([np.eye(8, 16)[2]], k=1)
+        assert res[0][0][0] == keys[2]
+
+    def test_host_use_downloads_once_and_releases_device(self):
+        import jax.numpy as jnp
+
+        from pathway_tpu.engine.device import lazy_rows
+
+        rows = lazy_rows(jnp.arange(12.0).reshape(3, 4), 3)
+        v = np.asarray(rows[1])
+        assert np.allclose(v, [4, 5, 6, 7])
+        handle = rows[0].batch
+        assert handle.dev is None  # HBM copy released after download
+        assert np.allclose(np.asarray(rows[2]), [8, 9, 10, 11])
+
+    def test_released_batch_falls_back_to_host_add(self):
+        import jax.numpy as jnp
+
+        from pathway_tpu.engine.device import common_device_parent, lazy_rows
+        from pathway_tpu.engine.external_index import DeviceKnnIndex
+        from pathway_tpu.engine.value import ref_scalar
+
+        rows = lazy_rows(jnp.eye(4, 8), 4)
+        np.asarray(rows[0])  # releases the device copy
+        assert common_device_parent(rows) is None
+        idx = DeviceKnnIndex(dim=8, capacity=16)
+        idx.add([ref_scalar(i) for i in range(4)], rows)  # host path
+        res = idx.search([np.eye(4, 8)[3]], k=1)
+        assert res[0][0][0] == ref_scalar(3)
+
+    def test_replacement_takes_general_path(self):
+        import jax.numpy as jnp
+
+        from pathway_tpu.engine.device import lazy_rows
+        from pathway_tpu.engine.external_index import DeviceKnnIndex
+        from pathway_tpu.engine.value import ref_scalar
+
+        idx = DeviceKnnIndex(dim=4, capacity=16)
+        k = ref_scalar("x")
+        idx.add([k], lazy_rows(jnp.asarray([[1.0, 0, 0, 0]]), 1))
+        idx.add([k], lazy_rows(jnp.asarray([[0.0, 1, 0, 0]]), 1))
+        res = idx.search([np.array([0.0, 1, 0, 0], np.float32)], k=1)
+        assert res[0][0][0] == k and res[0][0][1] > 0.99
+        assert len(idx) == 1
+
+    def test_lazy_vectors_round_trip_operator_snapshots(self):
+        import pickle
+
+        import jax.numpy as jnp
+
+        from pathway_tpu.engine.device import lazy_rows
+
+        rows = lazy_rows(jnp.arange(8.0).reshape(2, 4), 2)
+        restored = pickle.loads(pickle.dumps(rows[1]))
+        assert np.allclose(restored, [4, 5, 6, 7])
