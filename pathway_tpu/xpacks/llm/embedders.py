@@ -52,6 +52,7 @@ class TpuEncoderEmbedder(UDF):
         params: Any = None,
         seed: int = 0,
         cache_strategy: CacheStrategy | None = None,
+        device_resident: bool | None = None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -122,18 +123,29 @@ class TpuEncoderEmbedder(UDF):
             lambda ids, mask: embed(params, ids, mask, cfg)
         )
 
-        def embed_batch(texts: list) -> list:
-            from pathway_tpu.engine.device import lazy_rows
+        if device_resident is None:
+            # device-resident rows skip the device→host→device round trip
+            # into the index — a win on locally-attached chips, a loss over
+            # remote-device links where each extra op dispatch costs an RPC
+            # (measured: ~10% slower through the axon tunnel). Default off;
+            # opt in per embedder or via env.
+            device_resident = os.environ.get(
+                "PATHWAY_DEVICE_RESIDENT_UDF", ""
+            ).lower() in ("1", "true", "yes", "on")
+        self.device_resident = device_resident
 
+        def embed_batch(texts: list) -> list:
             ids, mask = self.tokenizer.encode_batch(
                 [str(t) for t in texts], self.max_len
             )
             ids, mask, real = pad_to_buckets(ids, mask)
             vecs_dev = self._jit_embed(jnp.asarray(ids), jnp.asarray(mask))
-            # lazy per-row cells: device consumers (the HBM index) gather
-            # straight from this batch with no host round trip; any host
-            # use downloads the batch once
-            return lazy_rows(vecs_dev, real)
+            if self.device_resident:
+                from pathway_tpu.engine.device import lazy_rows
+
+                return lazy_rows(vecs_dev, real)
+            vecs = np.asarray(vecs_dev, np.float32)
+            return [vecs[i] for i in range(real)]
 
         super().__init__(
             embed_batch,

@@ -338,3 +338,21 @@ class TestLazyDeviceVectors:
         rows = lazy_rows(jnp.arange(8.0).reshape(2, 4), 2)
         restored = pickle.loads(pickle.dumps(rows[1]))
         assert np.allclose(restored, [4, 5, 6, 7])
+
+    def test_embedder_device_resident_opt_in(self, monkeypatch):
+        from pathway_tpu.engine.device import LazyDeviceVector
+        from pathway_tpu.xpacks.llm.embedders import TpuEncoderEmbedder
+
+        eager = TpuEncoderEmbedder("minilm_l6", max_len=16)
+        out = eager._fn(["hello"])
+        assert isinstance(out[0], np.ndarray)
+
+        resident = TpuEncoderEmbedder(
+            "minilm_l6", max_len=16, device_resident=True
+        )
+        out = resident._fn(["hello"])
+        assert isinstance(out[0], LazyDeviceVector)
+
+        monkeypatch.setenv("PATHWAY_DEVICE_RESIDENT_UDF", "1")
+        via_env = TpuEncoderEmbedder("minilm_l6", max_len=16)
+        assert via_env.device_resident
