@@ -5,7 +5,7 @@ lowers onto Table ops. sqlglot is not in this image, so the same subset is
 parsed with a small recursive-descent parser and lowered identically:
 SELECT expressions (+aliases, arithmetic, comparisons, AND/OR/NOT, literals),
 FROM, INNER JOIN ... ON equalities, WHERE, GROUP BY with aggregates
-(count/sum/min/max/avg), HAVING, UNION ALL.
+(count/sum/min/max/avg), HAVING, UNION ALL, INTERSECT.
 """
 
 from __future__ import annotations
@@ -29,7 +29,7 @@ _TOKEN_RE = re.compile(
 
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "as", "and", "or",
-    "not", "join", "inner", "left", "on", "union", "all", "count", "sum",
+    "not", "join", "inner", "left", "on", "union", "all", "intersect", "count", "sum",
     "min", "max", "avg", "null", "true", "false", "is",
 }
 
@@ -88,9 +88,14 @@ class _Parser:
 
     def parse_query(self) -> dict:
         q = self.parse_select()
-        while self.accept("kw", "union"):
-            self.expect("kw", "all")
-            q = {"kind": "union", "left": q, "right": self.parse_select()}
+        while True:
+            if self.accept("kw", "union"):
+                self.expect("kw", "all")
+                q = {"kind": "union", "left": q, "right": self.parse_select()}
+            elif self.accept("kw", "intersect"):
+                q = {"kind": "intersect", "left": q, "right": self.parse_select()}
+            else:
+                break
         self.expect("end")
         return q
 
@@ -246,6 +251,21 @@ class _Lowerer:
             left = self.lower(q["left"])
             right = self.lower(q["right"])
             return left.concat_reindex(right)
+        if q["kind"] == "intersect":
+            # set semantics: distinct rows present on both sides
+            left = self.lower(q["left"])
+            right = self.lower(q["right"])
+            lcols = left.column_names()
+            rcols = right.column_names()
+            if len(lcols) != len(rcols):
+                raise ValueError("INTERSECT sides must have equal arity")
+            conds = [left[lc] == right[rc] for lc, rc in zip(lcols, rcols)]
+            joined = left.join(right, *conds).select(
+                **{lc: left[lc] for lc in lcols}
+            )
+            return joined.groupby(*[joined[c] for c in lcols]).reduce(
+                **{c: joined[c] for c in lcols}
+            )
         return self.lower_select(q)
 
     def _resolve_col(self, tname: str | None, col: str, scope: dict[str, Table]):

@@ -105,3 +105,14 @@ def test_join_duplicate_columns_unqualified_ambiguous():
     )
     with pytest.raises(ValueError, match="ambiguous"):
         pw.sql("SELECT val FROM a JOIN b ON a.k = b.k", a=a, b=b)
+
+
+def test_intersect():
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str), [("x",), ("y",), ("z",), ("y",)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str), [("y",), ("z",), ("w",)]
+    )
+    res = pw.sql("SELECT name FROM a INTERSECT SELECT name FROM b", a=a, b=b)
+    assert rows_of(res) == [("y",), ("z",)]

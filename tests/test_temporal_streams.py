@@ -187,3 +187,52 @@ class TestIntervalJoinStream:
             (("lt", "l1"), ("rt", "r1")),
             (("lt", "l2"), ("rt", "r2")),
         }
+
+
+class TestOrderSensitiveReducers:
+    def test_earliest_latest_over_stream(self):
+        """earliest keeps the first-arrived value, latest the last — across
+        commits (reference Earliest/Latest reducers reduce.rs:22)."""
+        sg = pw.debug.StreamGenerator()
+
+        class S(pw.Schema):
+            k: str
+            v: int
+
+        t = sg.table_from_list_of_batches(
+            [
+                [{"k": "a", "v": 1}],
+                [{"k": "a", "v": 2}, {"k": "b", "v": 10}],
+                [{"k": "a", "v": 3}],
+            ],
+            S,
+        )
+        res = t.groupby(t.k).reduce(
+            k=t.k,
+            first=pw.reducers.earliest(t.v),
+            last=pw.reducers.latest(t.v),
+        )
+        updates = run_stream(res)
+        final = {}
+        for _c, r, d in updates:
+            final[r] = final.get(r, 0) + d
+        live = sorted(r for r, n in final.items() if n > 0)
+        assert live == [
+            (("first", 1), ("k", "a"), ("last", 3)),
+            (("first", 10), ("k", "b"), ("last", 10)),
+        ]
+
+    def test_ndarray_reducer(self):
+        import numpy as np
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=str, v=float),
+            [("a", 1.0), ("a", 2.0), ("b", 5.0)],
+        )
+        res = t.groupby(t.k).reduce(
+            k=t.k, arr=pw.reducers.ndarray(t.v)
+        )
+        (snap,) = GraphRunner().capture(res)
+        by_k = {r[0]: np.sort(np.asarray(r[1])) for r in snap.values()}
+        assert np.allclose(by_k["a"], [1.0, 2.0])
+        assert np.allclose(by_k["b"], [5.0])
