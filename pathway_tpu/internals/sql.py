@@ -87,16 +87,22 @@ class _Parser:
     # -- grammar -------------------------------------------------------------
 
     def parse_query(self) -> dict:
-        q = self.parse_select()
-        while True:
-            if self.accept("kw", "union"):
-                self.expect("kw", "all")
-                q = {"kind": "union", "left": q, "right": self.parse_select()}
-            elif self.accept("kw", "intersect"):
-                q = {"kind": "intersect", "left": q, "right": self.parse_select()}
-            else:
-                break
+        q = self.parse_intersect_chain()
+        while self.accept("kw", "union"):
+            self.expect("kw", "all")
+            # INTERSECT binds tighter than UNION (standard SQL precedence)
+            q = {
+                "kind": "union",
+                "left": q,
+                "right": self.parse_intersect_chain(),
+            }
         self.expect("end")
+        return q
+
+    def parse_intersect_chain(self) -> dict:
+        q = self.parse_select()
+        while self.accept("kw", "intersect"):
+            q = {"kind": "intersect", "left": q, "right": self.parse_select()}
         return q
 
     def parse_select(self) -> dict:
@@ -252,19 +258,24 @@ class _Lowerer:
             right = self.lower(q["right"])
             return left.concat_reindex(right)
         if q["kind"] == "intersect":
-            # set semantics: distinct rows present on both sides
-            left = self.lower(q["left"])
-            right = self.lower(q["right"])
+            # set semantics: distinct rows present on both sides. Each side
+            # deduplicates FIRST so duplicate-heavy inputs can't blow up
+            # the join (k*m rows per repeated value otherwise)
+            def distinct(t: Table) -> Table:
+                cols = t.column_names()
+                return t.groupby(*[t[c] for c in cols]).reduce(
+                    **{c: t[c] for c in cols}
+                )
+
+            left = distinct(self.lower(q["left"]))
+            right = distinct(self.lower(q["right"]))
             lcols = left.column_names()
             rcols = right.column_names()
             if len(lcols) != len(rcols):
                 raise ValueError("INTERSECT sides must have equal arity")
             conds = [left[lc] == right[rc] for lc, rc in zip(lcols, rcols)]
-            joined = left.join(right, *conds).select(
+            return left.join(right, *conds).select(
                 **{lc: left[lc] for lc in lcols}
-            )
-            return joined.groupby(*[joined[c] for c in lcols]).reduce(
-                **{c: joined[c] for c in lcols}
             )
         return self.lower_select(q)
 

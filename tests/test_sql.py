@@ -116,3 +116,15 @@ def test_intersect():
     )
     res = pw.sql("SELECT name FROM a INTERSECT SELECT name FROM b", a=a, b=b)
     assert rows_of(res) == [("y",), ("z",)]
+
+
+def test_intersect_binds_tighter_than_union():
+    a = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(1,), (2,)])
+    b = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(3,), (4,)])
+    c = pw.debug.table_from_rows(pw.schema_from_types(x=int), [(2,), (3,)])
+    res = pw.sql(
+        "SELECT x FROM a UNION ALL SELECT x FROM b INTERSECT SELECT x FROM c",
+        a=a, b=b, c=c,
+    )
+    # standard SQL: a UNION (b ∩ c) = {1, 2} ∪ {3} = {1, 2, 3}
+    assert rows_of(res) == [(1,), (2,), (3,)]
