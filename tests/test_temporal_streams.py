@@ -236,3 +236,67 @@ class TestOrderSensitiveReducers:
         by_k = {r[0]: np.sort(np.asarray(r[1])) for r in snap.values()}
         assert np.allclose(by_k["a"], [1.0, 2.0])
         assert np.allclose(by_k["b"], [5.0])
+
+
+class TestAsofDirections:
+    def _tables(self):
+        trades = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, sym=str), [(10, "A"), (20, "A")]
+        )
+        quotes = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, sym=str, px=float),
+            [(7, "A", 1.0), (12, "A", 2.0), (19, "A", 3.0), (30, "A", 4.0)],
+        )
+        return trades, quotes
+
+    def _run(self, direction):
+        trades, quotes = self._tables()
+        res = trades.asof_join(
+            quotes,
+            trades.t,
+            quotes.t,
+            trades.sym == quotes.sym,
+            direction=direction,
+        ).select(t=trades.t, px=quotes.px)
+        (snap,) = GraphRunner().capture(res)
+        return sorted(snap.values())
+
+    def test_backward(self):
+        # latest quote at or before each trade
+        assert self._run("backward") == [(10, 1.0), (20, 3.0)]
+
+    def test_forward(self):
+        # earliest quote at or after each trade
+        assert self._run("forward") == [(10, 2.0), (20, 4.0)]
+
+    def test_nearest(self):
+        # closest quote either side (|12-10| < |7-10|; |19-20| < |30-20|)
+        assert self._run("nearest") == [(10, 2.0), (20, 3.0)]
+
+
+class TestSessionWindowStream:
+    def test_sessions_merge_as_gap_closes(self):
+        """Two separate sessions MERGE when a bridging row arrives — the
+        retract/re-emit shape of incremental session windows."""
+        sg = pw.debug.StreamGenerator()
+
+        class S(pw.Schema):
+            t: int
+
+        t = sg.table_from_list_of_batches(
+            [[{"t": 1}], [{"t": 10}], [{"t": 5}]], S  # 5 bridges 1 and 10
+        )
+        res = t.windowby(t.t, window=temporal.session(max_gap=5)).reduce(
+            start=pw.this["_pw_window_start"],
+            end=pw.this["_pw_window_end"],
+            n=pw.reducers.count(),
+        )
+        updates = run_stream(res)
+        final = {}
+        for _c, r, d in updates:
+            final[r] = final.get(r, 0) + d
+        live = {r for r, n in final.items() if n > 0}
+        # one merged session [1, 10] with all three rows
+        assert live == {(("end", 10), ("n", 3), ("start", 1))}
+        # and the separate pre-merge sessions were retracted
+        assert any(d < 0 for _c, _r, d in updates)
