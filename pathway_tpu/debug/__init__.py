@@ -236,8 +236,10 @@ class StreamGenerator:
                 entries.append((INSERT, ref_scalar("sg", next(seq)), values))
             schedule.append(entries)
 
-        def attach(scope: Scope):
+        def attach(scope: Scope, make_driver: bool = True):
             session = scope.input_session(len(names))
+            if not make_driver:
+                return session, None
             driver = BatchScheduleDriver(session, schedule)
             return session, driver
 

@@ -165,8 +165,10 @@ def replay_csv_with_time(
             current_time = t
         batches[-1].append((INSERT, ref_scalar(i), values))
 
-    def attach(scope: Scope):
+    def attach(scope: Scope, make_driver: bool = True):
         session = scope.input_session(len(names))
+        if not make_driver:
+            return session, None
         driver = BatchScheduleDriver(session, batches)
         return session, driver
 

@@ -539,8 +539,10 @@ class FileWriter:
         )
 
     def on_time_end(self, time: int) -> None:
-        self._file.flush()
+        if not self._file.closed:
+            self._file.flush()
 
     def on_end(self) -> None:
-        self._file.flush()
-        self._file.close()
+        if not self._file.closed:
+            self._file.flush()
+            self._file.close()

@@ -1,0 +1,577 @@
+"""Multi-process execution: a TCP exchange mesh between worker processes.
+
+This is the DCN leg of the worker model (reference: timely
+``CommunicationConfig::Cluster`` built in src/engine/dataflow/config.rs:72-86
+from PATHWAY_PROCESSES/PATHWAY_PROCESS_ID/PATHWAY_FIRST_PORT, launched by
+`pathway spawn`, python/pathway/cli.py:93-107; transport = vendored timely
+communication: TCP sockets + progress gossip, SURVEY §2.10).
+
+Design (TPU-first, not a timely translation):
+
+- Every process runs the IDENTICAL program and builds the identical graph
+  (the reference re-executes the Python logic per worker,
+  python_api.rs:3329). Total workers = processes x threads; worker ``w``
+  lives on process ``w // threads``. Partitioning seams are shared with the
+  in-process exchange (engine/sharded.py `partitioner`).
+- Process 0 is the coordinator: it owns connector drivers (inputs read on
+  one worker and reshard, reference dataflow.rs:3492) and all sinks
+  (single-threaded sinks, data_storage.rs:611). It drives commits by
+  broadcasting control frames.
+- In place of timely's asynchronous progress gossip, a commit settles with
+  *synchronous exchange rounds*: each round every process drains its local
+  operators to quiescence, then swaps one frame with every peer carrying
+  (busy-bit, deliveries). A commit is done after a round in which no
+  process was busy and nothing was exchanged — at that point nothing can
+  be in flight, so this is an exact distributed-quiescence test. The round
+  barrier is the host-side analog of the jit step boundary that ICI
+  collectives synchronize on (SURVEY §5.8 mapping).
+- Frames are length-prefixed pickles; per-peer receiver threads drain
+  sockets continuously so bulk sends can never deadlock the mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time as _walltime
+from typing import Any, Sequence
+
+from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
+from pathway_tpu.engine.graph import (
+    ErrorLogNode,
+    InputSession,
+    Node,
+    Scope,
+    StaticSource,
+)
+from pathway_tpu.engine.sharded import _shard_of, partitioner
+from pathway_tpu.engine.value import Pointer
+
+_LEN = struct.Struct(">Q")
+
+#: how long a process waits for a peer frame before declaring the run dead
+RECV_TIMEOUT = float(os.environ.get("PATHWAY_EXCHANGE_TIMEOUT", "600"))
+_CONNECT_DEADLINE = 60.0
+
+
+def default_addresses(n_processes: int, first_port: int) -> list[tuple[str, int]]:
+    """Static address book (reference config.rs:113-117: 127.0.0.1,
+    first_port+i). Multi-host deployments override via
+    PATHWAY_PROCESS_ADDRESSES="host1:port1;host2:port2;..."."""
+    spec = os.environ.get("PATHWAY_PROCESS_ADDRESSES")
+    if spec:
+        out = []
+        for part in spec.split(";"):
+            host, _, port = part.strip().rpartition(":")
+            out.append((host, int(port)))
+        if len(out) != n_processes:
+            raise ValueError(
+                f"PATHWAY_PROCESS_ADDRESSES lists {len(out)} hosts for "
+                f"{n_processes} processes"
+            )
+        return out
+    return [("127.0.0.1", first_port + i) for i in range(n_processes)]
+
+
+class MeshTransport:
+    """Full TCP mesh; one duplex socket per process pair.
+
+    Process ``i`` accepts connections from peers ``j > i`` and dials peers
+    ``j < i``; a HELLO frame identifies the dialer. One receiver thread per
+    peer parses frames into a FIFO queue (per-peer streams are totally
+    ordered, and the round protocol is globally sequenced per peer, so a
+    plain queue is a sufficient demultiplexer)."""
+
+    def __init__(
+        self,
+        process_id: int,
+        n_processes: int,
+        first_port: int = 10000,
+        addresses: Sequence[tuple[str, int]] | None = None,
+    ) -> None:
+        self.process_id = process_id
+        self.n = n_processes
+        addrs = list(addresses or default_addresses(n_processes, first_port))
+        self._socks: dict[int, socket.socket] = {}
+        self._queues: dict[int, queue.Queue] = {
+            p: queue.Queue() for p in range(n_processes) if p != process_id
+        }
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._threads: list[threading.Thread] = []
+        self._closed = False
+        if n_processes == 1:
+            return
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((("0.0.0.0", addrs[process_id][1])))
+        listener.listen(n_processes)
+        listener.settimeout(_CONNECT_DEADLINE)
+        try:
+            for peer in range(process_id):  # dial lower ids
+                self._socks[peer] = self._dial(addrs[peer])
+                self._send(peer, ("hello", process_id))
+            for _ in range(process_id + 1, n_processes):  # accept higher ids
+                conn, _addr = listener.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                kind, peer = self._read_frame(conn)
+                assert kind == "hello"
+                self._socks[peer] = conn
+        finally:
+            listener.close()
+        for peer, sock in self._socks.items():
+            self._send_locks[peer] = threading.Lock()
+            t = threading.Thread(
+                target=self._recv_loop, args=(peer, sock), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    @staticmethod
+    def _dial(addr: tuple[str, int]) -> socket.socket:
+        deadline = _walltime.monotonic() + _CONNECT_DEADLINE
+        delay = 0.02
+        while True:
+            try:
+                sock = socket.create_connection(addr, timeout=_CONNECT_DEADLINE)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError:
+                if _walltime.monotonic() > deadline:
+                    raise
+                _walltime.sleep(delay)
+                delay = min(delay * 2, 0.5)
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    @classmethod
+    def _read_frame(cls, sock: socket.socket) -> Any:
+        (length,) = _LEN.unpack(cls._read_exact(sock, _LEN.size))
+        return pickle.loads(cls._read_exact(sock, length))
+
+    def _recv_loop(self, peer: int, sock: socket.socket) -> None:
+        q = self._queues[peer]
+        try:
+            while True:
+                q.put(self._read_frame(sock))
+        except (ConnectionError, OSError, EOFError, pickle.PickleError):
+            q.put(("__eof__", peer))
+
+    def _send(self, peer: int, frame: Any) -> None:
+        payload = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
+        lock = self._send_locks.get(peer)
+        data = _LEN.pack(len(payload)) + payload
+        if lock is None:
+            self._socks[peer].sendall(data)
+        else:
+            with lock:
+                self._socks[peer].sendall(data)
+
+    def send(self, peer: int, frame: Any) -> None:
+        try:
+            self._send(peer, frame)
+        except OSError as exc:
+            raise RuntimeError(
+                f"process {self.process_id}: lost connection to peer {peer}"
+            ) from exc
+
+    def broadcast(self, frame: Any) -> None:
+        for peer in self._queues:
+            self.send(peer, frame)
+
+    def recv(self, peer: int, timeout: float = RECV_TIMEOUT) -> Any:
+        try:
+            frame = self._queues[peer].get(timeout=timeout)
+        except queue.Empty:
+            raise RuntimeError(
+                f"process {self.process_id}: no frame from peer {peer} "
+                f"within {timeout}s — a peer likely crashed"
+            ) from None
+        if isinstance(frame, tuple) and frame and frame[0] == "__eof__":
+            raise RuntimeError(
+                f"process {self.process_id}: peer {peer} disconnected"
+            )
+        return frame
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for sock in self._socks.values():
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class DistributedScheduler:
+    """The per-process commit pump of the multi-process runtime.
+
+    Mirrors engine/sharded.py ShardedScheduler over ``threads`` local scope
+    replicas, with remote workers reached through the mesh. Process 0's
+    scope 0 is the primary replica: sources flush there, sinks and
+    globally-stateful operators are pinned there."""
+
+    def __init__(
+        self,
+        local_scopes: Sequence[Scope],
+        process_id: int,
+        n_processes: int,
+        transport: MeshTransport,
+        n_shared: int | None = None,
+    ) -> None:
+        self.scopes = list(local_scopes)
+        self.threads = len(self.scopes)
+        self.process_id = process_id
+        self.n_processes = n_processes
+        self.n_workers = self.threads * n_processes
+        self.transport = transport
+        self.time = 0
+        self.stats: dict[int, Any] = {}  # monitoring surface parity
+        #: shared graph length: nodes with index >= n_shared exist only on
+        #: process 0 / scope 0 (sink-side chains attached there). The
+        #: runner records it before attaching sinks; the min() fallback
+        #: only works when a second sink-free local scope exists.
+        self.n_shared = (
+            n_shared
+            if n_shared is not None
+            else min(len(s.nodes) for s in self.scopes)
+        )
+        #: producer index -> [(consumer index, port)] for process-0-only
+        #: consumers, learned from the coordinator's topology broadcast
+        self.extra_consumers: dict[int, list[tuple[int, int]]] = {}
+        # local replicas must carry the identical shared operator sequence
+        # (ShardedScheduler's divergence check, applied per process)
+        sig0 = self._shared_signature()
+        for idx, scope in enumerate(self.scopes[1:], start=1):
+            sig = [type(n).__name__ for n in scope.nodes[: self.n_shared]]
+            if sig != sig0:
+                raise ValueError(
+                    f"local worker {idx} scope diverged: the graph logic "
+                    "must build the identical operator sequence on every "
+                    "worker"
+                )
+        self._parts: dict[tuple[int, int], Any] = {}
+        #: deliveries queued for each remote process this round
+        self._outbox: dict[int, list[tuple]] = {
+            p: [] for p in range(n_processes) if p != process_id
+        }
+
+    # -- topology ----------------------------------------------------------
+
+    def _shared_signature(self) -> list[str]:
+        return [
+            type(n).__name__ for n in self.scopes[0].nodes[: self.n_shared]
+        ]
+
+    def announce_topology(self) -> None:
+        """Process 0: tell peers about sink-side consumers so their
+        producer replicas route output here (the sharded scheduler reads
+        worker 0's superset scope directly; remote processes can't)."""
+        assert self.process_id == 0
+        scope0 = self.scopes[0]
+        extra: list[tuple[int, int, int]] = []
+        for node in scope0.nodes[: self.n_shared]:
+            for consumer, port in node.consumers:
+                if consumer.index >= self.n_shared:
+                    extra.append((node.index, consumer.index, port))
+        for prod, cons, port in extra:
+            self.extra_consumers.setdefault(prod, []).append((cons, port))
+        self.transport.broadcast(
+            ("topology", self.n_shared, self._shared_signature(), extra)
+        )
+
+    def receive_topology(self) -> None:
+        kind, n_shared, signature, extra = self.transport.recv(0)
+        assert kind == "topology", kind
+        if n_shared != self.n_shared or signature != self._shared_signature():
+            raise RuntimeError(
+                "graph divergence: the program must build the identical "
+                f"operator graph in every process (coordinator has "
+                f"{n_shared} shared nodes {signature[:6]}..., process "
+                f"{self.process_id} has {self.n_shared} "
+                f"{self._shared_signature()[:6]}...)"
+            )
+        for prod, cons, port in extra:
+            self.extra_consumers.setdefault(prod, []).append((cons, port))
+
+    # -- worker placement --------------------------------------------------
+
+    def _owner(self, worker: int) -> tuple[int, int]:
+        """worker -> (process, local scope idx)."""
+        return worker // self.threads, worker % self.threads
+
+    def _partition_fn(self, consumer: Node, port: int):
+        key = (consumer.index, port)
+        fn = self._parts.get(key, False)
+        if fn is False:
+            fn = partitioner(consumer, port, self.n_workers)
+            self._parts[key] = fn
+        return fn
+
+    def _push_remote(
+        self,
+        process: int,
+        kind: str,
+        index: int,
+        port_or_worker: int,
+        worker: int,
+        entries: list,
+        consolidated: bool,
+    ) -> None:
+        self._outbox[process].append(
+            (kind, index, port_or_worker, worker, entries, consolidated)
+        )
+
+    def _local_push(
+        self, scope_idx: int, consumer_index: int, port: int, entries: list,
+        consolidated: bool,
+    ) -> None:
+        batch = DeltaBatch(entries)
+        batch._consolidated = consolidated
+        self.scopes[scope_idx].nodes[consumer_index].push(port, batch)
+
+    # -- exchange ----------------------------------------------------------
+
+    def _deliver(self, producer: Node, out: DeltaBatch) -> None:
+        """Split ``out`` per consumer; push each part to the consumer's
+        replica on the owning worker (local) or queue it for the owning
+        process (remote)."""
+        consolidated = out._consolidated
+        for consumer, port in self.scopes[0].nodes[producer.index].consumers:
+            self._route_part(consumer.index, port, consumer, out, consolidated)
+        # sink-side consumers exist only on process 0 / scope 0. Process 0
+        # reads them from its own superset consumer lists above (for every
+        # local replica); remote processes route from the broadcast topology.
+        if self.process_id != 0:
+            for cons_idx, port in self.extra_consumers.get(producer.index, ()):
+                self._push_remote(
+                    0, "push", cons_idx, port, 0, list(out.entries), consolidated
+                )
+
+    def _route_part(
+        self,
+        cons_idx: int,
+        port: int,
+        consumer: Node,
+        out: DeltaBatch,
+        consolidated: bool,
+    ) -> None:
+        if cons_idx >= self.n_shared:
+            # process-0-only sink chain: pinned there whole
+            if self.process_id == 0:
+                self._local_push(0, cons_idx, port, list(out.entries), consolidated)
+            else:
+                self._push_remote(0, "push", cons_idx, port, 0, list(out.entries), consolidated)
+            return
+        fn = self._partition_fn(consumer, port)
+        if fn is None:
+            # globally-stateful operator: worker 0 (= process 0, scope 0)
+            if self.process_id == 0:
+                self._local_push(0, cons_idx, port, list(out.entries), consolidated)
+            else:
+                self._push_remote(0, "push", cons_idx, port, 0, list(out.entries), consolidated)
+            return
+        parts: list[list] = [[] for _ in range(self.n_workers)]
+        for key, row, diff in out:
+            parts[fn(key, row)].append((key, row, diff))
+        for worker, entries in enumerate(parts):
+            if not entries:
+                continue
+            process, scope_idx = self._owner(worker)
+            if process == self.process_id:
+                self._local_push(scope_idx, cons_idx, port, entries, consolidated)
+            else:
+                self._push_remote(
+                    process, "push", cons_idx, port, worker, entries, consolidated
+                )
+
+    def _apply_remote(self, deliveries: list[tuple]) -> bool:
+        got = False
+        for kind, index, port_or_worker, worker, entries, consolidated in deliveries:
+            got = True
+            _process, scope_idx = self._owner(worker)
+            if kind == "state":
+                apply_batch_to_state(
+                    self.scopes[scope_idx].nodes[index].current,
+                    DeltaBatch(entries),
+                )
+            else:
+                self._local_push(
+                    scope_idx, index, port_or_worker, entries, consolidated
+                )
+        return got
+
+    # -- commit ------------------------------------------------------------
+
+    def _drain_local(self, time: int) -> bool:
+        """Process local pending work to quiescence (including same-time
+        error-log feedback); remote parts accumulate in the outbox.
+        Returns True if anything was processed."""
+        busy = False
+        while True:
+            did = False
+            for scope in self.scopes:
+                for node in scope.nodes:
+                    if not node.has_pending():
+                        continue
+                    did = True
+                    out = node.process(time)
+                    if out is None:
+                        out = DeltaBatch()
+                    out = out.consolidate() if out else out
+                    apply_batch_to_state(node.current, out)
+                    if out:
+                        self._deliver(node, out)
+            if did:
+                busy = True
+                continue
+            flushed = False
+            for scope in self.scopes:
+                for node in scope.nodes:
+                    if isinstance(node, ErrorLogNode):
+                        batch = node.flush_buffer()
+                        if batch:
+                            node.push(0, batch)
+                            flushed = True
+            if not flushed:
+                return busy
+            busy = True
+
+    def _flush_sources(self) -> None:
+        """Coordinator: flush static sources + input sessions of the
+        primary replica; maintain the sharded source-state invariant
+        (sharded.py _route_source) and route downstream parts."""
+        scope0 = self.scopes[0]
+        for node in scope0.nodes:
+            if isinstance(node, StaticSource):
+                batch = node.initial_batch()
+            elif isinstance(node, InputSession):
+                batch = node.flush()
+            else:
+                continue
+            if not batch:
+                continue
+            # full state on the primary replica
+            apply_batch_to_state(node.current, batch)
+            # key-shard parts maintain replica state on workers > 0
+            if self.n_workers > 1:
+                parts: list[list] = [[] for _ in range(self.n_workers)]
+                for key, row, diff in batch:
+                    parts[_shard_of(key, self.n_workers)].append((key, row, diff))
+                for worker in range(1, self.n_workers):
+                    if not parts[worker]:
+                        continue
+                    process, scope_idx = self._owner(worker)
+                    if process == self.process_id:
+                        apply_batch_to_state(
+                            self.scopes[scope_idx].nodes[node.index].current,
+                            DeltaBatch(parts[worker]),
+                        )
+                    else:
+                        self._push_remote(
+                            process, "state", node.index, 0, worker,
+                            parts[worker], batch._consolidated,
+                        )
+            self._deliver(node, batch)
+
+    def _mark_replica_sources(self) -> None:
+        """Non-primary replicas never emit static rows themselves
+        (sharded.py: `if w != 0: node._emitted = True`)."""
+        for scope_idx, scope in enumerate(self.scopes):
+            if self.process_id == 0 and scope_idx == 0:
+                continue
+            for node in scope.nodes:
+                if isinstance(node, StaticSource):
+                    node._emitted = True
+
+    def _exchange_rounds(self, time: int, notify_time_end: bool = True) -> bool:
+        transport = self.transport
+        peers = sorted(self._outbox)
+        round_no = 0
+        any_work = False
+        while True:
+            busy = self._drain_local(time)
+            my_bit = busy or any(self._outbox.values())
+            for peer in peers:
+                transport.send(
+                    peer, ("round", time, round_no, my_bit, self._outbox[peer])
+                )
+                self._outbox[peer] = []
+            global_busy = my_bit
+            for peer in peers:
+                frame = transport.recv(peer)
+                kind, f_time, f_round, bit, deliveries = frame
+                if kind != "round" or f_time != time or f_round != round_no:
+                    raise RuntimeError(
+                        f"process {self.process_id}: protocol desync with "
+                        f"peer {peer}: got {frame[:3]}, expected round "
+                        f"({time}, {round_no})"
+                    )
+                self._apply_remote(deliveries)
+                global_busy = global_busy or bit
+            round_no += 1
+            any_work = any_work or global_busy
+            if not global_busy:
+                break
+        if notify_time_end or any_work:
+            for scope in self.scopes:
+                for node in scope.nodes:
+                    node.on_time_end(time)
+        return any_work
+
+    def commit_local(self) -> int:
+        """One commit: coordinator flushes sources, then all processes run
+        exchange rounds to global quiescence."""
+        self._mark_replica_sources()
+        if self.process_id == 0:
+            self._flush_sources()
+        time = self.time
+        self._exchange_rounds(time)
+        self.time += 1
+        return time
+
+    def finish_local(self) -> None:
+        """Final commit + on_end hooks + one settling commit
+        (ShardedScheduler.finish)."""
+        self.commit_local()
+        for scope in self.scopes:
+            for node in scope.nodes:
+                node.on_end()
+        # on_end may inject final batches (buffer flush) on any process;
+        # sinks tear down in close() only after the settlement delivers them
+        self._exchange_rounds(self.time, notify_time_end=False)
+        self.time += 1
+        for scope in self.scopes:
+            for node in scope.nodes:
+                node.close()
+
+    # -- monitoring surface parity ----------------------------------------
+
+    @property
+    def scope(self) -> Scope:
+        return self.scopes[0]
+
+    def merged_state(self, index: int) -> dict[Pointer, tuple]:
+        """Union of one operator's state across LOCAL replicas (cross-
+        process captures are not collected; outputs flow through sinks)."""
+        out: dict[Pointer, tuple] = {}
+        for scope in self.scopes:
+            out.update(scope.nodes[index].current)
+        return out

@@ -78,6 +78,12 @@ class Node:
     def on_end(self) -> None:
         pass
 
+    def close(self) -> None:
+        """Final resource teardown, after the post-``on_end`` settlement
+        commit — ``on_end`` may inject final batches (temporal buffer
+        flush) that still have to reach sinks, so sinks must not close
+        inside ``on_end`` itself."""
+
     def report(self, key: Pointer | None, message: str) -> None:
         self.scope.report_error(self, key, message)
 
@@ -1180,7 +1186,10 @@ class SubscribeNode(Node):
         if self._on_time_end is not None:
             self._on_time_end(time)
 
-    def on_end(self) -> None:
+    def close(self) -> None:
+        # the user's on_end ("stream finished") fires here — after the
+        # settlement commit — so buffer-flush rows injected by upstream
+        # on_end hooks were already delivered through on_change
         if self._on_end is not None:
             self._on_end()
 
@@ -1516,12 +1525,14 @@ class Scheduler:
 
     def _end_nodes(self) -> None:
         """Run on_end hooks; they may inject final batches (buffer flush) —
-        propagate those as one more commit."""
+        propagate those as one more commit, then tear sinks down."""
         for node in self.scope.nodes:
             node.on_end()
         if any(n.has_pending() for n in self.scope.nodes):
             self.propagate(self.time)
             self.time += 1
+        for node in self.scope.nodes:
+            node.close()
 
     def run_static(self) -> None:
         """Batch mode: all static sources at time 0, one commit, then end."""

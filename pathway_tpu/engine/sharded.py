@@ -303,6 +303,9 @@ class ShardedScheduler:
         ):
             self.propagate(self.time)
             self.time += 1
+        for scope in self.scopes:
+            for node in scope.nodes:
+                node.close()
 
     # -- results --------------------------------------------------------------
 

@@ -54,6 +54,9 @@ class PathwayConfig:
     processes: int = field(
         default_factory=lambda: _env_int("PATHWAY_PROCESSES", 1)
     )
+    first_port: int = field(
+        default_factory=lambda: _env_int("PATHWAY_FIRST_PORT", 10000)
+    )
 
     @property
     def replay_config(self) -> Any:

@@ -66,14 +66,38 @@ def run(
     serves Prometheus metrics on port 20000 + PATHWAY_PROCESS_ID
     (reference monitoring.py:56-228, http_server.rs:22)."""
     from pathway_tpu.internals.config import get_pathway_config
-    from pathway_tpu.internals.runner import GraphRunner, ShardedGraphRunner
+    from pathway_tpu.internals.runner import (
+        DistributedGraphRunner,
+        GraphRunner,
+        ShardedGraphRunner,
+    )
 
+    config = get_pathway_config()
     if persistence_config is None:
         # env-driven persistence (PATHWAY_PERSISTENT_STORAGE etc.,
         # reference PathwayConfig.replay_config)
-        persistence_config = get_pathway_config().replay_config
-    threads = kwargs.get("threads") or get_pathway_config().threads
-    if threads > 1:
+        persistence_config = config.replay_config
+    threads = kwargs.get("threads") or config.threads
+    processes = kwargs.get("processes") or config.processes
+    if processes > 1:
+        # multi-process: identical program per process, key-sharded TCP
+        # exchange (engine/distributed.py; reference `pathway spawn`
+        # cluster topology, config.rs:72-86)
+        runner: Any = DistributedGraphRunner(
+            threads,
+            processes,
+            int(config.process_id),
+            first_port=config.first_port,
+            persistence_config=persistence_config,
+        )
+        if int(config.process_id) != 0:
+            # live dashboards belong to process 0 only (the Prometheus
+            # endpoint stays per-process: port 20000 + process_id, as in
+            # the reference http_server.rs:22)
+            from pathway_tpu.internals.monitoring import MonitoringLevel
+
+            monitoring_level = MonitoringLevel.NONE
+    elif threads > 1:
         # multi-worker: identical graph per worker, key-sharded exchange
         # (engine/sharded.py; reference PATHWAY_THREADS)
         runner: Any = ShardedGraphRunner(
@@ -116,7 +140,7 @@ def run(
 
     try:
         with run_span():
-            if isinstance(runner, ShardedGraphRunner):
+            if isinstance(runner, (ShardedGraphRunner, DistributedGraphRunner)):
                 runner.attach_sinks()
                 runner.run()
             else:

@@ -50,6 +50,50 @@ _CAST_NAMES = {
 from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 
 
+def _pump_drivers(w0: "GraphRunner", drivers: list, on_data, on_idle=None) -> None:
+    """The one streaming poll loop (GraphRunner / ShardedGraphRunner /
+    DistributedGraphRunner all drive it): poll every connector driver,
+    call ``on_data()`` (which commits) whenever any driver produced rows or
+    finished, drain passive loopback sources (AsyncTransformer) once no
+    live driver can still feed them, and back off exponentially when idle
+    (``on_idle`` hooks extra idle work, e.g. coordinator pings)."""
+    import time as _time
+
+    live = list(drivers)
+    idle_spins = 0
+    while live:
+        produced = False
+        for d in list(live):
+            status = d.poll()
+            if status == "done":
+                live.remove(d)
+                produced = True
+            elif status == "data":
+                produced = True
+        if produced:
+            on_data()
+            idle_spins = 0
+            continue
+        notified = False
+        if live and all(
+            getattr(d, "upstream_done", None) is not None for d in live
+        ):
+            for d in live:
+                if getattr(d, "_upstream_notified", False):
+                    continue
+                if w0._loopback_upstream_live(d, live):
+                    continue
+                d._upstream_notified = True
+                d.upstream_done()
+                notified = True
+                break
+        if not notified:
+            idle_spins += 1
+            _time.sleep(min(0.001 * idle_spins, 0.05))
+            if on_idle is not None:
+                on_idle()
+
+
 class GraphRunner:
     def __init__(
         self,
@@ -268,7 +312,12 @@ class GraphRunner:
         if kind == "input":
             # connector-backed table: the io layer supplies an attach function
             attach = spec.params["attach"]
-            node, driver = attach(scope)
+            import inspect
+
+            if "make_driver" in inspect.signature(attach).parameters:
+                node, driver = attach(scope, make_driver=self.attach_drivers)
+            else:  # custom attach without the kwarg: discard after the fact
+                node, driver = attach(scope)
             if driver is not None and not self.attach_drivers:
                 driver = None  # replica scopes never poll; worker 0 reads
             if driver is not None:
@@ -888,49 +937,18 @@ class GraphRunner:
                     node.push(0, batch)
         sched.propagate(sched.time)
         sched.time += 1
-        drivers = list(self.drivers)
-        idle_spins = 0
-        while drivers:
-            produced = False
-            for driver in list(drivers):
-                status = driver.poll()
-                if status == "done":
-                    drivers.remove(driver)
-                    produced = True
-                elif status == "data":
-                    produced = True
-            if produced:
-                commit_started = _time.monotonic()
-                time = sched.commit()
-                for driver in persistent:
-                    driver.on_commit(time)
-                if snapshot_mgr is not None:
-                    snapshot_mgr.on_commit(self.scope, self.drivers, time)
-                if self.monitor is not None:
-                    self._sync_monitor_connectors()
-                    self.monitor.on_commit(time, commit_started)
-                idle_spins = 0
-            else:
-                # only passive loopback sources left (AsyncTransformer):
-                # notify one whose subscribed upstream no live driver can
-                # still feed, so chained loopbacks drain upstream-first
-                notified = False
-                if drivers and all(
-                    getattr(d, "upstream_done", None) is not None
-                    for d in drivers
-                ):
-                    for d in drivers:
-                        if getattr(d, "_upstream_notified", False):
-                            continue
-                        if self._loopback_upstream_live(d, drivers):
-                            continue
-                        d._upstream_notified = True
-                        d.upstream_done()
-                        notified = True
-                        break
-                if not notified:
-                    idle_spins += 1
-                    _time.sleep(min(0.001 * idle_spins, 0.05))
+        def on_data() -> None:
+            commit_started = _time.monotonic()
+            time = sched.commit()
+            for driver in persistent:
+                driver.on_commit(time)
+            if snapshot_mgr is not None:
+                snapshot_mgr.on_commit(self.scope, self.drivers, time)
+            if self.monitor is not None:
+                self._sync_monitor_connectors()
+                self.monitor.on_commit(time, commit_started)
+
+        _pump_drivers(self, self.drivers, on_data)
         sched.finish()
         for driver in persistent:
             driver.on_commit(sched.time)
@@ -1065,47 +1083,18 @@ class ShardedGraphRunner:
             # aggregated cross-worker operator stats (ShardedScheduler.stats)
             self.monitor.scheduler = sched
         sched.commit()
-        idle_spins = 0
-        live = list(drivers)
-        while live:
-            produced = False
-            for d in list(live):
-                status = d.poll()
-                if status == "done":
-                    live.remove(d)
-                    produced = True
-                elif status == "data":
-                    produced = True
-            if produced:
-                started = _time.monotonic()
-                time = sched.commit()
-                for d in persistent:
-                    d.on_commit(time)
-                if self.monitor is not None:
-                    w0.monitor = self.monitor
-                    w0._sync_monitor_connectors()
-                    self.monitor.on_commit(time, started)
-                idle_spins = 0
-            else:
-                # passive loopback sources (AsyncTransformer) wait for
-                # their upstream to finish — same drain as GraphRunner.run
-                notified = False
-                if live and all(
-                    getattr(d, "upstream_done", None) is not None
-                    for d in live
-                ):
-                    for d in live:
-                        if getattr(d, "_upstream_notified", False):
-                            continue
-                        if w0._loopback_upstream_live(d, live):
-                            continue
-                        d._upstream_notified = True
-                        d.upstream_done()
-                        notified = True
-                        break
-                if not notified:
-                    idle_spins += 1
-                    _time.sleep(min(0.001 * idle_spins, 0.05))
+
+        def on_data() -> None:
+            started = _time.monotonic()
+            time = sched.commit()
+            for d in persistent:
+                d.on_commit(time)
+            if self.monitor is not None:
+                w0.monitor = self.monitor
+                w0._sync_monitor_connectors()
+                self.monitor.on_commit(time, started)
+
+        _pump_drivers(w0, drivers, on_data)
         sched.finish()
         for d in persistent:
             d.on_commit(sched.time)
@@ -1137,15 +1126,180 @@ class ShardedGraphRunner:
         """Attach ALL registered sinks on worker 0 (pw.run path). All sink
         tables build FIRST so SubscribeNodes land after every shared node
         and worker replicas stay index-aligned."""
-        from pathway_tpu.internals import parse_graph
+        _attach_sinks_on_primary(self.workers, attach=True)
 
-        sinks = list(parse_graph.G.sinks)
-        nodes = [self.workers[0].build(s.table) for s in sinks]
-        for w in self.workers[1:]:
-            for s in sinks:
-                w.build(s.table)
+
+def _attach_sinks_on_primary(workers: list, attach: bool) -> int:
+    """Build every registered sink table on every worker replica (index
+    alignment), then attach the actual sink drivers on worker 0's scope
+    (single-threaded sinks, reference data_storage.rs:611) — or skip the
+    attachment entirely (follower processes). Returns the shared graph
+    length: nodes past it exist only on the attaching scope."""
+    from pathway_tpu.internals import parse_graph
+
+    sinks = list(parse_graph.G.sinks)
+    nodes = [workers[0].build(s.table) for s in sinks]
+    for w in workers[1:]:
+        for s in sinks:
+            w.build(s.table)
+    n_shared = len(workers[0].scope.nodes)
+    if attach:
         for sink, node in zip(sinks, nodes):
-            driver = sink.attach(self.workers[0].scope, node)
+            driver = sink.attach(workers[0].scope, node)
             if driver is not None:
-                self.workers[0].drivers.append(driver)
-        parse_graph.G.sinks = []
+                workers[0].drivers.append(driver)
+    parse_graph.G.sinks = []
+    return n_shared
+
+
+class DistributedGraphRunner:
+    """Multi-process execution: the same program running in PATHWAY_PROCESSES
+    processes, exchanging key-sharded batches over the TCP mesh
+    (engine/distributed.py; reference CommunicationConfig::Cluster,
+    config.rs:72-86, launched by `pathway spawn`, cli.py:93-107).
+
+    Every process hosts ``threads`` local worker replicas; total workers =
+    threads x processes. Process 0 is the coordinator: connector drivers
+    poll there, sinks attach there, and it broadcasts commit/finish
+    commands to the followers.
+    """
+
+    def __init__(
+        self,
+        threads: int,
+        processes: int,
+        process_id: int,
+        first_port: int = 10000,
+        persistence_config: Any = None,
+    ) -> None:
+        if processes < 2:
+            raise ValueError("DistributedGraphRunner needs processes >= 2")
+        if not 0 <= process_id < processes:
+            raise ValueError(
+                f"PATHWAY_PROCESS_ID={process_id} out of range for "
+                f"{processes} processes"
+            )
+        from pathway_tpu.internals.license import check_worker_count
+
+        check_worker_count(threads * processes)
+        from pathway_tpu.persistence import PersistenceMode
+
+        if (
+            persistence_config is not None
+            and getattr(persistence_config, "persistence_mode", None)
+            == PersistenceMode.OPERATOR_PERSISTING
+        ):
+            raise NotImplementedError(
+                "operator snapshots are single-process for now; use "
+                "input-journal persistence (PersistenceMode.PERSISTING) "
+                "with processes>1"
+            )
+        self.threads = threads
+        self.processes = processes
+        self.process_id = process_id
+        self.first_port = first_port
+        primary = process_id == 0
+        self.workers = [
+            GraphRunner(
+                persistence_config=persistence_config if primary else None,
+                attach_drivers=primary and i == 0,
+            )
+            for i in range(threads)
+        ]
+        self.monitor: Any = None
+
+    def build(self, table: "Table") -> list[Node]:
+        return [w.build(table) for w in self.workers]
+
+    def attach_sinks(self) -> None:
+        """Build every sink table on every local replica (index alignment
+        across processes); attach actual sink drivers on process 0 only."""
+        self.n_shared = _attach_sinks_on_primary(
+            self.workers, attach=self.process_id == 0
+        )
+
+    def run(self):
+        from pathway_tpu.engine.distributed import (
+            DistributedScheduler,
+            MeshTransport,
+        )
+
+        transport = MeshTransport(
+            self.process_id, self.processes, self.first_port
+        )
+        try:
+            sched = DistributedScheduler(
+                [w.scope for w in self.workers],
+                self.process_id,
+                self.processes,
+                transport,
+                n_shared=getattr(self, "n_shared", None),
+            )
+            if self.monitor is not None:
+                self.monitor.scheduler = sched
+            if self.process_id == 0:
+                sched.announce_topology()
+                self._coordinate(sched, transport)
+            else:
+                sched.receive_topology()
+                self._follow(sched, transport)
+            return sched
+        finally:
+            transport.close()
+
+    def _coordinate(self, sched, transport) -> None:
+        import time as _time
+
+        w0 = self.workers[0]
+        drivers = list(w0.drivers)
+        persistent = [d for d in drivers if hasattr(d, "replay")]
+        for d in persistent:
+            d.replay()
+        transport.broadcast(("cmd", "commit"))
+        sched.commit_local()
+        last_sign_of_life = _time.monotonic()
+
+        def on_data() -> None:
+            nonlocal last_sign_of_life
+            started = _time.monotonic()
+            transport.broadcast(("cmd", "commit"))
+            time = sched.commit_local()
+            for d in persistent:
+                d.on_commit(time)
+            if self.monitor is not None:
+                w0.monitor = self.monitor
+                w0._sync_monitor_connectors()
+                self.monitor.on_commit(time, started)
+            last_sign_of_life = started
+
+        def on_idle() -> None:
+            # keep follower recv timeouts from tripping during long quiet
+            # stretches of a streaming run
+            nonlocal last_sign_of_life
+            if _time.monotonic() - last_sign_of_life > 30.0:
+                transport.broadcast(("cmd", "ping"))
+                last_sign_of_life = _time.monotonic()
+
+        _pump_drivers(w0, drivers, on_data, on_idle)
+        transport.broadcast(("cmd", "finish"))
+        sched.finish_local()
+        for d in persistent:
+            d.on_commit(sched.time)
+
+    def _follow(self, sched, transport) -> None:
+        while True:
+            kind, cmd = transport.recv(0)
+            if kind != "cmd":
+                raise RuntimeError(
+                    f"process {self.process_id}: expected a coordinator "
+                    f"command, got {kind!r}"
+                )
+            if cmd == "ping":
+                continue
+            if cmd == "commit":
+                sched.commit_local()
+            elif cmd == "finish":
+                sched.finish_local()
+                return
+            else:
+                raise RuntimeError(f"unknown coordinator command {cmd!r}")

@@ -40,7 +40,11 @@ class ThisMetaclass:
         self._side = side
 
     def __getattr__(self, name: str) -> ThisColumnReference:
-        if name.startswith("_"):
+        # engine-provided columns (_pw_window_start, _pw_instance, ...) are
+        # addressable by attribute, like the reference (_window.py usage);
+        # other underscore names stay AttributeError so copy/pickle probes
+        # of the sentinel don't manufacture ghost columns
+        if name.startswith("_") and not name.startswith("_pw_"):
             raise AttributeError(name)
         return ThisColumnReference(self, name)
 

@@ -62,12 +62,18 @@ def input_table(
     pk = schema.primary_key_columns()
     pk_indices = [column_names.index(p) for p in pk] if pk else None
 
-    def attach(scope: Scope):
+    def attach(scope: Scope, make_driver: bool = True):
         parser = make_parser(column_names)
         session = scope.input_session(
             len(all_names),
             upsert=getattr(parser, "session_type", "native") == "upsert",
         )
+        if not make_driver:
+            # replica scopes (sharded workers > 0, follower processes)
+            # need the session node for graph alignment but must NOT
+            # construct readers: a reader may start threads or consume
+            # from external services — only worker 0 reads
+            return session, None
         driver = InputDriver(
             session,
             make_reader(),
