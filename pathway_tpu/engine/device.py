@@ -32,6 +32,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from pathway_tpu.engine import expression as ex
+from pathway_tpu.native import kernels as _native
 
 # Batches smaller than this are cheaper to run through the per-row
 # interpreter than to columnarise.
@@ -41,14 +42,20 @@ _OK_KINDS = frozenset("bifU")
 
 
 class ColumnarView:
-    """Lazy column-major view over a batch's rows (insertions only)."""
+    """Lazy column-major view over a batch's rows (insertions only).
 
-    __slots__ = ("rows", "n", "_cols")
+    ``from_entries=True`` views ``(key, row, diff)`` entries directly —
+    saving the 1M-element row list comprehension on the hot paths."""
 
-    def __init__(self, rows: Sequence[tuple]) -> None:
+    __slots__ = ("rows", "n", "_cols", "_entries")
+
+    def __init__(
+        self, rows: Sequence[tuple], from_entries: bool = False
+    ) -> None:
         self.rows = rows
         self.n = len(rows)
         self._cols: dict[int, np.ndarray | None] = {}
+        self._entries = from_entries
 
     def column(self, index: int) -> np.ndarray | None:
         """The column as a NumPy array, or None if not cleanly columnar
@@ -56,7 +63,18 @@ class ColumnarView:
         got = self._cols.get(index, _MISSING)
         if got is not _MISSING:
             return got
-        arr = _extract([row[index] for row in self.rows])
+        arr = None
+        if _native is not None and isinstance(self.rows, list):
+            # one C pass for int64/float64/bool columns; returns None for
+            # strings and anything non-clean (falls through below)
+            arr = _native.extract_column(self.rows, index, self._entries)
+        if arr is None:
+            values = (
+                [e[1][index] for e in self.rows]
+                if self._entries
+                else [row[index] for row in self.rows]
+            )
+            arr = _extract(values)
         self._cols[index] = arr
         return arr
 
@@ -207,12 +225,14 @@ def eval_columnar(expr: ex.EngineExpression, view: ColumnarView) -> np.ndarray:
 
 
 def eval_expressions_columnar_cols(
-    expressions: Sequence[ex.EngineExpression], rows: Sequence[tuple]
+    expressions: Sequence[ex.EngineExpression],
+    rows: Sequence[tuple],
+    from_entries: bool = False,
 ) -> list[list] | None:
     """Vectorized ExpressionNode body: all expressions over all rows,
     returned column-major as plain Python lists (exact interpreter types).
     None signals fallback to the row interpreter."""
-    view = ColumnarView(rows)
+    view = ColumnarView(rows, from_entries=from_entries)
     outs = []
     for expr in expressions:
         try:

@@ -116,10 +116,21 @@ class MeshTransport:
                 self._send(peer, ("hello", process_id))
             for _ in range(process_id + 1, n_processes):  # accept higher ids
                 conn, _addr = listener.accept()
+                conn.settimeout(None)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                kind, peer = self._read_frame(conn)
-                assert kind == "hello"
-                self._socks[peer] = conn
+                frame = self._read_frame(conn)
+                if (
+                    not isinstance(frame, tuple)
+                    or len(frame) != 2
+                    or frame[0] != "hello"
+                    or not isinstance(frame[1], int)
+                    or not 0 <= frame[1] < n_processes
+                ):
+                    raise RuntimeError(
+                        f"process {process_id}: bad handshake on exchange "
+                        f"port: {frame!r}"
+                    )
+                self._socks[frame[1]] = conn
         finally:
             listener.close()
         for peer, sock in self._socks.items():
@@ -137,6 +148,10 @@ class MeshTransport:
         while True:
             try:
                 sock = socket.create_connection(addr, timeout=_CONNECT_DEADLINE)
+                # the connect timeout must not linger: receiver threads
+                # block in recv indefinitely between commits (quiet
+                # follower-follower links would otherwise fake-EOF at 60s)
+                sock.settimeout(None)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return sock
             except OSError:
@@ -298,8 +313,13 @@ class DistributedScheduler:
         )
 
     def receive_topology(self) -> None:
-        kind, n_shared, signature, extra = self.transport.recv(0)
-        assert kind == "topology", kind
+        frame = self.transport.recv(0)
+        if not isinstance(frame, tuple) or len(frame) != 4 or frame[0] != "topology":
+            raise RuntimeError(
+                f"process {self.process_id}: expected the coordinator's "
+                f"topology frame, got {frame!r}"
+            )
+        _kind, n_shared, signature, extra = frame
         if n_shared != self.n_shared or signature != self._shared_signature():
             raise RuntimeError(
                 "graph divergence: the program must build the identical "
@@ -334,17 +354,20 @@ class DistributedScheduler:
         worker: int,
         entries: list,
         consolidated: bool,
+        insert_only: bool = False,
     ) -> None:
         self._outbox[process].append(
-            (kind, index, port_or_worker, worker, entries, consolidated)
+            (kind, index, port_or_worker, worker, entries, consolidated,
+             insert_only)
         )
 
     def _local_push(
         self, scope_idx: int, consumer_index: int, port: int, entries: list,
-        consolidated: bool,
+        consolidated: bool, insert_only: bool = False,
     ) -> None:
         batch = DeltaBatch(entries)
         batch._consolidated = consolidated
+        batch._insert_only = insert_only
         self.scopes[scope_idx].nodes[consumer_index].push(port, batch)
 
     # -- exchange ----------------------------------------------------------
@@ -353,16 +376,16 @@ class DistributedScheduler:
         """Split ``out`` per consumer; push each part to the consumer's
         replica on the owning worker (local) or queue it for the owning
         process (remote)."""
-        consolidated = out._consolidated
         for consumer, port in self.scopes[0].nodes[producer.index].consumers:
-            self._route_part(consumer.index, port, consumer, out, consolidated)
+            self._route_part(consumer.index, port, consumer, out)
         # sink-side consumers exist only on process 0 / scope 0. Process 0
         # reads them from its own superset consumer lists above (for every
         # local replica); remote processes route from the broadcast topology.
         if self.process_id != 0:
             for cons_idx, port in self.extra_consumers.get(producer.index, ()):
                 self._push_remote(
-                    0, "push", cons_idx, port, 0, list(out.entries), consolidated
+                    0, "push", cons_idx, port, 0, out.entries,
+                    out._consolidated, out._insert_only,
                 )
 
     def _route_part(
@@ -371,23 +394,20 @@ class DistributedScheduler:
         port: int,
         consumer: Node,
         out: DeltaBatch,
-        consolidated: bool,
     ) -> None:
-        if cons_idx >= self.n_shared:
-            # process-0-only sink chain: pinned there whole
+        if cons_idx >= self.n_shared or self._partition_fn(consumer, port) is None:
+            # pinned whole to worker 0 (sink chain / globally-stateful op):
+            # push the batch object itself, no copy (ShardedScheduler does
+            # the same — consumers never mutate received batches)
             if self.process_id == 0:
-                self._local_push(0, cons_idx, port, list(out.entries), consolidated)
+                self.scopes[0].nodes[cons_idx].push(port, out)
             else:
-                self._push_remote(0, "push", cons_idx, port, 0, list(out.entries), consolidated)
+                self._push_remote(
+                    0, "push", cons_idx, port, 0, out.entries,
+                    out._consolidated, out._insert_only,
+                )
             return
         fn = self._partition_fn(consumer, port)
-        if fn is None:
-            # globally-stateful operator: worker 0 (= process 0, scope 0)
-            if self.process_id == 0:
-                self._local_push(0, cons_idx, port, list(out.entries), consolidated)
-            else:
-                self._push_remote(0, "push", cons_idx, port, 0, list(out.entries), consolidated)
-            return
         parts: list[list] = [[] for _ in range(self.n_workers)]
         for key, row, diff in out:
             parts[fn(key, row)].append((key, row, diff))
@@ -396,15 +416,22 @@ class DistributedScheduler:
                 continue
             process, scope_idx = self._owner(worker)
             if process == self.process_id:
-                self._local_push(scope_idx, cons_idx, port, entries, consolidated)
+                self._local_push(
+                    scope_idx, cons_idx, port, entries,
+                    out._consolidated, out._insert_only,
+                )
             else:
                 self._push_remote(
-                    process, "push", cons_idx, port, worker, entries, consolidated
+                    process, "push", cons_idx, port, worker, entries,
+                    out._consolidated, out._insert_only,
                 )
 
     def _apply_remote(self, deliveries: list[tuple]) -> bool:
         got = False
-        for kind, index, port_or_worker, worker, entries, consolidated in deliveries:
+        for (
+            kind, index, port_or_worker, worker, entries, consolidated,
+            insert_only,
+        ) in deliveries:
             got = True
             _process, scope_idx = self._owner(worker)
             if kind == "state":
@@ -414,7 +441,8 @@ class DistributedScheduler:
                 )
             else:
                 self._local_push(
-                    scope_idx, index, port_or_worker, entries, consolidated
+                    scope_idx, index, port_or_worker, entries, consolidated,
+                    insert_only,
                 )
         return got
 

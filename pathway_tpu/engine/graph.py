@@ -242,7 +242,7 @@ class ExpressionNode(Node):
             from pathway_tpu.native import kernels as _native
 
             cols = eval_expressions_columnar_cols(
-                self.expressions, [row for _k, row, _d in inserts]
+                self.expressions, inserts, from_entries=True
             )
             if cols is not None:
                 fresh = not out.entries
@@ -699,8 +699,7 @@ class GroupbyNode(Node):
         import numpy as np
 
         entries = batch.entries
-        rows = [row for _k, row, _d in entries]
-        view = device.ColumnarView(rows)
+        view = device.ColumnarView(entries, from_entries=True)
         by = view.column(self.by_cols[0])
         if by is None:
             return None
@@ -711,9 +710,14 @@ class GroupbyNode(Node):
                 if col is None or col.dtype.kind not in "bif":
                     return None  # non-numeric sums keep row-wise semantics
                 sum_arrays[ri] = col
-        diffs = np.fromiter(
-            (d for _k, _r, d in entries), np.int64, len(entries)
-        )
+        from pathway_tpu.native import kernels as _native
+
+        if _native is not None:
+            diffs = _native.entry_diffs(entries)
+        else:
+            diffs = np.fromiter(
+                (d for _k, _r, d in entries), np.int64, len(entries)
+            )
         if sum_arrays and len(entries):
             # int64 segment sums wrap silently while the row-wise path
             # computes exact Python ints; reject batches whose worst-case

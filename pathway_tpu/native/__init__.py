@@ -36,6 +36,8 @@ def _build() -> str | None:
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
         return so
     include = sysconfig.get_path("include")
+    import numpy as np
+
     cmd = [
         "g++",
         "-O3",
@@ -43,6 +45,7 @@ def _build() -> str | None:
         "-shared",
         "-fPIC",
         f"-I{include}",
+        f"-I{np.get_include()}",
         _SRC,
         "-o",
         so + ".tmp",

@@ -16,7 +16,116 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
 namespace {
+
+/* -- columnar extraction -----------------------------------------------------
+ *
+ * One C pass replaces the Python `[row[c] for row in rows]` + np.asarray
+ * dance of engine/device.py::_extract. Exact-type discipline matches the
+ * Python path: only genuine int/float/bool cells columnarise (subclasses —
+ * Pointer(int) keys, np scalars — and str/None/ERROR fall back), so the
+ * row interpreter keeps ownership of every edge case.
+ */
+
+enum ColKind { K_UNSET = 0, K_INT, K_FLOAT, K_BOOL, K_FAIL };
+
+/* Extract rows[i][col] (item_idx < 0) or entries[i][1][col] into a fresh
+ * typed ndarray; NULL+no-error means "not cleanly columnar". */
+PyObject *extract_col_core(PyObject *seq, Py_ssize_t col, int from_entries) {
+  Py_ssize_t n = PyList_GET_SIZE(seq);
+  if (n == 0) return nullptr; /* empty: Python path decides */
+  ColKind kind = K_UNSET;
+  /* first pass: decide the dtype from the first cell, verify the rest */
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PyList_GET_ITEM(seq, i);
+    if (from_entries && (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3))
+      return nullptr;
+    PyObject *row = from_entries ? PyTuple_GET_ITEM(item, 1) : item;
+    if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) <= col) return nullptr;
+    PyObject *v = PyTuple_GET_ITEM(row, col);
+    PyTypeObject *t = Py_TYPE(v);
+    ColKind k = t == &PyLong_Type    ? K_INT
+                : t == &PyFloat_Type ? K_FLOAT
+                : t == &PyBool_Type  ? K_BOOL
+                                     : K_FAIL;
+    if (k == K_FAIL) return nullptr;
+    if (kind == K_UNSET)
+      kind = k;
+    else if (kind != k)
+      return nullptr; /* mixed dtypes: exact semantics live row-wise */
+  }
+  npy_intp dims[1] = {n};
+  int typenum = kind == K_INT ? NPY_INT64 : kind == K_FLOAT ? NPY_FLOAT64 : NPY_BOOL;
+  PyObject *arr = PyArray_SimpleNew(1, dims, typenum);
+  if (!arr) return nullptr; /* with error set */
+  char *data = PyArray_BYTES((PyArrayObject *)arr);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PyList_GET_ITEM(seq, i);
+    PyObject *row = from_entries ? PyTuple_GET_ITEM(item, 1) : item;
+    PyObject *v = PyTuple_GET_ITEM(row, col);
+    if (kind == K_INT) {
+      int overflow = 0;
+      long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+      if (overflow || (x == -1 && PyErr_Occurred())) {
+        PyErr_Clear();
+        Py_DECREF(arr);
+        return nullptr; /* bigint: row path computes exact Python ints */
+      }
+      ((npy_int64 *)data)[i] = (npy_int64)x;
+    } else if (kind == K_FLOAT) {
+      ((npy_double *)data)[i] = PyFloat_AS_DOUBLE(v);
+    } else {
+      ((npy_bool *)data)[i] = (v == Py_True);
+    }
+  }
+  return arr;
+}
+
+/* extract_column(seq, col, from_entries) -> ndarray | None
+ * seq is a list of row tuples (from_entries=0) or (key,row,diff) entries
+ * (from_entries=1). */
+PyObject *extract_column(PyObject *, PyObject *args) {
+  PyObject *rows;
+  Py_ssize_t col;
+  int from_entries;
+  if (!PyArg_ParseTuple(args, "O!np", &PyList_Type, &rows, &col, &from_entries))
+    return nullptr;
+  PyObject *arr = extract_col_core(rows, col, from_entries);
+  if (!arr) {
+    if (PyErr_Occurred()) return nullptr;
+    Py_RETURN_NONE;
+  }
+  return arr;
+}
+
+/* entry_diffs(entries) -> int64 ndarray of each entry's diff. */
+PyObject *entry_diffs(PyObject *, PyObject *args) {
+  PyObject *entries;
+  if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &entries)) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  npy_intp dims[1] = {n};
+  PyObject *diffs = PyArray_SimpleNew(1, dims, NPY_INT64);
+  if (!diffs) return nullptr;
+  npy_int64 *ddata = (npy_int64 *)PyArray_BYTES((PyArrayObject *)diffs);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 3) {
+      PyErr_SetString(PyExc_ValueError, "malformed entry");
+      Py_DECREF(diffs);
+      return nullptr;
+    }
+    long long d = PyLong_AsLongLong(PyTuple_GET_ITEM(e, 2));
+    if (d == -1 && PyErr_Occurred()) {
+      Py_DECREF(diffs);
+      return nullptr;
+    }
+    ddata[i] = (npy_int64)d;
+  }
+  return diffs;
+}
 
 /* consolidate(entries) -> (new_entries | None, insert_only)
  *
@@ -295,6 +404,10 @@ PyMethodDef methods[] = {
      "build_entries(entries, columns) -> entries"},
     {"filter_truthy", filter_truthy, METH_VARARGS,
      "filter_truthy(entries, col) -> entries|None"},
+    {"extract_column", extract_column, METH_VARARGS,
+     "extract_column(seq, col, from_entries) -> ndarray|None"},
+    {"entry_diffs", entry_diffs, METH_VARARGS,
+     "entry_diffs(entries) -> int64 ndarray"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
@@ -309,4 +422,7 @@ PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
 
 }  // namespace
 
-PyMODINIT_FUNC PyInit__enginecore(void) { return PyModule_Create(&moduledef); }
+PyMODINIT_FUNC PyInit__enginecore(void) {
+  import_array();
+  return PyModule_Create(&moduledef);
+}

@@ -356,3 +356,69 @@ class TestLazyDeviceVectors:
         monkeypatch.setenv("PATHWAY_DEVICE_RESIDENT_UDF", "1")
         via_env = TpuEncoderEmbedder("minilm_l6", max_len=16)
         assert via_env.device_resident
+
+
+class TestNativeExtraction:
+    """The C extraction kernels (native/enginecore.cpp extract_column /
+    entry_columns) must enforce the same exact-type discipline as the
+    Python _extract path — subclasses, bigints and mixed dtypes fall back."""
+
+    def setup_method(self):
+        from pathway_tpu.native import kernels
+
+        if kernels is None:
+            import pytest
+
+            pytest.skip("native kernels unavailable")
+        self.k = kernels
+
+    def test_typed_columns(self):
+        import numpy as np
+
+        rows = [(1, 2.5, True, "a"), (3, 4.5, False, "b")]
+        ints = self.k.extract_column(rows, 0, False)
+        floats = self.k.extract_column(rows, 1, False)
+        bools = self.k.extract_column(rows, 2, False)
+        assert ints.dtype == np.int64 and ints.tolist() == [1, 3]
+        assert floats.dtype == np.float64 and floats.tolist() == [2.5, 4.5]
+        assert bools.dtype == np.bool_ and bools.tolist() == [True, False]
+        # strings are left to the Python path
+        assert self.k.extract_column(rows, 3, False) is None
+
+    def test_exact_type_discipline(self):
+        from pathway_tpu.engine.value import ref_scalar
+
+        # Pointer subclasses int: must NOT columnarise (keys hash/print
+        # differently than their integer value suggests)
+        rows = [(ref_scalar(1),), (ref_scalar(2),)]
+        assert self.k.extract_column(rows, 0, False) is None
+        # bool/int mixing would silently promote
+        assert self.k.extract_column([(1,), (True,)], 0, False) is None
+        # int/float mixing
+        assert self.k.extract_column([(1,), (2.0,)], 0, False) is None
+        # bigints overflow int64: exact Python arithmetic owns them
+        assert self.k.extract_column([(1 << 70,), (2,)], 0, False) is None
+        # None cells
+        assert self.k.extract_column([(1,), (None,)], 0, False) is None
+
+    def test_entry_mode_and_diffs(self):
+        import numpy as np
+
+        entries = [(100, (7, "x"), 1), (101, (8, "y"), -1), (102, (9, "z"), 2)]
+        diffs = self.k.entry_diffs(entries)
+        assert diffs.dtype == np.int64 and diffs.tolist() == [1, -1, 2]
+        via_flag = self.k.extract_column(entries, 0, True)
+        assert via_flag.tolist() == [7, 8, 9]
+        assert self.k.extract_column(entries, 1, True) is None  # strings
+
+    def test_columnar_view_uses_native_and_matches_python(self):
+        import numpy as np
+
+        from pathway_tpu.engine import device
+
+        entries = [(i, (i % 5, float(i), f"s{i}"), 1) for i in range(1000)]
+        view = device.ColumnarView(entries, from_entries=True)
+        assert view.column(0).tolist() == [i % 5 for i in range(1000)]
+        assert view.column(1).dtype == np.float64
+        s = view.column(2)  # Python fallback path handles strings
+        assert s is not None and s[3] == "s3"
