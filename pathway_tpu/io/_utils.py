@@ -136,3 +136,40 @@ def require(module_names: str, feature: str, injected: Any = None) -> Any:
             f"not installed; pass an explicit client/transport object to run "
             f"without it"
         ) from e
+
+
+def lake_parquet_events(
+    path: str,
+    column_names: Sequence[str],
+    key_indices: Sequence[int] | None,
+    lake_kind: str,
+):
+    """Shared data-lake read leg (Delta + Iceberg): one parquet data file ->
+    ParsedEvents. Files written by a pathway writer carry time/diff columns;
+    diff=-1 rows become retractions, which need primary-key columns to find
+    the row they cancel."""
+    import pyarrow.parquet as pq
+
+    from pathway_tpu.engine.connectors import DELETE, INSERT, ParsedEvent
+
+    table = pq.read_table(path)
+    data = {c: table.column(c).to_pylist() for c in table.column_names}
+    n = table.num_rows
+    absent = [None] * n
+    events = []
+    for i in range(n):
+        values = tuple(data.get(name, absent)[i] for name in column_names)
+        diff = data["diff"][i] if "diff" in data else 1
+        key = (
+            tuple(values[j] for j in key_indices) if key_indices else None
+        )
+        if diff < 0 and key is None:
+            raise ValueError(
+                f"{lake_kind} table contains retractions (diff=-1); declare "
+                "primary_key columns in the read schema so they key the "
+                "update stream"
+            )
+        events.append(
+            ParsedEvent(INSERT if diff >= 0 else DELETE, values, key=key)
+        )
+    return events

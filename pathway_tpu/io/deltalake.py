@@ -171,37 +171,14 @@ class DeltaReader(Reader):
         self._done_static = False
 
     def _events_of_file(self, fname: str):
-        import pyarrow.parquet as pq
+        from pathway_tpu.io._utils import lake_parquet_events
 
-        from pathway_tpu.engine.connectors import DELETE, INSERT, ParsedEvent
-
-        table = pq.read_table(os.path.join(self.table_path, fname))
-        cols = table.column_names
-        data = {c: table.column(c).to_pylist() for c in cols}
-        n = table.num_rows
-        events = []
-        for i in range(n):
-            values = tuple(
-                data.get(name, [None] * n)[i] for name in self.column_names
-            )
-            diff = data["diff"][i] if "diff" in data else 1
-            key = (
-                tuple(values[j] for j in self.key_indices)
-                if self.key_indices
-                else None
-            )
-            if diff < 0 and key is None:
-                # without a row identity a retraction can't find the row it
-                # cancels (InputDriver keys unkeyed rows by arrival sequence)
-                raise ValueError(
-                    "delta table contains retractions (diff=-1); declare "
-                    "primary_key columns in the read schema so they key the "
-                    "update stream"
-                )
-            events.append(
-                ParsedEvent(INSERT if diff >= 0 else DELETE, values, key=key)
-            )
-        return events
+        return lake_parquet_events(
+            os.path.join(self.table_path, fname),
+            self.column_names,
+            self.key_indices,
+            "delta",
+        )
 
     def poll(self) -> tuple[list[tuple[Any, str, dict]], bool]:
         if self._done_static:
