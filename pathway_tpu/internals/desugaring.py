@@ -108,3 +108,25 @@ def resolve_join_sides(
         return None
 
     return substitute(expression, replace)
+
+
+def resolve_side(expression: Any, table: "Table", side: str) -> ColumnExpression:
+    """Bind ``pw.this`` AND the matching side sentinel (``pw.left`` when
+    side='left', ``pw.right`` when side='right') to ``table`` — temporal
+    joins take per-side time expressions where the reference accepts either
+    spelling (interval/asof/window join signatures)."""
+    if isinstance(expression, str):
+        return ColumnReference(table, expression)
+    expression = expr_mod.wrap_expression(expression)
+    sided = left if side == "left" else right
+
+    def replace(node: ColumnExpression) -> ColumnExpression | None:
+        if isinstance(node, ThisColumnReference):
+            if node._owner is this or node._owner is sided:
+                return ColumnReference(table, node.name)
+            raise ValueError(
+                f"{node!r} cannot be used for the {side} side of this join"
+            )
+        return None
+
+    return substitute(expression, replace)

@@ -210,15 +210,20 @@ class Table:
         )
 
     def rename(self, names_mapping: Mapping[Any, str] | None = None, **kwargs: str) -> "Table":
+        def colname(ref: Any) -> str:
+            if isinstance(ref, ColumnReference):
+                return ref.name
+            # pw.this.x sentinel (ThisColumnReference) carries _name
+            this_name = getattr(ref, "_name", None)
+            return this_name if this_name is not None else str(ref)
+
         mapping: dict[str, str] = {}
         if names_mapping:
             for old, new in names_mapping.items():
-                old_name = old.name if isinstance(old, ColumnReference) else str(old)
-                mapping[old_name] = new
+                mapping[colname(old)] = new
         # kwargs follow reference convention: new_name=old_column
         for new, old in kwargs.items():
-            old_name = old.name if isinstance(old, ColumnReference) else str(old)
-            mapping[old_name] = new
+            mapping[colname(old)] = new
         exprs = {
             mapping.get(n, n): ColumnReference(self, n) for n in self._column_names
         }

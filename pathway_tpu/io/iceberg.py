@@ -76,13 +76,17 @@ def _schema_json(column_names: Sequence[str], dtypes: dict) -> dict:
 
 def _check_local(catalog_uri: str | os.PathLike) -> str:
     uri = os.fspath(catalog_uri)
-    if isinstance(uri, str) and uri.split("://", 1)[0] in ("http", "https"):
-        raise NotImplementedError(
-            "pw.io.iceberg speaks the filesystem (hadoop-style) catalog; "
-            "REST catalog services are unreachable from this build — pass "
-            "a local warehouse directory instead"
-        )
-    if isinstance(uri, str) and uri.startswith("file://"):
+    if isinstance(uri, str) and "://" in uri:
+        scheme = uri.split("://", 1)[0]
+        if scheme != "file":
+            # http(s) REST catalogs and object-store warehouses (s3/gs/
+            # abfs/...) need services this build cannot reach — refuse
+            # rather than silently writing to a local dir named "s3:"
+            raise NotImplementedError(
+                f"pw.io.iceberg speaks the filesystem (hadoop-style) "
+                f"catalog; {scheme}:// locations are unreachable from this "
+                f"build — pass a local warehouse directory instead"
+            )
         uri = uri[len("file://"):]
     return uri
 
@@ -207,6 +211,12 @@ class IcebergWriter:
         pq.write_table(arrow, fpath)
 
         version = _current_version(self.location)
+        if version is None:
+            raise RuntimeError(
+                f"iceberg table at {self.location}: metadata/version-hint."
+                f"text is missing or unreadable; the catalog was deleted or "
+                f"corrupted after this writer opened it"
+            )
         metadata = _read_metadata(self.location, version)
         seq = metadata["last-sequence-number"] + 1
         snapshot_id = int(uuid.uuid4().int % (1 << 62))

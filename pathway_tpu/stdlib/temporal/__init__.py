@@ -22,7 +22,7 @@ from pathway_tpu.internals.expression import (
     wrap_expression,
 )
 from pathway_tpu.internals.table import Table, TableSpec
-from pathway_tpu.internals.desugaring import resolve_this
+from pathway_tpu.internals.desugaring import resolve_this, resolve_side
 
 
 # -- behaviors ---------------------------------------------------------------
@@ -465,8 +465,8 @@ def interval_join(
         left,
         right,
         {
-            "left_time": resolve_this(left_time, left),
-            "right_time": resolve_this(right_time, right),
+            "left_time": resolve_side(left_time, left, "left"),
+            "right_time": resolve_side(right_time, right, "right"),
             "lower_bound": interval.lower_bound,
             "upper_bound": interval.upper_bound,
         },
@@ -501,8 +501,8 @@ def asof_join(
         left,
         right,
         {
-            "left_time": resolve_this(left_time, left),
-            "right_time": resolve_this(right_time, right),
+            "left_time": resolve_side(left_time, left, "left"),
+            "right_time": resolve_side(right_time, right, "right"),
             "direction": direction,
         },
         on,
@@ -551,11 +551,28 @@ class WindowJoinResult:
         self._join = JoinResult(left_assigned, right_assigned, tuple(conds), how)
 
     def _retarget_both(self, expression: Any) -> Any:
-        e = _retarget(expression, self._orig_left, self._left_assigned)
-        # second pass: rewrite right-table refs (left pass left them alone)
         from pathway_tpu.internals.desugaring import substitute
         from pathway_tpu.internals.expression import ColumnReference
+        from pathway_tpu.internals.thisclass import (
+            ThisColumnReference,
+            left as pw_left,
+            right as pw_right,
+        )
 
+        # pw.left / pw.right sentinels address the join sides (reference
+        # WindowJoinResult.select accepts them alongside direct refs)
+        def replace_sided(x: Any) -> Any:
+            if isinstance(x, ThisColumnReference):
+                if x._owner is pw_left:
+                    return ColumnReference(self._left_assigned, x.name)
+                if x._owner is pw_right:
+                    return ColumnReference(self._right_assigned, x.name)
+            return None
+
+        expression = substitute(wrap_expression(expression), replace_sided)
+        e = _retarget(expression, self._orig_left, self._left_assigned)
+
+        # second pass: rewrite right-table refs (left pass left them alone)
         def replace(x: Any) -> Any:
             if isinstance(x, ColumnReference) and x.table is self._orig_right:
                 return ColumnReference(self._right_assigned, x.name)
@@ -590,8 +607,8 @@ def _session_window_sides(
 ) -> tuple[Table, Table]:
     """Sessions span the *union* of both sides' records per (instance,
     on-values) group (reference _window_join.py session path)."""
-    lt = resolve_this(left_time, left)
-    rt = resolve_this(right_time, right)
+    lt = resolve_side(left_time, left, "left")
+    rt = resolve_side(right_time, right, "right")
     lgrp = make_tuple(
         linst if linst is not None else wrap_expression(0),
         *[lexpr for lexpr, _r in on_pairs],
@@ -676,9 +693,15 @@ def window_join(
         ):
             raise ValueError("window_join conditions must be equalities")
         on_pairs.append((resolved._left, resolved._right))
-    linst = resolve_this(left_instance, left) if left_instance is not None else None
+    linst = (
+        resolve_side(left_instance, left, "left")
+        if left_instance is not None
+        else None
+    )
     rinst = (
-        resolve_this(right_instance, right) if right_instance is not None else None
+        resolve_side(right_instance, right, "right")
+        if right_instance is not None
+        else None
     )
 
     if isinstance(window, SessionWindow):
@@ -692,8 +715,8 @@ def window_join(
         ]
         return WindowJoinResult(left, right, la, ra, conds, how)
 
-    la = _assign_windows(left, resolve_this(left_time, left), window, linst)
-    ra = _assign_windows(right, resolve_this(right_time, right), window, rinst)
+    la = _assign_windows(left, resolve_side(left_time, left, "left"), window, linst)
+    ra = _assign_windows(right, resolve_side(right_time, right, "right"), window, rinst)
     conds = [
         la["_pw_window_start"] == ra["_pw_window_start"],
         la["_pw_window_end"] == ra["_pw_window_end"],
