@@ -252,6 +252,9 @@ class DistributedScheduler:
         n_shared: int | None = None,
     ) -> None:
         self.scopes = list(local_scopes)
+        for scope in self.scopes:
+            # replica `current` holds key shards (see ShardedScheduler)
+            scope.sharded = True
         self.threads = len(self.scopes)
         self.process_id = process_id
         self.n_processes = n_processes

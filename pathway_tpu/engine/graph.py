@@ -473,7 +473,30 @@ class OverrideUniverseNode(Node):
         return self.take(0)
 
 
-class ZipNode(Node):
+class InputMirrors:
+    """Own per-port input-state mirrors for state-peeking operators.
+
+    Under sharded execution a local input REPLICA's ``current`` holds the
+    shard of the keys IT processed, which diverges from the consumer's
+    shard whenever an upstream reindex changed keys — so sharded scopes
+    read OWN mirrors built from the batches routed here by row key.
+    Single-worker scopes read the input's complete ``current`` directly
+    (no memory duplication)."""
+
+    def _init_mirrors(self) -> None:
+        self._mirrors: list[dict] = [{} for _ in self.inputs]
+
+    def _input_state(self, port: int) -> dict:
+        if self.scope.sharded:
+            return self._mirrors[port]
+        return self.inputs[port].current
+
+    def _absorb(self, port: int, batch: DeltaBatch) -> None:
+        if self.scope.sharded:
+            apply_batch_to_state(self._mirrors[port], batch)
+
+
+class ZipNode(InputMirrors, Node):
     """Zip same-universe tables into one storage (column concatenation).
 
     The reference reaches the same goal by flattening same-universe columns
@@ -483,13 +506,16 @@ class ZipNode(Node):
     naturally.
     """
 
+    STATE_ATTRS = ("_mirrors",)
+
     def __init__(self, scope: "Scope", sources: Sequence[Node]) -> None:
         super().__init__(scope, list(sources), sum(s.arity for s in sources))
+        self._init_mirrors()
 
     def _combined(self, key: Pointer) -> tuple | None:
         parts = []
-        for inp in self.inputs:
-            row = inp.current.get(key)
+        for port in range(len(self.inputs)):
+            row = self._input_state(port).get(key)
             if row is None:
                 return None
             parts.append(row)
@@ -498,7 +524,9 @@ class ZipNode(Node):
     def process(self, time: int) -> DeltaBatch:
         affected: set[Pointer] = set()
         for port in range(len(self.inputs)):
-            for key, _row, _diff in self.take(port):
+            batch = self.take(port)
+            self._absorb(port, batch)
+            for key, _row, _diff in batch:
                 affected.add(key)
         out = DeltaBatch()
         for key in affected:
@@ -1006,12 +1034,12 @@ class SortNode(Node):
         return out.consolidate()
 
 
-class IxNode(Node):
+class IxNode(InputMirrors, Node):
     """Pointer-lookup join: for each input row, fetch the source row its
     key column points to (reference: ix_table python_api.rs:2963).
     """
 
-    STATE_ATTRS = ("forward", "reverse")
+    STATE_ATTRS = ("forward", "reverse", "_mirrors")
 
     def __init__(
         self,
@@ -1028,6 +1056,7 @@ class IxNode(Node):
         self.strict = strict
         self.forward: dict[Pointer, Pointer] = {}  # input key -> source key
         self.reverse: dict[Pointer, set[Pointer]] = {}  # source key -> input keys
+        self._init_mirrors()
 
     def _lookup(self, key: Pointer, skey: Pointer | None) -> tuple | None:
         if skey is None:
@@ -1035,7 +1064,7 @@ class IxNode(Node):
                 return (None,) * self.arity
             self.report(key, "ix: key is None and optional=False")
             return None
-        src = self.inputs[1].current.get(skey)
+        src = self._input_state(1).get(skey)
         if src is None:
             if self.strict:
                 self.report(key, f"ix: missing key {skey!r}")
@@ -1046,6 +1075,7 @@ class IxNode(Node):
     def process(self, time: int) -> DeltaBatch:
         keys_batch = self.take(0)
         source_batch = self.take(1)
+        self._absorb(1, source_batch)
         out = DeltaBatch()
         # Source-side changes: re-emit rows for affected input keys
         affected_src: set[Pointer] = {key for key, _r, _d in source_batch}
@@ -1087,23 +1117,28 @@ class IxNode(Node):
         return out.consolidate()
 
 
-class UpdateRowsNode(Node):
+class UpdateRowsNode(InputMirrors, Node):
     """``orig.update_rows(updates)`` — updates win per key; union of universes."""
+
+    STATE_ATTRS = ("_mirrors",)
 
     def __init__(self, scope: "Scope", orig: Node, updates: Node) -> None:
         assert orig.arity == updates.arity
         super().__init__(scope, [orig, updates], orig.arity)
+        self._init_mirrors()
 
     def _effective(self, key: Pointer) -> tuple | None:
-        upd = self.inputs[1].current.get(key)
+        upd = self._input_state(1).get(key)
         if upd is not None:
             return upd
-        return self.inputs[0].current.get(key)
+        return self._input_state(0).get(key)
 
     def process(self, time: int) -> DeltaBatch:
         affected: set[Pointer] = set()
         for port in (0, 1):
-            for key, _row, _diff in self.take(port):
+            batch = self.take(port)
+            self._absorb(port, batch)
+            for key, _row, _diff in batch:
                 affected.add(key)
         out = DeltaBatch()
         for key in affected:
@@ -1116,24 +1151,27 @@ class UpdateRowsNode(Node):
         return out
 
 
-class UpdateCellsNode(Node):
+class UpdateCellsNode(InputMirrors, Node):
     """``orig.update_cells(updates)`` — override selected columns per key.
 
     ``update_cols[i]`` gives, for each output column, the column index in the
     updates table or -1 to keep the original value.
     """
 
+    STATE_ATTRS = ("_mirrors",)
+
     def __init__(
         self, scope: "Scope", orig: Node, updates: Node, update_cols: Sequence[int]
     ) -> None:
         super().__init__(scope, [orig, updates], orig.arity)
         self.update_cols = list(update_cols)
+        self._init_mirrors()
 
     def _effective(self, key: Pointer) -> tuple | None:
-        orig = self.inputs[0].current.get(key)
+        orig = self._input_state(0).get(key)
         if orig is None:
             return None
-        upd = self.inputs[1].current.get(key)
+        upd = self._input_state(1).get(key)
         if upd is None:
             return orig
         return tuple(
@@ -1143,7 +1181,9 @@ class UpdateCellsNode(Node):
     def process(self, time: int) -> DeltaBatch:
         affected: set[Pointer] = set()
         for port in (0, 1):
-            for key, _row, _diff in self.take(port):
+            batch = self.take(port)
+            self._absorb(port, batch)
+            for key, _row, _diff in batch:
                 affected.add(key)
         out = DeltaBatch()
         for key in affected:
@@ -1256,6 +1296,10 @@ class Scope:
         self._error_log_stack: list[ErrorLogNode] = [self.error_log_default]
         self.worker_index = 0
         self.worker_count = 1
+        #: set by the sharded/distributed schedulers: replica node state
+        #: (`current`) then holds only a key shard, so state-peeking
+        #: operators (zip/ix/update/iterate) switch to own input mirrors
+        self.sharded = False
 
     # -- error plumbing -----------------------------------------------------
 

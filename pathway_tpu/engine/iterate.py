@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Sequence
 
-from pathway_tpu.engine.batch import DeltaBatch
+from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
 from pathway_tpu.engine.graph import Node, Scope
 from pathway_tpu.engine.value import Pointer
 
@@ -24,9 +24,17 @@ class IterateNode(Node):
     """Recompute-on-change host loop.
 
     ``compute(input_states) -> output_state`` runs the full fixed point;
-    ``input_states`` are the current key->row dicts of the inputs, the
-    return value is the final key->row dict of the designated output table.
+    ``input_states`` are key->row dicts of the inputs, the return value is
+    the final key->row dict of the designated output table.
+
+    Under sharded execution this node is pinned to worker 0 and sees every
+    batch, while the local input replicas' ``current`` only hold one key
+    shard — so sharded scopes read OWN mirrors built from received batches
+    (same pattern as RecomputeNode / the InputMirrors operators in
+    engine/graph.py); single-worker scopes read inputs' ``current``.
     """
+
+    STATE_ATTRS = ("_input_states",)
 
     def __init__(
         self,
@@ -37,17 +45,26 @@ class IterateNode(Node):
     ) -> None:
         super().__init__(scope, list(inputs), arity)
         self.compute = compute
+        self._input_states: list[dict] = [{} for _ in self.inputs]
 
     def process(self, time: int) -> DeltaBatch:
+        sharded = self.scope.sharded
         changed = False
         for port in range(len(self.inputs)):
-            if self.take(port):
+            batch = self.take(port)
+            if batch:
                 changed = True
+                if sharded:
+                    apply_batch_to_state(self._input_states[port], batch)
         out = DeltaBatch()
         if not changed:
             return out
         try:
-            new_state = self.compute([inp.current for inp in self.inputs])
+            new_state = self.compute(
+                self._input_states
+                if sharded
+                else [inp.current for inp in self.inputs]
+            )
         except Exception as e:  # noqa: BLE001
             self.report(None, f"iterate error: {e!r}")
             return out

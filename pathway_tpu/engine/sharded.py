@@ -141,6 +141,10 @@ class ShardedScheduler:
     def __init__(self, scopes: Sequence[Scope], probe: bool = False) -> None:
         self.scopes = list(scopes)
         self.n = len(self.scopes)
+        for scope in self.scopes:
+            # replica `current` holds key shards: state-peeking operators
+            # (zip/ix/update/iterate) must use their own input mirrors
+            scope.sharded = True
         self.time = 0
         self.probe = probe
         #: node index -> OperatorStats aggregated ACROSS workers (the

@@ -551,31 +551,28 @@ class WindowJoinResult:
         self._join = JoinResult(left_assigned, right_assigned, tuple(conds), how)
 
     def _retarget_both(self, expression: Any) -> Any:
-        from pathway_tpu.internals.desugaring import substitute
+        from pathway_tpu.internals.desugaring import (
+            resolve_join_sides,
+            substitute,
+        )
         from pathway_tpu.internals.expression import ColumnReference
-        from pathway_tpu.internals.thisclass import (
-            ThisColumnReference,
-            left as pw_left,
-            right as pw_right,
+
+        if isinstance(expression, str):
+            # bare column name binds to the left side, like resolve_this
+            expression = ColumnReference(self._left_assigned, expression)
+        # pw.left / pw.right / pw.this(→left) address the join sides
+        # (reference WindowJoinResult.select accepts them alongside refs)
+        e = resolve_join_sides(
+            expression, self._left_assigned, self._right_assigned
         )
 
-        # pw.left / pw.right sentinels address the join sides (reference
-        # WindowJoinResult.select accepts them alongside direct refs)
-        def replace_sided(x: Any) -> Any:
-            if isinstance(x, ThisColumnReference):
-                if x._owner is pw_left:
-                    return ColumnReference(self._left_assigned, x.name)
-                if x._owner is pw_right:
-                    return ColumnReference(self._right_assigned, x.name)
-            return None
-
-        expression = substitute(wrap_expression(expression), replace_sided)
-        e = _retarget(expression, self._orig_left, self._left_assigned)
-
-        # second pass: rewrite right-table refs (left pass left them alone)
+        # rewrite direct refs to the ORIGINAL tables onto the assigned twins
         def replace(x: Any) -> Any:
-            if isinstance(x, ColumnReference) and x.table is self._orig_right:
-                return ColumnReference(self._right_assigned, x.name)
+            if isinstance(x, ColumnReference):
+                if x.table is self._orig_left:
+                    return ColumnReference(self._left_assigned, x.name)
+                if x.table is self._orig_right:
+                    return ColumnReference(self._right_assigned, x.name)
             return None
 
         return substitute(e, replace)

@@ -120,18 +120,23 @@ class HashTokenizer:
 
 
 def pad_to_buckets(
-    ids: np.ndarray, mask: np.ndarray, batch_bucket_min: int = 8
+    ids: np.ndarray,
+    mask: np.ndarray,
+    batch_bucket_min: int = 8,
+    seq_bucket_min: int = 8,
 ) -> tuple[np.ndarray, np.ndarray, int]:
     """Pad batch and seq dims up to powers of two so jit caches stay small.
 
     Returns (ids, mask, real_batch). Sequence is padded to the next power of
-    two; batch likewise (min ``batch_bucket_min``).
+    two (min ``seq_bucket_min`` — raise it to trade padding FLOPs for fewer
+    jit specializations, e.g. on remote-device links where each compile is
+    expensive); batch likewise (min ``batch_bucket_min``).
     """
     b, t = ids.shape
     bt = batch_bucket_min
     while bt < b:
         bt *= 2
-    tt = 8
+    tt = seq_bucket_min
     while tt < t:
         tt *= 2
     out_ids = np.zeros((bt, tt), np.int32)

@@ -53,6 +53,7 @@ class TpuEncoderEmbedder(UDF):
         seed: int = 0,
         cache_strategy: CacheStrategy | None = None,
         device_resident: bool | None = None,
+        seq_bucket_min: int = 8,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -114,6 +115,11 @@ class TpuEncoderEmbedder(UDF):
                 )
             self.config = cfg_fn()
         self.max_len = max_len
+        #: minimum pow-2 seq padding bucket — raise (up to max_len) to trade
+        #: padding FLOPs for fewer jit specializations (one compile per
+        #: (batch bucket, seq bucket) pair; compiles are seconds-expensive
+        #: over remote-device links)
+        self.seq_bucket_min = min(seq_bucket_min, max_len)
         self.tokenizer = tokenizer or HashTokenizer(self.config.vocab_size)
         if params is None:
             params = init_encoder_params(jax.random.key(seed), self.config)
@@ -138,7 +144,9 @@ class TpuEncoderEmbedder(UDF):
             ids, mask = self.tokenizer.encode_batch(
                 [str(t) for t in texts], self.max_len
             )
-            ids, mask, real = pad_to_buckets(ids, mask)
+            ids, mask, real = pad_to_buckets(
+                ids, mask, seq_bucket_min=self.seq_bucket_min
+            )
             vecs_dev = self._jit_embed(jnp.asarray(ids), jnp.asarray(mask))
             if self.device_resident:
                 from pathway_tpu.engine.device import lazy_rows

@@ -803,3 +803,37 @@ class TestSqlDepth:
     def test_count_star(self):
         r = pw.sql("SELECT dept, COUNT(*) AS n FROM t GROUP BY dept", t=self._t())
         assert rows_of(r) == [("eng", 2), ("ops", 1)]
+
+
+class TestWindowJoinSelectForms:
+    """WindowJoinResult.select accepts bare strings (left column), pw.left/
+    pw.right sentinels, and direct refs to the original tables."""
+
+    def _join(self):
+        import pathway_tpu.stdlib.temporal as tmp
+
+        left = T(
+            """
+            t | a
+            1 | x
+            """
+        )
+        right = T(
+            """
+            t | b
+            2 | p
+            """
+        )
+        return left, right, left.window_join(
+            right, left.t, right.t, tmp.tumbling(duration=4)
+        )
+
+    def test_string_kwarg_is_left_column(self):
+        _l, _r, j = self._join()
+        assert rows_of(j.select(a="a")) == [("x",)]
+
+    def test_sentinels_and_direct_refs(self):
+        left, right, j = self._join()
+        assert rows_of(
+            j.select(a=pw.left.a, b=pw.right.b, t2=left.t + right.t)
+        ) == [("x", "p", 3)]

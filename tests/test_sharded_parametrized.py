@@ -117,7 +117,78 @@ SCENARIOS = {
             )
         )(t.groupby(t.city).reduce(city=t.city, n=pw.reducers.count()))
     )(people()),
+    "windowby_tumbling": lambda: (
+        lambda t: t.windowby(
+            t.age, window=_temporal().tumbling(duration=10)
+        ).reduce(
+            start=pw.this["_pw_window_start"], n=pw.reducers.count()
+        )
+    )(people()),
+    "windowby_session_instance": lambda: (
+        lambda t: t.windowby(
+            t.age, window=_temporal().session(max_gap=6), instance=t.city
+        ).reduce(
+            city=pw.this["_pw_instance"], n=pw.reducers.count()
+        )
+    )(people()),
+    "interval_join": lambda: (
+        lambda p, b: p.interval_join(
+            b, p.age, b.amount, _temporal().interval(-5, 5)
+        ).select(name=pw.left.name, amount=pw.right.amount)
+    )(people(), purchases()),
+    "asof_join": lambda: (
+        lambda p, b: p.asof_join(
+            b, p.age, b.amount, direction="backward"
+        ).select(name=pw.left.name, amount=pw.right.amount)
+    )(people(), purchases()),
+    "window_join": lambda: (
+        lambda p, b: p.window_join(
+            b, p.age, b.amount, _temporal().tumbling(duration=15)
+        ).select(name=pw.left.name, amount=pw.right.amount)
+    )(people(), purchases()),
+    "intersect_difference": lambda: (
+        lambda a, b: a.intersect(b).concat_reindex(a.difference(b))
+    )(
+        people().with_id_from(pw.this.name),
+        purchases().with_id_from(pw.this.who),
+    ),
+    "ix_lookup": lambda: (
+        lambda p, b: b.select(
+            who=b.who, city=p.ix(p.pointer_from(b.who), optional=True).city
+        )
+    )(people().with_id_from(pw.this.name), purchases()),
+    "sql_group_having": lambda: pw.sql(
+        "SELECT city, COUNT(*) AS n FROM t GROUP BY city HAVING COUNT(*) > 1",
+        t=people(),
+    ),
+    "iterate_collatz_steps": lambda: (
+        lambda t: pw.iterate(
+            lambda tt: dict(
+                tt=tt.select(
+                    n=pw.if_else(
+                        pw.this.n == 1,
+                        pw.this.n,
+                        pw.if_else(
+                            pw.this.n % 2 == 0,
+                            pw.this.n // 2,
+                            3 * pw.this.n + 1,
+                        ),
+                    ),
+                    steps=pw.if_else(
+                        pw.this.n == 1, pw.this.steps, pw.this.steps + 1
+                    ),
+                )
+            ),
+            tt=t.select(n=pw.this.age, steps=0),
+        ).tt
+    )(people()),
 }
+
+
+def _temporal():
+    import pathway_tpu.stdlib.temporal as tmp
+
+    return tmp
 
 
 @pytest.mark.parametrize("scenario", sorted(SCENARIOS))
