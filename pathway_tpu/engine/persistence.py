@@ -266,12 +266,21 @@ class OperatorSnapshotManager:
         inner._seq = state.get("seq", 0)
         inner._per_source_rows = dict(state.get("per_source", {}))
 
+    @staticmethod
+    def _scopes_of(scope: Any) -> list:
+        return list(scope) if isinstance(scope, (list, tuple)) else [scope]
+
     def snapshot(self, scope: Any, drivers: list, time: int) -> None:
+        """``scope`` may be a single scope or the list of per-worker scope
+        replicas (ShardedGraphRunner) — each worker's operator state is
+        captured separately, like the reference's per-worker snapshot
+        writers (operator_snapshot.rs + tracker.rs per-worker storage)."""
         import pickle as _pickle
 
+        scopes = self._scopes_of(scope)
         payload = {
-            "sig": [type(n).__name__ for n in scope.nodes],
-            "nodes": [n.op_state() for n in scope.nodes],
+            "sigs": [[type(n).__name__ for n in s.nodes] for s in scopes],
+            "per_worker": [[n.op_state() for n in s.nodes] for s in scopes],
             "drivers": [self._driver_state(d) for d in drivers],
             "time": time,
         }
@@ -304,15 +313,31 @@ class OperatorSnapshotManager:
             payload = _pickle.loads(raw)
         except Exception:  # truncated/corrupt snapshot: cold start
             return None
-        sig = [type(n).__name__ for n in scope.nodes]
-        if payload.get("sig") != sig:
+        scopes = self._scopes_of(scope)
+        if "per_worker" in payload:
+            sigs = payload["sigs"]
+            per_worker = payload["per_worker"]
+        else:  # pre-multi-worker snapshot layout
+            sigs = [payload["sig"]]
+            per_worker = [payload["nodes"]]
+        if len(per_worker) != len(scopes):
             raise ValueError(
-                "operator snapshot does not match this graph (operator "
-                "sequence changed); clear the persistence location or use "
-                "input-journal persistence across code changes"
+                f"operator snapshot was taken with {len(per_worker)} "
+                f"worker(s) but this run has {len(scopes)}; operator "
+                "persistence cannot rescale workers — use input-journal "
+                "persistence (PersistenceMode.PERSISTING) to change the "
+                "worker count"
             )
-        for node, state in zip(scope.nodes, payload["nodes"]):
-            node.restore_op_state(state)
+        for s, sig in zip(scopes, sigs):
+            if [type(n).__name__ for n in s.nodes] != sig:
+                raise ValueError(
+                    "operator snapshot does not match this graph (operator "
+                    "sequence changed); clear the persistence location or "
+                    "use input-journal persistence across code changes"
+                )
+        for s, states in zip(scopes, per_worker):
+            for node, state in zip(s.nodes, states):
+                node.restore_op_state(state)
         for driver, state in zip(drivers, payload["drivers"]):
             self._restore_driver(driver, state)
         return int(payload.get("time", 0))

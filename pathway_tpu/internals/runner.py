@@ -1035,18 +1035,6 @@ class ShardedGraphRunner:
         from pathway_tpu.internals.license import check_worker_count
 
         check_worker_count(n_workers)
-        from pathway_tpu.persistence import PersistenceMode
-
-        if (
-            persistence_config is not None
-            and getattr(persistence_config, "persistence_mode", None)
-            == PersistenceMode.OPERATOR_PERSISTING
-        ):
-            raise NotImplementedError(
-                "operator snapshots are single-worker for now; use "
-                "input-journal persistence (PersistenceMode.PERSISTING) "
-                "with threads>1"
-            )
         self.workers = [
             GraphRunner(
                 persistence_config=persistence_config,
@@ -1079,6 +1067,14 @@ class ShardedGraphRunner:
         persistent = [d for d in drivers if hasattr(d, "replay")]
         for d in persistent:
             d.replay()
+        scopes = [w.scope for w in self.workers]
+        snapshot_mgr = w0._operator_snapshot_manager()
+        if snapshot_mgr is not None:
+            # per-worker operator snapshots: restore every replica's state
+            # and resume the clock after the snapshotted commit
+            restored_time = snapshot_mgr.restore(scopes, drivers)
+            if restored_time is not None:
+                sched.time = max(sched.time, restored_time + 1)
         if self.monitor is not None:
             # aggregated cross-worker operator stats (ShardedScheduler.stats)
             self.monitor.scheduler = sched
@@ -1089,6 +1085,8 @@ class ShardedGraphRunner:
             time = sched.commit()
             for d in persistent:
                 d.on_commit(time)
+            if snapshot_mgr is not None:
+                snapshot_mgr.on_commit(scopes, drivers, time)
             if self.monitor is not None:
                 w0.monitor = self.monitor
                 w0._sync_monitor_connectors()
@@ -1098,6 +1096,8 @@ class ShardedGraphRunner:
         sched.finish()
         for d in persistent:
             d.on_commit(sched.time)
+        if snapshot_mgr is not None:
+            snapshot_mgr.snapshot(scopes, drivers, sched.time)
         return sched
 
     def capture(self, *tables: "Table") -> list[dict[Pointer, tuple]]:

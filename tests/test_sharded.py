@@ -166,15 +166,18 @@ class TestShardedReviewRegressions:
         (sharded,) = ShardedGraphRunner(2).capture(res)
         assert sorted(v[0] for v in sharded.values()) == ["A", "B"]
 
-    def test_operator_persistence_rejected(self):
+    def test_operator_persistence_accepted_multiworker(self):
+        """Operator snapshots are per-worker now (engine/persistence.py);
+        construction with threads>1 must succeed. End-to-end resume is
+        covered in test_operator_snapshots.TestShardedOperatorSnapshots."""
         from pathway_tpu.persistence import Backend, Config, PersistenceMode
 
         cfg = Config(
             Backend.mock(),
             persistence_mode=PersistenceMode.OPERATOR_PERSISTING,
         )
-        with pytest.raises(NotImplementedError, match="single-worker"):
-            ShardedGraphRunner(2, persistence_config=cfg)
+        runner = ShardedGraphRunner(2, persistence_config=cfg)
+        assert runner.workers[0]._operator_snapshot_manager() is not None
 
     def test_upsert_stream_retractions(self):
         """Upsert replacements must retract the old row even when its
