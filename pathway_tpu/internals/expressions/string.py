@@ -33,12 +33,20 @@ class StringNamespace:
         return _method(len, int, self._e)
 
     def strip(self, chars: Any = None) -> ColumnExpression:
+        # a literal-None optional arg must not ride through None-propagating
+        # apply (it would blank the result row) — omit it instead
+        if chars is None:
+            return _method(lambda s: s.strip(), str, self._e)
         return _method(lambda s, c: s.strip(c), str, self._e, wrap_expression(chars))
 
     def lstrip(self, chars: Any = None) -> ColumnExpression:
+        if chars is None:
+            return _method(lambda s: s.lstrip(), str, self._e)
         return _method(lambda s, c: s.lstrip(c), str, self._e, wrap_expression(chars))
 
     def rstrip(self, chars: Any = None) -> ColumnExpression:
+        if chars is None:
+            return _method(lambda s: s.rstrip(), str, self._e)
         return _method(lambda s, c: s.rstrip(c), str, self._e, wrap_expression(chars))
 
     def startswith(self, prefix: Any) -> ColumnExpression:
@@ -54,34 +62,28 @@ class StringNamespace:
         return _method(lambda s: s.title(), str, self._e)
 
     def count(self, sub: Any, start: Any = None, end: Any = None) -> ColumnExpression:
-        return _method(
-            lambda s, x, b, e: s.count(x, b, e),
-            int,
-            self._e,
-            wrap_expression(sub),
-            wrap_expression(start),
-            wrap_expression(end),
-        )
+        return self._bounded(lambda s: s.count, int, sub, start, end)
+
+    def _bounded(self, method_of, ret, sub: Any, start: Any, end: Any) -> ColumnExpression:
+        # omitted bounds must not ride through None-propagating apply (a
+        # None operand would blank the whole result): pass only given args
+        args = [self._e, wrap_expression(sub)]
+        if start is not None or end is not None:
+            args.append(wrap_expression(0 if start is None else start))
+        if end is not None:
+            args.append(wrap_expression(end))
+        fns = {
+            2: lambda s, x: method_of(s)(x),
+            3: lambda s, x, b: method_of(s)(x, b),
+            4: lambda s, x, b, e: method_of(s)(x, b, e),
+        }
+        return _method(fns[len(args)], ret, *args)
 
     def find(self, sub: Any, start: Any = None, end: Any = None) -> ColumnExpression:
-        return _method(
-            lambda s, x, b, e: s.find(x, b, e),
-            int,
-            self._e,
-            wrap_expression(sub),
-            wrap_expression(start),
-            wrap_expression(end),
-        )
+        return self._bounded(lambda s: s.find, int, sub, start, end)
 
     def rfind(self, sub: Any, start: Any = None, end: Any = None) -> ColumnExpression:
-        return _method(
-            lambda s, x, b, e: s.rfind(x, b, e),
-            int,
-            self._e,
-            wrap_expression(sub),
-            wrap_expression(start),
-            wrap_expression(end),
-        )
+        return self._bounded(lambda s: s.rfind, int, sub, start, end)
 
     def replace(self, old: Any, new: Any, count: Any = -1) -> ColumnExpression:
         return _method(
@@ -94,6 +96,13 @@ class StringNamespace:
         )
 
     def split(self, sep: Any = None, maxsplit: Any = -1) -> ColumnExpression:
+        if sep is None:  # whitespace split; None must not blank the row
+            return _method(
+                lambda s, m: tuple(s.split(None, m)),
+                tuple[str, ...],
+                self._e,
+                wrap_expression(maxsplit),
+            )
         return ApplyExpression(
             lambda s, sp, m: tuple(s.split(sp, m)),
             tuple[str, ...],
@@ -145,9 +154,11 @@ class StringNamespace:
     def to_datetime(self, fmt: Any = None) -> ColumnExpression:
         import datetime
 
-        def parse(s: str, f: str | None) -> datetime.datetime:
+        def parse(s: str, f: str | None = None) -> datetime.datetime:
             if f is not None:
                 return datetime.datetime.strptime(s, f)
             return datetime.datetime.fromisoformat(s)
 
+        if fmt is None:
+            return _method(parse, datetime.datetime, self._e)
         return _method(parse, datetime.datetime, self._e, wrap_expression(fmt))

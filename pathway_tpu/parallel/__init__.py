@@ -42,6 +42,10 @@ from pathway_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_sharded,
 )
+from pathway_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -56,6 +60,8 @@ __all__ = [
     "replicated",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "set_mesh",
     "shard_batch",
     "shard_params",

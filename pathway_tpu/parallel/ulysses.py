@@ -1,0 +1,155 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+The second long-context strategy next to ring attention
+(parallel/ring_attention.py): instead of rotating K/V blocks around a
+ring, every device exchanges its sequence shard for a HEAD shard with one
+``all_to_all`` before attention and swaps back after — each device then
+runs ordinary full-sequence attention over ``heads / n`` heads. (The
+reference has no model math at all, SURVEY.md §5.7; this is new TPU-first
+design after the public DeepSpeed-Ulysses recipe, see PAPERS.md.)
+
+Trade-offs vs the ring (why the framework ships both):
+- Ulysses moves Q, K and V once each (two all-to-alls total) and computes
+  attention in one fused [T, T] matmul per head group — fewer, larger MXU
+  ops, better for moderate sequence lengths where O(T^2 / n) score memory
+  still fits.
+- Ring keeps score memory at O((T/n)^2) per step and overlaps K/V
+  transfer with compute — better for extreme sequence lengths.
+- Ulysses requires ``heads % n == 0``; the ring has no head constraint.
+
+``ulysses_attention`` is the inside-shard_map kernel; the sharded wrapper
+mirrors ``ring_attention_sharded`` so callers can switch strategies with
+one name change.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pathway_tpu.parallel.mesh import SEQ_AXIS, axis_size as mesh_axis_size
+
+
+def _attend(q, k, v, *, causal, scale, k_valid):
+    """Plain full-sequence attention: [b, t, h, d] x [b, s, h, d].
+
+    Scores and softmax run in float32 regardless of input dtype (the ring
+    kernel upcasts the same way); fully-masked query rows output exactly 0,
+    matching ring_attention's online-softmax behavior."""
+    out_dtype = q.dtype
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    scores = jnp.einsum("bthd,bshd->bhts", qf, kf) * scale
+    if k_valid is not None:
+        scores = jnp.where(k_valid[:, None, None, :], scores, -jnp.inf)
+    if causal:
+        t = q.shape[1]
+        s = k.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(s)[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    # rows with every position masked (padding queries) would softmax NaN;
+    # compute them on neutral scores, then zero their OUTPUT (never let
+    # them attend uniformly — that would leak masked/future values)
+    all_masked = jnp.all(jnp.isneginf(scores), axis=-1, keepdims=True)
+    probs = jax.nn.softmax(jnp.where(all_masked, 0.0, scores), axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, vf)
+    out = jnp.where(
+        jnp.swapaxes(all_masked, 1, 2)[..., 0, None], 0.0, out
+    )
+    return out.astype(out_dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    scale: float | None = None,
+    k_valid: jax.Array | None = None,
+) -> jax.Array:
+    """Attention over sequence-sharded inputs via head all-to-all.
+
+    Call inside shard_map. Local blocks are ``[batch, t_local, heads,
+    head_dim]`` with ``heads % axis_size == 0``; ``k_valid`` is the local
+    key padding mask ``[batch, t_local]`` (True = attend). Returns the
+    local output block ``[batch, t_local, heads, head_dim]``.
+    """
+    b, t_local, heads, head_dim = q.shape
+    if heads % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({heads}) divisible by the sequence "
+            f"axis size ({axis_size}); use ring_attention otherwise"
+        )
+    if scale is None:
+        scale = head_dim**-0.5
+
+    def seq_to_heads(x):
+        # [b, t_local, heads, d] -> [b, t_local*n ( = T global), heads/n, d]
+        # all_to_all: scatter the head axis, gather the sequence axis
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg = seq_to_heads(q)
+    kg = seq_to_heads(k)
+    vg = seq_to_heads(v)
+    valid_g = None
+    if k_valid is not None:
+        # the key mask follows K's sequence gather: [b, t_local] -> [b, T]
+        valid_g = lax.all_gather(k_valid, axis_name, axis=1, tiled=True)
+    out_g = _attend(qg, kg, vg, causal=causal, scale=scale, k_valid=valid_g)
+    return heads_to_seq(out_g)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    k_valid: jax.Array | None = None,
+    seq_axis: str = SEQ_AXIS,
+    batch_spec: Any = None,
+    head_spec: Any = None,
+) -> jax.Array:
+    """shard_map wrapper: global ``[B, T, H, D]`` in, same out — the exact
+    signature of ``ring_attention_sharded``, so callers switch strategies
+    with one name change. T is sharded over ``seq_axis``; batch/heads may
+    additionally be sharded via ``batch_spec`` / ``head_spec``."""
+    n = mesh_axis_size(mesh, seq_axis)
+    t_spec = seq_axis if n > 1 else None
+    spec = P(batch_spec, t_spec, head_spec, None)
+    mask_spec = P(batch_spec, t_spec)
+
+    def fn(q, k, v, valid):
+        return ulysses_attention(
+            q, k, v,
+            axis_name=seq_axis,
+            axis_size=n,
+            causal=causal,
+            scale=scale,
+            k_valid=valid,
+        )
+
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, None if k_valid is None else mask_spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v, k_valid)

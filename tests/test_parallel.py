@@ -58,3 +58,111 @@ def test_ring_attention_single_device_axis():
     out = ring_attention_sharded(q, k, v, mesh, batch_spec="data")
     ref = dense_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# -- Ulysses (all-to-all) sequence parallelism --------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    from pathway_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=1, seq=8))
+    rng = np.random.default_rng(3)
+    b, t, h, d = 2, 32, 8, 16  # heads divisible by seq axis (8)
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_matches_ring_with_padding_mask():
+    from pathway_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=1, seq=8))
+    rng = np.random.default_rng(4)
+    b, t, h, d = 2, 64, 8, 8
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    valid = jnp.asarray(rng.random((b, t)) > 0.3)
+    out_u = ulysses_attention_sharded(q, k, v, mesh, k_valid=valid)
+    out_r = ring_attention_sharded(q, k, v, mesh, k_valid=valid)
+    np.testing.assert_allclose(
+        np.asarray(out_u), np.asarray(out_r), atol=2e-5
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from pathway_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=1, seq=8))
+    q = jnp.zeros((1, 16, 6, 8), jnp.float32)  # 6 heads over 8 devices
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention_sharded(q, q, q, mesh)
+
+
+def test_ulysses_fully_masked_rows_output_zero():
+    """Padding queries whose every key is masked must output 0 (never
+    uniform attention over masked/future values) — parity with the ring."""
+    from pathway_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=1, seq=8))
+    rng = np.random.default_rng(5)
+    b, t, h, d = 1, 16, 8, 8
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    valid = jnp.ones((b, t), bool).at[0, 0].set(False)
+    out_u = ulysses_attention_sharded(q, k, v, mesh, causal=True, k_valid=valid)
+    out_r = ring_attention_sharded(q, k, v, mesh, causal=True, k_valid=valid)
+    # query 0 sees only key 0 (causal), which is masked: output must be 0
+    np.testing.assert_allclose(np.asarray(out_u[0, 0]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out_u), np.asarray(out_r), atol=2e-5
+    )
+
+
+def test_ulysses_signature_is_ring_drop_in():
+    """Swapping the function name must be enough: same kwargs, including
+    batch_spec sharding over the data axis."""
+    from pathway_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=8, seq=1))
+    rng = np.random.default_rng(6)
+    b, t, h, d = 8, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    for fn in (ring_attention_sharded, ulysses_attention_sharded):
+        out = fn(q, k, v, mesh, batch_spec="data", seq_axis="seq")
+        ref = dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_bf16_matches_f32_closely():
+    """Scores/softmax upcast to f32 like the ring: bf16 inputs stay close
+    to the f32 result (inputs-only quantization noise)."""
+    from pathway_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=1, seq=8))
+    rng = np.random.default_rng(7)
+    b, t, h, d = 1, 32, 8, 16
+    q = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    v = rng.normal(size=(b, t, h, d)).astype(np.float32)
+    out32 = ulysses_attention_sharded(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh
+    )
+    out16 = ulysses_attention_sharded(
+        jnp.asarray(q, jnp.bfloat16),
+        jnp.asarray(k, jnp.bfloat16),
+        jnp.asarray(v, jnp.bfloat16),
+        mesh,
+    )
+    assert out16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out16, np.float32), np.asarray(out32), atol=0.05
+    )
