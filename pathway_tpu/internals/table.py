@@ -419,14 +419,16 @@ class Table:
         return self._derived(
             TableSpec("intersect", [self, *tables], {}),
             {n: self._dtypes[n] for n in self._column_names},
-            universe=self._universe.subset(),
+            universe=solver.get_intersection(
+                self._universe, *(t._universe for t in tables)
+            ),
         )
 
     def difference(self, other: "Table") -> "Table":
         return self._derived(
             TableSpec("subtract", [self, other], {}),
             {n: self._dtypes[n] for n in self._column_names},
-            universe=self._universe.subset(),
+            universe=solver.get_difference(self._universe, other._universe),
         )
 
     def restrict(self, other: "Table") -> "Table":

@@ -244,3 +244,65 @@ def test_mesh_transport_roundtrip():
     finally:
         for tr in transports.values():
             tr.close()
+
+
+PERSISTENT_WORDCOUNT = """
+    import os
+    import pathway_tpu as pw
+    from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+    words = pw.io.plaintext.read(
+        {indir!r}, mode="static", persistent_id="w"
+    )
+    counts = words.groupby(words.data).reduce(
+        word=words.data, cnt=pw.reducers.count()
+    )
+    pw.io.jsonlines.write(counts, {out!r})
+    pw.run(persistence_config=Config(
+        Backend.filesystem({store!r}),
+        persistence_mode=PersistenceMode.PERSISTING,
+    ))
+"""
+
+
+def test_spawn_with_journal_persistence_resumes(tmp_path):
+    """Input-journal persistence under multi-process execution: a second
+    spawned run replays the journal on process 0 and emits only the delta
+    (the reference's backfilling tests, integration_tests/kafka/
+    test_backfilling.py, at wordcount scale)."""
+    import json as _json
+
+    indir = tmp_path / "in"
+    indir.mkdir()
+    (indir / "a.txt").write_text("apple\nbanana\napple\n")
+    store = tmp_path / "store"
+    out1 = tmp_path / "out1.jsonl"
+    _spawn_program(
+        tmp_path,
+        PERSISTENT_WORDCOUNT.format(
+            indir=str(indir), out=str(out1), store=str(store)
+        ),
+        processes=2,
+    )
+    rows1 = [
+        _json.loads(l) for l in out1.read_text().splitlines() if l.strip()
+    ]
+    assert {r["word"]: r["cnt"] for r in rows1 if r["diff"] > 0} == {
+        "apple": 2,
+        "banana": 1,
+    }
+
+    (indir / "b.txt").write_text("banana\ncherry\n")
+    out2 = tmp_path / "out2.jsonl"
+    _spawn_program(
+        tmp_path,
+        PERSISTENT_WORDCOUNT.format(
+            indir=str(indir), out=str(out2), store=str(store)
+        ),
+        processes=2,
+    )
+    rows2 = [
+        _json.loads(l) for l in out2.read_text().splitlines() if l.strip()
+    ]
+    finals = {r["word"]: r["cnt"] for r in rows2 if r["diff"] > 0}
+    assert finals["banana"] == 2 and finals["cherry"] == 1

@@ -104,3 +104,63 @@ def test_dtype_wrap():
     assert dt.wrap(list[int]) == dt.List(dt.INT)
     assert dt.wrap(datetime.datetime) == dt.DATE_TIME_NAIVE
     assert dt.wrap(np.ndarray) == dt.ANY_ARRAY
+
+
+class TestUniverseSatSolver:
+    """SAT-based universe reasoning (reference universe_solver.py:14 —
+    pysat there, own DPLL here): derived facts beyond registered edges."""
+
+    def _solver(self):
+        from pathway_tpu.internals.universe import Universe, UniverseSolver
+
+        return UniverseSolver(), Universe
+
+    def test_set_algebra_derivations(self):
+        s, U = self._solver()
+        a, b = U(), U()
+        u, i, d = s.get_union(a, b), s.get_intersection(a, b), s.get_difference(a, b)
+        assert s.query_is_subset(i, u)  # A∩B ⊆ A∪B: never registered
+        assert s.query_is_subset(d, u)  # A∖B ⊆ A∪B
+        assert not s.query_is_subset(u, a)
+        s.register_subset(b, a)
+        assert s.query_are_equal(u, a)  # B⊆A makes A∪B == A
+        assert s.query_are_equal(i, b)  # ... and A∩B == B
+
+    def test_transitivity_and_equality_chains(self):
+        s, U = self._solver()
+        chain = [U() for _ in range(6)]
+        for sub, sup in zip(chain, chain[1:]):
+            s.register_subset(sub, sup)
+        assert s.query_is_subset(chain[0], chain[-1])
+        assert not s.query_is_subset(chain[-1], chain[0])
+        x = U()
+        s.register_equal(x, chain[3])
+        assert s.query_is_subset(chain[0], x)
+        assert s.query_is_subset(x, chain[-1])
+
+    def test_difference_disjoint_from_subtrahend(self):
+        s, U = self._solver()
+        a, b = U(), U()
+        d = s.get_difference(a, b)
+        i = s.get_intersection(d, b)
+        empty = U()
+        # d ∩ b has no elements: it is a subset of ANY universe
+        assert s.query_is_subset(i, empty)
+
+    def test_scales_to_graph_sized_chains(self):
+        import time
+
+        s, U = self._solver()
+        chain = [U() for _ in range(400)]
+        for sub, sup in zip(chain, chain[1:]):
+            s.register_subset(sub, sup)
+        t0 = time.perf_counter()
+        assert s.query_is_subset(chain[0], chain[-1])
+        assert not s.query_is_subset(chain[-1], chain[0])
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_memoized_derived_universes(self):
+        s, U = self._solver()
+        a, b = U(), U()
+        assert s.get_union(a, b) is s.get_union(b, a)
+        assert s.get_intersection(a, b) is s.get_intersection(b, a)
