@@ -381,6 +381,10 @@ class Table:
         return self._derived(
             TableSpec("concat", tables, {}),
             dtypes,
+            # concat's key set IS the union of the operands': the SAT
+            # solver then proves each operand ⊆ result (cross-table
+            # selects against an operand keep working)
+            universe=solver.get_union(*(t._universe for t in tables)),
         )
 
     def concat_reindex(self, *others: "Table") -> "Table":

@@ -166,7 +166,10 @@ class UniverseSolver:
 
     def _add(self, *clauses: tuple[int, ...]) -> None:
         self._clauses.extend(clauses)
-        self._cache.clear()
+        # clause sets only grow, and subset=True means UNSAT — which more
+        # clauses can never undo. Only negative answers can flip, so keep
+        # the (frequent, graph-build-critical) positive cache entries.
+        self._cache = {k: v for k, v in self._cache.items() if v}
 
     # -- axioms ------------------------------------------------------------
 
