@@ -85,6 +85,28 @@ def filter_expr():
     return run
 
 
+def join_inner():
+    n_right = 50_000
+    lrows = [
+        (ref_scalar(("l", i)), (i % n_right, float(i))) for i in range(N // 2)
+    ]
+    rrows = [(ref_scalar(("r", i)), (i, f"name{i}")) for i in range(n_right)]
+
+    def run():
+        scope = Scope()
+        left = scope.input_session(2)
+        right = scope.input_session(2)
+        scope.join_tables(left, right, left_on=[0], right_on=[0], kind="inner")
+        sched = Scheduler(scope)
+        for key, row in lrows:
+            left.insert(key, row)
+        for key, row in rrows:
+            right.insert(key, row)
+        return timed(sched.commit)
+
+    return run
+
+
 def wordcount():
     words = [f"w{i % 4096}" for i in range(N)]
     rows = [(ref_scalar(i), (w,)) for i, w in enumerate(words)]
@@ -130,6 +152,18 @@ def main() -> None:
                 }
             )
         )
+    # join has no columnar/rowwise split (per-group incremental recompute)
+    run = join_inner()
+    t = min(run() for _ in range(2))
+    print(
+        json.dumps(
+            {
+                "workload": "join_inner",
+                "rows": N // 2,
+                "rows_per_sec": round((N // 2) / t),
+            }
+        )
+    )
 
 
 if __name__ == "__main__":

@@ -320,7 +320,52 @@ def _feed(h: "hashlib._Hash", value: Any) -> None:
 
 
 def hash_values(values: Iterable[Any], *, salt: bytes = b"") -> Pointer:
-    """Stable 128-bit key from a sequence of values (Key::for_values analog)."""
+    """Stable 128-bit key from a sequence of values (Key::for_values analog).
+
+    Digest-identical fast path: common scalar types append to one buffer
+    flushed in a single ``update`` (join/groupby key derivation calls this
+    per output row — the per-value ``_feed`` dispatch dominated join time).
+    """
+    h = hashlib.blake2b(digest_size=16, person=b"pw-tpu-key")
+    buf = bytearray(salt)
+    for value in values:
+        t = type(value)
+        if t is Pointer:
+            buf += _H_POINTER
+            buf += int.to_bytes(value, 16, "little")
+        elif t is int:
+            buf += _H_INT
+            buf += value.to_bytes(16, "little", signed=True)
+        elif t is str:
+            b = value.encode()
+            buf += _H_STRING
+            buf += len(b).to_bytes(8, "little")
+            buf += b
+        elif t is bool:
+            buf += _H_BOOL
+            buf += b"\x01" if value else b"\x00"
+        elif t is float:
+            if math.isnan(value) or math.isinf(value):
+                buf += _H_FLOAT
+                buf += struct.pack("<d", value)
+            elif abs(value) < 2**63 and value == int(value):
+                buf += _H_INT
+                buf += int(value).to_bytes(16, "little", signed=True)
+            else:
+                buf += _H_FLOAT
+                buf += struct.pack("<d", value)
+        else:
+            if buf:
+                h.update(bytes(buf))
+                buf.clear()
+            _feed(h, value)
+    if buf:
+        h.update(bytes(buf))
+    return Pointer(int.from_bytes(h.digest(), "little"))
+
+
+def _hash_values_slow(values: Iterable[Any], *, salt: bytes = b"") -> Pointer:
+    """Reference implementation (kept for digest-equality tests)."""
     h = hashlib.blake2b(digest_size=16, person=b"pw-tpu-key")
     if salt:
         h.update(salt)

@@ -162,14 +162,15 @@ class UniverseSolver:
         self._unions: dict[tuple[int, ...], Universe] = {}
         self._intersections: dict[tuple[int, ...], Universe] = {}
         self._differences: dict[tuple[int, int], Universe] = {}
-        self._cache: dict[tuple[int, int], bool] = {}
+        # clause sets only grow, and subset=True means UNSAT — which more
+        # clauses can never undo: positive answers cache forever, negative
+        # answers are dropped (O(1)) whenever clauses are added
+        self._cache_true: dict[tuple[int, int], bool] = {}
+        self._cache_false: dict[tuple[int, int], bool] = {}
 
     def _add(self, *clauses: tuple[int, ...]) -> None:
         self._clauses.extend(clauses)
-        # clause sets only grow, and subset=True means UNSAT — which more
-        # clauses can never undo. Only negative answers can flip, so keep
-        # the (frequent, graph-build-critical) positive cache entries.
-        self._cache = {k: v for k, v in self._cache.items() if v}
+        self._cache_false.clear()
 
     # -- axioms ------------------------------------------------------------
 
@@ -180,14 +181,16 @@ class UniverseSolver:
         self._add((-sub.id, sup.id))
 
     def register_union(self, result: Universe, *parts: Universe) -> None:
-        for p in parts:
-            self._add((-p.id, result.id))
-        self._add((-result.id, *(p.id for p in parts)))
+        self._add(
+            *((-p.id, result.id) for p in parts),
+            (-result.id, *(p.id for p in parts)),
+        )
 
     def register_intersection(self, result: Universe, *parts: Universe) -> None:
-        for p in parts:
-            self._add((-result.id, p.id))
-        self._add((*(-p.id for p in parts), result.id))
+        self._add(
+            *((-result.id, p.id) for p in parts),
+            (*(-p.id for p in parts), result.id),
+        )
 
     def register_difference(
         self, result: Universe, a: Universe, b: Universe
@@ -232,12 +235,12 @@ class UniverseSolver:
         if sub.id == sup.id:
             return True
         key = (sub.id, sup.id)
-        got = self._cache.get(key)
-        if got is None:
-            got = not _dpll(
-                self._clauses, {sub.id: True, sup.id: False}
-            )
-            self._cache[key] = got
+        if key in self._cache_true:
+            return True
+        if key in self._cache_false:
+            return False
+        got = not _dpll(self._clauses, {sub.id: True, sup.id: False})
+        (self._cache_true if got else self._cache_false)[key] = got
         return got
 
     def query_are_equal(self, a: Universe, b: Universe) -> bool:

@@ -167,6 +167,7 @@ class RagEvaluator:
             if missing:
                 n_missing += 1
             res, docs = by_prompt.get(s.question, ("", ()))
+            res = str(res or "")  # a None/errored answer scores 0, not crash
             docs = list(docs or ())
             gold_tokens = set(_normalize(s.answer).split())
             needle = _normalize(s.source or s.answer)

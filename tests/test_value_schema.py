@@ -164,3 +164,37 @@ class TestUniverseSatSolver:
         a, b = U(), U()
         assert s.get_union(a, b) is s.get_union(b, a)
         assert s.get_intersection(a, b) is s.get_intersection(b, a)
+
+
+def test_hash_values_fast_path_matches_reference():
+    """The buffered fast path must stay digest-identical to the per-value
+    reference implementation — these 128-bit keys are stability-critical
+    (sharding, persistence, cross-version row identity)."""
+    import datetime
+    import random
+
+    import numpy as np
+
+    from pathway_tpu.engine.value import (
+        ERROR,
+        Json,
+        Pointer,
+        _hash_values_slow,
+        hash_values,
+    )
+
+    pool = [
+        0, 1, -1, 2**70, -(2**70), True, False, None, "", "héllo",
+        3.14, -0.0, 5.0, float("nan"), float("inf"), 2.0**80,
+        b"bytes", (1, "x"), [1, 2], Pointer(12345), ERROR,
+        np.int64(7), np.float64(2.5), Json({"k": [1, 2]}),
+        datetime.datetime(2024, 1, 1, 12), datetime.timedelta(seconds=90),
+        np.arange(6).reshape(2, 3),
+    ]
+    rng = random.Random(7)
+    for _ in range(500):
+        vals = tuple(rng.choice(pool) for _ in range(rng.randrange(0, 5)))
+        salt = rng.choice([b"", b"join", b"groupby"])
+        assert hash_values(vals, salt=salt) == _hash_values_slow(
+            vals, salt=salt
+        ), (vals, salt)
