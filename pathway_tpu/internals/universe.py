@@ -165,8 +165,8 @@ class UniverseSolver:
         # clause sets only grow, and subset=True means UNSAT — which more
         # clauses can never undo: positive answers cache forever, negative
         # answers are dropped (O(1)) whenever clauses are added
-        self._cache_true: dict[tuple[int, int], bool] = {}
-        self._cache_false: dict[tuple[int, int], bool] = {}
+        self._cache_true: set[tuple[int, int]] = set()
+        self._cache_false: set[tuple[int, int]] = set()
 
     def _add(self, *clauses: tuple[int, ...]) -> None:
         self._clauses.extend(clauses)
@@ -240,7 +240,7 @@ class UniverseSolver:
         if key in self._cache_false:
             return False
         got = not _dpll(self._clauses, {sub.id: True, sup.id: False})
-        (self._cache_true if got else self._cache_false)[key] = got
+        (self._cache_true if got else self._cache_false).add(key)
         return got
 
     def query_are_equal(self, a: Universe, b: Universe) -> bool:

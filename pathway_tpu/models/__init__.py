@@ -26,6 +26,7 @@ from pathway_tpu.models.decoder import (
     decoder_forward,
     decoder_param_spec,
     greedy_generate,
+    sample_generate,
     init_decoder_params,
     mistral_7b,
     tiny_decoder,
@@ -51,6 +52,7 @@ __all__ = [
     "encoder_forward",
     "encoder_param_spec",
     "greedy_generate",
+    "sample_generate",
     "info_nce_loss",
     "init_cross_encoder_params",
     "init_decoder_params",

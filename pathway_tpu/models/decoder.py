@@ -233,15 +233,19 @@ def decoder_forward(
     return logits, cache
 
 
-def greedy_generate(
+def _generate_loop(
     params: Params,
-    prompt_ids: jax.Array,  # [b, t_prompt]
+    prompt_ids: jax.Array,
     cfg: DecoderConfig,
     max_new_tokens: int,
-    eos_id: int | None = None,
-    prompt_mask: jax.Array | None = None,  # [b, t_prompt] True = real token
+    eos_id: int | None,
+    prompt_mask: jax.Array | None,
+    choose,
 ) -> jax.Array:
-    """Greedy decode with a static-shape cache; returns ``[b, max_new]``.
+    """Shared decode scaffold: prompt prefill, per-step cache decode,
+    EOS padding. ``choose(logits [b, vocab], step_no) -> [b] int32`` picks
+    each next token (argmax for greedy, filtered categorical for
+    sampling).
 
     ``prompt_mask`` handles left-padded batches of unequal-length prompts:
     pad slots are never attended to and RoPE positions are shifted so every
@@ -264,21 +268,98 @@ def greedy_generate(
         attn_mask=prompt_mask,
         pos_offset=pos_offset,
     )
-    next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    next_tok = choose(logits[:, -1], 0)
     done = jnp.zeros((b,), bool)
 
-    def step(carry, _):
+    def step(carry, step_no):
         cache, tok, done = carry
         logits, cache = decoder_forward(
             params, tok[:, None], cfg, cache, pos_offset=pos_offset
         )
-        new_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        new_tok = choose(logits[:, -1], step_no + 1)
         if eos_id is not None:
             done = done | (tok == eos_id)
             new_tok = jnp.where(done, eos_id, new_tok)
         return (cache, new_tok, done), tok
 
     (_, _, _), toks = lax.scan(
-        step, (cache, next_tok, done), None, length=max_new_tokens
+        step, (cache, next_tok, done), jnp.arange(max_new_tokens)
     )
     return toks.transpose(1, 0)  # [b, max_new]
+
+
+def greedy_generate(
+    params: Params,
+    prompt_ids: jax.Array,  # [b, t_prompt]
+    cfg: DecoderConfig,
+    max_new_tokens: int,
+    eos_id: int | None = None,
+    prompt_mask: jax.Array | None = None,  # [b, t_prompt] True = real token
+) -> jax.Array:
+    """Greedy decode with a static-shape cache; returns ``[b, max_new]``."""
+
+    def choose(logits: jax.Array, _step: Any) -> jax.Array:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return _generate_loop(
+        params, prompt_ids, cfg, max_new_tokens, eos_id, prompt_mask, choose
+    )
+
+
+def _filter_logits(
+    logits: jax.Array, top_k: int | None, top_p: float | None
+) -> jax.Array:
+    """HF-style logit filtering: keep the top-k logits and/or the nucleus
+    whose cumulative probability reaches top_p; everything else -> -inf."""
+    if top_k is not None and 0 < top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_desc = -jnp.sort(-logits, axis=-1)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # keep tokens up to and including the one crossing top_p; the
+        # exclusive-cumulative test against a positive threshold always
+        # keeps the argmax (HF's min_tokens_to_keep=1) — clamp guards
+        # top_p<=0, which would otherwise mask EVERY logit to -inf
+        keep_sorted = (cumulative - probs) < max(top_p, 1e-9)
+        kept_min = jnp.min(
+            jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= kept_min, logits, -jnp.inf)
+    return logits
+
+
+def sample_generate(
+    params: Params,
+    prompt_ids: jax.Array,  # [b, t_prompt]
+    cfg: DecoderConfig,
+    max_new_tokens: int,
+    row_seeds: jax.Array,  # [b] uint32 — per-row PRNG seeds
+    *,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_id: int | None = None,
+    prompt_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Sampling decode (reference HFPipelineChat forwards do_sample/
+    temperature/top_k/top_p to HF generate, llms.py:441): temperature
+    scaling then top-k/top-p filtering then categorical sampling, with a
+    per-ROW PRNG key folded per step — so each row's generation is a
+    deterministic function of (params, its prompt, its seed), independent
+    of how rows are batched (the engine's retraction consistency needs
+    deterministic UDF outputs)."""
+    keys = jax.vmap(jax.random.key)(row_seeds)
+    inv_temp = 1.0 / max(temperature, 1e-6)
+
+    def choose(logits: jax.Array, step_no: Any) -> jax.Array:
+        step_keys = jax.vmap(jax.random.fold_in, (0, None))(keys, step_no)
+        filtered = _filter_logits(logits * inv_temp, top_k, top_p)
+        return jax.vmap(jax.random.categorical)(step_keys, filtered).astype(
+            jnp.int32
+        )
+
+    return _generate_loop(
+        params, prompt_ids, cfg, max_new_tokens, eos_id, prompt_mask, choose
+    )

@@ -40,7 +40,13 @@ class TpuPipelineChat(UDF):
         tokenizer: Any = None,
         seed: int = 0,
         max_batch_size: int = 8,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> None:
+        import zlib
+
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -49,6 +55,7 @@ class TpuPipelineChat(UDF):
             greedy_generate,
             init_decoder_params,
             mistral_7b,
+            sample_generate,
             tiny_decoder,
         )
 
@@ -75,14 +82,38 @@ class TpuPipelineChat(UDF):
             for i, e in enumerate(encoded):
                 ids[i, t_max - len(e) :] = e  # left-pad: generation is at end
                 mask[i, t_max - len(e) :] = True
-            toks = greedy_generate(
-                params,
-                jnp.asarray(ids),
-                cfg,
-                max_new_tokens=mnt,
-                eos_id=2,
-                prompt_mask=jnp.asarray(mask),
-            )
+            if do_sample:
+                # per-row seed from (seed, prompt text): sampling stays a
+                # deterministic function of the row, independent of batch
+                # composition (retraction consistency)
+                row_seeds = np.asarray(
+                    [
+                        (zlib.crc32(t.encode()) ^ seed) & 0xFFFFFFFF
+                        for t in texts
+                    ],
+                    np.uint32,
+                )
+                toks = sample_generate(
+                    params,
+                    jnp.asarray(ids),
+                    cfg,
+                    max_new_tokens=mnt,
+                    row_seeds=jnp.asarray(row_seeds),
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                    eos_id=2,
+                    prompt_mask=jnp.asarray(mask),
+                )
+            else:
+                toks = greedy_generate(
+                    params,
+                    jnp.asarray(ids),
+                    cfg,
+                    max_new_tokens=mnt,
+                    eos_id=2,
+                    prompt_mask=jnp.asarray(mask),
+                )
             toks = np.asarray(toks)
             return [self.tokenizer.decode(list(row)) for row in toks]
 
@@ -90,7 +121,16 @@ class TpuPipelineChat(UDF):
             generate_batch,
             executor=batch_executor(max_batch_size=max_batch_size),
             deterministic=True,
-            cache_name=f"TpuPipelineChat:{model}:{max_new_tokens}:seed{seed}",
+            # sampling params only shape the output when do_sample is on;
+            # keeping them out of the greedy name preserves existing caches
+            cache_name=(
+                f"TpuPipelineChat:{model}:{max_new_tokens}:seed{seed}"
+                + (
+                    f":sample:{temperature}:{top_k}:{top_p}"
+                    if do_sample
+                    else ""
+                )
+            ),
         )
 
 
