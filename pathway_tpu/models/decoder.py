@@ -315,18 +315,21 @@ def _filter_logits(
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None and top_p < 1.0:
-        sorted_desc = -jnp.sort(-logits, axis=-1)
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_desc = jnp.take_along_axis(logits, order, axis=-1)
         probs = jax.nn.softmax(sorted_desc, axis=-1)
         cumulative = jnp.cumsum(probs, axis=-1)
         # keep tokens up to and including the one crossing top_p; the
         # exclusive-cumulative test against a positive threshold always
         # keeps the argmax (HF's min_tokens_to_keep=1) — clamp guards
-        # top_p<=0, which would otherwise mask EVERY logit to -inf
+        # top_p<=0, which would otherwise mask EVERY logit to -inf.
+        # Keep flags map back through the inverse permutation (index-based
+        # like HF, so boundary-logit TIES outside the nucleus are dropped
+        # rather than kept by a value threshold).
         keep_sorted = (cumulative - probs) < max(top_p, 1e-9)
-        kept_min = jnp.min(
-            jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits >= kept_min, logits, -jnp.inf)
+        inverse = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inverse, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
     return logits
 
 

@@ -40,6 +40,7 @@ class TpuPipelineChat(UDF):
         tokenizer: Any = None,
         seed: int = 0,
         max_batch_size: int = 8,
+        cache_tag: str | None = None,
         do_sample: bool = False,
         temperature: float = 1.0,
         top_k: int | None = None,
@@ -122,9 +123,22 @@ class TpuPipelineChat(UDF):
             executor=batch_executor(max_batch_size=max_batch_size),
             deterministic=True,
             # sampling params only shape the output when do_sample is on;
-            # keeping them out of the greedy name preserves existing caches
+            # keeping them out of the greedy name preserves existing caches.
+            # Custom params/tokenizer change generations: without an explicit
+            # cache_tag they get a per-instance namespace so two checkpoints
+            # can never serve each other's cached rows.
             cache_name=(
-                f"TpuPipelineChat:{model}:{max_new_tokens}:seed{seed}"
+                f"TpuPipelineChat:{model}:{max_new_tokens}:{max_prompt_len}"
+                f":seed{seed}"
+                + (
+                    f":tag{cache_tag}"
+                    if cache_tag is not None
+                    else (
+                        f":inst{id(self)}"
+                        if params is not None or tokenizer is not None
+                        else ""
+                    )
+                )
                 + (
                     f":sample:{temperature}:{top_k}:{top_p}"
                     if do_sample

@@ -306,3 +306,59 @@ def test_spawn_with_journal_persistence_resumes(tmp_path):
     ]
     finals = {r["word"]: r["cnt"] for r in rows2 if r["diff"] > 0}
     assert finals["banana"] == 2 and finals["cherry"] == 1
+
+
+def test_process_addresses_env_overrides_address_book(tmp_path, monkeypatch):
+    """PATHWAY_PROCESS_ADDRESSES replaces the 127.0.0.1:first_port+i book
+    (the multi-host deployment seam, reference config.rs:113-117 overridden
+    via env in k8s)."""
+    from pathway_tpu.engine.distributed import default_addresses
+
+    monkeypatch.setenv(
+        "PATHWAY_PROCESS_ADDRESSES", "hostA:7001; hostB:7002 ;hostC:7003"
+    )
+    assert default_addresses(3, 10_000) == [
+        ("hostA", 7001),
+        ("hostB", 7002),
+        ("hostC", 7003),
+    ]
+    with pytest.raises(ValueError, match="3 hosts for 2"):
+        default_addresses(2, 10_000)
+    monkeypatch.delenv("PATHWAY_PROCESS_ADDRESSES")
+    assert default_addresses(2, 9000) == [
+        ("127.0.0.1", 9000),
+        ("127.0.0.1", 9001),
+    ]
+
+
+def test_mesh_over_explicit_addresses(monkeypatch):
+    """The mesh dials the address book (localhost here; multi-host swaps
+    only the env var)."""
+    from pathway_tpu.engine.distributed import MeshTransport
+
+    base = _free_port_base(2)
+    monkeypatch.setenv(
+        "PATHWAY_PROCESS_ADDRESSES",
+        f"127.0.0.1:{base};127.0.0.1:{base + 1}",
+    )
+    transports = {}
+    errs = []
+
+    def build(pid):
+        try:
+            transports[pid] = MeshTransport(pid, 2, first_port=55555)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=build, args=(p,)) for p in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs and len(transports) == 2
+    try:
+        transports[0].send(1, ("cmd", "over-addresses"))
+        assert transports[1].recv(0, timeout=5) == ("cmd", "over-addresses")
+    finally:
+        for tr in transports.values():
+            tr.close()

@@ -219,3 +219,13 @@ class TestSamplingDecode:
         out2 = chat._fn(["other prompt"])
         assert isinstance(out1[0], str)
         assert out1[1] == out2[0]
+
+
+def test_top_p_boundary_ties_dropped_like_hf():
+    """A tail token whose logit TIES the nucleus boundary must be dropped
+    (sorted-index semantics), not kept by a value threshold."""
+    from pathway_tpu.models.decoder import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.4, 0.4, 0.2]], jnp.float32))
+    kept = np.asarray(_filter_logits(logits, None, 0.3))
+    assert np.isfinite(kept[0]).sum() == 1  # exactly one of the tied pair
