@@ -837,3 +837,189 @@ class TestWindowJoinSelectForms:
         assert rows_of(
             j.select(a=pw.left.a, b=pw.right.b, t2=left.t + right.t)
         ) == [("x", "p", 3)]
+
+
+class TestTemporalJoinModes:
+    """Left/right/outer temporal join modes (reference _interval_join.py
+    interval_join_left/right/outer, _asof_join.py)."""
+
+    def _lr(self):
+        left = T(
+            """
+            t  | a
+            1  | x
+            10 | y
+            """
+        )
+        right = T(
+            """
+            t | b
+            2 | p
+            """
+        )
+        return left, right
+
+    def test_interval_join_left_pads_unmatched(self):
+        import pathway_tpu.stdlib.temporal as tmp
+
+        left, right = self._lr()
+        j = tmp.interval_join_left(
+            left, right, left.t, right.t, tmp.interval(-2, 2)
+        ).select(a=pw.left.a, b=pw.right.b)
+        assert rows_of(j) == srt([("x", "p"), ("y", None)])
+
+    def test_interval_join_outer_pads_both(self):
+        import pathway_tpu.stdlib.temporal as tmp
+
+        left = T(
+            """
+            t  | a
+            10 | y
+            """
+        )
+        right = T(
+            """
+            t | b
+            2 | p
+            """
+        )
+        j = tmp.interval_join_outer(
+            left, right, left.t, right.t, tmp.interval(-2, 2)
+        ).select(a=pw.left.a, b=pw.right.b)
+        assert rows_of(j) == srt([("y", None), (None, "p")])
+
+    def test_interval_join_with_equality_condition(self):
+        import pathway_tpu.stdlib.temporal as tmp
+
+        left = T(
+            """
+            t | g | a
+            1 | u | x
+            1 | v | y
+            """
+        )
+        right = T(
+            """
+            t | g | b
+            2 | u | p
+            """
+        )
+        j = left.interval_join(
+            right,
+            pw.left.t,
+            pw.right.t,
+            tmp.interval(-2, 2),
+            pw.left.g == pw.right.g,
+        ).select(a=pw.left.a, b=pw.right.b)
+        assert rows_of(j) == [("x", "p")]
+
+    def test_asof_join_forward_and_nearest(self):
+        import pathway_tpu.stdlib.temporal as tmp
+
+        left = T(
+            """
+            t | a
+            5 | x
+            """
+        )
+        right = T(
+            """
+            t  | b
+            3  | early
+            6  | late
+            20 | far
+            """
+        )
+        fwd = tmp.asof_join(
+            left, right, left.t, right.t, direction="forward"
+        ).select(a=pw.left.a, b=pw.right.b)
+        assert rows_of(fwd) == [("x", "late")]
+        near = tmp.asof_join(
+            left, right, left.t, right.t, direction="nearest"
+        ).select(a=pw.left.a, b=pw.right.b)
+        assert rows_of(near) == [("x", "late")]  # |6-5| < |5-3|... no: 1 < 2
+
+    def test_window_behavior_keep_results_false_drops_expired(self):
+        """cutoff with keep_results=False retracts expired windows entirely
+        at end of stream (reference TimeColumnForget)."""
+        import pathway_tpu.stdlib.temporal as tmp
+        from pathway_tpu.debug import StreamGenerator
+
+        gen = StreamGenerator()
+        t = gen.table_from_list_of_batches(
+            [
+                [{"t": 1}],
+                [{"t": 25}],   # watermark far past window [0, 10)
+                [{"t": 3}],    # late: dropped by cutoff
+            ],
+            pw.schema_from_types(t=int),
+        )
+        win = t.windowby(
+            pw.this.t,
+            window=tmp.tumbling(duration=10),
+            behavior=tmp.common_behavior(cutoff=0, keep_results=True),
+        ).reduce(
+            start=pw.this["_pw_window_start"], n=pw.reducers.count()
+        )
+        (snap,) = run_tables(win)
+        got = dict(snap.values())
+        assert got[0] == 1  # late t=3 never counted
+        assert got[20] == 1
+
+
+class TestStdlibStatefulOrdered:
+    """pw.statistical.interpolate / pw.ordered.diff / pw.stateful.deduplicate
+    (reference stdlib/{statistical,ordered,stateful})."""
+
+    def test_interpolate_fills_interior_and_boundaries(self):
+        from pathway_tpu.stdlib.statistical import interpolate
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(ts=int, v=float | None),
+            [(0, None), (1, 10.0), (2, None), (3, 30.0), (4, None)],
+        )
+        r = interpolate(t, pw.this.ts, pw.this.v)
+        got = {row[0]: row[1] for row in rows_of(r)}
+        assert got[1] == 10.0 and got[3] == 30.0
+        assert got[2] == 20.0          # linear midpoint
+        assert got[0] == 10.0 and got[4] == 30.0  # boundary nearest
+
+    def test_ordered_diff_per_instance(self):
+        from pathway_tpu.stdlib.ordered import diff
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(ts=int, g=str, v=int),
+            [(1, "a", 10), (2, "a", 13), (3, "a", 11), (1, "b", 5), (4, "b", 9)],
+        )
+        r = diff(t, pw.this.ts, pw.this.v, instance=pw.this.g)
+        cols = r.column_names()
+        di = cols.index("diff_v")
+        gi = cols.index("g")
+        ti = cols.index("ts")
+        got = {
+            (row[gi], row[ti]): row[di] for row in rows_of(r)
+        }
+        assert got[("a", 1)] is None and got[("b", 1)] is None
+        assert got[("a", 2)] == 3 and got[("a", 3)] == -2
+        assert got[("b", 4)] == 4
+
+    def test_stateful_deduplicate_acceptor(self):
+        """Acceptor-gated dedup: a new value replaces the kept one only when
+        the acceptor approves (reference pw.stateful.deduplicate)."""
+        from pathway_tpu.stdlib.stateful import deduplicate
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(g=str, v=int),
+            [("a", 5), ("a", 3), ("a", 9), ("b", 1)],
+        )
+        r = deduplicate(
+            t,
+            value=pw.this.v,
+            instance=pw.this.g,
+            acceptor=lambda new, old: new > old,
+        )
+        cols = r.column_names()
+        vi = cols.index("v")
+        gi = cols.index("g")
+        got = {row[gi]: row[vi] for row in rows_of(r)}
+        assert got == {"a": 9, "b": 1}
