@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import secrets
 import shlex
 import subprocess
 import sys
@@ -33,6 +34,9 @@ def spawn(
 ) -> int:
     env_base = dict(os.environ if env is None else env)
     run_id = str(uuid.uuid4())
+    # fresh per-run key authenticating exchange-mesh frames (all processes
+    # share it; engine/distributed.py rejects unauthenticated frames)
+    env_base.setdefault("PATHWAY_EXCHANGE_SECRET", secrets.token_hex(32))
     print(
         f"Preparing {processes} process(es) "
         f"({processes * threads} total workers)",

@@ -292,12 +292,16 @@ class InputDriver:
         primary_key_indices: Sequence[int] | None = None,
         source_name: str = "input",
         append_metadata: bool = False,
+        autocommit_duration_ms: int | None = None,
     ) -> None:
         self.session = session
         self.reader = reader
         self.parser = parser
         self.pk = list(primary_key_indices) if primary_key_indices else None
         self.source_name = source_name
+        #: max seconds this connector's rows may wait before a commit
+        #: (the pump loop batches accordingly); 0 commits on every poll
+        self.autocommit_s = (autocommit_duration_ms or 0) / 1000.0
         self.append_metadata = append_metadata
         self._per_source_rows: dict[str, list[tuple[Pointer, tuple]]] = {}
         self._seq = 0

@@ -27,6 +27,7 @@ row-wise interpreter at extraction time (OverflowError → object dtype).
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Sequence
 
 import numpy as np
@@ -309,6 +310,10 @@ def rows_to_device_matrix(rows: Sequence[tuple], col: int, dtype=np.float32):
 
 # -- device-resident row cells ------------------------------------------------
 
+#: device batches produced since the last commit boundary (weak: a batch
+#: no row references anymore needs no decay)
+_LIVE_HANDLES: "weakref.WeakSet" = weakref.WeakSet()
+
 
 def _identity(arr: np.ndarray) -> np.ndarray:
     return arr
@@ -319,26 +324,58 @@ class DeviceBatchHandle:
     produced by device UDF batches (the embedder), consumed directly by
     device operators (the HBM index) without a host round trip.
 
-    Memory note: rows retained in engine state keep their batch alive, so
-    batches live in HBM until first host use (after which the device copy
-    is RELEASED and only the host twin remains). A pipeline that indexes
-    embeddings and also stores them in table rows therefore holds ~one
-    corpus copy in HBM (the index) plus per-batch arrays until/unless the
-    rows are touched host-side — comparable to the host-RAM copy the
-    eager path kept.
+    Lifecycle: within the producing commit BOTH copies may exist — a
+    subscribe callback materialising the host twin must not steal the
+    device copy from an index operator later in the same sweep. At
+    commit end the scheduler calls :func:`decay_device_batches`, which
+    downloads any still-live batch (the DMA was prefetched, so this is a
+    cheap wait) and releases its HBM. HBM usage is therefore bounded by
+    one commit's worth of batches; rows retained in table state hold
+    only the host twin — the same RAM the eager path used.
     """
 
-    __slots__ = ("dev", "_host")
+    __slots__ = ("dev", "_host", "_prefetched", "__weakref__")
 
     def __init__(self, dev: Any) -> None:
         self.dev = dev
         self._host = None
+        self._prefetched = False
+        _LIVE_HANDLES.add(self)
+
+    def prefetch(self) -> None:
+        """Start the device→host DMA without blocking. ``host()`` later
+        completes against the cached buffer instead of paying a full
+        synchronous round trip — over remote-device links this turns a
+        ~100 ms stall per batch into background transfer that overlaps
+        the next batch's tokenize+dispatch."""
+        if self._host is None and not self._prefetched:
+            copy_async = getattr(self.dev, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+            self._prefetched = True
 
     def host(self) -> np.ndarray:
         if self._host is None:
             self._host = np.asarray(self.dev)
-            self.dev = None  # free the HBM copy; host twin serves from now
         return self._host
+
+    def decay(self) -> None:
+        """Materialise the host twin and release the HBM copy."""
+        if self.dev is not None:
+            self.prefetch()
+            self.host()
+            self.dev = None
+
+
+def decay_device_batches() -> None:
+    """End-of-commit hook (called by every scheduler): download + release
+    all device batches produced this commit. Keeps HBM bounded by one
+    commit while letting any device operator in the commit consume the
+    batch transfer-free regardless of sweep order."""
+    if _LIVE_HANDLES:
+        for handle in list(_LIVE_HANDLES):
+            handle.decay()
+        _LIVE_HANDLES.clear()
 
 
 class LazyDeviceVector:
@@ -407,23 +444,64 @@ class LazyDeviceVector:
         return (_identity, (np.array(np.asarray(self)),))
 
 
-def lazy_rows(dev_batch: Any, n: int) -> list:
-    """Wrap a device ``[b, dim]`` result as ``n`` lazy per-row cells."""
+def lazy_rows(dev_batch: Any, n: int, prefetch: bool = True) -> list:
+    """Wrap a device ``[b, dim]`` result as ``n`` lazy per-row cells.
+
+    ``prefetch`` starts the host copy in the background immediately: the
+    device consumers (HBM index) slice ``dev`` regardless, and any host
+    consumer (subscribe callbacks, persistence) finds the bytes already
+    in flight."""
     handle = DeviceBatchHandle(dev_batch)
+    if prefetch:
+        handle.prefetch()
     return [LazyDeviceVector(handle, i) for i in range(n)]
 
 
+def device_runs(
+    vectors: Sequence[Any],
+) -> list[tuple[int, int, Any, list[int] | None]]:
+    """Partition ``vectors`` into maximal contiguous runs of
+    ``(start, stop, dev_array_or_None, row_indices_or_None)``.
+
+    A run with a device array means every vector in it is a
+    LazyDeviceVector of that one live batch — consumable by device
+    operators with a transfer-free gather. A ``None`` run is host data.
+    Batch-executor chunking makes several parents per commit the normal
+    case, so callers should iterate runs rather than requiring a single
+    common parent."""
+    runs: list[tuple[int, int, Any, list[int] | None]] = []
+    i, n = 0, len(vectors)
+    while i < n:
+        v = vectors[i]
+        if isinstance(v, LazyDeviceVector) and v.batch.dev is not None:
+            parent = v.batch
+            indices = [v.index]
+            j = i + 1
+            while (
+                j < n
+                and isinstance(vectors[j], LazyDeviceVector)
+                and vectors[j].batch is parent
+            ):
+                indices.append(vectors[j].index)
+                j += 1
+            runs.append((i, j, parent.dev, indices))
+        else:
+            j = i + 1
+            while j < n and not (
+                isinstance(vectors[j], LazyDeviceVector)
+                and vectors[j].batch.dev is not None
+            ):
+                j += 1
+            runs.append((i, j, None, None))
+        i = j
+    return runs
+
+
 def common_device_parent(vectors: Sequence[Any]) -> tuple[Any, list[int]] | None:
-    """When every vector is a LazyDeviceVector of one batch, return
-    (device array, row indices) for a transfer-free gather."""
-    if not vectors or not isinstance(vectors[0], LazyDeviceVector):
-        return None
-    parent = vectors[0].batch
-    if parent.dev is None:
-        return None  # already downloaded+released: host path
-    indices = [vectors[0].index]
-    for v in vectors[1:]:
-        if not isinstance(v, LazyDeviceVector) or v.batch is not parent:
-            return None
-        indices.append(v.index)
-    return parent.dev, indices
+    """When every vector is a LazyDeviceVector of one live batch, return
+    (device array, row indices) for a transfer-free gather. Thin shim over
+    :func:`device_runs` so liveness semantics live in one place."""
+    runs = device_runs(list(vectors))
+    if len(runs) == 1 and runs[0][2] is not None:
+        return runs[0][2], runs[0][3]
+    return None

@@ -31,6 +31,8 @@ Design (TPU-first, not a timely translation):
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import pickle
 import queue
@@ -52,6 +54,23 @@ from pathway_tpu.engine.sharded import _shard_of, partitioner
 from pathway_tpu.engine.value import Pointer
 
 _LEN = struct.Struct(">Q")
+_MAC_LEN = hashlib.sha256().digest_size
+
+
+def _mesh_secret() -> bytes:
+    """Shared frame-authentication key for the exchange mesh.
+
+    Frames are pickles, so an unauthenticated peer that can reach an
+    exchange port could otherwise execute arbitrary code. Every frame
+    carries an HMAC-SHA256 over its payload; frames that fail
+    verification tear the connection down before ``pickle.loads`` ever
+    sees the bytes. ``pathway spawn`` generates a fresh secret per run
+    (cli.py); multi-host deployments must set PATHWAY_EXCHANGE_SECRET to
+    the same value on every host."""
+    secret = os.environ.get("PATHWAY_EXCHANGE_SECRET") or os.environ.get(
+        "PATHWAY_RUN_ID"
+    )
+    return secret.encode() if secret else b""
 
 #: how long a process waits for a peer frame before declaring the run dead
 RECV_TIMEOUT = float(os.environ.get("PATHWAY_EXCHANGE_TIMEOUT", "600"))
@@ -103,11 +122,31 @@ class MeshTransport:
         self._send_locks: dict[int, threading.Lock] = {}
         self._threads: list[threading.Thread] = []
         self._closed = False
+        self._secret = _mesh_secret()
         if n_processes == 1:
             return
+        # bind only the configured interface (127.0.0.1 by default) — not
+        # 0.0.0.0 — so single-host meshes are unreachable off-box. NAT'd
+        # deployments whose advertised address is not locally bindable
+        # (Docker bridge) set PATHWAY_EXCHANGE_BIND (e.g. to 0.0.0.0).
+        bind_host = os.environ.get(
+            "PATHWAY_EXCHANGE_BIND", addrs[process_id][0]
+        )
+        loopback = ("127.0.0.1", "localhost", "::1")
+        exposed = bind_host not in loopback or any(
+            host not in loopback for host, _port in addrs
+        )
+        if exposed and not os.environ.get("PATHWAY_EXCHANGE_SECRET"):
+            # an off-loopback listener with a missing/guessable key would
+            # hand pickle.loads to anyone who can reach the port
+            raise RuntimeError(
+                "a non-loopback exchange listener requires "
+                "PATHWAY_EXCHANGE_SECRET (the same value on every host) "
+                "to authenticate peer frames"
+            )
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind((("0.0.0.0", addrs[process_id][1])))
+        listener.bind((bind_host, addrs[process_id][1]))
         listener.listen(n_processes)
         listener.settimeout(_CONNECT_DEADLINE)
         try:
@@ -171,10 +210,19 @@ class MeshTransport:
             n -= len(chunk)
         return b"".join(chunks)
 
-    @classmethod
-    def _read_frame(cls, sock: socket.socket) -> Any:
-        (length,) = _LEN.unpack(cls._read_exact(sock, _LEN.size))
-        return pickle.loads(cls._read_exact(sock, length))
+    def _read_frame(self, sock: socket.socket) -> Any:
+        (length,) = _LEN.unpack(self._read_exact(sock, _LEN.size))
+        mac = self._read_exact(sock, _MAC_LEN)
+        payload = self._read_exact(sock, length)
+        # authenticate BEFORE deserializing: a forged frame must never
+        # reach pickle.loads (ADVICE r2: unauthenticated pickle = RCE)
+        expected = hmac.new(self._secret, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, expected):
+            raise ConnectionError(
+                "exchange frame failed HMAC authentication "
+                "(PATHWAY_EXCHANGE_SECRET mismatch or foreign traffic)"
+            )
+        return pickle.loads(payload)
 
     def _recv_loop(self, peer: int, sock: socket.socket) -> None:
         q = self._queues[peer]
@@ -187,7 +235,8 @@ class MeshTransport:
     def _send(self, peer: int, frame: Any) -> None:
         payload = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
         lock = self._send_locks.get(peer)
-        data = _LEN.pack(len(payload)) + payload
+        mac = hmac.new(self._secret, payload, hashlib.sha256).digest()
+        data = _LEN.pack(len(payload)) + mac + payload
         if lock is None:
             self._socks[peer].sendall(data)
         else:
@@ -264,13 +313,17 @@ class DistributedScheduler:
         self.stats: dict[int, Any] = {}  # monitoring surface parity
         #: shared graph length: nodes with index >= n_shared exist only on
         #: process 0 / scope 0 (sink-side chains attached there). The
-        #: runner records it before attaching sinks; the min() fallback
-        #: only works when a second sink-free local scope exists.
-        self.n_shared = (
-            n_shared
-            if n_shared is not None
-            else min(len(s.nodes) for s in self.scopes)
-        )
+        #: runner measures it before attaching sink drivers; guessing it
+        #: here (e.g. min over local scopes) silently desynchronizes
+        #: routing when every local scope carries sink-side nodes
+        #: (ADVICE r2), so it is required.
+        if n_shared is None:
+            raise ValueError(
+                "n_shared is required: pass the shared graph length "
+                "measured before sink drivers are attached "
+                "(DistributedGraphRunner.attach_sinks records it)"
+            )
+        self.n_shared = n_shared
         #: producer index -> [(consumer index, port)] for process-0-only
         #: consumers, learned from the coordinator's topology broadcast
         self.extra_consumers: dict[int, list[tuple[int, int]]] = {}
@@ -565,6 +618,9 @@ class DistributedScheduler:
             for scope in self.scopes:
                 for node in scope.nodes:
                     node.on_time_end(time)
+        from pathway_tpu.engine.device import decay_device_batches
+
+        decay_device_batches()
         return any_work
 
     def commit_local(self) -> int:

@@ -44,6 +44,33 @@ def _bucket(n: int, minimum: int = 8) -> int:
 
 
 _gather_pad_jit = None
+_pack_results_jit = None
+
+
+def _pack_results(scores, slots):
+    """Stack (scores f32, slots i32) into ONE int32 array [2, q, k] (scores
+    bitcast) so the host pays a single device→host round trip per search —
+    each separate small fetch costs a full tunnel RTT on remote devices."""
+    global _pack_results_jit
+    if _pack_results_jit is None:
+        import jax
+
+        @jax.jit
+        def pack(s, i):
+            import jax.numpy as jnp
+            from jax import lax
+
+            return jnp.stack(
+                [
+                    lax.bitcast_convert_type(
+                        s.astype(jnp.float32), jnp.int32
+                    ),
+                    i.astype(jnp.int32),
+                ]
+            )
+
+        _pack_results_jit = pack
+    return _pack_results_jit(scores, slots)
 
 
 def _gather_pad(dev, idx_pad, enabled):
@@ -145,8 +172,45 @@ class DeviceKnnIndex:
         )
 
     def add(self, keys: Sequence[Pointer], vectors: Sequence[Any]) -> None:
-        if self._try_add_device(keys, vectors):
-            return
+        from pathway_tpu.engine.device import LazyDeviceVector
+
+        # Group lazy rows by their parent device batch — NOT by contiguous
+        # runs: upstream operators iterate key sets and scramble row order,
+        # which would fragment a 1000-row commit into ~1000 one-row device
+        # updates (measured: 732 updates/commit, whose device-queue depth
+        # then stalled the next query's search by ~6 s). One gather+scatter
+        # per parent keeps the device queue a few ops deep.
+        groups: dict[int, tuple[Any, list[int], list[Pointer]]] = {}
+        host_keys: list[Pointer] = []
+        host_vecs: list[Any] = []
+        for key, vec in zip(keys, vectors):
+            if (
+                isinstance(vec, LazyDeviceVector)
+                and vec.batch.dev is not None
+                and tuple(vec.batch.dev.shape[1:]) == (self.dim,)
+            ):
+                handle, indices, gkeys = groups.setdefault(
+                    id(vec.batch), (vec.batch, [], [])
+                )
+                indices.append(vec.index)
+                gkeys.append(key)
+            else:
+                host_keys.append(key)
+                host_vecs.append(vec)
+        for handle, indices, gkeys in groups.values():
+            if not self._add_device_run(gkeys, handle.dev, indices):
+                # replacements take the general path; the lazy rows
+                # materialise through their (prefetched) host twin
+                self._add_host(
+                    gkeys,
+                    [LazyDeviceVector(handle, i) for i in indices],
+                )
+        if host_keys:
+            self._add_host(host_keys, host_vecs)
+
+    def _add_host(
+        self, keys: Sequence[Pointer], vectors: Sequence[Any]
+    ) -> None:
         slots, vecs, valid = [], [], []
         deferred_free: list[int] = []  # freed only after the batch lands, so
         # a replaced key's old slot can't be reused (= written twice) in it
@@ -173,25 +237,19 @@ class DeviceKnnIndex:
         self._apply(slots, np.asarray(vecs, np.float32), valid)
         self._free.extend(deferred_free)
 
-    def _try_add_device(
-        self, keys: Sequence[Pointer], vectors: Sequence[Any]
+    def _add_device_run(
+        self, keys: Sequence[Pointer], dev: Any, indices: Sequence[int]
     ) -> bool:
-        """Transfer-free ingest: when the whole batch is lazy rows of one
-        device array (the embedder's jit output), gather on device and
+        """Transfer-free ingest of one run of lazy rows sharing a live
+        device batch (the embedder's jit output): gather on device and
         scatter straight into HBM — no device→host→device round trip
         (the bench pipeline's hot path)."""
-        from pathway_tpu.engine.device import common_device_parent
-
-        parent = common_device_parent(list(vectors))
-        if parent is None:
-            return False
+        if tuple(dev.shape[1:]) != (self.dim,):
+            return False  # rejection must precede any capacity growth
         if any(key in self.key_to_slot for key in keys):
             return False  # replacements take the general path
-        if len(self._free) < len(keys):
-            return False  # growth takes the general path
-        dev, indices = parent
-        if tuple(dev.shape[1:]) != (self.dim,):
-            return False
+        while len(self._free) < len(keys):
+            self._grow()  # device-side copy; cheaper than a host detour
 
         import jax.numpy as jnp
 
@@ -284,19 +342,39 @@ class DeviceKnnIndex:
             return []
         k_eff = min(k, self.capacity)
         b = _bucket(n)
-        q = np.zeros((b, self.dim), np.float32)
-        for i, vec in enumerate(queries):
-            q[i] = np.asarray(vec, np.float32).reshape(self.dim)
+        q_dev = None
+        from pathway_tpu.engine.device import device_runs
+
+        runs = device_runs(list(queries))
+        if (
+            len(runs) == 1
+            and runs[0][2] is not None
+            and tuple(runs[0][2].shape[1:]) == (self.dim,)
+        ):
+            # query vectors still live on device (embedder output): gather
+            # there and fetch only the top-k — one small round trip total
+            dev, indices = runs[0][2], runs[0][3]
+            idx_pad = np.zeros((b,), np.int32)
+            idx_pad[:n] = indices
+            enabled = np.zeros((b,), bool)
+            enabled[:n] = True
+            q_dev = _gather_pad(dev, jnp.asarray(idx_pad), jnp.asarray(enabled))
+        if q_dev is None:
+            q = np.zeros((b, self.dim), np.float32)
+            for i, vec in enumerate(queries):
+                q[i] = np.asarray(vec, np.float32).reshape(self.dim)
+            q_dev = jnp.asarray(q)
         if self.mesh is not None:
             scores, slots = knn_search_sharded(
-                self.state, jnp.asarray(q), k_eff, self.mesh, self.metric
+                self.state, q_dev, k_eff, self.mesh, self.metric
             )
         else:
             scores, slots = knn_search(
-                self.state, jnp.asarray(q), k_eff, self.metric
+                self.state, q_dev, k_eff, self.metric
             )
-        scores = np.asarray(scores)[:n]
-        slots = np.asarray(slots)[:n]
+        packed = np.asarray(_pack_results(scores, slots))
+        scores = packed[0].view(np.float32)[:n]
+        slots = packed[1][:n]
         out: list[list[tuple[Pointer, float]]] = []
         for i in range(n):
             hits = []

@@ -1570,6 +1570,9 @@ class Scheduler:
                         consumer.push(port, out)
         for node in scope.nodes:
             node.on_time_end(time)
+        from pathway_tpu.engine.device import decay_device_batches
+
+        decay_device_batches()
 
     def _end_nodes(self) -> None:
         """Run on_end hooks; they may inject final batches (buffer flush) —

@@ -253,6 +253,9 @@ class ShardedScheduler:
         for scope in self.scopes:
             for node in scope.nodes:
                 node.on_time_end(time)
+        from pathway_tpu.engine.device import decay_device_batches
+
+        decay_device_batches()
 
     def commit(self) -> int:
         for w, scope in enumerate(self.scopes):

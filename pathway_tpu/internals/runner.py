@@ -53,26 +53,59 @@ from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 def _pump_drivers(w0: "GraphRunner", drivers: list, on_data, on_idle=None) -> None:
     """The one streaming poll loop (GraphRunner / ShardedGraphRunner /
     DistributedGraphRunner all drive it): poll every connector driver,
-    call ``on_data()`` (which commits) whenever any driver produced rows or
-    finished, drain passive loopback sources (AsyncTransformer) once no
-    live driver can still feed them, and back off exponentially when idle
-    (``on_idle`` hooks extra idle work, e.g. coordinator pings)."""
+    accumulate rows into input sessions, and call ``on_data()`` (which
+    commits) when a driver's autocommit deadline expires or a driver
+    finishes. Also drains passive loopback sources (AsyncTransformer) once
+    no live driver can still feed them, and backs off exponentially when
+    idle (``on_idle`` hooks extra idle work, e.g. coordinator pings).
+
+    The autocommit window (``autocommit_duration_ms`` on each connector,
+    reference python/pathway/io/python/__init__.py read kwarg) is what
+    keeps commit granularity healthy: committing on every poll turns a
+    fast feed into thousands of tiny commits whose per-commit overhead
+    (scheduler sweep + device dispatch + decay barrier) dwarfs the row
+    work — measured 163 vs ~8000 docs/s on the RAG ingest bench. Data
+    waits at most the window; a 0-window connector (queries) pulls the
+    commit forward immediately."""
     import time as _time
 
     live = list(drivers)
     idle_spins = 0
+    pending = False  # rows sit in input sessions awaiting a commit
+    deadline = 0.0
     while live:
         produced = False
+        flush_now = False
         for d in list(live):
             status = d.poll()
             if status == "done":
                 live.remove(d)
                 produced = True
+                flush_now = True  # stream end surfaces immediately
+                # a driver's last poll can drain rows AND report EOF in
+                # one call — those rows are in the session now, so a
+                # commit must follow even if nothing else was pending
+                pending = True
             elif status == "data":
                 produced = True
-        if produced:
+                ac_deadline = _time.monotonic() + getattr(
+                    d, "autocommit_s", 0.0
+                )
+                deadline = min(deadline, ac_deadline) if pending else ac_deadline
+                pending = True
+        if pending and (flush_now or _time.monotonic() >= deadline):
             on_data()
+            pending = False
             idle_spins = 0
+            continue
+        if produced:
+            idle_spins = 0
+            continue  # keep draining the feed until the window closes
+        if pending:
+            # nothing new this sweep: sleep out (a slice of) the window
+            _time.sleep(
+                min(max(deadline - _time.monotonic(), 0.0), 0.001)
+            )
             continue
         notified = False
         if live and all(
@@ -1233,7 +1266,11 @@ class DistributedGraphRunner:
                 self.process_id,
                 self.processes,
                 transport,
-                n_shared=getattr(self, "n_shared", None),
+                # attach_sinks records the pre-attachment length; without
+                # sinks, every node is shared on every replica
+                n_shared=getattr(
+                    self, "n_shared", len(self.workers[0].scope.nodes)
+                ),
             )
             if self.monitor is not None:
                 self.monitor.scheduler = sched

@@ -47,6 +47,7 @@ def input_table(
     persistent_id: str | None = None,
     upstream_done: Callable[[], None] | None = None,
     upstream_table: Table | None = None,
+    autocommit_duration_ms: int | None = None,
 ) -> Table:
     """Create a connector-backed table (spec kind "input").
 
@@ -81,6 +82,7 @@ def input_table(
             primary_key_indices=pk_indices,
             source_name=source_name,
             append_metadata=with_metadata,
+            autocommit_duration_ms=autocommit_duration_ms,
         )
         if upstream_done is not None:
             driver.upstream_done = upstream_done

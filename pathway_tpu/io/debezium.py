@@ -40,4 +40,5 @@ def read(
         lambda names: DebeziumParser(names, key_field_names=pk, db_type=db_type),
         source_name=f"debezium:{topic_name}",
         persistent_id=persistent_id,
+        autocommit_duration_ms=autocommit_duration_ms,
     )

@@ -235,6 +235,7 @@ def read(
         lambda names: TransparentParser(names),
         source_name=f"deltalake:{uri}",
         persistent_id=persistent_id,
+        autocommit_duration_ms=autocommit_duration_ms,
     )
 
 

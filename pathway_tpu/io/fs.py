@@ -73,6 +73,7 @@ def read(
         source_name=f"fs:{path}",
         with_metadata=with_metadata,
         persistent_id=persistent_id,
+        autocommit_duration_ms=autocommit_duration_ms,
     )
 
 

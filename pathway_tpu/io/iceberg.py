@@ -205,7 +205,6 @@ class IcebergWriter:
         columns = list(zip(*self._rows))
         arrow = pa.table({n: list(c) for n, c in zip(names, columns)})
         n_rows = len(self._rows)
-        self._rows = []
         fname = f"{uuid.uuid4()}.parquet"
         fpath = os.path.join(self.location, _DATA, fname)
         pq.write_table(arrow, fpath)
@@ -295,6 +294,11 @@ class IcebergWriter:
             }
         )
         self._publish_metadata(version + 1, metadata)
+        # only a fully committed snapshot releases the buffer: if the
+        # parquet write or the exclusive version commit raised (lost
+        # catalog race), the rows stay queued for the next flush — an
+        # orphaned unreferenced data file is harmless, lost rows are not
+        self._rows = []
 
     def on_end(self) -> None:
         self.on_time_end(-1)
@@ -423,6 +427,7 @@ def read(
         lambda names: TransparentParser(names),
         source_name=f"iceberg:{loc}",
         persistent_id=persistent_id,
+        autocommit_duration_ms=autocommit_duration_ms,
     )
 
 

@@ -154,6 +154,7 @@ def read(
         make_parser,
         source_name=f"kafka:{topic}",
         persistent_id=persistent_id,
+        autocommit_duration_ms=autocommit_duration_ms,
     )
 
 

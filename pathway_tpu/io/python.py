@@ -113,4 +113,5 @@ def read(
         make_parser,
         source_name="python-connector",
         persistent_id=persistent_id,
+        autocommit_duration_ms=autocommit_duration_ms,
     )

@@ -32,4 +32,5 @@ def read(
         lambda: SqliteReader(path, table_name, column_names, mode=mode),
         lambda names: TransparentParser(names),
         source_name=f"sqlite:{path}:{table_name}",
+        autocommit_duration_ms=autocommit_duration_ms,
     )

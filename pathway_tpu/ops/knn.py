@@ -108,7 +108,12 @@ def _scores(
     """Higher-is-better scores ``[q, capacity]`` with invalid slots masked."""
     q = queries.astype(jnp.float32)
     db = state.vectors.astype(jnp.float32)
-    dots = jnp.einsum("qd,cd->qc", q, db)
+    # HIGHEST: TPU's default f32 matmul runs bf16 multiply passes, which
+    # alone costs ~4% top-10 overlap vs exact host search; the score
+    # matmul is tiny relative to embedding, so full precision is free
+    dots = jnp.einsum(
+        "qd,cd->qc", q, db, precision=lax.Precision.HIGHEST
+    )
     if metric == "dot":
         scores = dots
     elif metric == "cos":

@@ -9,7 +9,9 @@ tokenizer is accepted — the contract is just ``encode_batch``.
 
 from __future__ import annotations
 
+import functools
 import hashlib
+import re
 from typing import Any, Protocol, Sequence
 
 import numpy as np
@@ -38,10 +40,19 @@ def _is_cjk(ch: str) -> bool:
     )
 
 
+@functools.lru_cache(maxsize=1 << 16)
 def _hash_token(word: str, vocab_size: int) -> int:
+    # word frequencies are Zipfian, so the cache absorbs nearly every
+    # lookup on real text (the blake2s+mod was ~25% of ingest CPU)
     h = hashlib.blake2s(word.encode(), digest_size=4).digest()
     # ids 0..3 reserved (pad/cls/sep/unk)
     return 4 + int.from_bytes(h, "little") % (vocab_size - 4)
+
+
+#: alnum runs become words; any other non-space character is its own token
+#: (C-speed equivalent of the former per-character isalnum() scan, which
+#: dominated ingest profiles at ~0.5 s per 7k docs)
+_WORD_RE = re.compile(r"[^\W_]+|[^\w\s]|_")
 
 
 class HashTokenizer:
@@ -51,19 +62,7 @@ class HashTokenizer:
         self.vocab_size = vocab_size
 
     def _words(self, text: str) -> list[str]:
-        out, cur = [], []
-        for ch in str(text).lower():
-            if ch.isalnum():
-                cur.append(ch)
-            else:
-                if cur:
-                    out.append("".join(cur))
-                    cur = []
-                if not ch.isspace():
-                    out.append(ch)
-        if cur:
-            out.append("".join(cur))
-        return out
+        return _WORD_RE.findall(str(text).lower())
 
     def encode(self, text: str, max_len: int) -> list[int]:
         words = self._words(text)[: max_len - 2]

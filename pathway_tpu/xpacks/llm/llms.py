@@ -22,6 +22,57 @@ from pathway_tpu.internals.udfs import (
 from pathway_tpu.xpacks.llm._tokenizer import HashTokenizer
 
 
+def _checkpoint_digest(params: Any, tokenizer: Any) -> str:
+    """Stable fingerprint of a custom (params, tokenizer) pair, so a
+    persistent UDF cache survives restarts and distinguishes checkpoints
+    (ADVICE r2: ``id(self)`` changed per run and could repeat after gc).
+
+    Per leaf: tree path + shape + dtype + a 16-element head sample + a
+    whole-tensor float32 sum. Samples and sums ride ONE fused device
+    reduction and ONE device→host fetch (per-leaf fetches would cost a
+    tunnel RTT each at init) — a fine-tune that changes any weight
+    anywhere moves its leaf sum, without downloading the full tree."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    h = hashlib.blake2b(digest_size=8)
+    if params is not None:
+        leaves = sorted(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            key=lambda kv: str(kv[0]),
+        )
+
+        def fingerprint(ls):
+            rows = []
+            for x in ls:
+                flat = jnp.ravel(x).astype(jnp.float32)
+                head = jnp.zeros((16,), jnp.float32)
+                head = head.at[: min(16, flat.size)].set(flat[:16])
+                rows.append(jnp.concatenate([head, jnp.sum(flat)[None]]))
+            return jnp.stack(rows)
+
+        prints = np.asarray(
+            jax.jit(fingerprint)([leaf for _p, leaf in leaves])
+        )
+        for (path, leaf), row in zip(leaves, prints):
+            h.update(str(path).encode())
+            h.update(str(jnp.shape(leaf)).encode())
+            h.update(str(jnp.result_type(leaf)).encode())
+            h.update(np.ascontiguousarray(row).tobytes())
+    if tokenizer is not None:
+        h.update(type(tokenizer).__name__.encode())
+        vocab = getattr(tokenizer, "vocab", None)
+        if vocab is not None:
+            vocab_list = list(vocab)
+            h.update(str(len(vocab_list)).encode())
+            for tok in vocab_list[:8] + vocab_list[-8:]:
+                h.update(str(tok).encode())
+    return h.hexdigest()
+
+
 class TpuPipelineChat(UDF):
     """Local decode on TPU.
 
@@ -67,6 +118,7 @@ class TpuPipelineChat(UDF):
         self.max_new_tokens = max_new_tokens
         self.max_prompt_len = max_prompt_len
         self.tokenizer = tokenizer or HashTokenizer(self.config.vocab_size)
+        custom_weights = params is not None or tokenizer is not None
         if params is None:
             params = init_decoder_params(jax.random.key(seed), self.config)
         cfg = self.config
@@ -125,8 +177,9 @@ class TpuPipelineChat(UDF):
             # sampling params only shape the output when do_sample is on;
             # keeping them out of the greedy name preserves existing caches.
             # Custom params/tokenizer change generations: without an explicit
-            # cache_tag they get a per-instance namespace so two checkpoints
-            # can never serve each other's cached rows.
+            # cache_tag they get a content-derived namespace (stable across
+            # restarts) so two checkpoints can never serve each other's
+            # cached rows.
             cache_name=(
                 f"TpuPipelineChat:{model}:{max_new_tokens}:{max_prompt_len}"
                 f":seed{seed}"
@@ -134,8 +187,8 @@ class TpuPipelineChat(UDF):
                     f":tag{cache_tag}"
                     if cache_tag is not None
                     else (
-                        f":inst{id(self)}"
-                        if params is not None or tokenizer is not None
+                        f":ckpt{_checkpoint_digest(params, tokenizer)}"
+                        if custom_weights
                         else ""
                     )
                 )

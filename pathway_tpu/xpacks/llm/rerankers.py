@@ -50,8 +50,13 @@ class CrossEncoderReranker(UDF):
         if params is None:
             params = init_cross_encoder_params(jax.random.key(seed), self.config)
         cfg = self.config
-        self._jit_score = jax.jit(
-            lambda ids, mask: cross_encode(params, ids, mask, cfg)
+        # params as a runtime argument: closed-over arrays become HLO
+        # constants and inflate compile times by the full weight tree
+        import functools
+
+        self._jit_score = functools.partial(
+            jax.jit(lambda p, ids, mask: cross_encode(p, ids, mask, cfg)),
+            params,
         )
 
         def score_batch(docs: list, queries: list) -> list:

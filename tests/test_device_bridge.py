@@ -286,27 +286,38 @@ class TestLazyDeviceVectors:
         res = idx.search([np.eye(8, 16)[2]], k=1)
         assert res[0][0][0] == keys[2]
 
-    def test_host_use_downloads_once_and_releases_device(self):
+    def test_host_use_keeps_device_until_commit_decay(self):
         import jax.numpy as jnp
 
-        from pathway_tpu.engine.device import lazy_rows
+        from pathway_tpu.engine.device import (
+            decay_device_batches,
+            lazy_rows,
+        )
 
         rows = lazy_rows(jnp.arange(12.0).reshape(3, 4), 3)
         v = np.asarray(rows[1])
         assert np.allclose(v, [4, 5, 6, 7])
         handle = rows[0].batch
-        assert handle.dev is None  # HBM copy released after download
+        # mid-commit host use must NOT steal the device copy from device
+        # operators later in the same sweep (subscribe-before-index order)
+        assert handle.dev is not None
+        decay_device_batches()  # the scheduler's end-of-commit hook
+        assert handle.dev is None  # HBM released at the commit boundary
         assert np.allclose(np.asarray(rows[2]), [8, 9, 10, 11])
 
-    def test_released_batch_falls_back_to_host_add(self):
+    def test_decayed_batch_falls_back_to_host_add(self):
         import jax.numpy as jnp
 
-        from pathway_tpu.engine.device import common_device_parent, lazy_rows
+        from pathway_tpu.engine.device import (
+            common_device_parent,
+            decay_device_batches,
+            lazy_rows,
+        )
         from pathway_tpu.engine.external_index import DeviceKnnIndex
         from pathway_tpu.engine.value import ref_scalar
 
         rows = lazy_rows(jnp.eye(4, 8), 4)
-        np.asarray(rows[0])  # releases the device copy
+        decay_device_batches()  # commit boundary releases the device copy
         assert common_device_parent(rows) is None
         idx = DeviceKnnIndex(dim=8, capacity=16)
         idx.add([ref_scalar(i) for i in range(4)], rows)  # host path
@@ -339,23 +350,24 @@ class TestLazyDeviceVectors:
         restored = pickle.loads(pickle.dumps(rows[1]))
         assert np.allclose(restored, [4, 5, 6, 7])
 
-    def test_embedder_device_resident_opt_in(self, monkeypatch):
+    def test_embedder_device_resident_default_with_opt_out(self, monkeypatch):
         from pathway_tpu.engine.device import LazyDeviceVector
         from pathway_tpu.xpacks.llm.embedders import TpuEncoderEmbedder
 
-        eager = TpuEncoderEmbedder("minilm_l6", max_len=16)
-        out = eager._fn(["hello"])
-        assert isinstance(out[0], np.ndarray)
-
-        resident = TpuEncoderEmbedder(
-            "minilm_l6", max_len=16, device_resident=True
-        )
+        resident = TpuEncoderEmbedder("minilm_l6", max_len=16)
+        assert resident.device_resident  # lazy device rows are the default
         out = resident._fn(["hello"])
         assert isinstance(out[0], LazyDeviceVector)
 
-        monkeypatch.setenv("PATHWAY_DEVICE_RESIDENT_UDF", "1")
+        eager = TpuEncoderEmbedder(
+            "minilm_l6", max_len=16, device_resident=False
+        )
+        out = eager._fn(["hello"])
+        assert isinstance(out[0], np.ndarray)
+
+        monkeypatch.setenv("PATHWAY_DEVICE_RESIDENT_UDF", "0")
         via_env = TpuEncoderEmbedder("minilm_l6", max_len=16)
-        assert via_env.device_resident
+        assert not via_env.device_resident
 
 
 class TestNativeExtraction:
