@@ -269,6 +269,12 @@ def main() -> None:
     device_docs_per_sec = device_only_leg()
     docs_per_sec = stats.pop("pipeline_docs_per_sec")
     stats["device_docs_per_sec"] = round(device_docs_per_sec, 1)
+    if os.environ.get("BENCH_SKIP_DATAFLOW", "") not in ("1", "true"):
+        # host dataflow workloads (wordcount/join/groupby/filter at 1M rows
+        # + incremental phase) tracked in the same JSON line every round
+        import bench_dataflow
+
+        stats["dataflow_rows_per_sec"] = bench_dataflow.run_all()
     print(
         json.dumps(
             {

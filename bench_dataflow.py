@@ -127,6 +127,60 @@ def wordcount():
     return run
 
 
+def incremental_update():
+    """Streaming phase: after a 1M-row bulk load into a groupby, apply 100
+    small delta commits (1k inserts + 1k retractions each) — measures the
+    incremental maintenance rate, not bulk throughput."""
+    rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(N)]
+    n_commits, delta = 100, 1000
+
+    def run():
+        scope = Scope()
+        sess = scope.input_session(2)
+        scope.group_by_table(
+            sess,
+            by_cols=[0],
+            reducers=[(make_reducer(ReducerKind.SUM), [1])],
+        )
+        sched = Scheduler(scope)
+        for key, row in rows:
+            sess.insert(key, row)
+        sched.commit()
+        t = 0.0
+        for c in range(n_commits):
+            base = (c * delta) % (N - delta)
+            for i in range(base, base + delta):
+                key, row = rows[i]
+                sess.remove(key, row)
+                sess.insert(key, (row[0], row[1] + 1.0))
+            t += timed(sched.commit)
+        return t
+
+    def rows_per_sec():
+        t = run()
+        return round(n_commits * 2 * delta / t)
+
+    return rows_per_sec
+
+
+def run_all() -> dict:
+    """One pass over every workload -> {name: rows_per_sec}; consumed by
+    bench.py so the dataflow line is tracked in BENCH_r{N}.json every
+    round (VERDICT r2 #2)."""
+    out = {}
+    for name, make in (
+        ("groupby_sum", groupby_sum),
+        ("filter_expr", filter_expr),
+        ("wordcount", wordcount),
+    ):
+        run = make()
+        out[name] = round(N / min(run() for _ in range(2)))
+    run = join_inner()
+    out["join_inner"] = round((N // 2 + 50_000) / min(run() for _ in range(2)))
+    out["incremental_update"] = incremental_update()()
+    return out
+
+
 def main() -> None:
     for name, make in (
         ("groupby_sum", groupby_sum),
@@ -152,15 +206,23 @@ def main() -> None:
                 }
             )
         )
-    # join has no columnar/rowwise split (per-group incremental recompute)
+    # join path: C insert-only inner kernel (native/enginecore.cpp)
     run = join_inner()
     t = min(run() for _ in range(2))
     print(
         json.dumps(
             {
                 "workload": "join_inner",
-                "rows": N // 2,
-                "rows_per_sec": round((N // 2) / t),
+                "rows": N // 2 + 50_000,
+                "rows_per_sec": round((N // 2 + 50_000) / t),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "workload": "incremental_update",
+                "rows_per_sec": incremental_update()(),
             }
         )
     )

@@ -23,12 +23,16 @@ Entry = tuple[Pointer, tuple, int]
 class DeltaBatch:
     """A consolidatable batch of keyed row updates."""
 
-    __slots__ = ("entries", "_consolidated", "_insert_only")
+    __slots__ = ("entries", "_consolidated", "_insert_only", "_preapplied")
 
     def __init__(self, entries: Iterable[Entry] | None = None) -> None:
         self.entries: list[Entry] = list(entries) if entries is not None else []
         self._consolidated = False
         self._insert_only = False  # set by consolidate(): unique-key inserts
+        #: producer already wrote these rows into its own node state
+        #: (fused C kernels); only the PRODUCING node's apply is skipped —
+        #: flag never travels on delivered/copied batches
+        self._preapplied = False
 
     def append(self, key: Pointer, row: tuple, diff: int) -> None:
         if diff != 0:
@@ -122,6 +126,9 @@ def apply_batch_to_state(state: dict[Pointer, tuple], batch: DeltaBatch) -> None
     A table maps each key to exactly one row; an in-place update arrives as
     a retraction of the old row and an insertion of the new one.
     """
+    if batch._preapplied:
+        batch._preapplied = False  # one producing-node apply only
+        return
     if _native is not None:
         _native.apply_state(state, batch.entries, batch._insert_only)
         return

@@ -55,6 +55,12 @@ from pathway_tpu.engine.value import Pointer
 
 _LEN = struct.Struct(">Q")
 _MAC_LEN = hashlib.sha256().digest_size
+#: refuse frames beyond this size BEFORE allocating — an unauthenticated
+#: sender must not be able to drive unbounded buffering via the length
+#: prefix (the MAC also covers the length, so a tampered prefix fails)
+_MAX_FRAME = int(
+    os.environ.get("PATHWAY_EXCHANGE_MAX_FRAME", str(1 << 31))
+)
 
 
 def _mesh_secret() -> bytes:
@@ -211,12 +217,20 @@ class MeshTransport:
         return b"".join(chunks)
 
     def _read_frame(self, sock: socket.socket) -> Any:
-        (length,) = _LEN.unpack(self._read_exact(sock, _LEN.size))
+        len_bytes = self._read_exact(sock, _LEN.size)
+        (length,) = _LEN.unpack(len_bytes)
+        if length > _MAX_FRAME:
+            raise ConnectionError(
+                f"exchange frame of {length} bytes exceeds "
+                f"PATHWAY_EXCHANGE_MAX_FRAME={_MAX_FRAME}"
+            )
         mac = self._read_exact(sock, _MAC_LEN)
         payload = self._read_exact(sock, length)
         # authenticate BEFORE deserializing: a forged frame must never
         # reach pickle.loads (ADVICE r2: unauthenticated pickle = RCE)
-        expected = hmac.new(self._secret, payload, hashlib.sha256).digest()
+        expected = hmac.new(
+            self._secret, len_bytes + payload, hashlib.sha256
+        ).digest()
         if not hmac.compare_digest(mac, expected):
             raise ConnectionError(
                 "exchange frame failed HMAC authentication "
@@ -235,8 +249,11 @@ class MeshTransport:
     def _send(self, peer: int, frame: Any) -> None:
         payload = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
         lock = self._send_locks.get(peer)
-        mac = hmac.new(self._secret, payload, hashlib.sha256).digest()
-        data = _LEN.pack(len(payload)) + mac + payload
+        len_bytes = _LEN.pack(len(payload))
+        mac = hmac.new(
+            self._secret, len_bytes + payload, hashlib.sha256
+        ).digest()
+        data = len_bytes + mac + payload
         if lock is None:
             self._socks[peer].sendall(data)
         else:

@@ -395,7 +395,322 @@ PyObject *filter_truthy(PyObject *, PyObject *args) {
   return out;
 }
 
+/* -- blake2b (RFC 7693) for join result keys ---------------------------------
+ *
+ * Digest-identical to engine/value.py hash_values: digest_size=16,
+ * personal "pw-tpu-key", message = salt + per-value tagged bytes. Only the
+ * Pointer-pair message shape is produced here (join_result_key), so the
+ * implementation is the compact single-purpose core, not a general hash
+ * library.
+ */
+
+const uint64_t B2B_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+const uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+void b2b_compress(uint64_t h[8], const uint8_t block[128], uint64_t t,
+                  int last) {
+  uint64_t v[16], m[16];
+  for (int i = 0; i < 8; i++) v[i] = h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = B2B_IV[i];
+  v[12] ^= t; /* low counter word; messages here are far below 2^64 */
+  if (last) v[14] = ~v[14];
+  memcpy(m, block, 128); /* little-endian host assumed (x86/arm64) */
+  for (int r = 0; r < 12; r++) {
+    const uint8_t *s = B2B_SIGMA[r];
+#define B2B_G(a, b, c, d, x, y)                 \
+  v[a] = v[a] + v[b] + (x);                     \
+  v[d] = rotr64(v[d] ^ v[a], 32);               \
+  v[c] = v[c] + v[d];                           \
+  v[b] = rotr64(v[b] ^ v[c], 24);               \
+  v[a] = v[a] + v[b] + (y);                     \
+  v[d] = rotr64(v[d] ^ v[a], 16);               \
+  v[c] = v[c] + v[d];                           \
+  v[b] = rotr64(v[b] ^ v[c], 63);
+    B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+#undef B2B_G
+  }
+  for (int i = 0; i < 8; i++) h[i] ^= v[i] ^ v[i + 8];
+}
+
+/* blake2b-128 of a short (<=128 byte) message with personal "pw-tpu-key". */
+void b2b16_short(const uint8_t *msg, size_t len, uint8_t out[16]) {
+  uint64_t h[8];
+  for (int i = 0; i < 8; i++) h[i] = B2B_IV[i];
+  /* param block: digest_length=16, fanout=1, depth=1, personal @48 */
+  uint8_t param[64] = {0};
+  param[0] = 16;
+  param[2] = 1;
+  param[3] = 1;
+  memcpy(param + 48, "pw-tpu-key", 10);
+  uint64_t pw[8];
+  memcpy(pw, param, 64);
+  for (int i = 0; i < 8; i++) h[i] ^= pw[i];
+  uint8_t block[128] = {0};
+  memcpy(block, msg, len);
+  b2b_compress(h, block, (uint64_t)len, 1);
+  memcpy(out, h, 16);
+}
+
+/* -- insert-only inner-join delta --------------------------------------------
+ *
+ * The C floor under JoinNode._process_insert_only_inner (engine/graph.py):
+ * ΔR pairs against the pre-delta left arrangement, then ΔL against the
+ * post-delta right arrangement. Join keys limited to scalar types the
+ * Python _jk would hash unchanged (int/bool/float/str/bytes incl.
+ * subclasses like Pointer); anything else — or an ERROR cell — bails to
+ * the Python path BEFORE mutating either arrangement.
+ */
+
+int jk_value_ok(PyObject *v, PyObject *error_obj) {
+  if (v == error_obj) return 0;
+  return PyLong_Check(v) || PyFloat_Check(v) || PyUnicode_Check(v) ||
+         PyBytes_Check(v);
+}
+
+/* row key (Pointer int) -> 16 little-endian bytes; -1 on failure */
+int key_bytes(PyObject *key, uint8_t out[16]) {
+  if (!PyLong_Check(key)) return -1;
+#if PY_VERSION_HEX >= 0x030d0000
+  if (PyLong_AsNativeBytes(key, out, 16,
+                           Py_ASNATIVEBYTES_LITTLE_ENDIAN |
+                               Py_ASNATIVEBYTES_UNSIGNED_BUFFER) < 0)
+    return -1;
+#else
+  if (_PyLong_AsByteArray((PyLongObject *)key, out, 16, 1, 0) < 0) return -1;
+#endif
+  return 0;
+}
+
+/* blake2b16("join" + 0x04 lkey16 + 0x04 rkey16) -> new Pointer.
+ * Pointer construction goes through int.__new__ (PyLong_Type.tp_new)
+ * directly: the digest is 128 bits by construction, so the Python-level
+ * Pointer.__new__ masking wrapper adds nothing but a frame per pair
+ * (measured >50% of the join kernel's time). */
+PyObject *join_pair_key(PyObject *pointer_type, const uint8_t lk[16],
+                        const uint8_t rk[16]) {
+  uint8_t msg[4 + 17 + 17];
+  memcpy(msg, "join", 4);
+  msg[4] = 0x04; /* _H_POINTER */
+  memcpy(msg + 5, lk, 16);
+  msg[21] = 0x04;
+  memcpy(msg + 22, rk, 16);
+  uint8_t digest[16];
+  b2b16_short(msg, sizeof(msg), digest);
+  PyObject *as_int = _PyLong_FromByteArray(digest, 16, 1, 0);
+  if (!as_int) return nullptr;
+  /* thread-safe without locking: the GIL is held throughout */
+  static PyObject *argtuple = nullptr;
+  if (!argtuple || Py_REFCNT(argtuple) != 1) {
+    argtuple = PyTuple_New(1);
+    if (!argtuple) {
+      Py_DECREF(as_int);
+      return nullptr;
+    }
+  } else {
+    Py_XDECREF(PyTuple_GET_ITEM(argtuple, 0));
+  }
+  PyTuple_SET_ITEM(argtuple, 0, as_int);
+  PyObject *ptr =
+      PyLong_Type.tp_new((PyTypeObject *)pointer_type, argtuple, nullptr);
+  return ptr;
+}
+
+/* build the join-key tuple for one row; NULL with no error set = bail */
+PyObject *make_jk(PyObject *row, PyObject *cols, PyObject *error_obj) {
+  Py_ssize_t k = PyList_GET_SIZE(cols);
+  PyObject *jk = PyTuple_New(k);
+  if (!jk) return nullptr;
+  for (Py_ssize_t c = 0; c < k; c++) {
+    Py_ssize_t idx = PyLong_AsSsize_t(PyList_GET_ITEM(cols, c));
+    if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) <= idx) {
+      Py_DECREF(jk);
+      return nullptr;
+    }
+    PyObject *v = PyTuple_GET_ITEM(row, idx);
+    if (!jk_value_ok(v, error_obj)) {
+      Py_DECREF(jk);
+      return nullptr;
+    }
+    Py_INCREF(v);
+    PyTuple_SET_ITEM(jk, c, v);
+  }
+  return jk;
+}
+
+/* pair every arranged row of `group` with (key,row); append to out and
+ * mirror into `current` (the node state) */
+int emit_pairs(PyObject *out, PyObject *group, PyObject *key, PyObject *row,
+               int row_is_left, PyObject *pointer_type, PyObject *one,
+               PyObject *current, PyObject *jrk_fn) {
+  uint8_t kb[16];
+  if (key_bytes(key, kb) < 0) return -1;
+  PyObject *gk, *grow;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(group, &pos, &gk, &grow)) {
+    PyObject *okey;
+    if ((PyObject *)Py_TYPE(gk) == pointer_type) {
+      uint8_t gb[16];
+      if (key_bytes(gk, gb) < 0) return -1;
+      okey = row_is_left ? join_pair_key(pointer_type, kb, gb)
+                         : join_pair_key(pointer_type, gb, kb);
+    } else {
+      /* arrangement rows from an earlier bailed (Python-path) batch may
+       * carry non-Pointer keys; route those pairs through the Python
+       * join_result_key so both paths agree on result identity */
+      okey = row_is_left
+                 ? PyObject_CallFunctionObjArgs(jrk_fn, key, gk, nullptr)
+                 : PyObject_CallFunctionObjArgs(jrk_fn, gk, key, nullptr);
+    }
+    if (!okey) return -1;
+    PyObject *orow = row_is_left ? PySequence_Concat(row, grow)
+                                 : PySequence_Concat(grow, row);
+    if (!orow) {
+      Py_DECREF(okey);
+      return -1;
+    }
+    PyObject *entry = PyTuple_Pack(3, okey, orow, one);
+    int rc = entry ? PyList_Append(out, entry) : -1;
+    Py_XDECREF(entry);
+    if (rc == 0) rc = PyDict_SetItem(current, okey, orow);
+    Py_DECREF(okey);
+    Py_DECREF(orow);
+    if (rc < 0) return -1;
+  }
+  return 0;
+}
+
+/* one side of the delta: pair each entry against `probe_arr`, then insert
+ * it into `build_arr`. Returns 0 ok, -1 error (error set). */
+int join_side(PyObject *entries, PyObject *cols, PyObject *probe_arr,
+              PyObject *build_arr, PyObject *out, int is_left,
+              PyObject *error_obj, PyObject *pointer_type, PyObject *one,
+              PyObject *current, PyObject *jrk_fn) {
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    PyObject *key = PyTuple_GET_ITEM(e, 0);
+    PyObject *row = PyTuple_GET_ITEM(e, 1);
+    PyObject *jk = make_jk(row, cols, error_obj);
+    if (!jk) return -1; /* prescan guarantees this cannot happen */
+    PyObject *group = PyDict_GetItem(probe_arr, jk);
+    if (group && PyDict_Check(group) &&
+        emit_pairs(out, group, key, row, is_left, pointer_type, one,
+                   current, jrk_fn) < 0) {
+      Py_DECREF(jk);
+      return -1;
+    }
+    PyObject *build_group = PyDict_GetItem(build_arr, jk);
+    if (!build_group) {
+      build_group = PyDict_New();
+      if (!build_group || PyDict_SetItem(build_arr, jk, build_group) < 0) {
+        Py_XDECREF(build_group);
+        Py_DECREF(jk);
+        return -1;
+      }
+      Py_DECREF(build_group); /* arr holds it */
+    }
+    if (PyDict_SetItem(build_group, key, row) < 0) {
+      Py_DECREF(jk);
+      return -1;
+    }
+    Py_DECREF(jk);
+  }
+  return 0;
+}
+
+/* every entry well-formed, keys EXACTLY Pointer, jk cells scalar
+ * non-ERROR? Exact-Pointer matters: join_pair_key tags keys _H_POINTER
+ * unsigned-16LE, which only matches Python's hash_values for genuine
+ * Pointers — a plain (possibly negative) int key must bail to Python so
+ * the fast and general paths derive identical result keys. */
+int join_prescan(PyObject *entries, PyObject *cols, PyObject *error_obj,
+                 PyObject *pointer_type) {
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  Py_ssize_t k = PyList_GET_SIZE(cols);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 3) return 0;
+    if ((PyObject *)Py_TYPE(PyTuple_GET_ITEM(e, 0)) != pointer_type)
+      return 0;
+    PyObject *row = PyTuple_GET_ITEM(e, 1);
+    if (!PyTuple_Check(row)) return 0;
+    for (Py_ssize_t c = 0; c < k; c++) {
+      Py_ssize_t idx = PyLong_AsSsize_t(PyList_GET_ITEM(cols, c));
+      if (idx < 0 || PyTuple_GET_SIZE(row) <= idx) return 0;
+      if (!jk_value_ok(PyTuple_GET_ITEM(row, idx), error_obj)) return 0;
+    }
+  }
+  return 1;
+}
+
+/* join_insert_inner(left_entries, right_entries, left_on, right_on,
+ *                   left_arr, right_arr, error_obj, pointer_type, current)
+ *   -> entries list | None (bail to Python; arrangements untouched).
+ * `current` (the node's key->row state) is written alongside emission, so
+ * the scheduler's apply_batch_to_state pass is skipped (_preapplied). */
+PyObject *join_insert_inner(PyObject *, PyObject *args) {
+  PyObject *le, *re, *lon, *ron, *larr, *rarr, *error_obj, *pointer_type,
+      *current, *jrk_fn;
+  if (!PyArg_ParseTuple(args, "O!O!O!O!O!O!OOO!O", &PyList_Type, &le,
+                        &PyList_Type, &re, &PyList_Type, &lon, &PyList_Type,
+                        &ron, &PyDict_Type, &larr, &PyDict_Type, &rarr,
+                        &error_obj, &pointer_type, &PyDict_Type, &current,
+                        &jrk_fn))
+    return nullptr;
+  if (!PyType_Check(pointer_type) ||
+      !PyType_IsSubtype((PyTypeObject *)pointer_type, &PyLong_Type))
+    Py_RETURN_NONE; /* tp_new shortcut requires an int subclass */
+  if (!join_prescan(le, lon, error_obj, pointer_type) ||
+      !join_prescan(re, ron, error_obj, pointer_type))
+    Py_RETURN_NONE;
+  PyObject *out = PyList_New(0);
+  if (!out) return nullptr;
+  PyObject *one = PyLong_FromLong(1);
+  /* ΔR probes the pre-delta left arrangement and lands in right_arr... */
+  if (join_side(re, ron, larr, rarr, out, 0, error_obj, pointer_type, one,
+                current, jrk_fn) < 0 ||
+      /* ...then ΔL probes the post-delta right arrangement */
+      join_side(le, lon, rarr, larr, out, 1, error_obj, pointer_type, one,
+                current, jrk_fn) < 0) {
+    Py_DECREF(out);
+    Py_DECREF(one);
+    return nullptr;
+  }
+  Py_DECREF(one);
+  return out;
+}
+
 PyMethodDef methods[] = {
+    {"join_insert_inner", join_insert_inner, METH_VARARGS,
+     "join_insert_inner(l_entries, r_entries, l_on, r_on, l_arr, r_arr, "
+     "ERROR, Pointer) -> entries|None"},
     {"consolidate", consolidate, METH_VARARGS,
      "consolidate(entries) -> (entries|None, insert_only)"},
     {"apply_state", apply_state, METH_VARARGS,

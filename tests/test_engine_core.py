@@ -286,3 +286,46 @@ def test_error_log_is_table():
     logs = list(scope.error_log_default.current.values())
     assert len(logs) == 1
     assert "ZeroDivisionError" in logs[0][0]
+
+
+def test_groupby_distinguishes_bool_from_int_keys():
+    # dict equality is coarser than the type-tagged key digest: True == 1
+    # but they are distinct groups; the gkey cache must not merge them
+    scope = Scope()
+    sess = scope.input_session(2)
+    out = scope.group_by_table(
+        sess,
+        by_cols=[0],
+        reducers=[(make_reducer(ReducerKind.SUM), [1])],
+    )
+    sched = Scheduler(scope)
+    sess.insert(k(1), (1, 10.0))
+    sess.insert(k(2), (True, 5.0))
+    sess.insert(k(3), (1, 7.0))
+    sched.commit()
+    rows = sorted(out.current.values(), key=repr)
+    assert len(rows) == 2, rows
+    assert (1, 17.0) in rows and (True, 5.0) in rows
+    # retraction routed later must hit the right group
+    sess.remove(k(2), (True, 5.0))
+    sched.commit()
+    assert list(out.current.values()) == [(1, 17.0)]
+
+
+def test_join_plain_int_row_keys_consistent_across_paths():
+    # entry keys that are NOT Pointer bail out of the C fast path; pairs
+    # probing arrangements populated either way must derive the same
+    # result keys, so a later retraction cancels the earlier insert
+    scope = Scope()
+    left = scope.input_session(2)
+    right = scope.input_session(2)
+    out = scope.join_tables(left, right, [0], [0], kind=JoinKind.INNER)
+    sched = Scheduler(scope)
+    left.insert(-5, ("x", 1))  # plain negative int key: Python path
+    sched.commit()
+    right.insert(k(10), ("x", 100))  # Pointer keys: C fast path probes
+    sched.commit()
+    assert set(out.current.values()) == {("x", 1, "x", 100)}
+    right.remove(k(10), ("x", 100))  # general path retraction
+    sched.commit()
+    assert out.current == {}
