@@ -264,6 +264,189 @@ def pipeline_leg() -> dict:
     }
 
 
+def vector_store_leg() -> dict:
+    """BASELINE config #2: VectorStoreServer streaming ingest + retrieve
+    with a BGE-base-class encoder (768 hidden, 12 layers), through the
+    DocumentStore dataflow (parser -> splitter -> embedder -> KNN)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.xpacks.llm.embedders import TpuEncoderEmbedder
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    G.clear()
+    n_docs = int(os.environ.get("BENCH_VS_DOCS", "3000"))
+    n_queries = int(os.environ.get("BENCH_VS_QUERIES", "16"))
+    embedder = TpuEncoderEmbedder(
+        model="BAAI/bge-base-en-v1.5",
+        max_len=SEQ_LEN,
+        max_batch_size=CHUNK,
+        seq_bucket_min=SEQ_LEN,
+    )
+    # warm the jit buckets outside the timed window
+    for b in (8, 64, CHUNK):
+        embedder._fn([_doc_text(i) for i in range(b)])
+
+    corpus = [_doc_text(i) for i in range(n_docs)]
+    ingest_done = threading.Event()
+    answer_seen = threading.Event()
+    timing = {"run_start": 0.0, "ingest_end": 0.0}
+    latencies: list[float] = []
+    answers: list = []
+    n_chunks = [0]
+
+    class DocFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            timing["run_start"] = time.perf_counter()
+            for i in range(n_docs):
+                self.next(data=corpus[i], _metadata={"path": f"/d/{i}"})
+
+    class QueryFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            ingest_done.wait()
+            for i in range(n_queries):
+                answer_seen.clear()
+                t0 = time.perf_counter()
+                self.next(query=corpus[(i * 53) % n_docs], k=K)
+                if answer_seen.wait(timeout=120.0):
+                    latencies.append(time.perf_counter() - t0)
+
+    docs = pw.io.python.read(
+        DocFeed(),
+        schema=pw.schema_from_types(data=str, _metadata=dict),
+        autocommit_duration_ms=100,
+    )
+    store = VectorStoreServer(
+        docs,
+        embedder=embedder,
+        index_capacity=1 << max(10, (n_docs - 1).bit_length()),
+    )
+    queries = pw.io.python.read(
+        QueryFeed(),
+        schema=pw.schema_from_types(query=str, k=int),
+        autocommit_duration_ms=None,
+    )
+    res = store.retrieve_query(queries)
+    perf_counter = time.perf_counter
+
+    def on_chunk(key, row, time, is_addition):
+        if is_addition:
+            n_chunks[0] += 1
+            if n_chunks[0] == n_docs:
+                timing["ingest_end"] = perf_counter()
+                ingest_done.set()
+
+    def on_answer(key, row, time, is_addition):
+        if is_addition:
+            answers.append(row["result"])
+            answer_seen.set()
+
+    pw.io.subscribe(store.chunks, on_change=on_chunk)
+    pw.io.subscribe(res, on_change=on_answer)
+    pw.run()
+    elapsed = timing["ingest_end"] - timing["run_start"]
+    lat_ms = sorted(1000.0 * x for x in latencies)
+    hit = sum(
+        1
+        for i, r in enumerate(answers)
+        if r and r[0]["text"] == corpus[(i * 53) % n_docs]
+    )
+    return {
+        "docs_per_sec": round(n_docs / elapsed, 1) if elapsed > 0 else None,
+        "query_p50_ms": round(lat_ms[len(lat_ms) // 2], 1) if lat_ms else None,
+        "n_docs": n_docs,
+        "top1_self_retrieval": round(hit / max(len(answers), 1), 4),
+        "encoder": "bge_base(768h/12L) seq 128",
+    }
+
+
+def reranker_leg() -> dict:
+    """BASELINE config #3: CrossEncoderReranker throughput (pairs/s) on the
+    jit cross-encoder (ms-marco-MiniLM class), batch 64 x seq buckets."""
+    from pathway_tpu.xpacks.llm.rerankers import CrossEncoderReranker
+
+    batch = int(os.environ.get("BENCH_RERANK_BATCH", "256"))
+    rr = CrossEncoderReranker(max_batch_size=batch)
+    docs = [_doc_text(i) for i in range(batch)]
+    queries = [_doc_text(i * 7) for i in range(batch)]
+    rr._fn(docs, queries)  # warm
+    t0 = time.perf_counter()
+    pairs = 0
+    while time.perf_counter() - t0 < 3.0:
+        scores = rr._fn(docs, queries)
+        pairs += len(scores)
+    dt = time.perf_counter() - t0
+    return {"pairs_per_sec": round(pairs / dt, 1), "batch": batch}
+
+
+def decode_leg() -> dict:
+    """BASELINE config #4: TpuPipelineChat local decode (Mistral-7B shape,
+    bf16 weights) — prefill latency, per-step latency, tokens/s, rough
+    decode MFU on the single chip."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models import init_decoder_params, mistral_7b
+    from pathway_tpu.models.decoder import DecoderConfig, greedy_generate
+
+    preset = os.environ.get("BENCH_DECODE_PRESET", "mistral-7b")
+    cfg = mistral_7b()
+    label = "mistral-7b"
+    if preset != "mistral-7b":
+        cfg = DecoderConfig(layers=int(preset))
+        label = f"mistral-7b-shape/{cfg.layers}L"
+    try:
+        params = init_decoder_params(jax.random.key(0), cfg, jnp.bfloat16)
+        jax.block_until_ready(params["lm_head"])
+    except Exception:
+        # chip too small for the full depth: largest fitting half-model
+        cfg = DecoderConfig(layers=mistral_7b().layers // 2)
+        label = f"mistral-7b-shape/{cfg.layers}L (full depth OOM)"
+        params = init_decoder_params(jax.random.key(0), cfg, jnp.bfloat16)
+
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params)
+    )
+    prompt = jnp.ones((1, SEQ_LEN), jnp.int32)
+
+    def gen(n_new):
+        return jax.jit(
+            functools.partial(
+                greedy_generate, cfg=cfg, max_new_tokens=n_new
+            ),
+        )
+
+    g4, g36 = gen(4), gen(36)
+    jax.block_until_ready(g4(params, prompt))  # compile + warm
+    jax.block_until_ready(g36(params, prompt))
+    t0 = time.perf_counter()
+    jax.block_until_ready(g4(params, prompt))
+    t4 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(g36(params, prompt))
+    t36 = time.perf_counter() - t0
+    per_step = (t36 - t4) / 32.0
+    prefill = max(t4 - 4 * per_step, 0.0)
+    tok_s = 1.0 / per_step if per_step > 0 else None
+    # decode step moves ~2 FLOPs per weight; v5e bf16 peak ~197 TFLOP/s.
+    # At batch 1 decode is HBM-bandwidth-bound (every step streams the
+    # full bf16 weight set), so bandwidth utilization vs the v5e's
+    # ~819 GB/s is the meaningful efficiency axis, not MFU.
+    mfu = (2.0 * n_params * tok_s) / 197e12 if tok_s else None
+    hbm_util = (2.0 * n_params * tok_s) / 819e9 if tok_s else None
+    return {
+        "model": label,
+        "n_params_b": round(n_params / 1e9, 2),
+        "prefill_ms": round(prefill * 1000, 1),
+        "per_step_ms": round(per_step * 1000, 2),
+        "decode_tokens_per_sec": round(tok_s, 1) if tok_s else None,
+        "decode_mfu": round(mfu, 4) if mfu else None,
+        "decode_hbm_utilization": round(hbm_util, 3) if hbm_util else None,
+        "prompt_len": SEQ_LEN,
+    }
+
+
 def main() -> None:
     stats = pipeline_leg()
     device_docs_per_sec = device_only_leg()
@@ -275,6 +458,13 @@ def main() -> None:
         import bench_dataflow
 
         stats["dataflow_rows_per_sec"] = bench_dataflow.run_all()
+    # BASELINE configs #2-#4 (VERDICT r2 #4); each skippable via env
+    if os.environ.get("BENCH_SKIP_VECTOR_STORE", "") not in ("1", "true"):
+        stats["config2_vector_store"] = vector_store_leg()
+    if os.environ.get("BENCH_SKIP_RERANKER", "") not in ("1", "true"):
+        stats["config3_reranker"] = reranker_leg()
+    if os.environ.get("BENCH_SKIP_DECODE", "") not in ("1", "true"):
+        stats["config4_decode"] = decode_leg()
     print(
         json.dumps(
             {

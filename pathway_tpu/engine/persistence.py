@@ -320,27 +320,212 @@ class OperatorSnapshotManager:
         else:  # pre-multi-worker snapshot layout
             sigs = [payload["sig"]]
             per_worker = [payload["nodes"]]
-        if len(per_worker) != len(scopes):
+        if [type(n).__name__ for n in scopes[0].nodes] != sigs[0]:
             raise ValueError(
-                f"operator snapshot was taken with {len(per_worker)} "
-                f"worker(s) but this run has {len(scopes)}; operator "
-                "persistence cannot rescale workers — use input-journal "
-                "persistence (PersistenceMode.PERSISTING) to change the "
-                "worker count"
+                "operator snapshot does not match this graph (operator "
+                "sequence changed); clear the persistence location or "
+                "use input-journal persistence across code changes"
             )
-        for s, sig in zip(scopes, sigs):
-            if [type(n).__name__ for n in s.nodes] != sig:
-                raise ValueError(
-                    "operator snapshot does not match this graph (operator "
-                    "sequence changed); clear the persistence location or "
-                    "use input-journal persistence across code changes"
-                )
+        if len(per_worker) != len(scopes):
+            # worker count changed: merge the old shards and re-split with
+            # the sharded scheduler's own routing (reference: re-sharded
+            # snapshot reads on worker-count change, persistence/config.rs:
+            # 126-163)
+            per_worker = _reshard_worker_states(per_worker, scopes)
+        else:
+            for s, sig in zip(scopes, sigs):
+                if [type(n).__name__ for n in s.nodes] != sig:
+                    raise ValueError(
+                        "operator snapshot does not match this graph "
+                        "(operator sequence changed); clear the persistence "
+                        "location or use input-journal persistence across "
+                        "code changes"
+                    )
         for s, states in zip(scopes, per_worker):
             for node, state in zip(s.nodes, states):
                 node.restore_op_state(state)
         for driver, state in zip(drivers, payload["drivers"]):
             self._restore_driver(driver, state)
         return int(payload.get("time", 0))
+
+
+def _reshard_worker_states(
+    per_worker: list[list[dict]], scopes: list
+) -> list[list[dict]]:
+    """Re-shard operator snapshots onto a different worker count.
+
+    Merge every old worker's state per node, then split along the SAME
+    routing the sharded scheduler applies to live deltas
+    (engine/sharded.py ``partitioner``): groupbys by grouping values,
+    joins by join key, deduplicate by instance, pinned operators whole to
+    worker 0, everything else by row key. Node types whose extra state
+    carries routing this function cannot reconstruct raise instead of
+    guessing — input-journal persistence rescales those.
+    """
+    from pathway_tpu.engine.graph import (
+        DeduplicateNode,
+        GroupbyNode,
+        InputSession,
+        JoinNode,
+        StaticSource,
+    )
+    from pathway_tpu.engine.sharded import _shard_of, partitioner
+
+    n_new = len(scopes)
+    # old worker 0 carried every node (sink chains included); workers > 0
+    # stop at the shared graph — same layout on the new side, so iterate
+    # worker-0's node list and let per-worker length guards handle the rest
+    nodes = scopes[0].nodes
+    n_nodes = min(len(nodes), len(per_worker[0]))
+
+    def merged_state(i: int) -> dict:
+        """Union of one node's state across the old workers."""
+        base = dict(per_worker[0][i])
+        for states in per_worker[1:]:
+            if i >= len(states):
+                continue
+            for attr, val in states[i].items():
+                cur = base.get(attr)
+                if isinstance(cur, dict) and isinstance(val, dict):
+                    merged = dict(cur)
+                    merged.update(val)
+                    base[attr] = merged
+                elif (
+                    isinstance(cur, list)
+                    and isinstance(val, list)
+                    and len(cur) == len(val)
+                    and all(isinstance(x, dict) for x in cur + val)
+                ):
+                    base[attr] = [
+                        {**a, **b} for a, b in zip(cur, val)
+                    ]
+                # scalars (watermarks, flags): worker 0's copy stands
+        return base
+
+    def empty_like(state: dict) -> dict:
+        out = {}
+        for attr, val in state.items():
+            if isinstance(val, dict):
+                out[attr] = {}
+            elif isinstance(val, list) and all(
+                isinstance(x, dict) for x in val
+            ):
+                out[attr] = [{} for _ in val]
+            else:
+                out[attr] = val
+        return out
+
+    def split_dict(d: dict, route) -> list[dict]:
+        parts: list[dict] = [{} for _ in range(n_new)]
+        for key, val in d.items():
+            parts[route(key, val)][key] = val
+        return parts
+
+    out: list[list[dict]] = [[] for _ in range(n_new)]
+    for i in range(n_nodes):
+        node = nodes[i]
+        merged = merged_state(i)
+        shards = [empty_like(merged) for _ in range(n_new)]
+        by_key = lambda key, _v: _shard_of(key, n_new)  # noqa: E731
+
+        if isinstance(node, (StaticSource, InputSession)):
+            # worker 0 keeps FULL source state; replicas hold key shards
+            # (the _route_source invariant, engine/sharded.py)
+            shards[0]["current"] = dict(merged["current"])
+            for w in range(1, n_new):
+                shards[w]["current"] = {
+                    k: v
+                    for k, v in merged["current"].items()
+                    if _shard_of(k, n_new) == w
+                }
+        elif isinstance(node, GroupbyNode):
+            shards_groups = split_dict(
+                merged["groups"],
+                lambda _k, entry: _shard_of(tuple(entry[0]), n_new),
+            )
+            n_by = len(node.by_cols)
+            shards_current = split_dict(
+                merged["current"],
+                lambda _k, row: _shard_of(tuple(row[:n_by]), n_new),
+            )
+            for w in range(n_new):
+                shards[w]["groups"] = shards_groups[w]
+                shards[w]["current"] = shards_current[w]
+        elif isinstance(node, JoinNode):
+            for attr, cols in (
+                ("left_arr", node.left_on),
+                ("right_arr", node.right_on),
+            ):
+                parts = split_dict(
+                    merged[attr], lambda jk, _v: _shard_of(jk, n_new)
+                )
+                for w in range(n_new):
+                    shards[w][attr] = parts[w]
+            lcols = node.left_on
+            rcols = node.right_on
+            l_arity = node.inputs[0].arity
+
+            def route_join_row(_k, row):
+                jk = tuple(row[c] for c in lcols)
+                if any(v is None for v in jk):
+                    # unmatched-right padding (RIGHT/OUTER joins): the
+                    # left prefix is all None — route by the right-side
+                    # key, which is where the live partitioner owns it
+                    jk = tuple(row[l_arity + c] for c in rcols)
+                return _shard_of(jk, n_new)
+
+            parts = split_dict(merged["current"], route_join_row)
+            for w in range(n_new):
+                shards[w]["current"] = parts[w]
+        elif isinstance(node, DeduplicateNode):
+            icols = node.instance_cols
+            for attr in ("accepted", "current"):
+                parts = split_dict(
+                    merged[attr],
+                    lambda _k, row: _shard_of(
+                        tuple(row[c] for c in icols), n_new
+                    ),
+                )
+                for w in range(n_new):
+                    shards[w][attr] = parts[w]
+        elif partitioner(node, 0, n_new) is None:
+            # pinned operator: whole state lives on worker 0
+            shards[0] = merged
+        else:
+            # key-routed node: current and input mirrors shard by row key;
+            # any OTHER populated container state has routing this generic
+            # path cannot reconstruct
+            for attr, val in merged.items():
+                if attr in ("current", "_mirrors"):
+                    continue
+                populated = (
+                    bool(val)
+                    if isinstance(val, (dict, list, set))
+                    else False
+                )
+                if populated:
+                    raise ValueError(
+                        f"operator snapshot cannot be re-sharded: node "
+                        f"{type(node).__name__} carries {attr!r} state "
+                        "with unknown routing; resume with the original "
+                        f"worker count ({len(per_worker)}) or use input-"
+                        "journal persistence (PersistenceMode.PERSISTING) "
+                        "to change worker counts"
+                    )
+            parts = split_dict(merged["current"], by_key)
+            for w in range(n_new):
+                shards[w]["current"] = parts[w]
+            if isinstance(merged.get("_mirrors"), list):
+                mirror_parts = [
+                    split_dict(m, by_key) for m in merged["_mirrors"]
+                ]
+                for w in range(n_new):
+                    shards[w]["_mirrors"] = [
+                        mp[w] for mp in mirror_parts
+                    ]
+        for w in range(n_new):
+            out[w].append(shards[w])
+    return out
 
 
 class ObjectStoreBackend(PersistenceBackend):

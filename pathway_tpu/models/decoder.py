@@ -59,16 +59,28 @@ def tiny_decoder(vocab_size: int = 512) -> DecoderConfig:
     )
 
 
-def init_decoder_params(rng: jax.Array, cfg: DecoderConfig) -> Params:
+def init_decoder_params(
+    rng: jax.Array, cfg: DecoderConfig, dtype: Any = jnp.float32
+) -> Params:
+    """``dtype=jnp.bfloat16`` stores weights half-size (7B fits a single
+    16 GB chip); each tensor is drawn in f32 and cast immediately, so the
+    f32 peak is one tensor, not the model."""
+
     def dense(key, shape):
         scale = 1.0 / math.sqrt(shape[0])
-        return scale * jax.random.normal(key, shape, jnp.float32)
+        return (scale * jax.random.normal(key, shape, jnp.float32)).astype(
+            dtype
+        )
 
     keys = iter(jax.random.split(rng, 3 + 7 * cfg.layers))
     hd, kvd = cfg.heads * cfg.head_dim, cfg.kv_heads * cfg.head_dim
     p: Params = {
-        "tok_emb": 0.02
-        * jax.random.normal(next(keys), (cfg.vocab_size, cfg.hidden), jnp.float32),
+        "tok_emb": (
+            0.02
+            * jax.random.normal(
+                next(keys), (cfg.vocab_size, cfg.hidden), jnp.float32
+            )
+        ).astype(dtype),
         "final_norm": jnp.ones((cfg.hidden,), jnp.float32),
         "lm_head": dense(next(keys), (cfg.hidden, cfg.vocab_size)),
         "layers": [],

@@ -1,0 +1,182 @@
+"""Avro codec + Iceberg spec-compliance tests (VERDICT r2 #8).
+
+The codec is validated the way a stock Avro reader would consume the
+files: parse the container header, take the embedded writer schema, and
+decode generically against it — plus binary-level checks of the spec's
+encoding rules (magic, zigzag varints, union branch indexes, field-ids).
+"""
+
+import io
+import json
+import struct
+
+import pytest
+
+from pathway_tpu.io import _avro
+
+
+class TestAvroBinary:
+    def test_zigzag_long_round_trip(self):
+        for n in (0, 1, -1, 63, 64, -64, -65, 2**31, -(2**31), 2**62, -(2**62)):
+            buf = io.BytesIO()
+            _avro.write_long(buf, n)
+            buf.seek(0)
+            assert _avro.read_long(buf) == n, n
+
+    def test_zigzag_spec_examples(self):
+        # Avro spec: 0->00, -1->01, 1->02, -2->03, 2->04
+        for n, expected in ((0, b"\x00"), (-1, b"\x01"), (1, b"\x02"),
+                            (-2, b"\x03"), (2, b"\x04"), (-64, b"\x7f"),
+                            (64, b"\x80\x01")):
+            buf = io.BytesIO()
+            _avro.write_long(buf, n)
+            assert buf.getvalue() == expected, n
+
+    def test_record_union_array_map_round_trip(self):
+        schema = {
+            "type": "record",
+            "name": "t",
+            "fields": [
+                {"name": "a", "type": "long"},
+                {"name": "b", "type": ["null", "string"]},
+                {"name": "c", "type": {"type": "array", "items": "int"}},
+                {"name": "d", "type": {"type": "map", "values": "double"}},
+                {"name": "e", "type": "boolean"},
+                {"name": "f", "type": "bytes"},
+            ],
+        }
+        value = {
+            "a": -(2**40),
+            "b": None,
+            "c": [1, 2, 3],
+            "d": {"x": 1.5, "y": -2.25},
+            "e": True,
+            "f": b"\x00\xff",
+        }
+        buf = io.BytesIO()
+        _avro.encode(buf, schema, value)
+        buf.seek(0)
+        assert _avro.decode(buf, schema) == value
+
+    def test_union_encodes_branch_index(self):
+        buf = io.BytesIO()
+        _avro.encode(buf, ["null", "long"], 7)
+        # branch 1 (zigzag 02) then long 7 (zigzag 0e)
+        assert buf.getvalue() == b"\x02\x0e"
+        buf = io.BytesIO()
+        _avro.encode(buf, ["null", "long"], None)
+        assert buf.getvalue() == b"\x00"
+
+
+class TestContainer:
+    def test_container_round_trip_and_header(self, tmp_path):
+        schema = {
+            "type": "record",
+            "name": "row",
+            "fields": [{"name": "v", "type": "long"}],
+        }
+        path = tmp_path / "f.avro"
+        _avro.write_container(
+            str(path), schema, [{"v": i} for i in range(100)],
+            metadata={"k": "val"},
+        )
+        raw = path.read_bytes()
+        assert raw[:4] == b"Obj\x01"  # spec magic
+        got_schema, records, meta = _avro.read_container(str(path))
+        assert got_schema == schema
+        assert records == [{"v": i} for i in range(100)]
+        assert meta["k"] == "val"
+        assert json.loads(meta["avro.schema"]) == schema
+        assert meta["avro.codec"] == "null"
+
+    def test_container_rejects_corruption(self, tmp_path):
+        path = tmp_path / "f.avro"
+        _avro.write_container(
+            str(path),
+            {"type": "record", "name": "r", "fields": []},
+            [{}],
+        )
+        raw = bytearray(path.read_bytes())
+        raw[-1] ^= 0xFF  # flip a sync byte
+        path.write_bytes(bytes(raw))
+        with pytest.raises(ValueError, match="sync"):
+            _avro.read_container(str(path))
+
+
+class TestIcebergManifests:
+    def _write_table(self, tmp_path, n_rows=4):
+        import pathway_tpu as pw
+        from pathway_tpu.internals.parse_graph import G
+
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=str),
+            [(i, f"s{i}") for i in range(n_rows)],
+        )
+        pw.io.iceberg.write(t, tmp_path / "wh", ["db"], "tab")
+        pw.run()
+        return tmp_path / "wh" / "db" / "tab"
+
+    def test_manifests_are_avro_with_spec_field_ids(self, tmp_path):
+        loc = self._write_table(tmp_path)
+        meta_dir = loc / "metadata"
+        version = int((meta_dir / "version-hint.text").read_text())
+        metadata = json.loads(
+            (meta_dir / f"v{version}.metadata.json").read_text()
+        )
+        snap = metadata["snapshots"][-1]
+        list_path = loc / snap["manifest-list"]
+        assert list_path.suffix == ".avro"
+        schema, manifests, fmeta = _avro.read_container(str(list_path))
+        assert fmeta["format-version"] == "2"
+        ids = {f["name"]: f.get("field-id") for f in schema["fields"]}
+        # spec field-ids for manifest_file (Iceberg table spec, v2)
+        assert ids["manifest_path"] == 500
+        assert ids["manifest_length"] == 501
+        assert ids["added_snapshot_id"] == 503
+        assert ids["sequence_number"] == 515
+        assert ids["content"] == 517
+        (m,) = manifests
+        manifest_path = loc / m["manifest_path"]
+        assert manifest_path.suffix == ".avro"
+        assert m["manifest_length"] == manifest_path.stat().st_size
+        eschema, entries, emeta = _avro.read_container(str(manifest_path))
+        assert emeta["format-version"] == "2"
+        assert emeta["content"] == "data"
+        assert json.loads(emeta["schema"])["type"] == "struct"
+        eids = {f["name"]: f.get("field-id") for f in eschema["fields"]}
+        assert eids["status"] == 0 and eids["data_file"] == 2
+        df_fields = {
+            f["name"]: f.get("field-id")
+            for f in next(
+                f for f in eschema["fields"] if f["name"] == "data_file"
+            )["type"]["fields"]
+        }
+        assert df_fields["file_path"] == 100
+        assert df_fields["record_count"] == 103
+        assert df_fields["content"] == 134
+        (entry,) = entries
+        assert entry["status"] == 1
+        assert entry["data_file"]["file_format"] == "PARQUET"
+        assert entry["data_file"]["record_count"] == 4
+        assert (loc / entry["data_file"]["file_path"]).exists()
+
+    def test_round_trip_through_reader(self, tmp_path):
+        import pathway_tpu as pw
+        from pathway_tpu.internals.parse_graph import G
+
+        loc_root = tmp_path
+        self._write_table(loc_root, n_rows=6)
+        G.clear()
+        back = pw.io.iceberg.read(
+            loc_root / "wh",
+            ["db"],
+            "tab",
+            schema=pw.schema_from_types(a=int, b=str),
+            mode="static",
+        )
+        rows = {
+            tuple(r)
+            for r in pw.debug.table_to_pandas(back).itertuples(index=False)
+        }
+        assert rows == {(i, f"s{i}") for i in range(6)}

@@ -362,3 +362,172 @@ def test_mesh_over_explicit_addresses(monkeypatch):
     finally:
         for tr in transports.values():
             tr.close()
+
+
+SLOW_STREAM = """
+    import time
+    import pathway_tpu as pw
+
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self):
+            for commit in range(60):
+                for i in range(5):
+                    self.next(k=(commit * 5 + i) % 10, v=float(commit))
+                self.commit()
+                time.sleep(0.2)
+
+    t = pw.io.python.read(
+        Feed(),
+        schema=pw.schema_from_types(k=int, v=float),
+        autocommit_duration_ms=None,
+    )
+    agg = t.groupby(pw.this.k).reduce(k=pw.this.k, s=pw.reducers.sum(pw.this.v))
+    pw.io.csv.write(agg, {out!r})
+    pw.run()
+"""
+
+
+def _launch_processes(tmp_path, code: str, processes: int):
+    """Popen each process directly (cli.spawn waits; these tests kill)."""
+    import uuid as _uuid
+
+    prog = tmp_path / "prog.py"
+    prog.write_text(textwrap.dedent(code))
+    base = _free_port_base(processes)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PATHWAY_THREADS"] = "1"
+    env["PATHWAY_PROCESSES"] = str(processes)
+    env["PATHWAY_FIRST_PORT"] = str(base)
+    env["PATHWAY_RUN_ID"] = str(_uuid.uuid4())
+    env["PATHWAY_EXCHANGE_SECRET"] = "test-secret"
+    env["PATHWAY_EXCHANGE_TIMEOUT"] = "20"
+    handles = []
+    for pid in range(processes):
+        e = dict(env, PATHWAY_PROCESS_ID=str(pid))
+        handles.append(
+            subprocess.Popen(
+                [sys.executable, str(prog)],
+                env=e,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        )
+    return handles
+
+
+def test_peer_kill_fail_stops_coordinator(tmp_path):
+    """SIGKILL a follower mid-run: the coordinator must fail-stop well
+    inside RECV_TIMEOUT (EOF on the dead peer's socket), exit nonzero, and
+    leave only complete rows in the sink (reference fail-stop teardown
+    dataflow.rs:5854-5883; harness kill at integration_tests/wordcount/
+    base.py:320)."""
+    import signal
+    import time as _t
+
+    out = tmp_path / "out.csv"
+    handles = _launch_processes(tmp_path, SLOW_STREAM.format(out=str(out)), 2)
+    try:
+        # let the pipeline make real progress first
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            if out.exists() and len(out.read_text().splitlines()) > 3:
+                break
+            if any(h.poll() is not None for h in handles):
+                raise AssertionError("a process died before the kill")
+            _t.sleep(0.2)
+        else:
+            raise AssertionError("pipeline produced no output to kill over")
+        handles[1].send_signal(signal.SIGKILL)
+        t0 = _t.monotonic()
+        rc = handles[0].wait(timeout=30)
+        fail_stop_s = _t.monotonic() - t0
+        assert rc != 0, "coordinator must not report success after peer loss"
+        assert fail_stop_s < 15, f"fail-stop took {fail_stop_s:.1f}s"
+        # sink integrity: every line parses as a complete csv row
+        rows = _read_csv(out)
+        for r in rows:
+            assert r["k"] is not None and r["s"] is not None
+            float(r["s"])
+            int(r["diff"])
+    finally:
+        for h in handles:
+            if h.poll() is None:
+                h.kill()
+
+
+def test_spawn_sigkill_midrun_then_journal_resume(tmp_path):
+    """SIGKILL BOTH processes mid-run under journal persistence, then
+    resume with a fresh 2-process spawn: every input is counted exactly
+    once (crash-safe journal across the process mesh)."""
+    import json as _json
+    import signal
+    import time as _t
+
+    indir = tmp_path / "in"
+    indir.mkdir()
+    store = tmp_path / "store"
+    out1 = tmp_path / "out1.jsonl"
+
+    streaming = """
+        import pathway_tpu as pw
+        from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+        words = pw.io.plaintext.read(
+            {indir!r}, mode="streaming", persistent_id="w",
+            autocommit_duration_ms=50,
+        )
+        counts = words.groupby(words.data).reduce(
+            word=words.data, cnt=pw.reducers.count()
+        )
+        pw.io.jsonlines.write(counts, {out!r})
+        pw.run(persistence_config=Config(
+            Backend.filesystem({store!r}),
+            persistence_mode=PersistenceMode.PERSISTING,
+        ))
+    """
+    (indir / "f0.txt").write_text("apple\nbanana\n")
+    handles = _launch_processes(
+        tmp_path,
+        streaming.format(indir=str(indir), out=str(out1), store=str(store)),
+        2,
+    )
+    try:
+        # wait until the first file's rows were committed (visible in out1)
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            if out1.exists() and "apple" in out1.read_text():
+                break
+            _t.sleep(0.2)
+        else:
+            raise AssertionError("run 1 never committed the first file")
+        (indir / "f1.txt").write_text("banana\ncherry\n")
+        _t.sleep(1.0)  # may or may not be consumed before the kill
+        for h in handles:
+            h.send_signal(signal.SIGKILL)
+        for h in handles:
+            h.wait(timeout=10)
+    finally:
+        for h in handles:
+            if h.poll() is None:
+                h.kill()
+
+    # resume: static read over the same dir + same journal store
+    out2 = tmp_path / "out2.jsonl"
+    resume = streaming.replace('mode="streaming"', 'mode="static"')
+    _spawn_program(
+        tmp_path,
+        resume.format(indir=str(indir), out=str(out2), store=str(store)),
+        processes=2,
+    )
+    rows = [
+        _json.loads(l) for l in out2.read_text().splitlines() if l.strip()
+    ]
+    state: dict[str, int] = {}
+    for r in rows:
+        if r["diff"] > 0:
+            state[r["word"]] = r["cnt"]
+        elif state.get(r["word"]) == r["cnt"]:
+            del state[r["word"]]
+    assert state == {"apple": 1, "banana": 2, "cherry": 1}

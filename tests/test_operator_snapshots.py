@@ -356,17 +356,90 @@ class TestShardedOperatorSnapshots:
         assert finals == {"banana": 2, "cherry": 1}
         assert all(r["word"] not in ("apple", "durian", "elder") for r in rows)
 
-    def test_worker_count_change_rejected(self, tmp_path):
-        from pathway_tpu.engine.persistence import OperatorSnapshotManager
+    def test_worker_count_change_reshards_groupby(self, tmp_path):
+        """Snapshots taken with N workers restore onto M workers: merged
+        state re-splits along the sharded scheduler's own routing
+        (reference: re-sharded snapshot reads, persistence/config.rs:
+        126-163)."""
+        from pathway_tpu.engine import (
+            ReducerKind,
+            make_reducer,
+            ref_scalar,
+        )
+        from pathway_tpu.engine.sharded import ShardedScheduler
         from pathway_tpu.engine.graph import Scope
+        from pathway_tpu.engine.persistence import OperatorSnapshotManager
 
         backend = Backend.filesystem(str(tmp_path / "store"))
         mgr = OperatorSnapshotManager(backend)
-        s1, s2 = Scope(), Scope()
-        mgr.snapshot([s1, s2], [], 5)
+
+        def build(n_workers):
+            scopes, sessions, aggs = [], [], []
+            for _w in range(n_workers):
+                sc = Scope()
+                sess = sc.input_session(2)
+                agg = sc.group_by_table(
+                    sess,
+                    by_cols=[0],
+                    reducers=[(make_reducer(ReducerKind.SUM), [1])],
+                )
+                scopes.append(sc)
+                sessions.append(sess)
+                aggs.append(agg)
+            return scopes, sessions, aggs
+
+        # run with 2 workers, snapshot
+        scopes, sessions, _aggs = build(2)
+        sched = ShardedScheduler(scopes)
+        for i in range(40):
+            sessions[0].insert(ref_scalar(i), (i % 8, float(i)))
+        sched.commit()
+        mgr.snapshot(scopes, [], sched.time)
+
+        # restore onto 3 workers; feed a delta and check totals
+        scopes3, sessions3, aggs3 = build(3)
+        assert mgr.restore(scopes3, []) is not None
+        sched3 = ShardedScheduler(scopes3)
+        sched3.time = 99
+        sessions3[0].insert(ref_scalar(1000), (3, 1000.0))
+        sched3.commit()
+        merged = {}
+        for agg in aggs3:
+            merged.update(agg.current)
+        expected = {}
+        for i in range(40):
+            expected[i % 8] = expected.get(i % 8, 0.0) + float(i)
+        expected[3] += 1000.0
+        got = {row[0]: row[1] for row in merged.values()}
+        assert got == expected
+        # the delta group's state landed on exactly one worker (the shard
+        # the partitioner routes group 3 to) — totals prove no double count
+
+    def test_reshard_refuses_unknown_extra_state(self, tmp_path):
+        from pathway_tpu.engine.graph import Scope
+        from pathway_tpu.engine.persistence import OperatorSnapshotManager
+
+        backend = Backend.filesystem(str(tmp_path / "store"))
+        mgr = OperatorSnapshotManager(backend)
+
+        def build():
+            sc = Scope()
+            sess = sc.input_session(2)
+            # prev_next/sort-style nodes carry routing-opaque state
+            sc.sort_table(sess, key_col=0, instance_col=None)
+            return sc, sess
+
         import pytest
 
-        with pytest.raises(ValueError, match="cannot rescale"):
-            mgr.restore([Scope()], [])
-        # same count restores fine
-        assert mgr.restore([Scope(), Scope()], []) == 5
+        built = [build(), build()]
+        scopes = [b[0] for b in built]
+        from pathway_tpu.engine import ref_scalar
+
+        built[0][1].insert(ref_scalar(1), (1, 1.0))
+        from pathway_tpu.engine.sharded import ShardedScheduler
+
+        sched = ShardedScheduler(list(scopes))
+        sched.commit()
+        mgr.snapshot(list(scopes), [], 1)
+        with pytest.raises(ValueError, match="re-shard|original worker"):
+            mgr.restore([build()[0], build()[0], build()[0]], [])
