@@ -121,8 +121,13 @@ def pipeline_leg() -> dict:
     # sequence (the device-only leg's arithmetic, and the honest "seq 128"
     # claim in the output unit) — one jit specialization per batch bucket
     # instead of one per (batch, seq) pair
+    # BENCH_CHECKPOINT: path to a local sentence-transformers/HF dir
+    # (model.npz|pytorch_model.bin + vocab.txt + config.json) — real
+    # weights + WordPiece replace the seeded-random MiniLM, making the
+    # recall axis a real-semantics measurement (tests/fixtures/tiny_bert
+    # is a committed example; parity: tests/test_checkpoint_parity.py)
     embedder = TpuEncoderEmbedder(
-        model="all-MiniLM-L6-v2",
+        model=os.environ.get("BENCH_CHECKPOINT", "all-MiniLM-L6-v2"),
         max_len=SEQ_LEN,
         max_batch_size=CHUNK,
         seq_bucket_min=SEQ_LEN,
@@ -447,6 +452,115 @@ def decode_leg() -> dict:
     }
 
 
+def multimodal_leg() -> dict:
+    """BASELINE config #5: multimodal (image) RAG — PNG slides through the
+    TPU ViT (CLIP ViT-B/16 shape) into the HBM KNN index via pw.run;
+    queries are noise-perturbed variants whose top-1 must recover the
+    source image."""
+    import io as _io
+
+    import pathway_tpu as pw
+    from PIL import Image
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.stdlib.indexing import DataIndex, TpuKnnFactory
+    from pathway_tpu.xpacks.llm.embedders import TpuImageEmbedder
+
+    G.clear()
+    n_imgs = int(os.environ.get("BENCH_MM_IMAGES", "512"))
+    n_queries = int(os.environ.get("BENCH_MM_QUERIES", "16"))
+    rng = np.random.default_rng(0)
+
+    def make_png(i: int, noisy: bool = False) -> bytes:
+        r = np.random.default_rng(i)
+        arr = r.integers(0, 255, (64, 64, 3), np.uint8)
+        if noisy:
+            arr = np.clip(
+                arr.astype(np.int16)
+                + rng.integers(-12, 12, arr.shape),
+                0,
+                255,
+            ).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr, "RGB").save(buf, format="PNG")
+        return buf.getvalue()
+
+    embedder = TpuImageEmbedder(model="vit-b16", max_batch_size=64)
+    blobs = [make_png(i) for i in range(n_imgs)]
+    for b in (8, 64):
+        embedder._fn(blobs[:b])  # warm jit buckets
+
+    ingest_done = threading.Event()
+    answer_seen = threading.Event()
+    timing = {"run_start": 0.0, "ingest_end": 0.0}
+    answers: dict = {}  # qid -> top-1 img_id (order-independent)
+    img_ids: dict = {}
+    n_seen = [0]
+
+    class ImgFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            timing["run_start"] = time.perf_counter()
+            for i, blob in enumerate(blobs):
+                self.next(img_id=i, data=blob)
+
+    class QueryFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            ingest_done.wait()
+            for i in range(n_queries):
+                answer_seen.clear()
+                self.next(qid=i, data=make_png((i * 31) % n_imgs, noisy=True))
+                answer_seen.wait(timeout=120.0)
+
+    imgs = pw.io.python.read(
+        ImgFeed(),
+        schema=pw.schema_from_types(img_id=int, data=bytes),
+        autocommit_duration_ms=100,
+    )
+    imgs = imgs.select(img_id=pw.this.img_id, emb=embedder(pw.this.data))
+    queries = pw.io.python.read(
+        QueryFeed(),
+        schema=pw.schema_from_types(qid=int, data=bytes),
+        autocommit_duration_ms=None,
+    )
+    queries = queries.select(qid=pw.this.qid, qemb=embedder(pw.this.data))
+    index = DataIndex(
+        imgs,
+        TpuKnnFactory(
+            dimensions=embedder.get_embedding_dimension(), capacity=1024
+        ),
+        imgs.emb,
+    )
+    res = index.query_as_of_now(queries, queries.qemb, number_of_matches=1)
+    perf_counter = time.perf_counter
+
+    def on_img(key, row, time, is_addition):
+        if is_addition:
+            img_ids[key] = row["img_id"]
+            n_seen[0] += 1
+            if n_seen[0] == n_imgs:
+                timing["ingest_end"] = perf_counter()
+                ingest_done.set()
+
+    def on_ans(key, row, time, is_addition):
+        if is_addition:
+            hits = row["_pw_index_reply_ids"]
+            answers[row["qid"]] = img_ids.get(hits[0]) if hits else None
+            answer_seen.set()
+
+    pw.io.subscribe(imgs, on_change=on_img)
+    pw.io.subscribe(res, on_change=on_ans)
+    pw.run()
+    elapsed = timing["ingest_end"] - timing["run_start"]
+    top1 = sum(
+        1 for qid, a in answers.items() if a == (qid * 31) % n_imgs
+    ) / max(len(answers), 1)
+    return {
+        "images_per_sec": round(n_imgs / elapsed, 1) if elapsed > 0 else None,
+        "n_images": n_imgs,
+        "noisy_query_top1": round(top1, 4),
+        "encoder": "ViT-B/16 shape (CLIP image tower), 224px",
+    }
+
+
 def main() -> None:
     stats = pipeline_leg()
     device_docs_per_sec = device_only_leg()
@@ -465,6 +579,8 @@ def main() -> None:
         stats["config3_reranker"] = reranker_leg()
     if os.environ.get("BENCH_SKIP_DECODE", "") not in ("1", "true"):
         stats["config4_decode"] = decode_leg()
+    if os.environ.get("BENCH_SKIP_MULTIMODAL", "") not in ("1", "true"):
+        stats["config5_multimodal"] = multimodal_leg()
     print(
         json.dumps(
             {

@@ -37,8 +37,28 @@ from pathway_tpu.models.train import (
     info_nce_loss,
     make_train_step,
 )
+from pathway_tpu.models.vision import (
+    VisionConfig,
+    clip_vit_b16,
+    init_vision_params,
+    normalize_u8,
+    preprocess_image,
+    preprocess_image_u8,
+    vision_forward,
+    vision_param_spec,
+    vit_tiny,
+)
 
 __all__ = [
+    "VisionConfig",
+    "clip_vit_b16",
+    "init_vision_params",
+    "normalize_u8",
+    "preprocess_image",
+    "preprocess_image_u8",
+    "vision_forward",
+    "vision_param_spec",
+    "vit_tiny",
     "ContrastiveBatch",
     "DecoderConfig",
     "EncoderConfig",

@@ -159,10 +159,22 @@ def load_sentence_transformer(
             f"no pytorch_model.bin / model.npz under {model_path}"
         )
     params, cfg = import_hf_encoder(state_path)
+    overrides: dict[str, Any] = {"pooling": pooling}
+    cfg_json = os.path.join(model_path, "config.json")
+    if os.path.exists(cfg_json):
+        # head count is invisible in tensor shapes (MiniLM: 384 hidden =
+        # 12 heads x 32, not the inferred 6 x 64) — config.json is
+        # authoritative when present
+        import json
+
+        with open(cfg_json, encoding="utf-8") as f:
+            hf_cfg = json.load(f)
+        if "num_attention_heads" in hf_cfg:
+            overrides["heads"] = int(hf_cfg["num_attention_heads"])
     cfg = EncoderConfig(
         **{
             **{f.name: getattr(cfg, f.name) for f in cfg.__dataclass_fields__.values()},
-            "pooling": pooling,
+            **overrides,
         }
     )
     vocab_path = os.path.join(model_path, "vocab.txt")

@@ -33,6 +33,32 @@ _ENCODER_PRESETS = {
 }
 
 
+def _resolve_device_resident(device_resident: "bool | None") -> bool:
+    """Shared default for the device-resident lazy-row mode (text and
+    image embedders must agree on the env contract)."""
+    if device_resident is not None:
+        return device_resident
+    import os
+
+    return os.environ.get("PATHWAY_DEVICE_RESIDENT_UDF", "1").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    )
+
+
+def _rows_from_device(vecs_dev: Any, real: int, device_resident: bool) -> list:
+    """Device batch -> per-row cells: lazy device rows (prefetched host
+    twin) or eager numpy."""
+    if device_resident:
+        from pathway_tpu.engine.device import lazy_rows
+
+        return lazy_rows(vecs_dev, real)
+    vecs = np.asarray(vecs_dev, np.float32)
+    return [vecs[i] for i in range(real)]
+
+
 class TpuEncoderEmbedder(UDF):
     """Local sentence embedder running on TPU.
 
@@ -114,12 +140,13 @@ class TpuEncoderEmbedder(UDF):
                     f"minilm_l6/bge_base/bge_small, or a local checkpoint dir"
                 )
             self.config = cfg_fn()
-        self.max_len = max_len
+        # a checkpoint's positional table caps the usable sequence length
+        self.max_len = min(max_len, self.config.max_len)
         #: minimum pow-2 seq padding bucket — raise (up to max_len) to trade
         #: padding FLOPs for fewer jit specializations (one compile per
         #: (batch bucket, seq bucket) pair; compiles are seconds-expensive
         #: over remote-device links)
-        self.seq_bucket_min = min(seq_bucket_min, max_len)
+        self.seq_bucket_min = min(seq_bucket_min, self.max_len)
         self.tokenizer = tokenizer or HashTokenizer(self.config.vocab_size)
         if params is None:
             params = init_encoder_params(jax.random.key(seed), self.config)
@@ -135,18 +162,14 @@ class TpuEncoderEmbedder(UDF):
             jax.jit(lambda p, ids, mask: embed(p, ids, mask, cfg)), params
         )
 
-        if device_resident is None:
-            # device-resident rows skip the device→host→device round trip
-            # into the index, and lazy_rows' background prefetch overlaps
-            # the host copy with the next batch's tokenize+dispatch —
-            # measured ~5x cheaper per batch than the old blocking
-            # np.asarray even over the remote-device tunnel (~103 ms ->
-            # ~19 ms per 256-row batch). Default on; PATHWAY_DEVICE_
-            # RESIDENT_UDF=0 restores eager host materialisation.
-            device_resident = os.environ.get(
-                "PATHWAY_DEVICE_RESIDENT_UDF", "1"
-            ).lower() in ("1", "true", "yes", "on")
-        self.device_resident = device_resident
+        # device-resident rows skip the device→host→device round trip
+        # into the index, and lazy_rows' background prefetch overlaps
+        # the host copy with the next batch's tokenize+dispatch —
+        # measured ~5x cheaper per batch than the old blocking
+        # np.asarray even over the remote-device tunnel (~103 ms ->
+        # ~19 ms per 256-row batch). Default on; PATHWAY_DEVICE_
+        # RESIDENT_UDF=0 restores eager host materialisation.
+        self.device_resident = _resolve_device_resident(device_resident)
 
         def embed_batch(texts: list) -> list:
             ids, mask = self.tokenizer.encode_batch(
@@ -156,12 +179,7 @@ class TpuEncoderEmbedder(UDF):
                 ids, mask, seq_bucket_min=self.seq_bucket_min
             )
             vecs_dev = self._jit_embed(jnp.asarray(ids), jnp.asarray(mask))
-            if self.device_resident:
-                from pathway_tpu.engine.device import lazy_rows
-
-                return lazy_rows(vecs_dev, real)
-            vecs = np.asarray(vecs_dev, np.float32)
-            return [vecs[i] for i in range(real)]
+            return _rows_from_device(vecs_dev, real, self.device_resident)
 
         super().__init__(
             embed_batch,
@@ -180,6 +198,133 @@ class TpuEncoderEmbedder(UDF):
 
 class SentenceTransformerEmbedder(TpuEncoderEmbedder):
     """Reference-compatible name (embedders.py:270); TPU-native engine."""
+
+
+_VISION_PRESETS = {
+    "vit-b16": "clip_vit_b16",
+    "clip-vit-b16": "clip_vit_b16",
+    "openai/clip-vit-base-patch16": "clip_vit_b16",
+    "vit-tiny": "vit_tiny",
+}
+
+
+class TpuImageEmbedder(UDF):
+    """Image bytes -> L2-normalised vector on TPU (models/vision.py ViT).
+
+    The vision leg of the multimodal RAG path (reference: CLIP embedders
+    feeding the multimodal vector store, python/pathway/xpacks/llm/
+    vector_store.py:588). Weights are seeded-random unless ``params`` is
+    given — embeddings are content-dependent either way (a random ViT is
+    a locality-preserving projection), so retrieval pipelines measure the
+    true ingest/query shape."""
+
+    def __init__(
+        self,
+        model: str = "vit-b16",
+        *,
+        params: Any = None,
+        seed: int = 0,
+        max_batch_size: int = 64,
+        cache_strategy: CacheStrategy | None = None,
+        device_resident: bool | None = None,
+    ) -> None:
+        import io as _io
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
+        from pathway_tpu.models.vision import (
+            clip_vit_b16,
+            init_vision_params,
+            normalize_u8,
+            preprocess_image_u8,
+            vision_forward,
+            vit_tiny,
+        )
+
+        preset = _VISION_PRESETS.get(model, model)
+        cfg_fn = {"clip_vit_b16": clip_vit_b16, "vit_tiny": vit_tiny}.get(
+            preset
+        )
+        if cfg_fn is None:
+            raise ValueError(
+                f"unknown vision preset {model!r}; known: "
+                f"{sorted(_VISION_PRESETS)}"
+            )
+        self.config = cfg_fn()
+        params_custom = params is not None
+        if params is None:
+            params = init_vision_params(jax.random.key(seed), self.config)
+        self._params = params
+        cfg = self.config
+        import functools
+
+        # uint8 pixels ride to the device; normalisation fuses into the
+        # forward (4x smaller transfer than f32 pixels)
+        self._jit_forward = functools.partial(
+            jax.jit(lambda p, x8: vision_forward(p, normalize_u8(x8), cfg)),
+            params,
+        )
+        self.device_resident = _resolve_device_resident(device_resident)
+
+        def embed_batch(blobs: list) -> list:
+            from PIL import Image
+
+            pixels = np.stack(
+                [
+                    preprocess_image_u8(
+                        Image.open(_io.BytesIO(b))
+                        if isinstance(b, (bytes, bytearray))
+                        else b,
+                        cfg,
+                    )
+                    for b in blobs
+                ]
+            )
+            return self.embed_pixels(pixels)
+
+        if params_custom:
+            # the namespace must identify the WEIGHTS (same rule as the
+            # text embedder's weights_tag): a content fingerprint keeps
+            # cached embeddings from different checkpoints apart
+            from pathway_tpu.xpacks.llm.llms import _checkpoint_digest
+
+            weights_part = f"ckpt{_checkpoint_digest(params, None)}"
+        else:
+            weights_part = f"seed{seed}"
+        super().__init__(
+            embed_batch,
+            executor=batch_executor(max_batch_size=max_batch_size),
+            deterministic=True,
+            cache_strategy=cache_strategy,
+            cache_name=f"TpuImageEmbedder:{preset}:{weights_part}",
+        )
+
+    def embed_pixels(self, pixels: "np.ndarray") -> list:
+        """``[b, H, W, 3]`` uint8 pixels -> per-row embeddings
+        (lazy device rows by default, like the text embedder)."""
+        import jax.numpy as jnp
+
+        real = pixels.shape[0]
+        b = 8
+        while b < real:
+            b *= 2
+        if b != real:
+            pad = np.zeros((b - real,) + pixels.shape[1:], pixels.dtype)
+            pixels = np.concatenate([pixels, pad])
+        vecs_dev = self._jit_forward(jnp.asarray(pixels))
+        return _rows_from_device(vecs_dev, real, self.device_resident)
+
+    def embed_images(self, images: list) -> "np.ndarray":
+        """PIL images -> ``[n, out_dim]`` numpy (host), for direct use by
+        the parsers' vision seam."""
+        return np.stack(
+            [np.asarray(v, np.float32) for v in self._fn(list(images))]
+        )
+
+    def get_embedding_dimension(self) -> int:
+        return self.config.out_dim
 
 
 class _RemoteEmbedder(UDF):

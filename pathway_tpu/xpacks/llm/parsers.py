@@ -73,20 +73,69 @@ class PypdfParser(UDF):
         super().__init__(parse, executor=SyncExecutor(), deterministic=True)
 
 
+_shared_vision_encoder: Any = None
+
+
+def _default_vision_encoder():
+    """Lazy shared TpuImageEmbedder backing the parsers' vision seam when
+    no vision LLM is injected (preset via PATHWAY_VISION_PRESET; vit-b16
+    — the CLIP image-tower shape — by default). One instance serves every
+    parser so the ViT compiles once per process."""
+    global _shared_vision_encoder
+    if _shared_vision_encoder is None:
+        import os
+
+        from pathway_tpu.xpacks.llm.embedders import TpuImageEmbedder
+
+        _shared_vision_encoder = TpuImageEmbedder(
+            model=os.environ.get("PATHWAY_VISION_PRESET", "vit-b16"),
+            device_resident=False,
+        )
+    return _shared_vision_encoder
+
+
+def _vision_parts(images: list, metas: list, vision: Any) -> list:
+    """Embed PIL images with the ViT in ONE batched forward: each vector
+    lands in its metadata (the multimodal retrieval payload) and the text
+    part carries a content signature, so downstream text remains
+    content-dependent. Batched per document — a 30-page deck is one
+    device dispatch, not 30."""
+    import hashlib
+
+    import numpy as np
+
+    vecs = vision.embed_images(images)
+    texts = []
+    for meta, vec in zip(metas, vecs):
+        meta["image_embedding"] = [float(x) for x in vec]
+        sig = hashlib.blake2s(
+            np.round(np.asarray(vec, np.float32), 3).tobytes(), digest_size=6
+        ).hexdigest()
+        texts.append(
+            f"image {meta['format']} {meta['width']}x{meta['height']} "
+            f"{meta['mode']} sig={sig}"
+        )
+    return texts
+
+
 class ImageParser(UDF):
     """Image bytes -> ((description, metadata),) (reference ImageParser
     parsers.py:396: a vision LLM schema-parses the image).
 
     ``llm``: callable(image: PIL.Image, prompt: str) -> str — the vision
     model seam (remote vision chat in a deployment, a mock offline).
-    Without it the parser still emits deterministic image metadata text so
-    pipelines run end-to-end."""
+    Without it the DEFAULT is the TPU-native ViT (models/vision.py): the
+    image's CLIP-style embedding lands in ``metadata["image_embedding"]``
+    (the multimodal retrieval payload) and the text part carries a
+    content-dependent signature. ``vision=None`` disables the encoder
+    (metadata-only text, the pre-r3 behavior)."""
 
     def __init__(
         self,
         llm: Any = None,
         parse_prompt: str = "Describe the image contents.",
         downsize_horizontal_width: int | None = None,
+        vision: Any = "default",
     ) -> None:
         import io as _io
 
@@ -110,6 +159,11 @@ class ImageParser(UDF):
             }
             if llm is not None:
                 text = str(llm(img, parse_prompt))
+            elif vision is not None:
+                enc = (
+                    _default_vision_encoder() if vision == "default" else vision
+                )
+                (text,) = _vision_parts([img], [meta], enc)
             else:
                 text = (
                     f"image {meta['format']} {img.width}x{img.height} "
@@ -128,30 +182,52 @@ class SlideParser(UDF):
     images (TIFF/GIF) yield one part per page; the vision seam matches
     ImageParser."""
 
-    def __init__(self, llm: Any = None, parse_prompt: str = "Describe the slide.") -> None:
+    def __init__(
+        self,
+        llm: Any = None,
+        parse_prompt: str = "Describe the slide.",
+        vision: Any = "default",
+    ) -> None:
         import io as _io
 
         from PIL import Image, ImageSequence
 
         def parse(contents: Any) -> tuple:
             img = Image.open(_io.BytesIO(contents))
-            parts = []
+            frames, metas = [], []
             for page, frame in enumerate(ImageSequence.Iterator(img)):
-                meta = {
-                    "format": (img.format or "").lower(),
-                    "page": page,
-                    "width": frame.width,
-                    "height": frame.height,
-                }
-                if llm is not None:
-                    text = str(llm(frame.copy(), parse_prompt))
-                else:
-                    text = (
-                        f"slide {page}: {meta['format']} "
-                        f"{frame.width}x{frame.height}"
+                frames.append(frame.copy())
+                metas.append(
+                    {
+                        "format": (img.format or "").lower(),
+                        "page": page,
+                        "width": frame.width,
+                        "height": frame.height,
+                        "mode": frame.mode,
+                    }
+                )
+            if llm is not None:
+                texts = [str(llm(f, parse_prompt)) for f in frames]
+            elif vision is not None:
+                enc = (
+                    _default_vision_encoder()
+                    if vision == "default"
+                    else vision
+                )
+                # whole deck in one batched device dispatch
+                texts = [
+                    f"slide {m['page']}: {t}"
+                    for m, t in zip(
+                        metas, _vision_parts(frames, metas, enc)
                     )
-                parts.append((text, meta))
-            return tuple(parts)
+                ]
+            else:
+                texts = [
+                    f"slide {m['page']}: {m['format']} "
+                    f"{m['width']}x{m['height']}"
+                    for m in metas
+                ]
+            return tuple(zip(texts, metas))
 
         super().__init__(
             parse, executor=SyncExecutor(), deterministic=llm is None

@@ -3,6 +3,9 @@ import os
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 # exercised without TPU hardware (bench.py runs on the real chip).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the parsers' default vision seam compiles a ViT; the tiny preset keeps
+# CPU test runs fast while exercising the identical code path
+os.environ.setdefault("PATHWAY_VISION_PRESET", "vit-tiny")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

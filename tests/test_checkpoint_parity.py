@@ -1,0 +1,86 @@
+"""Committed real-checkpoint parity fixture (VERDICT r2 #5).
+
+tests/fixtures/tiny_bert holds a frozen HF BertModel checkpoint (.npz, a
+real torch-generated state dict) plus golden sentence embeddings computed
+ONCE via torch (tools/make_tiny_bert_fixture.py). These tests reproduce
+the goldens from the committed bytes through the full product path —
+WordPiece tokenizer -> hf_import -> JAX encoder -> mean pooling — with no
+torch at test time. Reference: python/pathway/xpacks/llm/embedders.py:270
+(SentenceTransformerEmbedder semantics)."""
+
+import os
+
+import numpy as np
+import pytest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "tiny_bert")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    data = np.load(os.path.join(FIXTURE, "golden_embeddings.npz"))
+    return (
+        [str(t) for t in data["texts"]],
+        np.asarray(data["embeddings"], np.float32),
+        np.asarray(data["input_ids"], np.int64),
+    )
+
+
+def test_tokenizer_reproduces_golden_input_ids(golden):
+    from pathway_tpu.xpacks.llm._tokenizer import WordPieceTokenizer
+
+    texts, _emb, input_ids = golden
+    tok = WordPieceTokenizer(os.path.join(FIXTURE, "vocab.txt"))
+    for row, text in zip(input_ids, texts):
+        real = [int(t) for t in row if t != tok.pad_id]
+        assert tok.encode(text) == real, text
+
+
+def test_jax_encoder_reproduces_torch_goldens_to_1e4(golden):
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.hf_import import load_sentence_transformer
+    from pathway_tpu.models.transformer import EncoderConfig, embed
+
+    texts, expected, _ids = golden
+    params, cfg, tok = load_sentence_transformer(FIXTURE)
+    assert tok is not None
+    # head count comes from the checkpoint's config.json (invisible in
+    # tensor shapes)
+    assert (cfg.hidden, cfg.layers, cfg.heads) == (64, 2, 4)
+    cfg = EncoderConfig(
+        **{
+            **{f: getattr(cfg, f) for f in cfg.__dataclass_fields__},
+            "dtype": jnp.float32,
+        }
+    )
+    ids, mask = tok.encode_batch(texts, 32)
+    ours = np.asarray(
+        embed(params, jnp.asarray(ids, jnp.int32), jnp.asarray(mask), cfg),
+        np.float32,
+    )
+    diff = np.abs(ours - expected).max()
+    assert diff < 1e-4, f"max |jax - torch| = {diff}"
+    # and the embeddings are semantically sane: self-similarity 1.0
+    sims = ours @ expected.T
+    assert np.allclose(np.diag(sims), 1.0, atol=1e-4)
+
+
+def test_embedder_udf_serves_fixture_checkpoint(golden):
+    """The user-facing path: TpuEncoderEmbedder(model=<dir>) loads the
+    committed checkpoint and reproduces the torch goldens."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.transformer import EncoderConfig
+    from pathway_tpu.xpacks.llm.embedders import TpuEncoderEmbedder
+
+    texts, expected, _ids = golden
+    emb = TpuEncoderEmbedder(model=FIXTURE, max_len=32, device_resident=False)
+    assert emb.config.heads == 4  # from the checkpoint's config.json
+    out = np.stack([np.asarray(v, np.float32) for v in emb._fn(list(texts))])
+    # bf16 activations on the default config cost precision; the parity
+    # axis is the f32 test above — here assert the product path ranks
+    # identically and stays close
+    assert np.abs(out - expected).max() < 2e-2
+    sims = out @ expected.T
+    assert (np.argmax(sims, axis=1) == np.arange(len(texts))).all()

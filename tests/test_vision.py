@@ -1,0 +1,124 @@
+"""ViT vision encoder + multimodal parser seam (VERDICT r2 #7; reference:
+python/pathway/xpacks/llm/parsers.py:396,569 vision path and the CLIP
+embedders of vector_store.py:588)."""
+
+import io
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image, ImageDraw  # noqa: E402
+
+
+def _img(seed: int, size: int = 32) -> Image.Image:
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+    img = Image.fromarray(arr, "RGB")
+    d = ImageDraw.Draw(img)
+    d.rectangle([seed % 10, seed % 7, 20 + seed % 10, 18 + seed % 7],
+                fill=(255, 0, 0))
+    return img
+
+
+def _png(img: Image.Image) -> bytes:
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class TestVisionModel:
+    def test_forward_shapes_and_norm(self):
+        import jax
+
+        from pathway_tpu.models import (
+            init_vision_params,
+            vision_forward,
+            vit_tiny,
+        )
+
+        cfg = vit_tiny()
+        params = init_vision_params(jax.random.key(0), cfg)
+        pixels = np.random.default_rng(0).normal(
+            size=(2, cfg.image_size, cfg.image_size, 3)
+        ).astype(np.float32)
+        out = np.asarray(vision_forward(params, pixels, cfg))
+        assert out.shape == (2, cfg.out_dim)
+        assert np.allclose(np.linalg.norm(out, axis=-1), 1.0, atol=1e-3)
+
+    def test_content_dependent_and_deterministic(self):
+        from pathway_tpu.xpacks.llm.embedders import TpuImageEmbedder
+
+        emb = TpuImageEmbedder(model="vit-tiny", device_resident=False)
+        a1 = np.asarray(emb._fn([_png(_img(1))])[0])
+        a2 = np.asarray(emb._fn([_png(_img(1))])[0])
+        b = np.asarray(emb._fn([_png(_img(7))])[0])
+        assert np.allclose(a1, a2)
+        assert not np.allclose(a1, b)
+
+    def test_locality_nearest_neighbor_recovers_source(self):
+        """A noisy variant of an image embeds nearer its source than other
+        images — the property multimodal retrieval rests on."""
+        from pathway_tpu.xpacks.llm.embedders import TpuImageEmbedder
+
+        emb = TpuImageEmbedder(model="vit-tiny", device_resident=False)
+        base = [_img(i) for i in range(6)]
+        mat = emb.embed_images(base)
+        noisy = base[3].copy()
+        arr = np.asarray(noisy, np.uint8).astype(np.int16)
+        arr = np.clip(
+            arr + np.random.default_rng(0).integers(-14, 14, arr.shape),
+            0, 255,
+        ).astype(np.uint8)
+        q = emb.embed_images([Image.fromarray(arr, "RGB")])[0]
+        sims = mat @ q
+        assert int(np.argmax(sims)) == 3, sims
+
+    def test_param_spec_covers_tree(self):
+        import jax
+
+        from pathway_tpu.models import (
+            init_vision_params,
+            vision_param_spec,
+            vit_tiny,
+        )
+
+        params = init_vision_params(jax.random.key(0), vit_tiny())
+        specs = jax.tree_util.tree_map_with_path(vision_param_spec, params)
+        assert jax.tree_util.tree_structure(specs) == jax.tree_util.tree_structure(params)
+
+
+class TestParserVisionSeam:
+    def test_image_parser_default_embeds_content(self):
+        from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+        parser = ImageParser()
+        ((t1, m1),) = parser._fn(_png(_img(1)))
+        ((t2, m2),) = parser._fn(_png(_img(2)))
+        assert "sig=" in t1 and t1 != t2  # content-dependent text
+        v1 = np.asarray(m1["image_embedding"], np.float32)
+        v2 = np.asarray(m2["image_embedding"], np.float32)
+        assert v1.shape == v2.shape and not np.allclose(v1, v2)
+        assert abs(np.linalg.norm(v1) - 1.0) < 1e-3
+
+    def test_slide_parser_default_per_frame_embeddings(self):
+        from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+        frames = [_img(i) for i in range(3)]
+        buf = io.BytesIO()
+        frames[0].save(
+            buf, format="GIF", save_all=True, append_images=frames[1:],
+            optimize=False,
+        )
+        parser = SlideParser()
+        parts = parser._fn(buf.getvalue())
+        assert len(parts) == 3
+        embs = [np.asarray(m["image_embedding"]) for _t, m in parts]
+        assert not np.allclose(embs[0], embs[1])
+
+    def test_vision_none_restores_metadata_only(self):
+        from pathway_tpu.xpacks.llm.parsers import ImageParser
+
+        parser = ImageParser(vision=None)
+        ((text, meta),) = parser._fn(_png(_img(1)))
+        assert "sig=" not in text and "image_embedding" not in meta

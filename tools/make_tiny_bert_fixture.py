@@ -1,0 +1,99 @@
+"""Generate the committed tiny-BERT parity fixture (run once; VERDICT r2 #5).
+
+Builds a seeded ``transformers.BertModel`` (real HF BERT graph, random but
+frozen weights), saves it as a sentence-transformers-style directory
+(model.npz + vocab.txt), and computes golden sentence embeddings via TORCH
+(mean pooling over the attention mask + L2 norm — the sentence-transformers
+recipe, reference python/pathway/xpacks/llm/embedders.py:270). The parity
+test (tests/test_checkpoint_parity.py) must reproduce these goldens from
+the committed .npz through the JAX path to 1e-4.
+
+Usage: python tools/make_tiny_bert_fixture.py  (writes tests/fixtures/tiny_bert)
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import torch
+import transformers
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests",
+    "fixtures",
+    "tiny_bert",
+)
+
+SPECIALS = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+WORDS = (
+    "the quick brown fox jump over lazy dog stream table index vector "
+    "engine commit window join reduce shard tensor batch query embed "
+    "token device mesh scatter gather fuse run process data model value "
+    "key state time event count sum filter group sort merge split parse"
+).split()
+SUBWORDS = ["##s", "##ed", "##ing", "##er", "##ly", ",", ".", "!", "?"]
+
+GOLDEN_TEXTS = [
+    "the quick brown fox jumps over the lazy dog",
+    "stream table index vector engine",
+    "commit window join reduce shard",
+    "tensor batch query embed token device",
+    "mesh scatter gather fuse run process",
+    "data model value key state time",
+    "running foxes jumped!",
+    "the the the",
+]
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    vocab = SPECIALS + WORDS + SUBWORDS
+    with open(os.path.join(OUT, "vocab.txt"), "w") as f:
+        f.write("\n".join(vocab) + "\n")
+
+    torch.manual_seed(1234)
+    config = transformers.BertConfig(
+        vocab_size=len(vocab),
+        hidden_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=128,
+        max_position_embeddings=64,
+        type_vocab_size=2,
+        hidden_act="gelu",
+    )
+    model = transformers.BertModel(config)
+    model.eval()
+    sd = {k: v.numpy().astype(np.float32) for k, v in model.state_dict().items()}
+    np.savez(os.path.join(OUT, "model.npz"), **sd)
+    with open(os.path.join(OUT, "config.json"), "w") as f:
+        f.write(config.to_json_string())
+
+    tok = transformers.BertTokenizer(
+        os.path.join(OUT, "vocab.txt"), do_lower_case=True, use_fast=False
+    )
+    enc = tok(
+        GOLDEN_TEXTS, padding=True, truncation=True, max_length=32,
+        return_tensors="pt",
+    )
+    with torch.no_grad():
+        hidden = model(
+            input_ids=enc["input_ids"], attention_mask=enc["attention_mask"]
+        ).last_hidden_state
+    m = enc["attention_mask"].unsqueeze(-1).float()
+    emb = (hidden * m).sum(1) / m.sum(1).clamp(min=1e-9)
+    emb = torch.nn.functional.normalize(emb, dim=-1).numpy()
+    np.savez(
+        os.path.join(OUT, "golden_embeddings.npz"),
+        texts=np.asarray(GOLDEN_TEXTS),
+        embeddings=emb.astype(np.float32),
+        input_ids=enc["input_ids"].numpy(),
+    )
+    print(f"wrote fixture to {OUT}: vocab={len(vocab)} dim=64 "
+          f"goldens={emb.shape}")
+
+
+if __name__ == "__main__":
+    main()
