@@ -1,11 +1,15 @@
 """pw.sql — SQL over Tables.
 
 Reference: python/pathway/internals/sql.py (726 LoC) parses with sqlglot and
-lowers onto Table ops. sqlglot is not in this image, so the same subset is
-parsed with a small recursive-descent parser and lowered identically:
-SELECT expressions (+aliases, arithmetic, comparisons, AND/OR/NOT, literals),
-FROM, INNER JOIN ... ON equalities, WHERE, GROUP BY with aggregates
-(count/sum/min/max/avg), HAVING, UNION ALL, INTERSECT.
+lowers onto Table ops. sqlglot is not in this image, so the dialect is
+parsed by a tokenizer + recursive-descent grammar producing a proper AST
+with standard precedence (OR < AND < NOT < comparisons/IS/IN < +- < */%),
+then lowered onto Table ops: SELECT expressions (+aliases, arithmetic,
+parenthesized nesting, literals, quoted identifiers), FROM with table
+aliases and derived tables (nested subqueries, arbitrarily deep),
+INNER/LEFT JOIN ... ON equalities (subqueries join too), WHERE,
+IN/NOT IN value lists, GROUP BY with aggregates (count/sum/min/max/avg),
+HAVING, UNION ALL, INTERSECT.
 """
 
 from __future__ import annotations
@@ -23,13 +27,14 @@ from pathway_tpu.internals.table import Table
 
 _TOKEN_RE = re.compile(
     r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<str>'(?:[^']|'')*')"
+    r'|(?P<qname>"(?:[^"]|"")*"|`[^`]*`)'
     r"|(?P<op><=|>=|<>|!=|==|[(),*+\-/<>=.%])"
     r"|(?P<name>[A-Za-z_][A-Za-z_0-9]*))"
 )
 
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "as", "and", "or",
-    "not", "join", "inner", "left", "on", "union", "all", "intersect", "count", "sum",
+    "not", "join", "inner", "left", "on", "union", "all", "intersect", "in", "count", "sum",
     "min", "max", "avg", "null", "true", "false", "is",
 }
 
@@ -48,6 +53,13 @@ def _tokenize(text: str) -> list[tuple[str, str]]:
             out.append(("num", m.group("num")))
         elif m.group("str") is not None:
             out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("qname") is not None:
+            q = m.group("qname")
+            # quoted identifier: case preserved, never a keyword
+            if q.startswith('"'):
+                out.append(("name", q[1:-1].replace('""', '"')))
+            else:
+                out.append(("name", q[1:-1]))
         elif m.group("op") is not None:
             out.append(("op", m.group("op")))
         else:
@@ -87,16 +99,22 @@ class _Parser:
     # -- grammar -------------------------------------------------------------
 
     def parse_query(self) -> dict:
+        q = self.parse_set_chain()
+        self.expect("end")
+        return q
+
+    def parse_set_chain(self) -> dict:
+        """UNION ALL chain over INTERSECT chains (INTERSECT binds tighter,
+        standard SQL precedence) — shared by top-level queries and derived
+        tables."""
         q = self.parse_intersect_chain()
         while self.accept("kw", "union"):
             self.expect("kw", "all")
-            # INTERSECT binds tighter than UNION (standard SQL precedence)
             q = {
                 "kind": "union",
                 "left": q,
                 "right": self.parse_intersect_chain(),
             }
-        self.expect("end")
         return q
 
     def parse_intersect_chain(self) -> dict:
@@ -122,7 +140,7 @@ class _Parser:
                 if not self.accept("op", ","):
                     break
         self.expect("kw", "from")
-        base = self.expect("name")
+        base = self.parse_table_ref()
         joins = []
         while self.peek() == ("kw", "join") or self.peek() == ("kw", "inner") or self.peek() == ("kw", "left"):
             how = "inner"
@@ -130,7 +148,7 @@ class _Parser:
                 how = "left"
             self.accept("kw", "inner")
             self.expect("kw", "join")
-            other = self.expect("name")
+            other = self.parse_table_ref()
             self.expect("kw", "on")
             cond = self.parse_expr()
             joins.append({"table": other, "on": cond, "how": how})
@@ -155,6 +173,23 @@ class _Parser:
             "group_by": group_by,
             "having": having,
         }
+
+    def parse_table_ref(self) -> dict:
+        """A FROM/JOIN operand: plain table name, or a parenthesized
+        subquery with a mandatory alias (standard derived-table form)."""
+        if self.accept("op", "("):
+            sub = self.parse_set_chain()
+            self.expect("op", ")")
+            self.accept("kw", "as")
+            alias = self.expect("name")
+            return {"subquery": sub, "alias": alias}
+        name = self.expect("name")
+        alias = name
+        if self.accept("kw", "as"):
+            alias = self.expect("name")
+        elif self.peek()[0] == "name":
+            alias = self.next()[1]
+        return {"table": name, "alias": alias}
 
     def parse_expr(self) -> Any:
         return self.parse_or()
@@ -187,6 +222,21 @@ class _Parser:
             negated = self.accept("kw", "not")
             self.expect("kw", "null")
             return ("is_not_null" if negated else "is_null", e)
+        negated_in = False
+        if self.peek() == ("kw", "not") and self.tokens[self.i + 1] == (
+            "kw",
+            "in",
+        ):
+            self.next()
+            negated_in = True
+        if self.accept("kw", "in"):
+            self.expect("op", "(")
+            values = [self.parse_expr()]
+            while self.accept("op", ","):
+                values.append(self.parse_expr())
+            self.expect("op", ")")
+            node = ("in", e, values)
+            return ("not", node) if negated_in else node
         return e
 
     def parse_add(self) -> Any:
@@ -342,6 +392,13 @@ class _Lowerer:
             return e.is_not_none()
         if op == "agg":
             raise ValueError("pw.sql: aggregate used outside GROUP BY select")
+        if op == "in":
+            e = self.expr(node[1], scope)
+            parts = [e == self.expr(v, scope) for v in node[2]]
+            out = parts[0]
+            for part in parts[1:]:
+                out = out | part
+            return out
         left = self.expr(node[1], scope)
         right = self.expr(node[2], scope)
         return {
@@ -421,19 +478,35 @@ class _Lowerer:
             return node[1]
         return f"col_{idx}"
 
+    def _resolve_table(self, ref: dict) -> tuple[Table, str]:
+        """FROM/JOIN operand -> (Table, alias). Derived tables (nested
+        subqueries) lower through a FRESH lowerer so their join colmaps
+        can't leak into this SELECT's."""
+        if "subquery" in ref:
+            return _Lowerer(self.tables).lower(ref["subquery"]), ref["alias"]
+        base = self.tables.get(ref["table"])
+        if base is None:
+            raise ValueError(f"pw.sql: unknown table {ref['table']!r}")
+        return base, ref["alias"]
+
+    @staticmethod
+    def _fresh_copy(table: Table) -> Table:
+        """Independent view of a table (self-joins: both aliases must
+        resolve to DISTINCT Table objects or every qualified reference
+        collapses onto one side)."""
+        return table.select(**{n: table[n] for n in table.column_names()})
+
     def lower_select(self, q: dict) -> Table:
         self.colmap = {}  # per-SELECT: a UNION branch must not see the other's joins
         scope: dict[str, Table] = {}
-        base = self.tables.get(q["from"])
-        if base is None:
-            raise ValueError(f"pw.sql: unknown table {q['from']!r}")
-        scope[q["from"]] = base
+        base, base_alias = self._resolve_table(q["from"])
+        scope[base_alias] = base
         current = base
         for j in q["joins"]:
-            other = self.tables.get(j["table"])
-            if other is None:
-                raise ValueError(f"pw.sql: unknown table {j['table']!r}")
-            scope[j["table"]] = other
+            other, other_alias = self._resolve_table(j["table"])
+            if any(existing is other for existing in scope.values()):
+                other = self._fresh_copy(other)  # self-join
+            scope[other_alias] = other
             cond_ast = j["on"]
             if not (isinstance(cond_ast, tuple) and cond_ast[0] == "=="):
                 raise ValueError("pw.sql: JOIN ON must be an equality")

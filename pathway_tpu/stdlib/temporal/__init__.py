@@ -206,6 +206,32 @@ class WindowedTable:
         cols = assigned.column_names()
         time_col = cols.index("_pw_time")
         out = assigned
+        # CUTOFF gates arrivals FIRST, on the raw stream: its watermark
+        # must advance with every arriving row. Downstream of the delay
+        # buffer it would only see released rows — held rows would not
+        # move it, and a late row for an already-emitted window could
+        # slip past the cutoff (caught by the behaviors x windows matrix:
+        # exactly_once emitted a second, revised result).
+        if behavior.cutoff is not None:
+            cutoff = behavior.cutoff
+            out = out.select(
+                **{n: out[n] for n in cols},
+                _pw_threshold=pw_apply(
+                    lambda e: e + cutoff, out["_pw_window_end"]
+                ),
+            )
+            kind = "forget" if not behavior.keep_results else "freeze"
+            out = out._derived(
+                TableSpec(
+                    kind,
+                    [out],
+                    {
+                        "threshold_col": len(cols),
+                        "time_col": time_col,
+                    },
+                ),
+                {n: out._dtypes[n] for n in out.column_names()},
+            )[cols]
         if behavior.delay is not None:
             # anchored at window *start* (reference _window.py:396-398:
             # "delays initial output ... with respect to the beginning of
@@ -220,26 +246,6 @@ class WindowedTable:
             out = out._derived(
                 TableSpec(
                     "buffer",
-                    [out],
-                    {
-                        "threshold_col": len(cols),
-                        "time_col": time_col,
-                    },
-                ),
-                {n: out._dtypes[n] for n in out.column_names()},
-            )[cols]
-        if behavior.cutoff is not None:
-            cutoff = behavior.cutoff
-            out = out.select(
-                **{n: out[n] for n in cols},
-                _pw_threshold=pw_apply(
-                    lambda e: e + cutoff, out["_pw_window_end"]
-                ),
-            )
-            kind = "forget" if not behavior.keep_results else "freeze"
-            out = out._derived(
-                TableSpec(
-                    kind,
                     [out],
                     {
                         "threshold_col": len(cols),

@@ -1,0 +1,294 @@
+"""Error-log semantics + dtype/schema-inference corner depth
+(VERDICT r2 #9; reference shapes: python/pathway/tests/test_errors.py and
+test_schema.py/test_types.py)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.value import is_error
+from pathway_tpu.internals.parse_graph import G
+
+
+def rows(table):
+    df = pw.debug.table_to_pandas(table)
+    return sorted(
+        map(tuple, df.itertuples(index=False)), key=repr
+    )  # repr-keyed: ERROR cells are unorderable
+
+
+class TestErrorPropagation:
+    """ERROR poisoning: errors stay row-local, flow through dependent
+    expressions, drop at sinks, and land in the error log with messages."""
+
+    def _table(self):
+        return pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=int),
+            [(10, 2), (7, 0), (9, 3)],
+        )
+
+    def test_division_by_zero_poisons_only_its_row(self):
+        G.clear()
+        t = self._table().select(a=pw.this.a, q=pw.this.a // pw.this.b)
+        got = rows(t)
+        ok = [(a, q) for a, q in got if not is_error(q)]
+        bad = [(a, q) for a, q in got if is_error(q)]
+        assert sorted(ok) == [(9, 3), (10, 5)]
+        assert [a for a, _q in bad] == [7]  # only the b=0 row poisoned
+
+    def test_error_propagates_through_dependent_expressions(self):
+        G.clear()
+        t = self._table().select(q=pw.this.a // pw.this.b)
+        t2 = t.select(r=pw.this.q + 1000)  # ERROR + 1000 stays ERROR
+        vals = [r[0] for r in rows(t2)]
+        assert sorted(v for v in vals if not is_error(v)) == [1003, 1005]
+        assert sum(1 for v in vals if is_error(v)) == 1
+
+    def test_error_log_carries_messages_and_counts(self):
+        G.clear()
+        t = self._table().select(q=pw.this.a // pw.this.b)
+        log = pw.global_error_log()
+        captured = []
+        pw.io.subscribe(
+            log,
+            on_change=lambda key, row, time, is_addition: captured.append(
+                row
+            ),
+        )
+        pw.io.null.write(t)
+        pw.run()
+        assert captured, "error log empty"
+        assert any(
+            "division" in str(r.get("message", "")).lower()
+            or "zero" in str(r.get("message", "")).lower()
+            for r in captured
+        )
+
+    def test_local_error_log_scopes(self):
+        G.clear()
+        outer_t = self._table().select(q=pw.this.a // pw.this.b)
+        with pw.local_error_log() as inner_log:
+            inner_t = self._table().select(
+                q=pw.this.a % (pw.this.b - pw.this.b)
+            )
+        inner_msgs = []
+        pw.io.subscribe(
+            inner_log,
+            on_change=lambda key, row, time, is_addition: inner_msgs.append(
+                row
+            ),
+        )
+        pw.io.null.write(outer_t)
+        pw.io.null.write(inner_t)
+        pw.run()
+        assert inner_msgs  # inner scope caught its own operator's errors
+
+    def test_udf_exception_poisons_row_not_pipeline(self):
+        G.clear()
+
+        @pw.udf
+        def fragile(x: int) -> int:
+            if x == 7:
+                raise RuntimeError("boom on 7")
+            return x * 2
+
+        t = self._table().select(y=fragile(pw.this.a))
+        vals = [r[0] for r in rows(t)]
+        assert sorted(v for v in vals if not is_error(v)) == [18, 20]
+        assert sum(1 for v in vals if is_error(v)) == 1  # only x=7
+
+    def test_error_in_groupby_key_skips_row(self):
+        G.clear()
+        t = self._table().select(
+            g=pw.this.a // pw.this.b, v=pw.this.a
+        )
+        agg = t.groupby(pw.this.g).reduce(
+            g=pw.this.g, s=pw.reducers.sum(pw.this.v)
+        )
+        got = rows(agg)
+        assert (5, 10) in got and (3, 9) in got and len(got) == 2
+
+    def test_error_in_join_key_skips_row(self):
+        G.clear()
+        left = self._table().select(
+            k=pw.this.a // pw.this.b, v=pw.this.a
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, name=str), [(5, "five"), (3, "three")]
+        )
+        j = left.join(right, left.k == right.k).select(
+            v=left.v, name=right.name
+        )
+        assert set(rows(j)) == {(9, "three"), (10, "five")}
+
+    def test_filter_on_error_condition_drops_row(self):
+        G.clear()
+        t = self._table().filter((pw.this.a // pw.this.b) > 0)
+        got = rows(t)
+        assert (7, 0) not in got and len(got) == 2
+
+
+class TestDtypeCorners:
+    def test_int64_boundaries_round_trip(self, tmp_path):
+        G.clear()
+        vals = [2**62, -(2**62), 2**63 - 1, -(2**63) + 1, 0]
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int), [(v,) for v in vals]
+        )
+        out = tmp_path / "o.jsonl"
+        pw.io.jsonlines.write(t, out)
+        pw.run()
+        got = sorted(
+            json.loads(l)["v"] for l in out.read_text().splitlines()
+        )
+        assert got == sorted(vals)
+
+    def test_float_specials_survive_expressions(self):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(f=float),
+            [(1.5,), (-0.0,), (1e308,), (5e-324,)],
+        )
+        t2 = t.select(d=pw.this.f * 2)
+        got = sorted(r[0] for r in rows(t2))
+        assert 3.0 in got and 1e-323 in got
+        assert any(x == float("inf") or x == 2e308 for x in got) or any(
+            np.isinf(x) for x in got
+        )
+
+    def test_bool_is_not_int_in_groupby(self):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=bool, v=int),
+            [(True, 1), (False, 2), (True, 4)],
+        )
+        agg = t.groupby(pw.this.k).reduce(
+            k=pw.this.k, s=pw.reducers.sum(pw.this.v)
+        )
+        got = dict(rows(agg))
+        assert got == {True: 5, False: 2}
+
+    def test_optional_int_none_handling(self):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int),
+            [(1,), (None,), (3,)],
+        )
+        present = t.filter(pw.this.v.is_not_none())
+        assert sorted(r[0] for r in rows(present)) == [1, 3]
+        absent = t.filter(pw.this.v.is_none())
+        assert len(rows(absent)) == 1
+
+    def test_string_unicode_and_nul_adjacent(self, tmp_path):
+        G.clear()
+        vals = ["héllo", "漢字テスト", "emoji 🎉", "tab\tchar", "a" * 1000]
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(s=str), [(v,) for v in vals]
+        )
+        out = tmp_path / "o.jsonl"
+        pw.io.jsonlines.write(t, out)
+        pw.run()
+        got = sorted(
+            json.loads(l)["s"] for l in out.read_text().splitlines()
+        )
+        assert got == sorted(vals)
+
+    def test_bigint_beyond_int64_stays_exact_in_python_path(self):
+        G.clear()
+        big = 2**100
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int), [(big,), (1,)]
+        )
+        t2 = t.select(d=pw.this.v + 1)
+        assert sorted(r[0] for r in rows(t2)) == [2, big + 1]
+
+    def test_bytes_round_trip_through_engine(self):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(b=bytes), [(b"\x00\xff",), (b"",)]
+        )
+        assert sorted(r[0] for r in rows(t)) == [b"", b"\x00\xff"]
+
+    def test_datetime_columns_compare_and_group(self):
+        G.clear()
+        import datetime
+
+        d1 = datetime.datetime(2026, 1, 1)
+        d2 = datetime.datetime(2026, 6, 1)
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(ts=datetime.datetime, v=int),
+            [(d1, 1), (d2, 2), (d1, 4)],
+        )
+        agg = t.groupby(pw.this.ts).reduce(
+            ts=pw.this.ts, s=pw.reducers.sum(pw.this.v)
+        )
+        got = dict(rows(agg))
+        assert got == {d1: 5, d2: 2}
+
+
+class TestSchemaInferenceCorners:
+    def test_csv_inference_mixed_then_promoted(self, tmp_path):
+        src = tmp_path / "t.csv"
+        src.write_text("a,b,c\n1,1.5,x\n2,2,y\n")
+        schema = pw.schema_from_csv(str(src))
+        dts = schema.dtypes()
+        names = schema.column_names()
+        assert names == ["a", "b", "c"]
+        from pathway_tpu.internals import dtype as dt
+
+        assert dts["a"].strip_optional() == dt.INT
+        # 1.5 then 2: promoted to float, not truncated to int
+        assert dts["b"].strip_optional() == dt.FLOAT
+        assert dts["c"].strip_optional() == dt.STR
+
+    def test_schema_from_dict_and_defaults(self):
+        schema = pw.schema_from_dict(
+            {"a": int, "b": {"dtype": str, "default_value": "?"}}
+        )
+        assert schema.column_names() == ["a", "b"]
+
+    def test_schema_equality_and_subset_assertion(self):
+        s1 = pw.schema_from_types(a=int, b=str)
+        t = pw.debug.table_from_rows(s1, [(1, "x")])
+        pw.assert_table_has_schema(t, s1)
+        with pytest.raises(Exception):
+            pw.assert_table_has_schema(
+                t, pw.schema_from_types(a=str, b=str)
+            )
+
+    def test_jsonlines_inference_of_optionals(self, tmp_path):
+        src = tmp_path / "t.jsonl"
+        src.write_text('{"a": 1, "b": "x"}\n{"a": null, "b": "y"}\n')
+        G.clear()
+        t = pw.io.jsonlines.read(
+            src,
+            schema=pw.schema_from_types(a=int, b=str),
+            mode="static",
+        )
+        import math
+
+        got = rows(t)
+        by_b = {b: a for a, b in got}
+        assert by_b["x"] == 1
+        a_null = by_b["y"]
+        assert a_null is None or (
+            isinstance(a_null, float) and math.isnan(a_null)
+        )
+
+    def test_primary_key_dedupes_on_reread(self, tmp_path):
+        G.clear()
+
+        class S(pw.Schema):
+            id: int = pw.column_definition(primary_key=True)
+            v: str
+
+        src = tmp_path / "t.jsonl"
+        src.write_text('{"id": 1, "v": "a"}\n{"id": 1, "v": "b"}\n')
+        t = pw.io.jsonlines.read(src, schema=S, mode="static")
+        got = rows(t)
+        # same primary key: the later row replaces the earlier
+        assert len(got) == 1 and got[0][0] == 1

@@ -1,0 +1,509 @@
+"""IO round-trip matrix (VERDICT r2 #9): every file/lake/queue connector
+write->read round trip, across dtypes, under journal persistence, and
+under multi-worker execution — the reference covers its connectors at this
+depth in python/pathway/tests/test_io.py (~5k LoC)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.storage import DictObjectStore, InMemoryTransport
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import GraphRunner, ShardedGraphRunner
+
+
+def _run(threads: int = 1):
+    pw.run(threads=threads)
+
+
+def _fresh():
+    G.clear()
+
+
+# -- payloads across the dtype surface ---------------------------------------
+
+ROWS_TYPED = [
+    (0, 0.0, True, "plain"),
+    (-(2**31), -1.5, False, "unicode-éß漢字"),
+    (2**40, 3.141592653589793, True, "comma, and 'quote'"),
+    (7, -0.0, False, ""),
+    (42, 1e-300, True, 'double"quote'),
+]
+SCHEMA_TYPED = pw.schema_from_types(i=int, f=float, b=bool, s=str)
+
+
+def _typed_table():
+    return pw.debug.table_from_rows(SCHEMA_TYPED, ROWS_TYPED)
+
+
+def _norm(rows):
+    # -0.0 == 0.0 under equality; normalize for set comparison
+    return sorted(
+        (int(i), float(f) + 0.0, bool(b), str(s)) for i, f, b, s in rows
+    )
+
+
+class TestFileFormatsRoundTrip:
+    @pytest.mark.parametrize("fmt", ["csv", "jsonlines"])
+    @pytest.mark.parametrize("threads", [1, 2])
+    def test_typed_round_trip(self, tmp_path, fmt, threads):
+        _fresh()
+        out = tmp_path / f"out.{fmt}"
+        io_mod = getattr(pw.io, fmt)
+        io_mod.write(_typed_table(), out)
+        _run(threads)
+        _fresh()
+        back = io_mod.read(out, schema=SCHEMA_TYPED, mode="static")
+        got = [
+            (r.i, r.f, r.b, r.s)
+            for r in pw.debug.table_to_pandas(back).itertuples(index=False)
+        ]
+        assert _norm(got) == _norm(ROWS_TYPED)
+
+    @pytest.mark.parametrize("threads", [1, 2])
+    def test_deltalake_round_trip(self, tmp_path, threads):
+        _fresh()
+        lake = tmp_path / "lake"
+        pw.io.deltalake.write(_typed_table(), lake)
+        _run(threads)
+        _fresh()
+        back = pw.io.deltalake.read(lake, schema=SCHEMA_TYPED, mode="static")
+        got = [
+            (r.i, r.f, r.b, r.s)
+            for r in pw.debug.table_to_pandas(back).itertuples(index=False)
+        ]
+        assert _norm(got) == _norm(ROWS_TYPED)
+
+    @pytest.mark.parametrize("threads", [1, 2])
+    def test_iceberg_round_trip(self, tmp_path, threads):
+        _fresh()
+        pw.io.iceberg.write(_typed_table(), tmp_path / "wh", ["db"], "t")
+        _run(threads)
+        _fresh()
+        back = pw.io.iceberg.read(
+            tmp_path / "wh", ["db"], "t", schema=SCHEMA_TYPED, mode="static"
+        )
+        got = [
+            (r.i, r.f, r.b, r.s)
+            for r in pw.debug.table_to_pandas(back).itertuples(index=False)
+        ]
+        assert _norm(got) == _norm(ROWS_TYPED)
+
+    def test_plaintext_preserves_lines(self, tmp_path):
+        _fresh()
+        src = tmp_path / "in"
+        src.mkdir()
+        lines = ["first line", "tabs\tstay", "spaces  stay", "final"]
+        (src / "a.txt").write_text("\n".join(lines) + "\n")
+        t = pw.io.plaintext.read(src, mode="static")
+        out = tmp_path / "out.jsonl"
+        pw.io.jsonlines.write(t, out)
+        pw.run()
+        got = sorted(
+            json.loads(l)["data"] for l in out.read_text().splitlines()
+        )
+        assert got == sorted(lines)
+
+    def test_csv_null_cells_round_trip(self, tmp_path):
+        _fresh()
+        src = tmp_path / "in.csv"
+        src.write_text("a,b\n1,x\n2,\n")
+        t = pw.io.csv.read(
+            src, schema=pw.schema_from_types(a=int, b=str), mode="static"
+        )
+        df = pw.debug.table_to_pandas(t)
+        by_a = {r.a: r.b for r in df.itertuples(index=False)}
+        assert by_a[1] == "x"
+        assert by_a[2] in ("", None)
+
+    def test_jsonlines_nested_json_column(self, tmp_path):
+        _fresh()
+        src = tmp_path / "in.jsonl"
+        rows = [
+            {"k": 1, "payload": {"tags": ["a", "b"], "depth": {"x": 1}}},
+            {"k": 2, "payload": {"tags": [], "depth": {"x": 2}}},
+        ]
+        src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        t = pw.io.jsonlines.read(
+            src, schema=pw.schema_from_types(k=int, payload=dict), mode="static"
+        )
+        out = tmp_path / "out.jsonl"
+        pw.io.jsonlines.write(t, out)
+        pw.run()
+        got = sorted(
+            (json.loads(l)["k"], json.loads(l)["payload"])
+            for l in out.read_text().splitlines()
+        )
+        assert got == sorted((r["k"], r["payload"]) for r in rows)
+
+
+class TestStreamingUpdatesThroughSinks:
+    """Update streams (insert + retract) must surface as diff rows in
+    every update-log sink, and net out in snapshot sinks."""
+
+    def _updating_table(self):
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                self.next(k=1, v=10)
+                self.next(k=2, v=20)
+                self.commit()
+                time.sleep(0.3)  # let the first batch commit separately
+                self.next(k=1, v=11)  # same key: replaces via groupby below
+                self.commit()
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(k=int, v=int),
+            autocommit_duration_ms=None,
+        )
+        return t.groupby(pw.this.k).reduce(
+            k=pw.this.k, latest=pw.reducers.max(pw.this.v)
+        )
+
+    def test_csv_update_log_carries_diffs(self, tmp_path):
+        _fresh()
+        out = tmp_path / "out.csv"
+        pw.io.csv.write(self._updating_table(), out)
+        pw.run()
+        rows = out.read_text().splitlines()
+        header = rows[0].split(",")
+        assert "diff" in header and "time" in header
+        parsed = [dict(zip(header, r.split(","))) for r in rows[1:]]
+        k1 = [p for p in parsed if p["k"] == "1"]
+        assert any(int(p["diff"]) < 0 for p in k1), "retraction missing"
+        state = {}
+        for p in parsed:
+            if int(p["diff"]) > 0:
+                state[p["k"]] = p["latest"]
+            elif state.get(p["k"]) == p["latest"]:
+                del state[p["k"]]
+        assert state == {"1": "11", "2": "20"}
+
+    def test_deltalake_streaming_reader_sees_appends(self, tmp_path):
+        _fresh()
+        lake = tmp_path / "lake"
+        pw.io.deltalake.write(
+            pw.debug.table_from_rows(
+                pw.schema_from_types(a=int), [(1,), (2,)]
+            ),
+            lake,
+        )
+        pw.run()
+        _fresh()
+        pw.io.deltalake.write(
+            pw.debug.table_from_rows(pw.schema_from_types(a=int), [(3,)]),
+            lake,
+        )
+        pw.run()
+        _fresh()
+        back = pw.io.deltalake.read(
+            lake, schema=pw.schema_from_types(a=int), mode="static"
+        )
+        assert sorted(
+            r.a for r in pw.debug.table_to_pandas(back).itertuples()
+        ) == [1, 2, 3]
+
+
+class TestQueueSeams:
+    """Message-queue connectors over the injectable transports — the same
+    driver/formatter code paths a broker deployment runs."""
+
+    def test_kafka_json_round_trip_with_tombstone(self):
+        _fresh()
+        transport = InMemoryTransport("topic")
+        transport.produce(
+            json.dumps({"id": 1, "name": "a"}).encode(), key=b"1"
+        )
+        transport.produce(
+            json.dumps({"id": 2, "name": "b"}).encode(), key=b"2"
+        )
+        transport.produce(None, key=b"1")  # tombstone deletes id 1
+        transport.close()
+        t = pw.io.kafka.read(
+            None,
+            topic="topic",
+            schema=pw.schema_from_types(id=int, name=str),
+            format="json",
+            transport=transport,
+            primary_key=["id"],
+        )
+        rows = list(pw.debug.table_to_pandas(t).itertuples(index=False))
+        assert [(r.id, r.name) for r in rows] == [(2, "b")]
+
+    def test_kafka_write_then_read_round_trip(self):
+        _fresh()
+        out_transport = InMemoryTransport("sink")
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(id=int, name=str), [(1, "x"), (2, "y")]
+        )
+        pw.io.kafka.write(t, None, topic="sink", transport=out_transport)
+        pw.run()
+        msgs = [json.loads(m.value) for m in out_transport.poll_messages()]
+        assert sorted((m["id"], m["name"]) for m in msgs) == [
+            (1, "x"),
+            (2, "y"),
+        ]
+        assert all(m["diff"] == 1 for m in msgs)
+
+    def test_nats_round_trip(self):
+        _fresh()
+        transport = InMemoryTransport("subj")
+        transport.produce(json.dumps({"v": 5}).encode())
+        transport.produce(json.dumps({"v": 6}).encode())
+        transport.close()
+        t = pw.io.nats.read(
+            None,
+            "subj",
+            schema=pw.schema_from_types(v=int),
+            format="json",
+            transport=transport,
+        )
+        assert sorted(
+            r.v for r in pw.debug.table_to_pandas(t).itertuples()
+        ) == [5, 6]
+
+    def test_elasticsearch_mongodb_logstash_writers_capture_changes(self):
+        _fresh()
+
+        class EsClient:
+            def __init__(self):
+                self.docs = []
+
+            def index(self, index_name, document):
+                self.docs.append((index_name, document))
+
+        class MongoClient:
+            def __init__(self):
+                self.docs = []
+
+            def insert_many(self, collection, docs):
+                self.docs.extend((collection, d) for d in docs)
+
+        es, mongo = EsClient(), MongoClient()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int), [(1,), (2,)]
+        )
+        pw.io.elasticsearch.write(t, index_name="idx", client=es)
+        pw.io.mongodb.write(t, collection="col", client=mongo)
+        pw.run()
+        assert sorted(d["a"] for _i, d in es.docs) == [1, 2]
+        assert all(i == "idx" and d["diff"] == 1 for i, d in es.docs)
+        assert sorted(d["a"] for _c, d in mongo.docs) == [1, 2]
+
+    def test_postgres_update_log_sql(self):
+        _fresh()
+
+        class Conn:
+            def __init__(self):
+                self.stmts = []
+
+            def execute(self, sql, params=None):
+                self.stmts.append((sql, tuple(params or ())))
+
+        conn = Conn()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=str), [(1, "x")]
+        )
+        pw.io.postgres.write(t, table_name="tbl", connection=conn)
+        pw.run()
+        assert conn.stmts, "no SQL executed"
+        sql, params = conn.stmts[0]
+        assert "tbl" in sql and "insert" in sql.lower()
+        assert 1 in params and "x" in params
+
+
+class TestObjectStoreSeams:
+    def test_s3_csv_round_trip_over_object_store(self):
+        _fresh()
+        store = DictObjectStore()
+        store.put_object("bucket/data/a.csv", b"a,b\n1,x\n2,y\n")
+        t = pw.io.s3.read(
+            "bucket/data",
+            format="csv",
+            schema=pw.schema_from_types(a=int, b=str),
+            mode="static",
+            client=store,
+        )
+        rows = sorted(
+            (r.a, r.b)
+            for r in pw.debug.table_to_pandas(t).itertuples(index=False)
+        )
+        assert rows == [(1, "x"), (2, "y")]
+
+    def test_minio_alias_same_engine(self):
+        _fresh()
+        store = DictObjectStore()
+        store.put_object("b/k/a.jsonl", b'{"v": 7}\n')
+        t = pw.io.minio.read(
+            "b/k",
+            format="json",
+            schema=pw.schema_from_types(v=int),
+            mode="static",
+            client=store,
+        )
+        assert [
+            r.v for r in pw.debug.table_to_pandas(t).itertuples()
+        ] == [7]
+
+
+class TestPersistenceAcrossConnectors:
+    """Journal persistence resumes every file connector without double
+    counting (reference backfilling suites)."""
+
+    @pytest.mark.parametrize("fmt", ["csv", "jsonlines", "plaintext"])
+    def test_resume_emits_only_delta(self, tmp_path, fmt):
+        from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+        indir = tmp_path / "in"
+        indir.mkdir()
+        store = tmp_path / "store"
+
+        def write_file(name, values):
+            if fmt == "csv":
+                (indir / name).write_text(
+                    "w\n" + "\n".join(values) + "\n"
+                )
+            elif fmt == "jsonlines":
+                (indir / name).write_text(
+                    "\n".join(json.dumps({"w": v}) for v in values) + "\n"
+                )
+            else:
+                (indir / name).write_text("\n".join(values) + "\n")
+
+        def build(out):
+            _fresh()
+            if fmt == "plaintext":
+                words = pw.io.plaintext.read(
+                    indir, mode="static", persistent_id="w"
+                )
+                col = words.data
+            else:
+                words = getattr(pw.io, fmt).read(
+                    indir,
+                    schema=pw.schema_from_types(w=str),
+                    mode="static",
+                    persistent_id="w",
+                )
+                col = words.w
+            counts = words.groupby(col).reduce(
+                word=col, cnt=pw.reducers.count()
+            )
+            pw.io.jsonlines.write(counts, out)
+            pw.run(
+                persistence_config=Config(
+                    Backend.filesystem(str(store)),
+                    persistence_mode=PersistenceMode.PERSISTING,
+                )
+            )
+
+        write_file("a", ["apple", "banana", "apple"])
+        out1 = tmp_path / "o1.jsonl"
+        build(out1)
+        state1 = {}
+        for line in out1.read_text().splitlines():
+            r = json.loads(line)
+            if r["diff"] > 0:
+                state1[r["word"]] = r["cnt"]
+        assert state1 == {"apple": 2, "banana": 1}
+
+        write_file("b", ["banana", "cherry"])
+        out2 = tmp_path / "o2.jsonl"
+        build(out2)
+        rows2 = [json.loads(l) for l in out2.read_text().splitlines()]
+        finals = {}
+        for r in rows2:
+            if r["diff"] > 0:
+                finals[r["word"]] = r["cnt"]
+            elif finals.get(r["word"]) == r["cnt"]:
+                del finals[r["word"]]
+        assert finals["banana"] == 2 and finals["cherry"] == 1
+        # apple was fully journaled: replays into state, no re-emission
+        # beyond the restored aggregate
+        assert finals.get("apple", 2) == 2
+
+    def test_kafka_offsets_persist(self, tmp_path):
+        from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+        store = tmp_path / "store"
+
+        def run_once(messages, out):
+            _fresh()
+            transport = InMemoryTransport("topic")
+            for m in messages:
+                transport.produce(json.dumps(m).encode())
+            transport.close()
+            t = pw.io.kafka.read(
+                None,
+                topic="topic",
+                schema=pw.schema_from_types(v=int),
+                format="json",
+                transport=transport,
+                persistent_id="k",
+            )
+            pw.io.jsonlines.write(t, out)
+            pw.run(
+                persistence_config=Config(
+                    Backend.filesystem(str(store)),
+                    persistence_mode=PersistenceMode.PERSISTING,
+                )
+            )
+
+        out1 = tmp_path / "o1.jsonl"
+        run_once([{"v": 1}, {"v": 2}], out1)
+        vals1 = [
+            json.loads(l)["v"] for l in out1.read_text().splitlines()
+        ]
+        assert sorted(vals1) == [1, 2]
+
+
+class TestSpawnedFormats:
+    """File formats under real 2-process execution: outputs must match the
+    single-process run exactly."""
+
+    @pytest.mark.parametrize("fmt", ["csv", "jsonlines"])
+    def test_two_process_matches_single(self, tmp_path, fmt):
+        from tests.test_distributed import _spawn_program
+
+        indir = tmp_path / "in"
+        indir.mkdir()
+        if fmt == "csv":
+            (indir / "a.csv").write_text(
+                "k,v\n" + "".join(f"{i % 5},{i}\n" for i in range(100))
+            )
+        else:
+            (indir / "a.jsonl").write_text(
+                "".join(
+                    json.dumps({"k": i % 5, "v": i}) + "\n"
+                    for i in range(100)
+                )
+            )
+        out = tmp_path / "out.jsonl"
+        prog = f"""
+            import pathway_tpu as pw
+            t = pw.io.{fmt}.read(
+                {str(indir)!r},
+                schema=pw.schema_from_types(k=int, v=int),
+                mode="static",
+            )
+            agg = t.groupby(pw.this.k).reduce(
+                k=pw.this.k, s=pw.reducers.sum(pw.this.v)
+            )
+            pw.io.jsonlines.write(agg, {str(out)!r})
+            pw.run()
+        """
+        _spawn_program(tmp_path, prog, processes=2)
+        rows = [json.loads(l) for l in out.read_text().splitlines()]
+        got = {r["k"]: r["s"] for r in rows if r["diff"] > 0}
+        expected = {}
+        for i in range(100):
+            expected[i % 5] = expected.get(i % 5, 0) + i
+        assert got == expected

@@ -128,3 +128,132 @@ def test_intersect_binds_tighter_than_union():
     )
     # standard SQL: a UNION (b ∩ c) = {1, 2} ∪ {3} = {1, 2, 3}
     assert rows_of(res) == [(1,), (2,), (3,)]
+
+
+class TestAstDepth:
+    """VERDICT r2 #10: nested subqueries + mixed AND/OR/parens + quoted
+    identifiers through the recursive-descent AST."""
+
+    def _tables(self):
+        import pathway_tpu as pw
+
+        t = pw.debug.table_from_markdown(
+            """
+            | a | b  | c
+          1 | 1 | 10 | x
+          2 | 2 | 20 | y
+          3 | 3 | 30 | x
+          4 | 4 | 40 | z
+          5 | 5 | 50 | y
+            """
+        )
+        return t
+
+    def _rows(self, table):
+        import pathway_tpu as pw
+
+        df = pw.debug.table_to_pandas(table)
+        return sorted(map(tuple, df.itertuples(index=False)))
+
+    def test_nested_subquery_in_from(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql(
+            "SELECT big.a, big.b FROM "
+            "(SELECT a, b FROM t WHERE b > 20) AS big WHERE big.a < 5",
+            t=t,
+        )
+        assert self._rows(out) == [(3, 30), (4, 40)]
+
+    def test_doubly_nested_subquery_with_aggregate(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql(
+            "SELECT s FROM (SELECT c, SUM(b) AS s FROM "
+            "(SELECT b, c FROM t WHERE a > 1) inner_t GROUP BY c) agg "
+            "WHERE s > 20",
+            t=t,
+        )
+        assert self._rows(out) == [(30,), (40,), (70,)]
+
+    def test_subquery_join(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql(
+            "SELECT t.a, small.b FROM t "
+            "JOIN (SELECT a, b FROM t WHERE b <= 20) AS small "
+            "ON t.a = small.a",
+            t=t,
+        )
+        assert self._rows(out) == [(1, 10), (2, 20)]
+
+    def test_mixed_and_or_parentheses_precedence(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        # without parens: AND binds tighter -> a=1 OR (a=2 AND b=20) -> 1,2
+        out1 = pw.sql(
+            "SELECT a FROM t WHERE a = 1 OR a = 2 AND b = 20", t=t
+        )
+        assert self._rows(out1) == [(1,), (2,)]
+        # parens flip it: (a=1 OR a=2) AND b=20 -> only 2
+        out2 = pw.sql(
+            "SELECT a FROM t WHERE (a = 1 OR a = 2) AND b = 20", t=t
+        )
+        assert self._rows(out2) == [(2,)]
+        # NOT with nesting
+        out3 = pw.sql(
+            "SELECT a FROM t WHERE NOT (a = 1 OR (b > 20 AND c = 'x'))",
+            t=t,
+        )
+        assert self._rows(out3) == [(2,), (4,), (5,)]
+
+    def test_arithmetic_precedence_nesting(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql("SELECT a + 2 * (b - a) AS v FROM t WHERE a = 2", t=t)
+        assert self._rows(out) == [(2 + 2 * 18,)]
+
+    def test_quoted_identifiers(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        t2 = t.select(**{"odd name": t.a, "select": t.b})
+        out = pw.sql(
+            'SELECT "odd name", "select" FROM t2 WHERE "select" > 30',
+            t2=t2,
+        )
+        assert self._rows(out) == [(4, 40), (5, 50)]
+
+    def test_in_list_and_not_in(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql("SELECT a FROM t WHERE c IN ('x', 'z')", t=t)
+        assert self._rows(out) == [(1,), (3,), (4,)]
+        out2 = pw.sql("SELECT a FROM t WHERE c NOT IN ('x', 'z')", t=t)
+        assert self._rows(out2) == [(2,), (5,)]
+
+    def test_table_alias(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql("SELECT u.a FROM t AS u WHERE u.b = 30", t=t)
+        assert self._rows(out) == [(3,)]
+
+    def test_self_join_with_aliases(self):
+        import pathway_tpu as pw
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=int), [(1, 2), (2, 3), (3, 9)]
+        )
+        out = pw.sql(
+            "SELECT u.a AS ua, v.a AS va FROM t AS u "
+            "JOIN t AS v ON u.b = v.a",
+            t=t,
+        )
+        assert self._rows(out) == [(1, 2), (2, 3)]

@@ -1,0 +1,297 @@
+"""Temporal behaviors x window types matrix (VERDICT r2 #9).
+
+Every (window kind x behavior kind) cell under streaming commits with
+artificial event time — final-state AND update-stream assertions, the
+reference's windows/behaviors coverage shape
+(python/pathway/tests/temporal/test_windows.py + test_behaviors.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.stdlib.temporal as temporal
+from pathway_tpu.internals.parse_graph import G
+
+
+class S(pw.Schema):
+    t: int
+    v: int
+
+
+def stream(batches):
+    sg = pw.debug.StreamGenerator()
+    return sg.table_from_list_of_batches(
+        [[{"t": t, "v": v} for t, v in batch] for batch in batches], S
+    )
+
+
+def run_stream(table):
+    updates = []
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: updates.append(
+            (time, tuple(sorted(row.items())), 1 if is_addition else -1)
+        ),
+    )
+    pw.run()
+    return updates
+
+
+def final_state(updates):
+    state = {}
+    for _c, row, diff in updates:
+        if diff > 0:
+            state[row] = state.get(row, 0) + 1
+        else:
+            state[row] = state.get(row, 0) - 1
+            if state[row] == 0:
+                del state[row]
+    return {r for r, n in state.items() if n > 0}
+
+
+def agg(table, window, behavior=None):
+    return table.windowby(
+        table.t, window=window, behavior=behavior
+    ).reduce(
+        start=pw.this["_pw_window_start"],
+        total=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+    )
+
+
+def rows(**kv):
+    return tuple(sorted(kv.items()))
+
+
+class TestNoBehaviorMatrix:
+    """No behavior: every revision flows, nothing is dropped or delayed."""
+
+    def test_tumbling(self):
+        G.clear()
+        t = stream([[(1, 10), (12, 2)], [(3, 5)], [(25, 7)]])
+        updates = run_stream(agg(t, temporal.tumbling(10)))
+        assert final_state(updates) == {
+            rows(start=0, total=15, n=2),
+            rows(start=10, total=2, n=1),
+            rows(start=20, total=7, n=1),
+        }
+        # the [0,10) window was revised: one retraction of total=10
+        assert ((("n", 1), ("start", 0), ("total", 10))) in [
+            r for _c, r, d in updates if d < 0
+        ]
+
+    def test_sliding_multi_assignment(self):
+        G.clear()
+        t = stream([[(5, 1)], [(9, 2)]])
+        updates = run_stream(agg(t, temporal.sliding(hop=5, duration=10)))
+        # t=5 lives in [0,10) and [5,15); t=9 in the same two
+        assert final_state(updates) == {
+            rows(start=0, total=3, n=2),
+            rows(start=5, total=3, n=2),
+        }
+
+    def test_session_merges_across_commits(self):
+        G.clear()
+        t = stream([[(1, 1)], [(10, 2)], [(5, 4)]])
+        updates = run_stream(agg(t, temporal.session(max_gap=4)))
+        # commit 3's t=5 bridges 1 and 10 into one session (gaps 4,5<=4?
+        # gap(1->5)=4 <= 4 merges, gap(5->10)=5 > 4 stays apart)
+        assert final_state(updates) == {
+            rows(start=1, total=5, n=2),
+            rows(start=10, total=2, n=1),
+        }
+
+    def test_tumbling_instance_partitions(self):
+        G.clear()
+
+        class S2(pw.Schema):
+            t: int
+            v: int
+            inst: str
+
+        sg = pw.debug.StreamGenerator()
+        t = sg.table_from_list_of_batches(
+            [
+                [
+                    {"t": 1, "v": 1, "inst": "a"},
+                    {"t": 2, "v": 2, "inst": "b"},
+                ]
+            ],
+            S2,
+        )
+        res = t.windowby(
+            t.t, window=temporal.tumbling(10), instance=t.inst
+        ).reduce(
+            inst=pw.this["_pw_instance"],
+            total=pw.reducers.sum(pw.this.v),
+        )
+        updates = run_stream(res)
+        assert final_state(updates) == {
+            rows(inst="a", total=1),
+            rows(inst="b", total=2),
+        }
+
+
+class TestCutoffMatrix:
+    """common_behavior(cutoff=...): a window stops accepting rows once the
+    watermark passes its close + cutoff — late rows are DROPPED."""
+
+    @pytest.mark.parametrize(
+        "window,late_time,on_time_total",
+        [
+            (temporal.tumbling(10), 3, 15),
+            (temporal.sliding(hop=10, duration=10), 3, 15),
+        ],
+    )
+    def test_late_row_dropped_after_cutoff(
+        self, window, late_time, on_time_total
+    ):
+        G.clear()
+        # watermark advances far past window [0,10)+cutoff 2, then a
+        # late row for it arrives: ignored
+        t = stream([[(1, 10), (9, 5)], [(40, 1)], [(late_time, 100)]])
+        updates = run_stream(
+            agg(t, window, temporal.common_behavior(cutoff=2))
+        )
+        finals = final_state(updates)
+        assert rows(start=0, total=on_time_total, n=2) in finals
+        assert not any(
+            dict(r)["total"] == on_time_total + 100 for r in finals
+        )
+
+    def test_session_late_row_dropped(self):
+        G.clear()
+        t = stream([[(1, 1), (2, 2)], [(50, 9)], [(3, 100)]])
+        updates = run_stream(
+            agg(
+                t,
+                temporal.session(max_gap=2),
+                temporal.common_behavior(cutoff=1),
+            )
+        )
+        finals = final_state(updates)
+        assert rows(start=1, total=3, n=2) in finals
+        assert not any(dict(r)["total"] == 103 for r in finals)
+
+    def test_keep_results_false_retracts_closed_windows(self):
+        G.clear()
+        t = stream([[(1, 10)], [(40, 1)]])
+        updates = run_stream(
+            agg(
+                t,
+                temporal.tumbling(10),
+                temporal.common_behavior(cutoff=0, keep_results=False),
+            )
+        )
+        finals = final_state(updates)
+        # window [0,10) was emitted then retracted once the watermark
+        # passed its close (keep_results=False)
+        assert not any(dict(r)["start"] == 0 for r in finals)
+        emitted = [r for _c, r, d in updates if d > 0]
+        assert any(dict(r)["start"] == 0 for r in emitted)
+
+
+class TestDelayMatrix:
+    """common_behavior(delay=...): emission waits until the watermark
+    reaches window start + delay — intermediate revisions are suppressed."""
+
+    @pytest.mark.parametrize(
+        "window",
+        [temporal.tumbling(10), temporal.sliding(hop=10, duration=10)],
+    )
+    def test_delay_suppresses_early_emission(self, window):
+        G.clear()
+        t = stream([[(1, 10)], [(5, 5)], [(30, 1)]])
+        updates = run_stream(
+            agg(t, window, temporal.common_behavior(delay=10))
+        )
+        zero_window = [
+            (c, dict(r), d)
+            for c, r, d in updates
+            if dict(r).get("start") == 0
+        ]
+        # only the settled total ever emits for [0,10): no (total=10)
+        # intermediate, no retraction churn
+        assert [x[1]["total"] for x in zero_window if x[2] > 0] == [15]
+        assert not [x for x in zero_window if x[2] < 0]
+
+
+class TestExactlyOnceMatrix:
+    @pytest.mark.parametrize(
+        "window",
+        [temporal.tumbling(10), temporal.sliding(hop=10, duration=10)],
+    )
+    def test_single_emission_then_frozen(self, window):
+        G.clear()
+        t = stream([[(1, 10)], [(5, 5)], [(25, 1)], [(2, 100)]])
+        updates = run_stream(
+            agg(t, window, temporal.exactly_once_behavior())
+        )
+        zero_window = [
+            (c, dict(r), d)
+            for c, r, d in updates
+            if dict(r).get("start") == 0
+        ]
+        inserts = [x for x in zero_window if x[2] > 0]
+        retracts = [x for x in zero_window if x[2] < 0]
+        assert len(inserts) == 1 and not retracts
+        assert inserts[0][1]["total"] == 15  # late t=2 row never lands
+
+    def test_shift_extends_acceptance(self):
+        G.clear()
+        # shift=5: window [0,10) emits once the watermark passes 15 and
+        # accepts rows until then
+        t = stream([[(1, 10)], [(12, 1)], [(3, 5)], [(30, 2)]])
+        updates = run_stream(
+            agg(
+                t,
+                temporal.tumbling(10),
+                temporal.exactly_once_behavior(shift=5),
+            )
+        )
+        zero_window = [
+            dict(r) for _c, r, d in updates if d > 0 and dict(r)["start"] == 0
+        ]
+        assert [z["total"] for z in zero_window] == [15]
+
+
+class TestWindowJoinAndIntervals:
+    def test_window_join_inner_tumbling(self):
+        G.clear()
+        left = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, a=str), [(1, "l1"), (11, "l2")]
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, b=str), [(2, "r1"), (25, "r2")]
+        )
+        joined = temporal.window_join(
+            left, right, left.t, right.t, window=temporal.tumbling(10)
+        ).select(a=pw.left.a, b=pw.right.b)
+        df = pw.debug.table_to_pandas(joined)
+        assert sorted(
+            (r.a, r.b) for r in df.itertuples(index=False)
+        ) == [("l1", "r1")]
+
+    def test_intervals_over_collects_neighbourhood(self):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, v=int),
+            [(0, 1), (5, 2), (10, 4), (20, 8)],
+        )
+        probes = pw.debug.table_from_rows(
+            pw.schema_from_types(at=int), [(5,), (20,)]
+        )
+        res = t.windowby(
+            t.t,
+            window=temporal.intervals_over(
+                at=probes.at, lower_bound=-5, upper_bound=5
+            ),
+        ).reduce(
+            start=pw.this["_pw_window_start"],
+            vs=pw.reducers.sorted_tuple(pw.this.v),
+        )
+        df = pw.debug.table_to_pandas(res)
+        got = {r.start: tuple(r.vs) for r in df.itertuples(index=False)}
+        assert got[0] == (1, 2, 4)  # probe at 5: [0, 10]
+        assert got[15] == (8,)  # probe at 20: [15, 25]
