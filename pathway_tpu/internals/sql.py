@@ -416,11 +416,16 @@ class _Lowerer:
         }[op]()
 
     def _has_agg(self, node: Any) -> bool:
-        if not isinstance(node, tuple):
+        if not isinstance(node, (tuple, list)):
             return False
-        if node[0] == "agg":
+        if isinstance(node, tuple) and node and node[0] == "agg":
             return True
-        return any(self._has_agg(c) for c in node[1:] if isinstance(c, tuple))
+        children = node[1:] if isinstance(node, tuple) else node
+        return any(
+            self._has_agg(c)
+            for c in children
+            if isinstance(c, (tuple, list))
+        )
 
     def _agg_expr(self, node: Any, scope: dict[str, Table]) -> Any:
         """Expression where ('agg', fn, arg) becomes a reducer expression."""
@@ -436,6 +441,15 @@ class _Lowerer:
                 "avg": reducers.avg,
             }[fn](inner)
         if isinstance(node, tuple) and node[0] not in ("lit", "col"):
+            if node[0] == "in":
+                # ('in', expr, [values]): OR chain of equalities; the
+                # values list is NOT an expression child
+                e = self._agg_expr(node[1], scope)
+                out = None
+                for v in node[2]:
+                    part = e == self._agg_expr(v, scope)
+                    out = part if out is None else (out | part)
+                return out
             parts = [self._agg_expr(c, scope) for c in node[1:]]
             return self._combine(node[0], parts)
         return self.expr(node, scope)

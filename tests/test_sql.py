@@ -257,3 +257,20 @@ class TestAstDepth:
             t=t,
         )
         assert self._rows(out) == [(1, 2), (2, 3)]
+
+    def test_in_under_group_by_and_having(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql(
+            "SELECT c, SUM(b) AS s FROM t GROUP BY c "
+            "HAVING c IN ('x', 'y')",
+            t=t,
+        )
+        assert self._rows(out) == [("x", 40), ("y", 70)]
+        out2 = pw.sql(
+            "SELECT c IN ('x') AS is_x, SUM(b) AS s FROM t GROUP BY c",
+            t=t,
+        )
+        got = {r for r in self._rows(out2)}
+        assert got == {(True, 40), (False, 70), (False, 40)}
