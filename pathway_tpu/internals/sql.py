@@ -415,18 +415,6 @@ class _Lowerer:
             ">=": lambda: left >= right,
         }[op]()
 
-    def _has_agg(self, node: Any) -> bool:
-        if not isinstance(node, (tuple, list)):
-            return False
-        if isinstance(node, tuple) and node and node[0] == "agg":
-            return True
-        children = node[1:] if isinstance(node, tuple) else node
-        return any(
-            self._has_agg(c)
-            for c in children
-            if isinstance(c, (tuple, list))
-        )
-
     def _agg_expr(self, node: Any, scope: dict[str, Table]) -> Any:
         """Expression where ('agg', fn, arg) becomes a reducer expression."""
         if isinstance(node, tuple) and node[0] == "agg":
@@ -450,6 +438,9 @@ class _Lowerer:
                     part = e == self._agg_expr(v, scope)
                     out = part if out is None else (out | part)
                 return out
+            if node[0] in ("is_null", "is_not_null"):
+                e = self._agg_expr(node[1], scope)
+                return e.is_none() if node[0] == "is_null" else e.is_not_none()
             parts = [self._agg_expr(c, scope) for c in node[1:]]
             return self._combine(node[0], parts)
         return self.expr(node, scope)

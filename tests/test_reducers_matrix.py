@@ -59,6 +59,9 @@ def expected_for(rows):
             "tup": tuple(sorted(r[3] for r in grp)),
             "nd": 1,
             "mean": sum(floats) / len(grp),
+            # one static batch: processing order = row order
+            "early": ints[0],
+            "late": ints[-1],
         }
     return out
 
@@ -88,9 +91,13 @@ def check(state, rows):
         assert tuple(srt) == e["srt"] and tuple(tup) == e["tup"]
         assert nd == e["nd"]
         assert abs(mean - e["mean"]) < 1e-9
-        # argmin/argmax return row pointers — must point at rows whose i
-        # is the min/max (identity checked via earliest/latest domain)
+        assert (early, late) == (e["early"], e["late"])
+        # argmin/argmax return row pointers; with distinct extremes they
+        # must differ (pointer IDENTITY is pinned by the engine-level
+        # test_argminmax_point_at_extreme_rows below, where keys are known)
         assert am is not None and an is not None
+        if e["imin"] != e["imax"]:
+            assert am != an
 
 
 class TestBulkMatrix:
@@ -106,32 +113,6 @@ class TestBulkMatrix:
 class TestIncrementalMatrix:
     """Engine-level streams: inserts, retractions, and replacement of the
     extreme element (min/max/argmin must RECOMPUTE, not cache)."""
-
-    def _run_stream(self, batches):
-        from pathway_tpu.engine import Scheduler, Scope, ref_scalar
-
-        G.clear()
-        sg = pw.debug.StreamGenerator()
-
-        class S(pw.Schema):
-            g: str
-            i: int
-            f: float
-            s: str
-
-        t = sg.table_from_list_of_batches(
-            [
-                [
-                    {"g": g, "i": i, "f": f, "s": s, "__diff__": d}
-                    if False
-                    else {"g": g, "i": i, "f": f, "s": s}
-                    for g, i, f, s, d in batch
-                ]
-                for batch in batches
-            ],
-            S,
-        )
-        return t
 
     def test_retraction_of_extreme_recomputes(self):
         from pathway_tpu.engine import (
@@ -176,6 +157,39 @@ class TestIncrementalMatrix:
         sess.remove(ref_scalar(3), ("g", 5))
         sched.commit()
         assert agg.current == {}
+
+    def test_argminmax_point_at_extreme_rows(self):
+        from pathway_tpu.engine import (
+            ReducerKind,
+            Scheduler,
+            Scope,
+            make_reducer,
+            ref_scalar,
+        )
+
+        scope = Scope()
+        sess = scope.input_session(3)  # (group, value, tag)
+        agg = scope.group_by_table(
+            sess,
+            by_cols=[0],
+            reducers=[
+                # engine arg-reducers take (value, arg) column pairs
+                (make_reducer(ReducerKind.ARG_MIN), [1, 2]),
+                (make_reducer(ReducerKind.ARG_MAX), [1, 2]),
+            ],
+        )
+        sched = Scheduler(scope)
+        for n, v in enumerate([5, 1, 9]):
+            sess.insert(ref_scalar(n), ("g", v, f"row{n}"))
+        sched.commit()
+        (state,) = agg.current.values()
+        assert state[1] == "row1"  # the row holding 1
+        assert state[2] == "row2"  # the row holding 9
+        # retract the max: argmax must move to the remaining extreme's row
+        sess.remove(ref_scalar(2), ("g", 9, "row2"))
+        sched.commit()
+        (state,) = agg.current.values()
+        assert state[1] == "row1" and state[2] == "row0"
 
     def test_earliest_latest_follow_processing_time(self):
         from pathway_tpu.engine import (

@@ -274,3 +274,13 @@ class TestAstDepth:
         )
         got = {r for r in self._rows(out2)}
         assert got == {(True, 40), (False, 70), (False, 40)}
+
+    def test_is_not_null_under_group_by(self):
+        import pathway_tpu as pw
+
+        t = self._tables()
+        out = pw.sql(
+            "SELECT c, SUM(b) AS s FROM t GROUP BY c HAVING c IS NOT NULL",
+            t=t,
+        )
+        assert self._rows(out) == [("x", 40), ("y", 70), ("z", 40)]

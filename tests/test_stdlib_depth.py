@@ -1,0 +1,218 @@
+"""stdlib depth: graphs with known-answer fixtures, ordered/statistical
+transforms, stateful deduplicate semantics, utils long tail
+(VERDICT r2 #9; reference python/pathway/stdlib/* doctest+test shape)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+
+
+def rows(table):
+    df = pw.debug.table_to_pandas(table)
+    return sorted(map(tuple, df.itertuples(index=False)), key=repr)
+
+
+class TestGraphsKnownAnswers:
+    def _edges(self, pairs):
+        return pw.debug.table_from_rows(
+            pw.schema_from_types(u=int, v=int), pairs
+        )
+
+    def test_pagerank_star_center_dominates(self):
+        import pathway_tpu.stdlib.graphs as graphs
+
+        G.clear()
+        # star: 1..4 all point at 0; 0 points at 1
+        edges = self._edges([(1, 0), (2, 0), (3, 0), (4, 0), (0, 1)])
+        ranks = graphs.pagerank(edges.select(u=edges.u, v=edges.v))
+        got = {r[0]: r[1] for r in rows(ranks)}
+        center = got[0]
+        assert all(center > got[n] for n in (2, 3, 4))
+        # 1 receives all of 0's rank: second place
+        assert got[1] > got[2]
+
+    def test_pagerank_symmetric_cycle_is_uniform(self):
+        import pathway_tpu.stdlib.graphs as graphs
+
+        G.clear()
+        edges = self._edges([(0, 1), (1, 2), (2, 0)])
+        ranks = graphs.pagerank(edges.select(u=edges.u, v=edges.v))
+        vals = [r[1] for r in rows(ranks)]
+        assert max(vals) - min(vals) < 1e-6  # symmetry => equal ranks
+
+    def test_bellman_ford_shortest_paths(self):
+        import pathway_tpu.stdlib.graphs as graphs
+
+        G.clear()
+        edges = pw.debug.table_from_rows(
+            pw.schema_from_types(u=int, v=int, dist=float),
+            [
+                (0, 1, 1.0),
+                (1, 2, 1.0),
+                (0, 2, 5.0),  # longer direct path must lose
+                (2, 3, 1.0),
+            ],
+        )
+        vertices = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int, is_source=bool),
+            [(0, True), (1, False), (2, False), (3, False)],
+        )
+        res = graphs.bellman_ford(vertices, edges)
+        got = {r[0]: r[1] for r in rows(res)}
+        assert got[1] == 1.0
+        assert got[2] == 2.0  # via 0->1->2, not the direct 5.0
+        assert got[3] == 3.0
+
+    def test_bellman_ford_unreachable_absent_or_inf(self):
+        import pathway_tpu.stdlib.graphs as graphs
+
+        G.clear()
+        edges = pw.debug.table_from_rows(
+            pw.schema_from_types(u=int, v=int, dist=float),
+            [(0, 1, 1.0), (5, 6, 1.0)],  # 5,6 disconnected from 0
+        )
+        vertices = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int, is_source=bool),
+            [(0, True), (1, False), (5, False), (6, False)],
+        )
+        res = graphs.bellman_ford(vertices, edges)
+        got = {r[0]: r[1] for r in rows(res)}
+        assert got.get(1) == 1.0
+        assert got.get(6) in (None, math.inf) or 6 not in got
+
+    def test_louvain_separates_two_cliques(self):
+        import pathway_tpu.stdlib.graphs as graphs
+
+        G.clear()
+        clique_a = [(a, b) for a in range(4) for b in range(4) if a < b]
+        clique_b = [
+            (a, b) for a in range(10, 14) for b in range(10, 14) if a < b
+        ]
+        bridge = [(3, 10)]
+        edges = self._edges(clique_a + clique_b + bridge)
+        comms = graphs.louvain_communities(
+            edges.select(u=edges.u, v=edges.v)
+        )
+        got = {r[0]: r[1] for r in rows(comms)}
+        assert len({got[n] for n in range(4)}) == 1
+        assert len({got[n] for n in range(10, 14)}) == 1
+        assert got[0] != got[10]
+
+
+class TestOrderedAndStatistical:
+    def test_ordered_diff_consecutive(self):
+        import pathway_tpu.stdlib.ordered as ordered
+
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, v=float),
+            [(1, 10.0), (2, 13.0), (3, 11.5), (4, 20.0)],
+        )
+        d = ordered.diff(t, t.t, t.v)
+        flat = sorted(
+            v
+            for row in rows(d)
+            for v in row
+            if isinstance(v, float)
+        )
+        # consecutive diffs: [first is None], 3.0, -1.5, 8.5
+        assert 3.0 in flat and -1.5 in flat and 8.5 in flat
+
+    def test_interpolate_fills_gaps_linearly(self):
+        import pathway_tpu.stdlib.statistical as statistical
+
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, v=float),
+            [(0, 0.0), (10, 100.0), (5, None), (2, None)],
+        )
+        res = statistical.interpolate(t, t.t, t.v)
+        df = pw.debug.table_to_pandas(res)
+        by_t = {int(r[0]): float(r[1]) for r in df.itertuples(index=False)}
+        assert by_t[2] == pytest.approx(20.0)
+        assert by_t[5] == pytest.approx(50.0)
+        assert by_t[0] == 0.0 and by_t[10] == 100.0
+
+
+class TestStatefulDeduplicate:
+    def test_acceptor_controls_replacement(self):
+        import pathway_tpu.stdlib.stateful as stateful
+
+        G.clear()
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                import time as _t
+
+                for v in (5, 3, 9, 7):
+                    self.next(inst="x", val=v)
+                    self.commit()
+                    _t.sleep(0.05)
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(inst=str, val=int),
+            autocommit_duration_ms=None,
+        )
+        # accept only increases: 5 -> 9 survive; 3 and 7 rejected
+        res = stateful.deduplicate(
+            t,
+            value=t.val,
+            instance=t.inst,
+            acceptor=lambda new, old: new > old,
+        )
+        seen = []
+        pw.io.subscribe(
+            res,
+            on_change=lambda key, row, time, is_addition: seen.append(
+                (row["val"], is_addition)
+            ),
+        )
+        pw.run()
+        accepted = [v for v, add in seen if add]
+        assert accepted == [5, 9]
+        # the replacement retracted the old accepted value
+        assert (5, False) in seen
+
+
+class TestUtilsLongTail:
+    def test_pandas_transformer_round_trip(self):
+        G.clear()
+        import pandas as pd
+
+        from pathway_tpu.stdlib.utils import pandas_transformer
+
+        @pandas_transformer(output_schema=pw.schema_from_types(total=int))
+        def totals(df: pd.DataFrame) -> pd.DataFrame:
+            return pd.DataFrame({"total": [int(df["v"].sum())]})
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int), [(1,), (2,), (3,)]
+        )
+        out = totals(t)
+        assert rows(out) == [(6,)]
+
+    def test_table_from_pandas_and_back(self):
+        G.clear()
+        import pandas as pd
+
+        df = pd.DataFrame({"a": [1, 2], "b": ["x", "y"]})
+        t = pw.debug.table_from_pandas(df)
+        back = pw.debug.table_to_pandas(t)
+        assert sorted(back["a"]) == [1, 2]
+        assert sorted(back["b"]) == ["x", "y"]
+
+    def test_compute_and_print_smoke(self, capsys):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int), [(1,), (2,)]
+        )
+        pw.debug.compute_and_print(t)
+        out = capsys.readouterr().out
+        assert "a" in out and "1" in out and "2" in out
