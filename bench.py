@@ -297,7 +297,12 @@ def _device_query_latency_ms(embedder, capacity: int, m: int = 64) -> float:
     en = jnp.zeros((8,), bool).at[0].set(True)
 
     def one():
-        vecs = embedder._jit_embed(ids, mask)
+        # same program the production query path dispatches (the ids-only
+        # variant when the tokenizer pads with 0)
+        if getattr(embedder, "_mask_from_ids", False):
+            vecs = embedder._jit_embed_ids(ids)
+        else:
+            vecs = embedder._jit_embed(ids, mask)
         q = _gather_pad(vecs, idx, en)
         scores, slots = knn_search(state, q, K, "cos")
         return _pack_results(scores, slots)

@@ -58,6 +58,9 @@ _WORD_RE = re.compile(r"[^\W_]+|[^\w\s]|_")
 class HashTokenizer:
     """Whitespace+punctuation split, blake2s-hashed ids, CLS/SEP framing."""
 
+    #: id 0 is reserved for padding (encode_batch zero-fills)
+    pad_id = 0
+
     def __init__(self, vocab_size: int = 30522) -> None:
         self.vocab_size = vocab_size
 

@@ -158,6 +158,20 @@ class TpuEncoderEmbedder(UDF):
         # for MiniLM-L6 vs ~2 s with params as inputs)
         import functools
 
+        # when the tokenizer pads with id 0 (both built-ins do; bucket
+        # padding is 0 too), the mask is derivable ON DEVICE as ids != 0 —
+        # halving the host->device uploads per chunk. A tokenizer that
+        # declares NO pad id gets the safe default (explicit mask).
+        pad = getattr(
+            self.tokenizer,
+            "pad_id",
+            getattr(self.tokenizer, "pad_token_id", None),
+        )
+        self._mask_from_ids = pad == 0
+        if self._mask_from_ids:
+            self._jit_embed_ids = functools.partial(
+                jax.jit(lambda p, ids: embed(p, ids, ids != 0, cfg)), params
+            )
         self._jit_embed = functools.partial(
             jax.jit(lambda p, ids, mask: embed(p, ids, mask, cfg)), params
         )
@@ -178,7 +192,12 @@ class TpuEncoderEmbedder(UDF):
             ids, mask, real = pad_to_buckets(
                 ids, mask, seq_bucket_min=self.seq_bucket_min
             )
-            vecs_dev = self._jit_embed(jnp.asarray(ids), jnp.asarray(mask))
+            if self._mask_from_ids and bool(np.array_equal(mask, ids != 0)):
+                vecs_dev = self._jit_embed_ids(jnp.asarray(ids))
+            else:
+                vecs_dev = self._jit_embed(
+                    jnp.asarray(ids), jnp.asarray(mask)
+                )
             return _rows_from_device(vecs_dev, real, self.device_resident)
 
         super().__init__(

@@ -296,3 +296,31 @@ class TestRagEvals:
         )
         ds = load_dataset(str(p))
         assert len(ds) == 2 and ds[0].source == "s1" and ds[1].source is None
+
+
+def test_embedder_mask_from_ids_path_matches_explicit_mask():
+    """The ids-only upload path (mask derived on device as ids != 0) must
+    produce bit-identical embeddings to the explicit-mask path."""
+    import numpy as np
+
+    from pathway_tpu.xpacks.llm.embedders import TpuEncoderEmbedder
+
+    emb = TpuEncoderEmbedder("minilm_l6", max_len=16, device_resident=False)
+    assert emb._mask_from_ids
+    texts = ["short", "a somewhat longer sentence for padding", "x"]
+    via_ids = np.stack([np.asarray(v) for v in emb._fn(list(texts))])
+
+    ids, mask = emb.tokenizer.encode_batch(texts, emb.max_len)
+    from pathway_tpu.xpacks.llm._tokenizer import pad_to_buckets
+
+    ids_p, mask_p, real = pad_to_buckets(
+        ids, mask, seq_bucket_min=emb.seq_bucket_min
+    )
+    import jax.numpy as jnp
+
+    explicit = np.asarray(
+        emb._jit_embed(jnp.asarray(ids_p), jnp.asarray(mask_p))
+    )[:real]
+    # two distinct jitted programs: semantically equal, but fusion order
+    # may differ per backend — tight tolerance, not bit equality
+    assert np.allclose(via_ids, explicit, atol=1e-6, rtol=1e-6)
