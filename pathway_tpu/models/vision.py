@@ -138,10 +138,14 @@ def patchify(pixels: jax.Array, cfg: VisionConfig) -> jax.Array:
 
 
 def vision_forward(
-    params: Params, pixels: jax.Array, cfg: VisionConfig
+    params: Params,
+    pixels: jax.Array,
+    cfg: VisionConfig,
+    attn_fn=dense_attention,
 ) -> jax.Array:
     """``pixels [b, H, W, 3]`` (normalised floats) -> L2-normalised
-    embeddings ``[b, out_dim]``."""
+    embeddings ``[b, out_dim]``. ``attn_fn`` is the attention seam
+    (dense by default; ops/flash_attention.py drops in)."""
     b = pixels.shape[0]
     patches = patchify(pixels.astype(cfg.dtype), cfg)
     x = patches @ params["patch_w"].astype(cfg.dtype)
@@ -159,7 +163,7 @@ def vision_forward(
         q = q.reshape(b, t, cfg.heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.heads, cfg.head_dim)
-        a = dense_attention(q, k, v, None).reshape(b, t, cfg.hidden)
+        a = attn_fn(q, k, v, None).reshape(b, t, cfg.hidden)
         x = x + a @ lp["out_w"].astype(cfg.dtype) + lp["out_b"].astype(cfg.dtype)
         h = layer_norm(x, lp["ln2"], cfg.layer_norm_eps)
         h = h @ lp["fc1_w"].astype(cfg.dtype) + lp["fc1_b"].astype(cfg.dtype)

@@ -8,6 +8,7 @@ and the model layers (models/). Everything is jit-compiled with static shapes
 — dynamic row counts are bucket-padded by the callers.
 """
 
+from pathway_tpu.ops.flash_attention import flash_attention
 from pathway_tpu.ops.knn import (
     DeviceKnnState,
     knn_init,
@@ -19,6 +20,7 @@ from pathway_tpu.ops.knn import (
 
 __all__ = [
     "DeviceKnnState",
+    "flash_attention",
     "knn_init",
     "knn_search",
     "knn_search_sharded",
