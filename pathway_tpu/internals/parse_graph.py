@@ -136,10 +136,13 @@ def run(
                     monitor, port=monitoring_server_port
                 )
 
-    from pathway_tpu.internals.telemetry import run_span
+    from pathway_tpu.internals.telemetry import run_span, telemetry_enabled
 
+    if telemetry_enabled():
+        # per-operator stats feed the metrics sampler + operator spans
+        runner.probe_stats = True
     try:
-        with run_span():
+        with run_span(lambda: getattr(runner, "scheduler", None)):
             if isinstance(runner, (ShardedGraphRunner, DistributedGraphRunner)):
                 runner.attach_sinks()
                 runner.run()

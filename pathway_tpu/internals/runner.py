@@ -918,9 +918,13 @@ class GraphRunner:
     def run_static(self) -> Scheduler:
         sched = Scheduler(
             self.scope,
-            probe=self.monitor is not None
-            and getattr(self.monitor, "wants_operator_stats", True),
+            probe=(
+                self.monitor is not None
+                and getattr(self.monitor, "wants_operator_stats", True)
+            )
+            or getattr(self, "probe_stats", False),
         )
+        self.scheduler = sched  # telemetry sampler reads stats here
         if self.monitor is not None:
             self.monitor.scheduler = sched
         import time as _time
@@ -943,9 +947,13 @@ class GraphRunner:
             return self.run_static()
         sched = Scheduler(
             self.scope,
-            probe=self.monitor is not None
-            and getattr(self.monitor, "wants_operator_stats", True),
+            probe=(
+                self.monitor is not None
+                and getattr(self.monitor, "wants_operator_stats", True)
+            )
+            or getattr(self, "probe_stats", False),
         )
+        self.scheduler = sched  # telemetry sampler reads stats here
         if self.monitor is not None:
             self.monitor.scheduler = sched
         persistent = [d for d in self.drivers if hasattr(d, "replay")]
@@ -1084,12 +1092,15 @@ class ShardedGraphRunner:
     def _make_scheduler(self):
         from pathway_tpu.engine.sharded import ShardedScheduler
 
-        probe = self.monitor is not None and getattr(
-            self.monitor, "wants_operator_stats", True
-        )
-        return ShardedScheduler(
+        probe = (
+            self.monitor is not None
+            and getattr(self.monitor, "wants_operator_stats", True)
+        ) or getattr(self, "probe_stats", False)
+        sched = ShardedScheduler(
             [w.scope for w in self.workers], probe=probe
         )
+        self.scheduler = sched  # telemetry sampler reads stats here
+        return sched
 
     def run(self, sched=None):
         import time as _time
@@ -1272,6 +1283,7 @@ class DistributedGraphRunner:
                     self, "n_shared", len(self.workers[0].scope.nodes)
                 ),
             )
+            self.scheduler = sched  # telemetry sampler reads stats here
             if self.monitor is not None:
                 self.monitor.scheduler = sched
             if self.process_id == 0:

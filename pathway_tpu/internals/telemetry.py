@@ -3,15 +3,29 @@ graph_runner/telemetry.py).
 
 Off by default (like the reference, where telemetry is opt-in via
 ``set_monitoring_config``). ``pw.set_monitoring_config(server_endpoint=...)``
-turns it on: every ``pw.run`` emits a root span with run metadata plus
-periodic process metrics, exported over OTLP. Without an endpoint (or the
-exporter packages) every hook is a no-op.
+turns it on: every ``pw.run`` emits
+
+- a root ``pathway.run`` span with run metadata,
+- one child span per operator at run end carrying that operator's
+  insertions/deletions/batches and time inside ``process()``
+  (the per-operator trace surface of telemetry.rs),
+- periodic process metrics — RSS, CPU utilization, thread count — plus
+  per-operator row counters, sampled by a background thread every
+  ``PATHWAY_TELEMETRY_INTERVAL_S`` seconds (default 5; reference
+  telemetry.rs:195-407 periodic reader).
+
+Metric samples are ALWAYS collected into an in-process snapshot
+(:func:`latest_process_metrics`) while a run is live — the OTLP export is
+the only part gated on the endpoint, so tests and the monitoring HTTP
+surface read the same numbers without exporter packages.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import time as _time
 import uuid
 from typing import Any, Iterator
 
@@ -19,6 +33,7 @@ _config: dict[str, Any] = {"endpoint": None, "license_key": None}
 _RUN_ID = str(uuid.uuid4())
 _provider_cache: dict[str, Any] = {}  # endpoint -> tracer (OTEL's global
 # provider is first-write-wins, so build ours once per endpoint)
+_latest_metrics: dict[str, Any] = {}
 
 
 def set_monitoring_config(
@@ -64,11 +79,173 @@ def _tracer() -> Any:
     return tracer
 
 
-@contextlib.contextmanager
-def run_span() -> Iterator[None]:
-    tracer = _tracer()
-    if tracer is None:
-        yield
+def _operator_stats(scheduler: Any) -> dict[str, dict[str, Any]]:
+    """idx-labelled per-operator counters, snapshotting the stats dict
+    (the run thread inserts entries lazily mid-run)."""
+    ops: dict[str, dict[str, Any]] = {}
+    if scheduler is None:
+        return ops
+    for idx, st in list(getattr(scheduler, "stats", {}).items()):
+        try:
+            node = scheduler.scope.nodes[idx]
+            name = f"{idx}:{getattr(node, 'name', type(node).__name__)}"
+        except Exception:  # noqa: BLE001
+            name = str(idx)
+        ops[name] = dict(
+            insertions=getattr(st, "insertions", 0),
+            deletions=getattr(st, "deletions", 0),
+            batches=getattr(st, "batches", 0),
+            time_spent=getattr(st, "time_spent", 0.0),
+        )
+    return ops
+
+
+def _sample_process(scheduler: Any) -> dict[str, Any]:
+    """One metrics sample: process gauges + per-operator counters."""
+    sample: dict[str, Any] = {"ts": _time.time()}
+    try:
+        import psutil
+
+        proc = psutil.Process()
+        sample["memory_rss_bytes"] = proc.memory_info().rss
+        sample["cpu_percent"] = proc.cpu_percent(interval=None)
+        sample["num_threads"] = proc.num_threads()
+    except Exception:  # noqa: BLE001 — psutil optional
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        sample["memory_rss_bytes"] = ru.ru_maxrss * 1024
+        sample["cpu_seconds"] = ru.ru_utime + ru.ru_stime
+    if scheduler is not None:
+        sample["operators"] = _operator_stats(scheduler)
+    return sample
+
+
+def latest_process_metrics() -> dict[str, Any]:
+    """Most recent sample of the live (or last) run (published
+    atomically by the sampler; a final sample lands at run end)."""
+    return dict(_latest_metrics)
+
+
+def telemetry_enabled() -> bool:
+    return bool(
+        _config["endpoint"]
+        or os.environ.get("PATHWAY_TELEMETRY_SERVER")
+        or os.environ.get("PATHWAY_PROCESS_METRICS")
+    )
+
+
+class _MetricsSampler(threading.Thread):
+    """Periodic process-metrics pump (reference telemetry.rs:195-407).
+
+    Samples regardless of OTLP; exports each sample as gauge values when
+    an endpoint + the OTEL metrics packages are available."""
+
+    def __init__(self, scheduler_ref: Any, interval_s: float) -> None:
+        super().__init__(name="pw-telemetry", daemon=True)
+        self._scheduler_ref = scheduler_ref
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._exporter = self._make_exporter()
+
+    def _make_exporter(self) -> Any:
+        endpoint = _config["endpoint"] or os.environ.get(
+            "PATHWAY_TELEMETRY_SERVER"
+        )
+        if not endpoint:
+            return None
+        try:
+            from opentelemetry.exporter.otlp.proto.grpc.metric_exporter import (
+                OTLPMetricExporter,
+            )
+            from opentelemetry.sdk.metrics import MeterProvider
+            from opentelemetry.sdk.metrics.export import (
+                PeriodicExportingMetricReader,
+            )
+            from opentelemetry.sdk.resources import Resource
+        except ImportError:
+            return None
+        reader = PeriodicExportingMetricReader(
+            OTLPMetricExporter(endpoint=endpoint),
+            export_interval_millis=int(self._interval * 1000),
+        )
+        provider = MeterProvider(
+            metric_readers=[reader],
+            resource=Resource.create(
+                {"service.name": "pathway-tpu", "run.id": _RUN_ID}
+            ),
+        )
+        meter = provider.get_meter("pathway_tpu")
+        gauges = {
+            "memory_rss_bytes": meter.create_gauge("process.memory.rss"),
+            "cpu_percent": meter.create_gauge("process.cpu.percent"),
+            "num_threads": meter.create_gauge("process.threads"),
+        }
+        return {"provider": provider, "gauges": gauges}
+
+    def _sample_once(self) -> None:
+        global _latest_metrics
+        sample = _sample_process(self._scheduler_ref())
+        _latest_metrics = sample  # atomic publish by rebinding
+        if self._exporter is not None:
+            for key, gauge in self._exporter["gauges"].items():
+                if key in sample:
+                    gauge.set(sample[key])
+
+    def run(self) -> None:
+        while not self._stop.wait(self._interval):
+            with contextlib.suppress(Exception):
+                self._sample_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # final sample: runs shorter than one interval still publish their
+        # end-of-run process + operator counters
+        with contextlib.suppress(Exception):
+            self._sample_once()
+        if self._exporter is not None:
+            with contextlib.suppress(Exception):
+                self._exporter["provider"].shutdown()
+
+
+def _emit_operator_spans(tracer: Any, scheduler: Any) -> None:
+    """One span per operator with its run-total counters — the
+    per-operator trace surface (reference telemetry.rs spans)."""
+    if tracer is None or scheduler is None:
         return
-    with tracer.start_as_current_span("pathway.run"):
-        yield
+    for name, st in _operator_stats(scheduler).items():
+        with tracer.start_as_current_span(f"operator.{name}") as span:
+            span.set_attribute("operator.insertions", st["insertions"])
+            span.set_attribute("operator.deletions", st["deletions"])
+            span.set_attribute("operator.batches", st["batches"])
+            span.set_attribute("operator.time_spent_s", st["time_spent"])
+
+
+@contextlib.contextmanager
+def run_span(scheduler_getter: Any = None) -> Iterator[None]:
+    """Root run span + periodic metrics sampler around ``pw.run``.
+
+    ``scheduler_getter`` returns the live scheduler (or None before the
+    run starts) so the sampler and operator spans can read its stats."""
+    tracer = _tracer()
+    getter = scheduler_getter or (lambda: None)
+    # sampling follows the telemetry switch, not tracer availability — an
+    # endpoint without the OTEL trace packages still collects samples
+    enabled = telemetry_enabled()
+    sampler: _MetricsSampler | None = None
+    if enabled:
+        interval = float(
+            os.environ.get("PATHWAY_TELEMETRY_INTERVAL_S", "5")
+        )
+        sampler = _MetricsSampler(getter, interval)
+        sampler.start()
+    try:
+        if tracer is None:
+            yield
+        else:
+            with tracer.start_as_current_span("pathway.run"):
+                yield
+                _emit_operator_spans(tracer, getter())
+    finally:
+        if sampler is not None:
+            sampler.stop()

@@ -143,3 +143,55 @@ class TestViz:
         t.show(console=Console(file=buf, width=60))
         pw.run()
         assert "5" in buf.getvalue()
+
+
+class TestTelemetryPipeline:
+    """Periodic process metrics + per-operator counters (reference
+    telemetry.rs:195-407 — the sampler runs whenever telemetry is on,
+    OTLP export only when an endpoint is reachable)."""
+
+    def test_sampler_collects_process_and_operator_metrics(
+        self, monkeypatch
+    ):
+        import time
+
+        import pathway_tpu as pw
+        from pathway_tpu.internals import telemetry
+        from pathway_tpu.internals.parse_graph import G
+
+        monkeypatch.setenv("PATHWAY_PROCESS_METRICS", "1")
+        monkeypatch.setenv("PATHWAY_TELEMETRY_INTERVAL_S", "0.05")
+        G.clear()
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(50):
+                    self.next(k=i % 5, v=i)
+                time.sleep(0.3)  # keep the run alive past one interval
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(k=int, v=int),
+            autocommit_duration_ms=None,
+        )
+        agg = t.groupby(pw.this.k).reduce(
+            k=pw.this.k, s=pw.reducers.sum(pw.this.v)
+        )
+        pw.io.null.write(agg)
+        pw.run()
+        sample = telemetry.latest_process_metrics()
+        assert sample.get("memory_rss_bytes", 0) > 0
+        ops = sample.get("operators", {})
+        assert ops, f"no operator counters in {sample}"
+        assert any(
+            st.get("insertions", 0) > 0 for st in ops.values()
+        ), ops
+        assert any("Groupby" in name for name in ops)
+
+    def test_disabled_by_default(self, monkeypatch):
+        from pathway_tpu.internals import telemetry
+
+        monkeypatch.delenv("PATHWAY_TELEMETRY_SERVER", raising=False)
+        monkeypatch.delenv("PATHWAY_PROCESS_METRICS", raising=False)
+        telemetry.set_monitoring_config(server_endpoint=None)
+        assert not telemetry.telemetry_enabled()
