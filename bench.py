@@ -606,7 +606,44 @@ def multimodal_leg() -> dict:
     }
 
 
+def _probe_device(timeout_s: float) -> None:
+    """Fail fast with a diagnostic JSON line if the accelerator is
+    unreachable (the remote-device tunnel has outage windows; a hang here
+    would otherwise eat the whole bench budget silently)."""
+    import threading
+
+    ok = threading.Event()
+
+    def touch():
+        import jax
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jnp.ones((8,)))
+        ok.set()
+
+    t = threading.Thread(target=touch, daemon=True)
+    t.start()
+    if not ok.wait(timeout_s):
+        print(
+            json.dumps(
+                {
+                    "metric": "streaming_rag_pipeline_docs_per_sec",
+                    "value": None,
+                    "unit": "docs/sec",
+                    "vs_baseline": None,
+                    "error": (
+                        f"accelerator unreachable: first device op did "
+                        f"not complete within {timeout_s}s "
+                        f"(BENCH_DEVICE_PROBE_S)"
+                    ),
+                }
+            )
+        )
+        os._exit(3)
+
+
 def main() -> None:
+    _probe_device(float(os.environ.get("BENCH_DEVICE_PROBE_S", "300")))
     # two runs, keep the better: host<->device tunnel turnaround varies
     # ~10x run-to-run (the device leg itself is stable at ~26.4k docs/s),
     # and the second run reuses every warm jit specialization
