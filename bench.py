@@ -612,18 +612,31 @@ def _probe_device(timeout_s: float) -> None:
     would otherwise eat the whole bench budget silently)."""
     import threading
 
-    ok = threading.Event()
+    done = threading.Event()
+    failure: list = []
 
     def touch():
-        import jax
-        import jax.numpy as jnp
+        try:
+            import jax
+            import jax.numpy as jnp
 
-        jax.block_until_ready(jnp.ones((8,)))
-        ok.set()
+            jax.block_until_ready(jnp.ones((8,)))
+        except Exception as exc:  # noqa: BLE001 — report, don't wait out
+            failure.append(repr(exc))
+        done.set()
 
     t = threading.Thread(target=touch, daemon=True)
     t.start()
-    if not ok.wait(timeout_s):
+    done.wait(timeout_s)
+    if not done.is_set() or failure:
+        error = (
+            f"accelerator init failed: {failure[0]}"
+            if failure
+            else (
+                f"accelerator unreachable: first device op did not "
+                f"complete within {timeout_s}s (BENCH_DEVICE_PROBE_S)"
+            )
+        )
         print(
             json.dumps(
                 {
@@ -631,13 +644,10 @@ def _probe_device(timeout_s: float) -> None:
                     "value": None,
                     "unit": "docs/sec",
                     "vs_baseline": None,
-                    "error": (
-                        f"accelerator unreachable: first device op did "
-                        f"not complete within {timeout_s}s "
-                        f"(BENCH_DEVICE_PROBE_S)"
-                    ),
+                    "error": error,
                 }
-            )
+            ),
+            flush=True,
         )
         os._exit(3)
 

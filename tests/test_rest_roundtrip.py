@@ -1,0 +1,107 @@
+"""REST connector round trip: live HTTP requests through a streaming
+run — the serving surface behind VectorStoreServer/QA servers
+(reference python/pathway/io/http + tests/test_rest_connector shape)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import GraphRunner
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url: str, payload: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _post_with_retry(url: str, payload: dict, deadline_s: float = 20.0):
+    deadline = time.monotonic() + deadline_s
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            return _post(url, payload)
+        except Exception as exc:  # noqa: BLE001 — server still starting
+            last = exc
+            time.sleep(0.1)
+    raise last  # type: ignore[misc]
+
+
+class TestRestConnectorRoundTrip:
+    def test_concurrent_requests_get_their_own_answers(self):
+        G.clear()
+        port = _free_port()
+        queries, attach = pw.io.http.rest_connector(
+            "127.0.0.1",
+            port,
+            schema=pw.schema_from_types(x=int),
+            route="/double",
+        )
+        result = queries.select(result=pw.this.x * 2)
+        runner = GraphRunner()
+        attach(result, runner)
+        threading.Thread(
+            target=runner.run, name="rest-test-run", daemon=True
+        ).start()
+
+        answers: dict[int, dict] = {}
+        errors: list[Exception] = []
+
+        def client(i: int) -> None:
+            try:
+                answers[i] = _post_with_retry(
+                    f"http://127.0.0.1:{port}/double", {"x": i}
+                )
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert len(answers) == 4
+        for i, body in answers.items():
+            value = body["result"] if isinstance(body, dict) else body
+            assert value == i * 2, (i, body)
+
+    def test_qa_style_server_class(self):
+        """The xpack server wrapper: BaseRestServer.serve + threaded run,
+        the exact shape DocumentStoreServer/QARestServer use."""
+        G.clear()
+        from pathway_tpu.xpacks.llm.servers import BaseRestServer
+
+        port = _free_port()
+        server = BaseRestServer("127.0.0.1", port)
+        server.serve(
+            "/echo",
+            pw.schema_from_types(text=str),
+            lambda q: q.select(result=pw.this.text + "!"),
+        )
+        server.run(threaded=True)
+        body = _post_with_retry(
+            f"http://127.0.0.1:{port}/echo", {"text": "hello"}
+        )
+        value = body["result"] if isinstance(body, dict) else body
+        assert value == "hello!"
