@@ -80,6 +80,11 @@ from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa:
 from pathway_tpu.internals import udfs  # noqa: E402
 from pathway_tpu.internals.iterate import iterate  # noqa: E402
 from pathway_tpu.internals.sql import sql  # noqa: E402
+from pathway_tpu.internals.interactive import (  # noqa: E402
+    LiveTable,
+    enable_interactive_mode,
+    stop_interactive_mode,
+)
 from pathway_tpu.internals.udfs import UDF, udf  # noqa: E402
 
 
@@ -105,6 +110,9 @@ def wrap_py_object(obj: object, **kwargs: object) -> PyObjectWrapper:
 __version__ = "0.1.0"
 
 __all__ = [
+    "LiveTable",
+    "enable_interactive_mode",
+    "stop_interactive_mode",
     "ERROR",
     "ColumnExpression",
     "ColumnReference",

@@ -1,0 +1,206 @@
+"""Notebook interactive layer (reference: python/pathway/internals/
+interactive.py + stdlib/viz/table_viz.py — live-updating tables in
+IPython, with the run pumping in the background so cells return).
+
+Surface:
+
+- :class:`LiveTable` — subscribes to a table and re-renders a snapshot on
+  every commit. In an IPython kernel it renders through a display handle
+  (``display(display_id=True)`` + ``handle.update``) as an HTML table;
+  outside IPython it falls back to the rich console renderer the viz
+  module already provides.
+- :func:`enable_interactive_mode` — starts ``pw.run`` on a background
+  thread so a notebook cell returns immediately while LiveTables keep
+  updating; :func:`stop_interactive_mode` joins it.
+- ``Table._repr_html_`` (installed by this module's import through
+  pathway_tpu/__init__) — schema-shaped HTML so bare table expressions
+  render usefully in notebooks without running the graph.
+"""
+
+from __future__ import annotations
+
+import html as _html
+import threading
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.viz_model import RowSnapshot
+
+_interactive: dict[str, Any] = {"thread": None, "error": None}
+
+
+def _in_ipython() -> bool:
+    try:
+        from IPython import get_ipython
+
+        return get_ipython() is not None
+    except ImportError:
+        return False
+
+
+class LiveTable:
+    """A live-updating view of ``table``: one row per key, revised as
+    commits land.
+
+    ``display_handle``: anything with ``.update(obj)`` — defaults to an
+    IPython display handle in a kernel; injectable for tests/headless."""
+
+    def __init__(
+        self,
+        table: Table,
+        *,
+        max_rows: int = 20,
+        display_handle: Any = None,
+    ) -> None:
+        from pathway_tpu.io import subscribe as _subscribe
+
+        self._snapshot = RowSnapshot(table.column_names(), max_rows)
+        self.n_commits = 0
+        self._handle = display_handle
+        _subscribe(
+            table,
+            on_change=self._on_change,
+            on_time_end=self._on_time_end,
+            on_end=self._on_end,
+        )
+
+    # -- engine callbacks -----------------------------------------------------
+
+    def _on_change(self, key, row, time, is_addition):
+        self._snapshot.apply(key, row, is_addition)
+
+    def _on_time_end(self, time):
+        self.n_commits += 1
+        self._render()
+
+    def _on_end(self):
+        self._render()
+
+    @property
+    def rows(self) -> dict:
+        return self._snapshot.rows
+
+    @property
+    def column_names(self) -> list:
+        return self._snapshot.column_names
+
+    # -- rendering ------------------------------------------------------------
+
+    def _repr_html_(self) -> str:
+        snap = self._snapshot
+        head = "".join(
+            f"<th>{_html.escape(str(n))}</th>" for n in snap.column_names
+        )
+        body = []
+        for row in snap.visible():
+            cells = "".join(
+                f"<td>{_html.escape(str(v))}</td>" for v in row
+            )
+            body.append(f"<tr>{cells}</tr>")
+        extra = (
+            f"<caption>... {snap.overflow} more rows</caption>"
+            if snap.overflow
+            else ""
+        )
+        return (
+            f"<table>{extra}<thead><tr>{head}</tr></thead>"
+            f"<tbody>{''.join(body)}</tbody></table>"
+            f"<small>{len(snap.rows)} rows · commit {self.n_commits}"
+            f"</small>"
+        )
+
+    def _render(self) -> None:
+        if self._handle is None and _in_ipython():
+            from IPython.display import HTML, display
+
+            self._handle = display(
+                HTML(self._repr_html_()), display_id=True
+            )
+            return
+        if self._handle is not None:
+            try:
+                from IPython.display import HTML
+
+                self._handle.update(HTML(self._repr_html_()))
+            except ImportError:
+                self._handle.update(self._repr_html_())
+
+
+def show(table: Table, **kwargs: Any) -> LiveTable | None:
+    """Notebook: a LiveTable; console: the rich live renderer
+    (stdlib/viz, which accepts the same kwargs it documents)."""
+    if _in_ipython() or kwargs.get("display_handle") is not None:
+        return LiveTable(table, **kwargs)
+    from pathway_tpu.stdlib.viz import show as console_show
+
+    console_show(table, **kwargs)
+    return None
+
+
+def enable_interactive_mode(**run_kwargs: Any) -> threading.Thread:
+    """Start ``pw.run`` on a background thread (reference interactive
+    mode: cells return while the dataflow keeps streaming)."""
+    if _interactive["thread"] is not None and _interactive["thread"].is_alive():
+        raise RuntimeError("interactive mode already running")
+    if _interactive["error"] is not None:
+        # a previous background run died and was never joined — surface
+        # its failure instead of silently discarding it
+        error = _interactive["error"]
+        _interactive["error"] = None
+        raise RuntimeError(
+            "previous interactive run failed; fix and retry"
+        ) from error
+    from pathway_tpu.internals import parse_graph
+
+    def runner():
+        try:
+            parse_graph.run(**run_kwargs)
+        except Exception as exc:  # noqa: BLE001 — surfaced on stop/join
+            _interactive["error"] = exc
+
+    thread = threading.Thread(
+        target=runner, name="pw-interactive", daemon=True
+    )
+    thread.start()
+    _interactive["thread"] = thread
+    return thread
+
+
+def stop_interactive_mode(timeout: float | None = 30.0) -> None:
+    """Join the background run (it ends when every connector finishes);
+    re-raises any error the run hit."""
+    thread = _interactive["thread"]
+    if thread is None:
+        return
+    thread.join(timeout=timeout)
+    if thread.is_alive():
+        # the run is still going (endless connector?) — keep the handle
+        # so a retry can join it; starting a second run stays blocked
+        raise TimeoutError(
+            f"interactive run still alive after {timeout}s; its "
+            "connectors have not finished"
+        )
+    _interactive["thread"] = None
+    if _interactive["error"] is not None:
+        error = _interactive["error"]
+        _interactive["error"] = None
+        raise error
+
+
+def _table_repr_html(table: Table) -> str:
+    """Schema-shaped notebook repr (no graph execution)."""
+    dtypes = table._dtypes
+    rows = "".join(
+        f"<tr><td>{_html.escape(str(n))}</td>"
+        f"<td><code>{_html.escape(str(dtypes.get(n)))}</code></td></tr>"
+        for n in table.column_names()
+    )
+    return (
+        f"<b>pw.Table</b> <code>{_html.escape(getattr(table, '_name', ''))}</code>"
+        f"<table><thead><tr><th>column</th><th>dtype</th></tr></thead>"
+        f"<tbody>{rows}</tbody></table>"
+    )
+
+
+# bare table expressions render their schema in notebooks
+Table._repr_html_ = _table_repr_html  # type: ignore[attr-defined]

@@ -1,0 +1,31 @@
+"""Shared row-snapshot model behind the live table renderers (console
+rich view in stdlib/viz and the notebook LiveTable in
+internals/interactive): one place owns add/retract semantics and the
+max_rows windowing so the two surfaces cannot diverge."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+
+class RowSnapshot:
+    """Current state of a table as {key: value-tuple}, fed by subscribe
+    callbacks."""
+
+    def __init__(self, column_names: Sequence[str], max_rows: int) -> None:
+        self.column_names = list(column_names)
+        self.max_rows = max_rows
+        self.rows: dict[Any, tuple] = {}
+
+    def apply(self, key: Any, row: dict, is_addition: bool) -> None:
+        if is_addition:
+            self.rows[key] = tuple(row[n] for n in self.column_names)
+        else:
+            self.rows.pop(key, None)
+
+    def visible(self) -> list[tuple]:
+        return list(self.rows.values())[: self.max_rows]
+
+    @property
+    def overflow(self) -> int:
+        return max(0, len(self.rows) - self.max_rows)

@@ -17,31 +17,33 @@ from pathway_tpu.internals.table import Table
 
 class _LiveTableViz:
     def __init__(self, table: Table, title: str, console: Any, max_rows: int):
-        self.column_names = table.column_names()
+        from pathway_tpu.internals.viz_model import RowSnapshot
+
+        # shared snapshot model with the notebook LiveTable
+        # (internals/interactive.py): one owner for add/retract semantics
+        self._snapshot = RowSnapshot(table.column_names(), max_rows)
         self.title = title
-        self.max_rows = max_rows
-        self.rows: dict = {}
         self._live = None
         self._console = console
+
+    @property
+    def rows(self) -> dict:
+        return self._snapshot.rows
 
     def _render(self):
         from rich.table import Table as RichTable
 
         rt = RichTable(title=self.title)
-        for name in self.column_names:
+        for name in self._snapshot.column_names:
             rt.add_column(name)
-        for _key, row in list(self.rows.items())[: self.max_rows]:
+        for row in self._snapshot.visible():
             rt.add_row(*[str(v) for v in row])
-        if len(self.rows) > self.max_rows:
-            rt.caption = f"... {len(self.rows) - self.max_rows} more rows"
+        if self._snapshot.overflow:
+            rt.caption = f"... {self._snapshot.overflow} more rows"
         return rt
 
     def on_change(self, key, row, time, is_addition):
-        values = tuple(row[name] for name in self.column_names)
-        if is_addition:
-            self.rows[key] = values
-        else:
-            self.rows.pop(key, None)
+        self._snapshot.apply(key, row, is_addition)
 
     def on_time_end(self, time):
         if self._live is None:

@@ -195,3 +195,89 @@ class TestTelemetryPipeline:
         monkeypatch.delenv("PATHWAY_PROCESS_METRICS", raising=False)
         telemetry.set_monitoring_config(server_endpoint=None)
         assert not telemetry.telemetry_enabled()
+
+
+class TestInteractiveLayer:
+    """Notebook interactive surface (reference internals/interactive.py):
+    LiveTable display updates per commit, background interactive mode."""
+
+    def test_live_table_updates_through_injected_handle(self):
+        import time
+
+        import pathway_tpu as pw
+        from pathway_tpu.internals.parse_graph import G
+
+        G.clear()
+
+        class Handle:
+            def __init__(self):
+                self.updates = []
+
+            def update(self, obj):
+                self.updates.append(
+                    obj.data if hasattr(obj, "data") else str(obj)
+                )
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                self.next(k=1, v=10)
+                self.commit()
+                time.sleep(0.2)
+                self.next(k=2, v=20)
+                self.commit()
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(k=int, v=int),
+            autocommit_duration_ms=None,
+        )
+        handle = Handle()
+        live = pw.LiveTable(t, display_handle=handle)
+        pw.run()
+        assert live.n_commits >= 2
+        assert handle.updates, "display handle never updated"
+        final = handle.updates[-1]
+        assert "10" in final and "20" in final and "<table>" in final
+
+    def test_enable_interactive_mode_runs_in_background(self):
+        import time
+
+        import pathway_tpu as pw
+        from pathway_tpu.internals.parse_graph import G
+
+        G.clear()
+        seen = []
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(3):
+                    self.next(v=i)
+                    self.commit()
+                    time.sleep(0.05)
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(v=int),
+            autocommit_duration_ms=None,
+        )
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: seen.append(
+                row["v"]
+            ),
+        )
+        thread = pw.enable_interactive_mode()
+        assert thread.is_alive() or seen  # cell returned immediately
+        pw.stop_interactive_mode()
+        assert sorted(seen) == [0, 1, 2]
+
+    def test_table_repr_html_shows_schema(self):
+        import pathway_tpu as pw
+        from pathway_tpu.internals.parse_graph import G
+
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=str), [(1, "x")]
+        )
+        h = t._repr_html_()
+        assert "pw.Table" in h and ">a<" in h and ">b<" in h
