@@ -17,4 +17,5 @@ def test_entry_returns_jittable():
 
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
-    assert out.shape[0] == args[0].shape[0]
+    # args = (params, token_ids, mask); batch dim rides on token_ids
+    assert out.shape[0] == args[1].shape[0]
