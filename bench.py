@@ -606,6 +606,33 @@ def multimodal_leg() -> dict:
     }
 
 
+def _maybe_run_dataflow(out: dict, timeout_s: float | None = None) -> None:
+    """Run the host dataflow workloads into ``out`` (single authority for
+    the env gate, so the normal and outage paths report comparable
+    numbers). ``timeout_s`` bounds the attempt via a worker thread."""
+    if os.environ.get("BENCH_SKIP_DATAFLOW", "") in ("1", "true"):
+        return
+
+    def attempt() -> None:
+        try:
+            import bench_dataflow
+
+            out["dataflow_rows_per_sec"] = bench_dataflow.run_all()
+        except Exception as exc:  # noqa: BLE001 — diagnostic only
+            out["dataflow_error"] = repr(exc)
+
+    if timeout_s is None:
+        attempt()
+        return
+    import threading
+
+    worker = threading.Thread(target=attempt, daemon=True)
+    worker.start()
+    worker.join(timeout_s)
+    if worker.is_alive():
+        out["dataflow_error"] = f"dataflow workloads hung past {timeout_s}s"
+
+
 def _probe_device(timeout_s: float) -> None:
     """Fail fast with a diagnostic JSON line if the accelerator is
     unreachable (the remote-device tunnel has outage windows; a hang here
@@ -637,6 +664,11 @@ def _probe_device(timeout_s: float) -> None:
                 f"complete within {timeout_s}s (BENCH_DEVICE_PROBE_S)"
             )
         )
+        extra: dict = {}
+        # the host dataflow workloads need no device — preserve the
+        # regression line even through an accelerator outage, but bound
+        # the attempt so a hung engine can't defeat the fail-fast probe
+        _maybe_run_dataflow(extra, timeout_s=600.0)
         print(
             json.dumps(
                 {
@@ -645,6 +677,7 @@ def _probe_device(timeout_s: float) -> None:
                     "unit": "docs/sec",
                     "vs_baseline": None,
                     "error": error,
+                    "extra": extra,
                 }
             ),
             flush=True,
@@ -667,12 +700,9 @@ def main() -> None:
     device_docs_per_sec = device_only_leg()
     docs_per_sec = stats.pop("pipeline_docs_per_sec")
     stats["device_docs_per_sec"] = round(device_docs_per_sec, 1)
-    if os.environ.get("BENCH_SKIP_DATAFLOW", "") not in ("1", "true"):
-        # host dataflow workloads (wordcount/join/groupby/filter at 1M rows
-        # + incremental phase) tracked in the same JSON line every round
-        import bench_dataflow
-
-        stats["dataflow_rows_per_sec"] = bench_dataflow.run_all()
+    # host dataflow workloads (wordcount/join/groupby/filter at 1M rows
+    # + incremental phase) tracked in the same JSON line every round
+    _maybe_run_dataflow(stats)
     # BASELINE configs #2-#4 (VERDICT r2 #4); each skippable via env
     if os.environ.get("BENCH_SKIP_VECTOR_STORE", "") not in ("1", "true"):
         stats["config2_vector_store"] = vector_store_leg()
