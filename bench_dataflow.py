@@ -32,13 +32,18 @@ def timed(fn):
     return time.perf_counter() - t0
 
 
+def _row_wise() -> bool:
+    """True while the 'row interpreter' comparison mode is active."""
+    return graph_mod.VECTOR_THRESHOLD > N
+
+
 def groupby_sum():
     rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(N)]
 
     def run():
         scope = Scope()
         sess = scope.input_session(2)
-        scope.group_by_table(
+        gb = scope.group_by_table(
             sess,
             by_cols=[0],
             reducers=[
@@ -46,6 +51,8 @@ def groupby_sum():
                 (make_reducer(ReducerKind.COUNT), []),
             ],
         )
+        if _row_wise():
+            gb._cg = None
         sched = Scheduler(scope)
         for key, row in rows:
             sess.insert(key, row)
@@ -114,11 +121,13 @@ def wordcount():
     def run():
         scope = Scope()
         sess = scope.input_session(1)
-        scope.group_by_table(
+        gb = scope.group_by_table(
             sess,
             by_cols=[0],
             reducers=[(make_reducer(ReducerKind.COUNT), [])],
         )
+        if _row_wise():
+            gb._cg = None
         sched = Scheduler(scope)
         for key, row in rows:
             sess.insert(key, row)

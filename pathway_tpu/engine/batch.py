@@ -5,14 +5,28 @@ at a logical timestamp (reference: tables as
 ``Collection<S, (Key, Value)>`` diffs, src/engine/dataflow.rs:820). A batch is
 consolidated when each (key, row) appears once with a non-zero diff.
 
-Rows are plain tuples of engine values; columnar views (NumPy / DLPack →
-jax.Array) are materialized on demand by the device bridge
-(:mod:`pathway_tpu.engine.device`).
+Two physical representations share the :class:`DeltaBatch` interface:
+
+- **row form** — a list of ``(Pointer, tuple, int)`` entries; the universal
+  fallback every operator understands.
+- **columnar form** — a :class:`Columns` payload: keys as a ``(n, 16)``
+  little-endian byte matrix (or an object vector of Pointers), one NumPy
+  array per column, and an optional diff vector (``None`` = all +1).
+  Produced by the vectorized operator paths (expression eval, filter,
+  hash join, groupby) and consumed array-to-array downstream; rows
+  materialise lazily only when something row-oriented touches the batch.
+
+The columnar form is what lets the engine hot path clear the ~1µs/row
+Python-object floor: a bulk commit flows source → join → groupby as NumPy
+gathers plus one vectorized key-hash pass, with zero per-row PyObjects
+unless a sink or a state read asks for them.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
 
 from pathway_tpu.engine.value import Pointer
 from pathway_tpu.native import kernels as _native
@@ -20,61 +34,262 @@ from pathway_tpu.native import kernels as _native
 Entry = tuple[Pointer, tuple, int]
 
 
+class Columns:
+    """Columnar payload of a delta batch.
+
+    ``kbytes`` and ``kobjs`` are two views of the same keys — 16-byte
+    little-endian rows vs. Pointer objects; either may be absent and is
+    derived from the other on demand. ``cols`` holds one 1-D array per
+    column (clean dtypes where possible, ``object`` otherwise). ``diffs``
+    is ``None`` when every diff is +1.
+    """
+
+    __slots__ = ("n", "_kbytes", "_kobjs", "cols", "diffs")
+
+    def __init__(
+        self,
+        n: int,
+        cols: Sequence[np.ndarray],
+        kbytes: np.ndarray | None = None,
+        kobjs: Sequence[Pointer] | None = None,
+        diffs: np.ndarray | None = None,
+    ) -> None:
+        assert kbytes is not None or kobjs is not None
+        self.n = n
+        self._kbytes = kbytes
+        self._kobjs = list(kobjs) if kobjs is not None else None
+        self.cols = list(cols)
+        self.diffs = diffs
+
+    # -- key views ----------------------------------------------------------
+
+    def kbytes(self) -> np.ndarray:
+        """Keys as a C-contiguous (n, 16) uint8 little-endian matrix."""
+        if self._kbytes is None:
+            if _native is not None and hasattr(_native, "pointers_to_bytes"):
+                self._kbytes = _native.pointers_to_bytes(self._kobjs)
+            else:
+                buf = b"".join(
+                    int(k).to_bytes(16, "little") for k in self._kobjs
+                )
+                self._kbytes = np.frombuffer(buf, np.uint8).reshape(
+                    self.n, 16
+                )
+        return self._kbytes
+
+    def kobjs(self) -> list[Pointer]:
+        """Keys as Pointer objects (materialised once, then cached)."""
+        if self._kobjs is None:
+            kb = np.ascontiguousarray(self._kbytes)
+            if _native is not None and hasattr(_native, "bytes_to_pointers"):
+                self._kobjs = _native.bytes_to_pointers(kb)
+            else:
+                mem = kb.tobytes()
+                self._kobjs = [
+                    Pointer(int.from_bytes(mem[i * 16 : i * 16 + 16], "little"))
+                    for i in range(self.n)
+                ]
+        return self._kobjs
+
+    # -- transforms ---------------------------------------------------------
+
+    def gather(self, idx: np.ndarray) -> "Columns":
+        """Row subset/reorder by an index vector (NumPy fancy gather)."""
+        kb = self._kbytes
+        kobjs = None
+        if kb is not None:
+            kb = kb[idx]
+        else:
+            arr = np.empty(self.n, object)
+            arr[:] = self._kobjs
+            kobjs = arr[idx].tolist()
+        diffs = self.diffs[idx] if self.diffs is not None else None
+        return Columns(
+            int(len(idx)),
+            [c[idx] for c in self.cols],
+            kbytes=kb,
+            kobjs=kobjs,
+            diffs=diffs,
+        )
+
+    def compress(self, mask: np.ndarray) -> "Columns":
+        """Row subset by boolean mask."""
+        return self.gather(np.flatnonzero(mask))
+
+    def column_diffs(self) -> np.ndarray:
+        """Diff vector (materialising the implicit all-ones case)."""
+        if self.diffs is None:
+            return np.ones(self.n, np.int64)
+        return self.diffs
+
+    @classmethod
+    def concat(cls, parts: "Sequence[Columns]") -> "Columns | None":
+        """Stack columnar payloads row-wise, or None when layouts differ
+        (arity mismatch or any per-column dtype mismatch — silent NumPy
+        promotion would change materialised Python types)."""
+        arity = len(parts[0].cols)
+        if any(len(p.cols) != arity for p in parts[1:]):
+            return None
+        for c in range(arity):
+            dt = parts[0].cols[c].dtype
+            if any(p.cols[c].dtype != dt for p in parts[1:]):
+                return None
+        n = sum(p.n for p in parts)
+        cols = [
+            np.concatenate([p.cols[c] for p in parts])
+            for c in range(arity)
+        ]
+        if all(p._kbytes is not None for p in parts):
+            kbytes = np.concatenate([p._kbytes for p in parts])
+            kobjs = None
+        else:
+            kbytes = None
+            kobjs = [k for p in parts for k in p.kobjs()]
+        if all(p.diffs is None for p in parts):
+            diffs = None
+        else:
+            diffs = np.concatenate([p.column_diffs() for p in parts])
+        return cls(n, cols, kbytes=kbytes, kobjs=kobjs, diffs=diffs)
+
+    def to_entries(self) -> list[Entry]:
+        """Materialise row-form entries (the per-row object cost lives
+        here, paid only when a row-oriented consumer needs it)."""
+        keys = self.kobjs()
+        if _native is not None and hasattr(_native, "columns_to_entries"):
+            return _native.columns_to_entries(
+                keys,
+                [np.ascontiguousarray(c) for c in self.cols],
+                self.diffs,
+            )
+        if self.cols:
+            rows = zip(*[c.tolist() for c in self.cols])
+        else:
+            rows = ((),) * self.n
+        if self.diffs is None:
+            return [(k, r, 1) for k, r in zip(keys, rows)]
+        return [
+            (k, r, int(d)) for k, r, d in zip(keys, rows, self.diffs)
+        ]
+
+
 class DeltaBatch:
     """A consolidatable batch of keyed row updates."""
 
-    __slots__ = ("entries", "_consolidated", "_insert_only", "_preapplied")
+    __slots__ = (
+        "_entries",
+        "columns",
+        "_consolidated",
+        "_insert_only",
+        "_preapplied",
+        "_ccache",
+    )
 
     def __init__(self, entries: Iterable[Entry] | None = None) -> None:
-        self.entries: list[Entry] = list(entries) if entries is not None else []
+        self._entries: list[Entry] | None = (
+            list(entries) if entries is not None else []
+        )
+        self.columns: Columns | None = None
         self._consolidated = False
         self._insert_only = False  # set by consolidate(): unique-key inserts
         #: producer already wrote these rows into its own node state
         #: (fused C kernels); only the PRODUCING node's apply is skipped —
         #: flag never travels on delivered/copied batches
         self._preapplied = False
+        #: cached consolidate() result — a batch fanning out to several
+        #: consumers (each consolidating in take()) merges only once
+        self._ccache: "DeltaBatch | None" = None
+
+    @classmethod
+    def from_columns(
+        cls,
+        columns: Columns,
+        consolidated: bool = True,
+        insert_only: bool = False,
+    ) -> "DeltaBatch":
+        """Wrap a columnar payload; producers assert consolidation
+        invariants at construction (unique keys ⇒ consolidated)."""
+        out = cls.__new__(cls)
+        out._entries = None
+        out.columns = columns
+        out._consolidated = consolidated
+        out._insert_only = insert_only and columns.diffs is None
+        out._preapplied = False
+        out._ccache = None
+        return out
+
+    @property
+    def entries(self) -> list[Entry]:
+        if self._entries is None:
+            self._entries = self.columns.to_entries()
+        return self._entries
+
+    @entries.setter
+    def entries(self, value: list[Entry]) -> None:
+        self._entries = value
+        self.columns = None
+        self._ccache = None
 
     def append(self, key: Pointer, row: tuple, diff: int) -> None:
         if diff != 0:
-            self.entries.append((key, row, diff))
+            entries = self._entries
+            if entries is None:
+                entries = self.entries
+            entries.append((key, row, diff))
+            self.columns = None  # row mutation invalidates the columnar view
             self._consolidated = False
             self._insert_only = False
+            self._ccache = None
 
     def extend(self, entries: Iterable[Entry]) -> None:
+        target = self.entries
         appended = False
         for key, row, diff in entries:
             if diff != 0:
-                self.entries.append((key, row, diff))
+                target.append((key, row, diff))
                 appended = True
         if appended:
+            self.columns = None
             self._consolidated = False
             self._insert_only = False
+            self._ccache = None
 
     def __iter__(self) -> Iterator[Entry]:
         return iter(self.entries)
 
     def __len__(self) -> int:
-        return len(self.entries)
+        if self._entries is not None:
+            return len(self._entries)
+        return self.columns.n
 
     def __bool__(self) -> bool:
-        return bool(self.entries)
+        return len(self) > 0
 
     def __repr__(self) -> str:
-        return f"DeltaBatch({self.entries!r})"
+        if self._entries is None:
+            return f"DeltaBatch(<columnar n={self.columns.n}>)"
+        return f"DeltaBatch({self._entries!r})"
 
     def consolidate(self) -> "DeltaBatch":
         """Merge duplicate (key, row) entries, dropping zero diffs."""
         if self._consolidated:
             return self
+        if self._ccache is not None:
+            return self._ccache
+        if self._entries is None:
+            # columnar batches are constructed with their consolidation
+            # flags asserted by the producer; an unconsolidated one has
+            # no cheap columnar merge — materialise and fall through
+            self.entries  # noqa: B018 — force row form
         if _native is not None:
-            merged, insert_only = _native.consolidate(self.entries)
+            merged, insert_only = _native.consolidate(self._entries)
             if merged is None:  # precheck passed: already consolidated
                 self._consolidated = True
                 self._insert_only = insert_only
                 return self
             out = DeltaBatch()
-            out.entries = merged
+            out._entries = merged
             out._consolidated = True
+            self._ccache = out
             return out
         # Cheap precheck for the dominant shape — insert-only with unique
         # keys (connector ingest, expression outputs): key uniqueness alone
@@ -82,7 +297,7 @@ class DeltaBatch:
         seen: set = set()
         seen_add = seen.add
         clean = True
-        for key, _row, diff in self.entries:
+        for key, _row, diff in self._entries:
             if diff <= 0 or key in seen:
                 clean = False
                 break
@@ -93,7 +308,7 @@ class DeltaBatch:
             return self
         acc: dict[tuple[Pointer, Any], list[Any]] = {}
         order: list[tuple[Pointer, Any]] = []
-        for key, row, diff in self.entries:
+        for key, row, diff in self._entries:
             try:
                 hash(row)
                 slot = (key, row)  # dict handles hash + equality correctly
@@ -109,8 +324,9 @@ class DeltaBatch:
         for slot in order:
             row, diff = acc[slot]
             if diff != 0:
-                out.entries.append((slot[0], row, diff))
+                out._entries.append((slot[0], row, diff))
         out._consolidated = True
+        self._ccache = out
         return out
 
     def map_rows(self, fn: Callable[[Pointer, tuple], tuple]) -> "DeltaBatch":
@@ -129,16 +345,17 @@ def apply_batch_to_state(state: dict[Pointer, tuple], batch: DeltaBatch) -> None
     if batch._preapplied:
         batch._preapplied = False  # one producing-node apply only
         return
+    entries = batch.entries
     if _native is not None:
-        _native.apply_state(state, batch.entries, batch._insert_only)
+        _native.apply_state(state, entries, batch._insert_only)
         return
     if batch._insert_only:
         # C-speed bulk store: no retraction pass needed
-        state.update((key, row) for key, row, _d in batch.entries)
+        state.update((key, row) for key, row, _d in entries)
         return
-    for key, row, diff in batch:
+    for key, row, diff in entries:
         if diff < 0:
             state.pop(key, None)
-    for key, row, diff in batch:
+    for key, row, diff in entries:
         if diff > 0:
             state[key] = row

@@ -272,6 +272,19 @@ def segment_count(
     return out
 
 
+def int_sum_overflow_risk(col: np.ndarray, n: int, dmax: int) -> bool:
+    """True when an int64 segment sum of ``col`` (diff magnitudes up to
+    ``dmax`` over ``n`` rows) could leave int64 range — the vectorized
+    paths compute in wrapping int64 while the row interpreter uses exact
+    Python ints, so risky batches must take the row path."""
+    if col.dtype.kind != "i" or col.size == 0:
+        return False
+    amax = int(np.abs(col).max())
+    if amax < 0 or dmax < 0:  # np.abs(INT64_MIN) wraps negative
+        return True
+    return amax * n * dmax > (1 << 62)
+
+
 def segment_sum(
     inverse: np.ndarray,
     values: np.ndarray,

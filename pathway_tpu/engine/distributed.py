@@ -565,6 +565,8 @@ class DistributedScheduler:
                 batch = node.initial_batch()
             elif isinstance(node, InputSession):
                 batch = node.flush()
+                if batch:
+                    batch = batch.consolidate()  # flush may return raw diffs
             else:
                 continue
             if not batch:

@@ -25,6 +25,8 @@ from __future__ import annotations
 import itertools
 from typing import Any, Callable, Iterable, Sequence
 
+import numpy as np
+
 from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
 from pathway_tpu.engine.device import VECTOR_THRESHOLD
 from pathway_tpu.engine.expression import EngineExpression, EvalContext
@@ -43,11 +45,54 @@ class Node:
         scope.nodes.append(self)
         self.consumers: list[tuple[Node, int]] = []
         self.pending: dict[int, list[DeltaBatch]] = {}
-        self.current: dict[Pointer, tuple] = {}
+        self._state: dict[Pointer, tuple] = {}
+        self._state_lag: list[DeltaBatch] = []
+        self._state_lag_rows = 0
         self.name: str = type(self).__name__
         self.trace: Any = None
         for port, inp in enumerate(self.inputs):
             inp.consumers.append((self, port))
+
+    # -- lazy state ---------------------------------------------------------
+    #
+    # A node's ``current`` (key -> row) is only needed when somebody
+    # actually observes it: a retraction arriving at this operator, a
+    # state-peeking consumer (zip/ix/update/restrict), a snapshot, a test.
+    # Differential dataflow pays for arrangements only where they exist;
+    # here output batches are deferred and applied on first read, so a
+    # bulk pipeline whose state is never inspected materialises no
+    # per-row dict entries at all. Deferred columnar batches are cheap
+    # (arrays); deferred row batches hold live tuples either way. The
+    # rows cap bounds memory for long streams whose state nobody reads.
+
+    _STATE_LAG_MAX_ROWS = 1 << 21
+
+    @property
+    def current(self) -> dict[Pointer, tuple]:
+        if self._state_lag:
+            lag, self._state_lag = self._state_lag, []
+            self._state_lag_rows = 0
+            for batch in lag:
+                # deferred batches may be raw (the scheduler no longer
+                # pre-consolidates); state application needs merged diffs
+                apply_batch_to_state(self._state, batch.consolidate())
+        return self._state
+
+    @current.setter
+    def current(self, value: dict[Pointer, tuple]) -> None:
+        self._state = value
+        self._state_lag = []
+        self._state_lag_rows = 0
+
+    def _defer_state(self, batch: DeltaBatch) -> None:
+        """Queue an output batch for lazy application to ``current``."""
+        if batch._preapplied:
+            batch._preapplied = False  # one producing-node apply only
+            return
+        self._state_lag.append(batch)
+        self._state_lag_rows += len(batch)
+        if self._state_lag_rows > self._STATE_LAG_MAX_ROWS:
+            self.current  # noqa: B018 — drain via the property
 
     # -- scheduler interface ------------------------------------------------
 
@@ -55,15 +100,29 @@ class Node:
         return bool(self.pending)
 
     def take(self, port: int) -> DeltaBatch:
+        return self.take_raw(port).consolidate()
+
+    def take_raw(self, port: int) -> DeltaBatch:
+        """Like :meth:`take` but without consolidation — for diff-linear
+        consumers (segment-sum groupby) that tolerate duplicate and
+        net-zero (key, row) entries."""
         batches = self.pending.pop(port, None)
         if not batches:
             return DeltaBatch()
         if len(batches) == 1:
-            return batches[0].consolidate()
+            return batches[0]
+        if all(b._entries is None for b in batches):
+            # stay columnar: concatenating arrays keeps the zero-PyObject
+            # path intact for the downstream segment consumer
+            from pathway_tpu.engine.batch import Columns
+
+            stacked = Columns.concat([b.columns for b in batches])
+            if stacked is not None:
+                return DeltaBatch.from_columns(stacked, consolidated=False)
         merged = DeltaBatch()
         for b in batches:
             merged.extend(b)
-        return merged.consolidate()
+        return merged
 
     def push(self, port: int, batch: DeltaBatch) -> None:
         if batch:
@@ -128,7 +187,7 @@ class StaticSource(Node):
         return DeltaBatch((k, r, 1) for k, r in self._rows)
 
     def process(self, time: int) -> DeltaBatch:
-        return self.take(0)
+        return self.take_raw(0)  # pass-through; consumers consolidate
 
 
 class InputSession(Node):
@@ -144,6 +203,7 @@ class InputSession(Node):
         self.upsert = upsert
         self._buffer: list[tuple[Pointer, tuple | None, int]] = []
         self._has_removals = False
+        self._has_rowless_removals = False
 
     def insert(self, key: Pointer, row: tuple) -> None:
         self._buffer.append((key, row, 1))
@@ -151,24 +211,34 @@ class InputSession(Node):
     def remove(self, key: Pointer, row: tuple | None = None) -> None:
         self._buffer.append((key, row, -1))
         self._has_removals = True
+        if row is None:
+            self._has_rowless_removals = True
 
     def flush(self) -> DeltaBatch | None:
         if not self._buffer:
             return None
-        if not self.upsert and not self._has_removals:
-            # dominant connector shape: plain inserts need no overlay logic
+        if not self.upsert and not self._has_rowless_removals:
+            # dominant connector shapes: plain inserts, or removals that
+            # carry their row — neither needs the per-row overlay (the
+            # overlay exists solely to resolve row-less removals against
+            # this commit's earlier updates and prior state)
             out = DeltaBatch(self._buffer)
             self._buffer = []
+            if not self._has_removals:
+                # cheap precheck (C): flags unique-key inserts, which the
+                # join/expression insert-only fast paths key off
+                out = out.consolidate()
             self._has_removals = False
-            return out.consolidate()
+            return out
         out = DeltaBatch()
+        state = self.current  # hoisted: property drains lazily-applied state
         # overlay of keys touched this commit: key -> row | None (absent row)
         overlay: dict[Pointer, tuple | None] = {}
 
         def effective(key: Pointer) -> tuple | None:
             if key in overlay:
                 return overlay[key]
-            return self.current.get(key)
+            return state.get(key)
 
         if self.upsert:
             for key, row, diff in self._buffer:
@@ -196,10 +266,13 @@ class InputSession(Node):
                 out.append(key, row, diff)  # type: ignore[arg-type]
         self._buffer.clear()
         self._has_removals = False
+        self._has_rowless_removals = False
         return out.consolidate()
 
     def process(self, time: int) -> DeltaBatch:
-        return self.take(0)
+        # pure pass-through: keep the batch raw so diff-linear consumers
+        # (columnar groupby) can skip consolidation entirely
+        return self.take_raw(0)
 
 
 class ExpressionNode(Node):
@@ -223,9 +296,10 @@ class ExpressionNode(Node):
         out = DeltaBatch()
         ctx = EvalContext()
         if not batch._insert_only:
+            state = self.current  # hoisted: drains lazy state once
             for key, row, diff in batch:
                 if diff < 0:
-                    prev = self.current.get(key)
+                    prev = state.get(key)
                     if prev is not None:
                         out.append(key, prev, diff)
         inserts = (
@@ -299,9 +373,10 @@ class BatchApplyNode(Node):
     def process(self, time: int) -> DeltaBatch:
         batch = self.take(0)
         out = DeltaBatch()
+        state = self.current  # hoisted: drains lazy state once
         for key, row, diff in batch:
             if diff < 0:
-                prev = self.current.get(key)
+                prev = state.get(key)
                 if prev is not None:
                     out.append(key, prev, diff)
         pending: list[tuple[Pointer, tuple, int]] = []
@@ -358,10 +433,11 @@ class FilterNode(Node):
                 out._insert_only = True
                 return out
         out = DeltaBatch()
+        state = self.current  # hoisted: drains lazy state once
         for key, row, diff in batch:
             if diff < 0:
-                if key in self.current:
-                    out.append(key, self.current[key], diff)
+                if key in state:
+                    out.append(key, state[key], diff)
                 continue
             cond = row[self.condition_col]
             if is_error(cond):
@@ -426,11 +502,10 @@ class KeyFilterNode(Node):
         assert mode in ("intersect", "subtract", "restrict")
         self.mode = mode
 
-    def _member(self, key: Pointer, exclude_port: int | None = None) -> bool:
-        others = self.inputs[1:]
+    def _member_in(self, key: Pointer, other_states: list[dict]) -> bool:
         if self.mode == "subtract":
-            return not any(key in o.current for o in others)
-        return all(key in o.current for o in others)
+            return not any(key in s for s in other_states)
+        return all(key in s for s in other_states)
 
     def process(self, time: int) -> DeltaBatch:
         source = self.inputs[0]
@@ -444,21 +519,25 @@ class KeyFilterNode(Node):
         handled: set[Pointer] = set()
         for key, row, diff in src_batch:
             handled.add(key)
+        # hoisted property reads: drain each lazy state once, not per row
+        state = self.current
+        others = [o.current for o in self.inputs[1:]]
+        src_state = source.current if affected else None
         # keys whose membership may flip (and are not already being updated)
         for key in affected - handled:
-            row = source.current.get(key)
-            was = key in self.current
-            now = row is not None and self._member(key)
+            row = src_state.get(key)
+            was = key in state
+            now = row is not None and self._member_in(key, others)
             if was and not now:
-                out.append(key, self.current[key], -1)
+                out.append(key, state[key], -1)
             elif not was and now and row is not None:
                 out.append(key, row, 1)
         for key, row, diff in src_batch:
             if diff < 0:
-                if key in self.current:
-                    out.append(key, self.current[key], -1)
+                if key in state:
+                    out.append(key, state[key], -1)
             else:
-                if self._member(key):
+                if self._member_in(key, others):
                     out.append(key, row, 1)
         return out.consolidate()
 
@@ -529,8 +608,9 @@ class ZipNode(InputMirrors, Node):
             for key, _row, _diff in batch:
                 affected.add(key)
         out = DeltaBatch()
+        state = self.current  # hoisted: drains lazy state once
         for key in affected:
-            old = self.current.get(key)
+            old = state.get(key)
             new = self._combined(key)
             if old is not None and rows_differ(old, new):
                 out.append(key, old, -1)
@@ -738,12 +818,329 @@ class JoinNode(Node):
         return out.consolidate()
 
 
+def _groupby_batch_arrays(
+    batch: DeltaBatch, by_col: int, sum_cols: Sequence[int]
+):
+    """Extract ``(by, diffs, sum value arrays)`` for a vectorized groupby
+    pass — shared by the columnar state machine and the degraded-mode
+    vectorized path so their cleanliness screens can never diverge.
+    Returns None whenever the batch is not cleanly columnar: mixed/object
+    dtypes, NaN group values (np.unique collapses NaNs while the row path
+    groups them by bit pattern), non-numeric sum columns."""
+    from pathway_tpu.engine import device
+    from pathway_tpu.native import kernels as _native
+
+    cols = batch.columns
+    if cols is not None:
+        by = cols.cols[by_col]
+        if by.dtype.kind not in "bifU":
+            return None
+        diffs = cols.diffs
+        getcol = lambda c: cols.cols[c]  # noqa: E731
+    else:
+        entries = batch.entries
+        view = device.ColumnarView(entries, from_entries=True)
+        by = view.column(by_col)
+        if by is None:
+            return None
+        if _native is not None:
+            diffs = _native.entry_diffs(entries)
+        else:
+            diffs = np.fromiter(
+                (d for _k, _r, d in entries), np.int64, len(entries)
+            )
+        getcol = view.column
+    if by.dtype.kind == "f" and np.isnan(by).any():
+        return None
+    vals = []
+    for c in sum_cols:
+        if c < 0:
+            vals.append(None)
+            continue
+        col = getcol(c)
+        if col is None or col.dtype.kind not in "bif":
+            return None
+        vals.append(col)
+    if diffs is None:
+        diffs = np.ones(len(by), np.int64)
+    return by, diffs, vals
+
+
+class _ColumnarGroups:
+    """Fully columnar group state for single-by-column count/sum groupbys.
+
+    Replaces the per-group Python objects (dict entry + reducer states +
+    tuple rebuilds) with flat arrays: ``member`` (signed multiplicity) and
+    one accumulator array per sum reducer, indexed by a dense group id.
+    A streaming delta commit then costs one ``np.unique`` + segment
+    reductions + O(touched groups) array math — the reference's semigroup
+    reducer update (src/engine/reduce.rs:78) at NumPy speed.
+
+    Any batch the arrays cannot represent exactly (mixed/object dtypes,
+    NaN group values, ERROR cells, int64 overflow risk) makes the owner
+    degrade to the dict-of-states row path BEFORE any mutation, via
+    :meth:`materialize`.
+    """
+
+    __slots__ = (
+        "by_col",
+        "kinds",
+        "sum_cols",
+        "index",
+        "by_raw",
+        "gkeys",
+        "member",
+        "accs",
+        "size",
+    )
+
+    _CAP0 = 1024
+
+    def __init__(
+        self, by_col: int, reducers: Sequence[tuple[Reducer, Sequence[int]]]
+    ) -> None:
+        from pathway_tpu.engine.reducers import ReducerKind
+
+        self.by_col = by_col
+        self.kinds = [r.kind for r, _c in reducers]
+        self.sum_cols = [
+            cols[0] if r.kind == ReducerKind.SUM else -1
+            for r, cols in reducers
+        ]
+        self.index: dict[Any, int] = {}  # normalised by-value -> group id
+        self.by_raw: list[Any] = []  # first-seen raw by-value per group
+        self.gkeys: list[Pointer] = []
+        self.member = np.zeros(self._CAP0, np.int64)
+        self.accs: list[np.ndarray | None] = [
+            np.zeros(self._CAP0, np.int64) if c >= 0 else None
+            for c in self.sum_cols
+        ]
+        self.size = 0
+
+    @staticmethod
+    def _norm(v: Any) -> Any:
+        """Group-identity key matching hash_values equivalence: bools are
+        tagged apart from ints, int-valued floats collapse onto ints."""
+        if isinstance(v, bool):
+            return ("\x01b", v)
+        if isinstance(v, float) and -(2**63) < v < 2**63 and v == int(v):
+            return int(v)
+        return v
+
+    def _grow(self, need: int) -> None:
+        cap = len(self.member)
+        if need <= cap:
+            return
+        while cap < need:
+            cap *= 2
+        member = np.zeros(cap, np.int64)
+        member[: self.size] = self.member[: self.size]
+        self.member = member
+        for i, acc in enumerate(self.accs):
+            if acc is not None:
+                grown = np.zeros(cap, acc.dtype)
+                grown[: self.size] = acc[: self.size]
+                self.accs[i] = grown
+
+    def _batch_arrays(self, batch: DeltaBatch):
+        """(by, diffs, sum value arrays) or None when not cleanly columnar."""
+        return _groupby_batch_arrays(batch, self.by_col, self.sum_cols)
+
+    def process_batch(self, batch: DeltaBatch, node: "GroupbyNode"):
+        """Apply one delta batch; returns the output DeltaBatch, or None to
+        signal degradation (state untouched)."""
+        from pathway_tpu.engine import device
+        from pathway_tpu.engine.batch import Columns
+        from pathway_tpu.engine.reducers import ReducerKind
+
+        got = self._batch_arrays(batch)
+        if got is None:
+            return None
+        by, diffs, vals = got
+        n = len(by)
+        if n == 0:
+            return DeltaBatch()
+        dmax = int(np.abs(diffs).max()) if n else 0
+        if dmax < 0:  # abs(INT64_MIN) wraps
+            return None
+        for col in vals:
+            if col is not None and device.int_sum_overflow_risk(col, n, dmax):
+                return None
+        uniq, inverse = np.unique(by, return_inverse=True)
+        raws = uniq.tolist()
+        nu = len(raws)
+        gdiffs = device.segment_count(inverse, diffs, nu)
+        deltas: list[np.ndarray | None] = []
+        for ri, col in enumerate(vals):
+            if col is None:
+                deltas.append(None)
+            else:
+                deltas.append(device.segment_sum(inverse, col, diffs, nu))
+        # resolve group ids (creating new groups), all before mutation
+        index = self.index
+        gis = np.empty(nu, np.int64)
+        created: list[int] = []
+        for i, raw in enumerate(raws):
+            k = self._norm(raw)
+            gi = index.get(k)
+            if gi is None:
+                gi = self.size
+                self._grow(gi + 1)
+                index[k] = gi
+                self.by_raw.append(raw)
+                self.gkeys.append(hash_values((raw,), salt=b"groupby"))
+                self.size = gi + 1
+                created.append(i)
+            gis[i] = gi
+        # int64 accumulator headroom: degrade before any mutation
+        for ri, delta in enumerate(deltas):
+            if delta is None:
+                continue
+            acc = self.accs[ri]
+            if acc.dtype.kind == "i" and delta.dtype.kind != "f":
+                amax_acc = int(np.abs(acc[gis]).max(initial=0))
+                amax_d = int(np.abs(delta).max(initial=0))
+                if amax_acc < 0 or amax_acc + amax_d > (1 << 62):
+                    for i in created:  # roll back group creation
+                        del index[self._norm(raws[i])]
+                    del self.by_raw[self.size - len(created) :]
+                    del self.gkeys[self.size - len(created) :]
+                    self.size -= len(created)
+                    return None
+        for ri, delta in enumerate(deltas):
+            if delta is None:
+                continue
+            if delta.dtype.kind == "f" and self.accs[ri].dtype.kind == "i":
+                # float contributions arrive: upcast like Python int+float
+                self.accs[ri] = self.accs[ri].astype(np.float64)
+        old_member = self.member[gis].copy()
+        old_accs = [
+            self.accs[ri][gis].copy() if d is not None else None
+            for ri, d in enumerate(deltas)
+        ]
+        self.member[gis] = old_member + gdiffs
+        for ri, delta in enumerate(deltas):
+            if delta is None:
+                continue
+            acc = self.accs[ri]
+            acc[gis] = acc[gis] + delta.astype(acc.dtype, copy=False)
+        new_member = self.member[gis]
+        for i in np.flatnonzero(new_member <= 0).tolist():
+            index.pop(self._norm(raws[i]), None)
+        # a group emits only when its VISIBLE row changes (matching the row
+        # path's old_row != new_row guard): membership flips always count;
+        # count columns change with member, sum columns with the stored acc
+        # (post-rounding — a float delta swallowed by rounding emits nothing)
+        changed = (old_member > 0) != (new_member > 0)
+        for ri, kind in enumerate(self.kinds):
+            if kind == ReducerKind.COUNT:
+                changed |= old_member != new_member
+            else:
+                changed |= old_accs[ri] != self.accs[ri][gis]
+        m_old = (old_member > 0) & changed
+        m_new = (new_member > 0) & changed
+        n_out = int(m_old.sum()) + int(m_new.sum())
+        if n_out == 0:
+            self._maybe_compact()
+            return DeltaBatch()
+        gkeys = self.gkeys
+        by_raw = self.by_raw
+
+        def block(mask, member_vals, acc_vals):
+            sel = np.flatnonzero(mask)
+            sel_g = gis[sel].tolist()
+            kobjs = list(map(gkeys.__getitem__, sel_g))
+            byv = np.empty(len(sel_g), object)
+            byv[:] = list(map(by_raw.__getitem__, sel_g))
+            cols = [byv]
+            for ri, kind in enumerate(self.kinds):
+                if kind == ReducerKind.COUNT:
+                    cols.append(member_vals[sel])
+                else:
+                    cols.append(acc_vals[ri][sel])
+            return kobjs, cols
+
+        ko_old, cols_old = block(m_old, old_member, old_accs)
+        new_accs = [
+            self.accs[ri][gis] if d is not None else None
+            for ri, d in enumerate(deltas)
+        ]
+        ko_new, cols_new = block(m_new, new_member, new_accs)
+        kobjs = ko_old + ko_new
+        out_cols = [
+            np.concatenate([a, b]) for a, b in zip(cols_old, cols_new)
+        ]
+        out_diffs = np.concatenate(
+            [
+                np.full(len(ko_old), -1, np.int64),
+                np.ones(len(ko_new), np.int64),
+            ]
+        )
+        payload = Columns(
+            len(kobjs), out_cols, kobjs=kobjs, diffs=out_diffs
+        )
+        self._maybe_compact()
+        return DeltaBatch.from_columns(payload, consolidated=True)
+
+    def _maybe_compact(self) -> None:
+        """Reclaim array slots of dead groups (index entry popped, slot
+        orphaned). Group-key churn otherwise grows state without bound;
+        the row path's dict ``del`` frees dead groups eagerly."""
+        live = len(self.index)
+        if self.size <= 4096 or self.size <= 2 * live:
+            return
+        order = sorted(self.index.items(), key=lambda kv: kv[1])
+        old_gis = np.fromiter((gi for _k, gi in order), np.int64, live)
+        self.by_raw = [self.by_raw[gi] for gi in old_gis]
+        self.gkeys = [self.gkeys[gi] for gi in old_gis]
+        member = np.zeros(max(self._CAP0, len(self.member) // 2), np.int64)
+        while len(member) < live:
+            member = np.zeros(len(member) * 2, np.int64)
+        member[:live] = self.member[old_gis]
+        self.member = member
+        for ri, acc in enumerate(self.accs):
+            if acc is None:
+                continue
+            grown = np.zeros(len(member), acc.dtype)
+            grown[:live] = acc[old_gis]
+            self.accs[ri] = grown
+        self.index = {k: i for i, (k, _gi) in enumerate(order)}
+        self.size = live
+
+    def materialize(self, node: "GroupbyNode") -> dict[Pointer, list[Any]]:
+        """Convert to the row path's dict-of-states form (degradation)."""
+        from pathway_tpu.engine.reducers import ReducerKind
+
+        groups: dict[Pointer, list[Any]] = {}
+        for k, gi in self.index.items():
+            raw = self.by_raw[gi]
+            by_vals = (raw,)
+            states = []
+            for ri, (reducer, _cols) in enumerate(node.reducers):
+                state = reducer.make_state()
+                state.count = int(self.member[gi])
+                if reducer.kind == ReducerKind.SUM:
+                    acc = self.accs[ri][gi]
+                    state.acc = (
+                        int(acc) if acc.dtype.kind == "i" else float(acc)
+                    )
+                states.append(state)
+            gkey = self.gkeys[gi]
+            groups[gkey] = [by_vals, states, int(self.member[gi])]
+            node._gkey_cache[(tuple(map(type, by_vals)), by_vals)] = gkey
+        return groups
+
+
 class GroupbyNode(Node):
     """Group-by with engine reducers.
 
     Output row layout: grouping values, then one value per reducer; the group
     id is ``ref_scalar(*grouping values)`` unless ``set_id`` names a pointer
     column to use directly (reference: group_by_table python_api.rs:2922).
+
+    Single-by-column count/sum groupbys hold their state in
+    :class:`_ColumnarGroups` arrays until a batch requires exact row-wise
+    semantics; then the state degrades (once) to the dict-of-states form.
     """
 
     STATE_ATTRS = ("groups",)
@@ -756,12 +1153,24 @@ class GroupbyNode(Node):
         reducers: Sequence[tuple[Reducer, Sequence[int]]],
         set_id: bool = False,
     ) -> None:
+        from pathway_tpu.engine.reducers import ReducerKind
+
         super().__init__(scope, [source], len(by_cols) + len(reducers))
         self.by_cols = list(by_cols)
         self.reducers = list(reducers)
         self.set_id = set_id
         # gkey -> [by_vals, [reducer states], membership count]
-        self.groups: dict[Pointer, list[Any]] = {}
+        self._groups: dict[Pointer, list[Any]] = {}
+        self._cg: _ColumnarGroups | None = None
+        if (
+            not set_id
+            and len(by_cols) == 1
+            and all(
+                r.kind in (ReducerKind.COUNT, ReducerKind.SUM)
+                for r, _c in reducers
+            )
+        ):
+            self._cg = _ColumnarGroups(by_cols[0], reducers)
         # (types, by_vals) -> gkey: a streaming workload touches the same
         # groups commit after commit — the blake2b derivation dominated
         # the incremental-update bench at ~1024 touched groups x 100
@@ -769,6 +1178,27 @@ class GroupbyNode(Node):
         # equality is coarser than the type-tagged digest (True == 1 but
         # hash_values distinguishes them).
         self._gkey_cache: dict[tuple, Pointer] = {}
+
+    @property
+    def groups(self) -> dict[Pointer, list[Any]]:
+        if self._cg is not None:
+            self._groups = self._cg.materialize(self)
+            self._cg = None
+        return self._groups
+
+    @groups.setter
+    def groups(self, value: dict[Pointer, list[Any]]) -> None:
+        self._groups = value
+        self._cg = None
+
+    def op_state(self) -> dict:
+        # snapshots (operator persistence) must not degrade the columnar
+        # state: materialise a dict VIEW for the snapshot, keep _cg live
+        state = {"current": dict(self.current)}
+        state["groups"] = (
+            self._cg.materialize(self) if self._cg is not None else self._groups
+        )
+        return state
 
     def _group_key(self, by_vals: tuple) -> Pointer:
         if self.set_id:
@@ -804,41 +1234,25 @@ class GroupbyNode(Node):
         for reducer, cols in self.reducers:
             if reducer.kind not in (ReducerKind.COUNT, ReducerKind.SUM):
                 return None
-        import numpy as np
-
-        entries = batch.entries
-        view = device.ColumnarView(entries, from_entries=True)
-        by = view.column(self.by_cols[0])
-        if by is None:
+        sum_col_idx = [
+            cols[0] if r.kind == ReducerKind.SUM else -1
+            for r, cols in self.reducers
+        ]
+        got = _groupby_batch_arrays(batch, self.by_cols[0], sum_col_idx)
+        if got is None:
+            return None
+        by, diffs, vals = got
+        n = len(by)
+        dmax = int(np.abs(diffs).max()) if n else 0
+        if dmax < 0:  # abs(INT64_MIN) wraps
             return None
         sum_arrays: dict[int, Any] = {}
-        for ri, (reducer, cols) in enumerate(self.reducers):
-            if reducer.kind == ReducerKind.SUM:
-                col = view.column(cols[0])
-                if col is None or col.dtype.kind not in "bif":
-                    return None  # non-numeric sums keep row-wise semantics
-                sum_arrays[ri] = col
-        from pathway_tpu.native import kernels as _native
-
-        if _native is not None:
-            diffs = _native.entry_diffs(entries)
-        else:
-            diffs = np.fromiter(
-                (d for _k, _r, d in entries), np.int64, len(entries)
-            )
-        if sum_arrays and len(entries):
-            # int64 segment sums wrap silently while the row-wise path
-            # computes exact Python ints; reject batches whose worst-case
-            # |group sum| <= max|v| * n * max|diff| could leave int64.
-            dmax = int(np.abs(diffs).max())
-            for col in sum_arrays.values():
-                if col.dtype.kind != "i":
-                    continue
-                amax = int(np.abs(col).max())
-                if amax < 0 or dmax < 0:  # abs(INT64_MIN) wraps
-                    return None
-                if amax * len(entries) * dmax > (1 << 62):
-                    return None
+        for ri, col in enumerate(vals):
+            if col is None:
+                continue
+            if device.int_sum_overflow_risk(col, n, dmax):
+                return None
+            sum_arrays[ri] = col
         uniques, inverse = device.factorize(by)
         n_groups = len(uniques)
         gdiffs = device.segment_count(inverse, diffs, n_groups)
@@ -889,7 +1303,19 @@ class GroupbyNode(Node):
         return out.consolidate()
 
     def process(self, time: int) -> DeltaBatch:
-        batch = self.take(0)
+        if self._cg is not None:
+            # segment sums are diff-linear: duplicate / net-zero entries
+            # contribute exactly their diff, so skip consolidation
+            batch = self.take_raw(0)
+            out = self._cg.process_batch(batch, self)
+            if out is not None:
+                return out
+            # this batch needs exact row semantics: degrade the columnar
+            # state to dict-of-states (once) and fall through
+            self.groups  # noqa: B018 — property materialises + clears _cg
+            batch = batch.consolidate()
+        else:
+            batch = self.take(0)
         if len(batch) >= VECTOR_THRESHOLD:
             fast = self._process_columnar(batch)
             if fast is not None:
@@ -1167,9 +1593,10 @@ class IxNode(InputMirrors, Node):
         handled: set[Pointer] = set()
         for key, row, diff in keys_batch:
             handled.add(key)
+        state = self.current  # hoisted: drains lazy state once
         for skey in affected_src:
             for ikey in self.reverse.get(skey, set()) - handled:
-                old = self.current.get(ikey)
+                old = state.get(ikey)
                 new = self._lookup(ikey, self.forward.get(ikey))
                 if old is not None and rows_differ(old, new):
                     out.append(ikey, old, -1)
@@ -1178,8 +1605,8 @@ class IxNode(InputMirrors, Node):
         # Input-side changes
         for key, row, diff in keys_batch:
             if diff < 0:
-                if key in self.current:
-                    out.append(key, self.current[key], -1)
+                if key in state:
+                    out.append(key, state[key], -1)
                 skey = self.forward.pop(key, None)
                 if skey is not None:
                     self.reverse.get(skey, set()).discard(key)
@@ -1191,8 +1618,8 @@ class IxNode(InputMirrors, Node):
             if skey is not None and not isinstance(skey, Pointer):
                 self.report(key, f"ix key must be a pointer, got {skey!r}")
                 continue
-            if key in self.current:
-                out.append(key, self.current[key], -1)
+            if key in state:
+                out.append(key, state[key], -1)
             if skey is not None:
                 self.forward[key] = skey
                 self.reverse.setdefault(skey, set()).add(key)
@@ -1226,8 +1653,9 @@ class UpdateRowsNode(InputMirrors, Node):
             for key, _row, _diff in batch:
                 affected.add(key)
         out = DeltaBatch()
+        state = self.current  # hoisted: drains lazy state once
         for key in affected:
-            old = self.current.get(key)
+            old = state.get(key)
             new = self._effective(key)
             if old is not None and rows_differ(old, new):
                 out.append(key, old, -1)
@@ -1271,8 +1699,9 @@ class UpdateCellsNode(InputMirrors, Node):
             for key, _row, _diff in batch:
                 affected.add(key)
         out = DeltaBatch()
+        state = self.current  # hoisted: drains lazy state once
         for key in affected:
-            old = self.current.get(key)
+            old = state.get(key)
             new = self._effective(key)
             if old is not None and rows_differ(old, new):
                 out.append(key, old, -1)
@@ -1554,10 +1983,11 @@ class _RemoveErrorsNode(Node):
     def process(self, time: int) -> DeltaBatch:
         batch = self.take(0)
         out = DeltaBatch()
+        state = self.current  # hoisted: drains lazy state once
         for key, row, diff in batch:
             if diff < 0:
-                if key in self.current:
-                    out.append(key, self.current[key], -1)
+                if key in state:
+                    out.append(key, state[key], -1)
                 continue
             if any(is_error(v) for v in row):
                 continue
@@ -1638,18 +2068,32 @@ class Scheduler:
                 out = node.process(time)
                 if out is None:
                     out = DeltaBatch()
-                out = out.consolidate() if out else out
-                apply_batch_to_state(node.current, out)
+                # no eager consolidation: consumers consolidate in take()
+                # (cached), lazy state drain consolidates before applying
+                node._defer_state(out)
                 if probe:
                     st = self._stats_of(node)
                     st.time_spent += _walltime.perf_counter() - t0
                     st.batches += 1
                     st.last_time = time
-                    for _k, _r, d in out:
-                        if d > 0:
-                            st.insertions += 1
+                    cols = out.columns
+                    if cols is not None:
+                        # count from the diff vector — don't materialise
+                        # rows just for monitoring
+                        if cols.diffs is None:
+                            st.insertions += cols.n
                         else:
-                            st.deletions += 1
+                            pos = int((cols.diffs > 0).sum())
+                            st.insertions += pos
+                            st.deletions += cols.n - pos
+                    else:
+                        # consolidate for counting: raw batches may carry
+                        # net-zero churn that monitoring should not report
+                        for _k, _r, d in out.consolidate():
+                            if d > 0:
+                                st.insertions += 1
+                            else:
+                                st.deletions += 1
                 if out:
                     for consumer, port in node.consumers:
                         consumer.push(port, out)
@@ -1735,11 +2179,12 @@ class RecomputeNode(Node):
             self.report(None, f"row transformer error: {e!r}")
             return DeltaBatch()
         out = DeltaBatch()
-        for key, row in self.current.items():
+        state = self.current  # hoisted: drains lazy state once
+        for key, row in state.items():
             if rows_differ(new.get(key), row):
                 out.append(key, row, -1)
         for key, row in new.items():
-            if rows_differ(self.current.get(key), row):
+            if rows_differ(state.get(key), row):
                 out.append(key, row, 1)
         return out.consolidate()
 

@@ -271,7 +271,8 @@ class ShardedScheduler:
                 elif isinstance(node, InputSession):
                     batch = node.flush()
                     if batch:
-                        self._route_source(node, batch)
+                        # flush may return raw diffs; routing applies state
+                        self._route_source(node, batch.consolidate())
         time = self.time
         self.propagate(time)
         self.time += 1

@@ -123,8 +123,14 @@ class BufferNode(Node):
         merged = super().take(port)
         extra = self.pending.pop(-1, None)
         if extra:
+            # NEVER extend the taken batch in place: take() may hand back
+            # the producer's own batch object (or its consolidate cache),
+            # still aliased by sibling consumers' pending queues and the
+            # producer's deferred state lag
+            out = DeltaBatch(merged.entries)
             for b in extra:
-                merged.extend(b)
+                out.extend(b)
+            return out
         return merged
 
 

@@ -1,0 +1,299 @@
+"""Columnar engine hot path: ColumnarGroups state, Columns payloads,
+lazy node state, raw-batch scheduling.
+
+These tests pin the columnar fast paths to the row interpreter's exact
+semantics (the contract: vectorization must be unobservable except in
+speed). Reference behaviors: reducer semantics src/engine/reduce.rs:78,
+consolidation src/engine/dataflow.rs (consolidate_for_output).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine import (
+    ReducerKind,
+    Scheduler,
+    Scope,
+    make_reducer,
+    ref_scalar,
+)
+from pathway_tpu.engine.batch import Columns, DeltaBatch
+
+
+def _groupby_scope(reducer_specs, row_wise=False):
+    scope = Scope()
+    sess = scope.input_session(2)
+    gb = scope.group_by_table(
+        sess,
+        by_cols=[0],
+        reducers=[(make_reducer(k), cols) for k, cols in reducer_specs],
+    )
+    if row_wise:
+        gb._cg = None
+    log: list = []
+    scope.subscribe_table(
+        gb, on_change=lambda k, r, t, d: log.append((k, r, d))
+    )
+    return scope, sess, gb, log
+
+
+class TestColumnarGroups:
+    def test_randomized_equivalence_with_row_path(self):
+        """Final states and per-commit net effects match the row path over
+        a randomized insert/retract stream (both modes, same ops)."""
+        rng = random.Random(11)
+        live: dict = {}
+        ops = []
+        for _ in range(25):
+            commit = []
+            for _ in range(rng.randint(1, 60)):
+                if live and rng.random() < 0.3:
+                    key = rng.choice(list(live))
+                    commit.append(("-", key, live.pop(key)))
+                else:
+                    key = ref_scalar(("k", rng.randint(0, 10**9)))
+                    row = (rng.randint(0, 7), float(rng.randint(-9, 9)))
+                    live[key] = row
+                    commit.append(("+", key, row))
+            ops.append(commit)
+
+        def run(row_wise):
+            scope, sess, gb, log = _groupby_scope(
+                [(ReducerKind.SUM, [1]), (ReducerKind.COUNT, [])],
+                row_wise=row_wise,
+            )
+            sched = Scheduler(scope)
+            for commit in ops:
+                for op, key, row in commit:
+                    (sess.insert if op == "+" else sess.remove)(key, row)
+                sched.commit()
+            return dict(gb.current)
+
+        assert run(False) == run(True)
+
+    def test_no_spurious_emission_when_row_unchanged(self):
+        """SUM-only groupby: inserting a zero contribution into an existing
+        group changes membership but not the visible row — nothing may be
+        emitted (the row path's old_row != new_row guard)."""
+        scope, sess, gb, log = _groupby_scope([(ReducerKind.SUM, [1])])
+        sched = Scheduler(scope)
+        sess.insert(ref_scalar(1), (5, 7.0))
+        sched.commit()
+        assert gb._cg is not None
+        log.clear()
+        sess.insert(ref_scalar(2), (5, 0.0))  # zero delta, same group
+        sched.commit()
+        assert log == [], log
+        # and the state still reflects both rows' membership
+        sess.remove(ref_scalar(1), (5, 7.0))
+        sched.commit()
+        rows = list(gb.current.values())
+        assert rows == [(5, 0.0)], rows
+
+    def test_float_rounding_swallowed_delta_emits_nothing(self):
+        scope, sess, gb, log = _groupby_scope([(ReducerKind.SUM, [1])])
+        sched = Scheduler(scope)
+        sess.insert(ref_scalar(1), (1, 1e18))
+        sched.commit()
+        log.clear()
+        sess.insert(ref_scalar(2), (1, 1.0))  # swallowed by float rounding
+        sched.commit()
+        assert log == [], log
+
+    def test_dead_group_slots_compact(self):
+        """Churning group keys must not grow columnar state unboundedly."""
+        scope, sess, gb, log = _groupby_scope([(ReducerKind.COUNT, [])])
+        sched = Scheduler(scope)
+        for wave in range(20):
+            keys = [
+                (ref_scalar((wave, i)), (wave * 1000 + i, 0.0))
+                for i in range(500)
+            ]
+            for k, r in keys:
+                sess.insert(k, r)
+            sched.commit()
+            for k, r in keys:
+                sess.remove(k, r)
+            sched.commit()
+        cg = gb._cg
+        assert cg is not None
+        assert cg.size <= 4096, cg.size
+        assert len(gb.current) == 0
+
+    def test_snapshot_does_not_degrade_columnar_state(self):
+        scope, sess, gb, log = _groupby_scope(
+            [(ReducerKind.SUM, [1]), (ReducerKind.COUNT, [])]
+        )
+        sched = Scheduler(scope)
+        for i in range(400):
+            sess.insert(ref_scalar(i), (i % 3, float(i)))
+        sched.commit()
+        state = gb.op_state()
+        assert gb._cg is not None  # snapshot did not degrade
+        assert len(state["groups"]) == 3
+        # restored state runs the dict path and stays correct
+        scope2, sess2, gb2, _ = _groupby_scope(
+            [(ReducerKind.SUM, [1]), (ReducerKind.COUNT, [])]
+        )
+        gb2.restore_op_state(state)
+        sched2 = Scheduler(scope2)
+        sess2.insert(ref_scalar("x"), (0, 10.0))
+        sched2.commit()
+        got = {r[0]: (r[1], r[2]) for r in gb2.current.values()}
+        exp_sum = sum(float(i) for i in range(400) if i % 3 == 0) + 10.0
+        assert got[0] == (exp_sum, 135)
+
+    def test_bool_int_group_identity_matches_row_path(self):
+        for row_wise in (False, True):
+            scope, sess, gb, _ = _groupby_scope(
+                [(ReducerKind.COUNT, [])], row_wise=row_wise
+            )
+            sched = Scheduler(scope)
+            for i in range(300):
+                sess.insert(ref_scalar(("b", i)), (True, 0.0))
+            sched.commit()
+            for i in range(300):
+                sess.insert(ref_scalar(("i", i)), (1, 0.0))
+            sched.commit()
+            for i in range(300):
+                sess.insert(ref_scalar(("f", i)), (1.0, 0.0))
+            sched.commit()
+            rows = sorted((repr(r[0]), r[1]) for r in gb.current.values())
+            assert rows == [("1", 600), ("True", 300)], (row_wise, rows)
+
+    def test_nan_group_values_degrade(self):
+        scope, sess, gb, _ = _groupby_scope([(ReducerKind.COUNT, [])])
+        sched = Scheduler(scope)
+        for i in range(300):
+            sess.insert(ref_scalar(i), (float("nan"), 0.0))
+        sched.commit()
+        assert gb._cg is None  # degraded rather than guessing NaN identity
+        assert sum(r[1] for r in gb.current.values()) == 300
+
+    def test_int64_overflow_risk_degrades_exactly(self):
+        scope, sess, gb, _ = _groupby_scope([(ReducerKind.SUM, [1])])
+        sched = Scheduler(scope)
+        big = (1 << 62) - 1
+        for i in range(300):
+            sess.insert(ref_scalar(i), (1, big))
+        sched.commit()
+        got = [r for r in gb.current.values()]
+        assert got == [(1, 300 * big)], got  # exact Python bigint
+
+
+class TestColumnsPayload:
+    def test_concat_keeps_layout_and_rejects_dtype_mixes(self):
+        a = Columns(
+            2,
+            [np.array([1, 2]), np.array([1.5, 2.5])],
+            kobjs=[ref_scalar(1), ref_scalar(2)],
+        )
+        b = Columns(
+            1,
+            [np.array([3]), np.array([3.5])],
+            kobjs=[ref_scalar(3)],
+        )
+        c = Columns.concat([a, b])
+        assert c.n == 3
+        assert c.cols[0].tolist() == [1, 2, 3]
+        mixed = Columns(1, [np.array([1.0]), np.array([1.0])], kobjs=[ref_scalar(4)])
+        assert Columns.concat([a, mixed]) is None  # int64 vs float64 col 0
+
+    def test_key_views_roundtrip(self):
+        keys = [ref_scalar(i) for i in range(5)]
+        c = Columns(5, [np.arange(5)], kobjs=keys)
+        kb = c.kbytes()
+        assert kb.shape == (5, 16)
+        c2 = Columns(5, [np.arange(5)], kbytes=kb)
+        assert c2.kobjs() == keys
+
+    def test_entries_materialisation_types(self):
+        keys = [ref_scalar(i) for i in range(3)]
+        c = Columns(
+            3,
+            [np.array([1, 2, 3]), np.array(["a", "b", "c"])],
+            kobjs=keys,
+            diffs=np.array([1, -1, 2], np.int64),
+        )
+        batch = DeltaBatch.from_columns(c, consolidated=True)
+        entries = batch.entries
+        assert entries == [
+            (keys[0], (1, "a"), 1),
+            (keys[1], (2, "b"), -1),
+            (keys[2], (3, "c"), 2),
+        ]
+        assert all(type(e[1][0]) is int for e in entries)
+
+
+class TestSharedBatchAliasing:
+    def test_buffer_end_flush_does_not_mutate_shared_batches(self):
+        """BufferNode.take must not extend a taken batch in place: take()
+        can return the producer's own batch object (or its consolidate
+        cache), still aliased by sibling consumers and by the producer's
+        deferred state lag. Regression: a fan-out source -> {buffer with
+        flush_on_end, groupby} double-counted the buffer's end-flush rows
+        at the sibling."""
+        from pathway_tpu.engine.temporal import BufferNode
+
+        scope = Scope()
+        sess = scope.input_session(3)  # (threshold, time, group)
+        b1 = BufferNode(scope, sess, threshold_col=0, time_col=1)
+        b2 = BufferNode(scope, b1, threshold_col=0, time_col=1)
+        gb = scope.group_by_table(
+            b1,  # sibling consumer of b1's output, next to b2
+            by_cols=[2],
+            reducers=[(make_reducer(ReducerKind.COUNT), [])],
+        )
+        sched = Scheduler(scope)
+        # H: b1 holds it (threshold 99 > watermark 9) until end-flush
+        sess.insert(ref_scalar("H"), (99, 9, "g"))
+        sched.commit()
+        # R: b1 emits (5 <= 9) but b2 holds (b2's watermark is only 1 —
+        # the watermark-driving row H never reached it); gb counts R now
+        sess.insert(ref_scalar("R"), (5, 1, "g"))
+        sched.commit()
+        assert dict(b2.held), "precondition: b2 must hold R at end"
+        # at finish, b1's end-flush batch [H] fans out to b2 and gb; b2's
+        # own end-flush of R must NOT be spliced into that shared object
+        sched.finish()
+        counts = {r[0]: r[1] for r in gb.current.values()}
+        assert counts == {"g": 2}, counts  # H + R once each, R not doubled
+    def test_state_drains_on_read_and_caps(self):
+        scope = Scope()
+        sess = scope.input_session(1)
+        sched = Scheduler(scope)
+        for i in range(100):
+            sess.insert(ref_scalar(i), (i,))
+        sched.commit()
+        assert sess._state_lag  # deferred, nothing observed yet
+        assert len(sess.current) == 100  # drain on read
+        assert not sess._state_lag
+
+    def test_retraction_after_deferred_state(self):
+        """An operator reading its own current for retraction handling sees
+        all earlier deferred batches."""
+        scope = Scope()
+        sess = scope.input_session(2)
+        ex_node = scope.expression_table(sess, [])
+        from pathway_tpu.engine import expression as ex
+
+        filt_in = scope.expression_table(
+            sess,
+            [
+                ex.ColumnRef(0),
+                ex.Binary(">", ex.ColumnRef(1), ex.Const(0.0)),
+            ],
+        )
+        filt = scope.filter_table(filt_in, 1)
+        sched = Scheduler(scope)
+        for i in range(50):
+            sess.insert(ref_scalar(i), (i, float(i % 2) - 0.5))
+        sched.commit()
+        sess.remove(ref_scalar(1), (1, 0.5))
+        sched.commit()
+        kept = sorted(r[0] for r in filt.current.values())
+        assert kept == [i for i in range(50) if i % 2 == 1 and i != 1]

@@ -236,10 +236,10 @@ class TestPerf:
         n = 200_000
         rows = [(k(i), (i % 512, float(i))) for i in range(n)]
 
-        def run_once():
+        def run_once(row_wise=False):
             scope = Scope()
             sess = scope.input_session(2)
-            scope.group_by_table(
+            gb = scope.group_by_table(
                 sess,
                 by_cols=[0],
                 reducers=[
@@ -247,6 +247,8 @@ class TestPerf:
                     (make_reducer(ReducerKind.COUNT), []),
                 ],
             )
+            if row_wise:
+                gb._cg = None  # disable the columnar group state
             sched = Scheduler(scope)
             for key, row in rows:
                 sess.insert(key, row)
@@ -258,7 +260,7 @@ class TestPerf:
         old = graph_mod.VECTOR_THRESHOLD
         graph_mod.VECTOR_THRESHOLD = 1 << 60  # force row-wise
         try:
-            t_slow = min(run_once() for _ in range(2))
+            t_slow = min(run_once(row_wise=True) for _ in range(2))
         finally:
             graph_mod.VECTOR_THRESHOLD = old
         assert t_slow / t_fast > 2.5, (t_slow, t_fast)
