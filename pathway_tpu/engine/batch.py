@@ -44,7 +44,7 @@ class Columns:
     is ``None`` when every diff is +1.
     """
 
-    __slots__ = ("n", "_kbytes", "_kobjs", "cols", "diffs")
+    __slots__ = ("n", "_kbytes", "_kobjs", "_kb_thunk", "cols", "diffs")
 
     def __init__(
         self,
@@ -53,11 +53,15 @@ class Columns:
         kbytes: np.ndarray | None = None,
         kobjs: Sequence[Pointer] | None = None,
         diffs: np.ndarray | None = None,
+        kb_thunk: "Any | None" = None,
     ) -> None:
-        assert kbytes is not None or kobjs is not None
+        assert kbytes is not None or kobjs is not None or kb_thunk is not None
         self.n = n
         self._kbytes = kbytes
         self._kobjs = list(kobjs) if kobjs is not None else None
+        #: lazy key derivation (e.g. the join's vectorized BLAKE2b pair
+        #: hash): runs only when somebody actually observes the keys
+        self._kb_thunk = kb_thunk
         self.cols = list(cols)
         self.diffs = diffs
 
@@ -66,23 +70,27 @@ class Columns:
     def kbytes(self) -> np.ndarray:
         """Keys as a C-contiguous (n, 16) uint8 little-endian matrix."""
         if self._kbytes is None:
+            if self._kb_thunk is not None:
+                self._kbytes = self._kb_thunk()
+                self._kb_thunk = None
+                return self._kbytes
+            kb = None
             if _native is not None and hasattr(_native, "pointers_to_bytes"):
-                self._kbytes = _native.pointers_to_bytes(self._kobjs)
-            else:
+                kb = _native.pointers_to_bytes(self._kobjs)
+            if kb is None:
                 buf = b"".join(
                     int(k).to_bytes(16, "little") for k in self._kobjs
                 )
-                self._kbytes = np.frombuffer(buf, np.uint8).reshape(
-                    self.n, 16
-                )
+                kb = np.frombuffer(buf, np.uint8).reshape(self.n, 16)
+            self._kbytes = kb
         return self._kbytes
 
     def kobjs(self) -> list[Pointer]:
         """Keys as Pointer objects (materialised once, then cached)."""
         if self._kobjs is None:
-            kb = np.ascontiguousarray(self._kbytes)
+            kb = np.ascontiguousarray(self.kbytes())
             if _native is not None and hasattr(_native, "bytes_to_pointers"):
-                self._kobjs = _native.bytes_to_pointers(kb)
+                self._kobjs = _native.bytes_to_pointers(kb, Pointer)
             else:
                 mem = kb.tobytes()
                 self._kobjs = [
@@ -97,6 +105,8 @@ class Columns:
         """Row subset/reorder by an index vector (NumPy fancy gather)."""
         kb = self._kbytes
         kobjs = None
+        if kb is None and self._kb_thunk is not None:
+            kb = self.kbytes()  # force the lazy keys once
         if kb is not None:
             kb = kb[idx]
         else:
@@ -123,6 +133,30 @@ class Columns:
         return self.diffs
 
     @classmethod
+    def with_keys_of(
+        cls,
+        other: "Columns",
+        cols: Sequence[np.ndarray],
+        diffs: np.ndarray | None = None,
+    ) -> "Columns":
+        """New payload sharing ``other``'s key storage (zero-copy — keys
+        are immutable); used by key-preserving operators (select/filter)."""
+        c = cls.__new__(cls)
+        c.n = other.n
+        c._kbytes = other._kbytes
+        c._kobjs = other._kobjs
+        # a still-lazy source: route through other.kbytes so the thunk
+        # runs once and caches in the source
+        c._kb_thunk = (
+            other.kbytes
+            if other._kbytes is None and other._kobjs is None
+            else None
+        )
+        c.cols = list(cols)
+        c.diffs = diffs
+        return c
+
+    @classmethod
     def concat(cls, parts: "Sequence[Columns]") -> "Columns | None":
         """Stack columnar payloads row-wise, or None when layouts differ
         (arity mismatch or any per-column dtype mismatch — silent NumPy
@@ -139,7 +173,25 @@ class Columns:
             np.concatenate([p.cols[c] for p in parts])
             for c in range(arity)
         ]
-        if all(p._kbytes is not None for p in parts):
+        if all(
+            p._kbytes is not None or p._kb_thunk is not None for p in parts
+        ):
+            if any(p._kbytes is None for p in parts):
+                held = list(parts)  # keep laziness across the concat
+                return cls(
+                    n,
+                    cols,
+                    kb_thunk=lambda: np.concatenate(
+                        [p.kbytes() for p in held]
+                    ),
+                    diffs=(
+                        None
+                        if all(p.diffs is None for p in parts)
+                        else np.concatenate(
+                            [p.column_diffs() for p in parts]
+                        )
+                    ),
+                )
             kbytes = np.concatenate([p._kbytes for p in parts])
             kobjs = None
         else:
@@ -156,10 +208,11 @@ class Columns:
         here, paid only when a row-oriented consumer needs it)."""
         keys = self.kobjs()
         if _native is not None and hasattr(_native, "columns_to_entries"):
+            diffs = self.diffs
+            if diffs is not None:
+                diffs = np.ascontiguousarray(diffs, np.int64)
             return _native.columns_to_entries(
-                keys,
-                [np.ascontiguousarray(c) for c in self.cols],
-                self.diffs,
+                keys, [np.ascontiguousarray(c) for c in self.cols], diffs
             )
         if self.cols:
             rows = zip(*[c.tolist() for c in self.cols])
@@ -180,7 +233,7 @@ class DeltaBatch:
         "columns",
         "_consolidated",
         "_insert_only",
-        "_preapplied",
+        "_raw_insert_only",
         "_ccache",
     )
 
@@ -191,10 +244,11 @@ class DeltaBatch:
         self.columns: Columns | None = None
         self._consolidated = False
         self._insert_only = False  # set by consolidate(): unique-key inserts
-        #: producer already wrote these rows into its own node state
-        #: (fused C kernels); only the PRODUCING node's apply is skipped —
-        #: flag never travels on delivered/copied batches
-        self._preapplied = False
+        #: producer guarantees every diff is literally +1 (session inserts,
+        #: static rows) WITHOUT the key-uniqueness scan of consolidate().
+        #: Multiset-correct consumers (the columnar join) accept this hint;
+        #: dict-state consumers still consolidate.
+        self._raw_insert_only = False
         #: cached consolidate() result — a batch fanning out to several
         #: consumers (each consolidating in take()) merges only once
         self._ccache: "DeltaBatch | None" = None
@@ -213,7 +267,7 @@ class DeltaBatch:
         out.columns = columns
         out._consolidated = consolidated
         out._insert_only = insert_only and columns.diffs is None
-        out._preapplied = False
+        out._raw_insert_only = out._insert_only
         out._ccache = None
         return out
 
@@ -228,6 +282,7 @@ class DeltaBatch:
         self._entries = value
         self.columns = None
         self._ccache = None
+        self._raw_insert_only = False
 
     def append(self, key: Pointer, row: tuple, diff: int) -> None:
         if diff != 0:
@@ -238,6 +293,7 @@ class DeltaBatch:
             self.columns = None  # row mutation invalidates the columnar view
             self._consolidated = False
             self._insert_only = False
+            self._raw_insert_only = False
             self._ccache = None
 
     def extend(self, entries: Iterable[Entry]) -> None:
@@ -251,6 +307,7 @@ class DeltaBatch:
             self.columns = None
             self._consolidated = False
             self._insert_only = False
+            self._raw_insert_only = False
             self._ccache = None
 
     def __iter__(self) -> Iterator[Entry]:
@@ -342,9 +399,6 @@ def apply_batch_to_state(state: dict[Pointer, tuple], batch: DeltaBatch) -> None
     A table maps each key to exactly one row; an in-place update arrives as
     a retraction of the old row and an insertion of the new one.
     """
-    if batch._preapplied:
-        batch._preapplied = False  # one producing-node apply only
-        return
     entries = batch.entries
     if _native is not None:
         _native.apply_state(state, entries, batch._insert_only)

@@ -83,6 +83,22 @@ class ColumnarView:
 _MISSING = object()
 
 
+class PayloadView:
+    """ColumnarView-compatible adapter over a columnar batch payload
+    (engine/batch.py Columns): columns are already arrays, so extraction
+    is a dtype screen, not a per-row pass."""
+
+    __slots__ = ("_payload", "n")
+
+    def __init__(self, payload: Any) -> None:
+        self._payload = payload
+        self.n = payload.n
+
+    def column(self, index: int) -> np.ndarray | None:
+        col = self._payload.cols[index]
+        return col if col.dtype.kind in _OK_KINDS else None
+
+
 def _extract(values: list) -> np.ndarray | None:
     """list of Python scalars -> homogeneous ndarray, else None."""
     kinds = set(map(type, values))

@@ -86,9 +86,6 @@ class Node:
 
     def _defer_state(self, batch: DeltaBatch) -> None:
         """Queue an output batch for lazy application to ``current``."""
-        if batch._preapplied:
-            batch._preapplied = False  # one producing-node apply only
-            return
         self._state_lag.append(batch)
         self._state_lag_rows += len(batch)
         if self._state_lag_rows > self._STATE_LAG_MAX_ROWS:
@@ -118,7 +115,14 @@ class Node:
 
             stacked = Columns.concat([b.columns for b in batches])
             if stacked is not None:
-                return DeltaBatch.from_columns(stacked, consolidated=False)
+                out = DeltaBatch.from_columns(stacked, consolidated=False)
+                # all-+1 inputs stay all-+1 stacked (keys may repeat
+                # across parts, so the consolidated insert_only flag —
+                # which asserts uniqueness — must NOT propagate)
+                out._raw_insert_only = all(
+                    b._raw_insert_only for b in batches
+                )
+                return out
         merged = DeltaBatch()
         for b in batches:
             merged.extend(b)
@@ -184,7 +188,9 @@ class StaticSource(Node):
         if self._emitted:
             return None
         self._emitted = True
-        return DeltaBatch((k, r, 1) for k, r in self._rows)
+        out = DeltaBatch((k, r, 1) for k, r in self._rows)
+        out._raw_insert_only = True  # diffs literally +1 by construction
+        return out
 
     def process(self, time: int) -> DeltaBatch:
         return self.take_raw(0)  # pass-through; consumers consolidate
@@ -225,9 +231,10 @@ class InputSession(Node):
             out = DeltaBatch(self._buffer)
             self._buffer = []
             if not self._has_removals:
-                # cheap precheck (C): flags unique-key inserts, which the
-                # join/expression insert-only fast paths key off
-                out = out.consolidate()
+                # every diff is +1 by construction of insert(); multiset-
+                # correct consumers (columnar join) key off this hint and
+                # dict-state consumers still consolidate in take()
+                out._raw_insert_only = True
             self._has_removals = False
             return out
         out = DeltaBatch()
@@ -291,11 +298,56 @@ class ExpressionNode(Node):
         super().__init__(scope, [source], len(expressions))
         self.expressions = list(expressions)
 
+    def _columnar_inserts(self, batch: DeltaBatch) -> DeltaBatch | None:
+        """Pure-insert batch → columnar output sharing the input's keys;
+        None falls back to the row/entry paths."""
+        from pathway_tpu.engine import device
+        from pathway_tpu.engine.batch import Columns
+        from pathway_tpu.native import kernels as _native
+
+        payload = batch.columns
+        if payload is not None:
+            view: Any = device.PayloadView(payload)
+        else:
+            view = device.ColumnarView(batch.entries, from_entries=True)
+        arrays = []
+        for expr in self.expressions:
+            try:
+                arrays.append(device.eval_columnar(expr, view))
+            except device.NotVectorizable:
+                return None
+        if payload is not None:
+            out_payload = Columns.with_keys_of(payload, arrays)
+        else:
+            entries = batch.entries
+            if _native is not None:
+                kb = _native.entry_keys_bytes(entries, Pointer)
+            else:
+                kb = _entry_keys_bytes_py(entries)
+            if kb is None:
+                return None  # non-Pointer keys: row path
+            out_payload = Columns(len(entries), arrays, kbytes=kb)
+        out = DeltaBatch.from_columns(
+            out_payload,
+            consolidated=batch._insert_only,
+            insert_only=batch._insert_only,
+        )
+        # keys are the input's: its all-+1 hint carries over verbatim
+        out._raw_insert_only = batch._raw_insert_only or out._insert_only
+        return out
+
     def process(self, time: int) -> DeltaBatch:
-        batch = self.take(0)
+        batch = self.take_raw(0)
+        if not (batch._insert_only or batch._raw_insert_only):
+            batch = batch.consolidate()
+        insert_only = batch._insert_only or batch._raw_insert_only
+        if insert_only and len(batch) >= VECTOR_THRESHOLD:
+            fast = self._columnar_inserts(batch)
+            if fast is not None:
+                return fast
         out = DeltaBatch()
         ctx = EvalContext()
-        if not batch._insert_only:
+        if not insert_only:
             state = self.current  # hoisted: drains lazy state once
             for key, row, diff in batch:
                 if diff < 0:
@@ -304,12 +356,12 @@ class ExpressionNode(Node):
                         out.append(key, prev, diff)
         inserts = (
             batch.entries
-            if batch._insert_only
+            if insert_only
             else [e for e in batch if e[2] > 0]
         )
         if len(inserts) >= VECTOR_THRESHOLD:
-            # columnar fast path: whole-batch NumPy eval (engine/device.py);
-            # falls back row-wise on mixed/None/error columns
+            # columnar eval with row-materialised output (retraction case
+            # or non-Pointer keys); falls back row-wise on mixed columns
             from pathway_tpu.engine.device import (
                 eval_expressions_columnar_cols,
             )
@@ -412,9 +464,25 @@ class FilterNode(Node):
         self.condition_col = condition_col
 
     def process(self, time: int) -> DeltaBatch:
-        batch = self.take(0)
+        batch = self.take_raw(0)
+        if not (batch._insert_only or batch._raw_insert_only):
+            batch = batch.consolidate()
         c = self.condition_col
-        if batch._insert_only:
+        if batch._insert_only or batch._raw_insert_only:
+            payload = batch.columns
+            if payload is not None:
+                cond = payload.cols[c]
+                if cond.dtype.kind == "b":
+                    # columnar mask-compress: keys/cols stay arrays
+                    out = DeltaBatch.from_columns(
+                        payload.compress(cond),
+                        consolidated=batch._insert_only,
+                        insert_only=batch._insert_only,
+                    )
+                    out._raw_insert_only = (
+                        batch._raw_insert_only or out._insert_only
+                    )
+                    return out
             from pathway_tpu.native import kernels as _native
 
             if _native is not None:
@@ -422,16 +490,19 @@ class FilterNode(Node):
                 if kept is not None:  # all-bool conditions, no errors
                     out = DeltaBatch()
                     out.entries = kept
-                    out._consolidated = True
-                    out._insert_only = True
+                    out._consolidated = batch._insert_only
+                    out._insert_only = batch._insert_only
+                    out._raw_insert_only = True
                     return out
             if not any(is_error(e[1][c]) for e in batch.entries):
                 # C-speed comprehension: no retractions, no error conditions
                 out = DeltaBatch()
                 out.entries = [e for e in batch.entries if e[1][c]]
-                out._consolidated = True
-                out._insert_only = True
+                out._consolidated = batch._insert_only
+                out._insert_only = batch._insert_only
+                out._raw_insert_only = True
                 return out
+            batch = batch.consolidate()  # ERROR rows: exact row semantics
         out = DeltaBatch()
         state = self.current  # hoisted: drains lazy state once
         for key, row, diff in batch:
@@ -640,6 +711,112 @@ def join_result_key(lkey: Pointer | None, rkey: Pointer | None) -> Pointer:
     return hash_values((rkey,), salt=_JOIN_RIGHT_SALT)
 
 
+def _keys_unique(kb: np.ndarray, n: int) -> bool:
+    """Vectorized uniqueness screen over (n,16) key bytes. Keys are
+    uniform 128-bit content hashes, so low-64-bit uniqueness implies full
+    uniqueness; only the ~n²/2⁶⁵ collision case pays the full check."""
+    if n < 2:
+        return True
+    lo = np.sort(np.ascontiguousarray(kb[:, :8]).view(np.uint64).ravel())
+    if not (lo[1:] == lo[:-1]).any():
+        return True
+    v = np.ascontiguousarray(kb).view(np.dtype((np.void, 16))).ravel()
+    return len(np.unique(v)) == n
+
+
+class _JoinSide:
+    """One side's rows in columnar form: join-key array, key bytes, and
+    the full column set (object arrays where a column isn't clean)."""
+
+    __slots__ = ("n", "jk", "kb", "cols")
+
+    def __init__(self, n, jk, kb, cols) -> None:
+        self.n = n
+        self.jk = jk
+        self.kb = kb
+        self.cols = cols
+
+
+_JOIN_FLOAT_EXACT = 1 << 53
+
+
+def _unify_join_keys(a: np.ndarray, b: np.ndarray):
+    """Cast two join-key arrays to one comparison dtype matching Python
+    dict-key equality (True == 1 == 1.0), or None when vectorized
+    equality would diverge (NaN identity, huge ints in float64, or
+    cross-kind pairs like str vs int — route those to the dict path)."""
+    ka, kb_ = a.dtype.kind, b.dtype.kind
+    if ka == kb_:
+        if ka == "f" and (np.isnan(a).any() or np.isnan(b).any()):
+            return None
+        return a, b
+    kinds = {ka, kb_}
+    if kinds <= {"b", "i"}:
+        return a.astype(np.int64), b.astype(np.int64)
+    if kinds <= {"b", "i", "f"}:
+        for arr in (a, b):
+            if arr.dtype.kind == "i" and arr.size:
+                amax = int(np.abs(arr).max())
+                if amax < 0 or amax > _JOIN_FLOAT_EXACT:
+                    return None  # not exactly float64-representable
+        a2 = a.astype(np.float64)
+        b2 = b.astype(np.float64)
+        if np.isnan(a2).any() or np.isnan(b2).any():
+            return None
+        return a2, b2
+    return None
+
+
+def _match_join_pairs(la: np.ndarray, ra: np.ndarray):
+    """Index pairs (l_idx, r_idx) of all equal-key matches — a sort-based
+    hash-join core; the smaller side becomes the sorted haystack."""
+    empty = np.empty(0, np.int64)
+    if len(la) == 0 or len(ra) == 0:
+        return empty, empty
+    if len(ra) > len(la):
+        r_idx, l_idx = _match_join_pairs(ra, la)
+        return l_idx, r_idx
+    order = np.argsort(ra, kind="stable")
+    rs = ra[order]
+    lo = np.searchsorted(rs, la, "left")
+    hi = np.searchsorted(rs, la, "right")
+    counts = hi - lo
+    total = int(counts.sum())
+    if total == 0:
+        return empty, empty
+    l_idx = np.repeat(np.arange(len(la)), counts)
+    starts = np.repeat(lo, counts)
+    csum = np.cumsum(counts) - counts
+    offs = np.arange(total) - np.repeat(csum, counts)
+    return l_idx, order[starts + offs]
+
+
+def _hash_join_pairs_py(lkb: np.ndarray, rkb: np.ndarray) -> np.ndarray:
+    """Python fallback for the vectorized join_result_key derivation."""
+    import hashlib
+
+    n = len(lkb)
+    out = np.empty((n, 16), np.uint8)
+    lmem, rmem = lkb.tobytes(), rkb.tobytes()
+    for i in range(n):
+        h = hashlib.blake2b(digest_size=16, person=b"pw-tpu-key")
+        h.update(
+            b"join\x04"
+            + lmem[i * 16 : i * 16 + 16]
+            + b"\x04"
+            + rmem[i * 16 : i * 16 + 16]
+        )
+        out[i] = np.frombuffer(h.digest(), np.uint8)
+    return out
+
+
+def _entry_keys_bytes_py(entries: list) -> np.ndarray | None:
+    if any(type(e[0]) is not Pointer for e in entries):
+        return None
+    buf = b"".join(int(e[0]).to_bytes(16, "little") for e in entries)
+    return np.frombuffer(buf, np.uint8).reshape(len(entries), 16)
+
+
 class JoinNode(Node):
     """Equality join with incremental per-group recomputation.
 
@@ -648,6 +825,14 @@ class JoinNode(Node):
     (reference: join_tables python_api.rs:2986, dataflow join at
     dataflow.rs:2320+). ``id_from_left`` keeps the left row id (used by
     id-preserving joins such as ``ix``-style lookups and asof_now joins).
+
+    Single-key inner joins run fully columnar while their input stays
+    insert-only: arrangements are kept as columnar blocks, each commit is
+    one sort-based NumPy hash join plus a vectorized BLAKE2b pass for the
+    result keys, and the output is a columnar batch (no per-row Python
+    objects). The first batch that needs exact row semantics (retraction,
+    outer kind, exotic key) materialises the blocks into the dict
+    arrangements once and the incremental row path takes over.
     """
 
     STATE_ATTRS = ("left_arr", "right_arr")
@@ -671,6 +856,204 @@ class JoinNode(Node):
         # join-key → {row_key: row}
         self.left_arr: dict[Any, dict[Pointer, tuple]] = {}
         self.right_arr: dict[Any, dict[Pointer, tuple]] = {}
+        # columnar arrangements (lists of _JoinSide blocks), active until
+        # a batch forces the dict path
+        self._blocks_left: list[_JoinSide] = []
+        self._blocks_right: list[_JoinSide] = []
+        self._columnar_ok = (
+            kind == JoinKind.INNER
+            and not id_from_left
+            and len(self.left_on) == 1
+            and len(self.right_on) == 1
+        )
+
+    # -- columnar fast path -------------------------------------------------
+
+    def _side_from_batch(
+        self, batch: DeltaBatch, on_col: int, arity: int
+    ) -> _JoinSide | None:
+        from pathway_tpu.engine import device
+        from pathway_tpu.native import kernels as _native
+
+        n = len(batch)
+        if n == 0:
+            return _JoinSide(0, None, None, [])
+        payload = batch.columns
+        if payload is not None:
+            if payload.diffs is not None and not (payload.diffs == 1).all():
+                return None
+            jk = payload.cols[on_col]
+            if jk.dtype.kind not in "bifU":
+                return None
+            try:
+                kb = payload.kbytes()
+            except (OverflowError, TypeError):
+                return None
+            if kb is None:
+                return None
+            if not batch._insert_only and not _keys_unique(kb, n):
+                return None
+            return _JoinSide(n, jk, kb, list(payload.cols))
+        entries = batch.entries
+        view = device.ColumnarView(entries, from_entries=True)
+        jk = view.column(on_col)
+        if jk is None or jk.dtype.kind not in "bifU":
+            return None
+        if _native is not None:
+            diffs = _native.entry_diffs(entries)
+            if not (diffs == 1).all():
+                return None
+            kb = _native.entry_keys_bytes(entries, Pointer)
+        else:
+            if any(e[2] != 1 for e in entries):
+                return None
+            kb = _entry_keys_bytes_py(entries)
+        if kb is None:
+            return None
+        if not batch._insert_only and not _keys_unique(kb, n):
+            # _raw_insert_only skipped the consolidate uniqueness scan;
+            # duplicate (key,row) pairs would collapse lossily at the
+            # dict-arrangement handover, so screen keys here
+            return None
+        cols = []
+        for c in range(arity):
+            col = view.column(c)
+            if col is None:
+                arr = np.empty(n, object)
+                arr[:] = [e[1][c] for e in entries]
+                col = arr
+            cols.append(col)
+        return _JoinSide(n, jk, kb, cols)
+
+    def _emit_part(
+        self,
+        lside: _JoinSide,
+        rside: _JoinSide,
+        l_idx: np.ndarray,
+        r_idx: np.ndarray,
+    ):
+        from pathway_tpu.engine.batch import Columns
+        from pathway_tpu.native import kernels as _native
+
+        lkb = np.ascontiguousarray(lside.kb[l_idx])
+        rkb = np.ascontiguousarray(rside.kb[r_idx])
+
+        def pair_keys() -> np.ndarray:
+            # the vectorized BLAKE2b pass over the pair keys is the join's
+            # single biggest fixed cost — run it only when the output keys
+            # are actually observed (sink, state read, downstream keying)
+            if _native is not None:
+                return _native.hash_join_pairs(lkb, rkb)
+            return _hash_join_pairs_py(lkb, rkb)
+
+        cols = [c[l_idx] for c in lside.cols] + [
+            c[r_idx] for c in rside.cols
+        ]
+        return Columns(len(l_idx), cols, kb_thunk=pair_keys)
+
+    def _process_columnar_inner(
+        self, left_batch: DeltaBatch, right_batch: DeltaBatch
+    ) -> DeltaBatch | None:
+        """Bilinear delta join over columnar blocks:
+        ``ΔL⋈ΔR + ΔL⋈R + L⋈ΔR``. None → caller falls back to the dict
+        path (state untouched: all screens run before any block append)."""
+        from pathway_tpu.engine.batch import Columns
+
+        ls = self._side_from_batch(
+            left_batch, self.left_on[0], self.inputs[0].arity
+        )
+        rs = self._side_from_batch(
+            right_batch, self.right_on[0], self.inputs[1].arity
+        )
+        if ls is None or rs is None:
+            return None
+        plan: list[tuple[_JoinSide, _JoinSide]] = []
+        if rs.n:
+            plan.extend((blk, rs) for blk in self._blocks_left)
+        if ls.n:
+            plan.extend((ls, blk) for blk in self._blocks_right)
+        if ls.n and rs.n:
+            plan.append((ls, rs))
+        matches = []
+        for l, r in plan:
+            uni = _unify_join_keys(l.jk, r.jk)
+            if uni is None:
+                return None
+            l_idx, r_idx = _match_join_pairs(*uni)
+            if len(l_idx):
+                matches.append((l, r, l_idx, r_idx))
+        # all screens passed: commit the block appends, then emit
+        if ls.n:
+            self._blocks_left.append(ls)
+        if rs.n:
+            self._blocks_right.append(rs)
+        parts = [
+            self._emit_part(l, r, l_idx, r_idx)
+            for l, r, l_idx, r_idx in matches
+        ]
+        if not parts:
+            return DeltaBatch()
+        payload = parts[0] if len(parts) == 1 else Columns.concat(parts)
+        if payload is not None:
+            return DeltaBatch.from_columns(
+                payload, consolidated=True, insert_only=True
+            )
+        # cross-part dtype drift: materialise rows (correct, slower)
+        out = DeltaBatch()
+        for p in parts:
+            out.entries.extend(
+                DeltaBatch.from_columns(p, consolidated=True).entries
+            )
+        out._consolidated = True
+        out._insert_only = True
+        return out
+
+    def _ensure_dict_arrangements(self) -> None:
+        """Materialise columnar blocks into the dict arrangements (once),
+        handing over to the incremental row path."""
+        if not self._columnar_ok:
+            return
+        self._columnar_ok = False
+        self._materialize_blocks_into(self.left_arr, self.right_arr)
+        self._blocks_left.clear()
+        self._blocks_right.clear()
+
+    def _materialize_blocks_into(self, left_arr: dict, right_arr: dict) -> None:
+        from pathway_tpu.engine.batch import Columns
+
+        for blocks, arr in (
+            (self._blocks_left, left_arr),
+            (self._blocks_right, right_arr),
+        ):
+            for side in blocks:
+                entries = Columns(
+                    side.n, side.cols, kbytes=side.kb
+                ).to_entries()
+                jks = side.jk.tolist()
+                for (key, row, _d), jkv in zip(entries, jks):
+                    arr.setdefault((jkv,), {})[key] = row
+
+    def op_state(self) -> dict:
+        # snapshot a dict VIEW of the arrangements without degrading the
+        # live columnar blocks (mirrors GroupbyNode.op_state)
+        state = {"current": dict(self.current)}
+        if self._columnar_ok and (self._blocks_left or self._blocks_right):
+            left: dict = {k: dict(v) for k, v in self.left_arr.items()}
+            right: dict = {k: dict(v) for k, v in self.right_arr.items()}
+            self._materialize_blocks_into(left, right)
+            state["left_arr"] = left
+            state["right_arr"] = right
+        else:
+            state["left_arr"] = self.left_arr
+            state["right_arr"] = self.right_arr
+        return state
+
+    def restore_op_state(self, state: dict) -> None:
+        super().restore_op_state(state)
+        self._blocks_left.clear()
+        self._blocks_right.clear()
+        if self.left_arr or self.right_arr:
+            self._columnar_ok = False
 
     def _jk(self, row: tuple, cols: Sequence[int], key: Pointer) -> Any:
         vals = tuple(row[c] for c in cols)
@@ -709,12 +1092,14 @@ class JoinNode(Node):
 
     def _process_insert_only_inner(
         self, left_batch: DeltaBatch, right_batch: DeltaBatch
-    ) -> DeltaBatch:
+    ) -> DeltaBatch | None:
         """Incremental inner-join fast path for insert-only deltas:
         ``ΔL⋈R + L⋈(R+ΔR)`` — no per-group recompute, no old/new diffing,
         no consolidation pass (result keys are unique pair hashes). This
         is the bulk-load hot path; the general path below handles
-        retractions and outer kinds."""
+        retractions and outer kinds. Returns None (state untouched) for
+        multiplicities > 1, which the pair-emitting loops and the dict
+        arrangements cannot represent."""
         from pathway_tpu.native import kernels as _native
 
         if _native is not None:
@@ -727,7 +1112,7 @@ class JoinNode(Node):
                 self.right_arr,
                 ERROR,
                 Pointer,
-                self.current,
+                None,  # lazy node state: scheduler defers the application
                 join_result_key,
             )
             if entries is not None:
@@ -735,9 +1120,12 @@ class JoinNode(Node):
                 out.entries = entries
                 out._consolidated = True
                 out._insert_only = True
-                out._preapplied = True  # kernel already wrote self.current
                 return out
             # non-scalar / ERROR join keys: Python keeps exact semantics
+        if any(e[2] != 1 for e in left_batch.entries) or any(
+            e[2] != 1 for e in right_batch.entries
+        ):
+            return None
         out = DeltaBatch()
         append = out.entries.append
         # ΔR pairs with the PRE-delta left arrangement...
@@ -766,15 +1154,38 @@ class JoinNode(Node):
         return out
 
     def process(self, time: int) -> DeltaBatch:
-        left_batch = self.take(0)
-        right_batch = self.take(1)
-        if (
+        # raw takes: the columnar path is multiset-correct, so the
+        # consolidation scan is skipped entirely while it holds
+        left_batch = self.take_raw(0)
+        right_batch = self.take_raw(1)
+        if self._columnar_ok:
+            if (
+                left_batch._raw_insert_only
+                or left_batch._insert_only
+                or not left_batch
+            ) and (
+                right_batch._raw_insert_only
+                or right_batch._insert_only
+                or not right_batch
+            ):
+                out = self._process_columnar_inner(left_batch, right_batch)
+                if out is not None:
+                    return out
+            # this batch needs exact row semantics: hand the columnar
+            # blocks to the dict arrangements (once) and fall through
+            self._ensure_dict_arrangements()
+        left_batch = left_batch.consolidate()
+        right_batch = right_batch.consolidate()
+        fast = (
             self.kind == JoinKind.INNER
             and not self.id_from_left
             and (left_batch._insert_only or not left_batch)
             and (right_batch._insert_only or not right_batch)
-        ):
-            return self._process_insert_only_inner(left_batch, right_batch)
+        )
+        if fast:
+            out = self._process_insert_only_inner(left_batch, right_batch)
+            if out is not None:
+                return out
         affected: set[Any] = set()
         old_local: dict[Any, dict[Pointer, tuple]] = {}
 

@@ -598,7 +598,9 @@ int emit_pairs(PyObject *out, PyObject *group, PyObject *key, PyObject *row,
     PyObject *entry = PyTuple_Pack(3, okey, orow, one);
     int rc = entry ? PyList_Append(out, entry) : -1;
     Py_XDECREF(entry);
-    if (rc == 0) rc = PyDict_SetItem(current, okey, orow);
+    /* current == None: caller defers state application (lazy node state) */
+    if (rc == 0 && current != Py_None)
+      rc = PyDict_SetItem(current, okey, orow);
     Py_DECREF(okey);
     Py_DECREF(orow);
     if (rc < 0) return -1;
@@ -659,6 +661,11 @@ int join_prescan(PyObject *entries, PyObject *cols, PyObject *error_obj,
     if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 3) return 0;
     if ((PyObject *)Py_TYPE(PyTuple_GET_ITEM(e, 0)) != pointer_type)
       return 0;
+    /* diff must be exactly 1: insert-only batches may legally carry
+     * multiplicities > 1, which this pair-emitting kernel (and the dict
+     * arrangements, which drop multiplicity) cannot represent */
+    PyObject *diff = PyTuple_GET_ITEM(e, 2);
+    if (!PyLong_Check(diff) || PyLong_AsLong(diff) != 1) return 0;
     PyObject *row = PyTuple_GET_ITEM(e, 1);
     if (!PyTuple_Check(row)) return 0;
     for (Py_ssize_t c = 0; c < k; c++) {
@@ -678,12 +685,15 @@ int join_prescan(PyObject *entries, PyObject *cols, PyObject *error_obj,
 PyObject *join_insert_inner(PyObject *, PyObject *args) {
   PyObject *le, *re, *lon, *ron, *larr, *rarr, *error_obj, *pointer_type,
       *current, *jrk_fn;
-  if (!PyArg_ParseTuple(args, "O!O!O!O!O!O!OOO!O", &PyList_Type, &le,
+  if (!PyArg_ParseTuple(args, "O!O!O!O!O!O!OOOO", &PyList_Type, &le,
                         &PyList_Type, &re, &PyList_Type, &lon, &PyList_Type,
                         &ron, &PyDict_Type, &larr, &PyDict_Type, &rarr,
-                        &error_obj, &pointer_type, &PyDict_Type, &current,
-                        &jrk_fn))
+                        &error_obj, &pointer_type, &current, &jrk_fn))
     return nullptr;
+  if (current != Py_None && !PyDict_Check(current)) {
+    PyErr_SetString(PyExc_TypeError, "current must be a dict or None");
+    return nullptr;
+  }
   if (!PyType_Check(pointer_type) ||
       !PyType_IsSubtype((PyTypeObject *)pointer_type, &PyLong_Type))
     Py_RETURN_NONE; /* tp_new shortcut requires an int subclass */
@@ -707,7 +717,265 @@ PyObject *join_insert_inner(PyObject *, PyObject *args) {
   return out;
 }
 
+/* -- columnar key plumbing ---------------------------------------------------
+ *
+ * The columnar DeltaBatch (engine/batch.py Columns) stores keys as a
+ * (n,16) little-endian byte matrix; these kernels convert to/from the
+ * Pointer-object view and derive join result keys vectorized — one C
+ * pass instead of per-row hashlib + int.to_bytes.
+ */
+
+/* make a Pointer (int subclass) from 16 LE bytes via tp_new, skipping the
+ * Python-level __new__ masking wrapper (the digest is already 128-bit) */
+PyObject *pointer_from_bytes(PyTypeObject *pointer_type,
+                             const uint8_t b[16]) {
+  PyObject *as_int = _PyLong_FromByteArray(b, 16, 1, 0);
+  if (!as_int) return nullptr;
+  PyObject *argtuple = PyTuple_New(1);
+  if (!argtuple) {
+    Py_DECREF(as_int);
+    return nullptr;
+  }
+  PyTuple_SET_ITEM(argtuple, 0, as_int);
+  PyObject *ptr = PyLong_Type.tp_new(pointer_type, argtuple, nullptr);
+  Py_DECREF(argtuple);
+  return ptr;
+}
+
+/* pointers_to_bytes(keys_list) -> (n,16) uint8 ndarray | None (non-int) */
+PyObject *pointers_to_bytes(PyObject *, PyObject *args) {
+  PyObject *keys;
+  if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &keys)) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(keys);
+  npy_intp dims[2] = {n, 16};
+  PyObject *arr = PyArray_SimpleNew(2, dims, NPY_UINT8);
+  if (!arr) return nullptr;
+  uint8_t *data = (uint8_t *)PyArray_BYTES((PyArrayObject *)arr);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (key_bytes(PyList_GET_ITEM(keys, i), data + i * 16) < 0) {
+      Py_DECREF(arr);
+      if (!PyErr_Occurred()) Py_RETURN_NONE;
+      return nullptr;
+    }
+  }
+  return arr;
+}
+
+/* bytes_to_pointers(arr, pointer_type) -> list of Pointer */
+PyObject *bytes_to_pointers(PyObject *, PyObject *args) {
+  PyObject *arr_obj, *pointer_type;
+  if (!PyArg_ParseTuple(args, "O!O", &PyArray_Type, &arr_obj, &pointer_type))
+    return nullptr;
+  if (!PyType_Check(pointer_type) ||
+      !PyType_IsSubtype((PyTypeObject *)pointer_type, &PyLong_Type)) {
+    PyErr_SetString(PyExc_TypeError, "pointer_type must subclass int");
+    return nullptr;
+  }
+  PyArrayObject *arr = (PyArrayObject *)arr_obj;
+  if (PyArray_NDIM(arr) != 2 || PyArray_DIM(arr, 1) != 16 ||
+      PyArray_TYPE(arr) != NPY_UINT8 ||
+      !PyArray_IS_C_CONTIGUOUS(arr)) {
+    PyErr_SetString(PyExc_ValueError, "expected C-contiguous (n,16) uint8");
+    return nullptr;
+  }
+  Py_ssize_t n = PyArray_DIM(arr, 0);
+  const uint8_t *data = (const uint8_t *)PyArray_BYTES(arr);
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *p = pointer_from_bytes((PyTypeObject *)pointer_type,
+                                     data + i * 16);
+    if (!p) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, p);
+  }
+  return out;
+}
+
+/* entry_keys_bytes(entries, pointer_type) -> (n,16) uint8 | None.
+ * None when any key is not EXACTLY a Pointer (subclass tagging matters:
+ * hash_join_pairs tags _H_POINTER, which only matches hash_values for
+ * genuine Pointers). */
+PyObject *entry_keys_bytes(PyObject *, PyObject *args) {
+  PyObject *entries, *pointer_type;
+  if (!PyArg_ParseTuple(args, "O!O", &PyList_Type, &entries, &pointer_type))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  npy_intp dims[2] = {n, 16};
+  PyObject *arr = PyArray_SimpleNew(2, dims, NPY_UINT8);
+  if (!arr) return nullptr;
+  uint8_t *data = (uint8_t *)PyArray_BYTES((PyArrayObject *)arr);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 3) {
+      Py_DECREF(arr);
+      Py_RETURN_NONE;
+    }
+    PyObject *key = PyTuple_GET_ITEM(e, 0);
+    if ((PyObject *)Py_TYPE(key) != pointer_type ||
+        key_bytes(key, data + i * 16) < 0) {
+      Py_DECREF(arr);
+      if (PyErr_Occurred()) return nullptr;
+      Py_RETURN_NONE;
+    }
+  }
+  return arr;
+}
+
+/* hash_join_pairs(lbytes, rbytes) -> (n,16) uint8 of
+ * blake2b16("join" + 0x04 lk + 0x04 rk) — vectorized join_result_key. */
+PyObject *hash_join_pairs(PyObject *, PyObject *args) {
+  PyObject *l_obj, *r_obj;
+  if (!PyArg_ParseTuple(args, "O!O!", &PyArray_Type, &l_obj, &PyArray_Type,
+                        &r_obj))
+    return nullptr;
+  PyArrayObject *l = (PyArrayObject *)l_obj, *r = (PyArrayObject *)r_obj;
+  PyArrayObject *pair[2] = {l, r};
+  for (int side = 0; side < 2; side++) {
+    PyArrayObject *a = pair[side];
+    if (PyArray_NDIM(a) != 2 || PyArray_DIM(a, 1) != 16 ||
+        PyArray_TYPE(a) != NPY_UINT8 || !PyArray_IS_C_CONTIGUOUS(a)) {
+      PyErr_SetString(PyExc_ValueError,
+                      "expected C-contiguous (n,16) uint8");
+      return nullptr;
+    }
+  }
+  if (PyArray_DIM(l, 0) != PyArray_DIM(r, 0)) {
+    PyErr_SetString(PyExc_ValueError, "length mismatch");
+    return nullptr;
+  }
+  Py_ssize_t n = PyArray_DIM(l, 0);
+  npy_intp dims[2] = {n, 16};
+  PyObject *out = PyArray_SimpleNew(2, dims, NPY_UINT8);
+  if (!out) return nullptr;
+  const uint8_t *lb = (const uint8_t *)PyArray_BYTES(l);
+  const uint8_t *rb = (const uint8_t *)PyArray_BYTES(r);
+  uint8_t *ob = (uint8_t *)PyArray_BYTES((PyArrayObject *)out);
+  uint8_t msg[4 + 17 + 17];
+  memcpy(msg, "join", 4);
+  msg[4] = 0x04; /* _H_POINTER */
+  msg[21] = 0x04;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    memcpy(msg + 5, lb + i * 16, 16);
+    memcpy(msg + 22, rb + i * 16, 16);
+    b2b16_short(msg, sizeof(msg), ob + i * 16);
+  }
+  return out;
+}
+
+/* one cell of a 1-D column array -> new reference */
+PyObject *cell_to_object(PyArrayObject *col, Py_ssize_t i) {
+  switch (PyArray_TYPE(col)) {
+    case NPY_INT64:
+      return PyLong_FromLongLong(*(npy_int64 *)PyArray_GETPTR1(col, i));
+    case NPY_FLOAT64:
+      return PyFloat_FromDouble(*(npy_double *)PyArray_GETPTR1(col, i));
+    case NPY_BOOL: {
+      PyObject *v = *(npy_bool *)PyArray_GETPTR1(col, i) ? Py_True : Py_False;
+      Py_INCREF(v);
+      return v;
+    }
+    case NPY_OBJECT: {
+      PyObject *v = *(PyObject **)PyArray_GETPTR1(col, i);
+      Py_INCREF(v);
+      return v;
+    }
+    default:
+      /* strings / datetimes / anything else: generic numpy conversion */
+      return PyArray_GETITEM(col, (const char *)PyArray_GETPTR1(col, i));
+  }
+}
+
+/* columns_to_entries(keys_list, cols_list, diffs|None) -> entries list.
+ * cols_list: 1-D ndarrays, one per column; diffs: int64 ndarray or None. */
+PyObject *columns_to_entries(PyObject *, PyObject *args) {
+  PyObject *keys, *cols, *diffs_obj;
+  if (!PyArg_ParseTuple(args, "O!O!O", &PyList_Type, &keys, &PyList_Type,
+                        &cols, &diffs_obj))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(keys);
+  Py_ssize_t arity = PyList_GET_SIZE(cols);
+  const npy_int64 *diffs = nullptr;
+  if (diffs_obj != Py_None) {
+    if (!PyArray_Check(diffs_obj) ||
+        PyArray_TYPE((PyArrayObject *)diffs_obj) != NPY_INT64 ||
+        PyArray_NDIM((PyArrayObject *)diffs_obj) != 1 ||
+        PyArray_DIM((PyArrayObject *)diffs_obj, 0) != n ||
+        !PyArray_IS_C_CONTIGUOUS((PyArrayObject *)diffs_obj)) {
+      PyErr_SetString(PyExc_ValueError, "diffs must be contiguous int64[n]");
+      return nullptr;
+    }
+    diffs = (const npy_int64 *)PyArray_BYTES((PyArrayObject *)diffs_obj);
+  }
+  for (Py_ssize_t c = 0; c < arity; c++) {
+    PyObject *col = PyList_GET_ITEM(cols, c);
+    if (!PyArray_Check(col) || PyArray_NDIM((PyArrayObject *)col) != 1 ||
+        PyArray_DIM((PyArrayObject *)col, 0) != n) {
+      PyErr_SetString(PyExc_ValueError, "columns must be 1-D ndarrays[n]");
+      return nullptr;
+    }
+  }
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  PyObject *one = PyLong_FromLong(1);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *row = PyTuple_New(arity);
+    if (!row) goto fail;
+    for (Py_ssize_t c = 0; c < arity; c++) {
+      PyObject *v =
+          cell_to_object((PyArrayObject *)PyList_GET_ITEM(cols, c), i);
+      if (!v) {
+        Py_DECREF(row);
+        goto fail;
+      }
+      PyTuple_SET_ITEM(row, c, v);
+    }
+    PyObject *diff;
+    if (diffs) {
+      diff = PyLong_FromLongLong(diffs[i]);
+      if (!diff) {
+        Py_DECREF(row);
+        goto fail;
+      }
+    } else {
+      diff = one;
+      Py_INCREF(one);
+    }
+    PyObject *key = PyList_GET_ITEM(keys, i);
+    Py_INCREF(key);
+    PyObject *entry = PyTuple_New(3);
+    if (!entry) {
+      Py_DECREF(row);
+      Py_DECREF(diff);
+      Py_DECREF(key);
+      goto fail;
+    }
+    PyTuple_SET_ITEM(entry, 0, key);
+    PyTuple_SET_ITEM(entry, 1, row);
+    PyTuple_SET_ITEM(entry, 2, diff);
+    PyList_SET_ITEM(out, i, entry);
+  }
+  Py_DECREF(one);
+  return out;
+fail:
+  Py_DECREF(one);
+  Py_DECREF(out);
+  return nullptr;
+}
+
 PyMethodDef methods[] = {
+    {"pointers_to_bytes", pointers_to_bytes, METH_VARARGS,
+     "pointers_to_bytes(keys) -> (n,16) uint8 | None"},
+    {"bytes_to_pointers", bytes_to_pointers, METH_VARARGS,
+     "bytes_to_pointers(arr, Pointer) -> list[Pointer]"},
+    {"hash_join_pairs", hash_join_pairs, METH_VARARGS,
+     "hash_join_pairs(lbytes, rbytes) -> (n,16) uint8"},
+    {"entry_keys_bytes", entry_keys_bytes, METH_VARARGS,
+     "entry_keys_bytes(entries, Pointer) -> (n,16) uint8 | None"},
+    {"columns_to_entries", columns_to_entries, METH_VARARGS,
+     "columns_to_entries(keys, cols, diffs|None) -> entries"},
     {"join_insert_inner", join_insert_inner, METH_VARARGS,
      "join_insert_inner(l_entries, r_entries, l_on, r_on, l_arr, r_arr, "
      "ERROR, Pointer) -> entries|None"},

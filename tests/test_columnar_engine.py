@@ -229,6 +229,202 @@ class TestColumnsPayload:
         assert all(type(e[1][0]) is int for e in entries)
 
 
+def _join_scope(columnar=True, kind="inner"):
+    scope = Scope()
+    left = scope.input_session(2)
+    right = scope.input_session(2)
+    jn = scope.join_tables(left, right, left_on=[0], right_on=[0], kind=kind)
+    if not columnar:
+        jn._columnar_ok = False
+    return scope, left, right, jn
+
+
+class TestColumnarJoin:
+    def test_randomized_streaming_equivalence(self):
+        """Insert-only streaming over several commits: the columnar block
+        join must equal the dict-path join state exactly."""
+        rng = random.Random(5)
+
+        def ops():
+            rng2 = random.Random(5)
+            out = []
+            for c in range(8):
+                commit = []
+                for i in range(rng2.randint(5, 80)):
+                    side = rng2.random() < 0.6
+                    jk = rng2.randint(0, 15)
+                    commit.append(
+                        (
+                            side,
+                            ref_scalar((c, i, side)),
+                            (jk, float(rng2.randint(0, 99))),
+                        )
+                    )
+                out.append(commit)
+            return out
+
+        def run(columnar):
+            scope, left, right, jn = _join_scope(columnar)
+            sched = Scheduler(scope)
+            for commit in ops():
+                for is_left, key, row in commit:
+                    (left if is_left else right).insert(key, row)
+                sched.commit()
+            return dict(jn.current)
+
+        a, b = run(True), run(False)
+        assert a == b and len(a) > 100
+
+    def test_retraction_hands_over_to_dict_path(self):
+        scope, left, right, jn = _join_scope()
+        sched = Scheduler(scope)
+        for i in range(300):
+            left.insert(ref_scalar(("l", i)), (i % 10, float(i)))
+        for i in range(10):
+            right.insert(ref_scalar(("r", i)), (i, float(i)))
+        sched.commit()
+        assert jn._columnar_ok and jn._blocks_left
+        n0 = len(jn.current)
+        assert n0 == 300
+        # retraction: blocks materialise into dicts, results stay exact
+        left.remove(ref_scalar(("l", 7)), (7, 7.0))
+        sched.commit()
+        assert not jn._columnar_ok and not jn._blocks_left
+        assert len(jn.current) == 299
+        # and further streaming still joins correctly
+        left.insert(ref_scalar(("l", 999)), (3, 999.0))
+        sched.commit()
+        assert len(jn.current) == 300
+
+    def test_result_keys_match_row_path(self):
+        """Lazy pair-key derivation must equal join_result_key exactly."""
+        out_cols, out_rows = [], []
+
+        def run(columnar):
+            scope, left, right, jn = _join_scope(columnar)
+            sched = Scheduler(scope)
+            for i in range(400):
+                left.insert(ref_scalar(("l", i)), (i % 7, float(i)))
+            for i in range(7):
+                right.insert(ref_scalar(("r", i)), (i, float(i) * 10))
+            sched.commit()
+            return dict(jn.current)
+
+        a, b = run(True), run(False)
+        assert a == b  # same Pointers AND same rows
+        assert len(a) == 400
+
+    def test_mixed_int_float_join_keys(self):
+        for columnar in (True, False):
+            scope, left, right, jn = _join_scope(columnar)
+            sched = Scheduler(scope)
+            left.insert(ref_scalar("a"), (1, 0.0))
+            left.insert(ref_scalar("b"), (2, 0.0))
+            right.insert(ref_scalar("x"), (1.0, 1.0))  # float 1.0 == int 1
+            right.insert(ref_scalar("y"), (2.5, 2.0))
+            sched.commit()
+            rows = sorted(r[:3] for r in jn.current.values())
+            assert rows == [(1, 0.0, 1.0)], (columnar, rows)
+
+    def test_string_join_keys_columnar(self):
+        scope, left, right, jn = _join_scope()
+        sched = Scheduler(scope)
+        for i in range(300):
+            left.insert(ref_scalar(("l", i)), (f"k{i % 5}", float(i)))
+        for i in range(5):
+            right.insert(ref_scalar(("r", i)), (f"k{i}", float(i)))
+        sched.commit()
+        assert jn._columnar_ok  # strings stayed on the columnar path
+        assert len(jn.current) == 300
+
+    def test_snapshot_roundtrip_during_columnar_mode(self):
+        scope, left, right, jn = _join_scope()
+        sched = Scheduler(scope)
+        for i in range(100):
+            left.insert(ref_scalar(("l", i)), (i % 4, float(i)))
+        for i in range(4):
+            right.insert(ref_scalar(("r", i)), (i, 0.5))
+        sched.commit()
+        state = jn.op_state()
+        assert jn._columnar_ok  # snapshot did not degrade
+        scope2, l2, r2, jn2 = _join_scope()
+        jn2.restore_op_state(state)
+        assert not jn2._columnar_ok  # restored dicts take the row path
+        sched2 = Scheduler(scope2)
+        l2.insert(ref_scalar("new"), (2, -1.0))
+        sched2.commit()
+        assert len(jn2.current) == 101
+
+    def test_duplicate_key_inserts_fall_back(self):
+        """Same (key,row) inserted twice in one commit: the columnar path
+        must not take the batch (the dict arrangements collapse duplicate
+        multiplicity, so a later retraction would leave a phantom row)."""
+
+        def run(columnar):
+            scope, left, right, jn = _join_scope(columnar)
+            sched = Scheduler(scope)
+            k = ref_scalar("dup")
+            left.insert(k, (10, 1.0))
+            left.insert(k, (10, 1.0))  # duplicate
+            right.insert(ref_scalar("r"), (10, 5.0))
+            sched.commit()
+            first = len(jn.current)
+            left.remove(k, (10, 1.0))
+            sched.commit()
+            second = len(jn.current)
+            left.remove(k, (10, 1.0))
+            sched.commit()
+            return first, second, len(jn.current)
+
+        assert run(True) == run(False)
+
+    def test_filter_expression_columnar_chain(self):
+        """session -> expression -> filter stays columnar end to end and
+        matches the row path exactly."""
+        from pathway_tpu.engine import expression as ex
+
+        def run(threshold):
+            import pathway_tpu.engine.graph as graph_mod
+
+            old = graph_mod.VECTOR_THRESHOLD
+            graph_mod.VECTOR_THRESHOLD = threshold
+            try:
+                scope = Scope()
+                sess = scope.input_session(2)
+                expr = scope.expression_table(
+                    sess,
+                    [
+                        ex.ColumnRef(0),
+                        ex.Binary(
+                            "*", ex.ColumnRef(1), ex.Const(2.0)
+                        ),
+                        ex.Binary(">", ex.ColumnRef(0), ex.Const(100)),
+                    ],
+                )
+                filt = scope.filter_table(expr, 2)
+                sched = Scheduler(scope)
+                for i in range(1000):
+                    sess.insert(ref_scalar(i), (i, float(i)))
+                sched.commit()
+                return dict(filt.current)
+            finally:
+                graph_mod.VECTOR_THRESHOLD = old
+
+        fast, slow = run(256), run(1 << 60)
+        assert fast == slow
+        assert len(fast) == 899
+        row = fast[ref_scalar(101)]
+        assert row == (101, 202.0, True) and type(row[1]) is float
+
+    def test_nan_join_keys_fall_back(self):
+        scope, left, right, jn = _join_scope()
+        sched = Scheduler(scope)
+        left.insert(ref_scalar("a"), (float("nan"), 0.0))
+        right.insert(ref_scalar("x"), (float("nan"), 1.0))
+        sched.commit()
+        assert not jn._columnar_ok  # NaN identity is the dict path's call
+
+
 class TestSharedBatchAliasing:
     def test_buffer_end_flush_does_not_mutate_shared_batches(self):
         """BufferNode.take must not extend a taken batch in place: take()
