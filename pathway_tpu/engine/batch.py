@@ -177,12 +177,18 @@ class Columns:
             p._kbytes is not None or p._kb_thunk is not None for p in parts
         ):
             if any(p._kbytes is None for p in parts):
-                held = list(parts)  # keep laziness across the concat
+                # keep laziness across the concat, pinning only each
+                # part's KEY source — not the whole Columns (the output
+                # already owns fresh copies of every data column)
+                sources = [
+                    p._kb_thunk if p._kbytes is None else p._kbytes
+                    for p in parts
+                ]
                 return cls(
                     n,
                     cols,
                     kb_thunk=lambda: np.concatenate(
-                        [p.kbytes() for p in held]
+                        [s() if callable(s) else s for s in sources]
                     ),
                     diffs=(
                         None

@@ -726,42 +726,70 @@ def _keys_unique(kb: np.ndarray, n: int) -> bool:
 
 class _JoinSide:
     """One side's rows in columnar form: join-key array, key bytes, and
-    the full column set (object arrays where a column isn't clean)."""
+    the full column set (object arrays where a column isn't clean).
+    Unified-dtype key casts and the NaN screen are cached per side, so
+    probing a long-lived block costs the cast/scan once, not once per
+    commit."""
 
-    __slots__ = ("n", "jk", "kb", "cols")
+    __slots__ = ("n", "jk", "kb", "cols", "_jk_int", "_jk_f64", "_nan")
 
     def __init__(self, n, jk, kb, cols) -> None:
         self.n = n
         self.jk = jk
         self.kb = kb
         self.cols = cols
+        self._jk_int = None
+        self._jk_f64: Any = None  # False = not exactly representable
+        self._nan: bool | None = None
+
+    def jk_has_nan(self) -> bool:
+        if self._nan is None:
+            self._nan = (
+                self.jk.dtype.kind == "f" and bool(np.isnan(self.jk).any())
+            )
+        return self._nan
+
+    def jk_int(self) -> np.ndarray:
+        if self._jk_int is None:
+            self._jk_int = (
+                self.jk
+                if self.jk.dtype == np.int64
+                else self.jk.astype(np.int64)
+            )
+        return self._jk_int
+
+    def jk_f64(self) -> np.ndarray | None:
+        if self._jk_f64 is None:
+            jk = self.jk
+            if jk.dtype.kind == "i" and jk.size:
+                amax = int(np.abs(jk).max())
+                if amax < 0 or amax > _JOIN_FLOAT_EXACT:
+                    self._jk_f64 = False  # would round in float64
+                    return None
+            cast = jk if jk.dtype == np.float64 else jk.astype(np.float64)
+            self._jk_f64 = False if bool(np.isnan(cast).any()) else cast
+        return None if self._jk_f64 is False else self._jk_f64
 
 
 _JOIN_FLOAT_EXACT = 1 << 53
 
 
-def _unify_join_keys(a: np.ndarray, b: np.ndarray):
-    """Cast two join-key arrays to one comparison dtype matching Python
-    dict-key equality (True == 1 == 1.0), or None when vectorized
+def _unify_join_keys(a: "_JoinSide", b: "_JoinSide"):
+    """Key arrays of two sides cast to one comparison dtype matching
+    Python dict-key equality (True == 1 == 1.0), or None when vectorized
     equality would diverge (NaN identity, huge ints in float64, or
     cross-kind pairs like str vs int — route those to the dict path)."""
-    ka, kb_ = a.dtype.kind, b.dtype.kind
+    ka, kb_ = a.jk.dtype.kind, b.jk.dtype.kind
     if ka == kb_:
-        if ka == "f" and (np.isnan(a).any() or np.isnan(b).any()):
+        if ka == "f" and (a.jk_has_nan() or b.jk_has_nan()):
             return None
-        return a, b
+        return a.jk, b.jk
     kinds = {ka, kb_}
     if kinds <= {"b", "i"}:
-        return a.astype(np.int64), b.astype(np.int64)
+        return a.jk_int(), b.jk_int()
     if kinds <= {"b", "i", "f"}:
-        for arr in (a, b):
-            if arr.dtype.kind == "i" and arr.size:
-                amax = int(np.abs(arr).max())
-                if amax < 0 or amax > _JOIN_FLOAT_EXACT:
-                    return None  # not exactly float64-representable
-        a2 = a.astype(np.float64)
-        b2 = b.astype(np.float64)
-        if np.isnan(a2).any() or np.isnan(b2).any():
+        a2, b2 = a.jk_f64(), b.jk_f64()
+        if a2 is None or b2 is None:
             return None
         return a2, b2
     return None
@@ -976,7 +1004,7 @@ class JoinNode(Node):
             plan.append((ls, rs))
         matches = []
         for l, r in plan:
-            uni = _unify_join_keys(l.jk, r.jk)
+            uni = _unify_join_keys(l, r)
             if uni is None:
                 return None
             l_idx, r_idx = _match_join_pairs(*uni)
@@ -1159,15 +1187,17 @@ class JoinNode(Node):
         left_batch = self.take_raw(0)
         right_batch = self.take_raw(1)
         if self._columnar_ok:
-            if (
-                left_batch._raw_insert_only
-                or left_batch._insert_only
-                or not left_batch
-            ) and (
-                right_batch._raw_insert_only
-                or right_batch._insert_only
-                or not right_batch
-            ):
+
+            def insertish(b: DeltaBatch) -> bool:
+                return b._raw_insert_only or b._insert_only or not b
+
+            if not (insertish(left_batch) and insertish(right_batch)):
+                # hint absent ≠ retractions present (e.g. a row-path
+                # expression output): consolidation may prove the batch
+                # insert-only and keep the columnar join alive
+                left_batch = left_batch.consolidate()
+                right_batch = right_batch.consolidate()
+            if insertish(left_batch) and insertish(right_batch):
                 out = self._process_columnar_inner(left_batch, right_batch)
                 if out is not None:
                     return out
