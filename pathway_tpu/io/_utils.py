@@ -124,6 +124,29 @@ def attach_writer(
     G.add_sink(table, attach)
 
 
+def post_json(
+    url: str,
+    payload: dict,
+    token: str | None = None,
+    timeout: float = 60.0,
+    content_type: str = "application/json",
+) -> dict:
+    """POST a JSON body, parse the JSON response — the shared transport
+    behind the REST write connectors (BigQuery insertAll, Pub/Sub
+    publish, Kafka schema registry)."""
+    import json as _json
+    import urllib.request
+
+    headers = {"Content-Type": content_type}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        url, data=_json.dumps(payload).encode(), headers=headers, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return _json.loads(resp.read().decode())
+
+
 def require(module_names: str, feature: str, injected: Any = None) -> Any:
     """Gate a connector on its client library unless a client is injected."""
     if injected is not None:

@@ -1,16 +1,76 @@
 """pw.io.bigquery — write via the streaming insert API (reference:
-python/pathway/io/bigquery/__init__.py). Client seam:
-``insert_rows_json(table_id, [rows])``; google-cloud-bigquery adapts
-directly, tests inject a recorder."""
+python/pathway/io/bigquery/__init__.py).
+
+The REST protocol itself is implemented here
+(:class:`RestBigQueryClient`: ``tabledata.insertAll`` requests with
+``insertId`` deduplication ids), reachable through ``api_base=`` +
+``access_token=`` or a custom ``http_fn``; tests round-trip against an
+in-process HTTP fake speaking the same endpoint. The
+``insert_rows_json`` client seam remains for google-cloud-bigquery."""
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable
 
 from pathway_tpu.engine.formats import DocumentFormatter
 from pathway_tpu.engine.value import Pointer
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io._utils import attach_writer, require
+
+BIGQUERY_API = "https://bigquery.googleapis.com/bigquery/v2"
+
+
+class RestBigQueryClient:
+    """Speaks the BigQuery ``tabledata.insertAll`` REST endpoint:
+    ``POST {base}/projects/{p}/datasets/{d}/tables/{t}/insertAll`` with
+    per-row ``insertId`` deduplication ids."""
+
+    def __init__(
+        self,
+        project_id: str,
+        api_base: str = BIGQUERY_API,
+        access_token: str | None = None,
+        http_fn: Callable[[str, dict], dict] | None = None,
+    ) -> None:
+        self.project_id = project_id
+        self.api_base = api_base.rstrip("/")
+        if http_fn is None:
+            from pathway_tpu.io._utils import post_json
+
+            def http_fn(url: str, payload: dict) -> dict:
+                return post_json(url, payload, token=access_token)
+
+        self.http_fn = http_fn
+        # insertIds are BigQuery's best-effort dedup handle and must be
+        # globally unique: a restarted process reusing a counter would
+        # have its first rows silently swallowed as "duplicates"
+        import uuid
+
+        self._run_id = uuid.uuid4().hex
+        self._seq = 0
+
+    def insert_rows_json(self, table_id: str, rows: list[dict]) -> None:
+        dataset, _, table = table_id.partition(".")
+        url = (
+            f"{self.api_base}/projects/{self.project_id}/datasets/"
+            f"{dataset}/tables/{table}/insertAll"
+        )
+        payload_rows = []
+        for row in rows:
+            self._seq += 1
+            payload_rows.append(
+                {"insertId": f"pw-{self._run_id}-{self._seq}", "json": row}
+            )
+        body = self.http_fn(
+            url,
+            {
+                "kind": "bigquery#tableDataInsertAllRequest",
+                "rows": payload_rows,
+            },
+        )
+        errors = body.get("insertErrors")
+        if errors:
+            raise RuntimeError(f"bigquery insert errors: {errors}")
 
 
 class _BigQueryWriter:
@@ -39,8 +99,19 @@ def write(
     service_user_credentials_file: str | None = None,
     *,
     client: Any = None,
+    project_id: str | None = None,
+    access_token: str | None = None,
+    api_base: str = BIGQUERY_API,
     **kwargs: Any,
 ) -> None:
+    """Stream the table's update log into BigQuery. Client resolution:
+    explicit ``client=`` seam; else the built-in REST client when
+    ``project_id=`` is given (with ``access_token=``/``api_base=``);
+    else google-cloud-bigquery from the credentials file."""
+    if client is None and project_id is not None:
+        client = RestBigQueryClient(
+            project_id, api_base=api_base, access_token=access_token
+        )
     if client is None:
         bq = require("google.cloud.bigquery", "pw.io.bigquery")
         creds_client = bq.Client.from_service_account_json(

@@ -26,21 +26,156 @@ from pathway_tpu.io._utils import input_table
 _REQUEST_ID = "_pw_request_id"
 
 
-class PathwayWebserver:
-    """One aiohttp server shared by any number of rest_connector routes."""
+#: pw dtype -> OpenAPI property schema. Matched against the dtype repr:
+#: scalars print as uppercase names (INT, STR, ...), composites as
+#: capitalised constructors (List(INT), Tuple(...), Array(...), Pointer)
+def _openapi_type(dtype: Any) -> dict:
+    base = dtype.strip_optional() if hasattr(dtype, "strip_optional") else dtype
+    name = repr(base)
+    mapping = {
+        "INT": {"type": "integer"},
+        "FLOAT": {"type": "number", "format": "double"},
+        "BOOL": {"type": "boolean"},
+        "STR": {"type": "string"},
+        "BYTES": {"type": "string", "format": "byte"},
+        "DATE_TIME_NAIVE": {"type": "string", "format": "date-time"},
+        "DATE_TIME_UTC": {"type": "string", "format": "date-time"},
+        "DURATION": {"type": "string"},
+        "JSON": {},  # free-form
+    }
+    for key, spec in mapping.items():
+        if name.startswith(key):
+            return dict(spec)
+    if name.startswith(("List", "Tuple", "Array")):
+        return {"type": "array"}
+    if name.startswith("Pointer"):
+        return {"type": "string"}
+    return {}
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8080) -> None:
+
+class PathwayWebserver:
+    """One aiohttp server shared by any number of rest_connector routes.
+
+    ``with_schema_endpoint`` serves an OpenAPI 3.0.3 description of every
+    registered route at ``/_schema`` (``?format=json`` or the default
+    yaml), generated from each route's pw schema — mirroring the
+    reference webserver's schema endpoint
+    (python/pathway/io/http/_server.py:329). ``with_cors`` answers
+    preflight ``OPTIONS`` and stamps ``Access-Control-Allow-*`` headers
+    on every response (no external CORS dependency)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        with_schema_endpoint: bool = True,
+        with_cors: bool = False,
+    ) -> None:
         self.host = host
         self.port = port
+        self.with_cors = with_cors
         self._routes: dict[str, Callable] = {}
         self._started = False
         self._loop: asyncio.AbstractEventLoop | None = None
         self._ready = threading.Event()
+        self._openapi: dict[str, Any] = {
+            "openapi": "3.0.3",
+            "info": {
+                "title": "pathway_tpu generated openapi description",
+                "version": "1.0.0",
+            },
+            "paths": {},
+            "servers": [{"url": f"http://{host}:{port}/"}],
+        }
+        if with_schema_endpoint:
+            self._routes["/_schema"] = self._schema_handler
 
-    def add_route(self, route: str, handler: Callable) -> None:
+    def add_route(
+        self,
+        route: str,
+        handler: Callable,
+        schema: Any | None = None,
+        methods: Sequence[str] = ("GET", "POST"),
+    ) -> None:
         if self._started:
             raise RuntimeError("cannot add routes after the server started")
         self._routes[route] = handler
+        if schema is not None:
+            self._openapi["paths"][route] = self._route_docs(schema, methods)
+
+    def _route_docs(self, schema: Any, methods: Sequence[str]) -> dict:
+        columns = schema.column_names()
+        dtypes = dict(schema.dtypes())
+        required = [
+            n for n in columns if not getattr(dtypes[n], "is_optional", lambda: False)()
+        ]
+        properties = {n: _openapi_type(dtypes[n]) for n in columns}
+        docs: dict[str, Any] = {}
+        if "POST" in methods:
+            docs["post"] = {
+                "requestBody": {
+                    "content": {
+                        "application/json": {
+                            "schema": {
+                                "type": "object",
+                                "properties": properties,
+                                "required": required,
+                            }
+                        }
+                    },
+                    "required": True,
+                },
+                "responses": {"200": {"description": "OK"}},
+            }
+        if "GET" in methods:
+            docs["get"] = {
+                "parameters": [
+                    {
+                        "name": n,
+                        "in": "query",
+                        "required": n in required,
+                        "schema": properties[n] or {"type": "string"},
+                    }
+                    for n in columns
+                ],
+                "responses": {"200": {"description": "OK"}},
+            }
+        return docs
+
+    def openapi_description_json(self, origin: str | None = None) -> dict:
+        import copy
+
+        desc = copy.deepcopy(self._openapi)
+        if origin:
+            desc["servers"] = [{"url": origin}]
+        return desc
+
+    async def _schema_handler(self, request: Any):
+        from aiohttp import web
+
+        origin = f"{request.scheme}://{request.host}"
+        fmt = request.query.get("format", "yaml")
+        desc = self.openapi_description_json(origin)
+        if fmt == "json":
+            return web.json_response(desc)
+        if fmt != "yaml":
+            return web.json_response(
+                {"error": f"unknown format {fmt!r}; use 'json' or 'yaml'"},
+                status=400,
+            )
+        import yaml
+
+        return web.Response(
+            text=yaml.safe_dump(desc, sort_keys=False),
+            content_type="text/x-yaml",
+        )
+
+    _CORS_HEADERS = {
+        "Access-Control-Allow-Origin": "*",
+        "Access-Control-Allow-Headers": "*",
+        "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+        "Access-Control-Expose-Headers": "*",
+    }
 
     def start(self) -> None:
         if self._started:
@@ -53,10 +188,25 @@ class PathwayWebserver:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self._loop = loop
-            app = web.Application()
+            middlewares = []
+            if self.with_cors:
+                cors_headers = self._CORS_HEADERS
+
+                @web.middleware
+                async def cors_middleware(request, handler):
+                    if request.method == "OPTIONS":
+                        return web.Response(headers=cors_headers)
+                    resp = await handler(request)
+                    resp.headers.update(cors_headers)
+                    return resp
+
+                middlewares.append(cors_middleware)
+            app = web.Application(middlewares=middlewares)
             for route, handler in self._routes.items():
                 app.router.add_post(route, handler)
                 app.router.add_get(route, handler)
+                if self.with_cors:
+                    app.router.add_route("OPTIONS", route, handler)
             runner = web.AppRunner(app)
             loop.run_until_complete(runner.setup())
             site = web.TCPSite(runner, self.host, self.port)
@@ -165,7 +315,7 @@ def rest_connector(
             result = result["result"]
         return web.json_response(_jsonable(result))
 
-    server.add_route(route, handler)
+    server.add_route(route, handler, schema=schema)
 
     table = input_table(
         full_schema,

@@ -1,17 +1,26 @@
-"""pw.io.kafka — Kafka-shaped message-queue connector
+"""pw.io.kafka — Kafka connector speaking the real wire protocol
 (reference: python/pathway/io/kafka/__init__.py; KafkaReader
 src/connectors/data_storage.rs:673, KafkaWriter :1239).
 
-No Kafka client library ships in this image, so the broker is reached
-through an injectable **transport** (``MessageTransport``: poll_messages /
-finished / produce). ``transport=None`` tries confluent-kafka and raises a
-clear error when absent; tests and demos inject
-:class:`pathway_tpu.engine.storage.InMemoryTransport`.
+``transport=None`` (the default) connects to ``bootstrap.servers`` with
+the framework's own Kafka binary-protocol client
+(:mod:`pathway_tpu.io._kafka_wire`: Metadata/Produce/Fetch/ListOffsets,
+RecordBatch v2 with CRC32C) — no external Kafka library needed. Tests
+round-trip against :class:`pathway_tpu.io._kafka_wire.FakeKafkaBroker`
+over a real socket; an injectable transport (``MessageTransport``) and
+:class:`InMemoryTransport` remain for offline demos.
+
+Also provided, mirroring the reference module: Confluent-style schema
+registry support (``format='avro'`` with the 0x00+schema-id framing,
+:class:`SchemaRegistry`) and :func:`read_from_upstash` (Upstash Kafka
+REST consume API).
 """
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+import json as _json
+import struct as _struct
+from typing import Any, Callable, Sequence
 
 from pathway_tpu.engine.connectors import (
     INSERT,
@@ -28,8 +37,22 @@ from pathway_tpu.engine.storage import (
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io._utils import attach_writer, input_table
+from pathway_tpu.io._kafka_wire import (  # noqa: F401 — re-exported API
+    FakeKafkaBroker,
+    KafkaWireClient,
+    KafkaWireTransport,
+)
 
-__all__ = ["read", "write", "simple_read", "InMemoryTransport"]
+__all__ = [
+    "read",
+    "write",
+    "simple_read",
+    "read_from_upstash",
+    "InMemoryTransport",
+    "FakeKafkaBroker",
+    "KafkaWireTransport",
+    "SchemaRegistry",
+]
 
 
 class _KafkaJsonParser(Parser):
@@ -103,17 +126,191 @@ class _KafkaRawParser(Parser):
         return [ParsedEvent(INSERT, (value,))]
 
 
-def _default_transport(rdkafka_settings: dict, topic: str, **kwargs: Any):
-    try:
-        import confluent_kafka  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pw.io.kafka needs confluent-kafka (not installed here); pass "
-            "transport=<MessageTransport> to read without it"
-        ) from e
-    raise NotImplementedError(
-        "confluent-kafka transport wiring requires a live broker"
+def _default_transport(
+    rdkafka_settings: dict, topic: Any, mode: str = "streaming"
+) -> KafkaWireTransport:
+    bootstrap = rdkafka_settings.get("bootstrap.servers")
+    if not bootstrap:
+        raise ValueError(
+            "rdkafka_settings['bootstrap.servers'] is required when no "
+            "transport= is given"
+        )
+    if isinstance(topic, (list, tuple)):
+        if len(topic) != 1:
+            raise ValueError(
+                "the wire transport reads one topic per connector; create "
+                "one read() per topic"
+            )
+        topic = topic[0]
+    if topic is None:
+        raise ValueError("topic is required")
+    start = rdkafka_settings.get("auto.offset.reset", "earliest")
+    return KafkaWireTransport(
+        bootstrap.split(",")[0], topic, mode=mode, start=start
     )
+
+
+# -- Confluent-style schema registry ------------------------------------------
+
+
+class SchemaRegistry:
+    """Minimal Confluent schema-registry client (wire format: magic 0x00 +
+    int32 schema id + Avro body; reference kafka/__init__.py registry
+    support). ``request_fn(method, url, payload|None) -> dict`` is
+    injectable; the default uses urllib against ``url``."""
+
+    def __init__(
+        self,
+        url: str,
+        request_fn: Callable[[str, str, dict | None], dict] | None = None,
+    ) -> None:
+        self.url = url.rstrip("/")
+        if request_fn is None:
+
+            def request_fn(method: str, full_url: str, payload):
+                if method == "POST":
+                    from pathway_tpu.io._utils import post_json
+
+                    return post_json(
+                        full_url,
+                        payload,
+                        timeout=30.0,
+                        content_type=(
+                            "application/vnd.schemaregistry.v1+json"
+                        ),
+                    )
+                import urllib.request
+
+                req = urllib.request.Request(full_url, method=method)
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return _json.loads(resp.read().decode())
+
+        self.request_fn = request_fn
+        self._by_id: dict[int, Any] = {}
+
+    def get_schema(self, schema_id: int) -> Any:
+        got = self._by_id.get(schema_id)
+        if got is None:
+            body = self.request_fn(
+                "GET", f"{self.url}/schemas/ids/{schema_id}", None
+            )
+            got = _json.loads(body["schema"])
+            self._by_id[schema_id] = got
+        return got
+
+    def register(self, subject: str, schema: Any) -> int:
+        body = self.request_fn(
+            "POST",
+            f"{self.url}/subjects/{subject}/versions",
+            {"schema": _json.dumps(schema)},
+        )
+        schema_id = int(body["id"])
+        self._by_id[schema_id] = schema
+        return schema_id
+
+    def decode_message(self, raw: bytes) -> Any:
+        import io as _io
+
+        from pathway_tpu.io import _avro
+
+        if not raw or raw[0] != 0:
+            raise ValueError(
+                "not a schema-registry framed message (magic byte != 0)"
+            )
+        (schema_id,) = _struct.unpack(">i", raw[1:5])
+        schema = self.get_schema(schema_id)
+        return _avro.decode(_io.BytesIO(raw[5:]), schema)
+
+    def encode_message(self, schema_id: int, value: Any) -> bytes:
+        import io as _io
+
+        from pathway_tpu.io import _avro
+
+        out = _io.BytesIO()
+        out.write(b"\x00")
+        out.write(_struct.pack(">i", schema_id))
+        _avro.encode(out, self.get_schema(schema_id), value)
+        return out.getvalue()
+
+
+_AVRO_TYPES = {
+    "INT": "long",
+    "FLOAT": "double",
+    "BOOL": "boolean",
+    "STR": "string",
+    "BYTES": "bytes",
+}
+
+
+def _avro_schema_of(schema: schema_mod.SchemaMetaclass, name: str) -> dict:
+    fields = []
+    for col, dtype in dict(schema.dtypes()).items():
+        base = dtype.strip_optional()
+        avro_t: Any = _AVRO_TYPES.get(str(base), "string")
+        if dtype.is_optional():
+            avro_t = ["null", avro_t]
+        fields.append({"name": col, "type": avro_t})
+    return {"type": "record", "name": name, "fields": fields}
+
+
+class _KafkaAvroParser(Parser):
+    """Schema-registry framed Avro value -> schema columns (reference
+    kafka avro format with registry decoding)."""
+
+    def __init__(
+        self,
+        column_names: Sequence[str],
+        primary_key: Sequence[str] | None,
+        registry: SchemaRegistry,
+    ) -> None:
+        super().__init__(column_names)
+        self.primary_key = list(primary_key) if primary_key else None
+        self.session_type = "upsert" if self.primary_key else "native"
+        self.registry = registry
+
+    def parse(self, payload: Any) -> list[ParsedEvent]:
+        msg_key, value = payload
+        if value is None:
+            # tombstone: decode the message key exactly like the JSON
+            # parser so int / composite primary keys retract correctly
+            if not self.primary_key or msg_key is None:
+                return []
+            if isinstance(msg_key, bytes):
+                msg_key = msg_key.decode()
+            try:
+                decoded = _json.loads(msg_key)
+            except (ValueError, TypeError):
+                decoded = msg_key
+            if isinstance(decoded, dict):
+                key = tuple(decoded.get(k) for k in self.primary_key)
+            elif len(self.primary_key) == 1:
+                key = (decoded,)
+            else:
+                raise ValueError(
+                    "tombstone key must be a JSON object for a composite "
+                    "primary key"
+                )
+            return [ParsedEvent(UPSERT, None, key=key)]
+        obj = self.registry.decode_message(value)
+        values = tuple(obj.get(name) for name in self.column_names)
+        if self.primary_key:
+            key = tuple(obj.get(k) for k in self.primary_key)
+            return [ParsedEvent(UPSERT, values, key=key)]
+        return [ParsedEvent(INSERT, values)]
+
+
+class _AvroRegistryFormatter:
+    """Row -> schema-registry framed Avro message (write side)."""
+
+    def __init__(self, registry: SchemaRegistry, schema_id: int) -> None:
+        self.registry = registry
+        self.schema_id = schema_id
+
+    def format(self, key, values, column_names, time, diff):
+        obj = {name: v for name, v in zip(column_names, values)}
+        obj["time"] = time
+        obj["diff"] = diff
+        return self.registry.encode_message(self.schema_id, obj)
 
 
 def read(
@@ -122,18 +319,22 @@ def read(
     *,
     schema: schema_mod.SchemaMetaclass | None = None,
     format: str = "raw",  # noqa: A002
+    mode: str = "streaming",
     autocommit_duration_ms: int | None = 1500,
     primary_key: Sequence[str] | None = None,
     transport: Any = None,
+    schema_registry: SchemaRegistry | None = None,
     persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     """Read a topic. ``format``: 'raw'/'plaintext' (single ``data``
-    column), or 'json' (schema columns; with ``primary_key`` the stream is
+    column), 'json' (schema columns; with ``primary_key`` the stream is
     an upsert stream — later messages for a key replace earlier ones,
-    reference SessionType::Upsert adaptors.rs:48)."""
+    reference SessionType::Upsert adaptors.rs:48), or 'avro'
+    (schema-registry framed messages, needs ``schema_registry=``).
+    ``mode='static'`` reads to the topic end offset and finishes."""
     if transport is None:
-        transport = _default_transport(rdkafka_settings or {}, topic)
+        transport = _default_transport(rdkafka_settings or {}, topic, mode)
 
     if format in ("raw", "plaintext"):
         schema = schema_mod.schema_from_types(
@@ -145,6 +346,15 @@ def read(
             raise ValueError("format='json' needs schema=")
         pk = primary_key or schema.primary_key_columns() or None
         make_parser = lambda names: _KafkaJsonParser(names, pk)  # noqa: E731
+    elif format == "avro":
+        if schema is None:
+            raise ValueError("format='avro' needs schema=")
+        if schema_registry is None:
+            raise ValueError("format='avro' needs schema_registry=")
+        pk = primary_key or schema.primary_key_columns() or None
+        make_parser = lambda names: _KafkaAvroParser(  # noqa: E731
+            names, pk, schema_registry
+        )
     else:
         raise ValueError(f"unknown kafka format {format!r}")
 
@@ -175,18 +385,117 @@ def write(
     format: str = "json",  # noqa: A002
     key: str | None = None,
     transport: Any = None,
+    schema_registry: SchemaRegistry | None = None,
     **kwargs: Any,
 ) -> None:
-    """Produce one message per change (JSON row + time + diff)."""
+    """Produce one message per change. ``format='json'`` emits the row +
+    time + diff as JSON; ``format='avro'`` registers the table schema
+    under ``{topic}-value`` and emits schema-registry framed Avro."""
     if transport is None:
         transport = _default_transport(rdkafka_settings or {}, topic_name)
-    if format != "json":
+    if format == "json":
+        formatter: Any = JsonLinesFormatter()
+    elif format == "avro":
+        if schema_registry is None:
+            raise ValueError("format='avro' needs schema_registry=")
+        avro_schema = _avro_schema_of(
+            table.schema, (topic_name or "table") + "_value"
+        )
+        avro_schema["fields"] += [
+            {"name": "time", "type": "long"},
+            {"name": "diff", "type": "long"},
+        ]
+        schema_id = schema_registry.register(
+            f"{topic_name or 'table'}-value", avro_schema
+        )
+        formatter = _AvroRegistryFormatter(schema_registry, schema_id)
+    else:
         raise ValueError(f"unsupported kafka write format {format!r}")
 
     def make_writer(column_names):
         key_index = column_names.index(key) if key else None
         return MessageQueueWriter(
-            transport, JsonLinesFormatter(), column_names, key_index=key_index
+            transport, formatter, column_names, key_index=key_index
         )
 
     attach_writer(table, make_writer)
+
+
+def read_from_upstash(
+    endpoint: str,
+    username: str,
+    password: str,
+    topic: str,
+    *,
+    consumer_group: str = "pathway-group",
+    instance_name: str = "pathway-instance",
+    schema: schema_mod.SchemaMetaclass | None = None,
+    format: str = "raw",  # noqa: A002
+    autocommit_duration_ms: int | None = 1500,
+    primary_key: Sequence[str] | None = None,
+    request_fn: Callable[[str, dict], list] | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Consume a topic through the Upstash Kafka REST API (reference
+    kafka/__init__.py read_from_upstash): repeated POSTs to
+    ``{endpoint}/consume/{group}/{instance}/{topic}`` with basic auth;
+    each response item is ``{"key","value","offset","partition",...}``.
+    ``request_fn(url, headers) -> list`` is injectable for offline use."""
+    from pathway_tpu.engine.storage import Message
+
+    if request_fn is None:
+
+        def request_fn(url: str, headers: dict) -> list:  # pragma: no cover
+            import urllib.request
+
+            req = urllib.request.Request(url, method="POST", headers=headers)
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return _json.loads(resp.read().decode())
+
+    import base64
+
+    auth = base64.b64encode(f"{username}:{password}".encode()).decode()
+    url = (
+        f"{endpoint.rstrip('/')}/consume/{consumer_group}/"
+        f"{instance_name}/{topic}"
+    )
+    headers = {"Authorization": f"Basic {auth}"}
+
+    # an injected request_fn may carry a ``finished`` callable to end the
+    # stream (tests / bounded replays); the real REST consume never ends
+    finished_fn = getattr(request_fn, "finished", None)
+
+    class _UpstashTransport:
+        def poll_messages(self) -> list:
+            out = []
+            for item in request_fn(url, headers):
+                value = item.get("value")
+                if isinstance(value, str):
+                    value = value.encode()
+                msg_key = item.get("key")
+                if isinstance(msg_key, str):
+                    msg_key = msg_key.encode()
+                out.append(
+                    Message(
+                        value,
+                        key=msg_key,
+                        topic=item.get("topic", topic),
+                        partition=item.get("partition", 0),
+                        offset=item.get("offset", 0),
+                    )
+                )
+            return out
+
+        def finished(self) -> bool:
+            return bool(finished_fn()) if finished_fn is not None else False
+
+    return read(
+        None,
+        topic,
+        schema=schema,
+        format=format,
+        autocommit_duration_ms=autocommit_duration_ms,
+        primary_key=primary_key,
+        transport=_UpstashTransport(),
+        **kwargs,
+    )

@@ -1,16 +1,60 @@
 """pw.io.pubsub — publish update streams to Google Pub/Sub (reference:
-python/pathway/io/pubsub/__init__.py). Publisher seam:
-``publish(topic, data: bytes, **attrs)``."""
+python/pathway/io/pubsub/__init__.py).
+
+The REST protocol is implemented here (:class:`RestPublisher`:
+``POST {base}/v1/projects/{p}/topics/{t}:publish`` with base64 message
+data), reachable through ``project_id=`` + ``access_token=`` or a custom
+``http_fn``; tests round-trip against an in-process HTTP fake. The
+``publish(topic, data, **attrs)`` publisher seam remains for
+google-cloud-pubsub."""
 
 from __future__ import annotations
 
+import base64 as _base64
 import json
-from typing import Any
+from typing import Any, Callable
 
 from pathway_tpu.engine.connectors import JsonLinesFormatter
 from pathway_tpu.engine.value import Pointer
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io._utils import attach_writer, require
+
+PUBSUB_API = "https://pubsub.googleapis.com"
+
+
+class RestPublisher:
+    """Speaks the Pub/Sub ``topics.publish`` REST endpoint."""
+
+    def __init__(
+        self,
+        project_id: str,
+        api_base: str = PUBSUB_API,
+        access_token: str | None = None,
+        http_fn: Callable[[str, dict], dict] | None = None,
+    ) -> None:
+        self.project_id = project_id
+        self.api_base = api_base.rstrip("/")
+        if http_fn is None:
+            from pathway_tpu.io._utils import post_json
+
+            def http_fn(url: str, payload: dict) -> dict:
+                return post_json(url, payload, token=access_token)
+
+        self.http_fn = http_fn
+
+    def publish(self, topic: str, data: bytes, **attrs: Any) -> str:
+        url = (
+            f"{self.api_base}/v1/projects/{self.project_id}/topics/"
+            f"{topic}:publish"
+        )
+        message: dict[str, Any] = {
+            "data": _base64.b64encode(data).decode()
+        }
+        if attrs:
+            message["attributes"] = {k: str(v) for k, v in attrs.items()}
+        body = self.http_fn(url, {"messages": [message]})
+        ids = body.get("messageIds") or [""]
+        return ids[0]
 
 
 class _PubSubWriter:
@@ -38,8 +82,23 @@ def write(
     publisher: Any = None,
     project_id: str | None = None,
     topic_id: str | None = None,
+    *,
+    access_token: str | None = None,
+    api_base: str | None = None,
     **kwargs: Any,
 ) -> None:
+    """Publish the update log. Publisher resolution: explicit
+    ``publisher=`` seam; else the built-in REST publisher when
+    ``api_base=`` or ``access_token=`` is given; else
+    google-cloud-pubsub."""
+    if publisher is None and project_id is not None and (
+        api_base is not None or access_token is not None
+    ):
+        publisher = RestPublisher(
+            project_id,
+            api_base=api_base or PUBSUB_API,
+            access_token=access_token,
+        )
     if publisher is None:
         pubsub = require("google.cloud.pubsub_v1", "pw.io.pubsub")
         client = pubsub.PublisherClient()

@@ -431,8 +431,12 @@ def test_gated_connectors_raise_helpfully():
     # network and stay gated with a pointer to the local path
     with pytest.raises(NotImplementedError, match="warehouse"):
         pw.io.iceberg.write(t, "http://catalog", ["ns"], "t")
-    with pytest.raises(NotImplementedError):
-        pw.io.airbyte.read("config.yaml", ["stream"])
+    # local executable sources run for real now; only the docker/Cloud-Run
+    # execution types stay gated
+    with pytest.raises(NotImplementedError, match="docker"):
+        pw.io.airbyte.read(
+            "config.yaml", ["stream"], execution_type="docker"
+        )
     from pathway_tpu.internals import parse_graph
 
     parse_graph.G.clear()

@@ -1,0 +1,285 @@
+"""Kafka wire-protocol round trips: real frames over a real socket.
+
+The client and the in-process broker (pathway_tpu/io/_kafka_wire.py) both
+speak genuine Kafka protocol bytes (RecordBatch v2, CRC32C, varints), so
+these tests exercise actual frame encode/decode on both ends — not the
+injectable transport seam (VERDICT r3 #6; reference KafkaReader/Writer
+src/connectors/data_storage.rs:673,1239).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._kafka_wire import (
+    FakeKafkaBroker,
+    KafkaWireClient,
+    KafkaWireTransport,
+    WireRecord,
+    crc32c,
+    decode_record_batches,
+    encode_record_batch,
+)
+from pathway_tpu.io.kafka import SchemaRegistry
+
+
+class TestProtocolPrimitives:
+    def test_crc32c_known_vector(self):
+        # RFC 3720 test vector for CRC32C (Castagnoli)
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"") == 0
+
+    def test_record_batch_roundtrip(self):
+        records = [
+            WireRecord(value=b"v0", key=b"k0", timestamp=1000),
+            WireRecord(value=None, key=b"tombstone", timestamp=1001),
+            WireRecord(
+                value=b"v2",
+                key=None,
+                timestamp=1002,
+                headers=[("h", b"x"), ("h2", b"")],
+            ),
+        ]
+        raw = encode_record_batch(records, base_offset=7)
+        back = decode_record_batches(raw)
+        assert [(r.key, r.value, r.timestamp) for r in back] == [
+            (b"k0", b"v0", 1000),
+            (b"tombstone", None, 1001),
+            (None, b"v2", 1002),
+        ]
+        assert [r.offset for r in back] == [7, 8, 9]
+        assert back[2].headers == [("h", b"x"), ("h2", b"")]
+
+    def test_corrupted_batch_fails_crc(self):
+        raw = bytearray(
+            encode_record_batch([WireRecord(value=b"abc")], base_offset=0)
+        )
+        raw[-1] ^= 0xFF
+        with pytest.raises(ValueError, match="CRC32C"):
+            decode_record_batches(bytes(raw))
+
+
+class TestClientAgainstBroker:
+    def test_api_versions_metadata_produce_fetch(self):
+        with FakeKafkaBroker() as broker:
+            client = KafkaWireClient(broker.host, broker.port)
+            versions = client.api_versions()
+            assert versions[0] == (3, 3)  # Produce v3
+            meta = client.metadata(["events"])
+            assert meta["brokers"][0]["port"] == broker.port
+            assert meta["topics"]["events"]["partitions"][0]["leader"] == 0
+
+            base = client.produce(
+                "events",
+                0,
+                [
+                    WireRecord(value=b"one", key=b"a"),
+                    WireRecord(value=b"two", key=b"b"),
+                ],
+            )
+            assert base == 0
+            base2 = client.produce("events", 0, [WireRecord(value=b"three")])
+            assert base2 == 2
+            assert client.list_offsets("events", 0, -1) == 3
+            assert client.list_offsets("events", 0, -2) == 0
+
+            records, high = client.fetch("events", 0, 0)
+            assert high == 3
+            assert [r.value for r in records] == [b"one", b"two", b"three"]
+            tail, _ = client.fetch("events", 0, 2)
+            assert [r.value for r in tail] == [b"three"]
+            client.close()
+
+
+class TestPipelineRoundTrip:
+    def test_read_write_through_pw_run(self):
+        """produce real frames -> pw.io.kafka.read (json, static) ->
+        transform -> pw.io.kafka.write -> fetch raw frames back."""
+        G.clear()
+        with FakeKafkaBroker() as broker:
+            bootstrap = f"{broker.host}:{broker.port}"
+            feeder = KafkaWireTransport(bootstrap, "in-topic")
+            for i in range(20):
+                feeder.produce(json.dumps({"uid": i, "score": i * 1.5}))
+            feeder.close()
+
+            t = pw.io.kafka.read(
+                {"bootstrap.servers": bootstrap},
+                "in-topic",
+                schema=pw.schema_from_types(uid=int, score=float),
+                format="json",
+                mode="static",
+            )
+            big = t.filter(pw.this.score >= 15.0)
+            pw.io.kafka.write(
+                big,
+                {"bootstrap.servers": bootstrap},
+                "out-topic",
+                key="uid",
+            )
+            pw.run()
+
+            verify = KafkaWireClient(broker.host, broker.port)
+            records, _high = verify.fetch("out-topic", 0, 0)
+            rows = sorted(
+                json.loads(r.value.decode())["uid"] for r in records
+            )
+            keys = sorted(r.key.decode() for r in records)
+            assert rows == list(range(10, 20))
+            assert keys == sorted(str(i) for i in range(10, 20))
+            verify.close()
+
+    def test_upsert_stream_with_tombstones(self):
+        G.clear()
+        with FakeKafkaBroker() as broker:
+            bootstrap = f"{broker.host}:{broker.port}"
+            feeder = KafkaWireTransport(bootstrap, "users")
+            feeder.produce(json.dumps({"uid": 1, "name": "ann"}), key="1")
+            feeder.produce(json.dumps({"uid": 2, "name": "bob"}), key="2")
+            feeder.produce(json.dumps({"uid": 1, "name": "anna"}), key="1")
+            feeder.client.produce(
+                "users", 0, [WireRecord(value=None, key=b"2")]
+            )  # tombstone deletes uid 2
+            feeder.close()
+
+            t = pw.io.kafka.read(
+                {"bootstrap.servers": bootstrap},
+                "users",
+                schema=pw.schema_from_types(uid=int, name=str),
+                format="json",
+                mode="static",
+                primary_key=["uid"],
+            )
+            rows = {}
+            pw.io.subscribe(
+                t,
+                on_change=lambda key, row, time, is_addition: (
+                    rows.__setitem__(row["uid"], row["name"])
+                    if is_addition
+                    else rows.pop(row["uid"], None)
+                ),
+            )
+            pw.run()
+            assert rows == {1: "anna"}
+
+
+class _FakeRegistry:
+    """request_fn for SchemaRegistry: in-memory Confluent-API subset."""
+
+    def __init__(self) -> None:
+        self.schemas: dict[int, str] = {}
+        self.next_id = 1
+
+    def __call__(self, method: str, url: str, payload):
+        if method == "POST" and "/versions" in url:
+            sid = self.next_id
+            self.next_id += 1
+            self.schemas[sid] = payload["schema"]
+            return {"id": sid}
+        if method == "GET" and "/schemas/ids/" in url:
+            sid = int(url.rsplit("/", 1)[1])
+            return {"schema": self.schemas[sid]}
+        raise ValueError(f"unexpected {method} {url}")
+
+
+class TestSchemaRegistryAvro:
+    def test_avro_write_read_roundtrip(self):
+        G.clear()
+        reg_backend = _FakeRegistry()
+        with FakeKafkaBroker() as broker:
+            bootstrap = f"{broker.host}:{broker.port}"
+            registry = SchemaRegistry(
+                "http://registry.test", request_fn=reg_backend
+            )
+            src = pw.debug.table_from_markdown(
+                """
+                uid | amount
+                1   | 2.5
+                2   | 7.25
+                """
+            )
+            pw.io.kafka.write(
+                src,
+                {"bootstrap.servers": bootstrap},
+                "payments",
+                format="avro",
+                schema_registry=registry,
+            )
+            pw.run()
+            # messages on the wire carry the 0x00 + schema-id framing
+            raw = KafkaWireClient(broker.host, broker.port)
+            records, _ = raw.fetch("payments", 0, 0)
+            assert len(records) == 2
+            assert all(r.value[0] == 0 for r in records)
+            raw.close()
+
+            G.clear()
+            t = pw.io.kafka.read(
+                {"bootstrap.servers": bootstrap},
+                "payments",
+                schema=pw.schema_from_types(uid=int, amount=float),
+                format="avro",
+                mode="static",
+                schema_registry=SchemaRegistry(
+                    "http://registry.test", request_fn=reg_backend
+                ),
+            )
+            got = {}
+            pw.io.subscribe(
+                t,
+                on_change=lambda key, row, time, is_addition: got.__setitem__(
+                    row["uid"], row["amount"]
+                ),
+            )
+            pw.run()
+            assert got == {1: 2.5, 2: 7.25}
+
+
+class TestUpstash:
+    def test_read_from_upstash_consume_api(self):
+        G.clear()
+        batches = [
+            [
+                {"key": "a", "value": json.dumps({"x": 1}), "offset": 0},
+                {"key": "b", "value": json.dumps({"x": 2}), "offset": 1},
+            ],
+            [],
+        ]
+        seen_urls: list[str] = []
+        done = {"n": 0}
+
+        def fake_request(url: str, headers: dict) -> list:
+            seen_urls.append(url)
+            assert headers["Authorization"].startswith("Basic ")
+            batch = batches[0] if done["n"] == 0 else []
+            done["n"] += 1
+            return batch
+
+        # terminate the stream after the canned batch drained, so pw.run
+        # returns and no immortal poll thread outlives the test
+        fake_request.finished = lambda: done["n"] >= 2
+
+        t = pw.io.kafka.read_from_upstash(
+            "https://upstash.test",
+            "user",
+            "pass",
+            "clicks",
+            schema=pw.schema_from_types(x=int),
+            format="json",
+            request_fn=fake_request,
+        )
+        got = []
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: got.append(row["x"]),
+        )
+        pw.run()  # terminates via fake_request.finished
+        assert sorted(got) == [1, 2]
+        assert seen_urls[0] == (
+            "https://upstash.test/consume/pathway-group/"
+            "pathway-instance/clicks"
+        )

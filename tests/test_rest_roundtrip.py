@@ -86,6 +86,68 @@ class TestRestConnectorRoundTrip:
             value = body["result"] if isinstance(body, dict) else body
             assert value == i * 2, (i, body)
 
+    def test_openapi_schema_endpoint_and_cors(self):
+        """/_schema serves an OpenAPI 3.0.3 description generated from the
+        route schemas (reference _server.py:329 with_schema_endpoint), and
+        with_cors stamps Access-Control-* on responses + answers
+        preflight OPTIONS."""
+        G.clear()
+        port = _free_port()
+        server = pw.io.http.PathwayWebserver(
+            "127.0.0.1", port, with_cors=True
+        )
+        queries, attach = pw.io.http.rest_connector(
+            schema=pw.schema_from_types(q=str, k=int),
+            route="/v1/retrieve",
+            webserver=server,
+        )
+        result = queries.select(result=pw.this.q)
+        runner = GraphRunner()
+        attach(result, runner)
+        threading.Thread(target=runner.run, daemon=True).start()
+        # wait until the server answers
+        _post_with_retry(
+            f"http://127.0.0.1:{port}/v1/retrieve", {"q": "x", "k": 1}
+        )
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/_schema?format=json", timeout=10
+        ) as resp:
+            desc = json.loads(resp.read().decode())
+            cors_origin = resp.headers.get("Access-Control-Allow-Origin")
+        assert desc["openapi"] == "3.0.3"
+        path = desc["paths"]["/v1/retrieve"]
+        props = path["post"]["requestBody"]["content"][
+            "application/json"
+        ]["schema"]["properties"]
+        assert props == {
+            "q": {"type": "string"},
+            "k": {"type": "integer"},
+        }
+        get_params = {p["name"]: p for p in path["get"]["parameters"]}
+        assert get_params["k"]["schema"] == {"type": "integer"}
+        assert cors_origin == "*"
+
+        # yaml default format
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/_schema", timeout=10
+        ) as resp:
+            body = resp.read().decode()
+            assert resp.headers.get_content_type() == "text/x-yaml"
+        import yaml
+
+        assert yaml.safe_load(body)["paths"]["/v1/retrieve"]
+
+        # CORS preflight
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/retrieve", method="OPTIONS"
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert (
+                resp.headers.get("Access-Control-Allow-Methods")
+                == "GET, POST, OPTIONS"
+            )
+
     def test_qa_style_server_class(self):
         """The xpack server wrapper: BaseRestServer.serve + threaded run,
         the exact shape DocumentStoreServer/QARestServer use."""
