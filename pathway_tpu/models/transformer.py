@@ -147,14 +147,33 @@ def dense_attention(
 AttnFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array | None], jax.Array]
 
 
+def default_attn_fn() -> AttnFn:
+    """The attention implementation for the current backend: the Pallas
+    flash kernel on real TPU backends (O(t·d) HBM traffic), dense
+    attention elsewhere (CPU tests, virtual meshes — where the kernel
+    would run interpreted and slower). ``PATHWAY_DISABLE_FLASH_ATTENTION=1``
+    forces dense everywhere."""
+    import os
+
+    if os.environ.get("PATHWAY_DISABLE_FLASH_ATTENTION") == "1":
+        return dense_attention
+    if jax.default_backend() in ("tpu", "axon"):
+        from pathway_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention
+    return dense_attention
+
+
 def encoder_forward(
     params: Params,
     token_ids: jax.Array,  # [b, t] int32
     mask: jax.Array | None,  # [b, t] bool (True = real token)
     cfg: EncoderConfig,
-    attn_fn: AttnFn = dense_attention,
+    attn_fn: AttnFn | None = None,
 ) -> jax.Array:
     """Token-level hidden states ``[b, t, hidden]`` (compute in cfg.dtype)."""
+    if attn_fn is None:
+        attn_fn = default_attn_fn()
     b, t = token_ids.shape
     x = (
         params["tok_emb"][token_ids]
@@ -201,9 +220,10 @@ def embed(
     token_ids: jax.Array,
     mask: jax.Array | None,
     cfg: EncoderConfig,
-    attn_fn: AttnFn = dense_attention,
+    attn_fn: AttnFn | None = None,
 ) -> jax.Array:
-    """The embedder entry point: tokens -> normalised sentence embeddings."""
+    """The embedder entry point: tokens -> normalised sentence embeddings.
+    ``attn_fn=None`` picks the backend default (flash on TPU)."""
     return pool(encoder_forward(params, token_ids, mask, cfg, attn_fn), mask, cfg)
 
 

@@ -141,11 +141,15 @@ def vision_forward(
     params: Params,
     pixels: jax.Array,
     cfg: VisionConfig,
-    attn_fn=dense_attention,
+    attn_fn=None,
 ) -> jax.Array:
     """``pixels [b, H, W, 3]`` (normalised floats) -> L2-normalised
-    embeddings ``[b, out_dim]``. ``attn_fn`` is the attention seam
-    (dense by default; ops/flash_attention.py drops in)."""
+    embeddings ``[b, out_dim]``. ``attn_fn=None`` picks the backend
+    default (the Pallas flash kernel on TPU, dense elsewhere)."""
+    if attn_fn is None:
+        from pathway_tpu.models.transformer import default_attn_fn
+
+        attn_fn = default_attn_fn()
     b = pixels.shape[0]
     patches = patchify(pixels.astype(cfg.dtype), cfg)
     x = patches @ params["patch_w"].astype(cfg.dtype)

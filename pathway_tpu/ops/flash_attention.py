@@ -21,12 +21,14 @@ Details:
   zero queries and sliced back (model paths bucket to powers of two, so
   padding is the exception, not the rule).
 - f32 accumulators; inputs may be bf16.
-- differentiable: ``jax.custom_vjp`` with a dense-recompute backward
-  (the O(t^2) backward of the reference math — a flash backward kernel
-  is future work), so the kernel drops into the training seam too.
-- off-accelerator (CPU tests, virtual meshes) the kernel runs in Pallas
+- differentiable: ``jax.custom_vjp`` with a TILED backward — the
+  forward also emits the per-row logsumexp, and two Pallas kernels
+  recompute probabilities tile-by-tile (dQ over q tiles, dK/dV over k
+  tiles, the standard flash backward split), so the backward's HBM
+  traffic stays O(t·d) like the forward's.
+- off-accelerator (CPU tests, virtual meshes) the kernels run in Pallas
   interpret mode; on the TPU backends ("tpu", and this environment's
-  "axon" remote plugin) it compiles.
+  "axon" remote plugin) they compile.
 """
 
 from __future__ import annotations
@@ -48,6 +50,7 @@ def _flash_kernel(
     v_ref,  # [1, t, d]
     bias_ref,  # [1, 1, t]  additive mask (0 or -inf)
     o_ref,  # [1, block_q, d]
+    lse_ref,  # [1, block_q]  per-row logsumexp (backward residual)
     *,
     block_k: int,
     scale: float,
@@ -78,8 +81,10 @@ def _flash_kernel(
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, _m, l = jax.lax.fori_loop(0, t // block_k, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    acc, m, l = jax.lax.fori_loop(0, t // block_k, body, (acc0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
 
 
 @functools.partial(jax.jit, static_argnames=("h", "interpret"))
@@ -90,7 +95,7 @@ def _flash_bhtd(
     bias: jax.Array,  # [b, 1, t] — heads fold via the index map
     h: int,
     interpret: bool = False,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     bh, t, d = q.shape
     block_q = min(t, _BLOCK)
     block_k = min(t, _BLOCK)
@@ -98,7 +103,10 @@ def _flash_bhtd(
     grid = (bh, t // block_q)
     return pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -106,72 +114,234 @@ def _flash_bhtd(
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, t), lambda b, i, h=h: (b // h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ),
         interpret=interpret,
     )(q, k, v, bias)
 
 
-def _forward(q, k, v, bias):
-    """q/k/v [b, t, h, d], bias [b, t] additive -> [b, t, h, d]."""
-    b, t, h, d = q.shape
+def _flash_bwd_dq_kernel(
+    q_ref,  # [1, block_q, d]
+    k_ref,  # [1, t, d]
+    v_ref,  # [1, t, d]
+    bias_ref,  # [1, 1, t]
+    do_ref,  # [1, block_q, d]
+    lse_ref,  # [1, block_q]
+    delta_ref,  # [1, block_q]  rowsum(dO * O)
+    dq_ref,  # [1, block_q, d]
+    *,
+    block_k: int,
+    scale: float,
+):
+    t = k_ref.shape[1]
+    _one, block_q, d = q_ref.shape
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    def body(start, acc):
+        k_tile = k_ref[0, pl.dslice(start * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        v_tile = v_ref[0, pl.dslice(start * block_k, block_k), :].astype(
+            jnp.float32
+        )
+        bias = bias_ref[0, 0, pl.dslice(start * block_k, block_k)].astype(
+            jnp.float32
+        )
+        s = q @ k_tile.T + bias[None, :]
+        p = jnp.exp(s - lse[:, None])  # true softmax probs via saved lse
+        dp = do @ v_tile.T  # [block_q, block_k]
+        ds = p * (dp - delta[:, None])
+        return acc + ds @ k_tile
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    acc = jax.lax.fori_loop(0, t // block_k, body, acc0)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref,  # [1, t, d]
+    k_ref,  # [1, block_k, d]
+    v_ref,  # [1, block_k, d]
+    bias_ref,  # [1, 1, block_k]
+    do_ref,  # [1, t, d]
+    lse_ref,  # [1, t]
+    delta_ref,  # [1, t]
+    dk_ref,  # [1, block_k, d]
+    dv_ref,  # [1, block_k, d]
+    dbias_ref,  # [1, block_k]  sum of dS over heads' rows (this bh slice)
+    *,
+    block_q: int,
+    scale: float,
+):
+    t = q_ref.shape[1]
+    _one, block_k, d = k_ref.shape
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bias = bias_ref[0, 0].astype(jnp.float32)
+
+    def body(start, carry):
+        dk_acc, dv_acc, db_acc = carry
+        q_tile = q_ref[0, pl.dslice(start * block_q, block_q), :].astype(
+            jnp.float32
+        ) * scale
+        do_tile = do_ref[0, pl.dslice(start * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        lse = lse_ref[0, pl.dslice(start * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(start * block_q, block_q)]
+        s = q_tile @ k.T + bias[None, :]  # [block_q, block_k]
+        p = jnp.exp(s - lse[:, None])
+        dv_acc = dv_acc + p.T @ do_tile
+        dp = do_tile @ v.T
+        ds = p * (dp - delta[:, None])
+        dk_acc = dk_acc + ds.T @ q_tile  # q_tile already carries scale
+        db_acc = db_acc + ds.sum(axis=0)  # bias enters s unscaled
+        return dk_acc, dv_acc, db_acc
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    db0 = jnp.zeros((block_k,), jnp.float32)
+    dk, dv, db = jax.lax.fori_loop(
+        0, t // block_q, body, (zeros, zeros, db0)
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dbias_ref[0] = db
+
+
+@functools.partial(jax.jit, static_argnames=("h", "interpret"))
+def _flash_bwd_bhtd(
+    q: jax.Array,  # [bh, t, d]
+    k: jax.Array,
+    v: jax.Array,
+    bias: jax.Array,  # [b, 1, t]
+    do: jax.Array,  # [bh, t, d]
+    lse: jax.Array,  # [bh, t]
+    delta: jax.Array,  # [bh, t]
+    h: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    bh, t, d = q.shape
+    block = min(t, _BLOCK)
+    scale = 1.0 / math.sqrt(d)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, t // block),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, i, h=h: (b // h, 0, 0)),
+            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v, bias, do, lse, delta)
+    dk, dv, dbias = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block, scale=scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ),
+        grid=(bh, t // block),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block), lambda b, j, h=h: (b // h, 0, j)),
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, t), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, t), lambda b, j: (b, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block), lambda b, j: (b, j)),
+        ),
+        interpret=interpret,
+    )(q, k, v, bias, do, lse, delta)
+    return dq, dk, dv, dbias
+
+
+def _interpret() -> bool:
     # pallas compiles on real TPU backends; "axon" is this environment's
     # remote-TPU plugin (PALLAS_AXON_REMOTE_COMPILE). Anything else
     # (cpu tests, virtual meshes) interprets.
-    interpret = jax.default_backend() not in ("tpu", "axon")
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _pad_t(x, pad, fill=0.0):
+    if not pad:
+        return x
+    shape = (x.shape[0], pad) + x.shape[2:]
+    return jnp.concatenate([x, jnp.full(shape, fill, x.dtype)], axis=1)
+
+
+def _prepare(q, k, v, bias):
+    """Pad to the tile size and fold [b,t,h,d] -> [b*h,t,d]."""
+    b, t, h, d = q.shape
     block = min(t, _BLOCK)
     pad = (-t) % block
-    if pad:
-        # tail tile: masked keys contribute -inf bias; extra query rows
-        # compute garbage that is sliced away below
-        zeros = lambda x: jnp.zeros(  # noqa: E731
-            (b, pad) + x.shape[2:], x.dtype
-        )
-        q = jnp.concatenate([q, zeros(q)], axis=1)
-        k = jnp.concatenate([k, zeros(k)], axis=1)
-        v = jnp.concatenate([v, zeros(v)], axis=1)
-        bias = jnp.concatenate(
-            [bias, jnp.full((b, pad), _NEG_INF, bias.dtype)], axis=1
-        )
+    # tail tile: masked keys contribute -inf bias; extra query rows
+    # compute garbage that is sliced away on exit
+    q, k, v = _pad_t(q, pad), _pad_t(k, pad), _pad_t(v, pad)
+    bias = _pad_t(bias, pad, fill=_NEG_INF)
 
     def to_bhtd(x):
-        tt = x.shape[1]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, tt, d)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    out = _flash_bhtd(
-        to_bhtd(q), to_bhtd(k), to_bhtd(v), bias[:, None, :], h,
-        interpret=interpret,
-    )
-    tt = out.shape[1]
-    out = out.reshape(b, h, tt, d).transpose(0, 2, 1, 3)
-    return out[:, :t] if pad else out
+    return to_bhtd(q), to_bhtd(k), to_bhtd(v), bias, pad
+
+
+def _from_bhtd(x, b, h, t):
+    tt = x.shape[1]
+    out = x.reshape(b, h, tt, -1).transpose(0, 2, 1, 3)
+    return out[:, :t] if tt != t else out
 
 
 @jax.custom_vjp
 def _flash_diff(q, k, v, bias):
-    return _forward(q, k, v, bias)
+    return _flash_diff_fwd(q, k, v, bias)[0]
 
 
 def _flash_diff_fwd(q, k, v, bias):
-    return _forward(q, k, v, bias), (q, k, v, bias)
+    b, t, h, _d = q.shape
+    interpret = _interpret()
+    qb, kb, vb, bias_p, pad = _prepare(q, k, v, bias)
+    out_b, lse = _flash_bhtd(
+        qb, kb, vb, bias_p[:, None, :], h, interpret=interpret
+    )
+    out = _from_bhtd(out_b, b, h, t)
+    res = (qb, kb, vb, bias_p, out_b, lse, (b, t, h, pad, interpret))
+    return out, res
 
 
 def _flash_diff_bwd(res, g):
-    # dense-recompute backward: exact gradients via the reference math
-    # (O(t^2) memory for the backward only; a flash backward kernel is
-    # the round-4 item)
-    q, k, v, bias = res
-
-    def dense(q_, k_, v_, bias_):
-        d = q_.shape[-1]
-        s = jnp.einsum("bthd,bshd->bhts", q_, k_).astype(
-            jnp.float32
-        ) / math.sqrt(d)
-        s = s + bias_[:, None, None, :]
-        p = jax.nn.softmax(s, axis=-1).astype(v_.dtype)
-        return jnp.einsum("bhts,bshd->bthd", p, v_)
-
-    _out, vjp = jax.vjp(dense, q, k, v, bias)
-    return vjp(g)
+    qb, kb, vb, bias_p, out_b, lse, (b, t, h, pad, interpret) = res
+    d = qb.shape[-1]
+    g = _pad_t(g, pad)
+    do = g.transpose(0, 2, 1, 3).reshape(b * h, g.shape[1], d)
+    delta = (do.astype(jnp.float32) * out_b.astype(jnp.float32)).sum(-1)
+    dq, dk, dv, dbias_bh = _flash_bwd_bhtd(
+        qb, kb, vb, bias_p[:, None, :], do, lse, delta, h,
+        interpret=interpret,
+    )
+    tt = qb.shape[1]
+    dbias = dbias_bh.reshape(b, h, tt).sum(axis=1)[:, :t]
+    return (
+        _from_bhtd(dq, b, h, t),
+        _from_bhtd(dk, b, h, t),
+        _from_bhtd(dv, b, h, t),
+        dbias.astype(jnp.float32),
+    )
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -184,7 +354,7 @@ def flash_attention(
     mask: jax.Array | None,  # [b, t] bool
 ) -> jax.Array:
     """Drop-in ``AttnFn`` (models/transformer.py dense_attention
-    contract), differentiable (dense-recompute backward)."""
+    contract), differentiable end to end (tiled flash backward)."""
     b, t = q.shape[:2]
     if mask is None:
         bias = jnp.zeros((b, t), jnp.float32)
