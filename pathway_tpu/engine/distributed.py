@@ -128,6 +128,8 @@ class MeshTransport:
         self._send_locks: dict[int, threading.Lock] = {}
         self._threads: list[threading.Thread] = []
         self._closed = False
+        #: peers whose socket closed/errored (set by the recv loops)
+        self.dead_peers: set[int] = set()
         self._secret = _mesh_secret()
         if n_processes == 1:
             return
@@ -244,7 +246,22 @@ class MeshTransport:
             while True:
                 q.put(self._read_frame(sock))
         except (ConnectionError, OSError, EOFError, pickle.PickleError):
+            # mark BEFORE enqueueing: a coordinator that never recv()s
+            # from this peer still observes the death via
+            # raise_if_peer_dead() at its next pump tick — send-side
+            # detection alone needs TWO sends after the RST (the first
+            # one buffers), which stalls fail-stop for idle streams
+            self.dead_peers.add(peer)
             q.put(("__eof__", peer))
+
+    def raise_if_peer_dead(self) -> None:
+        """Fail-stop promptly when any peer's socket closed (reference
+        teardown on worker loss, dataflow.rs:5854-5883)."""
+        if self.dead_peers and not self._closed:
+            dead = sorted(self.dead_peers)
+            raise RuntimeError(
+                f"process {self.process_id}: peer(s) {dead} disconnected"
+            )
 
     def _send(self, peer: int, frame: Any) -> None:
         payload = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)

@@ -1310,6 +1310,7 @@ class DistributedGraphRunner:
 
         def on_data() -> None:
             nonlocal last_sign_of_life
+            transport.raise_if_peer_dead()
             started = _time.monotonic()
             transport.broadcast(("cmd", "commit"))
             time = sched.commit_local()
@@ -1321,11 +1322,20 @@ class DistributedGraphRunner:
                 self.monitor.on_commit(time, started)
             last_sign_of_life = started
 
+        from pathway_tpu.engine.distributed import RECV_TIMEOUT
+
+        # pings must always undercut the followers' recv timeout, or a
+        # quiet stream trips spurious peer-crash errors
+        ping_every = min(30.0, RECV_TIMEOUT / 2.0)
+
         def on_idle() -> None:
+            # fail-stop promptly when a peer's socket closed — the
+            # send path alone needs TWO sends after the RST to notice
+            transport.raise_if_peer_dead()
             # keep follower recv timeouts from tripping during long quiet
             # stretches of a streaming run
             nonlocal last_sign_of_life
-            if _time.monotonic() - last_sign_of_life > 30.0:
+            if _time.monotonic() - last_sign_of_life > ping_every:
                 transport.broadcast(("cmd", "ping"))
                 last_sign_of_life = _time.monotonic()
 

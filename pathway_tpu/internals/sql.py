@@ -34,8 +34,10 @@ _TOKEN_RE = re.compile(
 
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "as", "and", "or",
-    "not", "join", "inner", "left", "on", "union", "all", "intersect", "in", "count", "sum",
-    "min", "max", "avg", "null", "true", "false", "is",
+    "not", "join", "inner", "left", "on", "union", "all", "intersect",
+    "except", "in", "count", "sum", "min", "max", "avg", "null", "true",
+    "false", "is", "case", "when", "then", "else", "end", "between",
+    "like", "cast", "coalesce", "nullif", "distinct",
 }
 
 
@@ -108,14 +110,23 @@ class _Parser:
         standard SQL precedence) — shared by top-level queries and derived
         tables."""
         q = self.parse_intersect_chain()
-        while self.accept("kw", "union"):
-            self.expect("kw", "all")
-            q = {
-                "kind": "union",
-                "left": q,
-                "right": self.parse_intersect_chain(),
-            }
-        return q
+        while True:
+            if self.accept("kw", "union"):
+                distinct = not self.accept("kw", "all")
+                q = {
+                    "kind": "union",
+                    "distinct": distinct,
+                    "left": q,
+                    "right": self.parse_intersect_chain(),
+                }
+            elif self.accept("kw", "except"):
+                q = {
+                    "kind": "except",
+                    "left": q,
+                    "right": self.parse_intersect_chain(),
+                }
+            else:
+                return q
 
     def parse_intersect_chain(self) -> dict:
         q = self.parse_select()
@@ -223,12 +234,24 @@ class _Parser:
             self.expect("kw", "null")
             return ("is_not_null" if negated else "is_null", e)
         negated_in = False
-        if self.peek() == ("kw", "not") and self.tokens[self.i + 1] == (
-            "kw",
-            "in",
-        ):
+        if self.peek() == ("kw", "not") and self.tokens[self.i + 1][0] == "kw" and self.tokens[
+            self.i + 1
+        ][1] in ("in", "between", "like"):
             self.next()
             negated_in = True
+        if self.accept("kw", "between"):
+            lo = self.parse_add()
+            self.expect("kw", "and")
+            hi = self.parse_add()
+            node = ("and", (">=", e, lo), ("<=", e, hi))
+            return ("not", node) if negated_in else node
+        if self.accept("kw", "like"):
+            k2, pattern = self.next()
+            if k2 != "str":
+                raise ValueError("pw.sql: LIKE needs a string literal pattern")
+            # negation folds into the node so NULL propagates through
+            # NOT LIKE too (SQL three-valued logic: NULL LIKE x is NULL)
+            return ("like", e, pattern, negated_in)
         if self.accept("kw", "in"):
             self.expect("op", "(")
             values = [self.parse_expr()]
@@ -272,9 +295,47 @@ class _Parser:
             if v == "count" and self.accept("op", "*"):
                 self.expect("op", ")")
                 return ("agg", "count", None)
+            if v == "count" and self.accept("kw", "distinct"):
+                arg = self.parse_expr()
+                self.expect("op", ")")
+                return ("agg", "count_distinct", arg)
             arg = self.parse_expr()
             self.expect("op", ")")
             return ("agg", v, arg)
+        if k == "kw" and v == "case":
+            arms = []
+            while self.accept("kw", "when"):
+                cond = self.parse_expr()
+                self.expect("kw", "then")
+                arms.append((cond, self.parse_expr()))
+            default = ("lit", None)
+            if self.accept("kw", "else"):
+                default = self.parse_expr()
+            self.expect("kw", "end")
+            if not arms:
+                raise ValueError("pw.sql: CASE needs at least one WHEN arm")
+            return ("case", arms, default)
+        if k == "kw" and v == "cast":
+            self.expect("op", "(")
+            e = self.parse_expr()
+            self.expect("kw", "as")
+            tname = self.expect("name").lower()
+            self.expect("op", ")")
+            return ("cast", e, tname)
+        if k == "kw" and v == "coalesce":
+            self.expect("op", "(")
+            args = [self.parse_expr()]
+            while self.accept("op", ","):
+                args.append(self.parse_expr())
+            self.expect("op", ")")
+            return ("coalesce", args)
+        if k == "kw" and v == "nullif":
+            self.expect("op", "(")
+            a = self.parse_expr()
+            self.expect("op", ",")
+            b = self.parse_expr()
+            self.expect("op", ")")
+            return ("nullif", a, b)
         if k == "kw" and v == "null":
             return ("lit", None)
         if k == "kw" and v == "true":
@@ -302,21 +363,40 @@ class _Lowerer:
         # duplicate names across join sides are qualified as f"{alias}_{name}"
         self.colmap: dict[str, dict[str, str]] = {}
 
+    @staticmethod
+    def _distinct(t: Table) -> Table:
+        cols = t.column_names()
+        return t.groupby(*[t[c] for c in cols]).reduce(
+            **{c: t[c] for c in cols}
+        )
+
     def lower(self, q: dict) -> Table:
         if q["kind"] == "union":
             left = self.lower(q["left"])
             right = self.lower(q["right"])
-            return left.concat_reindex(right)
+            merged = left.concat_reindex(right)
+            if q.get("distinct"):
+                return self._distinct(merged)
+            return merged
+        if q["kind"] == "except":
+            # set difference: distinct left rows with no equal right row
+            left = self._distinct(self.lower(q["left"]))
+            right = self._distinct(self.lower(q["right"]))
+            lcols = left.column_names()
+            rcols = right.column_names()
+            if len(lcols) != len(rcols):
+                raise ValueError("EXCEPT sides must have equal arity")
+            conds = [left[lc] == right[rc] for lc, rc in zip(lcols, rcols)]
+            # the arity-0 select materialises the JoinResult into a Table
+            # (difference needs a universe); no column payload is carried
+            matched = left.join(right, *conds, id=left.id).select()
+            kept = left.difference(matched)
+            return kept.select(**{lc: kept[lc] for lc in lcols})
         if q["kind"] == "intersect":
             # set semantics: distinct rows present on both sides. Each side
             # deduplicates FIRST so duplicate-heavy inputs can't blow up
             # the join (k*m rows per repeated value otherwise)
-            def distinct(t: Table) -> Table:
-                cols = t.column_names()
-                return t.groupby(*[t[c] for c in cols]).reduce(
-                    **{c: t[c] for c in cols}
-                )
-
+            distinct = self._distinct
             left = distinct(self.lower(q["left"]))
             right = distinct(self.lower(q["right"]))
             lcols = left.column_names()
@@ -399,6 +479,8 @@ class _Lowerer:
             for part in parts[1:]:
                 out = out | part
             return out
+        if op in ("case", "like", "cast", "coalesce", "nullif"):
+            return self._special(node, lambda n: self.expr(n, scope))
         left = self.expr(node[1], scope)
         right = self.expr(node[2], scope)
         return {
@@ -415,12 +497,91 @@ class _Lowerer:
             ">=": lambda: left >= right,
         }[op]()
 
-    def _agg_expr(self, node: Any, scope: dict[str, Table]) -> Any:
-        """Expression where ('agg', fn, arg) becomes a reducer expression."""
+    def _special(self, node: Any, rec: Any) -> Any:
+        """CASE / LIKE / CAST / COALESCE / NULLIF lowering, shared by the
+        plain and aggregate expression walkers (``rec`` recurses with the
+        right walker)."""
+        from pathway_tpu.internals.expression import if_else
+
+        op = node[0]
+        if op == "case":
+            arms, default = node[1], node[2]
+            out = rec(default)
+            for cond, val in reversed(arms):
+                out = if_else(rec(cond), rec(val), out)
+            return out
+        if op == "like":
+            pattern, negated = node[2], node[3]
+            regex = re.compile(
+                "^"
+                + re.escape(pattern).replace("%", ".*").replace("_", ".")
+                + "$",
+                re.DOTALL,
+            )
+
+            def like(s, _rx=regex, _neg=negated):
+                if s is None:
+                    return None  # NULL [NOT] LIKE x is NULL: WHERE drops it
+                return bool(_rx.match(str(s))) != _neg
+
+            return pw_apply(like, rec(node[1]))
+        if op == "cast":
+            def to_bool(v):
+                if isinstance(v, str):
+                    s = v.strip().lower()
+                    if s in ("true", "t", "1", "yes", "on"):
+                        return True
+                    if s in ("false", "f", "0", "no", "off"):
+                        return False
+                    raise ValueError(f"invalid boolean literal {v!r}")
+                return bool(v)
+
+            target = {
+                "int": int,
+                "integer": int,
+                "bigint": int,
+                "float": float,
+                "double": float,
+                "real": float,
+                "text": str,
+                "varchar": str,
+                "string": str,
+                "bool": to_bool,
+                "boolean": to_bool,
+            }.get(node[2])
+            if target is None:
+                raise ValueError(f"pw.sql: unsupported CAST type {node[2]!r}")
+            return pw_apply(
+                lambda v, _t=target: None if v is None else _t(v),
+                rec(node[1]),
+            )
+        if op == "coalesce":
+            args = [rec(a) for a in node[1]]
+            out = args[-1]
+            for a in reversed(args[:-1]):
+                out = if_else(a.is_not_none(), a, out)
+            return out
+        if op == "nullif":
+            a, b = rec(node[1]), rec(node[2])
+            return if_else(a == b, wrap_expression(None), a)
+        raise AssertionError(op)
+
+    def _agg_expr(
+        self, node: Any, scope: dict[str, Table], gb: tuple = ()
+    ) -> Any:
+        """Expression where ('agg', fn, arg) becomes a reducer expression.
+        ``gb`` maps GROUP BY key ASTs to their materialized key columns:
+        any subtree structurally equal to a group key lowers to that key
+        (required for computed keys, which are invalid inside reduce)."""
+        for g_ast, g_expr in gb:
+            if node == g_ast:
+                return g_expr
         if isinstance(node, tuple) and node[0] == "agg":
             fn, arg = node[1], node[2]
             if fn == "count":
                 return reducers.count()
+            if fn == "count_distinct":
+                return reducers.count_distinct(self.expr(arg, scope))
             inner = self.expr(arg, scope)
             return {
                 "sum": reducers.sum,
@@ -432,16 +593,20 @@ class _Lowerer:
             if node[0] == "in":
                 # ('in', expr, [values]): OR chain of equalities; the
                 # values list is NOT an expression child
-                e = self._agg_expr(node[1], scope)
+                e = self._agg_expr(node[1], scope, gb)
                 out = None
                 for v in node[2]:
-                    part = e == self._agg_expr(v, scope)
+                    part = e == self._agg_expr(v, scope, gb)
                     out = part if out is None else (out | part)
                 return out
             if node[0] in ("is_null", "is_not_null"):
-                e = self._agg_expr(node[1], scope)
+                e = self._agg_expr(node[1], scope, gb)
                 return e.is_none() if node[0] == "is_null" else e.is_not_none()
-            parts = [self._agg_expr(c, scope) for c in node[1:]]
+            if node[0] in ("case", "like", "cast", "coalesce", "nullif"):
+                return self._special(
+                    node, lambda n: self._agg_expr(n, scope, gb)
+                )
+            parts = [self._agg_expr(c, scope, gb) for c in node[1:]]
             return self._combine(node[0], parts)
         return self.expr(node, scope)
 
@@ -570,14 +735,15 @@ class _Lowerer:
                     for i, b in enumerate(by_exprs)
                 ]
             grouped = current.groupby(*by_exprs)
+            gb = tuple(zip(q["group_by"], by_exprs))
             out: dict[str, Any] = {}
             for idx, (node, alias) in enumerate(q["items"]):
                 if node == "*":
                     raise ValueError("pw.sql: SELECT * with GROUP BY")
                 name = self._item_name(node, alias, idx)
-                out[name] = self._agg_expr(node, scope)
+                out[name] = self._agg_expr(node, scope, gb)
             if q["having"] is not None:
-                out["_pw_having"] = self._agg_expr(q["having"], scope)
+                out["_pw_having"] = self._agg_expr(q["having"], scope, gb)
             result = grouped.reduce(**out)
             if q["having"] is not None:
                 result = result.filter(result["_pw_having"])[

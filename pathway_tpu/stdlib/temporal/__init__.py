@@ -329,10 +329,24 @@ class WindowedTable:
             windows["_pw_window_end"] == reduced["_pw_window_end"],
             how="left",
         )
+        from pathway_tpu.internals.expression import ColumnReference
+
         out_cols = {}
         for n in user_names:
+            resolved = resolved_kwargs[n]
+            bound_ref = (
+                resolved.name
+                if isinstance(resolved, ColumnReference)
+                and resolved.name in ("_pw_window_start", "_pw_window_end")
+                else None
+            )
             if n in ("_pw_window_start", "_pw_window_end"):
                 out_cols[n] = windows[n]
+            elif bound_ref is not None:
+                # a user-renamed window bound (e.g. start=this._pw_window_start)
+                # must keep its value for EMPTY windows too — the reduced
+                # side is all-None there
+                out_cols[n] = windows[bound_ref]
             else:
                 out_cols[n] = reduced[n]
         return join.select(**out_cols)

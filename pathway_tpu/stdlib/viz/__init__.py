@@ -92,24 +92,182 @@ def table_viz(
     G.add_sink(table, attach)
 
 
+class LiveDashboard:
+    """Live streaming web dashboard — the TPU-repo equivalent of the
+    reference's Bokeh/Panel notebook dashboards (stdlib/viz/plotting.py):
+    no notebook stack ships in this image, so the dashboard is a
+    dependency-free web page served by the framework itself. Subscribed
+    tables stream into row snapshots; the page polls ``/data`` and
+    re-renders tables plus an SVG row-count sparkline per table.
+
+    Usage::
+
+        dash = pw.stdlib.viz.LiveDashboard(port=8099)
+        dash.add(my_table, title="events")
+        ...
+        pw.run()   # dashboard live at http://127.0.0.1:8099/
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8099,
+        max_rows: int = 50,
+        history: int = 600,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.max_rows = max_rows
+        self.history = history
+        self._tables: dict[str, dict] = {}
+        self._server = None
+        self._started = False
+        import threading
+
+        self._lock = threading.Lock()
+
+    def add(self, table: Table, title: str | None = None) -> None:
+        from pathway_tpu.internals.parse_graph import G
+        from pathway_tpu.internals.viz_model import RowSnapshot
+
+        name = title or f"table_{len(self._tables)}"
+        column_names = table.column_names()
+        snap = RowSnapshot(column_names, self.max_rows)
+        entry = {"snapshot": snap, "counts": [], "commits": 0}
+        self._tables[name] = entry
+
+        def attach(scope, node):
+            def on_change(key, values, time, diff):
+                with self._lock:
+                    snap.apply(
+                        key, dict(zip(column_names, values)), diff > 0
+                    )
+
+            def on_time_end(time):
+                with self._lock:
+                    entry["commits"] += 1
+                    entry["counts"].append(len(snap.rows))
+                    del entry["counts"][: -self.history]
+                self._ensure_server()
+
+            scope.subscribe_table(
+                node, on_change=on_change, on_time_end=on_time_end
+            )
+            return None
+
+        G.add_sink(table, attach)
+
+    # -- serving ------------------------------------------------------------
+
+    def snapshot_json(self) -> dict:
+        with self._lock:
+            out = {}
+            for name, entry in self._tables.items():
+                snap = entry["snapshot"]
+                out[name] = {
+                    "columns": list(snap.column_names),
+                    "rows": [
+                        [str(v) for v in row] for row in snap.visible()
+                    ],
+                    "n_rows": len(snap.rows),
+                    "overflow": snap.overflow,
+                    "commits": entry["commits"],
+                    "count_history": list(entry["counts"]),
+                }
+            return out
+
+    _PAGE = """<!doctype html><html><head><title>pathway dashboard</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+h2{margin:.8rem 0 .3rem}
+table{border-collapse:collapse;background:#fff;box-shadow:0 1px 3px #0002}
+td,th{border:1px solid #ddd;padding:.25rem .6rem;font-size:.85rem}
+th{background:#f0f0f0}.meta{color:#666;font-size:.8rem}
+svg{background:#fff;box-shadow:0 1px 3px #0002;margin:.3rem 0}
+</style></head><body><h1>pathway live dashboard</h1>
+<div id="root"></div><script>
+function spark(h){if(!h.length)return "";const W=420,H=60,m=Math.max(...h,1);
+const pts=h.map((v,i)=>`${(i/(Math.max(h.length-1,1)))*W},${H-(v/m)*(H-6)-3}`).join(" ");
+return `<svg width="${W}" height="${H}"><polyline fill="none" stroke="#2a6" stroke-width="2" points="${pts}"/></svg>`}
+async function tick(){try{
+const d=await (await fetch('data')).json();let html='';
+for(const [name,t] of Object.entries(d)){
+html+=`<h2>${name}</h2><div class="meta">${t.n_rows} rows · ${t.commits} commits</div>`;
+html+=spark(t.count_history);
+html+='<table><tr>'+t.columns.map(c=>`<th>${c}</th>`).join('')+'</tr>';
+for(const r of t.rows){html+='<tr>'+r.map(v=>`<td>${v}</td>`).join('')+'</tr>'}
+html+='</table>';if(t.overflow){html+=`<div class="meta">… ${t.overflow} more rows</div>`}}
+document.getElementById('root').innerHTML=html}catch(e){}}
+setInterval(tick,500);tick();
+</script></body></html>"""
+
+    def _ensure_server(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: A003
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") in ("", "/index.html"):
+                    body = dash._PAGE.encode()
+                    ctype = "text/html"
+                elif self.path.lstrip("/").startswith("data"):
+                    body = _json.dumps(dash.snapshot_json()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever,
+            name="pw-dashboard",
+            daemon=True,
+        ).start()
+
+    def start(self) -> None:
+        """Open the port immediately (otherwise it opens lazily at the
+        first commit)."""
+        self._ensure_server()
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+
+
 def plot(
     table: Table,
-    plotting_function: Callable,
+    plotting_function: Callable | None = None,
     *,
     sorting_col: Any = None,
-) -> Any:
-    """Live Bokeh plot of a streaming table (reference plotting.py:plot).
-    Needs bokeh, which this image does not ship."""
-    try:
-        import bokeh  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pw.stdlib.viz.plot needs bokeh; use table_viz for the console "
-            "rendering, or install bokeh for notebook dashboards"
-        ) from e
-    raise NotImplementedError(
-        "bokeh plotting requires a notebook event loop; use table_viz here"
-    )
+    host: str = "127.0.0.1",
+    port: int = 0,
+) -> LiveDashboard:
+    """Live streaming plot of a table (reference plotting.py:plot).
+
+    With bokeh installed and a ``plotting_function``, the reference's
+    notebook path would apply; this environment has neither, so the call
+    serves the table on a :class:`LiveDashboard` (row table + row-count
+    sparkline) and returns it."""
+    dash = LiveDashboard(host=host, port=port)
+    dash.add(table, title="plot")
+    dash.start()
+    return dash
 
 
 def show(table: Table, **kwargs: Any) -> None:

@@ -531,3 +531,87 @@ def test_spawn_sigkill_midrun_then_journal_resume(tmp_path):
         elif state.get(r["word"]) == r["cnt"]:
             del state[r["word"]]
     assert state == {"apple": 1, "banana": 2, "cherry": 1}
+
+
+def test_three_process_kill_one_then_resume_rescaled(tmp_path):
+    """3-process mesh, SIGKILL ONE follower mid-stream, then resume the
+    SAME journal store with a 2-process spawn: the survivors fail-stop
+    (no partial success), and the rescaled resume counts every input
+    exactly once — the persistence threshold is the min across the OLD
+    worker set, and input snapshots reshard on restore (reference
+    persistence/state.rs:129-150, wordcount recovery harness
+    integration_tests/wordcount/base.py:320; rescaling
+    config.rs:126-163)."""
+    import json as _json
+    import signal
+    import time as _t
+
+    indir = tmp_path / "in"
+    indir.mkdir()
+    store = tmp_path / "store"
+    out1 = tmp_path / "out1.jsonl"
+
+    streaming = """
+        import pathway_tpu as pw
+        from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+        words = pw.io.plaintext.read(
+            {indir!r}, mode="streaming", persistent_id="w",
+            autocommit_duration_ms=50,
+        )
+        counts = words.groupby(words.data).reduce(
+            word=words.data, cnt=pw.reducers.count()
+        )
+        pw.io.jsonlines.write(counts, {out!r})
+        pw.run(persistence_config=Config(
+            Backend.filesystem({store!r}),
+            persistence_mode=PersistenceMode.PERSISTING,
+        ))
+    """
+    (indir / "f0.txt").write_text("apple\nbanana\napple\n")
+    handles = _launch_processes(
+        tmp_path,
+        streaming.format(indir=str(indir), out=str(out1), store=str(store)),
+        3,
+    )
+    try:
+        deadline = _t.monotonic() + 45
+        while _t.monotonic() < deadline:
+            if out1.exists() and "apple" in out1.read_text():
+                break
+            if any(h.poll() is not None for h in handles):
+                raise AssertionError("a process died before the kill")
+            _t.sleep(0.2)
+        else:
+            raise AssertionError("run 1 never committed the first file")
+        (indir / "f1.txt").write_text("banana\ncherry\n")
+        _t.sleep(0.7)  # may or may not be consumed before the kill
+        handles[2].send_signal(signal.SIGKILL)
+        # BOTH survivors must fail-stop nonzero, promptly
+        t0 = _t.monotonic()
+        rcs = [handles[0].wait(timeout=30), handles[1].wait(timeout=30)]
+        assert all(rc != 0 for rc in rcs), rcs
+        assert _t.monotonic() - t0 < 20
+    finally:
+        for h in handles:
+            if h.poll() is None:
+                h.kill()
+
+    # rescaled resume: 2 processes over the 3-process journal
+    out2 = tmp_path / "out2.jsonl"
+    resume = streaming.replace('mode="streaming"', 'mode="static"')
+    _spawn_program(
+        tmp_path,
+        resume.format(indir=str(indir), out=str(out2), store=str(store)),
+        processes=2,
+    )
+    rows = [
+        _json.loads(l) for l in out2.read_text().splitlines() if l.strip()
+    ]
+    state: dict[str, int] = {}
+    for r in rows:
+        if r["diff"] > 0:
+            state[r["word"]] = r["cnt"]
+        elif state.get(r["word"]) == r["cnt"]:
+            del state[r["word"]]
+    assert state == {"apple": 2, "banana": 2, "cherry": 1}

@@ -284,3 +284,114 @@ class TestAstDepth:
             t=t,
         )
         assert self._rows(out) == [("x", 40), ("y", 70), ("z", 40)]
+
+
+def rows(q, **tables):
+    from pathway_tpu.internals.parse_graph import G
+
+    import pathway_tpu.debug as dbg
+
+    G.clear()
+    res = pw.sql(q, **tables)
+    pdf = dbg.table_to_pandas(res)
+    return sorted(
+        (
+            tuple(None if v != v else v for v in r)
+            for r in pdf.itertuples(index=False, name=None)
+        ),
+        key=repr,
+    )
+
+
+class TestDialectExtensions:
+    """CASE/BETWEEN/LIKE/CAST/COALESCE/NULLIF/COUNT DISTINCT/UNION/EXCEPT
+    (reference parses these via sqlglot, internals/sql.py:1-726)."""
+
+    def _t(self):
+        return pw.debug.table_from_markdown(
+            """
+            name    | dept | salary
+            alice   | eng  | 100
+            bob     | eng  | 80
+            carol   | ops  | 60
+            dave    | ops  | 60
+            """
+        )
+
+    def test_case_when(self):
+        t = self._t()
+        got = rows(
+            "SELECT name, CASE WHEN salary >= 100 THEN 'high' "
+            "WHEN salary >= 70 THEN 'mid' ELSE 'low' END AS band FROM t",
+            t=t,
+        )
+        assert got == sorted(
+            [
+                ("alice", "high"),
+                ("bob", "mid"),
+                ("carol", "low"),
+                ("dave", "low"),
+            ]
+        )
+
+    def test_between_and_like(self):
+        t = self._t()
+        assert rows(
+            "SELECT name FROM t WHERE salary BETWEEN 60 AND 90 "
+            "AND name LIKE 'b%'",
+            t=t,
+        ) == [("bob",)]
+        assert rows(
+            "SELECT name FROM t WHERE name NOT LIKE '%a%'", t=t
+        ) == [("bob",)]
+        assert rows(
+            "SELECT name FROM t WHERE name LIKE '_ave'", t=t
+        ) == [("dave",)]
+
+    def test_cast(self):
+        t = self._t()
+        assert rows(
+            "SELECT CAST(salary AS text) AS s FROM t WHERE name = 'bob'",
+            t=t,
+        ) == [("80",)]
+        assert rows(
+            "SELECT CAST('7' AS int) + 1 AS n FROM t WHERE name = 'bob'",
+            t=t,
+        ) == [(8,)]
+
+    def test_coalesce_nullif_group_by_computed_key(self):
+        t = self._t()
+        got = rows(
+            "SELECT COALESCE(NULLIF(dept, 'ops'), 'other') AS d, "
+            "COUNT(*) AS c FROM t "
+            "GROUP BY COALESCE(NULLIF(dept, 'ops'), 'other')",
+            t=t,
+        )
+        assert got == [("eng", 2), ("other", 2)]
+
+    def test_case_as_group_key(self):
+        t = self._t()
+        got = rows(
+            "SELECT CASE WHEN salary > 70 THEN 'hi' ELSE 'lo' END AS band, "
+            "COUNT(*) AS c FROM t "
+            "GROUP BY CASE WHEN salary > 70 THEN 'hi' ELSE 'lo' END",
+            t=t,
+        )
+        assert got == [("hi", 2), ("lo", 2)]
+
+    def test_count_distinct(self):
+        t = self._t()
+        assert rows(
+            "SELECT dept, COUNT(DISTINCT salary) AS ds FROM t GROUP BY dept",
+            t=t,
+        ) == [("eng", 2), ("ops", 1)]
+
+    def test_union_distinct_and_except(self):
+        t = self._t()
+        assert rows(
+            "SELECT dept FROM t UNION SELECT dept FROM t", t=t
+        ) == [("eng",), ("ops",)]
+        assert rows(
+            "SELECT name FROM t EXCEPT SELECT name FROM t WHERE dept = 'eng'",
+            t=t,
+        ) == [("carol",), ("dave",)]

@@ -216,3 +216,68 @@ class TestUtilsLongTail:
         pw.debug.compute_and_print(t)
         out = capsys.readouterr().out
         assert "a" in out and "1" in out and "2" in out
+
+
+class TestLiveDashboard:
+    def test_dashboard_serves_live_snapshots(self):
+        """Streaming run with the web dashboard attached: / serves the
+        page, /data reflects rows and commit history as they land
+        (reference stdlib/viz/plotting.py live dashboards)."""
+        import json
+        import threading
+        import time
+        import urllib.request
+
+        import pathway_tpu as pw
+        from pathway_tpu.internals.parse_graph import G
+        from pathway_tpu.stdlib.viz import LiveDashboard
+
+        G.clear()
+        done = threading.Event()
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self) -> None:
+                for i in range(30):
+                    self.next(k=i % 3, v=float(i))
+                done.wait(10)
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(k=int, v=float),
+            autocommit_duration_ms=20,
+        )
+        agg = t.groupby(t.k).reduce(k=t.k, s=pw.reducers.sum(t.v))
+        dash = LiveDashboard(port=0)
+        dash.add(agg, title="sums")
+        dash.start()
+        runner = threading.Thread(target=pw.run, daemon=True)
+        runner.start()
+        try:
+            base = f"http://127.0.0.1:{dash.port}"
+            with urllib.request.urlopen(base + "/", timeout=10) as resp:
+                page = resp.read().decode()
+            assert "pathway live dashboard" in page
+            deadline = time.monotonic() + 20
+            data = {}
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                    base + "/data", timeout=10
+                ) as resp:
+                    data = json.loads(resp.read().decode())
+                if data.get("sums", {}).get("n_rows") == 3:
+                    break
+                time.sleep(0.1)
+            assert data["sums"]["n_rows"] == 3, data
+            assert data["sums"]["columns"] == ["k", "s"]
+            assert data["sums"]["commits"] >= 1
+            assert data["sums"]["count_history"]
+            got = {r[0]: float(r[1]) for r in data["sums"]["rows"]}
+            assert got == {
+                "0": sum(float(i) for i in range(30) if i % 3 == 0),
+                "1": sum(float(i) for i in range(30) if i % 3 == 1),
+                "2": sum(float(i) for i in range(30) if i % 3 == 2),
+            }
+        finally:
+            done.set()
+            dash.close()
+            runner.join(timeout=15)
