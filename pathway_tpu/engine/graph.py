@@ -527,12 +527,54 @@ class ConcatNode(Node):
         assert all(s.arity == arity for s in sources)
         super().__init__(scope, list(sources), arity)
 
+    def _columnar_bulk(self, batches: list[DeltaBatch]) -> DeltaBatch | None:
+        """Cold-state pure-insert concat: stack the columnar payloads and
+        screen cross-input key uniqueness vectorized — the bulk-load path
+        with zero per-row objects. None falls back to the row loop."""
+        from pathway_tpu.engine.batch import Columns
+
+        if self._state or self._state_lag:
+            return None  # membership checks against prior keys: row path
+        payloads = []
+        for b in batches:
+            if not b:
+                continue
+            if b.columns is None or not (
+                b._insert_only or b._raw_insert_only
+            ):
+                return None
+            payloads.append(b.columns)
+        if not payloads:
+            return DeltaBatch()
+        stacked = (
+            payloads[0] if len(payloads) == 1 else Columns.concat(payloads)
+        )
+        if stacked is None or stacked.diffs is not None:
+            return None
+        try:
+            kb = stacked.kbytes()
+        except (OverflowError, TypeError):
+            return None
+        if kb is None or not _keys_unique(
+            np.ascontiguousarray(kb), stacked.n
+        ):
+            return None  # duplicate keys need the reporting row path
+        out = DeltaBatch.from_columns(
+            stacked, consolidated=True, insert_only=True
+        )
+        return out
+
     def process(self, time: int) -> DeltaBatch:
+        batches = [
+            self.take_raw(port) for port in range(len(self.inputs))
+        ]
+        fast = self._columnar_bulk(batches)
+        if fast is not None:
+            return fast
         out = DeltaBatch()
         seen = set(self.current)
-        for port in range(len(self.inputs)):
-            batch = self.take(port)
-            for key, row, diff in batch:
+        for batch in batches:
+            for key, row, diff in batch.consolidate():
                 if diff > 0:
                     if key in seen:
                         self.report(key, "duplicate key in concat")
@@ -1491,8 +1533,14 @@ class _ColumnarGroups:
             sel = np.flatnonzero(mask)
             sel_g = gis[sel].tolist()
             kobjs = list(map(gkeys.__getitem__, sel_g))
-            byv = np.empty(len(sel_g), object)
-            byv[:] = list(map(by_raw.__getitem__, sel_g))
+            by_vals = list(map(by_raw.__getitem__, sel_g))
+            # densify when the by values are cleanly typed, so downstream
+            # columnar consumers (hash join, expressions) stay columnar;
+            # mixed/exotic values keep the exact object representation
+            byv = device._extract(by_vals)
+            if byv is None:
+                byv = np.empty(len(by_vals), object)
+                byv[:] = by_vals
             cols = [byv]
             for ri, kind in enumerate(self.kinds):
                 if kind == ReducerKind.COUNT:
@@ -1508,20 +1556,43 @@ class _ColumnarGroups:
         ]
         ko_new, cols_new = block(m_new, new_member, new_accs)
         kobjs = ko_old + ko_new
-        out_cols = [
-            np.concatenate([a, b]) for a, b in zip(cols_old, cols_new)
-        ]
-        out_diffs = np.concatenate(
-            [
-                np.full(len(ko_old), -1, np.int64),
-                np.ones(len(ko_new), np.int64),
-            ]
-        )
+
+        def cat(a, b):
+            # empty placeholders must not promote the other side's dtype,
+            # and MISMATCHED dense dtypes (int by-values one commit, str
+            # the next) must not silently promote values (int64+<U would
+            # stringify the retraction side) — exact objects instead
+            if len(a) == 0:
+                return b
+            if len(b) == 0:
+                return a
+            if a.dtype == b.dtype:
+                return np.concatenate([a, b])
+            arr = np.empty(len(a) + len(b), object)
+            arr[: len(a)] = a.tolist()
+            arr[len(a) :] = b.tolist()
+            return arr
+
+        out_cols = [cat(a, b) for a, b in zip(cols_old, cols_new)]
+        if ko_old:
+            out_diffs = np.concatenate(
+                [
+                    np.full(len(ko_old), -1, np.int64),
+                    np.ones(len(ko_new), np.int64),
+                ]
+            )
+        else:
+            # pure-insert commit (bulk load, fresh groups): diffs=None
+            # marks the batch insert-only so downstream columnar
+            # consumers (the hash join) take it without consolidation
+            out_diffs = None
         payload = Columns(
             len(kobjs), out_cols, kobjs=kobjs, diffs=out_diffs
         )
         self._maybe_compact()
-        return DeltaBatch.from_columns(payload, consolidated=True)
+        return DeltaBatch.from_columns(
+            payload, consolidated=True, insert_only=out_diffs is None
+        )
 
     def _maybe_compact(self) -> None:
         """Reclaim array slots of dead groups (index entry popped, slot

@@ -254,6 +254,13 @@ class _Parser:
             return ("like", e, pattern, negated_in)
         if self.accept("kw", "in"):
             self.expect("op", "(")
+            if self.peek() == ("kw", "select"):
+                sub = self.parse_set_chain()
+                self.expect("op", ")")
+                # semi-join form; negation stays in the node (the WHERE
+                # lowering turns it into intersect/difference, which a
+                # generic NOT wrapper could not express)
+                return ("in_subquery", e, sub, negated_in)
             values = [self.parse_expr()]
             while self.accept("op", ","):
                 values.append(self.parse_expr())
@@ -481,6 +488,11 @@ class _Lowerer:
             return out
         if op in ("case", "like", "cast", "coalesce", "nullif"):
             return self._special(node, lambda n: self.expr(n, scope))
+        if op == "in_subquery":
+            raise ValueError(
+                "pw.sql: IN (SELECT ...) is only supported as a top-level "
+                "AND conjunct of WHERE"
+            )
         left = self.expr(node[1], scope)
         right = self.expr(node[2], scope)
         return {
@@ -712,8 +724,54 @@ class _Lowerer:
             scope = {name: current for name in scope}
             scope["__joined__"] = current
         if q["where"] is not None:
-            current = current.filter(self.expr(q["where"], scope))
-            scope = {name: current for name in scope}
+            def conjuncts(node):
+                if isinstance(node, tuple) and node[0] == "and":
+                    return conjuncts(node[1]) + conjuncts(node[2])
+                return [node]
+
+            plain = []
+            for part in conjuncts(q["where"]):
+                if isinstance(part, tuple) and part[0] == "in_subquery":
+                    _tag, e_ast, sub, negated = part
+                    sub_table = _Lowerer(self.tables).lower(sub)
+                    sub_cols = sub_table.column_names()
+                    if len(sub_cols) != 1:
+                        raise ValueError(
+                            "pw.sql: IN (SELECT ...) needs exactly one "
+                            "output column"
+                        )
+                    needle = self.expr(e_ast, scope)
+                    sub_d = self._distinct(sub_table)
+                    matched = current.join(
+                        sub_d,
+                        needle == sub_d[sub_cols[0]],
+                        id=current.id,
+                    ).select()
+                    current = (
+                        current.difference(matched)
+                        if negated
+                        else current.restrict(matched)
+                    )
+                    scope = {name: current for name in scope}
+                else:
+                    plain.append(part)
+            def has_in_subquery(node):
+                if isinstance(node, tuple):
+                    if node and node[0] == "in_subquery":
+                        return True
+                    return any(has_in_subquery(c) for c in node)
+                if isinstance(node, list):
+                    return any(has_in_subquery(c) for c in node)
+                return False
+
+            for part in plain:
+                if has_in_subquery(part):
+                    raise ValueError(
+                        "pw.sql: IN (SELECT ...) is only supported as a "
+                        "top-level AND conjunct of WHERE"
+                    )
+                current = current.filter(self.expr(part, scope))
+                scope = {name: current for name in scope}
         if q["group_by"] is not None:
             from pathway_tpu.internals.expression import ColumnReference
 

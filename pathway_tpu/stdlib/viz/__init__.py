@@ -186,16 +186,18 @@ th{background:#f0f0f0}.meta{color:#666;font-size:.8rem}
 svg{background:#fff;box-shadow:0 1px 3px #0002;margin:.3rem 0}
 </style></head><body><h1>pathway live dashboard</h1>
 <div id="root"></div><script>
+function esc(s){return String(s).replace(/[&<>"']/g,
+ c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function spark(h){if(!h.length)return "";const W=420,H=60,m=Math.max(...h,1);
 const pts=h.map((v,i)=>`${(i/(Math.max(h.length-1,1)))*W},${H-(v/m)*(H-6)-3}`).join(" ");
 return `<svg width="${W}" height="${H}"><polyline fill="none" stroke="#2a6" stroke-width="2" points="${pts}"/></svg>`}
 async function tick(){try{
 const d=await (await fetch('data')).json();let html='';
 for(const [name,t] of Object.entries(d)){
-html+=`<h2>${name}</h2><div class="meta">${t.n_rows} rows · ${t.commits} commits</div>`;
+html+=`<h2>${esc(name)}</h2><div class="meta">${t.n_rows} rows · ${t.commits} commits</div>`;
 html+=spark(t.count_history);
-html+='<table><tr>'+t.columns.map(c=>`<th>${c}</th>`).join('')+'</tr>';
-for(const r of t.rows){html+='<tr>'+r.map(v=>`<td>${v}</td>`).join('')+'</tr>'}
+html+='<table><tr>'+t.columns.map(c=>`<th>${esc(c)}</th>`).join('')+'</tr>';
+for(const r of t.rows){html+='<tr>'+r.map(v=>`<td>${esc(v)}</td>`).join('')+'</tr>'}
 html+='</table>';if(t.overflow){html+=`<div class="meta">… ${t.overflow} more rows</div>`}}
 document.getElementById('root').innerHTML=html}catch(e){}}
 setInterval(tick,500);tick();
@@ -206,7 +208,6 @@ setInterval(tick,500);tick();
             return
         self._started = True
         import json as _json
-        import threading
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         dash = self
@@ -232,7 +233,21 @@ setInterval(tick,500);tick();
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        import sys
+        import threading
+
+        try:
+            self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        except OSError as exc:
+            # this runs inside subscribe callbacks: a port collision must
+            # not kill the streaming run — disable the dashboard loudly
+            self.error = exc
+            print(
+                f"pw.viz.LiveDashboard: cannot bind "
+                f"{self.host}:{self.port} ({exc}); dashboard disabled",
+                file=sys.stderr,
+            )
+            return
         self.port = self._server.server_address[1]
         threading.Thread(
             target=self._server.serve_forever,

@@ -395,3 +395,36 @@ class TestDialectExtensions:
             "SELECT name FROM t EXCEPT SELECT name FROM t WHERE dept = 'eng'",
             t=t,
         ) == [("carol",), ("dave",)]
+
+    def test_in_subquery_semi_join(self):
+        emp = pw.debug.table_from_markdown(
+            """
+            name  | dept
+            alice | eng
+            bob   | ops
+            carol | hr
+            """
+        )
+        good = pw.debug.table_from_markdown(
+            """
+            d
+            eng
+            ops
+            """
+        )
+        assert rows(
+            "SELECT name FROM emp WHERE dept IN (SELECT d FROM good)",
+            emp=emp,
+            good=good,
+        ) == [("alice",), ("bob",)]
+        assert rows(
+            "SELECT name FROM emp WHERE dept NOT IN (SELECT d FROM good)",
+            emp=emp,
+            good=good,
+        ) == [("carol",)]
+        assert rows(
+            "SELECT name FROM emp WHERE dept IN (SELECT d FROM good) "
+            "AND name LIKE '%b%'",
+            emp=emp,
+            good=good,
+        ) == [("bob",)]
