@@ -898,7 +898,10 @@ def _run_bounded(fn, timeout_s: float):
 
 def _device_alive(timeout_s: float) -> bool:
     """Quick liveness re-probe after a leg failure: decides whether the
-    remaining device legs are worth attempting."""
+    remaining device legs are worth attempting. Uses the EXACT op the
+    startup probe already compiled: a fresh shape would need its own jit
+    compile, and an abandoned slow leg holding the XLA compile lock
+    would then read as 'accelerator lost' when the device is fine."""
     done = threading.Event()
     ok: list = []
 
@@ -907,7 +910,7 @@ def _device_alive(timeout_s: float) -> bool:
             import jax
             import jax.numpy as jnp
 
-            jax.block_until_ready(jnp.ones((4,)) + 1)
+            jax.block_until_ready(jnp.ones((8,)))
             ok.append(True)
         except Exception:  # noqa: BLE001 — liveness only
             pass

@@ -566,6 +566,9 @@ class TestSharedBatchAliasing:
         sched.finish()
         counts = {r[0]: r[1] for r in gb.current.values()}
         assert counts == {"g": 2}, counts  # H + R once each, R not doubled
+
+
+class TestLazyState:
     def test_state_drains_on_read_and_caps(self):
         scope = Scope()
         sess = scope.input_session(1)
@@ -601,3 +604,86 @@ class TestSharedBatchAliasing:
         sched.commit()
         kept = sorted(r[0] for r in filt.current.values())
         assert kept == [i for i in range(50) if i % 2 == 1 and i != 1]
+
+
+class TestErrorSemanticsAtColumnarScale:
+    """ERROR poisoning and None handling must survive batches large
+    enough to trigger every columnar fast path — the screens bail to the
+    row interpreter, which owns the exact semantics."""
+
+    def test_division_error_rows_poison_not_crash(self):
+        import pathway_tpu as pw
+        import pathway_tpu.debug as dbg
+        from pathway_tpu.internals.parse_graph import G
+
+        G.clear()
+        n = 2000
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int, b=int),
+            [(i, i % 100) for i in range(n)],  # b==0 every 100th row
+        )
+        r = t.select(q=pw.this.a // pw.this.b)
+        pdf = dbg.table_to_pandas(r)
+        errs = sum(1 for v in pdf["q"].tolist() if str(v) == "Error")
+        assert errs == n // 100
+        ok = [v for v in pdf["q"].tolist() if str(v) != "Error"]
+        assert len(ok) == n - n // 100
+
+    def test_groupby_error_in_by_column_reports_and_skips(self):
+        from pathway_tpu.engine.value import ERROR
+
+        scope = Scope()
+        sess = scope.input_session(2)
+        gb = scope.group_by_table(
+            sess,
+            by_cols=[0],
+            reducers=[(make_reducer(ReducerKind.COUNT), [])],
+        )
+        sched = Scheduler(scope)
+        for i in range(1000):
+            sess.insert(ref_scalar(i), (i % 4, 0.0))
+        sess.insert(ref_scalar("bad"), (ERROR, 0.0))
+        sched.commit()
+        counts = {r[0]: r[1] for r in gb.current.values()}
+        assert counts == {0: 250, 1: 250, 2: 250, 3: 250}
+        assert len(scope.error_log_default.current) == 1
+
+    def test_join_error_in_key_reports_and_skips(self):
+        from pathway_tpu.engine.value import ERROR
+
+        scope = Scope()
+        left = scope.input_session(2)
+        right = scope.input_session(2)
+        jn = scope.join_tables(left, right, left_on=[0], right_on=[0])
+        sched = Scheduler(scope)
+        for i in range(800):
+            left.insert(ref_scalar(("l", i)), (i % 8, float(i)))
+        left.insert(ref_scalar("bad"), (ERROR, -1.0))
+        for g in range(8):
+            right.insert(ref_scalar(("r", g)), (g, float(g)))
+        sched.commit()
+        assert len(jn.current) == 800  # the poisoned row joined nothing
+        assert len(scope.error_log_default.current) == 1
+
+    def test_none_values_in_payload_columns_roundtrip(self):
+        """Nones in non-key columns ride object arrays through the
+        columnar join and materialise back as None exactly."""
+        scope = Scope()
+        left = scope.input_session(2)
+        right = scope.input_session(2)
+        jn = scope.join_tables(left, right, left_on=[0], right_on=[0])
+        sched = Scheduler(scope)
+        for i in range(600):
+            left.insert(
+                ref_scalar(("l", i)),
+                (i % 3, None if i % 2 else float(i)),
+            )
+        for g in range(3):
+            right.insert(ref_scalar(("r", g)), (g, f"g{g}"))
+        sched.commit()
+        assert jn._columnar_ok
+        rows = list(jn.current.values())
+        assert len(rows) == 600
+        nones = sum(1 for r in rows if r[1] is None)
+        assert nones == 300
+        assert all(r[3] == f"g{r[0]}" for r in rows)
