@@ -57,58 +57,34 @@ def _shard_of(value: Any, n: int) -> int:
         return int(hash_values((repr(value),), salt=b"shard")) % n
 
 
-def partitioner(
-    consumer: Node, port: int, n_workers: int
-) -> Callable[[Pointer, tuple], int] | None:
-    """How entries entering ``consumer`` on ``port`` pick their worker;
-    None = worker 0 (globally-stateful operator)."""
+def partition_rule(consumer: Node, port: int) -> tuple:
+    """ONE classification of how entries entering ``consumer`` on ``port``
+    pick their worker — consumed by BOTH the per-row closure builder and
+    the vectorized columnar exchange, so the two can never drift:
+
+    - ``("pin",)``        everything to worker 0 (globally-stateful op)
+    - ``("key",)``        by row key (full 128-bit pointer mod n)
+    - ``("cols", cols)``  by ``hash(tuple(row[c] for c in cols))``
+    - ``("col", c)``      by the bare value ``row[c]`` (c None = constant)
+    """
     from pathway_tpu.engine import temporal as _temporal
     from pathway_tpu.engine.external_index import ExternalIndexNode
+    from pathway_tpu.engine.graph import RecomputeNode
     from pathway_tpu.engine.iterate import IterateNode
 
     if isinstance(consumer, GroupbyNode):
-        cols = consumer.by_cols
-
-        def by_group(key: Pointer, row: tuple) -> int:
-            return _shard_of(tuple(row[c] for c in cols), n_workers)
-
-        return by_group
+        return ("cols", list(consumer.by_cols))
     if isinstance(consumer, DeduplicateNode):
-        cols = consumer.instance_cols
-
-        def by_instance(key: Pointer, row: tuple) -> int:
-            return _shard_of(tuple(row[c] for c in cols), n_workers)
-
-        return by_instance
+        return ("cols", list(consumer.instance_cols))
     if isinstance(consumer, JoinNode):
-        cols = consumer.left_on if port == 0 else consumer.right_on
-
-        def by_join_key(key: Pointer, row: tuple) -> int:
-            return _shard_of(tuple(row[c] for c in cols), n_workers)
-
-        return by_join_key
+        return (
+            "cols",
+            list(consumer.left_on if port == 0 else consumer.right_on),
+        )
     if isinstance(consumer, SortNode):
-        inst = consumer.instance_col
-
-        def by_sort_instance(key: Pointer, row: tuple) -> int:
-            return _shard_of(row[inst] if inst is not None else None, n_workers)
-
-        return by_sort_instance
+        return ("col", consumer.instance_col)
     if isinstance(consumer, IxNode):
-        if port == 0:
-            col = consumer.key_col
-
-            def by_lookup(key: Pointer, row: tuple) -> int:
-                return _shard_of(row[col], n_workers)
-
-            return by_lookup
-
-        def by_row_key(key: Pointer, row: tuple) -> int:
-            return _shard_of(key, n_workers)
-
-        return by_row_key
-    from pathway_tpu.engine.graph import RecomputeNode
-
+        return ("col", consumer.key_col) if port == 0 else ("key",)
     if isinstance(
         consumer,
         (
@@ -127,7 +103,34 @@ def partitioner(
             _temporal.AsofNowJoinNode,
         ),
     ):
-        return None  # global state: pin to worker 0
+        return ("pin",)  # global state: pin to worker 0
+    return ("key",)
+
+
+def partitioner(
+    consumer: Node, port: int, n_workers: int
+) -> Callable[[Pointer, tuple], int] | None:
+    """Per-row closure for :func:`partition_rule`; None = worker 0."""
+    rule = partition_rule(consumer, port)
+    kind = rule[0]
+    if kind == "pin":
+        return None
+    if kind == "cols":
+        cols = rule[1]
+
+        def by_cols(key: Pointer, row: tuple) -> int:
+            return _shard_of(tuple(row[c] for c in cols), n_workers)
+
+        return by_cols
+    if kind == "col":
+        col = rule[1]
+
+        def by_col(key: Pointer, row: tuple) -> int:
+            return _shard_of(
+                row[col] if col is not None else None, n_workers
+            )
+
+        return by_col
 
     def by_key(key: Pointer, row: tuple) -> int:
         return _shard_of(key, n_workers)
@@ -173,6 +176,52 @@ class ShardedScheduler:
             self._parts[key] = fn
         return fn
 
+    def _columnar_shards(
+        self, consumer: Node, port: int, out: DeltaBatch
+    ):
+        """Vectorized worker assignment for a columnar batch, or None when
+        the routing rule needs the row path. Digest-identical to the
+        per-row partitioners: row-key routing is the full 128-bit pointer
+        mod n; column routing hashes per DISTINCT value (np.unique) and
+        maps back through the inverse index."""
+        import numpy as np
+
+        payload = out.columns
+        rule = partition_rule(consumer, port)
+        kind = rule[0]
+        if kind in ("cols", "col"):
+            if kind == "cols":
+                if len(rule[1]) != 1:
+                    return None
+                c = rule[1][0]
+                wrap = lambda v: (v,)  # noqa: E731 — tuple-wrapped hash
+            else:
+                c = rule[1]
+                if c is None:
+                    # constant instance: every row to _shard_of(None)
+                    return np.full(
+                        payload.n, _shard_of(None, self.n), np.int64
+                    )
+                wrap = lambda v: v  # noqa: E731 — bare-value hash
+            col = payload.cols[c]
+            if col.dtype.kind not in "bifU":
+                return None
+            uniq, inverse = np.unique(col, return_inverse=True)
+            table = np.fromiter(
+                (_shard_of(wrap(v), self.n) for v in uniq.tolist()),
+                np.int64,
+                len(uniq),
+            )
+            return table[inverse]
+        if kind != "key":
+            return None  # "pin" never reaches here (fn is None earlier)
+        kb = np.ascontiguousarray(payload.kbytes())
+        lo = kb[:, :8].copy().view(np.uint64).ravel()
+        hi = kb[:, 8:].copy().view(np.uint64).ravel()
+        n = np.uint64(self.n)
+        base = np.uint64((1 << 64) % self.n)
+        return (((hi % n) * base + lo % n) % n).astype(np.int64)
+
     def _deliver(
         self, worker: int, producer: Node, out: DeltaBatch
     ) -> None:
@@ -180,12 +229,31 @@ class ShardedScheduler:
         the consumer's replica on the owning worker. The consumer topology
         comes from worker 0's scope — the superset, since sinks attach
         there only."""
+        import numpy as np
+
         for consumer, port in self.scopes[0].nodes[producer.index].consumers:
             fn = self._partition_fn(consumer, port)
             if fn is None:
                 target = self.scopes[0].nodes[consumer.index]
                 target.push(port, out)
                 continue
+            if out._entries is None and out.columns is not None:
+                shards = self._columnar_shards(consumer, port, out)
+                if shards is not None:
+                    for w in range(self.n):
+                        idx = np.flatnonzero(shards == w)
+                        if not len(idx):
+                            continue
+                        part = DeltaBatch.from_columns(
+                            out.columns.gather(idx),
+                            consolidated=out._consolidated,
+                            insert_only=out._insert_only,
+                        )
+                        part._raw_insert_only = out._raw_insert_only
+                        self.scopes[w].nodes[consumer.index].push(
+                            port, part
+                        )
+                    continue
             parts: list[list[Entry]] = [[] for _ in range(self.n)]
             for key, row, diff in out:
                 parts[fn(key, row)].append((key, row, diff))
@@ -224,18 +292,29 @@ class ShardedScheduler:
                     out = node.process(time)
                     if out is None:
                         out = DeltaBatch()
-                    out = out.consolidate() if out else out
-                    apply_batch_to_state(node.current, out)
+                    # defer like the single scheduler: an eager apply
+                    # would materialise columnar batches before the
+                    # vectorized exchange can route them
+                    node._defer_state(out)
                     if probe:
                         st = self._stats_of(node)
                         st.time_spent += _walltime.perf_counter() - t0
                         st.batches += 1
                         st.last_time = time
-                        for _k, _r, d in out:
-                            if d > 0:
-                                st.insertions += 1
+                        cols = out.columns
+                        if cols is not None:
+                            if cols.diffs is None:
+                                st.insertions += cols.n
                             else:
-                                st.deletions += 1
+                                pos = int((cols.diffs > 0).sum())
+                                st.insertions += pos
+                                st.deletions += cols.n - pos
+                        else:
+                            for _k, _r, d in out.consolidate():
+                                if d > 0:
+                                    st.insertions += 1
+                                else:
+                                    st.deletions += 1
                     if out:
                         self._deliver(w, node, out)
             if busy:
@@ -290,7 +369,7 @@ class ShardedScheduler:
           an input's ``current`` (zip/update/ix source side) find exactly
           the rows whose downstream parts they receive."""
         replica0 = self.scopes[0].nodes[node.index]
-        apply_batch_to_state(replica0.current, batch)
+        replica0._defer_state(batch)
         if self.n > 1:
             parts: list[list[Entry]] = [[] for _ in range(self.n)]
             for key, row, diff in batch:
@@ -298,7 +377,7 @@ class ShardedScheduler:
             for w in range(1, self.n):
                 if parts[w]:
                     replica = self.scopes[w].nodes[node.index]
-                    apply_batch_to_state(replica.current, DeltaBatch(parts[w]))
+                    replica._defer_state(DeltaBatch(parts[w]))
         self._deliver(0, replica0, batch)
 
     def finish(self) -> None:
