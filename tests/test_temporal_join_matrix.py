@@ -446,6 +446,72 @@ class TestIntervalsOver:
             assert got.get(a, ()) == vals, (a, got.get(a), vals)
 
 
+class TestAsofNowMatrix:
+    """as-of-now contract (SURVEY Appendix B, reference
+    external_index.rs:38 / _asof_now_join.py): answers reflect right-side
+    state at query ARRIVAL and never revise; left deletions retract."""
+
+    def test_randomized_interleaving_against_oracle(self):
+        from pathway_tpu.engine.temporal import AsofNowJoinNode
+
+        rng = random.Random(31)
+        scope = Scope()
+        l_in = scope.input_session(arity=2)
+        r_in = scope.input_session(arity=2)
+        node = AsofNowJoinNode(scope, l_in, r_in, [0], [0], kind="inner")
+        sched = Scheduler(scope)
+
+        right_state: dict = {}  # jk -> {rkey: row}
+        expected: dict = {}  # left key -> frozen match multiset
+        live_left: dict = {}
+        next_id = [0]
+
+        for _commit in range(25):
+            # right-side churn FIRST within the commit boundary
+            for _ in range(rng.randint(0, 4)):
+                jk = rng.randint(0, 4)
+                if right_state.get(jk) and rng.random() < 0.4:
+                    rkey = rng.choice(list(right_state[jk]))
+                    row = right_state[jk].pop(rkey)
+                    r_in.remove(rkey, row)
+                else:
+                    next_id[0] += 1
+                    rkey = ref_scalar(("r", next_id[0]))
+                    row = (jk, f"v{next_id[0]}")
+                    right_state.setdefault(jk, {})[rkey] = row
+                    r_in.insert(rkey, row)
+            sched.commit()
+            # queries arrive in their own commit: they must see exactly
+            # the right state as of now, frozen forever after
+            for _ in range(rng.randint(0, 3)):
+                if live_left and rng.random() < 0.3:
+                    lkey = rng.choice(list(live_left))
+                    l_in.remove(lkey, live_left.pop(lkey))
+                    expected.pop(lkey, None)
+                else:
+                    next_id[0] += 1
+                    jk = rng.randint(0, 4)
+                    lkey = ref_scalar(("l", next_id[0]))
+                    lrow = (jk, next_id[0])
+                    live_left[lkey] = lrow
+                    l_in.insert(lkey, lrow)
+                    expected[lkey] = sorted(
+                        v for _rk, (_j, v) in right_state.get(
+                            jk, {}
+                        ).items()
+                    )
+            sched.commit()
+
+        got: dict = {}
+        for _okey, row in node.current.items():
+            # output rows: left_row + right_row
+            jk, lid, _rjk, rv = row
+            lkey = [k for k, r in live_left.items() if r == (jk, lid)][0]
+            got.setdefault(lkey, []).append(rv)
+        for lkey in expected:
+            assert sorted(got.get(lkey, [])) == expected[lkey], lkey
+
+
 # -- behaviors under the matrices --------------------------------------------
 
 
