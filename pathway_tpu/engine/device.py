@@ -279,6 +279,36 @@ def factorize(values: np.ndarray) -> tuple[list, np.ndarray]:
     return uniques.tolist(), inverse
 
 
+def factorize_multi(
+    arrays: "list[np.ndarray]",
+) -> tuple[np.ndarray, np.ndarray]:
+    """Composite factorization over several same-length columns:
+    ``(first, inverse)`` where ``first[g]`` is a representative row index
+    of distinct tuple ``g`` and ``inverse[i]`` is row ``i``'s tuple id.
+
+    Tuple identity is reduced to integer-code identity column by column:
+    per-column dense codes (``np.unique``) chain through a mixed-radix
+    combine, re-densified each step so codes stay ``< n**2`` and the
+    int64 product cannot overflow. No Python tuples are materialised.
+    """
+    combined: np.ndarray | None = None
+    for a in arrays:
+        _u, inv = np.unique(a, return_inverse=True)
+        inv = inv.astype(np.int64, copy=False).reshape(-1)
+        if combined is None:
+            combined = inv
+        else:
+            _pu, prev = np.unique(combined, return_inverse=True)
+            combined = prev.astype(np.int64).reshape(-1) * np.int64(
+                len(_u)
+            ) + inv
+    assert combined is not None
+    _uc, first, inverse = np.unique(
+        combined, return_index=True, return_inverse=True
+    )
+    return first, inverse.reshape(-1)
+
+
 def segment_count(
     inverse: np.ndarray, diffs: np.ndarray, n_groups: int
 ) -> np.ndarray:

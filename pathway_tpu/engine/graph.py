@@ -767,74 +767,93 @@ def _keys_unique(kb: np.ndarray, n: int) -> bool:
 
 
 class _JoinSide:
-    """One side's rows in columnar form: join-key array, key bytes, and
-    the full column set (object arrays where a column isn't clean).
-    Unified-dtype key casts and the NaN screen are cached per side, so
-    probing a long-lived block costs the cast/scan once, not once per
-    commit."""
+    """One side's rows in columnar form: join-key arrays (one per key
+    column), key bytes, and the full column set (object arrays where a
+    column isn't clean). Unified-dtype key casts and the NaN screen are
+    cached per side AND per key column, so probing a long-lived block
+    costs the cast/scan once, not once per commit."""
 
-    __slots__ = ("n", "jk", "kb", "cols", "_jk_int", "_jk_f64", "_nan")
+    __slots__ = ("n", "jks", "kb", "cols", "_jk_int", "_jk_f64", "_nan")
 
-    def __init__(self, n, jk, kb, cols) -> None:
+    def __init__(self, n, jks, kb, cols) -> None:
         self.n = n
-        self.jk = jk
+        self.jks = jks
         self.kb = kb
         self.cols = cols
-        self._jk_int = None
-        self._jk_f64: Any = None  # False = not exactly representable
-        self._nan: bool | None = None
+        self._jk_int: dict[int, np.ndarray] = {}
+        self._jk_f64: dict[int, Any] = {}  # False = not representable
+        self._nan: dict[int, bool] = {}
 
-    def jk_has_nan(self) -> bool:
-        if self._nan is None:
-            self._nan = (
-                self.jk.dtype.kind == "f" and bool(np.isnan(self.jk).any())
+    def jk_has_nan(self, i: int = 0) -> bool:
+        got = self._nan.get(i)
+        if got is None:
+            jk = self.jks[i]
+            got = self._nan[i] = (
+                jk.dtype.kind == "f" and bool(np.isnan(jk).any())
             )
-        return self._nan
+        return got
 
-    def jk_int(self) -> np.ndarray:
-        if self._jk_int is None:
-            self._jk_int = (
-                self.jk
-                if self.jk.dtype == np.int64
-                else self.jk.astype(np.int64)
+    def jk_int(self, i: int = 0) -> np.ndarray:
+        got = self._jk_int.get(i)
+        if got is None:
+            jk = self.jks[i]
+            got = self._jk_int[i] = (
+                jk if jk.dtype == np.int64 else jk.astype(np.int64)
             )
-        return self._jk_int
+        return got
 
-    def jk_f64(self) -> np.ndarray | None:
-        if self._jk_f64 is None:
-            jk = self.jk
+    def jk_f64(self, i: int = 0) -> np.ndarray | None:
+        got = self._jk_f64.get(i)
+        if got is None:
+            jk = self.jks[i]
             if jk.dtype.kind == "i" and jk.size:
                 amax = int(np.abs(jk).max())
                 if amax < 0 or amax > _JOIN_FLOAT_EXACT:
-                    self._jk_f64 = False  # would round in float64
+                    self._jk_f64[i] = False  # would round in float64
                     return None
             cast = jk if jk.dtype == np.float64 else jk.astype(np.float64)
-            self._jk_f64 = False if bool(np.isnan(cast).any()) else cast
-        return None if self._jk_f64 is False else self._jk_f64
+            got = self._jk_f64[i] = (
+                False if bool(np.isnan(cast).any()) else cast
+            )
+        return None if got is False else got
 
 
 _JOIN_FLOAT_EXACT = 1 << 53
 
 
-def _unify_join_keys(a: "_JoinSide", b: "_JoinSide"):
-    """Key arrays of two sides cast to one comparison dtype matching
+def _unify_join_col(a: "_JoinSide", b: "_JoinSide", i: int):
+    """Key column ``i`` of two sides cast to one comparison dtype matching
     Python dict-key equality (True == 1 == 1.0), or None when vectorized
     equality would diverge (NaN identity, huge ints in float64, or
     cross-kind pairs like str vs int — route those to the dict path)."""
-    ka, kb_ = a.jk.dtype.kind, b.jk.dtype.kind
+    ajk, bjk = a.jks[i], b.jks[i]
+    ka, kb_ = ajk.dtype.kind, bjk.dtype.kind
     if ka == kb_:
-        if ka == "f" and (a.jk_has_nan() or b.jk_has_nan()):
+        if ka == "f" and (a.jk_has_nan(i) or b.jk_has_nan(i)):
             return None
-        return a.jk, b.jk
+        return ajk, bjk
     kinds = {ka, kb_}
     if kinds <= {"b", "i"}:
-        return a.jk_int(), b.jk_int()
+        return a.jk_int(i), b.jk_int(i)
     if kinds <= {"b", "i", "f"}:
-        a2, b2 = a.jk_f64(), b.jk_f64()
+        a2, b2 = a.jk_f64(i), b.jk_f64(i)
         if a2 is None or b2 is None:
             return None
         return a2, b2
     return None
+
+
+def _unify_join_keys(a: "_JoinSide", b: "_JoinSide"):
+    """Per-key-column unification: (left arrays, right arrays) or None."""
+    left: list[np.ndarray] = []
+    right: list[np.ndarray] = []
+    for i in range(len(a.jks)):
+        uni = _unify_join_col(a, b, i)
+        if uni is None:
+            return None
+        left.append(uni[0])
+        right.append(uni[1])
+    return left, right
 
 
 def _match_join_pairs(la: np.ndarray, ra: np.ndarray):
@@ -859,6 +878,25 @@ def _match_join_pairs(la: np.ndarray, ra: np.ndarray):
     csum = np.cumsum(counts) - counts
     offs = np.arange(total) - np.repeat(csum, counts)
     return l_idx, order[starts + offs]
+
+
+def _match_join_pairs_multi(
+    l_arrays: "list[np.ndarray]", r_arrays: "list[np.ndarray]"
+):
+    """Multi-column join matching: reduce key TUPLES to joint integer
+    codes (factorized over the concatenation of both sides, so equal
+    tuples get equal codes across sides), then run the single-array
+    sort-based matcher. Columns arrive already dtype-unified."""
+    from pathway_tpu.engine.device import factorize_multi
+
+    if len(l_arrays) == 1:
+        return _match_join_pairs(l_arrays[0], r_arrays[0])
+    nl = len(l_arrays[0])
+    both = [
+        np.concatenate([la, ra]) for la, ra in zip(l_arrays, r_arrays)
+    ]
+    _first, inverse = factorize_multi(both)
+    return _match_join_pairs(inverse[:nl], inverse[nl:])
 
 
 def _hash_join_pairs_py(lkb: np.ndarray, rkb: np.ndarray) -> np.ndarray:
@@ -933,14 +971,14 @@ class JoinNode(Node):
         self._columnar_ok = (
             kind == JoinKind.INNER
             and not id_from_left
-            and len(self.left_on) == 1
-            and len(self.right_on) == 1
+            and len(self.left_on) >= 1
+            and len(self.left_on) == len(self.right_on)
         )
 
     # -- columnar fast path -------------------------------------------------
 
     def _side_from_batch(
-        self, batch: DeltaBatch, on_col: int, arity: int
+        self, batch: DeltaBatch, on_cols: Sequence[int], arity: int
     ) -> _JoinSide | None:
         from pathway_tpu.engine import device
         from pathway_tpu.native import kernels as _native
@@ -952,8 +990,8 @@ class JoinNode(Node):
         if payload is not None:
             if payload.diffs is not None and not (payload.diffs == 1).all():
                 return None
-            jk = payload.cols[on_col]
-            if jk.dtype.kind not in "bifU":
+            jks = [payload.cols[c] for c in on_cols]
+            if any(jk.dtype.kind not in "bifU" for jk in jks):
                 return None
             try:
                 kb = payload.kbytes()
@@ -963,12 +1001,15 @@ class JoinNode(Node):
                 return None
             if not batch._insert_only and not _keys_unique(kb, n):
                 return None
-            return _JoinSide(n, jk, kb, list(payload.cols))
+            return _JoinSide(n, jks, kb, list(payload.cols))
         entries = batch.entries
         view = device.ColumnarView(entries, from_entries=True)
-        jk = view.column(on_col)
-        if jk is None or jk.dtype.kind not in "bifU":
-            return None
+        jks = []
+        for c in on_cols:
+            jk = view.column(c)
+            if jk is None or jk.dtype.kind not in "bifU":
+                return None
+            jks.append(jk)
         if _native is not None:
             diffs = _native.entry_diffs(entries)
             if not (diffs == 1).all():
@@ -993,7 +1034,7 @@ class JoinNode(Node):
                 arr[:] = [e[1][c] for e in entries]
                 col = arr
             cols.append(col)
-        return _JoinSide(n, jk, kb, cols)
+        return _JoinSide(n, jks, kb, cols)
 
     def _emit_part(
         self,
@@ -1030,10 +1071,10 @@ class JoinNode(Node):
         from pathway_tpu.engine.batch import Columns
 
         ls = self._side_from_batch(
-            left_batch, self.left_on[0], self.inputs[0].arity
+            left_batch, self.left_on, self.inputs[0].arity
         )
         rs = self._side_from_batch(
-            right_batch, self.right_on[0], self.inputs[1].arity
+            right_batch, self.right_on, self.inputs[1].arity
         )
         if ls is None or rs is None:
             return None
@@ -1049,7 +1090,7 @@ class JoinNode(Node):
             uni = _unify_join_keys(l, r)
             if uni is None:
                 return None
-            l_idx, r_idx = _match_join_pairs(*uni)
+            l_idx, r_idx = _match_join_pairs_multi(*uni)
             if len(l_idx):
                 matches.append((l, r, l_idx, r_idx))
         # all screens passed: commit the block appends, then emit
@@ -1099,9 +1140,9 @@ class JoinNode(Node):
                 entries = Columns(
                     side.n, side.cols, kbytes=side.kb
                 ).to_entries()
-                jks = side.jk.tolist()
-                for (key, row, _d), jkv in zip(entries, jks):
-                    arr.setdefault((jkv,), {})[key] = row
+                jk_lists = zip(*(a.tolist() for a in side.jks))
+                for (key, row, _d), jkv in zip(entries, jk_lists):
+                    arr.setdefault(jkv, {})[key] = row
 
     def op_state(self) -> dict:
         # snapshot a dict VIEW of the arrangements without degrading the
@@ -1302,30 +1343,33 @@ class JoinNode(Node):
 
 
 def _groupby_batch_arrays(
-    batch: DeltaBatch, by_col: int, sum_cols: Sequence[int]
+    batch: DeltaBatch, by_cols: Sequence[int], sum_cols: Sequence[int]
 ):
-    """Extract ``(by, diffs, sum value arrays)`` for a vectorized groupby
-    pass — shared by the columnar state machine and the degraded-mode
-    vectorized path so their cleanliness screens can never diverge.
-    Returns None whenever the batch is not cleanly columnar: mixed/object
-    dtypes, NaN group values (np.unique collapses NaNs while the row path
-    groups them by bit pattern), non-numeric sum columns."""
+    """Extract ``(by arrays, diffs, sum value arrays)`` for a vectorized
+    groupby pass — shared by the columnar state machine and the
+    degraded-mode vectorized path so their cleanliness screens can never
+    diverge. Returns None whenever the batch is not cleanly columnar:
+    mixed/object dtypes, NaN group values (np.unique collapses NaNs while
+    the row path groups them by bit pattern), non-numeric sum columns."""
     from pathway_tpu.engine import device
     from pathway_tpu.native import kernels as _native
 
     cols = batch.columns
     if cols is not None:
-        by = cols.cols[by_col]
-        if by.dtype.kind not in "bifU":
+        bys = [cols.cols[c] for c in by_cols]
+        if any(by.dtype.kind not in "bifU" for by in bys):
             return None
         diffs = cols.diffs
         getcol = lambda c: cols.cols[c]  # noqa: E731
     else:
         entries = batch.entries
         view = device.ColumnarView(entries, from_entries=True)
-        by = view.column(by_col)
-        if by is None:
-            return None
+        bys = []
+        for c in by_cols:
+            by = view.column(c)
+            if by is None or by.dtype.kind not in "bifU":
+                return None
+            bys.append(by)
         if _native is not None:
             diffs = _native.entry_diffs(entries)
         else:
@@ -1333,7 +1377,9 @@ def _groupby_batch_arrays(
                 (d for _k, _r, d in entries), np.int64, len(entries)
             )
         getcol = view.column
-    if by.dtype.kind == "f" and np.isnan(by).any():
+    if any(
+        by.dtype.kind == "f" and np.isnan(by).any() for by in bys
+    ):
         return None
     vals = []
     for c in sum_cols:
@@ -1345,19 +1391,33 @@ def _groupby_batch_arrays(
             return None
         vals.append(col)
     if diffs is None:
-        diffs = np.ones(len(by), np.int64)
-    return by, diffs, vals
+        diffs = np.ones(len(bys[0]), np.int64)
+    return bys, diffs, vals
+
+
+def _factorize_bys(bys: "list[np.ndarray]"):
+    """``(raw tuples, inverse)`` of the distinct by-value tuples in a
+    batch — single-column keeps the cheap ``np.unique`` path."""
+    from pathway_tpu.engine.device import factorize, factorize_multi
+
+    if len(bys) == 1:
+        uniq, inverse = factorize(bys[0])
+        return [(v,) for v in uniq], inverse.reshape(-1)
+    first, inverse = factorize_multi(bys)
+    return list(zip(*(by[first].tolist() for by in bys))), inverse
 
 
 class _ColumnarGroups:
-    """Fully columnar group state for single-by-column count/sum groupbys.
+    """Fully columnar group state for count/sum groupbys over clean by
+    columns (one or several).
 
     Replaces the per-group Python objects (dict entry + reducer states +
     tuple rebuilds) with flat arrays: ``member`` (signed multiplicity) and
     one accumulator array per sum reducer, indexed by a dense group id.
-    A streaming delta commit then costs one ``np.unique`` + segment
-    reductions + O(touched groups) array math — the reference's semigroup
-    reducer update (src/engine/reduce.rs:78) at NumPy speed.
+    A streaming delta commit then costs one factorization (``np.unique``,
+    composite codes for multi-by) + segment reductions + O(touched
+    groups) array math — the reference's semigroup reducer update
+    (src/engine/reduce.rs:78) at NumPy speed.
 
     Any batch the arrays cannot represent exactly (mixed/object dtypes,
     NaN group values, ERROR cells, int64 overflow risk) makes the owner
@@ -1366,7 +1426,8 @@ class _ColumnarGroups:
     """
 
     __slots__ = (
-        "by_col",
+        "by_cols",
+        "_single",
         "kinds",
         "sum_cols",
         "index",
@@ -1380,18 +1441,24 @@ class _ColumnarGroups:
     _CAP0 = 1024
 
     def __init__(
-        self, by_col: int, reducers: Sequence[tuple[Reducer, Sequence[int]]]
+        self,
+        by_cols: Sequence[int],
+        reducers: Sequence[tuple[Reducer, Sequence[int]]],
     ) -> None:
         from pathway_tpu.engine.reducers import ReducerKind
 
-        self.by_col = by_col
+        self.by_cols = list(by_cols)
+        # single-by state stores bare scalars in index/by_raw (tuple
+        # wrapping + tuple hashing per touched group measurably drags
+        # the incremental hot path); multi-by stores value tuples
+        self._single = len(self.by_cols) == 1
         self.kinds = [r.kind for r, _c in reducers]
         self.sum_cols = [
             cols[0] if r.kind == ReducerKind.SUM else -1
             for r, cols in reducers
         ]
-        self.index: dict[Any, int] = {}  # normalised by-value -> group id
-        self.by_raw: list[Any] = []  # first-seen raw by-value per group
+        self.index: dict[Any, int] = {}  # normalised by-value(s) -> group id
+        self.by_raw: list[Any] = []  # first-seen raw by-value(s) per group
         self.gkeys: list[Pointer] = []
         self.member = np.zeros(self._CAP0, np.int64)
         self.accs: list[np.ndarray | None] = [
@@ -1401,7 +1468,7 @@ class _ColumnarGroups:
         self.size = 0
 
     @staticmethod
-    def _norm(v: Any) -> Any:
+    def _norm_one(v: Any) -> Any:
         """Group-identity key matching hash_values equivalence: bools are
         tagged apart from ints, int-valued floats collapse onto ints."""
         if isinstance(v, bool):
@@ -1409,6 +1476,13 @@ class _ColumnarGroups:
         if isinstance(v, float) and -(2**63) < v < 2**63 and v == int(v):
             return int(v)
         return v
+
+    def _norm(self, raw: Any) -> Any:
+        """Raw by-value (scalar for single-by, tuple for multi-by) -> the
+        index key under hash_values-equivalent identity."""
+        if self._single:
+            return self._norm_one(raw)
+        return tuple(map(self._norm_one, raw))
 
     def _grow(self, need: int) -> None:
         cap = len(self.member)
@@ -1426,8 +1500,9 @@ class _ColumnarGroups:
                 self.accs[i] = grown
 
     def _batch_arrays(self, batch: DeltaBatch):
-        """(by, diffs, sum value arrays) or None when not cleanly columnar."""
-        return _groupby_batch_arrays(batch, self.by_col, self.sum_cols)
+        """(by arrays, diffs, sum value arrays) or None when not cleanly
+        columnar."""
+        return _groupby_batch_arrays(batch, self.by_cols, self.sum_cols)
 
     def process_batch(self, batch: DeltaBatch, node: "GroupbyNode"):
         """Apply one delta batch; returns the output DeltaBatch, or None to
@@ -1439,8 +1514,8 @@ class _ColumnarGroups:
         got = self._batch_arrays(batch)
         if got is None:
             return None
-        by, diffs, vals = got
-        n = len(by)
+        bys, diffs, vals = got
+        n = len(bys[0])
         if n == 0:
             return DeltaBatch()
         dmax = int(np.abs(diffs).max()) if n else 0
@@ -1449,8 +1524,11 @@ class _ColumnarGroups:
         for col in vals:
             if col is not None and device.int_sum_overflow_risk(col, n, dmax):
                 return None
-        uniq, inverse = np.unique(by, return_inverse=True)
-        raws = uniq.tolist()
+        if self._single:
+            raws, inverse = device.factorize(bys[0])
+            inverse = inverse.reshape(-1)
+        else:
+            raws, inverse = _factorize_bys(bys)
         nu = len(raws)
         gdiffs = device.segment_count(inverse, diffs, nu)
         deltas: list[np.ndarray | None] = []
@@ -1471,7 +1549,11 @@ class _ColumnarGroups:
                 self._grow(gi + 1)
                 index[k] = gi
                 self.by_raw.append(raw)
-                self.gkeys.append(hash_values((raw,), salt=b"groupby"))
+                self.gkeys.append(
+                    hash_values(
+                        (raw,) if self._single else raw, salt=b"groupby"
+                    )
+                )
                 self.size = gi + 1
                 created.append(i)
             gis[i] = gi
@@ -1529,6 +1611,9 @@ class _ColumnarGroups:
         gkeys = self.gkeys
         by_raw = self.by_raw
 
+        n_by = len(self.by_cols)
+        single = self._single
+
         def block(mask, member_vals, acc_vals):
             sel = np.flatnonzero(mask)
             sel_g = gis[sel].tolist()
@@ -1537,11 +1622,16 @@ class _ColumnarGroups:
             # densify when the by values are cleanly typed, so downstream
             # columnar consumers (hash join, expressions) stay columnar;
             # mixed/exotic values keep the exact object representation
-            byv = device._extract(by_vals)
-            if byv is None:
-                byv = np.empty(len(by_vals), object)
-                byv[:] = by_vals
-            cols = [byv]
+            cols = []
+            for j in range(n_by):
+                col_vals = (
+                    by_vals if single else [t[j] for t in by_vals]
+                )
+                byv = device._extract(col_vals)
+                if byv is None:
+                    byv = np.empty(len(col_vals), object)
+                    byv[:] = col_vals
+                cols.append(byv)
             for ri, kind in enumerate(self.kinds):
                 if kind == ReducerKind.COUNT:
                     cols.append(member_vals[sel])
@@ -1626,7 +1716,7 @@ class _ColumnarGroups:
         groups: dict[Pointer, list[Any]] = {}
         for k, gi in self.index.items():
             raw = self.by_raw[gi]
-            by_vals = (raw,)
+            by_vals = (raw,) if self._single else raw
             states = []
             for ri, (reducer, _cols) in enumerate(node.reducers):
                 state = reducer.make_state()
@@ -1676,13 +1766,13 @@ class GroupbyNode(Node):
         self._cg: _ColumnarGroups | None = None
         if (
             not set_id
-            and len(by_cols) == 1
+            and len(by_cols) >= 1
             and all(
                 r.kind in (ReducerKind.COUNT, ReducerKind.SUM)
                 for r, _c in reducers
             )
         ):
-            self._cg = _ColumnarGroups(by_cols[0], reducers)
+            self._cg = _ColumnarGroups(by_cols, reducers)
         # (types, by_vals) -> gkey: a streaming workload touches the same
         # groups commit after commit — the blake2b derivation dominated
         # the incremental-update bench at ~1024 touched groups x 100
@@ -1734,14 +1824,14 @@ class GroupbyNode(Node):
         return tuple(by_vals) + tuple(vals)
 
     def _process_columnar(self, batch: DeltaBatch) -> DeltaBatch | None:
-        """Vectorized path for count/sum groupbys over a single clean by
-        column: per-row work collapses to np.unique + segment reductions
+        """Vectorized path for count/sum groupbys over clean by columns:
+        per-row work collapses to factorization + segment reductions
         (engine/device.py), leaving only per-group Python. Falls back (None)
         whenever semantics would differ from the row-wise loop."""
         from pathway_tpu.engine import device
         from pathway_tpu.engine.reducers import ReducerKind
 
-        if self.set_id or len(self.by_cols) != 1:
+        if self.set_id or len(self.by_cols) < 1:
             return None
         for reducer, cols in self.reducers:
             if reducer.kind not in (ReducerKind.COUNT, ReducerKind.SUM):
@@ -1750,11 +1840,11 @@ class GroupbyNode(Node):
             cols[0] if r.kind == ReducerKind.SUM else -1
             for r, cols in self.reducers
         ]
-        got = _groupby_batch_arrays(batch, self.by_cols[0], sum_col_idx)
+        got = _groupby_batch_arrays(batch, self.by_cols, sum_col_idx)
         if got is None:
             return None
-        by, diffs, vals = got
-        n = len(by)
+        bys, diffs, vals = got
+        n = len(bys[0])
         dmax = int(np.abs(diffs).max()) if n else 0
         if dmax < 0:  # abs(INT64_MIN) wraps
             return None
@@ -1765,7 +1855,7 @@ class GroupbyNode(Node):
             if device.int_sum_overflow_risk(col, n, dmax):
                 return None
             sum_arrays[ri] = col
-        uniques, inverse = device.factorize(by)
+        uniques, inverse = _factorize_bys(bys)
         n_groups = len(uniques)
         gdiffs = device.segment_count(inverse, diffs, n_groups)
         aggs: list[Any] = []
@@ -1779,8 +1869,7 @@ class GroupbyNode(Node):
                     )
                 )
         out = DeltaBatch()
-        for gi, val in enumerate(uniques):
-            by_vals = (val,)
+        for gi, by_vals in enumerate(uniques):
             gkey = self._group_key(by_vals)
             entry = self.groups.get(gkey)
             old_row = self._group_row(entry) if entry is not None else None

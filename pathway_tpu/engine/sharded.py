@@ -191,9 +191,35 @@ class ShardedScheduler:
         kind = rule[0]
         if kind in ("cols", "col"):
             if kind == "cols":
-                if len(rule[1]) != 1:
-                    return None
-                c = rule[1][0]
+                idxs = rule[1]
+                if len(idxs) == 0:
+                    return np.full(
+                        payload.n, _shard_of((), self.n), np.int64
+                    )
+                if len(idxs) > 1:
+                    # multi-column routing: composite factorization, one
+                    # Python tuple hash per DISTINCT key tuple
+                    from pathway_tpu.engine.device import factorize_multi
+
+                    arrays = [payload.cols[c] for c in idxs]
+                    if any(a.dtype.kind not in "bifU" for a in arrays):
+                        return None
+                    if any(
+                        a.dtype.kind == "f" and np.isnan(a).any()
+                        for a in arrays
+                    ):
+                        # np.unique collapses distinct-bit NaNs that the
+                        # per-row hash_values routing keeps apart
+                        return None
+                    first, inverse = factorize_multi(arrays)
+                    reps = zip(*(a[first].tolist() for a in arrays))
+                    table = np.fromiter(
+                        (_shard_of(t, self.n) for t in reps),
+                        np.int64,
+                        len(first),
+                    )
+                    return table[inverse]
+                c = idxs[0]
                 wrap = lambda v: (v,)  # noqa: E731 — tuple-wrapped hash
             else:
                 c = rule[1]
@@ -205,6 +231,10 @@ class ShardedScheduler:
                 wrap = lambda v: v  # noqa: E731 — bare-value hash
             col = payload.cols[c]
             if col.dtype.kind not in "bifU":
+                return None
+            if col.dtype.kind == "f" and np.isnan(col).any():
+                # np.unique collapses distinct-bit NaNs that the per-row
+                # hash_values routing keeps apart
                 return None
             uniq, inverse = np.unique(col, return_inverse=True)
             table = np.fromiter(

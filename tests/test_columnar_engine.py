@@ -533,6 +533,203 @@ class TestColumnarJoin:
         assert not jn._columnar_ok  # NaN identity is the dict path's call
 
 
+def _join_scope2(columnar=True):
+    """Two-equality join: rows are (k1, k2, v); join on [0, 1]."""
+    scope = Scope()
+    left = scope.input_session(3)
+    right = scope.input_session(3)
+    jn = scope.join_tables(
+        left, right, left_on=[0, 1], right_on=[0, 1], kind="inner"
+    )
+    if not columnar:
+        jn._columnar_ok = False
+    return scope, left, right, jn
+
+
+class TestMultiKeyColumnar:
+    """Multi-column columnar joins/groupbys: composite-code matching must
+    be unobservable next to the row/dict paths (the round-4 engine only
+    took single-key operators columnar; reference joins arbitrary key
+    tuples natively, src/engine/dataflow.rs:820)."""
+
+    def test_multikey_join_randomized_equivalence(self):
+        rng_ops = []
+        rng = random.Random(77)
+        for c in range(8):
+            commit = []
+            for i in range(rng.randint(5, 90)):
+                side = rng.random() < 0.6
+                commit.append(
+                    (
+                        side,
+                        ref_scalar((c, i, side)),
+                        (
+                            rng.randint(0, 5),
+                            f"s{rng.randint(0, 3)}",
+                            float(rng.randint(0, 99)),
+                        ),
+                    )
+                )
+            rng_ops.append(commit)
+
+        def run(columnar):
+            scope, left, right, jn = _join_scope2(columnar)
+            sched = Scheduler(scope)
+            for commit in rng_ops:
+                for is_left, key, row in commit:
+                    (left if is_left else right).insert(key, row)
+                sched.commit()
+            if columnar:
+                # the columnar path actually carried the load
+                assert jn._columnar_ok and jn._blocks_left
+            return dict(jn.current)
+
+        a, b = run(True), run(False)
+        assert a == b and len(a) > 100
+
+    def test_multikey_join_cross_dtype_second_key(self):
+        """int vs float equality on key column 2 (1 == 1.0) must match the
+        dict path's Python semantics under composite codes."""
+        for columnar in (True, False):
+            scope, left, right, jn = _join_scope2(columnar)
+            sched = Scheduler(scope)
+            left.insert(ref_scalar("a"), (7, 1, 0.0))
+            left.insert(ref_scalar("b"), (7, 2, 0.0))
+            right.insert(ref_scalar("x"), (7, 1.0, 5.0))
+            right.insert(ref_scalar("y"), (7, 2.5, 6.0))
+            sched.commit()
+            rows = sorted(tuple(r) for r in jn.current.values())
+            assert rows == [(7, 1, 0.0, 7, 1.0, 5.0)], (columnar, rows)
+
+    def test_multikey_join_nan_in_one_key_falls_back(self):
+        scope, left, right, jn = _join_scope2()
+        sched = Scheduler(scope)
+        left.insert(ref_scalar("a"), (1, float("nan"), 0.0))
+        right.insert(ref_scalar("x"), (1, float("nan"), 1.0))
+        sched.commit()
+        assert not jn._columnar_ok
+
+    def test_multikey_join_retraction_hands_over(self):
+        scope, left, right, jn = _join_scope2()
+        sched = Scheduler(scope)
+        for i in range(200):
+            left.insert(
+                ref_scalar(("l", i)), (i % 5, i % 3, float(i))
+            )
+        for i in range(15):
+            right.insert(
+                ref_scalar(("r", i)), (i % 5, i % 3, float(i) * 10)
+            )
+        sched.commit()
+        assert jn._columnar_ok and jn._blocks_left
+        before = dict(jn.current)
+        left.remove(ref_scalar(("l", 7)), (2, 1, 7.0))
+        sched.commit()
+        assert not jn._columnar_ok
+        # exactly the pairs of the removed row disappeared
+        lost = set(before) - set(jn.current)
+        assert len(lost) == 1  # (2,1) matched one right row
+        assert len(jn.current) == len(before) - 1
+
+    def _groupby2(self, row_wise=False):
+        scope = Scope()
+        sess = scope.input_session(3)
+        gb = scope.group_by_table(
+            sess,
+            by_cols=[0, 1],
+            reducers=[
+                (make_reducer(ReducerKind.SUM), [2]),
+                (make_reducer(ReducerKind.COUNT), []),
+            ],
+        )
+        if row_wise:
+            gb._cg = None
+        return scope, sess, gb
+
+    def test_multikey_groupby_randomized_equivalence(self):
+        rng = random.Random(31)
+        live: dict = {}
+        ops = []
+        for _ in range(20):
+            commit = []
+            for _ in range(rng.randint(1, 70)):
+                if live and rng.random() < 0.3:
+                    key = rng.choice(list(live))
+                    commit.append(("-", key, live.pop(key)))
+                else:
+                    key = ref_scalar(("k", rng.randint(0, 10**9)))
+                    row = (
+                        rng.randint(0, 4),
+                        f"g{rng.randint(0, 3)}",
+                        float(rng.randint(-9, 9)),
+                    )
+                    live[key] = row
+                    commit.append(("+", key, row))
+            ops.append(commit)
+
+        def run(row_wise):
+            scope, sess, gb = self._groupby2(row_wise)
+            sched = Scheduler(scope)
+            for commit in ops:
+                for op, key, row in commit:
+                    (sess.insert if op == "+" else sess.remove)(key, row)
+                sched.commit()
+            if not row_wise:
+                assert gb._cg is not None  # never degraded
+            return dict(gb.current)
+
+        assert run(False) == run(True)
+
+    def test_multikey_groupby_bool_int_identity(self):
+        """(True, 1.0) and (1, 1) are DIFFERENT groups on the first column
+        (bool tag) and the SAME value on the second (1.0 == 1) — exactly
+        the row path's hash_values identity."""
+
+        def run(row_wise):
+            scope, sess, gb = self._groupby2(row_wise)
+            sched = Scheduler(scope)
+            rows = [
+                (True, 1, 1.0),
+                (1, 1.0, 2.0),
+                (1, 1, 4.0),
+                (True, 1.0, 8.0),
+            ]
+            for i, row in enumerate(rows):
+                sess.insert(ref_scalar(i), row)
+            sched.commit()
+            return sorted(
+                (repr(r[0]), r[1], r[2]) for r in gb.current.values()
+            )
+
+        a, b = run(False), run(True)
+        assert a == b
+        assert [x[2] for x in a] == [6.0, 9.0]  # two groups, not four
+
+    def test_multikey_groupby_nan_by_value_degrades(self):
+        scope, sess, gb = self._groupby2()
+        sched = Scheduler(scope)
+        sess.insert(ref_scalar(1), (1, float("nan"), 2.0))
+
+        # second by column is int here, first carries the NaN
+        scope2 = Scope()
+        sess2 = scope2.input_session(3)
+        gb2 = scope2.group_by_table(
+            sess2,
+            by_cols=[1, 0],
+            reducers=[(make_reducer(ReducerKind.COUNT), [])],
+        )
+        sched.commit()
+        assert gb._cg is None  # degraded, state exact via row path
+        assert len(gb.current) == 1
+        sched2 = Scheduler(scope2)
+        sess2.insert(ref_scalar(1), (1.5, 3, 0.0))
+        sess2.insert(ref_scalar(2), (1.5, 3, 0.0))
+        sched2.commit()
+        assert gb2._cg is not None  # clean floats stay columnar
+        (row,) = gb2.current.values()
+        assert row == (3, 1.5, 2)
+
+
 class TestSharedBatchAliasing:
     def test_buffer_end_flush_does_not_mutate_shared_batches(self):
         """BufferNode.take must not extend a taken batch in place: take()
