@@ -606,6 +606,73 @@ def multimodal_leg() -> dict:
     }
 
 
+def flash_parity_leg() -> dict:
+    """Compiled flash-attention numerics + speed on the real chip:
+    ``test_on_tpu_parity``'s fwd/bwd max-error checks, captured as bench
+    numbers because CI has no accelerator (the pallas kernels otherwise
+    only ever run in interpret mode on CPU), plus a timed fwd comparison
+    at a longer sequence where tiling should win."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.transformer import dense_attention
+    from pathway_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(7)
+
+    def mk(b, t, h, d):
+        f = lambda: jnp.asarray(  # noqa: E731
+            rng.normal(size=(b, t, h, d)), jnp.float32
+        )
+        return f(), f(), f()
+
+    # numerics: the parity test's shape + ragged mask
+    q, k, v = mk(2, 256, 4, 32)
+    mask = jnp.asarray([[True] * 256, [True] * 200 + [False] * 56])
+    fwd_err = float(
+        jnp.abs(
+            flash_attention(q, k, v, mask) - dense_attention(q, k, v, mask)
+        ).max()
+    )
+
+    def loss(fn, q_, k_, v_):
+        return (fn(q_, k_, v_, mask) ** 2).sum()
+
+    g_flash = jax.grad(lambda *a: loss(flash_attention, *a), (0, 1, 2))(
+        q, k, v
+    )
+    g_dense = jax.grad(lambda *a: loss(dense_attention, *a), (0, 1, 2))(
+        q, k, v
+    )
+    bwd_err = max(
+        float(jnp.abs(gf - gd).max()) for gf, gd in zip(g_flash, g_dense)
+    )
+
+    # speed: longer sequence, fwd only, warm jit
+    t_long = int(os.environ.get("BENCH_FLASH_SEQ", "2048"))
+    ql, kl, vl = mk(2, t_long, 8, 64)
+
+    def timed(fn) -> float:
+        run = jax.jit(lambda a, b_, c: fn(a, b_, c, None))
+        jax.block_until_ready(run(ql, kl, vl))  # compile
+        reps, t0 = 10, time.perf_counter()
+        for _ in range(reps):
+            out = run(ql, kl, vl)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps * 1000.0
+
+    flash_ms = timed(flash_attention)
+    dense_ms = timed(dense_attention)
+    return {
+        "fwd_max_err": round(fwd_err, 5),
+        "bwd_max_err": round(bwd_err, 5),
+        "parity_ok": bool(fwd_err < 2e-2 and bwd_err < 5e-2),
+        "seq": t_long,
+        "flash_fwd_ms": round(flash_ms, 3),
+        "dense_fwd_ms": round(dense_ms, 3),
+    }
+
+
 def query_load_leg() -> dict:
     """Query serving under concurrent load: N clients fire queries at the
     running engine simultaneously; admission is batched (a short
@@ -800,6 +867,10 @@ def _maybe_run_dataflow(out: dict, timeout_s: float | None = None) -> None:
     numbers). ``timeout_s`` bounds the attempt via a worker thread."""
     if os.environ.get("BENCH_SKIP_DATAFLOW", "") in ("1", "true"):
         return
+    if _DATAFLOW_PREFETCH and out is not _DATAFLOW_PREFETCH:
+        # already computed while waiting out a tunnel outage
+        out.update(_DATAFLOW_PREFETCH)
+        return
 
     def attempt() -> None:
         try:
@@ -821,56 +892,124 @@ def _maybe_run_dataflow(out: dict, timeout_s: float | None = None) -> None:
         out["dataflow_error"] = f"dataflow workloads hung past {timeout_s}s"
 
 
-def _probe_device(timeout_s: float) -> None:
-    """Fail fast with a diagnostic JSON line if the accelerator is
-    unreachable (the remote-device tunnel has outage windows; a hang here
-    would otherwise eat the whole bench budget silently)."""
-    import threading
+#: host dataflow results prefetched while waiting out a tunnel outage,
+#: reused by _maybe_run_dataflow so the work never runs twice
+_DATAFLOW_PREFETCH: dict = {}
 
-    done = threading.Event()
-    failure: list = []
 
-    def touch():
-        try:
-            import jax
-            import jax.numpy as jnp
+def _probe_device_retrying() -> None:
+    """Wait for first accelerator contact, reprobing ACROSS the bench
+    window instead of one fixed probe (the remote-device tunnel has
+    outage windows that can END mid-round — rounds 3/4 lost every device
+    number to a single 300s probe). Wakes every BENCH_REPROBE_GAP_S to
+    log a reprobe line (the stderr trail proves the retries happened),
+    and keeps trying until BENCH_PROBE_WINDOW_S elapses. While waiting,
+    the host dataflow workloads run in parallel so the window is not
+    dead time. On exhaustion: emit the outage JSON (with the dataflow
+    numbers) and exit 3."""
+    window = float(os.environ.get("BENCH_PROBE_WINDOW_S", "1800"))
+    gap = float(os.environ.get("BENCH_REPROBE_GAP_S", "120"))
+    start = time.time()
+    failures: list = []
+    attempts = [0]
 
-            jax.block_until_ready(jnp.ones((8,)))
-        except Exception as exc:  # noqa: BLE001 — report, don't wait out
-            failure.append(repr(exc))
-        done.set()
+    def start_touch():
+        # jax backend init is process-global: a HUNG init simply
+        # completes when the tunnel returns, so one thread suffices for
+        # the hang case; a RAISED init error gets a fresh attempt
+        done = threading.Event()
+        failure: list = []
 
-    t = threading.Thread(target=touch, daemon=True)
-    t.start()
-    done.wait(timeout_s)
-    if not done.is_set() or failure:
-        error = (
-            f"accelerator init failed: {failure[0]}"
-            if failure
-            else (
-                f"accelerator unreachable: first device op did not "
-                f"complete within {timeout_s}s (BENCH_DEVICE_PROBE_S)"
-            )
-        )
-        extra: dict = {}
-        # the host dataflow workloads need no device — preserve the
-        # regression line even through an accelerator outage, but bound
-        # the attempt so a hung engine can't defeat the fail-fast probe
-        _maybe_run_dataflow(extra, timeout_s=600.0)
+        def touch():
+            attempts[0] += 1
+            try:
+                import jax
+                import jax.numpy as jnp
+
+                jax.block_until_ready(jnp.ones((8,)))
+            except Exception as exc:  # noqa: BLE001 — report + retry
+                failure.append(repr(exc))
+            done.set()
+
+        threading.Thread(target=touch, daemon=True).start()
+        return done, failure
+
+    done, failure = start_touch()
+    dataflow_thread: threading.Thread | None = None
+    while True:
+        elapsed = time.time() - start
+        remaining = window - elapsed
+        if done.wait(timeout=max(0.0, min(gap, remaining))):
+            if not failure:
+                print(
+                    f"bench probe: device contact after "
+                    f"{time.time() - start:.0f}s "
+                    f"({attempts[0]} attempt(s))",
+                    file=__import__("sys").stderr,
+                    flush=True,
+                )
+                if dataflow_thread is not None:
+                    # finish the host workloads before device legs so
+                    # CPU contention cannot skew the pipeline feed
+                    dataflow_thread.join(900.0)
+                return
+            failures.append(failure[0])
+            if time.time() - start < window:
+                time.sleep(min(gap, max(0.0, window - (time.time() - start))))
+                done, failure = start_touch()
+                continue
+        elapsed = time.time() - start
         print(
-            json.dumps(
-                {
-                    "metric": "streaming_rag_pipeline_docs_per_sec",
-                    "value": None,
-                    "unit": "docs/sec",
-                    "vs_baseline": None,
-                    "error": error,
-                    "extra": extra,
-                }
-            ),
+            f"bench probe: no device contact after {elapsed:.0f}s "
+            f"(attempt {attempts[0]}, window {window:.0f}s, "
+            f"reprobe gap {gap:.0f}s)",
+            file=__import__("sys").stderr,
             flush=True,
         )
-        os._exit(3)
+        if dataflow_thread is None:
+            # the outage wait doubles as the dataflow window
+
+            def prefetch() -> None:
+                _maybe_run_dataflow(_DATAFLOW_PREFETCH)
+
+            dataflow_thread = threading.Thread(
+                target=prefetch, daemon=True
+            )
+            dataflow_thread.start()
+        if elapsed >= window:
+            break
+    error = (
+        f"accelerator init failed: {failures[-1]}"
+        if failures
+        else (
+            f"accelerator unreachable: no device contact across "
+            f"{window:.0f}s window, {attempts[0]} probe attempt(s) "
+            f"(BENCH_PROBE_WINDOW_S / BENCH_REPROBE_GAP_S)"
+        )
+    )
+    extra: dict = {}
+    if dataflow_thread is not None:
+        dataflow_thread.join(900.0)
+    if _DATAFLOW_PREFETCH:
+        extra.update(_DATAFLOW_PREFETCH)
+    else:
+        _maybe_run_dataflow(extra, timeout_s=600.0)
+    extra["probe_attempts"] = attempts[0]
+    extra["probe_window_s"] = window
+    print(
+        json.dumps(
+            {
+                "metric": "streaming_rag_pipeline_docs_per_sec",
+                "value": None,
+                "unit": "docs/sec",
+                "vs_baseline": None,
+                "error": error,
+                "extra": extra,
+            }
+        ),
+        flush=True,
+    )
+    os._exit(3)
 
 
 def _run_bounded(fn, timeout_s: float):
@@ -922,7 +1061,7 @@ def _device_alive(timeout_s: float) -> bool:
 
 
 def main() -> None:
-    _probe_device(float(os.environ.get("BENCH_DEVICE_PROBE_S", "300")))
+    _probe_device_retrying()
     leg_timeout = float(os.environ.get("BENCH_LEG_TIMEOUT_S", "1200"))
     stats: dict = {}
     errors: dict = {}
@@ -989,26 +1128,30 @@ def main() -> None:
         )
         if q is not None:
             stats["query_device_ms"] = q
-    dev = bounded("device_only", device_only_leg)
-    if dev is not None:
-        stats["device_docs_per_sec"] = round(dev, 1)
-    # host dataflow workloads (wordcount/join/groupby/filter at 1M rows
-    # + incremental phase) tracked in the same JSON line every round;
-    # needs no device, so it runs regardless of tunnel state
-    _maybe_run_dataflow(stats, timeout_s=900.0)
-    # BASELINE configs #2-#5 (VERDICT r2 #4); each skippable via env
+    # device legs in VALUE-DENSITY order (a brief tunnel window should
+    # yield the highest-information numbers first): query-load, flash
+    # parity, decode, multimodal, then the config sweep + device-only
     for name, flag, fn in (
-        ("config2_vector_store", "BENCH_SKIP_VECTOR_STORE", vector_store_leg),
-        ("config3_reranker", "BENCH_SKIP_RERANKER", reranker_leg),
+        ("config2b_query_load", "BENCH_SKIP_QUERY_LOAD", query_load_leg),
+        ("flash_parity", "BENCH_SKIP_FLASH_PARITY", flash_parity_leg),
         ("config4_decode", "BENCH_SKIP_DECODE", decode_leg),
         ("config5_multimodal", "BENCH_SKIP_MULTIMODAL", multimodal_leg),
-        ("config2b_query_load", "BENCH_SKIP_QUERY_LOAD", query_load_leg),
+        ("config2_vector_store", "BENCH_SKIP_VECTOR_STORE", vector_store_leg),
+        ("config3_reranker", "BENCH_SKIP_RERANKER", reranker_leg),
     ):
         if os.environ.get(flag, "") in ("1", "true"):
             continue
         result = bounded(name, fn)
         if result is not None:
             stats[name] = result
+    dev = bounded("device_only", device_only_leg)
+    if dev is not None:
+        stats["device_docs_per_sec"] = round(dev, 1)
+    # host dataflow workloads (wordcount/join/groupby/filter at 1M rows
+    # + incremental phase) tracked in the same JSON line every round;
+    # needs no device, so it runs last regardless of tunnel state (and
+    # reuses the outage-window prefetch when one ran)
+    _maybe_run_dataflow(stats, timeout_s=900.0)
     if errors:
         stats["leg_errors"] = errors
     out = {
