@@ -36,6 +36,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import sys
 import threading
 import time
 
@@ -867,9 +868,14 @@ def _maybe_run_dataflow(out: dict, timeout_s: float | None = None) -> None:
     numbers). ``timeout_s`` bounds the attempt via a worker thread."""
     if os.environ.get("BENCH_SKIP_DATAFLOW", "") in ("1", "true"):
         return
-    if _DATAFLOW_PREFETCH and out is not _DATAFLOW_PREFETCH:
-        # already computed while waiting out a tunnel outage
-        out.update(_DATAFLOW_PREFETCH)
+    if _DATAFLOW_THREAD and out is not _DATAFLOW_PREFETCH:
+        # a prefetch started during the outage wait: wait for IT instead
+        # of racing a second 1M-row run against it
+        _DATAFLOW_THREAD[0].join(timeout_s if timeout_s else 900.0)
+        if _DATAFLOW_PREFETCH:
+            out.update(_DATAFLOW_PREFETCH)
+        else:
+            out["dataflow_error"] = "dataflow prefetch still running"
         return
 
     def attempt() -> None:
@@ -895,6 +901,7 @@ def _maybe_run_dataflow(out: dict, timeout_s: float | None = None) -> None:
 #: host dataflow results prefetched while waiting out a tunnel outage,
 #: reused by _maybe_run_dataflow so the work never runs twice
 _DATAFLOW_PREFETCH: dict = {}
+_DATAFLOW_THREAD: list = []  # the live prefetch thread, if one started
 
 
 def _probe_device_retrying() -> None:
@@ -907,7 +914,14 @@ def _probe_device_retrying() -> None:
     the host dataflow workloads run in parallel so the window is not
     dead time. On exhaustion: emit the outage JSON (with the dataflow
     numbers) and exit 3."""
-    window = float(os.environ.get("BENCH_PROBE_WINDOW_S", "1800"))
+    window = float(
+        os.environ.get(
+            "BENCH_PROBE_WINDOW_S",
+            # legacy knob: configs that set BENCH_DEVICE_PROBE_S to fail
+            # fast keep that meaning (it bounds the whole window)
+            os.environ.get("BENCH_DEVICE_PROBE_S", "1800"),
+        )
+    )
     gap = float(os.environ.get("BENCH_REPROBE_GAP_S", "120"))
     start = time.time()
     failures: list = []
@@ -935,49 +949,55 @@ def _probe_device_retrying() -> None:
         return done, failure
 
     done, failure = start_touch()
-    dataflow_thread: threading.Thread | None = None
     while True:
         elapsed = time.time() - start
         remaining = window - elapsed
-        if done.wait(timeout=max(0.0, min(gap, remaining))):
-            if not failure:
-                print(
-                    f"bench probe: device contact after "
-                    f"{time.time() - start:.0f}s "
-                    f"({attempts[0]} attempt(s))",
-                    file=__import__("sys").stderr,
-                    flush=True,
-                )
-                if dataflow_thread is not None:
-                    # finish the host workloads before device legs so
-                    # CPU contention cannot skew the pipeline feed
-                    dataflow_thread.join(900.0)
-                return
-            failures.append(failure[0])
-            if time.time() - start < window:
-                time.sleep(min(gap, max(0.0, window - (time.time() - start))))
-                done, failure = start_touch()
-                continue
+        contacted = done.wait(timeout=max(0.0, min(gap, remaining)))
+        if contacted and not failure:
+            print(
+                f"bench probe: device contact after "
+                f"{time.time() - start:.0f}s "
+                f"({attempts[0]} attempt(s))",
+                file=sys.stderr,
+                flush=True,
+            )
+            if _DATAFLOW_THREAD:
+                # finish the host workloads before device legs so CPU
+                # contention cannot skew the pipeline feed
+                _DATAFLOW_THREAD[0].join(900.0)
+            return
+        # both outage modes (hung init, raised init) log the reprobe
+        # trail and reuse the wait as the dataflow window
         elapsed = time.time() - start
         print(
             f"bench probe: no device contact after {elapsed:.0f}s "
             f"(attempt {attempts[0]}, window {window:.0f}s, "
-            f"reprobe gap {gap:.0f}s)",
-            file=__import__("sys").stderr,
+            f"reprobe gap {gap:.0f}s"
+            + (f", last error: {failure[0]}" if failure else "")
+            + ")",
+            file=sys.stderr,
             flush=True,
         )
-        if dataflow_thread is None:
-            # the outage wait doubles as the dataflow window
+        if not _DATAFLOW_THREAD:
 
             def prefetch() -> None:
                 _maybe_run_dataflow(_DATAFLOW_PREFETCH)
 
-            dataflow_thread = threading.Thread(
-                target=prefetch, daemon=True
-            )
-            dataflow_thread.start()
+            t = threading.Thread(target=prefetch, daemon=True)
+            _DATAFLOW_THREAD.append(t)
+            t.start()
         if elapsed >= window:
             break
+        if contacted:
+            # init RAISED (vs hung): pace to the reprobe gap, then try a
+            # fresh attempt
+            failures.append(failure[0])
+            time.sleep(
+                max(0.0, min(gap, window - (time.time() - start)))
+            )
+            if time.time() - start >= window:
+                break
+            done, failure = start_touch()
     error = (
         f"accelerator init failed: {failures[-1]}"
         if failures
@@ -988,8 +1008,8 @@ def _probe_device_retrying() -> None:
         )
     )
     extra: dict = {}
-    if dataflow_thread is not None:
-        dataflow_thread.join(900.0)
+    if _DATAFLOW_THREAD:
+        _DATAFLOW_THREAD[0].join(900.0)
     if _DATAFLOW_PREFETCH:
         extra.update(_DATAFLOW_PREFETCH)
     else:

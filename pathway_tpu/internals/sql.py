@@ -9,7 +9,11 @@ parenthesized nesting, literals, quoted identifiers), FROM with table
 aliases and derived tables (nested subqueries, arbitrarily deep),
 INNER/LEFT JOIN ... ON equalities (subqueries join too), WHERE,
 IN/NOT IN value lists, GROUP BY with aggregates (count/sum/min/max/avg),
-HAVING, UNION ALL, INTERSECT.
+global aggregates without GROUP BY, HAVING, UNION ALL, INTERSECT,
+WITH/CTE blocks (chained, reusable, valid in any subquery position), and
+non-correlated scalar subqueries (lifted to live left-cross-join inputs,
+so the scalar updates incrementally; reference threads its WITH blocks
+through every SELECT at internals/sql.py:175-176,525).
 """
 
 from __future__ import annotations
@@ -37,7 +41,7 @@ _KEYWORDS = {
     "not", "join", "inner", "left", "on", "union", "all", "intersect",
     "except", "in", "count", "sum", "min", "max", "avg", "null", "true",
     "false", "is", "case", "when", "then", "else", "end", "between",
-    "like", "cast", "coalesce", "nullif", "distinct",
+    "like", "cast", "coalesce", "nullif", "distinct", "with",
 }
 
 
@@ -101,9 +105,31 @@ class _Parser:
     # -- grammar -------------------------------------------------------------
 
     def parse_query(self) -> dict:
-        q = self.parse_set_chain()
+        q = self.parse_query_expr()
         self.expect("end")
         return q
+
+    def parse_query_expr(self) -> dict:
+        """Optional WITH prologue over a set chain. CTEs see earlier CTEs
+        (chained), and a WITH may open any subquery position (derived
+        tables, IN (...), scalar subqueries), like standard SQL."""
+        if self.accept("kw", "with"):
+            ctes: list[tuple[str, dict]] = []
+            while True:
+                name = self.expect("name")
+                self.expect("kw", "as")
+                self.expect("op", "(")
+                sub = self.parse_query_expr()
+                self.expect("op", ")")
+                ctes.append((name, sub))
+                if not self.accept("op", ","):
+                    break
+            return {
+                "kind": "with",
+                "ctes": ctes,
+                "query": self.parse_query_expr(),
+            }
+        return self.parse_set_chain()
 
     def parse_set_chain(self) -> dict:
         """UNION ALL chain over INTERSECT chains (INTERSECT binds tighter,
@@ -189,7 +215,7 @@ class _Parser:
         """A FROM/JOIN operand: plain table name, or a parenthesized
         subquery with a mandatory alias (standard derived-table form)."""
         if self.accept("op", "("):
-            sub = self.parse_set_chain()
+            sub = self.parse_query_expr()
             self.expect("op", ")")
             self.accept("kw", "as")
             alias = self.expect("name")
@@ -254,8 +280,8 @@ class _Parser:
             return ("like", e, pattern, negated_in)
         if self.accept("kw", "in"):
             self.expect("op", "(")
-            if self.peek() == ("kw", "select"):
-                sub = self.parse_set_chain()
+            if self.peek() in (("kw", "select"), ("kw", "with")):
+                sub = self.parse_query_expr()
                 self.expect("op", ")")
                 # semi-join form; negation stays in the node (the WHERE
                 # lowering turns it into intersect/difference, which a
@@ -350,6 +376,10 @@ class _Parser:
         if k == "kw" and v == "false":
             return ("lit", False)
         if k == "op" and v == "(":
+            if self.peek() in (("kw", "select"), ("kw", "with")):
+                sub = self.parse_query_expr()
+                self.expect("op", ")")
+                return ("scalar_subquery", sub)
             e = self.parse_expr()
             self.expect("op", ")")
             return e
@@ -369,6 +399,8 @@ class _Lowerer:
         # after a JOIN, alias -> {original column name -> materialized name};
         # duplicate names across join sides are qualified as f"{alias}_{name}"
         self.colmap: dict[str, dict[str, str]] = {}
+        # scalar subquery AST node (by identity) -> grafted aux column name
+        self._scalar_cols: dict[int, str] = {}
 
     @staticmethod
     def _distinct(t: Table) -> Table:
@@ -378,6 +410,15 @@ class _Lowerer:
         )
 
     def lower(self, q: dict) -> Table:
+        if q["kind"] == "with":
+            # each CTE lowers ONCE into a Table the later CTEs and the
+            # main query see by name (reference threads the WITH block
+            # through every SELECT, internals/sql.py:175-176,525); a CTE
+            # referenced twice reuses the same dataflow subgraph
+            env = dict(self.tables)
+            for name, sub in q["ctes"]:
+                env[name] = _Lowerer(env).lower(sub)
+            return _Lowerer(env).lower(q["query"])
         if q["kind"] == "union":
             left = self.lower(q["left"])
             right = self.lower(q["right"])
@@ -488,6 +529,14 @@ class _Lowerer:
             return out
         if op in ("case", "like", "cast", "coalesce", "nullif"):
             return self._special(node, lambda n: self.expr(n, scope))
+        if op == "scalar_subquery":
+            aux = self._scalar_cols.get(id(node))
+            if aux is None:
+                raise ValueError(
+                    "pw.sql: scalar subquery in an unsupported position "
+                    "(supported: SELECT items, WHERE, GROUP BY, HAVING)"
+                )
+            return next(iter(scope.values()))[aux]
         if op == "in_subquery":
             raise ValueError(
                 "pw.sql: IN (SELECT ...) is only supported as a top-level "
@@ -618,6 +667,11 @@ class _Lowerer:
                 return self._special(
                     node, lambda n: self._agg_expr(n, scope, gb)
                 )
+            if node[0] == "scalar_subquery":
+                # inside a reduce the grafted aux column is not a group
+                # key; it is constant across all rows, so min() recovers
+                # the scalar without changing semantics
+                return reducers.min(self.expr(node, scope))
             parts = [self._agg_expr(c, scope, gb) for c in node[1:]]
             return self._combine(node[0], parts)
         return self.expr(node, scope)
@@ -678,6 +732,69 @@ class _Lowerer:
         collapses onto one side)."""
         return table.select(**{n: table[n] for n in table.column_names()})
 
+    def _graft_scalar_subqueries(
+        self, q: dict, current: Table, scope: dict[str, Table]
+    ) -> tuple[Table, dict[str, Table]]:
+        """Lift each non-correlated scalar subquery to a computed join
+        input: lower it to its (single-row, single-column) table and
+        LEFT-cross-join it onto ``current`` as an aux column, so the
+        value streams incrementally like any other input (an empty
+        subquery result reads as NULL, matching SQL). Correlated
+        subqueries fail the inner lowering's name resolution."""
+
+        def collect(node: Any, acc: list) -> None:
+            if isinstance(node, tuple):
+                if node and node[0] == "scalar_subquery":
+                    acc.append(node)
+                    return
+                for child in node[1:]:
+                    collect(child, acc)
+            elif isinstance(node, list):
+                for child in node:
+                    collect(child, acc)
+
+        found: list = []
+        for node, _alias in q["items"]:
+            if node != "*":
+                collect(node, found)
+        collect(q["where"], found)
+        for g in q["group_by"] or ():
+            collect(g, found)
+        collect(q["having"], found)
+        by_shape: dict[str, str] = {}  # structural dedup of repeats
+        for i, node in enumerate(found):
+            if id(node) in self._scalar_cols:
+                continue
+            shape = repr(node)
+            aux = by_shape.get(shape)
+            if aux is not None:
+                # textually identical subquery: reuse the grafted column
+                self._scalar_cols[id(node)] = aux
+                continue
+            sub_t = _Lowerer(self.tables).lower(node[1])
+            sub_cols = sub_t.column_names()
+            if len(sub_cols) != 1:
+                raise ValueError(
+                    "pw.sql: scalar subquery needs exactly one output "
+                    "column"
+                )
+            aux = f"_pw_sq_{i}"
+            # collapse to ONE row: unique() poisons with ERROR when the
+            # subquery yields several distinct values (SQL's more-than-
+            # one-row runtime error, expressed through error poisoning);
+            # an empty subquery leaves no row and left-join pads NULL
+            sub_one = sub_t.reduce(
+                **{aux: reducers.unique(sub_t[sub_cols[0]])}
+            )
+            keep = {n: current[n] for n in current.column_names()}
+            current = current.join(sub_one, how="left").select(
+                **keep, **{aux: sub_one[aux]}
+            )
+            self._scalar_cols[id(node)] = aux
+            by_shape[shape] = aux
+            scope = {name: current for name in scope}
+        return current, scope
+
     def lower_select(self, q: dict) -> Table:
         self.colmap = {}  # per-SELECT: a UNION branch must not see the other's joins
         scope: dict[str, Table] = {}
@@ -723,6 +840,7 @@ class _Lowerer:
             self.colmap = newmap
             scope = {name: current for name in scope}
             scope["__joined__"] = current
+        current, scope = self._graft_scalar_subqueries(q, current, scope)
         if q["where"] is not None:
             def conjuncts(node):
                 if isinstance(node, tuple) and node[0] == "and":
@@ -808,11 +926,43 @@ class _Lowerer:
                     [n for n in out if n != "_pw_having"]
                 ]
             return result
+        def has_agg(node: Any) -> bool:
+            if isinstance(node, tuple):
+                if node and node[0] == "agg":
+                    return True
+                if node and node[0] == "scalar_subquery":
+                    return False  # its aggregates belong to the subquery
+                return any(has_agg(c) for c in node[1:])
+            if isinstance(node, list):
+                return any(has_agg(c) for c in node)
+            return False
+
+        if any(
+            node != "*" and has_agg(node) for node, _a in q["items"]
+        ):
+            # global aggregate (no GROUP BY): one output row over the
+            # whole table, e.g. SELECT count(*), max(v) FROM t
+            out = {}
+            for idx, (node, alias) in enumerate(q["items"]):
+                if node == "*":
+                    raise ValueError("pw.sql: SELECT * with aggregates")
+                out[self._item_name(node, alias, idx)] = self._agg_expr(
+                    node, scope
+                )
+            if q["having"] is not None:
+                out["_pw_having"] = self._agg_expr(q["having"], scope)
+            result = current.reduce(**out)
+            if q["having"] is not None:
+                result = result.filter(result["_pw_having"])[
+                    [n for n in out if n != "_pw_having"]
+                ]
+            return result
         out = {}
         for idx, (node, alias) in enumerate(q["items"]):
             if node == "*":
                 for name in current.column_names():
-                    out[name] = current[name]
+                    if not name.startswith("_pw_sq_"):
+                        out[name] = current[name]
                 continue
             out[self._item_name(node, alias, idx)] = self.expr(node, scope)
         return current.select(**out)

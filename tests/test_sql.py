@@ -428,3 +428,193 @@ class TestDialectExtensions:
             emp=emp,
             good=good,
         ) == [("bob",)]
+
+
+class TestCTEsAndScalarSubqueries:
+    """WITH/CTE blocks + scalar subqueries (reference lowers CTEs and
+    threads the WITH block through every SELECT,
+    /root/reference/python/pathway/internals/sql.py:175-176,525)."""
+
+    def test_chained_ctes_referenced_twice(self):
+        t = people()
+        res = pw.sql(
+            "WITH grown AS (SELECT name, age, city FROM t WHERE age >= 25), "
+            "parisians AS (SELECT name, age FROM grown WHERE city = 'paris') "
+            "SELECT g.name, p.age FROM grown g JOIN parisians p "
+            "ON g.name = p.name",
+            t=t,
+        )
+        assert rows_of(res) == [("alice", 30), ("carol", 35)]
+
+    def test_cte_feeding_a_join(self):
+        t = people()
+        cities = pw.debug.table_from_rows(
+            pw.schema_from_types(cname=str, country=str),
+            [("paris", "fr"), ("london", "uk")],
+        )
+        res = pw.sql(
+            "WITH adults AS (SELECT name, city FROM t WHERE age >= 25) "
+            "SELECT name, country FROM adults "
+            "JOIN cities ON adults.city = cities.cname",
+            t=t,
+            cities=cities,
+        )
+        assert rows_of(res) == [
+            ("alice", "fr"),
+            ("bob", "uk"),
+            ("carol", "fr"),
+        ]
+
+    def test_cte_used_twice_in_one_query(self):
+        t = people()
+        res = pw.sql(
+            "WITH base AS (SELECT city, age FROM t) "
+            "SELECT a.city, count(*) AS n FROM base a "
+            "JOIN base b ON a.city = b.city GROUP BY a.city",
+            t=t,
+        )
+        # 2 rows per city on each side -> 4 join pairs per city
+        assert rows_of(res) == [("london", 4), ("paris", 4)]
+
+    def test_cte_in_derived_table_and_in_subquery(self):
+        t = people()
+        res = pw.sql(
+            "SELECT name FROM (WITH old AS (SELECT name, age FROM t "
+            "WHERE age > 28) SELECT name FROM old) AS sub",
+            t=t,
+        )
+        assert rows_of(res) == [("alice",), ("carol",)]
+        res2 = pw.sql(
+            "SELECT name FROM t WHERE city IN "
+            "(WITH p AS (SELECT city, count(*) AS n FROM t GROUP BY city) "
+            "SELECT city FROM p WHERE n >= 2) AND age > 24",
+            t=t,
+        )
+        assert rows_of(res2) == [("alice",), ("bob",), ("carol",)]
+
+    def test_global_aggregates(self):
+        t = people()
+        res = pw.sql(
+            "SELECT count(*) AS n, max(age) AS mx, avg(age) AS mean FROM t",
+            t=t,
+        )
+        assert rows_of(res) == [(4, 35, 27.5)]
+
+    def test_scalar_subquery_in_select(self):
+        t = people()
+        res = pw.sql(
+            "SELECT name, age - (SELECT min(age) FROM t) AS above FROM t "
+            "WHERE city = 'paris'",
+            t=t,
+        )
+        assert rows_of(res) == [("alice", 10), ("carol", 15)]
+
+    def test_scalar_subquery_in_where(self):
+        t = people()
+        res = pw.sql(
+            "SELECT name FROM t WHERE age > (SELECT avg(age) FROM t)",
+            t=t,
+        )
+        assert rows_of(res) == [("alice",), ("carol",)]
+
+    def test_scalar_subquery_with_cte_and_other_table(self):
+        t = people()
+        bonus = pw.debug.table_from_rows(
+            pw.schema_from_types(amount=int), [(5,), (7,)]
+        )
+        res = pw.sql(
+            "WITH caps AS (SELECT max(amount) AS cap FROM bonus) "
+            "SELECT name, age + (SELECT cap FROM caps) AS boosted FROM t "
+            "WHERE age >= 30",
+            t=t,
+            bonus=bonus,
+        )
+        assert rows_of(res) == [("alice", 37), ("carol", 42)]
+
+    def test_scalar_subquery_over_empty_table_is_null(self):
+        t = people()
+        empty = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int), []
+        )
+        res = pw.sql(
+            "SELECT name FROM t WHERE age > coalesce("
+            "(SELECT max(v) FROM empty), 0) AND age > 30",
+            t=t,
+            empty=empty,
+        )
+        assert rows_of(res) == [("carol",)]
+
+    def test_streaming_scalar_subquery_updates(self):
+        """The grafted scalar is a live join input: a new row that shifts
+        the aggregate retracts and re-emits dependents."""
+        import pathway_tpu as pw_
+        from pathway_tpu.internals.parse_graph import G
+
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str, age=int),
+            [("a", 10), ("b", 20), ("c", 30)],
+            stream_rows=True,
+        )
+        res = pw.sql(
+            "SELECT name FROM t WHERE age >= (SELECT avg(age) FROM t)",
+            t=t,
+        )
+        assert rows_of(res) == [("b",), ("c",)]
+
+    def test_scalar_subquery_under_group_by(self):
+        t = people()
+        res = pw.sql(
+            "SELECT city, sum(age) - (SELECT min(age) FROM t) AS adj "
+            "FROM t GROUP BY city",
+            t=t,
+        )
+        assert rows_of(res) == [("london", 25), ("paris", 45)]
+
+    def test_scalar_subquery_multiple_rows_poisons(self):
+        """SQL's more-than-one-row runtime error surfaces as ERROR
+        poisoning (unique() reducer), not a silent cross join."""
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str, age=int),
+            [("a", 20), ("b", 60)],
+        )
+        u = pw.debug.table_from_rows(
+            pw.schema_from_types(v=int), [(5,), (50,)]
+        )
+        res = pw.sql(
+            "SELECT name FROM t WHERE age > (SELECT v FROM u)", t=t, u=u
+        )
+        assert rows_of(res) == []  # poisoned comparisons drop all rows
+
+    def test_identical_scalar_subqueries_graft_once(self):
+        t = people()
+        from pathway_tpu.internals import sql as sql_mod
+
+        ast = sql_mod._Parser(
+            sql_mod._tokenize(
+                "SELECT age - (SELECT min(age) FROM t) AS a, "
+                "age * (SELECT min(age) FROM t) AS b FROM t"
+            )
+        ).parse_query()
+        lowerer = sql_mod._Lowerer({"t": t})
+        res = lowerer.lower(ast)
+        # two AST nodes, ONE grafted aux column
+        assert len(lowerer._scalar_cols) == 2
+        assert len(set(lowerer._scalar_cols.values())) == 1
+        assert rows_of(res) == [
+            (0, 400),
+            (10, 600),
+            (15, 700),
+            (5, 500),
+        ]
+
+    def test_global_aggregate_having(self):
+        t = people()
+        res = pw.sql(
+            "SELECT count(*) AS n FROM t HAVING count(*) > 100", t=t
+        )
+        assert rows_of(res) == []
+        res2 = pw.sql(
+            "SELECT count(*) AS n FROM t HAVING count(*) > 2", t=t
+        )
+        assert rows_of(res2) == [(4,)]
