@@ -968,6 +968,10 @@ def _probe_device_retrying() -> None:
             return
         # both outage modes (hung init, raised init) log the reprobe
         # trail and reuse the wait as the dataflow window
+        if contacted:
+            # init raised (vs hung): record the root cause BEFORE any
+            # window-expiry break so the outage JSON reports it
+            failures.append(failure[0])
         elapsed = time.time() - start
         print(
             f"bench probe: no device contact after {elapsed:.0f}s "
@@ -989,9 +993,7 @@ def _probe_device_retrying() -> None:
         if elapsed >= window:
             break
         if contacted:
-            # init RAISED (vs hung): pace to the reprobe gap, then try a
-            # fresh attempt
-            failures.append(failure[0])
+            # pace to the reprobe gap, then try a fresh attempt
             time.sleep(
                 max(0.0, min(gap, window - (time.time() - start)))
             )

@@ -668,27 +668,36 @@ class GraphRunner:
         nr = len(right._column_names)
         k = len(on)
 
+        has_time = kind in ("interval_join", "asof_join")
+        # interval/asof nodes key on ONE instance value: several equality
+        # conditions fold into a single tuple-valued column (exactly the
+        # reference's `*on` -> join key tuple, _interval_join.py:583)
+        fold = has_time and k > 1
+
         def prep(node, side, layout, n, time_expr):
             extras: list[eex.EngineExpression] = [eex.KeyRef()]
             if time_expr is not None:
                 extras.append(self.compile(time_expr, layout))
-            for pair in on:
-                # explicit side index: `base is left` would misfire on
-                # self-joins where left and right are the same table
-                extras.append(self.compile(pair[side], layout))
+            # explicit side index: `base is left` would misfire on
+            # self-joins where left and right are the same table
+            compiled = [self.compile(pair[side], layout) for pair in on]
+            if fold:
+                extras.append(eex.MakeTuple(compiled))
+            else:
+                extras.extend(compiled)
             return scope.expression_table(
                 node, [eex.ColumnRef(i) for i in range(n)] + extras
             )
 
-        has_time = kind in ("interval_join", "asof_join")
         lt_expr = spec.params.get("left_time")
         rt_expr = spec.params.get("right_time")
         left_prep = prep(left_node, 0, llayout, nl, lt_expr if has_time else None)
         right_prep = prep(right_node, 1, rlayout, nr, rt_expr if has_time else None)
 
         t_off = 1 if has_time else 0
-        l_inst = list(range(nl + 1 + t_off, nl + 1 + t_off + k))
-        r_inst = list(range(nr + 1 + t_off, nr + 1 + t_off + k))
+        k_extras = 1 if fold else k
+        l_inst = list(range(nl + 1 + t_off, nl + 1 + t_off + k_extras))
+        r_inst = list(range(nr + 1 + t_off, nr + 1 + t_off + k_extras))
 
         if kind == "interval_join":
             node = tmp.IntervalJoinNode(
@@ -699,8 +708,8 @@ class GraphRunner:
                 right_time_col=nr + 1,
                 lower_bound=spec.params["lower_bound"],
                 upper_bound=spec.params["upper_bound"],
-                left_instance_col=l_inst[0] if k == 1 else None,
-                right_instance_col=r_inst[0] if k == 1 else None,
+                left_instance_col=l_inst[0] if k >= 1 else None,
+                right_instance_col=r_inst[0] if k >= 1 else None,
                 kind=how,
             )
         elif kind == "asof_join":
@@ -710,8 +719,8 @@ class GraphRunner:
                 right_prep,
                 left_time_col=nl + 1,
                 right_time_col=nr + 1,
-                left_instance_col=l_inst[0] if k == 1 else None,
-                right_instance_col=r_inst[0] if k == 1 else None,
+                left_instance_col=l_inst[0] if k >= 1 else None,
+                right_instance_col=r_inst[0] if k >= 1 else None,
                 direction=spec.params["direction"],
                 kind=how,
             )
@@ -723,7 +732,7 @@ class GraphRunner:
         for i, name in enumerate(left._column_names):
             combined.columns[(left._id, name)] = i
         combined.id_columns[left._id] = nl
-        off = nl + 1 + t_off + k
+        off = nl + 1 + t_off + k_extras
         for i, name in enumerate(right._column_names):
             combined.columns[(right._id, name)] = off + i
         combined.id_columns[right._id] = off + nr

@@ -939,24 +939,64 @@ class _Lowerer:
 
         if any(
             node != "*" and has_agg(node) for node, _a in q["items"]
-        ):
-            # global aggregate (no GROUP BY): one output row over the
-            # whole table, e.g. SELECT count(*), max(v) FROM t
+        ) or (q["having"] is not None and has_agg(q["having"])):
+            # global aggregate (no GROUP BY): ONE output row over the
+            # whole table, e.g. SELECT count(*), max(v) FROM t — present
+            # even when the input is empty (SQL: count(*)=0 row)
             out = {}
+            count_rooted: list[str] = []
             for idx, (node, alias) in enumerate(q["items"]):
                 if node == "*":
                     raise ValueError("pw.sql: SELECT * with aggregates")
-                out[self._item_name(node, alias, idx)] = self._agg_expr(
-                    node, scope
-                )
+                name = self._item_name(node, alias, idx)
+                out[name] = self._agg_expr(node, scope)
+                if (
+                    isinstance(node, tuple)
+                    and node[0] == "agg"
+                    and node[1] in ("count", "count_distinct")
+                ):
+                    count_rooted.append(name)
             if q["having"] is not None:
                 out["_pw_having"] = self._agg_expr(q["having"], scope)
             result = current.reduce(**out)
+            # an empty input leaves reduce with NO row; a static one-row
+            # marker left-cross-joined in restores SQL's single row:
+            # count-rooted items read 0, everything else NULL (compound
+            # expressions over aggregates read NULL when empty — a
+            # documented approximation)
+            import pathway_tpu.debug as _debug
+            from pathway_tpu.internals.schema import schema_from_types
+
+            marker = _debug.table_from_rows(
+                schema_from_types(_pw_one=int), [(1,)]
+            )
+            padded = marker.join(result, how="left")
+            from pathway_tpu.internals.expression import if_else
+
+            pad_cols = {}
+            for n in out:
+                col = result[n]
+                if n in count_rooted or n == "_pw_having":
+                    pad_cols[n] = if_else(
+                        col.is_not_none(),
+                        col,
+                        wrap_expression(
+                            0 if n in count_rooted else False
+                        ),
+                    )
+                else:
+                    pad_cols[n] = col
+            result = padded.select(**pad_cols)
             if q["having"] is not None:
                 result = result.filter(result["_pw_having"])[
                     [n for n in out if n != "_pw_having"]
                 ]
             return result
+        if q["having"] is not None:
+            raise ValueError(
+                "pw.sql: HAVING without GROUP BY requires an aggregate "
+                "predicate"
+            )
         out = {}
         for idx, (node, alias) in enumerate(q["items"]):
             if node == "*":

@@ -278,10 +278,6 @@ class WindowedTable:
         rows with time in [at + lower, at + upper]; with is_outer, empty
         windows surface with None aggregates (reference _window.py:771)."""
         w = self.window
-        if self.instance is not None:
-            raise NotImplementedError(
-                "intervals_over does not support instance="
-            )
         at_ref = w.at
         at_table = at_ref.table
         lb, ub = w.lower_bound, w.upper_bound
@@ -294,14 +290,24 @@ class WindowedTable:
             interval(lb, ub),
             how="inner",
         )
+        # instance rides as a GROUP key, not a join equality — every
+        # at-window sees all rows, groups split per instance (reference
+        # _IntervalsOverWindow._apply, _window.py:557-568)
+        inst_kwargs = (
+            {"_pw_instance": self.instance}
+            if self.instance is not None
+            else {}
+        )
         flat = joined.select(
             *[self.table[n] for n in self.table.column_names()],
             _pw_window_start=pw_apply(lambda p: p + lb, probe["_pw_at"]),
             _pw_window_end=pw_apply(lambda p: p + ub, probe["_pw_at"]),
+            **inst_kwargs,
         )
-        grouped = flat.groupby(
-            flat["_pw_window_start"], flat["_pw_window_end"]
-        )
+        by = [flat["_pw_window_start"], flat["_pw_window_end"]]
+        if self.instance is not None:
+            by.append(flat["_pw_instance"])
+        grouped = flat.groupby(*by)
         resolved_kwargs = {}
         for arg in args:
             resolved = _retarget(arg, self.table, flat)
@@ -427,10 +433,9 @@ class _TemporalJoinResult:
                 raise ValueError("temporal join conditions must be equalities")
             self._on.append((resolved._left, resolved._right))
         if kind in ("interval_join", "asof_join"):
-            if len(self._on) > 1:
-                raise NotImplementedError(
-                    "interval/asof joins support at most one equality condition"
-                )
+            # several equalities fold into one tuple-valued join key at
+            # lowering time (reference takes `*on` the same way,
+            # _interval_join.py:583)
             direction = params.get("direction")
             if direction is not None and direction not in (
                 "backward",

@@ -618,3 +618,33 @@ class TestCTEsAndScalarSubqueries:
             "SELECT count(*) AS n FROM t HAVING count(*) > 2", t=t
         )
         assert rows_of(res2) == [(4,)]
+
+    def test_global_aggregate_empty_input_single_row(self):
+        """SQL mandates ONE row for a global aggregate even over empty
+        input: count-rooted items read 0, others NULL."""
+        t = people()
+        res = pw.sql(
+            "SELECT count(*) AS c, max(age) AS m FROM t WHERE age > 100",
+            t=t,
+        )
+        assert rows_of(res) == [(0, None)]
+
+    def test_scalar_count_subquery_over_empty_is_zero(self):
+        t = people()
+        res = pw.sql(
+            "SELECT name FROM t WHERE "
+            "(SELECT count(*) FROM t WHERE age > 100) = 0 AND age > 30",
+            t=t,
+        )
+        assert rows_of(res) == [("carol",)]
+
+    def test_having_without_group_by(self):
+        t = people()
+        res = pw.sql("SELECT 1 AS one FROM t HAVING count(*) > 5", t=t)
+        assert rows_of(res) == []
+        res2 = pw.sql("SELECT 1 AS one FROM t HAVING count(*) > 2", t=t)
+        assert rows_of(res2) == [(1,)]
+        import pytest
+
+        with pytest.raises(ValueError, match="HAVING without GROUP BY"):
+            pw.sql("SELECT name FROM t HAVING age > 100", t=t)

@@ -571,3 +571,184 @@ class TestBehaviorEdges:
             w = at // duration * duration
             expected[w] = expected.get(w, 0) + 1
         assert got == expected
+
+# -- multi-equality temporal joins (reference *on, _interval_join.py:583) ----
+
+
+def _gen2(rng, n, i1s, i2s, t_range):
+    return [
+        (rng.randint(0, t_range), rng.choice(i1s), rng.choice(i2s), i)
+        for i in range(n)
+    ]
+
+
+def _interval2_oracle(lrows, rrows, lo, hi, how):
+    """Brute-force 2-equality interval join on (time, i1, i2, id) rows."""
+    out = []
+    l_matched, r_matched = set(), set()
+    for li, (lt, la, lb, lid) in enumerate(lrows):
+        for ri, (rt, ra, rb, rid) in enumerate(rrows):
+            if la == ra and lb == rb and lo <= rt - lt <= hi:
+                out.append((lt, lid, rt, rid))
+                l_matched.add(li)
+                r_matched.add(ri)
+    if how in ("left", "outer"):
+        out += [
+            (lt, lid, None, None)
+            for i, (lt, _a, _b, lid) in enumerate(lrows)
+            if i not in l_matched
+        ]
+    if how in ("right", "outer"):
+        out += [
+            (None, None, rt, rid)
+            for i, (rt, _a, _b, rid) in enumerate(rrows)
+            if i not in r_matched
+        ]
+    return sorted(out, key=repr)
+
+
+class TestMultiEqualityTemporalJoins:
+    """Several equality conditions fold into one tuple-valued join key
+    (reference interval_join takes ``*on``, _interval_join.py:583)."""
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_interval_join_two_equalities(self, how):
+        rng = random.Random(zlib.crc32(repr(("iv2", how)).encode()))
+        lrows = _gen2(rng, 30, ["a", "b"], [0, 1], 25)
+        rrows = _gen2(rng, 30, ["a", "b"], [0, 1], 25)
+        G.clear()
+        left = pw.debug.table_from_rows(
+            pw.schema_from_types(lt=int, l1=str, l2=int, lid=int), lrows
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(rt=int, r1=str, r2=int, rid=int), rrows
+        )
+        res = tmp.interval_join(
+            left,
+            right,
+            left.lt,
+            right.rt,
+            tmp.interval(-2, 2),
+            left.l1 == right.r1,
+            left.l2 == right.r2,
+            how=how,
+        ).select(lt=left.lt, lid=left.lid, rt=right.rt, rid=right.rid)
+        got = sorted(rows_of(res), key=repr)
+        expected = _interval2_oracle(lrows, rrows, -2, 2, how)
+        assert got == expected, how
+
+    @pytest.mark.parametrize("direction", ["backward", "forward", "nearest"])
+    @pytest.mark.parametrize("how", ["inner", "left"])
+    def test_asof_join_two_equalities(self, direction, how):
+        rng = random.Random(zlib.crc32(repr(("as2", direction, how)).encode()))
+        insts = [("x", 0), ("x", 1), ("y", 0)]
+        lrows = [
+            (rng.randint(0, 50), *rng.choice(insts), i) for i in range(25)
+        ]
+        # distinct right times per (i1, i2) pair: equal-time ties are
+        # implementation-defined, the oracle pins unique-time cases
+        rrows = [
+            (t, i1, i2, 100 * (1 + j) + k)
+            for j, (i1, i2) in enumerate(insts)
+            for k, t in enumerate(rng.sample(range(0, 60), 10))
+        ]
+
+        def oracle():
+            out = []
+            for lt, la, lb, lid in lrows:
+                cands = [
+                    (rt, rid)
+                    for rt, ra, rb, rid in rrows
+                    if (ra, rb) == (la, lb)
+                    and (
+                        (direction == "backward" and rt <= lt)
+                        or (direction == "forward" and rt >= lt)
+                        or direction == "nearest"
+                    )
+                ]
+                if cands:
+                    if direction == "backward":
+                        best = max(cands, key=lambda c: (c[0], c[1]))
+                    elif direction == "forward":
+                        best = min(cands, key=lambda c: (c[0], -c[1]))
+                    else:
+                        best = min(
+                            cands, key=lambda c: (abs(c[0] - lt), c[0], c[1])
+                        )
+                    out.append((lt, lid, best[1]))
+                elif how == "left":
+                    out.append((lt, lid, None))
+            return sorted(out, key=repr)
+
+        G.clear()
+        left = pw.debug.table_from_rows(
+            pw.schema_from_types(lt=int, l1=str, l2=int, lid=int), lrows
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(rt=int, r1=str, r2=int, rid=int), rrows
+        )
+        res = tmp.asof_join(
+            left,
+            right,
+            left.lt,
+            right.rt,
+            left.l1 == right.r1,
+            left.l2 == right.r2,
+            how=how,
+            direction=direction,
+        ).select(lt=left.lt, lid=left.lid, rid=right.rid)
+        got = sorted(rows_of(res), key=repr)
+        assert got == oracle(), (direction, how)
+
+
+class TestIntervalsOverInstance:
+    """instance= splits intervals_over windows per instance value
+    (reference _window.py:49,557-568: instance rides as a group key)."""
+
+    @pytest.mark.parametrize("is_outer", [False, True])
+    def test_instanced_against_oracle(self, is_outer):
+        lo, hi = -2, 2
+        rng = random.Random(zlib.crc32(repr(("io_inst", is_outer)).encode()))
+        anchors = sorted(rng.sample(range(0, 30), 6))
+        data = [
+            (rng.randint(0, 30), rng.choice(["u", "v"]), i)
+            for i in range(25)
+        ]
+        G.clear()
+        at = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int), [(a,) for a in anchors]
+        )
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(dt_=int, g=str, v=int), data
+        )
+        res = tmp.windowby(
+            t,
+            t.dt_,
+            window=tmp.intervals_over(
+                at=at.a, lower_bound=lo, upper_bound=hi, is_outer=is_outer
+            ),
+            instance=t.g,
+        ).reduce(
+            start=pw.this["_pw_window_start"],
+            inst=pw.this["_pw_instance"],
+            vals=pw.reducers.sorted_tuple(pw.this.v),
+        )
+        got = sorted(
+            (
+                (r[0] - lo, r[1], tuple(r[2]) if r[2] is not None else ())
+                for r in rows_of(res)
+            ),
+            key=repr,
+        )
+        expected = []
+        for a in anchors:
+            by_inst: dict = {}
+            for dt_, g, v in data:
+                if a + lo <= dt_ <= a + hi:
+                    by_inst.setdefault(g, []).append(v)
+            for g, vals in by_inst.items():
+                expected.append((a, g, tuple(sorted(vals))))
+            if not by_inst and is_outer:
+                expected.append((a, None, ()))
+        expected.sort(key=repr)
+        assert got == expected, is_outer
