@@ -3,10 +3,14 @@
 src/connectors/data_storage.rs:1061, Psql formatters data_format.rs:1625,
 :1684).
 
-The database is reached through an injected ``connection`` object with
-``execute(statement, params)`` (and optionally ``commit()``). psycopg2's
-cursor adapts directly (after $N -> %s placeholder translation); tests use
-a recording executor.
+The database is reached through the built-in wire-protocol client
+(``io/_pg_wire.py``: startup handshake with cleartext/md5/SCRAM-SHA-256
+auth and sslmode-driven TLS, extended-query Parse/Bind/Execute/Sync with
+$N placeholders, BEGIN/COMMIT transactional batches). An injected
+``connection`` object with ``execute(statement, params)`` (and
+optionally ``commit()``) overrides it; wrap a psycopg2 connection with
+:func:`psycopg2_adapter` to translate the $N placeholders it cannot
+execute natively.
 """
 
 from __future__ import annotations
@@ -16,24 +20,18 @@ from typing import Any
 from pathway_tpu.engine.formats import PsqlSnapshotFormatter, PsqlUpdatesFormatter
 from pathway_tpu.engine.storage import PsqlWriter
 from pathway_tpu.internals.table import Table
-from pathway_tpu.io._utils import attach_writer, require
+from pathway_tpu.io._utils import attach_writer
 
 
-def _executor(postgres_settings: dict | None, connection: Any) -> Any:
-    if connection is not None:
-        return connection
-    psycopg2 = require("psycopg2", "pw.io.postgres")
-    conn = psycopg2.connect(
-        **{k: v for k, v in (postgres_settings or {}).items()}
-    )
+def psycopg2_adapter(conn: Any) -> Any:
+    """Wrap a psycopg2 connection into the executor contract: the Psql
+    formatters emit $N placeholders (which repeat — the snapshot upsert
+    reuses $1 in VALUES, SET and WHERE), translated here to psycopg2's
+    NAMED pyformat so each occurrence binds the same parameter."""
+    import re
 
     class _Adapter:
         def execute(self, statement: str, params):
-            import re
-
-            # $N placeholders repeat (snapshot upsert reuses $1 in VALUES,
-            # SET and WHERE) — translate to psycopg2's *named* pyformat so
-            # each occurrence binds the same parameter
             stmt = re.sub(r"\$(\d+)", r"%(p\1)s", statement)
             named = {f"p{i + 1}": v for i, v in enumerate(params)}
             with conn.cursor() as cur:
@@ -43,6 +41,23 @@ def _executor(postgres_settings: dict | None, connection: Any) -> Any:
             conn.commit()
 
     return _Adapter()
+
+
+def _executor(postgres_settings: dict | None, connection: Any) -> Any:
+    if connection is not None:
+        return connection
+    from pathway_tpu.io._pg_wire import PgWireConnection
+
+    settings = dict(postgres_settings or {})
+    return PgWireConnection(
+        host=settings.get("host", "127.0.0.1"),
+        port=int(settings.get("port", 5432)),
+        user=settings.get("user", "pathway"),
+        password=settings.get("password"),
+        dbname=settings.get("dbname", settings.get("database", "pathway")),
+        connect_timeout=float(settings.get("connect_timeout", 10.0)),
+        sslmode=settings.get("sslmode", "prefer"),
+    )
 
 
 def write(

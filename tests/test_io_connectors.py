@@ -492,17 +492,39 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="primary_key"):
             r.poll()
 
-    def test_psycopg2_placeholder_translation(self):
-        """Repeated $N placeholders bind as named params (snapshot upserts)."""
-        import re
+    def test_psycopg2_adapter_placeholder_translation(self):
+        """psycopg2_adapter: repeated $N placeholders bind as named params
+        (snapshot upserts reuse $1 across VALUES/SET/WHERE)."""
+        from pathway_tpu.io.postgres import psycopg2_adapter
 
+        executed = []
+
+        class _Cursor:
+            def execute(self, stmt, named):
+                rendered = stmt % {k: repr(v) for k, v in named.items()}
+                executed.append(rendered)
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        class _Conn:
+            def cursor(self):
+                return _Cursor()
+
+            def commit(self):
+                executed.append("COMMIT")
+
+        adapter = psycopg2_adapter(_Conn())
         stmt, params = PsqlSnapshotFormatter("s", ["id"], ["id", "name"]).format(
             None, (1, "x"), 2, 1
         )
-        translated = re.sub(r"\$(\d+)", r"%(p\1)s", stmt)
-        named = {f"p{i + 1}": v for i, v in enumerate(params)}
-        rendered = translated % {k: repr(v) for k, v in named.items()}
-        assert "$" not in rendered and "%(" not in rendered
+        adapter.execute(stmt, params)
+        adapter.commit()
+        assert "$" not in executed[0] and "%(" not in executed[0]
+        assert executed[-1] == "COMMIT"
 
 
 class TestReviewRegressions2:

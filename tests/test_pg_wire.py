@@ -1,0 +1,314 @@
+"""Postgres wire protocol: client + fake server over REAL v3 frames.
+
+Round 4 gave Kafka a real wire protocol; this does the same for the Psql
+writer (VERDICT r4 next-step #6): startup/auth, extended-query
+Parse/Bind/Execute/Sync, BEGIN/COMMIT transactional batches, covering
+PsqlUpdates and PsqlSnapshot formatter semantics end to end.
+
+Reference: PsqlWriter src/connectors/data_storage.rs:1061, formatters
+src/connectors/data_format.rs:1625,1684.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.formats import (
+    PsqlSnapshotFormatter,
+    PsqlUpdatesFormatter,
+)
+from pathway_tpu.engine.storage import PsqlWriter
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._pg_wire import (
+    FakePostgresServer,
+    PgError,
+    PgWireConnection,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = FakePostgresServer()
+    yield srv
+    srv.close()
+
+
+class TestWireClient:
+    def test_startup_and_auth_password(self):
+        srv = FakePostgresServer(password="s3cret")
+        try:
+            conn = PgWireConnection(
+                port=srv.port, user="u", password="s3cret", dbname="d"
+            )
+            conn.execute("INSERT INTO t (a) VALUES ($1)", [1])
+            conn.commit()
+            conn.close()
+            assert srv.snapshot("t") == [{"a": 1}]
+        finally:
+            srv.close()
+
+    def test_wrong_password_rejected(self):
+        srv = FakePostgresServer(password="s3cret")
+        try:
+            with pytest.raises(PgError, match="password"):
+                PgWireConnection(
+                    port=srv.port, user="u", password="nope", dbname="d"
+                )
+        finally:
+            srv.close()
+
+    def test_extended_protocol_frames_on_the_wire(self, server):
+        conn = PgWireConnection(port=server.port)
+        conn.execute("INSERT INTO t (a,b) VALUES ($1,$2)", [1, "x"])
+        conn.commit()
+        conn.close()
+        # the statement MUST have traveled as Parse/Bind/Execute/Sync
+        # frames, not a simple query
+        joined = "".join(server.frames)
+        assert "PBES" in joined, server.frames
+        # and BEGIN/COMMIT rode the simple-query path
+        assert server.statements[0] == "BEGIN"
+        assert "COMMIT" in server.statements
+
+    def test_transaction_staging_until_commit(self, server):
+        conn = PgWireConnection(port=server.port)
+        conn.execute("INSERT INTO t (a) VALUES ($1)", [1])
+        assert server.snapshot("t") == []  # staged, not yet visible
+        conn.execute("INSERT INTO t (a) VALUES ($1)", [2])
+        assert server.snapshot("t") == []
+        conn.commit()
+        assert sorted(r["a"] for r in server.snapshot("t")) == [1, 2]
+        conn.close()
+
+    def test_param_types_roundtrip(self, server):
+        conn = PgWireConnection(port=server.port)
+        conn.execute(
+            "INSERT INTO t (i,f,b,s,n) VALUES ($1,$2,$3,$4,$5)",
+            [7, 2.5, True, "hi there", None],
+        )
+        conn.commit()
+        conn.close()
+        (row,) = server.snapshot("t")
+        assert row == {"i": 7, "f": 2.5, "b": True, "s": "hi there", "n": None}
+
+    def test_server_error_raises_and_connection_survives(self, server):
+        conn = PgWireConnection(port=server.port)
+        with pytest.raises(PgError, match="unsupported statement"):
+            conn.execute("TRUNCATE t", [])
+        # the connection recovers after Sync: further statements work
+        conn.execute("INSERT INTO t (a) VALUES ($1)", [5])
+        conn.commit()
+        assert server.snapshot("t") == [{"a": 5}]
+        conn.close()
+
+
+class TestPsqlWriterOverWire:
+    def test_snapshot_upsert_and_delete_semantics(self, server):
+        """PsqlSnapshot formatter driven through real frames: upsert on
+        insert, retraction deletes by key, re-insert upserts again."""
+        conn = PgWireConnection(port=server.port)
+        writer = PsqlWriter(
+            conn,
+            PsqlSnapshotFormatter("snap", ["k"], ["k", "v"]),
+        )
+        k1, k2 = ref_scalar(1), ref_scalar(2)
+        writer.on_change(k1, (1, "a"), 0, 1)
+        writer.on_change(k2, (2, "b"), 0, 1)
+        writer.on_time_end(0)
+        assert sorted(
+            (r["k"], r["v"]) for r in server.snapshot("snap")
+        ) == [(1, "a"), (2, "b")]
+        # replace k=1's value: retract + insert in one commit batch
+        writer.on_change(k1, (1, "a"), 1, -1)
+        writer.on_change(k1, (1, "a2"), 1, 1)
+        writer.on_time_end(1)
+        assert sorted(
+            (r["k"], r["v"]) for r in server.snapshot("snap")
+        ) == [(1, "a2"), (2, "b")]
+        # pure deletion
+        writer.on_change(k2, (2, "b"), 2, -1)
+        writer.on_time_end(2)
+        assert [(r["k"], r["v"]) for r in server.snapshot("snap")] == [
+            (1, "a2")
+        ]
+        # diff/time bookkeeping columns ride along on the upserts
+        assert all(
+            "time" in r and "diff" in r for r in server.snapshot("snap")
+        )
+        conn.close()
+
+    def test_updates_formatter_appends_log_rows(self, server):
+        conn = PgWireConnection(port=server.port)
+        writer = PsqlWriter(
+            conn, PsqlUpdatesFormatter("log", ["k", "v"])
+        )
+        writer.on_change(ref_scalar(1), (1, "a"), 3, 1)
+        writer.on_change(ref_scalar(1), (1, "a"), 4, -1)
+        writer.on_time_end(4)
+        rows = sorted(
+            (r["k"], r["v"], r["time"], r["diff"])
+            for r in server.snapshot("log")
+        )
+        assert rows == [(1, "a", 3, 1), (1, "a", 4, -1)]
+        conn.close()
+
+
+class TestPipelineOverWire:
+    def test_pw_io_postgres_write_end_to_end(self, server):
+        """pw.io.postgres.write drives the wire client by default: the
+        full pipeline (table -> formatter -> frames -> fake server)."""
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, v=str),
+            [(1, "x"), (2, "y"), (3, "z")],
+        )
+        pw.io.postgres.write(
+            t,
+            postgres_settings={"host": "127.0.0.1", "port": server.port},
+            table_name="events",
+        )
+        pw.run()
+        rows = sorted(
+            (r["k"], r["v"], r["diff"]) for r in server.snapshot("events")
+        )
+        assert rows == [(1, "x", 1), (2, "y", 1), (3, "z", 1)]
+        assert server.commits >= 1
+        assert "PBES" in "".join(server.frames)
+
+    def test_pw_io_postgres_write_snapshot_streaming(self, server):
+        """write_snapshot over a streamed groupby: a later batch revises
+        a group, which must upsert (not duplicate) through the wire."""
+        G.clear()
+        sg = pw.debug.StreamGenerator()
+
+        class S(pw.Schema):
+            k: int
+            v: int
+
+        t = sg.table_from_list_of_batches(
+            [
+                [{"k": 1, "v": 10}, {"k": 2, "v": 20}],
+                [{"k": 1, "v": 5}],
+            ],
+            S,
+        )
+        agg = t.groupby(t.k).reduce(
+            k=t.k, total=pw.reducers.sum(t.v)
+        )
+        pw.io.postgres.write_snapshot(
+            agg,
+            postgres_settings={"host": "127.0.0.1", "port": server.port},
+            table_name="snap",
+            primary_key=["k"],
+        )
+        pw.run()
+        rows = sorted(
+            (r["k"], r["total"]) for r in server.snapshot("snap")
+        )
+        assert rows == [(1, 15), (2, 20)]
+        assert server.commits >= 2  # one transactional batch per time
+
+
+class TestAuthModes:
+    @pytest.mark.parametrize("auth", ["md5", "scram-sha-256"])
+    def test_auth_success(self, auth):
+        srv = FakePostgresServer(password="pw123", auth=auth)
+        try:
+            conn = PgWireConnection(
+                port=srv.port, user="u", password="pw123"
+            )
+            conn.execute("INSERT INTO t (a) VALUES ($1)", [1])
+            conn.commit()
+            conn.close()
+            assert srv.snapshot("t") == [{"a": 1}]
+        finally:
+            srv.close()
+
+    @pytest.mark.parametrize("auth", ["md5", "scram-sha-256"])
+    def test_auth_wrong_password(self, auth):
+        srv = FakePostgresServer(password="pw123", auth=auth)
+        try:
+            with pytest.raises(PgError):
+                PgWireConnection(port=srv.port, user="u", password="bad")
+        finally:
+            srv.close()
+
+    def test_sslmode_require_refused(self, server):
+        # the fake server answers 'N' to SSLRequest: require must error,
+        # prefer must fall back to plaintext
+        with pytest.raises(PgError, match="sslmode=require"):
+            PgWireConnection(port=server.port, sslmode="require")
+        conn = PgWireConnection(port=server.port, sslmode="prefer")
+        conn.execute("INSERT INTO t (a) VALUES ($1)", [9])
+        conn.commit()
+        conn.close()
+        assert server.snapshot("t") == [{"a": 9}]
+
+
+class TestAbortedTransaction:
+    def test_failed_statement_discards_batch_and_rolls_back(self, server):
+        """Statement error aborts the postgres transaction: the client
+        ROLLBACKs (so COMMIT cannot silently discard), earlier staged
+        rows of the failed batch are lost, and the NEXT batch works."""
+        conn = PgWireConnection(port=server.port)
+        conn.execute("INSERT INTO t (a) VALUES ($1)", [1])
+        with pytest.raises(PgError, match="unsupported statement"):
+            conn.execute("TRUNCATE t", [])
+        assert "ROLLBACK" in server.statements
+        conn.commit()  # no-op: transaction already rolled back
+        assert server.snapshot("t") == []  # row 1 was in the failed batch
+        conn.execute("INSERT INTO t (a) VALUES ($1)", [2])
+        conn.commit()
+        assert server.snapshot("t") == [{"a": 2}]
+        conn.close()
+
+    def test_server_rejects_statements_in_aborted_txn(self, server):
+        """Protocol-level: after an error, the server refuses further
+        statements until the transaction block ends (like postgres)."""
+        import socket
+        import struct
+
+        from pathway_tpu.io._pg_wire import _FrameReader, _cstr, _frame
+
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        payload = (
+            struct.pack(">I", 196608)
+            + _cstr("user")
+            + _cstr("u")
+            + b"\0"
+        )
+        sock.sendall(struct.pack(">I", len(payload) + 4) + payload)
+        reader = _FrameReader(sock)
+        while reader.read_message()[0] != b"Z":
+            pass
+        sock.sendall(_frame(b"Q", _cstr("BEGIN")))
+        while reader.read_message()[0] != b"Z":
+            pass
+
+        def extended(stmt):
+            parse = _cstr("") + _cstr(stmt) + struct.pack(">H", 0)
+            bind = (
+                _cstr("")
+                + _cstr("")
+                + struct.pack(">HHH", 0, 0, 0)
+            )
+            execute = _cstr("") + struct.pack(">i", 0)
+            sock.sendall(
+                _frame(b"P", parse)
+                + _frame(b"B", bind)
+                + _frame(b"E", execute)
+                + _frame(b"S", b"")
+            )
+            tags = []
+            while True:
+                tag, _body = reader.read_message()
+                tags.append(tag)
+                if tag == b"Z":
+                    return tags
+
+        assert b"E" in extended("TRUNCATE t")  # error: txn now aborted
+        tags = extended("INSERT INTO t (a) VALUES (1)")
+        assert b"E" in tags and b"C" not in tags  # refused while aborted
+        sock.close()
