@@ -70,11 +70,23 @@ def _error_fields(body: bytes) -> str:
 
 
 def encode_text_param(v: Any) -> bytes | None:
-    """Python value -> postgres text-format parameter (None = SQL NULL)."""
+    """Python value -> postgres text-format parameter (None = SQL NULL).
+    bytes use the bytea hex form; lists/tuples the array literal form."""
     if v is None:
         return None
     if isinstance(v, bool):
         return b"t" if v else b"f"
+    if isinstance(v, (bytes, bytearray)):
+        return b"\\x" + bytes(v).hex().encode()
+    if isinstance(v, (list, tuple)):
+        items = []
+        for item in v:
+            if item is None:
+                items.append("NULL")
+            else:
+                s = str(item).replace("\\", "\\\\").replace('"', '\\"')
+                items.append(f'"{s}"')
+        return ("{" + ",".join(items) + "}").encode("utf-8")
     return str(v).encode("utf-8")
 
 
@@ -88,6 +100,11 @@ def decode_text_param(b: bytes | None) -> Any:
         return True
     if s == "f":
         return False
+    if s.startswith("\\x"):
+        try:
+            return bytes.fromhex(s[2:])
+        except ValueError:
+            pass
     try:
         return int(s)
     except ValueError:
@@ -419,10 +436,10 @@ class FakePostgresServer:
             + _frame(b"Z", b"I")
         )
         staged: list = []  # (table, op, payload) applied on COMMIT
-        last_stmt: list[str] = [""]
-        bound: list[list] = [[]]
-        failed = [False]
-        aborted = [False]  # statement error poisons the transaction
+        last_stmt = ""
+        bound: list = []
+        failed = False
+        aborted = False  # statement error poisons the transaction
         while True:
             tag, body = reader.read_message()
             with self._lock:
@@ -436,9 +453,9 @@ class FakePostgresServer:
                 word = q.split()[0].upper() if q.split() else ""
                 if word == "BEGIN":
                     staged.clear()
-                    aborted[0] = False
+                    aborted = False
                 elif word == "COMMIT":
-                    if aborted[0]:
+                    if aborted:
                         # real postgres: COMMIT of an aborted txn is a
                         # rollback (reported as such)
                         word = "ROLLBACK"
@@ -447,10 +464,10 @@ class FakePostgresServer:
                         with self._lock:
                             self.commits += 1
                     staged.clear()
-                    aborted[0] = False
+                    aborted = False
                 elif word == "ROLLBACK":
                     staged.clear()
-                    aborted[0] = False
+                    aborted = False
                 else:
                     try:
                         self._run_sql(q, [], staged)
@@ -465,8 +482,8 @@ class FakePostgresServer:
                 name_end = body.index(b"\0")
                 rest = body[name_end + 1 :]
                 q_end = rest.index(b"\0")
-                last_stmt[0] = rest[:q_end].decode()
-                failed[0] = False
+                last_stmt = rest[:q_end].decode()
+                failed = False
                 conn.sendall(_frame(b"1", b""))
             elif tag == b"B":
                 i = body.index(b"\0") + 1  # portal name
@@ -486,15 +503,15 @@ class FakePostgresServer:
                             decode_text_param(body[i : i + plen])
                         )
                         i += plen
-                bound[0] = params
+                bound = params
                 conn.sendall(_frame(b"2", b""))
             elif tag == b"D":
                 conn.sendall(_frame(b"n", b""))
             elif tag == b"E":
                 with self._lock:
-                    self.statements.append(last_stmt[0])
-                if aborted[0]:
-                    failed[0] = True
+                    self.statements.append(last_stmt)
+                if aborted:
+                    failed = True
                     conn.sendall(
                         self._err(
                             PgError(
@@ -505,15 +522,15 @@ class FakePostgresServer:
                     )
                     continue
                 try:
-                    self._run_sql(last_stmt[0], bound[0], staged)
+                    self._run_sql(last_stmt, bound, staged)
                     conn.sendall(_frame(b"C", _cstr("INSERT 0 1")))
                 except PgError as exc:
-                    failed[0] = True
-                    aborted[0] = True
+                    failed = True
+                    aborted = True
                     conn.sendall(self._err(exc))
             elif tag == b"S":
-                conn.sendall(_frame(b"Z", b"E" if failed[0] else b"I"))
-                failed[0] = False
+                conn.sendall(_frame(b"Z", b"E" if failed else b"I"))
+                failed = False
             else:
                 raise PgError(f"unsupported frame {tag!r}")
 

@@ -74,18 +74,27 @@ def _schema_json(column_names: Sequence[str], dtypes: dict) -> dict:
     return {"type": "struct", "schema-id": 0, "fields": fields}
 
 
+def _is_rest_uri(catalog_uri: str | os.PathLike) -> bool:
+    uri = os.fspath(catalog_uri)
+    return isinstance(uri, str) and uri.split("://", 1)[0] in (
+        "http",
+        "https",
+    )
+
+
 def _check_local(catalog_uri: str | os.PathLike) -> str:
     uri = os.fspath(catalog_uri)
     if isinstance(uri, str) and "://" in uri:
         scheme = uri.split("://", 1)[0]
         if scheme != "file":
-            # http(s) REST catalogs and object-store warehouses (s3/gs/
-            # abfs/...) need services this build cannot reach — refuse
-            # rather than silently writing to a local dir named "s3:"
+            # http(s) goes through the REST catalog path; other object-
+            # store warehouses (s3/gs/abfs/...) need services this build
+            # cannot reach — refuse rather than silently writing to a
+            # local dir named "s3:"
             raise NotImplementedError(
                 f"pw.io.iceberg speaks the filesystem (hadoop-style) "
-                f"catalog; {scheme}:// locations are unreachable from this "
-                f"build — pass a local warehouse directory instead"
+                f"catalog or an http(s) REST catalog; {scheme}:// "
+                f"locations are unreachable from this build"
             )
         uri = uri[len("file://"):]
     return uri
@@ -325,17 +334,14 @@ def _read_manifest(path: str) -> list[dict]:
     return records
 
 
-class IcebergWriter:
-    """Append-only Iceberg writer: one parquet data file + one snapshot
-    commit per engine commit (reference data_lake/writer.rs batching)."""
+class FilesystemCatalog:
+    """Hadoop-style catalog: the table's metadata directory IS the
+    catalog; commits publish vN+1 with an exclusive create."""
 
-    def __init__(
-        self, location: str, column_names: Sequence[str], dtypes: dict
-    ):
+    def __init__(self, location: str) -> None:
         self.location = os.fspath(location)
-        self.column_names = list(column_names)
-        self.dtypes = dtypes
-        self._rows: list[tuple] = []
+
+    def ensure(self, column_names: Sequence[str], dtypes: dict) -> str:
         os.makedirs(os.path.join(self.location, _METADATA), exist_ok=True)
         os.makedirs(os.path.join(self.location, _DATA), exist_ok=True)
         if _current_version(self.location) is None:
@@ -345,12 +351,12 @@ class IcebergWriter:
                 "location": self.location,
                 "last-sequence-number": 0,
                 "last-updated-ms": int(_time.time() * 1000),
-                "last-column-id": len(self.column_names) + 2,
+                "last-column-id": len(column_names) + 2,
                 "current-schema-id": 0,
                 "schemas": [
                     _schema_json(
-                        self.column_names + ["time", "diff"],
-                        {**self.dtypes, "time": dt.INT, "diff": dt.INT},
+                        list(column_names) + ["time", "diff"],
+                        {**dtypes, "time": dt.INT, "diff": dt.INT},
                     )
                 ],
                 "default-spec-id": 0,
@@ -364,9 +370,17 @@ class IcebergWriter:
                 "snapshot-log": [],
                 "metadata-log": [],
             }
-            self._publish_metadata(1, metadata)
+            self.commit(0, metadata)
+        return self.location
 
-    def _publish_metadata(self, version: int, metadata: dict) -> None:
+    def load(self) -> tuple[Any, dict | None]:
+        version = _current_version(self.location)
+        if version is None:
+            return None, None
+        return version, _read_metadata(self.location, version)
+
+    def commit(self, token: Any, metadata: dict) -> None:
+        version = int(token) + 1
         _atomic_write(
             _metadata_path(self.location, version),
             json.dumps(metadata, indent=1),
@@ -376,6 +390,117 @@ class IcebergWriter:
             os.path.join(self.location, _METADATA, _VERSION_HINT),
             str(version),
         )
+
+
+class RestCatalog:
+    """REST catalog (reference: src/connectors/data_lake/iceberg.rs):
+    metadata lives in the catalog service, reached through
+    io/_iceberg_rest.py's client; data/manifest files live at the
+    table's ``location``. Commits send the spec's CommitTableRequest
+    (assert-table-uuid + assert-ref-snapshot-id requirements,
+    add-snapshot + set-snapshot-ref updates) — a stale snapshot gets
+    409 and the engine retries the batch, mirroring the filesystem
+    catalog's exclusive-create race."""
+
+    def __init__(
+        self,
+        uri: str,
+        namespace: Sequence[str],
+        table_name: str,
+        *,
+        token: str | None = None,
+    ) -> None:
+        from pathway_tpu.io._iceberg_rest import RestCatalogClient
+
+        self.client = RestCatalogClient(uri, token=token)
+        self.namespace = list(namespace)
+        self.table_name = table_name
+        self.location: str | None = None
+
+    def ensure(self, column_names: Sequence[str], dtypes: dict) -> str:
+        from pathway_tpu.io._iceberg_rest import IcebergRestError
+
+        loaded = self.client.load_table(self.namespace, self.table_name)
+        if loaded is None:
+            self.client.create_namespace(self.namespace)
+            try:
+                loaded = self.client.create_table(
+                    self.namespace,
+                    self.table_name,
+                    _schema_json(
+                        list(column_names) + ["time", "diff"],
+                        {**dtypes, "time": dt.INT, "diff": dt.INT},
+                    ),
+                )
+            except IcebergRestError as exc:
+                if exc.code != 409:
+                    raise
+                # lost the create race: the table exists now — use it
+                loaded = self.client.load_table(
+                    self.namespace, self.table_name
+                )
+                if loaded is None:
+                    raise
+        self.location = loaded["metadata"]["location"]
+        return self.location
+
+    def load(self) -> tuple[Any, dict | None]:
+        loaded = self.client.load_table(self.namespace, self.table_name)
+        if loaded is None:
+            return None, None
+        meta = loaded["metadata"]
+        self.location = meta["location"]
+        head = meta.get("refs", {}).get("main", {}).get("snapshot-id")
+        return (meta["table-uuid"], head), meta
+
+    def commit(self, token: Any, metadata: dict) -> None:
+        table_uuid, head = token
+        snapshot = metadata["snapshots"][-1]
+        self.client.commit_table(
+            self.namespace,
+            self.table_name,
+            requirements=[
+                {"type": "assert-table-uuid", "uuid": table_uuid},
+                {
+                    "type": "assert-ref-snapshot-id",
+                    "ref": "main",
+                    "snapshot-id": head,
+                },
+            ],
+            updates=[
+                {"action": "add-snapshot", "snapshot": snapshot},
+                {
+                    "action": "set-snapshot-ref",
+                    "ref-name": "main",
+                    "type": "branch",
+                    "snapshot-id": snapshot["snapshot-id"],
+                },
+            ],
+        )
+
+
+class IcebergWriter:
+    """Append-only Iceberg writer: one parquet data file + one snapshot
+    commit per engine commit (reference data_lake/writer.rs batching).
+    The catalog seam carries the commit protocol: filesystem
+    (version-hint exclusive create) or REST (CommitTableRequest)."""
+
+    def __init__(
+        self,
+        location: str | None,
+        column_names: Sequence[str],
+        dtypes: dict,
+        catalog: Any = None,
+    ):
+        self.catalog = (
+            catalog
+            if catalog is not None
+            else FilesystemCatalog(os.fspath(location))
+        )
+        self.column_names = list(column_names)
+        self.dtypes = dtypes
+        self._rows: list[tuple] = []
+        self.location = self.catalog.ensure(self.column_names, dtypes)
 
     def on_change(
         self, key: Pointer, values: tuple, time: int, diff: int
@@ -399,14 +524,13 @@ class IcebergWriter:
         fpath = os.path.join(self.location, _DATA, fname)
         pq.write_table(arrow, fpath)
 
-        version = _current_version(self.location)
-        if version is None:
+        token, metadata = self.catalog.load()
+        if metadata is None:
             raise RuntimeError(
-                f"iceberg table at {self.location}: metadata/version-hint."
-                f"text is missing or unreadable; the catalog was deleted or "
-                f"corrupted after this writer opened it"
+                f"iceberg table at {self.location}: the catalog no longer "
+                f"knows the table; it was deleted or corrupted after this "
+                f"writer opened it"
             )
-        metadata = _read_metadata(self.location, version)
         seq = metadata["last-sequence-number"] + 1
         snapshot_id = int(uuid.uuid4().int % (1 << 62))
         now_ms = int(_time.time() * 1000)
@@ -485,13 +609,14 @@ class IcebergWriter:
         metadata["snapshot-log"].append(
             {"snapshot-id": snapshot_id, "timestamp-ms": now_ms}
         )
-        metadata["metadata-log"].append(
-            {
-                "metadata-file": _metadata_path(self.location, version),
-                "timestamp-ms": now_ms,
-            }
-        )
-        self._publish_metadata(version + 1, metadata)
+        if isinstance(token, int):  # fs catalog: token is the version
+            metadata["metadata-log"].append(
+                {
+                    "metadata-file": _metadata_path(self.location, token),
+                    "timestamp-ms": now_ms,
+                }
+            )
+        self.catalog.commit(token, metadata)
         # only a fully committed snapshot releases the buffer: if the
         # parquet write or the exclusive version commit raised (lost
         # catalog race), the rows stay queued for the next flush — an
@@ -509,12 +634,20 @@ class IcebergReader(Reader):
 
     def __init__(
         self,
-        location: str,
+        location: str | None,
         column_names: Sequence[str],
         mode: str,
         key_indices: Sequence[int] | None = None,
+        catalog: Any = None,
     ):
-        self.location = os.fspath(location)
+        self.catalog = (
+            catalog
+            if catalog is not None
+            else FilesystemCatalog(os.fspath(location))
+        )
+        self.location = (
+            os.fspath(location) if location is not None else None
+        )
         self.column_names = list(column_names)
         self.mode = mode
         self.key_indices = list(key_indices) if key_indices else None
@@ -538,9 +671,10 @@ class IcebergReader(Reader):
         if self._done_static:
             return [], True
         entries = []
-        version = _current_version(self.location)
-        if version is not None:
-            metadata = _read_metadata(self.location, version)
+        _token, metadata = self.catalog.load()
+        if metadata is not None:
+            # REST tables learn their file location from the catalog
+            self.location = metadata.get("location", self.location)
             fresh = sorted(
                 (
                     s
@@ -582,6 +716,23 @@ class IcebergReader(Reader):
         self._done_static = False
 
 
+def _rest_catalog_factory(
+    catalog_uri: str | os.PathLike,
+    namespace: Sequence[str] | None,
+    table_name: str | None,
+    kwargs: dict,
+):
+    """Shared REST dispatch for read()/write(): validation + a factory
+    producing fresh RestCatalog clients."""
+    if namespace is None or table_name is None:
+        raise ValueError(
+            "pw.io.iceberg: REST catalogs need namespace and table_name"
+        )
+    uri = os.fspath(catalog_uri)
+    token = kwargs.get("credentials")
+    return lambda: RestCatalog(uri, namespace, table_name, token=token)
+
+
 def read(
     catalog_uri: str | os.PathLike,
     namespace: Sequence[str] | None = None,
@@ -593,10 +744,12 @@ def read(
     persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
-    """Read an Iceberg table. ``catalog_uri`` is the warehouse root (the
-    reference's REST catalog URI maps here to the filesystem catalog);
+    """Read an Iceberg table. An http(s) ``catalog_uri`` speaks the REST
+    catalog protocol (reference src/connectors/data_lake/iceberg.rs);
+    otherwise it is the warehouse root of the filesystem catalog.
     ``namespace`` + ``table_name`` locate the table under it — both may be
-    omitted when ``catalog_uri`` IS the table directory."""
+    omitted when ``catalog_uri`` IS the table directory (filesystem
+    only)."""
     if schema is None:
         raise ValueError("schema= is required for pw.io.iceberg.read")
     if (namespace is None) != (table_name is None):
@@ -606,14 +759,36 @@ def read(
         )
     from pathway_tpu.engine.storage import TransparentParser
 
+    column_names = schema.column_names()
+    pk = schema.primary_key_columns()
+    key_indices = [column_names.index(p) for p in pk] if pk else None
+    if _is_rest_uri(catalog_uri):
+        make_catalog = _rest_catalog_factory(
+            catalog_uri, namespace, table_name, kwargs
+        )
+
+        def make_rest_reader():
+            return IcebergReader(
+                None, column_names, mode, key_indices,
+                catalog=make_catalog(),
+            )
+
+        return input_table(
+            schema,
+            make_rest_reader,
+            lambda names: TransparentParser(names),
+            source_name=(
+                f"iceberg:{os.fspath(catalog_uri)}/"
+                f"{'.'.join(namespace)}/{table_name}"
+            ),
+            persistent_id=persistent_id,
+            autocommit_duration_ms=autocommit_duration_ms,
+        )
     loc = (
         table_location(catalog_uri, namespace, table_name)
         if namespace is not None and table_name is not None
         else _check_local(catalog_uri)
     )
-    column_names = schema.column_names()
-    pk = schema.primary_key_columns()
-    key_indices = [column_names.index(p) for p in pk] if pk else None
     return input_table(
         schema,
         lambda: IcebergReader(loc, column_names, mode, key_indices),
@@ -633,18 +808,33 @@ def write(
     min_commit_frequency: int | None = None,
     **kwargs: Any,
 ) -> None:
-    """Write a table's update stream as Iceberg snapshot appends."""
+    """Write a table's update stream as Iceberg snapshot appends. An
+    http(s) ``catalog_uri`` speaks the REST catalog protocol (reference
+    src/connectors/data_lake/iceberg.rs); otherwise the filesystem
+    (hadoop-style) catalog remains the default."""
     if (namespace is None) != (table_name is None):
         raise ValueError(
             "pw.io.iceberg: pass both namespace and table_name (table under "
             "the warehouse root), or neither (catalog_uri IS the table dir)"
         )
+    dtypes = dict(table._dtypes)
+    if _is_rest_uri(catalog_uri):
+        make_catalog = _rest_catalog_factory(
+            catalog_uri, namespace, table_name, kwargs
+        )
+
+        def make_rest_writer(column_names):
+            return IcebergWriter(
+                None, column_names, dtypes, catalog=make_catalog()
+            )
+
+        attach_writer(table, make_rest_writer)
+        return
     loc = (
         table_location(catalog_uri, namespace, table_name)
         if namespace is not None and table_name is not None
         else _check_local(catalog_uri)
     )
-    dtypes = dict(table._dtypes)
 
     def make_writer(column_names):
         return IcebergWriter(loc, column_names, dtypes)

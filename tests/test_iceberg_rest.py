@@ -1,0 +1,269 @@
+"""Iceberg REST catalog: client + fake service over real HTTP endpoints
+(VERDICT r4 next-step #7; reference src/connectors/data_lake/iceberg.rs
+reads/writes through a REST catalog). The filesystem catalog remains the
+default — these tests cover the http(s) path end to end, including
+snapshot streaming and commit-conflict behavior."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._iceberg_rest import (
+    FakeIcebergRestServer,
+    IcebergRestError,
+    RestCatalogClient,
+)
+from pathway_tpu.io.iceberg import IcebergReader, IcebergWriter, RestCatalog
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    srv = FakeIcebergRestServer(str(tmp_path / "warehouse"))
+    yield srv
+    srv.close()
+
+
+class SCHEMA(pw.Schema):
+    k: int
+    v: str
+
+
+class TestRestEndpoints:
+    def test_create_load_commit_flow(self, catalog):
+        client = RestCatalogClient(catalog.uri())
+        assert client.load_table(["db"], "t") is None
+        client.create_namespace(["db"])
+        client.create_namespace(["db"])  # idempotent (409 swallowed)
+        created = client.create_table(
+            ["db"], "t", {"type": "struct", "schema-id": 0, "fields": []}
+        )
+        meta = created["metadata"]
+        assert meta["format-version"] == 2 and meta["snapshots"] == []
+        loaded = client.load_table(["db"], "t")
+        assert loaded["metadata"]["table-uuid"] == meta["table-uuid"]
+        # commit a snapshot through the spec's CommitTableRequest
+        snap = {
+            "snapshot-id": 77,
+            "sequence-number": 1,
+            "timestamp-ms": 5,
+            "manifest-list": "metadata/x.avro",
+            "summary": {"operation": "append"},
+            "schema-id": 0,
+        }
+        out = client.commit_table(
+            ["db"],
+            "t",
+            requirements=[
+                {"type": "assert-table-uuid", "uuid": meta["table-uuid"]},
+                {
+                    "type": "assert-ref-snapshot-id",
+                    "ref": "main",
+                    "snapshot-id": None,
+                },
+            ],
+            updates=[
+                {"action": "add-snapshot", "snapshot": snap},
+                {
+                    "action": "set-snapshot-ref",
+                    "ref-name": "main",
+                    "type": "branch",
+                    "snapshot-id": 77,
+                },
+            ],
+        )
+        assert out["metadata"]["current-snapshot-id"] == 77
+        assert out["metadata"]["last-sequence-number"] == 1
+
+    def test_stale_snapshot_requirement_conflicts(self, catalog):
+        client = RestCatalogClient(catalog.uri())
+        client.create_namespace(["db"])
+        meta = client.create_table(
+            ["db"], "t", {"type": "struct", "schema-id": 0, "fields": []}
+        )["metadata"]
+
+        def commit(head, snap_id):
+            return client.commit_table(
+                ["db"],
+                "t",
+                requirements=[
+                    {
+                        "type": "assert-table-uuid",
+                        "uuid": meta["table-uuid"],
+                    },
+                    {
+                        "type": "assert-ref-snapshot-id",
+                        "ref": "main",
+                        "snapshot-id": head,
+                    },
+                ],
+                updates=[
+                    {
+                        "action": "add-snapshot",
+                        "snapshot": {
+                            "snapshot-id": snap_id,
+                            "sequence-number": 1,
+                            "timestamp-ms": 0,
+                            "manifest-list": "metadata/x.avro",
+                            "summary": {},
+                            "schema-id": 0,
+                        },
+                    },
+                    {
+                        "action": "set-snapshot-ref",
+                        "ref-name": "main",
+                        "type": "branch",
+                        "snapshot-id": snap_id,
+                    },
+                ],
+            )
+
+        commit(None, 1)
+        with pytest.raises(IcebergRestError) as err:
+            commit(None, 2)  # stale head: ref moved to 1
+        assert err.value.code == 409
+        assert catalog.conflicts == 1
+        commit(1, 2)  # correct head succeeds
+
+    def test_bearer_token_auth(self, tmp_path):
+        srv = FakeIcebergRestServer(
+            str(tmp_path / "wh"), token="tok123"
+        )
+        try:
+            with pytest.raises(IcebergRestError) as err:
+                RestCatalogClient(srv.uri()).load_table(["db"], "t")
+            assert err.value.code == 401
+            ok = RestCatalogClient(srv.uri(), token="tok123")
+            assert ok.load_table(["db"], "t") is None
+        finally:
+            srv.close()
+
+
+class TestRestSnapshotStreaming:
+    def test_writer_reader_snapshot_streaming(self, catalog):
+        """Snapshot-streaming through the REST path: each flush is one
+        REST commit; a reader polling the catalog picks up exactly the
+        new snapshots' files (VERDICT done-criterion)."""
+        writer = IcebergWriter(
+            None,
+            ["k", "v"],
+            {},
+            catalog=RestCatalog(catalog.uri(), ["db"], "events"),
+        )
+        reader = IcebergReader(
+            None,
+            ["k", "v"],
+            "streaming",
+            catalog=RestCatalog(catalog.uri(), ["db"], "events"),
+        )
+        writer.on_change(None, (1, "a"), 0, 1)
+        writer.on_change(None, (2, "b"), 0, 1)
+        writer.on_time_end(0)
+        entries, _done = reader.poll()
+        rows = [
+            e.values for events, _k, _m in entries for e in events
+        ]
+        assert sorted(rows) == [(1, "a"), (2, "b")]
+        # second flush -> second snapshot; only NEW files are emitted
+        writer.on_change(None, (3, "c"), 1, 1)
+        writer.on_time_end(1)
+        entries, _done = reader.poll()
+        rows = [e.values for events, _k, _m in entries for e in events]
+        assert rows == [(3, "c")]
+        # a no-op poll emits nothing
+        entries, _done = reader.poll()
+        assert entries == []
+        # the catalog (not the filesystem hint) carried every commit
+        posts = [
+            p
+            for m, p in catalog.requests
+            if m == "POST" and p.endswith("/tables/events")
+        ]
+        assert len(posts) == 2
+
+    def test_concurrent_writers_conflict_and_requeue(self, catalog):
+        """Two writers on one table: the loser's REST commit 409s, its
+        rows stay buffered, and the next flush lands them."""
+        w1 = IcebergWriter(
+            None, ["k", "v"], {},
+            catalog=RestCatalog(catalog.uri(), ["db"], "t"),
+        )
+        w2 = IcebergWriter(
+            None, ["k", "v"], {},
+            catalog=RestCatalog(catalog.uri(), ["db"], "t"),
+        )
+        # interleave: both load the same head, w1 commits first
+        w1.on_change(None, (1, "one"), 0, 1)
+        w2.on_change(None, (2, "two"), 0, 1)
+
+        barrier = threading.Barrier(2)
+        errors: list = []
+
+        def flush(w):
+            barrier.wait()
+            try:
+                w.on_time_end(0)
+            except IcebergRestError as exc:
+                errors.append(exc)
+
+        t1 = threading.Thread(target=flush, args=(w1,))
+        t2 = threading.Thread(target=flush, args=(w2,))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        if errors:  # the race actually collided (usually does)
+            assert all(e.code == 409 for e in errors)
+            assert catalog.conflicts >= 1
+            # the loser retries with fresh state and succeeds
+            loser = w1 if w1._rows else w2
+            assert loser._rows  # buffer kept, nothing lost
+            loser.on_time_end(0)
+        reader = IcebergReader(
+            None, ["k", "v"], "static",
+            catalog=RestCatalog(catalog.uri(), ["db"], "t"),
+        )
+        entries, done = reader.poll()
+        rows = [e.values for events, _k, _m in entries for e in events]
+        assert sorted(rows) == [(1, "one"), (2, "two")]
+        assert done
+
+    def test_pw_io_iceberg_rest_round_trip(self, catalog):
+        """pw.io.iceberg.read/write dispatch http(s) URIs onto the REST
+        catalog; full pipeline round trip."""
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, v=str), [(1, "x"), (2, "y")]
+        )
+        pw.io.iceberg.write(t, catalog.uri(), ["db"], "rt")
+        pw.run()
+        G.clear()
+        back = pw.io.iceberg.read(
+            catalog.uri(), ["db"], "rt", schema=SCHEMA, mode="static"
+        )
+        got = sorted(
+            (r.k, r.v)
+            for r in pw.debug.table_to_pandas(back).itertuples(
+                index=False
+            )
+        )
+        assert got == [(1, "x"), (2, "y")]
+
+    def test_local_filesystem_catalog_still_default(self, tmp_path):
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, v=str), [(5, "z")]
+        )
+        pw.io.iceberg.write(t, tmp_path / "wh", ["db"], "t2")
+        pw.run()
+        G.clear()
+        back = pw.io.iceberg.read(
+            tmp_path / "wh", ["db"], "t2", schema=SCHEMA, mode="static"
+        )
+        got = [
+            (r.k, r.v)
+            for r in pw.debug.table_to_pandas(back).itertuples(
+                index=False
+            )
+        ]
+        assert got == [(5, "z")]

@@ -427,10 +427,10 @@ class TestHttpWriters:
 
 def test_gated_connectors_raise_helpfully():
     t = pw.debug.table_from_rows(pw.schema_from_types(a=int), [(1,)])
-    # iceberg is implemented for filesystem catalogs; REST catalogs need
-    # network and stay gated with a pointer to the local path
-    with pytest.raises(NotImplementedError, match="warehouse"):
-        pw.io.iceberg.write(t, "http://catalog", ["ns"], "t")
+    # iceberg speaks filesystem and http(s) REST catalogs; object-store
+    # warehouses stay gated with a pointer to the supported paths
+    with pytest.raises(NotImplementedError, match="REST"):
+        pw.io.iceberg.write(t, "s3://bucket/warehouse", ["ns"], "t")
     # local executable sources run for real now; only the docker/Cloud-Run
     # execution types stay gated
     with pytest.raises(NotImplementedError, match="docker"):
