@@ -955,12 +955,19 @@ class JoinNode(Node):
         kind: str = JoinKind.INNER,
         id_from_left: bool = False,
         left_keys_repeat: bool = True,
+        id_spec: tuple | None = None,
     ) -> None:
         super().__init__(scope, [left, right], left.arity + right.arity)
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.kind = kind
-        self.id_from_left = id_from_left
+        #: result-id source: None -> pair hash; ("left"/"right", None) ->
+        #: that side's row key; ("left"/"right", col) -> that side's
+        #: pointer column (reference join id= assignment)
+        if id_spec is None and id_from_left:
+            id_spec = ("left", None)
+        self.id_spec = id_spec
+        self.id_from_left = id_spec == ("left", None)
         # join-key → {row_key: row}
         self.left_arr: dict[Any, dict[Pointer, tuple]] = {}
         self.right_arr: dict[Any, dict[Pointer, tuple]] = {}
@@ -968,12 +975,50 @@ class JoinNode(Node):
         # a batch forces the dict path
         self._blocks_left: list[_JoinSide] = []
         self._blocks_right: list[_JoinSide] = []
+        #: custom-id joins: result id -> owning join-key group, so
+        #: duplicate ids are caught ACROSS groups, not only within one
+        self._id_owners: dict[Pointer, Any] = {}
         self._columnar_ok = (
             kind == JoinKind.INNER
-            and not id_from_left
+            and id_spec is None
             and len(self.left_on) >= 1
             and len(self.left_on) == len(self.right_on)
         )
+
+    def _okey(
+        self,
+        lk: Pointer | None,
+        rk: Pointer | None,
+        lrow: tuple | None,
+        rrow: tuple | None,
+        report: bool = True,
+    ) -> Pointer:
+        """Result row id per id_spec; an id_spec pointing at a side that
+        is absent (outer padding) falls back to the pair hash.
+        ``report=False`` on snapshot passes (old-state recomputation) so
+        one bad row is reported once per batch, not once per pass."""
+        spec = self.id_spec
+        if spec is not None:
+            side, col = spec
+            v: Any = None
+            if side == "left" and lk is not None:
+                v = lk if col is None else lrow[col]
+            elif side == "right" and rk is not None:
+                v = rk if col is None else rrow[col]
+            if isinstance(v, Pointer):
+                return v
+            if v is not None or (
+                side == "left" and lk is not None
+            ) or (side == "right" and rk is not None):
+                # None / non-pointer id value: poison, don't emit a
+                # non-Pointer row key into the dataflow
+                if report:
+                    self.report(
+                        lk if lk is not None else rk,
+                        f"join id= value is not a pointer: {v!r}",
+                    )
+                return None  # caller drops the row
+        return join_result_key(lk, rk)
 
     # -- columnar fast path -------------------------------------------------
 
@@ -1177,28 +1222,53 @@ class JoinNode(Node):
             vals = tuple(repr(v) for v in vals)
         return vals
 
-    def _local_output(self, jk: Any) -> dict[Pointer, tuple]:
+    def _local_output(
+        self, jk: Any, report: bool = True
+    ) -> dict[Pointer, tuple]:
         lrows = self.left_arr.get(jk, {})
         rrows = self.right_arr.get(jk, {})
         out: dict[Pointer, tuple] = {}
         l_pad = (None,) * self.inputs[0].arity
         r_pad = (None,) * self.inputs[1].arity
+        custom = self.id_spec is not None
+
+        def put(okey: Pointer | None, row: tuple) -> None:
+            if okey is None:
+                return  # poisoned id value, reported in _okey
+            if custom:
+                owner = self._id_owners.get(okey, jk)
+                if okey in out or owner != jk:
+                    # the reference errors on duplicate result ids; here
+                    # the row poisons via the error log (within AND
+                    # across join-key groups) and the first row wins
+                    if report:
+                        self.report(okey, "duplicate join result id")
+                    return
+            out[okey] = row
+
         if lrows and rrows:
             for lk, lrow in lrows.items():
                 for rk, rrow in rrows.items():
-                    okey = lk if self.id_from_left else join_result_key(lk, rk)
-                    out[okey] = lrow + rrow
+                    put(
+                        self._okey(lk, rk, lrow, rrow, report),
+                        lrow + rrow,
+                    )
         if self.kind in (JoinKind.LEFT, JoinKind.OUTER) or (
             self.id_from_left and self.kind != JoinKind.INNER
         ):
             if not rrows:
                 for lk, lrow in lrows.items():
-                    okey = lk if self.id_from_left else join_result_key(lk, None)
-                    out[okey] = lrow + r_pad
+                    put(
+                        self._okey(lk, None, lrow, None, report),
+                        lrow + r_pad,
+                    )
         if self.kind in (JoinKind.RIGHT, JoinKind.OUTER) and not self.id_from_left:
             if not lrows:
                 for rk, rrow in rrows.items():
-                    out[join_result_key(None, rk)] = l_pad + rrow
+                    put(
+                        self._okey(None, rk, None, rrow, report),
+                        l_pad + rrow,
+                    )
         return out
 
     def _process_insert_only_inner(
@@ -1291,7 +1361,7 @@ class JoinNode(Node):
         right_batch = right_batch.consolidate()
         fast = (
             self.kind == JoinKind.INNER
-            and not self.id_from_left
+            and self.id_spec is None
             and (left_batch._insert_only or not left_batch)
             and (right_batch._insert_only or not right_batch)
         )
@@ -1304,7 +1374,9 @@ class JoinNode(Node):
 
         def note(jk: Any) -> None:
             if jk is not ERROR and jk not in old_local:
-                old_local[jk] = self._local_output(jk)
+                # snapshot pass: suppress reports (the new-state pass
+                # reports each problem exactly once per batch)
+                old_local[jk] = self._local_output(jk, report=False)
                 affected.add(jk)
 
         staged: list[tuple[int, Any, Pointer, tuple, int]] = []
@@ -1333,6 +1405,12 @@ class JoinNode(Node):
         for jk in affected:
             old = old_local[jk]
             new = self._local_output(jk)
+            if self.id_spec is not None:
+                for okey in old:
+                    if okey not in new and self._id_owners.get(okey) == jk:
+                        del self._id_owners[okey]
+                for okey in new:
+                    self._id_owners[okey] = jk
             for okey, orow in old.items():
                 if okey not in new or rows_differ(new[okey], orow):
                     out.append(okey, orow, -1)
@@ -1428,6 +1506,7 @@ class _ColumnarGroups:
     __slots__ = (
         "by_cols",
         "_single",
+        "gkey_salt",
         "kinds",
         "sum_cols",
         "index",
@@ -1444,10 +1523,12 @@ class _ColumnarGroups:
         self,
         by_cols: Sequence[int],
         reducers: Sequence[tuple[Reducer, Sequence[int]]],
+        gkey_salt: bytes = b"",
     ) -> None:
         from pathway_tpu.engine.reducers import ReducerKind
 
         self.by_cols = list(by_cols)
+        self.gkey_salt = gkey_salt
         # single-by state stores bare scalars in index/by_raw (tuple
         # wrapping + tuple hashing per touched group measurably drags
         # the incremental hot path); multi-by stores value tuples
@@ -1549,9 +1630,13 @@ class _ColumnarGroups:
                 self._grow(gi + 1)
                 index[k] = gi
                 self.by_raw.append(raw)
+                # group id = ref_scalar(*by values) — addressable from
+                # pointer_from / ix_ref like the reference (ref_scalar,
+                # python_api.rs:3373; group_by_table :2922)
                 self.gkeys.append(
                     hash_values(
-                        (raw,) if self._single else raw, salt=b"groupby"
+                        (raw,) if self._single else raw,
+                        salt=self.gkey_salt,
                     )
                 )
                 self.size = gi + 1
@@ -1754,6 +1839,7 @@ class GroupbyNode(Node):
         by_cols: Sequence[int],
         reducers: Sequence[tuple[Reducer, Sequence[int]]],
         set_id: bool = False,
+        instance_last: bool = False,
     ) -> None:
         from pathway_tpu.engine.reducers import ReducerKind
 
@@ -1761,6 +1847,10 @@ class GroupbyNode(Node):
         self.by_cols = list(by_cols)
         self.reducers = list(reducers)
         self.set_id = set_id
+        # instance groupbys derive ids like ref_scalar(*vals, instance=i)
+        # (salt=b"inst", engine/value.py:377-381) so pointer_from with
+        # instance= addresses the groups
+        self._gkey_salt = b"inst" if instance_last else b""
         # gkey -> [by_vals, [reducer states], membership count]
         self._groups: dict[Pointer, list[Any]] = {}
         self._cg: _ColumnarGroups | None = None
@@ -1772,7 +1862,9 @@ class GroupbyNode(Node):
                 for r, _c in reducers
             )
         ):
-            self._cg = _ColumnarGroups(by_cols, reducers)
+            self._cg = _ColumnarGroups(
+                by_cols, reducers, gkey_salt=self._gkey_salt
+            )
         # (types, by_vals) -> gkey: a streaming workload touches the same
         # groups commit after commit — the blake2b derivation dominated
         # the incremental-update bench at ~1024 touched groups x 100
@@ -1810,9 +1902,9 @@ class GroupbyNode(Node):
         try:
             gkey = self._gkey_cache.get(ck)
         except TypeError:  # unhashable by-values: derive directly
-            return hash_values(by_vals, salt=b"groupby")
+            return hash_values(by_vals, salt=self._gkey_salt)
         if gkey is None:
-            gkey = hash_values(by_vals, salt=b"groupby")
+            gkey = hash_values(by_vals, salt=self._gkey_salt)
             self._gkey_cache[ck] = gkey
         return gkey
 
@@ -2494,9 +2586,17 @@ class Scope:
         right_on: Sequence[int],
         kind: str = JoinKind.INNER,
         id_from_left: bool = False,
+        id_spec: tuple | None = None,
     ) -> Node:
         return JoinNode(
-            self, left, right, left_on, right_on, kind=kind, id_from_left=id_from_left
+            self,
+            left,
+            right,
+            left_on,
+            right_on,
+            kind=kind,
+            id_from_left=id_from_left,
+            id_spec=id_spec,
         )
 
     def group_by_table(
@@ -2505,8 +2605,16 @@ class Scope:
         by_cols: Sequence[int],
         reducers: Sequence[tuple[Reducer, Sequence[int]]],
         set_id: bool = False,
+        instance_last: bool = False,
     ) -> Node:
-        return GroupbyNode(self, table, by_cols, reducers, set_id=set_id)
+        return GroupbyNode(
+            self,
+            table,
+            by_cols,
+            reducers,
+            set_id=set_id,
+            instance_last=instance_last,
+        )
 
     def deduplicate(
         self,

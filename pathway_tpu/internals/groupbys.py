@@ -25,10 +25,12 @@ class GroupedTable:
         table: "Table",
         by: list[ColumnReference],
         set_id: bool = False,
+        instance_last: bool = False,
     ) -> None:
         self._table = table
         self._by = by
         self._set_id = set_id
+        self._instance_last = instance_last
 
     def reduce(self, *args: Any, **kwargs: Any) -> "Table":
         from pathway_tpu.internals.table import Table, TableSpec
@@ -36,6 +38,20 @@ class GroupedTable:
         table = self._table
         exprs: dict[str, ColumnExpression] = {}
         for arg in args:
+            from pathway_tpu.internals.thisclass import ThisStar
+
+            if isinstance(arg, ThisStar):
+                from pathway_tpu.internals.thisclass import this
+
+                if arg._owner is not this:
+                    raise ValueError(
+                        f"{arg!r} cannot be used here; use *pw.this"
+                    )
+                # *pw.this inside reduce: the grouping columns (anything
+                # else is invalid in a reduce anyway)
+                for ref in self._by:
+                    exprs[ref.name] = ref
+                continue
             resolved = resolve_this(arg, table)
             if not isinstance(resolved, ColumnReference):
                 raise ValueError("positional reduce arguments must be column references")
@@ -61,6 +77,7 @@ class GroupedTable:
                     "by": self._by,
                     "exprs": exprs,
                     "set_id": self._set_id,
+                    "instance_last": self._instance_last,
                 },
             ),
             list(exprs.keys()),

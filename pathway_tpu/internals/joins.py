@@ -63,8 +63,24 @@ class JoinResult:
     def select(self, *args: Any, **kwargs: Any) -> "Table":
         from pathway_tpu.internals.table import Table, TableSpec
 
+        from pathway_tpu.internals.thisclass import ThisStar, left, right
+
         exprs: dict[str, ColumnExpression] = {}
         for arg in args:
+            if isinstance(arg, ThisStar):
+                # *pw.left / *pw.right expand that side; *pw.this takes
+                # both (left first; duplicate names keep the left column)
+                sides = (
+                    [self._left]
+                    if arg._owner is left
+                    else [self._right]
+                    if arg._owner is right
+                    else [self._left, self._right]
+                )
+                for side in sides:
+                    for n in side.column_names():
+                        exprs.setdefault(n, ColumnReference(side, n))
+                continue
             resolved = resolve_join_sides(arg, self._left, self._right)
             if not isinstance(resolved, ColumnReference):
                 raise ValueError("positional join-select arguments must be column refs")
@@ -72,17 +88,41 @@ class JoinResult:
         for name, value in kwargs.items():
             exprs[name] = resolve_join_sides(value, self._left, self._right)
         dtypes = {n: e._dtype for n, e in exprs.items()}
-        id_from_left = False
+        id_spec = None
         if self._id is not None:
             resolved_id = resolve_join_sides(self._id, self._left, self._right)
-            if (
-                isinstance(resolved_id, ColumnReference)
-                and resolved_id.table is self._left
-                and resolved_id.name == "id"
-            ):
-                id_from_left = True
+            if not isinstance(resolved_id, ColumnReference):
+                raise ValueError(
+                    "join id= must be a column reference (a side's .id or "
+                    "a pointer column)"
+                )
+            if resolved_id.table is self._left:
+                side, side_table = "left", self._left
+            elif resolved_id.table is self._right:
+                side, side_table = "right", self._right
             else:
-                raise NotImplementedError("join id= supports only left.id for now")
+                raise ValueError(
+                    "join id= must reference one of the joined tables"
+                )
+            if resolved_id.name == "id":
+                id_spec = (side, None)
+            else:
+                col_dtype = side_table._dtypes.get(resolved_id.name)
+                base = (
+                    col_dtype.strip_optional()
+                    if col_dtype is not None
+                    else None
+                )
+                if not (
+                    col_dtype is None
+                    or col_dtype == dt.ANY
+                    or isinstance(base, dt.Pointer)
+                ):
+                    raise ValueError(
+                        f"join id= column {resolved_id.name!r} must be "
+                        f"pointer-typed, got {col_dtype}"
+                    )
+                id_spec = (side, resolved_id.name)
         return Table(
             TableSpec(
                 "join_select",
@@ -91,7 +131,7 @@ class JoinResult:
                     "on": self._on,
                     "how": self._how,
                     "exprs": exprs,
-                    "id_from_left": id_from_left,
+                    "id_spec": id_spec,
                 },
             ),
             list(exprs.keys()),

@@ -851,6 +851,7 @@ class GraphRunner:
             by_cols=list(range(nb)),
             reducers=reducer_descr,
             set_id=set_id,
+            instance_last=spec.params.get("instance_last", False),
         )
 
         # post-projection: reducer nodes -> group-row positions; by refs too
@@ -902,13 +903,19 @@ class GraphRunner:
             + [eex.KeyRef()]
             + [self.compile(re_, rlayout) for _le, re_ in on],
         )
+        id_spec = spec.params.get("id_spec")
+        if id_spec is not None and id_spec[1] is not None:
+            # name -> column index in the side's prep row
+            side, name = id_spec
+            names = (left if side == "left" else right)._column_names
+            id_spec = (side, names.index(name))
         joined = scope.join_tables(
             left_prep,
             right_prep,
             left_on=list(range(nl + 1, nl + 1 + k)),
             right_on=list(range(nr + 1, nr + 1 + k)),
             kind=how,
-            id_from_left=spec.params.get("id_from_left", False),
+            id_spec=id_spec,
         )
         combined = Layout()
         for i, name in enumerate(left._column_names):

@@ -136,10 +136,23 @@ class Table:
     def _resolve_kwargs(
         self, args: tuple, kwargs: dict
     ) -> dict[str, ColumnExpression]:
+        from pathway_tpu.internals.thisclass import ThisStar
+
         out: dict[str, ColumnExpression] = {}
         for arg in args:
             if isinstance(arg, str):
                 out[arg] = ColumnReference(self, arg)
+                continue
+            if isinstance(arg, ThisStar):
+                from pathway_tpu.internals.thisclass import this
+
+                if arg._owner is not this:
+                    raise ValueError(
+                        f"{arg!r} cannot be used here; use *pw.this"
+                    )
+                # ``*pw.this``: every column of the bound table
+                for n in self._column_names:
+                    out[n] = ColumnReference(self, n)
                 continue
             resolved = resolve_this(arg, self)
             if isinstance(resolved, ColumnReference):
@@ -316,7 +329,7 @@ class Table:
             inst = resolve_this(instance, self)
             assert isinstance(inst, ColumnReference)
             by.append(inst)
-        return GroupedTable(self, by)
+        return GroupedTable(self, by, instance_last=instance is not None)
 
     def reduce(self, *args: Any, **kwargs: Any) -> "Table":
         from pathway_tpu.internals.groupbys import GroupedTable
@@ -582,10 +595,16 @@ class Table:
         self, expression: Any, *, optional: bool = False, context: Any = None
     ) -> "Table":
         expression = wrap_expression(expression)
-        deps = list(expression._dependencies())
-        if not deps:
-            raise ValueError("ix expression must reference a column")
-        keys_table = deps[0].table
+        if context is not None:
+            keys_table = context
+        else:
+            deps = list(expression._dependencies())
+            if not deps:
+                raise ValueError(
+                    "ix expression must reference a column (or pass "
+                    "context=)"
+                )
+            keys_table = deps[0].table
         keys = keys_table.select(_pw_ix_key=expression)
         return self._derived(
             TableSpec("ix", [keys, self], {"optional": optional}),
@@ -593,9 +612,52 @@ class Table:
             universe=keys_table._universe,
         )
 
-    def ix_ref(self, *args: Any, optional: bool = False, instance: Any = None) -> "Table":
-        raise NotImplementedError(
-            "ix_ref requires the keys-table context; use table.ix(table.pointer_from(...))"
+    def ix_ref(
+        self,
+        *args: Any,
+        optional: bool = False,
+        instance: Any = None,
+        context: "Table | None" = None,
+        allow_misses: bool = False,
+    ) -> "Table":
+        """Reindex this table by primary-key expressions: desugars to
+        ``self.ix(keys_table.pointer_from(*args))``, inferring the keys
+        table from the expressions' column references (reference
+        Table.ix_ref, python/pathway/internals/table.py:2400-2455).
+        ``context`` pins the keys table when the arguments are literals
+        only; ``pw.this.ix_ref(...)`` inside select supplies it
+        automatically."""
+        from pathway_tpu.internals.expression import wrap_expression
+
+        keys_table = context
+        if keys_table is None:
+            exprs = [wrap_expression(a) for a in args]
+            if instance is not None:
+                exprs.append(wrap_expression(instance))
+            deps = [d for e in exprs for d in e._dependencies()]
+            if not deps:
+                raise ValueError(
+                    "ix_ref with literal-only keys cannot infer the keys "
+                    "table; pass context= or use pw.this.ix_ref(...) "
+                    "inside select"
+                )
+            keys_table = deps[0].table
+        # plain strings are literal KEY VALUES here (ix_ref("Alice")),
+        # unlike select's string-as-column-name convention
+        resolved = [
+            wrap_expression(a)
+            if isinstance(a, str)
+            else resolve_this(a, keys_table)
+            for a in args
+        ]
+        inst = (
+            resolve_this(instance, keys_table)
+            if instance is not None
+            else None
+        )
+        pointer = PointerExpression(resolved, instance=inst)
+        return self.ix(
+            pointer, optional=optional or allow_misses, context=keys_table
         )
 
     # -- misc ops -----------------------------------------------------------

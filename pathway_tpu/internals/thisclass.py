@@ -35,9 +35,72 @@ class ThisColumnReference(ColumnExpression):
         return f"pw.{self._owner._side}.{self._name}"
 
 
+class DelayedIxRefColumn(ColumnExpression):
+    """``pw.this.ix_ref(*keys).column`` — the whole chain resolves when
+    the consuming select/reduce binds pw.this to a concrete table: the
+    table indexes ITSELF by the key expressions (reference delayed
+    ix_ref, thisclass.py ix handling)."""
+
+    def __init__(
+        self, owner: "ThisMetaclass", args: tuple, kwargs: dict, name: str
+    ) -> None:
+        self._owner = owner
+        self._ix_args = args
+        self._ix_kwargs = kwargs
+        self._name = name
+        self._dtype = dt.ANY
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _dependencies(self):
+        raise RuntimeError(
+            f"pw.{self._owner._side}.ix_ref(...) used outside of a table "
+            f"context"
+        )
+
+    def __repr__(self) -> str:
+        return f"pw.{self._owner._side}.ix_ref(...).{self._name}"
+
+
+class DelayedIxRef:
+    """Result of ``pw.this.ix_ref(...)`` — column access yields the
+    delayed expression."""
+
+    def __init__(
+        self, owner: "ThisMetaclass", args: tuple, kwargs: dict
+    ) -> None:
+        self._owner = owner
+        self._args = args
+        self._kwargs = kwargs
+
+    def __getattr__(self, name: str) -> DelayedIxRefColumn:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DelayedIxRefColumn(self._owner, self._args, self._kwargs, name)
+
+    def __getitem__(self, name: str) -> DelayedIxRefColumn:
+        return DelayedIxRefColumn(self._owner, self._args, self._kwargs, name)
+
+
+class ThisStar:
+    """``*pw.this`` marker: select expands it to every column of the
+    bound table (reference thisclass __iter__ mock, thisclass.py:103)."""
+
+    def __init__(self, owner: "ThisMetaclass") -> None:
+        self._owner = owner
+
+    def __repr__(self) -> str:
+        return f"*pw.{self._owner._side}"
+
+
 class ThisMetaclass:
     def __init__(self, side: str) -> None:
         self._side = side
+
+    def ix_ref(self, *args: Any, **kwargs: Any) -> DelayedIxRef:
+        return DelayedIxRef(self, args, kwargs)
 
     def __getattr__(self, name: str) -> ThisColumnReference:
         # engine-provided columns (_pw_window_start, _pw_instance, ...) are
@@ -49,7 +112,14 @@ class ThisMetaclass:
         return ThisColumnReference(self, name)
 
     def __getitem__(self, name: str) -> ThisColumnReference:
+        if not isinstance(name, str):
+            # guards the implicit-iteration protocol: without this,
+            # ``*pw.this`` would loop forever on integer indices
+            raise TypeError(f"pw.{self._side}[...] needs a column name")
         return ThisColumnReference(self, name)
+
+    def __iter__(self):
+        return iter([ThisStar(self)])
 
     def __repr__(self) -> str:
         return f"pw.{self._side}"

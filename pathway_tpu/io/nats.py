@@ -1,15 +1,40 @@
 """pw.io.nats — NATS subject connector (reference:
-python/pathway/io/nats/__init__.py, 277 LoC). Message-queue shaped: same
-transport seam as kafka; default transport gated on nats-py."""
+python/pathway/io/nats/__init__.py, 277 LoC; NATS reader/writer in
+src/connectors/data_storage.rs). Message-queue shaped: same engine seam
+as kafka, with the wire-protocol client in ``io/_nats_wire.py``
+(INFO/CONNECT handshake, PUB/SUB/MSG frames, token/user auth) as the
+default transport — an injected ``transport=`` overrides it."""
 
 from __future__ import annotations
 
 from typing import Any
+from urllib.parse import urlparse
 
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io import kafka as _kafka
-from pathway_tpu.io._utils import require
+
+
+def _wire_transport(uri: str | None, topic: str | None) -> Any:
+    from pathway_tpu.io._nats_wire import NatsTransport
+
+    if uri is None or topic is None:
+        raise ValueError("pw.io.nats needs uri and topic")
+    parsed = urlparse(uri if "://" in uri else f"nats://{uri}")
+    user = parsed.username or None
+    password = parsed.password or None
+    token = None
+    if user is not None and password is None:
+        # nats://<token>@host — bare userinfo is a token (nats.io URLs)
+        token, user = user, None
+    return NatsTransport(
+        parsed.hostname or "127.0.0.1",
+        parsed.port or 4222,
+        topic,
+        token=token,
+        user=user,
+        password=password,
+    )
 
 
 def read(
@@ -21,9 +46,10 @@ def read(
     transport: Any = None,
     **kwargs: Any,
 ) -> Table:
+    """Read a NATS subject (reference nats.read): SUB over the wire
+    client; ``uri`` accepts ``nats://[user:pass@]host:port``."""
     if transport is None:
-        require("nats", "pw.io.nats")
-        raise NotImplementedError("nats transport wiring requires a live server")
+        transport = _wire_transport(uri, topic)
     return _kafka.read(
         None, topic, schema=schema, format=format, transport=transport, **kwargs
     )
@@ -37,7 +63,8 @@ def write(
     transport: Any = None,
     **kwargs: Any,
 ) -> None:
+    """Publish a table's update stream to a NATS subject (reference
+    nats.write): PUB frames over the wire client."""
     if transport is None:
-        require("nats", "pw.io.nats")
-        raise NotImplementedError("nats transport wiring requires a live server")
+        transport = _wire_transport(uri, topic)
     _kafka.write(table, None, topic, transport=transport, **kwargs)

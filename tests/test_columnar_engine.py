@@ -884,3 +884,352 @@ class TestErrorSemanticsAtColumnarScale:
         nones = sum(1 for r in rows if r[1] is None)
         assert nones == 300
         assert all(r[3] == f"g{r[0]}" for r in rows)
+
+
+# -- adversarial property tests for the exact-semantics degrade screens ------
+#
+# The columnar paths compute in wrapping int64 / IEEE float64 / dense
+# arrays while the row interpreter computes exact Python semantics; a
+# family of screens (NaN bail, int64 overflow headroom, duplicate-key
+# low-64-bit pass, mixed-dtype bail, group-identity normalization) must
+# force degradation BEFORE any divergence. The reference gets this for
+# free from Rust's type system; here the screens are load-bearing, so
+# they are pinned by randomized generators (VERDICT r4 next-step #10).
+
+
+def _gen_scalar(rng, kind):
+    """One adversarial scalar of the given column kind."""
+    if kind == "int":
+        return rng.choice(
+            [
+                rng.randint(-5, 5),
+                rng.randint(-(10**6), 10**6),
+                # int64 cliff: sums/products near the wrap boundary
+                (1 << 62) - rng.randint(0, 3),
+                -(1 << 62) + rng.randint(0, 3),
+                (1 << 63) - 1,
+                -(1 << 63),
+                (1 << 53) + rng.randint(-1, 1),  # float-exactness edge
+            ]
+        )
+    if kind == "float":
+        return rng.choice(
+            [
+                float(rng.randint(-9, 9)),  # int-valued floats (== int)
+                rng.random() * 1e3,
+                float("nan"),
+                float("inf"),
+                float("-inf"),
+                1e18,
+                -1e18,
+                0.0,
+                -0.0,
+                5e-324,  # min subnormal
+            ]
+        )
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "str":
+        return rng.choice(["", "a", "b\x00c", "日本", "x" * 50])
+    # mixed: bool/int/float sharing one column, where 1 == 1.0 == True
+    return _gen_scalar(rng, rng.choice(["int", "float", "bool"]))
+
+
+def _gen_clean_scalar(rng, kind):
+    """Like _gen_scalar but never NaN (for cases pinning NON-degraded
+    paths where the oracle needs dict-key equality)."""
+    v = _gen_scalar(rng, kind)
+    while isinstance(v, float) and v != v:
+        v = _gen_scalar(rng, kind)
+    return v
+
+
+def _colliding_pointer_pairs(rng, n):
+    """Pointers sharing their LOW 64 bits but differing in the high 64:
+    the duplicate-key screen's first pass sorts the low halves only, so
+    these force the full 16-byte verification pass."""
+    from pathway_tpu.engine.value import unsafe_make_pointer
+
+    out = []
+    for _ in range(n):
+        lo = rng.getrandbits(64)
+        hi1, hi2 = rng.getrandbits(63), rng.getrandbits(63)
+        out.append(
+            (
+                unsafe_make_pointer(lo | (hi1 << 64)),
+                unsafe_make_pointer(lo | (hi2 << 64)),
+            )
+        )
+    return out
+
+
+class TestDegradeScreenProperties:
+    def _run_groupby(self, ops, n_vals, row_wise):
+        scope = Scope()
+        sess = scope.input_session(1 + n_vals)
+        gb = scope.group_by_table(
+            sess,
+            by_cols=[0],
+            reducers=[(make_reducer(ReducerKind.SUM), [i + 1]) for i in range(n_vals)]
+            + [(make_reducer(ReducerKind.COUNT), [])],
+        )
+        if row_wise:
+            gb._cg = None
+        sched = Scheduler(scope)
+        for commit in ops:
+            for op, key, row in commit:
+                (sess.insert if op == "+" else sess.remove)(key, row)
+            sched.commit()
+        if not row_wise:
+            # force any lazy state to materialize the same way
+            pass
+        return {k: tuple(map(repr, v)) for k, v in gb.current.items()}
+
+    @pytest.mark.parametrize("seed", range(8))
+    @pytest.mark.parametrize("by_kind", ["int", "float", "mixed", "str"])
+    def test_groupby_columnar_equals_row_path(self, seed, by_kind):
+        """Randomized adversarial columns (NaN placement, int64
+        near-overflow, bool/int/float identity mixing) through insert/
+        retract schedules: columnar state == row state EXACTLY (repr
+        equality, so 1 vs 1.0 vs True differences count)."""
+        rng = random.Random((seed << 8) ^ hash(by_kind))
+        live: dict = {}
+        ops = []
+        for _ in range(rng.randint(4, 10)):
+            commit = []
+            for _ in range(rng.randint(1, 50)):
+                if live and rng.random() < 0.35:
+                    key = rng.choice(list(live))
+                    commit.append(("-", key, live.pop(key)))
+                else:
+                    key = ref_scalar(("pk", rng.randint(0, 10**9)))
+                    row = (
+                        _gen_scalar(rng, by_kind),
+                        _gen_scalar(rng, "int"),
+                        _gen_scalar(rng, "float"),
+                    )
+                    live[key] = row
+                    commit.append(("+", key, row))
+            ops.append(commit)
+        a = self._run_groupby(ops, 2, row_wise=False)
+        b = self._run_groupby(ops, 2, row_wise=True)
+        assert a == b
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_join_columnar_equals_dict_path(self, seed):
+        """Randomized join-key columns across kinds (cross-kind equality,
+        NaN keys, huge ints beyond float64 exactness) with interleaved
+        retractions: columnar blocks == dict arrangements exactly."""
+        rng = random.Random(900 + seed)
+        kinds = ["int", "float", "bool", "str", "mixed"]
+        lk_kind = rng.choice(kinds)
+        rk_kind = rng.choice(kinds)
+
+        def ops():
+            rng2 = random.Random(900 + seed)
+            live: list = []
+            out = []
+            for c in range(6):
+                commit = []
+                for i in range(rng2.randint(3, 40)):
+                    if live and rng2.random() < 0.2:
+                        entry = live.pop(rng2.randrange(len(live)))
+                        commit.append(("-",) + entry)
+                    else:
+                        is_left = rng2.random() < 0.5
+                        kind = lk_kind if is_left else rk_kind
+                        entry = (
+                            is_left,
+                            ref_scalar((c, i, is_left)),
+                            (
+                                _gen_scalar(rng2, kind),
+                                float(rng2.randint(0, 99)),
+                            ),
+                        )
+                        live.append(entry)
+                        commit.append(("+",) + entry)
+                out.append(commit)
+            return out
+
+        def run(columnar):
+            scope, left, right, jn = _join_scope(columnar)
+            sched = Scheduler(scope)
+            for commit in ops():
+                for op, is_left, key, row in commit:
+                    sess = left if is_left else right
+                    (sess.insert if op == "+" else sess.remove)(key, row)
+                sched.commit()
+            return {
+                k: tuple(map(repr, v)) for k, v in jn.current.items()
+            }
+
+        assert run(True) == run(False)
+
+    def test_duplicate_key_screen_low64_collisions(self):
+        """Row keys engineered to collide in their LOW 64 bits (the
+        screen's cheap first pass) but differ in the high bits: the
+        uniqueness verdict must come from the full 16-byte pass, keeping
+        genuinely distinct keys on the columnar path and catching true
+        duplicates."""
+        rng = random.Random(4242)
+        pairs = _colliding_pointer_pairs(rng, 40)
+        scope, left, right, jn = _join_scope()
+        sched = Scheduler(scope)
+        for i, (p1, p2) in enumerate(pairs):
+            left.insert(p1, (i % 5, 1.0))
+            left.insert(p2, (i % 5, 2.0))  # collides in low 64 bits
+        for i in range(5):
+            right.insert(ref_scalar(("r", i)), (i, 10.0))
+        sched.commit()
+        # distinct (despite colliding halves): columnar path holds
+        assert jn._columnar_ok and jn._blocks_left
+        assert len(jn.current) == 80
+        # a TRUE duplicate (same full key, same row, twice in one batch)
+        scope2, left2, right2, jn2 = _join_scope()
+        sched2 = Scheduler(scope2)
+        dup = pairs[0][0]
+        left2.insert(dup, (1, 1.0))
+        left2.insert(dup, (1, 1.0))
+        right2.insert(ref_scalar("r"), (1, 5.0))
+        sched2.commit()
+        d1 = dict(jn2.current)
+        left2.remove(dup, (1, 1.0))
+        sched2.commit()
+        scope3, left3, right3, jn3 = _join_scope(columnar=False)
+        sched3 = Scheduler(scope3)
+        left3.insert(dup, (1, 1.0))
+        left3.insert(dup, (1, 1.0))
+        right3.insert(ref_scalar("r"), (1, 5.0))
+        sched3.commit()
+        d2 = dict(jn3.current)
+        left3.remove(dup, (1, 1.0))
+        sched3.commit()
+        assert d1 == d2
+        assert dict(jn2.current) == dict(jn3.current)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_int64_overflow_headroom_rollback(self, seed):
+        """Sums pushed near the int64 cliff from random directions: the
+        headroom screen must degrade (with group-creation rollback)
+        before any wrapped value can differ from Python's exact ints."""
+        rng = random.Random(7000 + seed)
+        ops = []
+        live: dict = {}
+        for c in range(6):
+            commit = []
+            for i in range(rng.randint(1, 12)):
+                if live and rng.random() < 0.25:
+                    key = rng.choice(list(live))
+                    commit.append(("-", key, live.pop(key)))
+                else:
+                    key = ref_scalar((c, i))
+                    row = (
+                        rng.randint(0, 2),
+                        rng.choice(
+                            [
+                                (1 << 62) - 1,
+                                -(1 << 62),
+                                (1 << 61),
+                                rng.randint(-100, 100),
+                                (1 << 63) - 1,
+                            ]
+                        ),
+                        0.0,
+                    )
+                    live[key] = row
+                    commit.append(("+", key, row))
+            ops.append(commit)
+        a = self._run_groupby(ops, 2, row_wise=False)
+        b = self._run_groupby(ops, 2, row_wise=True)
+        assert a == b
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_expression_columnar_equals_row_interpreter(self, seed):
+        """Arithmetic over adversarial int/float columns: the vectorized
+        evaluator's overflow/division guards must route every batch whose
+        NumPy result could differ (int64 wrap, ZeroDivision poisoning)
+        back to the row interpreter."""
+        from pathway_tpu.engine import expression as ex
+        import pathway_tpu.engine.graph as graph_mod
+
+        rng = random.Random(3100 + seed)
+        rows = [
+            (
+                ref_scalar(i),
+                (
+                    _gen_clean_scalar(rng, "int"),
+                    _gen_clean_scalar(rng, "float"),
+                ),
+            )
+            for i in range(400)
+        ]
+        exprs = [
+            ex.Binary("+", ex.ColumnRef(0), ex.Const(1)),
+            ex.Binary("*", ex.ColumnRef(0), ex.ColumnRef(0)),
+            ex.Binary("-", ex.ColumnRef(1), ex.ColumnRef(1)),
+            ex.Binary(">", ex.ColumnRef(0), ex.Const(0)),
+        ]
+
+        def run(threshold):
+            old = graph_mod.VECTOR_THRESHOLD
+            graph_mod.VECTOR_THRESHOLD = threshold
+            try:
+                scope = Scope()
+                sess = scope.input_session(2)
+                out = scope.expression_table(sess, exprs)
+                sched = Scheduler(scope)
+                for key, row in rows:
+                    sess.insert(key, row)
+                sched.commit()
+                return {
+                    k: tuple(map(repr, v))
+                    for k, v in out.current.items()
+                }
+            finally:
+                graph_mod.VECTOR_THRESHOLD = old
+
+        assert run(16) == run(1 << 60)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_sharded_columnar_matches_single_adversarial(self, seed):
+        """The sharded columnar exchange with adversarial group values
+        (NaNs among them) must produce the single-worker result — NaN
+        batches fall back to per-row routing, everything else rides the
+        vectorized path."""
+        import pathway_tpu as pw
+        from pathway_tpu.internals.parse_graph import G
+        from pathway_tpu.internals.runner import (
+            GraphRunner,
+            ShardedGraphRunner,
+        )
+
+        rng = random.Random(5200 + seed)
+        data = [
+            (
+                rng.choice(
+                    [1.0, 2.5, float("nan"), -0.0, 1e17, 3.0]
+                ),
+                rng.randint(0, 50),
+            )
+            for _ in range(600)
+        ]
+
+        def build():
+            t = pw.debug.table_from_rows(
+                pw.schema_from_types(g=float, v=int), data
+            )
+            return t.groupby(t.g).reduce(
+                g=t.g, s=pw.reducers.sum(t.v), n=pw.reducers.count()
+            )
+
+        G.clear()
+        (single,) = GraphRunner().capture(build())
+        G.clear()
+        (sharded,) = ShardedGraphRunner(4).capture(build())
+
+        def norm(cap):
+            # repr-normalize: NaN != NaN would fail equality on
+            # IDENTICAL rows
+            return {k: tuple(map(repr, v)) for k, v in cap.items()}
+
+        assert norm(single) == norm(sharded)

@@ -1,0 +1,160 @@
+"""NATS wire protocol: client + fake server over real frames
+(VERDICT r4 next-step #9 — replaces the io/nats.py stub; reference NATS
+reader/writer src/connectors/data_storage.rs, io module
+python/pathway/io/nats/__init__.py)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._nats_wire import (
+    FakeNatsServer,
+    NatsConnection,
+    NatsError,
+    NatsTransport,
+    _subject_matches,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = FakeNatsServer()
+    yield srv
+    srv.close()
+
+
+class TestWireClient:
+    def test_handshake_and_pub_sub_roundtrip(self, server):
+        sub = NatsConnection(port=server.port)
+        sub.subscribe("events.orders", sid=7)
+        sub.flush()
+        pub = NatsConnection(port=server.port)
+        pub.publish("events.orders", b"hello")
+        pub.publish("events.other", b"ignored")
+        pub.flush()
+        got = sub.drain(timeout=0.5)
+        assert got == [("events.orders", 7, b"hello")]
+        # the server really parsed CONNECT/PING/SUB/PUB frames
+        verbs = [v for _c, v in server.frames]
+        for expected in ("CONNECT", "PING", "SUB", "PUB"):
+            assert expected in verbs, verbs
+        sub.close(); pub.close()
+
+    def test_wildcards(self, server):
+        assert _subject_matches("a.*", "a.b")
+        assert not _subject_matches("a.*", "a.b.c")
+        assert _subject_matches("a.>", "a.b.c")
+        assert not _subject_matches("a.>", "a")
+        sub = NatsConnection(port=server.port)
+        sub.subscribe("metrics.>", sid=1)
+        sub.flush()
+        pub = NatsConnection(port=server.port)
+        pub.publish("metrics.cpu.host1", b"0.5")
+        pub.publish("logs.cpu", b"nope")
+        pub.flush()
+        got = sub.drain(timeout=0.5)
+        assert [(s, p) for s, _i, p in got] == [
+            ("metrics.cpu.host1", b"0.5")
+        ]
+        sub.close(); pub.close()
+
+    def test_unsubscribe_stops_delivery(self, server):
+        sub = NatsConnection(port=server.port)
+        sub.subscribe("t", sid=3)
+        sub.unsubscribe(3)
+        sub.flush()
+        pub = NatsConnection(port=server.port)
+        pub.publish("t", b"late")
+        pub.flush()
+        assert sub.drain(timeout=0.3) == []
+        sub.close(); pub.close()
+
+    def test_token_auth(self):
+        srv = FakeNatsServer(token="tok1")
+        try:
+            ok = NatsConnection(port=srv.port, token="tok1")
+            ok.publish("x", b"1")
+            ok.flush()
+            assert srv.published["x"] == [b"1"]
+            ok.close()
+            with pytest.raises(NatsError, match="Authorization"):
+                NatsConnection(port=srv.port, token="bad")
+        finally:
+            srv.close()
+
+    def test_verbose_ok_frames(self, server):
+        conn = NatsConnection(port=server.port, verbose=True)
+        conn.subscribe("v", sid=1)
+        conn.publish("v", b"payload")
+        got = conn.drain(timeout=0.5)
+        assert [(s, p) for s, _i, p in got] == [("v", b"payload")]
+        conn.close()
+
+
+class TestNatsTransport:
+    def test_produce_poll_roundtrip(self, server):
+        writer = NatsTransport("127.0.0.1", server.port, "tbl")
+        reader = NatsTransport("127.0.0.1", server.port, "tbl")
+        writer.produce(json.dumps({"k": 1, "v": "a"}))
+        writer.conn.flush()
+        msgs = reader.poll_messages()
+        assert len(msgs) == 1
+        assert json.loads(msgs[0].value) == {"k": 1, "v": "a"}
+        assert msgs[0].topic == "tbl" and msgs[0].offset == 0
+        writer.close(); reader.close()
+
+
+class TestPipelineOverWire:
+    def test_pw_io_nats_write_then_read(self, server):
+        """Full pipeline round trip over real NATS frames: write a table
+        to a subject, read it back through a second connector."""
+        uri = f"nats://127.0.0.1:{server.port}"
+
+        class S(pw.Schema):
+            k: int
+            v: str
+
+        # reader subscribes FIRST (NATS has no replay): the transport
+        # SUBs at read() declaration time
+        G.clear()
+        back = pw.io.nats.read(uri, "stream.t", schema=S, format="json")
+        captured = []
+        pw.io.subscribe(
+            back,
+            on_change=lambda key, row, time, is_addition: captured.append(
+                (row["k"], row["v"])
+            ),
+        )
+        from pathway_tpu.engine.graph import Scheduler
+        from pathway_tpu.internals import parse_graph
+        from pathway_tpu.internals.runner import GraphRunner
+
+        runner = GraphRunner()
+        for sink in parse_graph.G.sinks:
+            node = runner.build(sink.table)
+            drv = sink.attach(runner.scope, node)
+            if drv is not None:
+                runner.drivers.append(drv)
+        sched = Scheduler(runner.scope)
+        # now write through a separate graph
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, v=str), [(1, "x"), (2, "y")]
+        )
+        pw.io.nats.write(t, uri, "stream.t", format="json")
+        pw.run()
+        # pump the reader graph until the two rows arrive
+        import time as _t
+
+        deadline = _t.time() + 5.0
+        while len(captured) < 2 and _t.time() < deadline:
+            for d in runner.drivers:
+                d.poll()
+            sched.commit()
+        assert sorted(captured) == [(1, "x"), (2, "y")]
+        # PUB frames carried the payloads
+        assert len(server.published.get("stream.t", [])) == 2

@@ -28,12 +28,23 @@ class TestShardedEquivalence:
         assert set(sharded.keys()) == set(base.keys())
 
     def test_state_is_actually_partitioned(self):
+        # enough distinct groups that hash placement cannot plausibly
+        # land them all on one worker (4 groups could, by luck)
+        def big_wordcount():
+            t = pw.debug.table_from_rows(
+                pw.schema_from_types(word=str),
+                [(f"w{i % 32}",) for i in range(128)],
+            )
+            return t.groupby(t.word).reduce(
+                word=t.word, cnt=pw.reducers.count()
+            )
+
         runner = ShardedGraphRunner(4)
-        reps = runner.build(wordcount())
+        reps = runner.build(big_wordcount())
         runner.run()
         per_worker = [len(r.current) for r in reps]
-        assert sum(per_worker) == 4  # four distinct words
-        assert max(per_worker) < 4  # spread over >1 worker
+        assert sum(per_worker) == 32  # 32 distinct words
+        assert max(per_worker) < 32  # spread over >1 worker
 
     def test_join_exchanges_both_sides(self):
         def build():
