@@ -976,8 +976,11 @@ class JoinNode(Node):
         self._blocks_left: list[_JoinSide] = []
         self._blocks_right: list[_JoinSide] = []
         #: custom-id joins: result id -> owning join-key group, so
-        #: duplicate ids are caught ACROSS groups, not only within one
+        #: duplicate ids are caught ACROSS groups, not only within one;
+        #: suppressed contenders wait in _id_waiters and are re-examined
+        #: when the owner releases the id
         self._id_owners: dict[Pointer, Any] = {}
+        self._id_waiters: dict[Pointer, set] = {}
         self._columnar_ok = (
             kind == JoinKind.INNER
             and id_spec is None
@@ -1243,6 +1246,12 @@ class JoinNode(Node):
                     # across join-key groups) and the first row wins
                     if report:
                         self.report(okey, "duplicate join result id")
+                        if owner != jk:
+                            # remember the contender: if the owner ever
+                            # releases the id, this group re-emits
+                            self._id_waiters.setdefault(
+                                okey, set()
+                            ).add(jk)
                     return
             out[okey] = row
 
@@ -1402,6 +1411,7 @@ class JoinNode(Node):
                     arr.pop(jk, None)
 
         out = DeltaBatch()
+        freed: list[Pointer] = []
         for jk in affected:
             old = old_local[jk]
             new = self._local_output(jk)
@@ -1409,6 +1419,8 @@ class JoinNode(Node):
                 for okey in old:
                     if okey not in new and self._id_owners.get(okey) == jk:
                         del self._id_owners[okey]
+                        if okey in self._id_waiters:
+                            freed.append(okey)
                 for okey in new:
                     self._id_owners[okey] = jk
             for okey, orow in old.items():
@@ -1417,6 +1429,23 @@ class JoinNode(Node):
             for okey, orow in new.items():
                 if okey not in old or rows_differ(old[okey], orow):
                     out.append(okey, orow, 1)
+        # a released custom id hands over to a suppressed contender:
+        # without this, the contender's row would stay missing until an
+        # unrelated update happened to touch its join-key group
+        for okey in freed:
+            if self._id_owners.get(okey) is not None:
+                continue  # re-claimed within this batch
+            for jk in sorted(
+                self._id_waiters.pop(okey, ()), key=repr
+            ):
+                if jk in affected:
+                    continue  # its recompute already saw the free id
+                candidate = self._local_output(jk, report=False)
+                row = candidate.get(okey)
+                if row is not None:
+                    self._id_owners[okey] = jk
+                    out.append(okey, row, 1)
+                    break
         return out.consolidate()
 
 

@@ -57,6 +57,31 @@ def _shard_of(value: Any, n: int) -> int:
         return int(hash_values((repr(value),), salt=b"shard")) % n
 
 
+def _object_codes(col) -> "Any":
+    """Dense int64 codes for a non-sortable (object-dtype) column, keyed
+    by the value's hash_values DIGEST — the exact identity the per-row
+    partitioners use. Dict equality would be coarser (a tz-aware
+    datetime equals its rebased twin but digests differently), which
+    could route one logical key to different workers depending on which
+    class member a batch sees first."""
+    import numpy as np
+
+    index: dict = {}
+    inverse = np.empty(len(col), np.int64)
+    n_codes = 0
+    for i, v in enumerate(col.tolist()):
+        try:
+            d = hash_values((v,))
+        except TypeError:
+            d = hash_values((repr(v),))
+        code = index.get(d)
+        if code is None:
+            code = index[d] = n_codes
+            n_codes += 1
+        inverse[i] = code
+    return inverse
+
+
 def partition_rule(consumer: Node, port: int) -> tuple:
     """ONE classification of how entries entering ``consumer`` on ``port``
     pick their worker — consumed by BOTH the per-row closure builder and
@@ -191,36 +216,12 @@ class ShardedScheduler:
         kind = rule[0]
         if kind in ("cols", "col"):
             if kind == "cols":
-                idxs = rule[1]
+                idxs = list(rule[1])
                 if len(idxs) == 0:
                     return np.full(
                         payload.n, _shard_of((), self.n), np.int64
                     )
-                if len(idxs) > 1:
-                    # multi-column routing: composite factorization, one
-                    # Python tuple hash per DISTINCT key tuple
-                    from pathway_tpu.engine.device import factorize_multi
-
-                    arrays = [payload.cols[c] for c in idxs]
-                    if any(a.dtype.kind not in "bifU" for a in arrays):
-                        return None
-                    if any(
-                        a.dtype.kind == "f" and np.isnan(a).any()
-                        for a in arrays
-                    ):
-                        # np.unique collapses distinct-bit NaNs that the
-                        # per-row hash_values routing keeps apart
-                        return None
-                    first, inverse = factorize_multi(arrays)
-                    reps = zip(*(a[first].tolist() for a in arrays))
-                    table = np.fromiter(
-                        (_shard_of(t, self.n) for t in reps),
-                        np.int64,
-                        len(first),
-                    )
-                    return table[inverse]
-                c = idxs[0]
-                wrap = lambda v: (v,)  # noqa: E731 — tuple-wrapped hash
+                wrap = tuple  # by_cols hashes the value TUPLE
             else:
                 c = rule[1]
                 if c is None:
@@ -228,19 +229,35 @@ class ShardedScheduler:
                     return np.full(
                         payload.n, _shard_of(None, self.n), np.int64
                     )
-                wrap = lambda v: v  # noqa: E731 — bare-value hash
-            col = payload.cols[c]
-            if col.dtype.kind not in "bifU":
-                return None
-            if col.dtype.kind == "f" and np.isnan(col).any():
-                # np.unique collapses distinct-bit NaNs that the per-row
-                # hash_values routing keeps apart
-                return None
-            uniq, inverse = np.unique(col, return_inverse=True)
+                idxs = [c]
+                wrap = lambda t: t[0]  # noqa: E731 — bare-value hash
+            # per-column dense codes: sortable dtypes through np.unique
+            # (inside factorize_multi), object columns through the
+            # hash-equivalence dict coder — then one Python hash per
+            # DISTINCT key (tuple)
+            from pathway_tpu.engine.device import factorize_multi
+
+            arrays = []
+            for c in idxs:
+                col = payload.cols[c]
+                if col.dtype.kind in "bifU":
+                    if col.dtype.kind == "f" and np.isnan(col).any():
+                        # np.unique collapses distinct-bit NaNs that the
+                        # per-row hash_values routing keeps apart
+                        return None
+                    arrays.append(col)
+                elif col.dtype == object:
+                    arrays.append(_object_codes(col))
+                else:
+                    return None
+            first, inverse = factorize_multi(arrays)
+            reps = zip(
+                *(payload.cols[c][first].tolist() for c in idxs)
+            )
             table = np.fromiter(
-                (_shard_of(wrap(v), self.n) for v in uniq.tolist()),
+                (_shard_of(wrap(t), self.n) for t in reps),
                 np.int64,
-                len(uniq),
+                len(first),
             )
             return table[inverse]
         if kind != "key":

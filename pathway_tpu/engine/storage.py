@@ -410,10 +410,13 @@ class ElasticsearchWriter:
         self.client.index(self.index_name, self.formatter.format(key, values, time, diff))
 
     def on_time_end(self, time: int) -> None:
-        pass
+        # bulk clients buffer per commit (one _bulk request per time)
+        flush = getattr(self.client, "flush", None)
+        if flush is not None:
+            flush()
 
     def on_end(self) -> None:
-        pass
+        self.on_time_end(-1)
 
 
 class MongoWriter:

@@ -137,8 +137,22 @@ class NatsConnection:
             # MSG <subject> <sid> [reply-to] <#bytes>
             subject, sid = parts[1], int(parts[2])
             size = int(parts[-1])
-            payload = self._reader.read_exact(size)
-            self._reader.read_exact(2)  # trailing \r\n
+            # the header is consumed: the payload MUST follow. A drain()
+            # poll timeout firing mid-payload would desync the stream,
+            # so the payload read gets its own generous window and a
+            # stall is a hard protocol error, not a quiet return
+            old_timeout = self.sock.gettimeout()
+            if old_timeout is not None and old_timeout < 5.0:
+                self.sock.settimeout(5.0)
+            try:
+                payload = self._reader.read_exact(size)
+                self._reader.read_exact(2)  # trailing \r\n
+            except (TimeoutError, socket.timeout) as exc:
+                raise NatsError(
+                    f"MSG payload stalled mid-frame ({size} bytes)"
+                ) from exc
+            finally:
+                self.sock.settimeout(old_timeout)
             self.inbox.append((subject, sid, payload))
         return line
 
@@ -208,13 +222,18 @@ class NatsTransport:
         token: str | None = None,
         user: str | None = None,
         password: str | None = None,
+        subscribe: bool = True,
     ) -> None:
         self.subject = subject
         self.conn = NatsConnection(
             host, port, token=token, user=user, password=password
         )
-        self.conn.subscribe(subject, sid=1)
-        self.conn.flush()  # SUB registered before the first poll/produce
+        if subscribe:
+            self.conn.subscribe(subject, sid=1)
+            self.conn.flush()  # SUB registered before the first poll
+        # write-only transports do NOT subscribe: the server would echo
+        # every published message back to this connection, and with
+        # nobody draining, the TCP buffers eventually deadlock both ends
         self._offset = 0
 
     def produce(self, value: Any, key: Any = None) -> None:

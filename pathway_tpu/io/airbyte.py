@@ -300,6 +300,83 @@ def _load_config(config_file_path: str) -> tuple[dict, list[str] | None]:
     return doc, None
 
 
+def venv_connector_command(
+    connector_name: str,
+    *,
+    venv_path: str | None = None,
+    pip_extra_args: Sequence[str] | None = None,
+    reuse: bool = True,
+) -> list[str]:
+    """Create a virtualenv, pip-install ``airbyte-<name>``, and return
+    the connector's entry-point argv (reference VenvAirbyteSource,
+    third_party/airbyte_serverless/sources.py:137-170).
+
+    pip needs a package index; this environment may be OFFLINE. The
+    offline paths, all first-class:
+
+    - ``venv_path=`` pointing at a venv where the connector entry point
+      already exists (``reuse=True`` skips pip entirely);
+    - ``pip_extra_args=["--no-index", "--find-links", <wheel dir>]``
+      installing from local wheels;
+    - or skip this helper and pass ``connector_command=`` naming any
+      local Airbyte-protocol executable.
+    """
+    import os
+    import pathlib
+    import subprocess
+    import tempfile
+    import venv as venv_mod
+
+    name = connector_name.removeprefix("airbyte-")
+    root = pathlib.Path(
+        venv_path
+        if venv_path is not None
+        else tempfile.mkdtemp(prefix=f"pw-airbyte-{name}-")
+    )
+    exe = root / "bin" / name
+    if reuse and exe.exists():
+        return [os.fspath(exe)]
+    if not (root / "bin" / "pip").exists():
+        venv_mod.create(root, with_pip=True)
+    cmd = [
+        os.fspath(root / "bin" / "pip"),
+        "install",
+        *(pip_extra_args or ()),
+        f"airbyte-{name}",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=600
+        )
+    except subprocess.TimeoutExpired as exc:
+        raise RuntimeError(
+            f"pip install airbyte-{name} timed out after 600s — this "
+            f"environment likely has no network access. Offline options: "
+            f"pip_extra_args=['--no-index', '--find-links', '<wheel "
+            f"dir>'], venv_path= at a venv with the connector already "
+            f"installed, or connector_command= naming a local "
+            f"Airbyte-protocol executable."
+        ) from exc
+    if proc.returncode != 0:
+        tail = (proc.stdout + proc.stderr)[-2000:]
+        raise RuntimeError(
+            f"failed to install airbyte-{name} into {root} (pip exited "
+            f"{proc.returncode}).\n--- pip output (tail) ---\n{tail}\n"
+            f"If PyPI is unreachable from this environment, use one of "
+            f"the offline options: pip_extra_args=['--no-index', "
+            f"'--find-links', '<dir with wheels>'], venv_path= at a "
+            f"venv where the connector is already installed, or "
+            f"connector_command= naming a local Airbyte-protocol "
+            f"executable."
+        )
+    if not exe.exists():
+        raise RuntimeError(
+            f"airbyte-{name} installed but its entry point {exe} is "
+            f"missing; pass connector_command= explicitly"
+        )
+    return [os.fspath(exe)]
+
+
 def read(
     config_file_path: str,
     streams: Sequence[str],
@@ -310,21 +387,41 @@ def read(
     env_vars: dict[str, str] | None = None,
     refresh_interval_ms: int = 60000,
     persistent_id: str | None = None,
+    connector_name: str | None = None,
+    venv_path: str | None = None,
+    pip_extra_args: Sequence[str] | None = None,
     **kwargs: Any,
 ) -> Table:
     """Run a local Airbyte source and stream its records (one JSON
     ``data`` column per record — the reference's _AirbyteRecordSchema).
 
-    ``connector_command`` names the executable (argv list or shell-split
-    string); it may also come from the config file's ``source.exec``
-    field. Docker/Cloud-Run execution types are not available in this
-    environment — use a pip-installed connector's entry point."""
-    if execution_type != "local":
+    ``execution_type="local"``: ``connector_command`` names the
+    executable (argv list or shell-split string); it may also come from
+    the config file's ``source.exec`` field.
+    ``execution_type="venv"`` (the reference's pypi method): a
+    virtualenv is created and ``airbyte-<connector_name>`` installed
+    into it via :func:`venv_connector_command` — with explicit offline
+    fallbacks (pre-installed ``venv_path=``, local-wheel
+    ``pip_extra_args=``). Docker/Cloud-Run execution stays unavailable
+    in this environment."""
+    if execution_type not in ("local", "venv", "pypi"):
         raise NotImplementedError(
-            f"execution_type={execution_type!r}: only 'local' executable "
-            "sources are supported here (no docker/Cloud Run runtime)"
+            f"execution_type={execution_type!r}: 'local' executables and "
+            "'venv' (pip-installed connectors) are supported here (no "
+            "docker/Cloud Run runtime)"
         )
     config, file_command = _load_config(config_file_path)
+    if execution_type in ("venv", "pypi"):
+        if connector_name is None:
+            raise ValueError(
+                "execution_type='venv' needs connector_name= (e.g. "
+                "'source-faker')"
+            )
+        connector_command = venv_connector_command(
+            connector_name,
+            venv_path=venv_path,
+            pip_extra_args=pip_extra_args,
+        )
     if connector_command is None:
         connector_command = file_command
     if connector_command is None:

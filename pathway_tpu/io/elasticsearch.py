@@ -9,7 +9,7 @@ from typing import Any
 from pathway_tpu.engine.formats import DocumentFormatter
 from pathway_tpu.engine.storage import ElasticsearchWriter
 from pathway_tpu.internals.table import Table
-from pathway_tpu.io._utils import attach_writer, require
+from pathway_tpu.io._utils import attach_writer
 
 
 class ElasticSearchAuth:
@@ -41,33 +41,35 @@ def write(
     client: Any = None,
     **kwargs: Any,
 ) -> None:
-    """Index one document (row + time + diff) per change. ``client`` needs
-    ``index(index_name, document)``; elasticsearch-py adapts directly."""
+    """Index one document (row + time + diff) per change through the
+    built-in HTTP ``_bulk`` client (``io/_es_wire.py``: NDJSON frames,
+    one bulk request per commit, Basic/Bearer/ApiKey auth). An injected
+    ``client`` with ``index(index_name, document)`` overrides it."""
     if client is None:
-        es_mod = require("elasticsearch", "pw.io.elasticsearch")
-        es_kwargs: dict[str, Any] = {}
+        from pathway_tpu.io._es_wire import (
+            EsBulkClient,
+            auth_header_apikey,
+            auth_header_basic,
+            auth_header_bearer,
+        )
+
+        if host is None:
+            raise ValueError("pw.io.elasticsearch needs host (or client=)")
+        auth_header = None
         if auth is not None:
             if auth.kind == "basic":
-                es_kwargs["basic_auth"] = (
-                    auth.params["username"],
-                    auth.params["password"],
+                auth_header = auth_header_basic(
+                    auth.params["username"], auth.params["password"]
                 )
             elif auth.kind == "bearer":
-                es_kwargs["bearer_auth"] = auth.params["token"]
+                auth_header = auth_header_bearer(auth.params["token"])
             elif auth.kind == "apikey":
-                es_kwargs["api_key"] = (
-                    auth.params["apikey_id"],
-                    auth.params["apikey"],
+                auth_header = auth_header_apikey(
+                    auth.params["apikey_id"], auth.params["apikey"]
                 )
             else:
                 raise ValueError(f"unknown auth kind {auth.kind!r}")
-        es = es_mod.Elasticsearch(host, **es_kwargs)
-
-        class _Adapter:
-            def index(self, index_name: str, document: dict) -> None:
-                es.index(index=index_name, document=document)
-
-        client = _Adapter()
+        client = EsBulkClient(host, auth_header=auth_header)
 
     def make_writer(column_names):
         return ElasticsearchWriter(

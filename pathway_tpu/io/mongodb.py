@@ -9,7 +9,7 @@ from typing import Any
 from pathway_tpu.engine.formats import DocumentFormatter
 from pathway_tpu.engine.storage import MongoWriter
 from pathway_tpu.internals.table import Table
-from pathway_tpu.io._utils import attach_writer, require
+from pathway_tpu.io._utils import attach_writer
 
 
 def write(
@@ -21,17 +21,30 @@ def write(
     client: Any = None,
     **kwargs: Any,
 ) -> None:
-    """Insert one document (row + time + diff) per change. ``client`` needs
-    ``insert_many(collection, docs)``; pymongo adapts in two lines."""
+    """Insert one document (row + time + diff) per change through the
+    built-in wire client (``io/_mongo_wire.py``: own BSON codec + OP_MSG
+    insert commands, one batch per commit). An injected ``client`` with
+    ``insert_many(collection, docs)`` overrides it."""
     if client is None:
-        pymongo = require("pymongo", "pw.io.mongodb")
-        mongo = pymongo.MongoClient(connection_string)[database]
+        from urllib.parse import urlparse
 
-        class _Adapter:
-            def insert_many(self, coll: str, docs: list) -> None:
-                mongo[coll].insert_many(docs)
+        from pathway_tpu.io._mongo_wire import MongoWireClient
 
-        client = _Adapter()
+        if connection_string is None or database is None:
+            raise ValueError(
+                "pw.io.mongodb needs connection_string and database "
+                "(or client=)"
+            )
+        parsed = urlparse(
+            connection_string
+            if "://" in connection_string
+            else f"mongodb://{connection_string}"
+        )
+        client = MongoWireClient(
+            parsed.hostname or "127.0.0.1",
+            parsed.port or 27017,
+            database=database,
+        )
 
     def make_writer(column_names):
         return MongoWriter(client, collection, DocumentFormatter(column_names))

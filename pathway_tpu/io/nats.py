@@ -15,7 +15,9 @@ from pathway_tpu.internals.table import Table
 from pathway_tpu.io import kafka as _kafka
 
 
-def _wire_transport(uri: str | None, topic: str | None) -> Any:
+def _wire_transport(
+    uri: str | None, topic: str | None, subscribe: bool = True
+) -> Any:
     from pathway_tpu.io._nats_wire import NatsTransport
 
     if uri is None or topic is None:
@@ -34,6 +36,7 @@ def _wire_transport(uri: str | None, topic: str | None) -> Any:
         token=token,
         user=user,
         password=password,
+        subscribe=subscribe,
     )
 
 
@@ -66,5 +69,5 @@ def write(
     """Publish a table's update stream to a NATS subject (reference
     nats.write): PUB frames over the wire client."""
     if transport is None:
-        transport = _wire_transport(uri, topic)
+        transport = _wire_transport(uri, topic, subscribe=False)
     _kafka.write(table, None, topic, transport=transport, **kwargs)

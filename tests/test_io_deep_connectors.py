@@ -423,3 +423,76 @@ class TestPubSubRest:
             assert events == ["click", "view"]
         finally:
             fake.close()
+
+
+class TestAirbyteVenvExecution:
+    """execution_type='venv' (the reference's pypi method,
+    VenvAirbyteSource at third_party/airbyte_serverless/sources.py:137)
+    with first-class OFFLINE fallbacks — this image has no network."""
+
+    def _fake_venv(self, tmp_path) -> str:
+        """A venv-shaped directory whose bin/ holds a ready connector
+        entry point (the 'connector already installed' offline path)."""
+        venv_dir = os.path.join(tmp_path, "venv")
+        bindir = os.path.join(venv_dir, "bin")
+        os.makedirs(bindir)
+        src = os.path.join(tmp_path, "impl.py")
+        with open(src, "w") as f:
+            f.write(_FAKE_SOURCE)
+        exe = os.path.join(bindir, "source-fixture")
+        with open(exe, "w") as f:
+            f.write(f"#!{sys.executable}\n" + _FAKE_SOURCE)
+        os.chmod(exe, 0o755)
+        return venv_dir
+
+    def test_preinstalled_venv_runs_end_to_end(self, tmp_path):
+        G.clear()
+        venv_dir = self._fake_venv(str(tmp_path))
+        cfg = os.path.join(str(tmp_path), "config.json")
+        with open(cfg, "w") as f:
+            json.dump({"api_key": "k"}, f)
+        t = pw.io.airbyte.read(
+            cfg,
+            ["users"],
+            mode="static",
+            execution_type="venv",
+            connector_name="source-fixture",
+            venv_path=venv_dir,
+        )
+        got = []
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: got.append(
+                row["data"].value["name"]
+            ),
+        )
+        pw.run()
+        assert sorted(got) == ["ann", "bob", "cid"]
+
+    def test_missing_index_error_names_offline_options(self, tmp_path):
+        import pytest
+
+        from pathway_tpu.io.airbyte import venv_connector_command
+
+        empty = os.path.join(str(tmp_path), "no-wheels")
+        os.makedirs(empty)
+        with pytest.raises(RuntimeError) as err:
+            venv_connector_command(
+                "source-nonexistent-fixture",
+                venv_path=os.path.join(str(tmp_path), "v2"),
+                # --no-index keeps the failure OFFLINE and fast
+                pip_extra_args=["--no-index", "--find-links", empty],
+            )
+        msg = str(err.value)
+        assert "--find-links" in msg and "connector_command=" in msg
+
+    def test_venv_requires_connector_name(self, tmp_path):
+        import pytest
+
+        cfg = os.path.join(str(tmp_path), "config.json")
+        with open(cfg, "w") as f:
+            json.dump({}, f)
+        with pytest.raises(ValueError, match="connector_name"):
+            pw.io.airbyte.read(
+                cfg, ["users"], execution_type="venv"
+            )

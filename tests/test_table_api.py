@@ -650,3 +650,38 @@ class TestGeneralIxRefAndJoinId:
         assert len(keyed.__dict__.get("_pw_ix_ref_cache", {})) == 1
         (cap,) = self._runner().capture(out)
         assert sorted(cap.values()) == [(1, 2), (1, 2)]
+
+    def test_join_id_duplicate_handover_on_retraction(self):
+        """First-wins duplicate ids hand over: retracting the owning row
+        re-emits the suppressed contender's row (engine-level, streaming)."""
+        from pathway_tpu.engine import (
+            Scheduler,
+            Scope,
+            ref_scalar,
+        )
+        from pathway_tpu.engine.value import unsafe_make_pointer
+
+        scope = Scope()
+        left = scope.input_session(2)
+        right = scope.input_session(2)
+        shared = unsafe_make_pointer(777)
+        jn = scope.join_tables(
+            left, right, left_on=[0], right_on=[0],
+            id_spec=("left", 1),
+        )
+        sched = Scheduler(scope)
+        # two different join-key groups, both naming the SAME result id
+        left.insert(ref_scalar("a"), (1, shared))
+        left.insert(ref_scalar("b"), (2, shared))
+        right.insert(ref_scalar("x"), (1, None))
+        right.insert(ref_scalar("y"), (2, None))
+        sched.commit()
+        assert list(jn.current) == [shared]
+        first_row = jn.current[shared]
+        owner_key = ref_scalar("a") if first_row[0] == 1 else ref_scalar("b")
+        owner_row = (1, shared) if first_row[0] == 1 else (2, shared)
+        # retract the owner: the suppressed group's row takes the id over
+        left.remove(owner_key, owner_row)
+        sched.commit()
+        assert list(jn.current) == [shared]
+        assert jn.current[shared][0] != first_row[0]
