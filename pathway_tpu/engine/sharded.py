@@ -204,70 +204,9 @@ class ShardedScheduler:
     def _columnar_shards(
         self, consumer: Node, port: int, out: DeltaBatch
     ):
-        """Vectorized worker assignment for a columnar batch, or None when
-        the routing rule needs the row path. Digest-identical to the
-        per-row partitioners: row-key routing is the full 128-bit pointer
-        mod n; column routing hashes per DISTINCT value (np.unique) and
-        maps back through the inverse index."""
-        import numpy as np
-
-        payload = out.columns
-        rule = partition_rule(consumer, port)
-        kind = rule[0]
-        if kind in ("cols", "col"):
-            if kind == "cols":
-                idxs = list(rule[1])
-                if len(idxs) == 0:
-                    return np.full(
-                        payload.n, _shard_of((), self.n), np.int64
-                    )
-                wrap = tuple  # by_cols hashes the value TUPLE
-            else:
-                c = rule[1]
-                if c is None:
-                    # constant instance: every row to _shard_of(None)
-                    return np.full(
-                        payload.n, _shard_of(None, self.n), np.int64
-                    )
-                idxs = [c]
-                wrap = lambda t: t[0]  # noqa: E731 — bare-value hash
-            # per-column dense codes: sortable dtypes through np.unique
-            # (inside factorize_multi), object columns through the
-            # hash-equivalence dict coder — then one Python hash per
-            # DISTINCT key (tuple)
-            from pathway_tpu.engine.device import factorize_multi
-
-            arrays = []
-            for c in idxs:
-                col = payload.cols[c]
-                if col.dtype.kind in "bifU":
-                    if col.dtype.kind == "f" and np.isnan(col).any():
-                        # np.unique collapses distinct-bit NaNs that the
-                        # per-row hash_values routing keeps apart
-                        return None
-                    arrays.append(col)
-                elif col.dtype == object:
-                    arrays.append(_object_codes(col))
-                else:
-                    return None
-            first, inverse = factorize_multi(arrays)
-            reps = zip(
-                *(payload.cols[c][first].tolist() for c in idxs)
-            )
-            table = np.fromiter(
-                (_shard_of(wrap(t), self.n) for t in reps),
-                np.int64,
-                len(first),
-            )
-            return table[inverse]
-        if kind != "key":
-            return None  # "pin" never reaches here (fn is None earlier)
-        kb = np.ascontiguousarray(payload.kbytes())
-        lo = kb[:, :8].copy().view(np.uint64).ravel()
-        hi = kb[:, 8:].copy().view(np.uint64).ravel()
-        n = np.uint64(self.n)
-        base = np.uint64((1 << 64) % self.n)
-        return (((hi % n) * base + lo % n) % n).astype(np.int64)
+        return columnar_shards(
+            partition_rule(consumer, port), out.columns, self.n
+        )
 
     def _deliver(
         self, worker: int, producer: Node, out: DeltaBatch
