@@ -44,6 +44,113 @@ import numpy as np
 
 BASELINE_DOCS_PER_SEC = 31.5
 
+#: hard wall-clock deadline for the WHOLE bench run (seconds; unset/0 =
+#: none). BENCH_r05 spent 1800s+ probing an unreachable TPU and was
+#: killed by the outer harness at rc=124 with ZERO data printed — with a
+#: budget set, the watchdog guarantees an outage JSON line (carrying
+#: every partial number gathered so far) lands before the deadline, no
+#: matter which leg is stuck.
+WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "0"))
+_START_TIME = time.time()
+
+#: numbers already measured this run, emitted incrementally the moment
+#: each leg finishes (one {"partial": ...} JSON line per leg) so a later
+#: hang or kill cannot erase them; the watchdog replays the dict in its
+#: outage line
+_PARTIAL: dict = {}
+
+
+def _budget_remaining() -> float | None:
+    """Seconds left in the wall budget, or None when no budget is set."""
+    if WALL_BUDGET_S <= 0:
+        return None
+    return WALL_BUDGET_S - (time.time() - _START_TIME)
+
+
+def _budget_bounded(default: float, headroom: float = 5.0) -> float:
+    """Clamp a wait/window to what the wall budget still allows."""
+    remaining = _budget_remaining()
+    if remaining is None:
+        return default
+    return max(0.0, min(default, remaining - headroom))
+
+
+def _emit_partial(label: str, value) -> None:
+    print(json.dumps({"partial": label, "value": value}), flush=True)
+    _PARTIAL[label] = value
+
+
+def _install_budget_watchdog() -> None:
+    """Daemon that force-emits the outage JSON at the wall deadline and
+    exits 3 — the bench may produce incomplete data, never no data."""
+    if WALL_BUDGET_S <= 0:
+        return
+
+    def watch() -> None:
+        while True:
+            remaining = _budget_remaining()
+            if remaining <= 0:
+                break
+            time.sleep(min(remaining, 5.0))
+        print(
+            json.dumps(
+                {
+                    "metric": "streaming_rag_pipeline_docs_per_sec",
+                    "value": None,
+                    "unit": "docs/sec",
+                    "vs_baseline": None,
+                    "error": (
+                        f"wall budget exhausted: BENCH_WALL_BUDGET_S="
+                        f"{WALL_BUDGET_S:.0f}s elapsed before the run "
+                        "completed"
+                    ),
+                    "extra": dict(_PARTIAL),
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+    # The thread alone cannot bound a C-level hang: libtpu's GCP-metadata
+    # retry loop holds the GIL for its entire multi-minute probe, starving
+    # every Python thread (observed: zero watchdog wakeups across a 40s
+    # init hang). A sentinel PROCESS shares no GIL — it waits a grace
+    # period past the deadline for the in-process watchdog to win, then
+    # prints the outage JSON on the inherited stdout and SIGKILLs the
+    # wedged bench. Exits silently the moment the parent dies on its own
+    # (getppid flips to the reaper).
+    import subprocess
+
+    sentinel = (
+        "import json,os,signal,sys,time\n"
+        "ppid=int(sys.argv[1]);deadline=float(sys.argv[2]);budget=sys.argv[3]\n"
+        "while time.time()<deadline:\n"
+        "    time.sleep(1.0)\n"
+        "    if os.getppid()!=ppid: sys.exit(0)\n"
+        "if os.getppid()!=ppid: sys.exit(0)\n"
+        "print(json.dumps({'metric':'streaming_rag_pipeline_docs_per_sec',"
+        "'value':None,'unit':'docs/sec','vs_baseline':None,"
+        "'error':'wall budget exhausted: BENCH_WALL_BUDGET_S='+budget+'s "
+        "passed with the process wedged in a non-Python hang (GIL held "
+        "through a C call); killed by the sentinel process',"
+        "'extra':{}}),flush=True)\n"
+        "try: os.kill(ppid,signal.SIGKILL)\n"
+        "except ProcessLookupError: pass\n"
+    )
+    subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            sentinel,
+            str(os.getpid()),
+            str(_START_TIME + WALL_BUDGET_S + 10.0),
+            f"{WALL_BUDGET_S:.0f}",
+        ],
+        stdin=subprocess.DEVNULL,
+    )
+
 N_DOCS = int(os.environ.get("BENCH_DOCS", "20000"))
 N_QUERIES = int(os.environ.get("BENCH_QUERIES", "64"))
 DEVICE_SECONDS = float(os.environ.get("BENCH_SECONDS", "5"))
@@ -882,7 +989,14 @@ def _maybe_run_dataflow(out: dict, timeout_s: float | None = None) -> None:
         try:
             import bench_dataflow
 
-            out["dataflow_rows_per_sec"] = bench_dataflow.run_all()
+            # incremental emission: each workload prints its JSON line
+            # the moment it finishes, so a budget kill mid-suite still
+            # reports the legs that completed
+            out["dataflow_rows_per_sec"] = bench_dataflow.run_all(
+                emit=lambda name, value: _emit_partial(
+                    f"dataflow_{name}", value
+                )
+            )
         except Exception as exc:  # noqa: BLE001 — diagnostic only
             out["dataflow_error"] = repr(exc)
 
@@ -922,6 +1036,10 @@ def _probe_device_retrying() -> None:
             os.environ.get("BENCH_DEVICE_PROBE_S", "1800"),
         )
     )
+    # the probe window must fit inside the wall budget with headroom for
+    # the outage JSON + dataflow join (the BENCH_r05 failure mode: the
+    # default 1800s window alone overran the harness deadline)
+    window = _budget_bounded(window, headroom=10.0)
     gap = float(os.environ.get("BENCH_REPROBE_GAP_S", "120"))
     start = time.time()
     failures: list = []
@@ -1011,11 +1129,11 @@ def _probe_device_retrying() -> None:
     )
     extra: dict = {}
     if _DATAFLOW_THREAD:
-        _DATAFLOW_THREAD[0].join(900.0)
+        _DATAFLOW_THREAD[0].join(_budget_bounded(900.0))
     if _DATAFLOW_PREFETCH:
         extra.update(_DATAFLOW_PREFETCH)
     else:
-        _maybe_run_dataflow(extra, timeout_s=600.0)
+        _maybe_run_dataflow(extra, timeout_s=_budget_bounded(600.0))
     extra["probe_attempts"] = attempts[0]
     extra["probe_window_s"] = window
     print(
@@ -1083,6 +1201,7 @@ def _device_alive(timeout_s: float) -> bool:
 
 
 def main() -> None:
+    _install_budget_watchdog()
     _probe_device_retrying()
     leg_timeout = float(os.environ.get("BENCH_LEG_TIMEOUT_S", "1200"))
     stats: dict = {}
@@ -1173,7 +1292,7 @@ def main() -> None:
     # + incremental phase) tracked in the same JSON line every round;
     # needs no device, so it runs last regardless of tunnel state (and
     # reuses the outage-window prefetch when one ran)
-    _maybe_run_dataflow(stats, timeout_s=900.0)
+    _maybe_run_dataflow(stats, timeout_s=_budget_bounded(900.0))
     if errors:
         stats["leg_errors"] = errors
     out = {

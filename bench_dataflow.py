@@ -11,6 +11,9 @@ Prints one JSON line per workload with rows/sec for both modes.
 from __future__ import annotations
 
 import json
+import os
+import socket
+import threading
 import time
 
 import pathway_tpu.engine.graph as graph_mod
@@ -203,25 +206,193 @@ def incremental_update():
     return rows_per_sec
 
 
-def run_all() -> dict:
+def _free_ports(n: int) -> list[int]:
+    """n distinct OS-assigned loopback ports (bound briefly, then freed)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _mesh_groupby_once(columnar: bool, n_rows: int) -> float:
+    """One 2-process mesh commit of the groupby-sum workload, both
+    processes as threads of this interpreter over a real loopback TCP
+    mesh. Returns the coordinator's commit wall time. ``columnar=False``
+    forces the pickled-row-entry wire path — the baseline the dtype-tagged
+    frames are measured against."""
+    from pathway_tpu.engine import distributed as dist
+
+    addrs = [("127.0.0.1", p) for p in _free_ports(2)]
+    rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(n_rows)]
+    barrier = threading.Barrier(2)
+    times = [0.0, 0.0]
+    errors: list[BaseException] = []
+
+    def worker(pid: int) -> None:
+        transport = None
+        try:
+            scope = Scope()
+            sess = scope.input_session(2)
+            scope.group_by_table(
+                sess,
+                by_cols=[0],
+                reducers=[
+                    (make_reducer(ReducerKind.SUM), [1]),
+                    (make_reducer(ReducerKind.COUNT), []),
+                ],
+            )
+            transport = dist.MeshTransport(pid, 2, addresses=addrs)
+            sched = dist.DistributedScheduler(
+                [scope], pid, 2, transport, n_shared=len(scope.nodes)
+            )
+            if pid == 0:
+                sched.announce_topology()
+                for key, row in rows:
+                    sess.insert(key, row)
+            else:
+                sched.receive_topology()
+            barrier.wait()
+            t0 = time.perf_counter()
+            sched.commit_local()
+            times[pid] = time.perf_counter() - t0
+            barrier.wait()  # don't tear the mesh down under the peer
+        except BaseException as exc:  # noqa: BLE001 — surfaced to caller
+            errors.append(exc)
+            barrier.abort()
+        finally:
+            if transport is not None:
+                transport.close()
+
+    old = dist.COLUMNAR_EXCHANGE
+    dist.COLUMNAR_EXCHANGE = columnar
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(pid,)) for pid in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        dist.COLUMNAR_EXCHANGE = old
+    if errors:
+        raise errors[0]
+    return times[0]
+
+
+def distributed_leg(n_rows: int | None = None) -> dict:
+    """Columnar mesh vs row-pickle mesh vs in-process, rows/sec each.
+
+    Smaller row count than the in-process legs (BENCH_MESH_ROWS, default
+    200k): the row-pickle baseline is slow enough that 1M rows would
+    dominate the bench wall budget."""
+    if n_rows is None:
+        n_rows = int(os.environ.get("BENCH_MESH_ROWS", "200000"))
+    rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(n_rows)]
+
+    def in_process() -> float:
+        scope = Scope()
+        sess = scope.input_session(2)
+        scope.group_by_table(
+            sess,
+            by_cols=[0],
+            reducers=[
+                (make_reducer(ReducerKind.SUM), [1]),
+                (make_reducer(ReducerKind.COUNT), []),
+            ],
+        )
+        sched = Scheduler(scope)
+        for key, row in rows:
+            sess.insert(key, row)
+        return timed(sched.commit)
+
+    def sharded_in_process() -> float:
+        """Same 2-worker columnar exchange WITHOUT the wire: the apples-
+        to-apples baseline the mesh's serialization overhead is judged
+        against (single-scope above measures sharding + wire together)."""
+        from pathway_tpu.engine.sharded import ShardedScheduler
+
+        scopes, sessions = [], []
+        for _w in range(2):
+            scope = Scope()
+            sess = scope.input_session(2)
+            scope.group_by_table(
+                sess,
+                by_cols=[0],
+                reducers=[
+                    (make_reducer(ReducerKind.SUM), [1]),
+                    (make_reducer(ReducerKind.COUNT), []),
+                ],
+            )
+            scopes.append(scope)
+            sessions.append(sess)
+        sched = ShardedScheduler(scopes)
+        for key, row in rows:
+            sessions[0].insert(key, row)
+        return timed(sched.commit)
+
+    t_in = min(in_process() for _ in range(2))
+    t_sharded = min(sharded_in_process() for _ in range(2))
+    t_col = min(_mesh_groupby_once(True, n_rows) for _ in range(2))
+    t_row = min(_mesh_groupby_once(False, n_rows) for _ in range(2))
+    return {
+        "workload": "mesh_groupby",
+        "rows": n_rows,
+        "columnar_mesh_rows_per_sec": round(n_rows / t_col),
+        "row_pickle_mesh_rows_per_sec": round(n_rows / t_row),
+        "in_process_rows_per_sec": round(n_rows / t_in),
+        "sharded_in_process_rows_per_sec": round(n_rows / t_sharded),
+        "columnar_vs_row_pickle_speedup": round(t_row / t_col, 2),
+        "mesh_overhead_vs_sharded": round(t_col / t_sharded, 2),
+        "mesh_overhead_vs_in_process": round(t_col / t_in, 2),
+    }
+
+
+def run_all(emit=None) -> dict:
     """One pass over every workload -> {name: rows_per_sec}; consumed by
     bench.py so the dataflow line is tracked in BENCH_r{N}.json every
-    round (VERDICT r2 #2)."""
+    round (VERDICT r2 #2). ``emit(name, value)`` fires as each leg
+    finishes, so a wall-budget abort still reports the completed legs."""
     out = {}
+
+    def record(name, value):
+        out[name] = value
+        if emit is not None:
+            emit(name, value)
+
     for name, make in (
         ("groupby_sum", groupby_sum),
         ("filter_expr", filter_expr),
         ("wordcount", wordcount),
     ):
         run = make()
-        out[name] = round(N / min(run() for _ in range(2)))
+        record(name, round(N / min(run() for _ in range(2))))
     run = join_inner()
-    out["join_inner"] = round((N // 2 + 50_000) / min(run() for _ in range(2)))
-    run = join_multikey()
-    out["join_multikey"] = round(
-        (N // 2 + 50_000) / min(run() for _ in range(2))
+    record(
+        "join_inner", round((N // 2 + 50_000) / min(run() for _ in range(2)))
     )
-    out["incremental_update"] = incremental_update()()
+    run = join_multikey()
+    record(
+        "join_multikey",
+        round((N // 2 + 50_000) / min(run() for _ in range(2))),
+    )
+    record("incremental_update", incremental_update()())
+    if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
+        try:
+            leg = distributed_leg()
+        except Exception as exc:  # mesh trouble must not sink the host legs
+            record("mesh_groupby_error", repr(exc))
+        else:
+            record(
+                "mesh_groupby",
+                {k: v for k, v in leg.items() if k != "workload"},
+            )
     return out
 
 
@@ -281,6 +452,10 @@ def main() -> None:
             }
         )
     )
+    # distributed leg: dtype-tagged columnar frames vs pickled row entries
+    # over a real 2-process loopback TCP mesh
+    if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
+        print(json.dumps(distributed_leg()))
 
 
 if __name__ == "__main__":

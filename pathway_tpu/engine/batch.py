@@ -24,6 +24,7 @@ unless a sink or a state read asks for them.
 
 from __future__ import annotations
 
+import operator
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
@@ -397,6 +398,50 @@ class DeltaBatch:
 
     def negated(self) -> "DeltaBatch":
         return DeltaBatch((key, row, -diff) for key, row, diff in self.entries)
+
+
+def columnarize_entries(batch: DeltaBatch) -> DeltaBatch | None:
+    """Columnar twin of a consolidated insert-only row batch, or None.
+
+    Sources flush row entries, but the exchange seams (engine/sharded.py,
+    engine/distributed.py) route and serialize arrays: one columnarisation
+    pass here lets a bulk source commit take the vectorized routing kernel
+    and the dtype-tagged wire frames instead of per-row hashing and row
+    pickles. Requires Pointer keys and uniform row arity; mixed-type
+    columns degrade to exact-object arrays (still key-routable, though not
+    wire-frame encodable). ``consolidated + insert_only`` is demanded up
+    front because ``from_columns`` asserts those invariants.
+    """
+    if not (batch._consolidated and batch._insert_only):
+        return None
+    entries = batch._entries
+    if not entries:
+        return None
+    # all-C arity scan (map/set run the loop without Python frames): a
+    # ragged batch must stay row-form — the columnar twin would silently
+    # truncate long rows to the first row's arity
+    if len(set(map(len, map(operator.itemgetter(1), entries)))) != 1:
+        return None
+    arity = len(entries[0][1])
+    kb = None
+    if _native is not None:
+        kb = _native.entry_keys_bytes(entries, Pointer)
+    else:
+        if all(type(e[0]) is Pointer for e in entries):
+            buf = b"".join(
+                int(e[0]).to_bytes(16, "little") for e in entries
+            )
+            kb = np.frombuffer(buf, np.uint8).reshape(len(entries), 16)
+    if kb is None:
+        return None  # non-Pointer keys: row path
+    from pathway_tpu.engine import device
+
+    view = device.ColumnarView(entries, from_entries=True)
+    return DeltaBatch.from_columns(
+        Columns(len(entries), device.materialize_columns(view, arity), kbytes=kb),
+        consolidated=True,
+        insert_only=True,
+    )
 
 
 def apply_batch_to_state(state: dict[Pointer, tuple], batch: DeltaBatch) -> None:

@@ -510,19 +510,28 @@ class DsvFormatter(Formatter):
     def __init__(self, delimiter: str = ",") -> None:
         self.delimiter = delimiter
 
+    @staticmethod
+    def _row(out: _io.StringIO) -> str:
+        # keep the default \r\n lineterminator while writing: the csv
+        # module only quotes fields containing the delimiter, quotechar or
+        # lineterminator characters, so with lineterminator="" an embedded
+        # newline would be emitted RAW and split the record. Strip the
+        # terminator afterwards (FileWriter adds its own "\n").
+        return out.getvalue().rstrip("\r\n")
+
     def header(self, column_names: Sequence[str]) -> str:
         out = _io.StringIO()
-        _csv.writer(out, delimiter=self.delimiter, lineterminator="").writerow(
+        _csv.writer(out, delimiter=self.delimiter).writerow(
             list(column_names) + ["time", "diff"]
         )
-        return out.getvalue()
+        return self._row(out)
 
     def format(self, key, values, column_names, time, diff):
         out = _io.StringIO()
-        _csv.writer(out, delimiter=self.delimiter, lineterminator="").writerow(
+        _csv.writer(out, delimiter=self.delimiter).writerow(
             [_plain(v) for v in values] + [time, diff]
         )
-        return out.getvalue()
+        return self._row(out)
 
 
 class FileWriter:

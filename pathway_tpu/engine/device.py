@@ -80,6 +80,27 @@ class ColumnarView:
         return arr
 
 
+def materialize_columns(view: ColumnarView, arity: int) -> list[np.ndarray]:
+    """Every column of an entry view as an array — clean dtypes where
+    extraction succeeds, an exact-object array otherwise (never None).
+    Object columns keep the original Python values, so a row round-trip
+    through ``Columns.to_entries`` is lossless."""
+    cols = []
+    rows = view.rows
+    for c in range(arity):
+        col = view.column(c)
+        if col is None:
+            arr = np.empty(view.n, object)
+            arr[:] = (
+                [e[1][c] for e in rows]
+                if view._entries
+                else [r[c] for r in rows]
+            )
+            col = arr
+        cols.append(col)
+    return cols
+
+
 _MISSING = object()
 
 

@@ -42,7 +42,15 @@ import threading
 import time as _walltime
 from typing import Any, Sequence
 
-from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
+import numpy as np
+
+from pathway_tpu.engine.batch import (
+    Columns,
+    DeltaBatch,
+    apply_batch_to_state,
+    columnarize_entries,
+)
+from pathway_tpu.engine.device import VECTOR_THRESHOLD
 from pathway_tpu.engine.graph import (
     ErrorLogNode,
     InputSession,
@@ -50,7 +58,8 @@ from pathway_tpu.engine.graph import (
     Scope,
     StaticSource,
 )
-from pathway_tpu.engine.sharded import _shard_of, partitioner
+from pathway_tpu.engine.routing import columnar_shards
+from pathway_tpu.engine.sharded import _shard_of, partition_rule, partitioner
 from pathway_tpu.engine.value import Pointer
 
 _LEN = struct.Struct(">Q")
@@ -81,6 +90,116 @@ def _mesh_secret() -> bytes:
 #: how long a process waits for a peer frame before declaring the run dead
 RECV_TIMEOUT = float(os.environ.get("PATHWAY_EXCHANGE_TIMEOUT", "600"))
 _CONNECT_DEADLINE = 60.0
+
+
+# ---------------------------------------------------------------------------
+# Columnar wire frames
+# ---------------------------------------------------------------------------
+
+#: kill-switch (and the bench's row-pickle baseline): "0" forces every
+#: exchange back onto pickled row entries
+COLUMNAR_EXCHANGE = os.environ.get(
+    "PATHWAY_EXCHANGE_COLUMNAR", "1"
+).lower() not in ("0", "false")
+
+#: probe counters for tests/benchmarks: columnar frames this process
+#: encoded for / decoded from remote peers, and row-entry deliveries that
+#: took the pickle fallback. tests/test_shard_routing.py asserts the
+#: columnar path engaged cross-process through these.
+EXCHANGE_STATS = {
+    "columnar_frames_sent": 0,
+    "columnar_frames_received": 0,
+    "row_batches_sent": 0,
+}
+
+_FRAME_MAGIC = b"PWCF"
+_FRAME_VERSION = 1
+_U8 = struct.Struct("<B")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def _frame_encodable(columns: Columns) -> bool:
+    """True when every data column is a fixed-width clean dtype whose raw
+    C-order buffer round-trips (bool/int/uint/float/unicode/datetime).
+    Object columns (mixed types, tuples, Json) take the pickled-entry
+    fallback instead."""
+    return all(c.dtype.kind not in "OV" for c in columns.cols)
+
+
+def encode_columns_frame(columns: Columns) -> bytes | None:
+    """Dtype-tagged columnar frame — the wire form of a ``Columns``
+    payload; no row is ever materialised or pickled.
+
+    Layout (integers little-endian; every variable block length-prefixed):
+
+        magic b"PWCF" | version u8 | flags u8 | n_rows u32 | n_cols u32
+        key block: n_rows x 16 raw little-endian key bytes
+        diff block (flags & 1): n_rows x int64
+        per column: u8 tag length + ascii numpy ``dtype.str`` tag,
+                    u64 buffer length + raw C-order column buffer
+
+    Returns ``None`` when the payload cannot be represented (object-dtype
+    column, key derivation failure) — callers fall back to row entries.
+    The transport length-prefixes and HMACs the enclosing mesh frame, so
+    this buffer needs no own authentication.
+    """
+    if not _frame_encodable(columns):
+        return None
+    try:
+        kb = np.ascontiguousarray(columns.kbytes(), np.uint8)
+    except Exception:  # lazy key thunk failed: row path derives the keys
+        return None
+    diffs = columns.diffs
+    parts = [
+        _FRAME_MAGIC,
+        _U8.pack(_FRAME_VERSION),
+        _U8.pack(1 if diffs is not None else 0),
+        _U32.pack(columns.n),
+        _U32.pack(len(columns.cols)),
+        kb.tobytes(),
+    ]
+    if diffs is not None:
+        parts.append(np.ascontiguousarray(diffs, np.int64).tobytes())
+    for col in columns.cols:
+        tag = col.dtype.str.encode("ascii")
+        buf = np.ascontiguousarray(col).tobytes()
+        parts.append(_U8.pack(len(tag)))
+        parts.append(tag)
+        parts.append(_U64.pack(len(buf)))
+        parts.append(buf)
+    return b"".join(parts)
+
+
+def decode_columns_frame(frame: bytes) -> Columns:
+    """Inverse of :func:`encode_columns_frame`; arrays are zero-copy views
+    into the frame buffer (batch payloads are immutable downstream)."""
+    if frame[:4] != _FRAME_MAGIC:
+        raise ValueError("bad columnar frame magic")
+    version = frame[4]
+    if version != _FRAME_VERSION:
+        raise ValueError(f"unsupported columnar frame version {version}")
+    flags = frame[5]
+    (n,) = _U32.unpack_from(frame, 6)
+    (ncols,) = _U32.unpack_from(frame, 10)
+    pos = 14
+    kb = np.frombuffer(frame, np.uint8, n * 16, pos).reshape(n, 16)
+    pos += n * 16
+    diffs = None
+    if flags & 1:
+        diffs = np.frombuffer(frame, np.int64, n, pos)
+        pos += n * 8
+    cols = []
+    for _ in range(ncols):
+        tlen = frame[pos]
+        pos += 1
+        dt = np.dtype(frame[pos : pos + tlen].decode("ascii"))
+        pos += tlen
+        (blen,) = _U64.unpack_from(frame, pos)
+        pos += 8
+        cols.append(np.frombuffer(frame, dt, n, pos))
+        pos += blen
+    return Columns(n, cols, kbytes=kb, diffs=diffs)
 
 
 def default_addresses(n_processes: int, first_port: int) -> list[tuple[str, int]]:
@@ -446,9 +565,57 @@ class DistributedScheduler:
         consolidated: bool,
         insert_only: bool = False,
     ) -> None:
+        if kind == "push":
+            EXCHANGE_STATS["row_batches_sent"] += 1
         self._outbox[process].append(
             (kind, index, port_or_worker, worker, entries, consolidated,
              insert_only)
+        )
+
+    def _push_remote_columnar(
+        self,
+        process: int,
+        kind: str,
+        index: int,
+        port_or_worker: int,
+        worker: int,
+        frame: bytes,
+        consolidated: bool,
+        insert_only: bool,
+        raw_insert_only: bool,
+    ) -> None:
+        EXCHANGE_STATS["columnar_frames_sent"] += 1
+        self._outbox[process].append(
+            (kind, index, port_or_worker, worker, frame, consolidated,
+             insert_only, raw_insert_only)
+        )
+
+    def _push_remote_batch(
+        self,
+        process: int,
+        cons_idx: int,
+        port: int,
+        worker: int,
+        out: DeltaBatch,
+    ) -> None:
+        """Ship a WHOLE batch to one remote worker: a columnar frame when
+        the payload allows it, pickled row entries otherwise."""
+        if (
+            COLUMNAR_EXCHANGE
+            and out._entries is None
+            and out.columns is not None
+        ):
+            frame = encode_columns_frame(out.columns)
+            if frame is not None:
+                self._push_remote_columnar(
+                    process, "cpush", cons_idx, port, worker, frame,
+                    out._consolidated, out._insert_only,
+                    out._raw_insert_only,
+                )
+                return
+        self._push_remote(
+            process, "push", cons_idx, port, worker, out.entries,
+            out._consolidated, out._insert_only,
         )
 
     def _local_push(
@@ -473,10 +640,7 @@ class DistributedScheduler:
         # local replica); remote processes route from the broadcast topology.
         if self.process_id != 0:
             for cons_idx, port in self.extra_consumers.get(producer.index, ()):
-                self._push_remote(
-                    0, "push", cons_idx, port, 0, out.entries,
-                    out._consolidated, out._insert_only,
-                )
+                self._push_remote_batch(0, cons_idx, port, 0, out)
 
     def _route_part(
         self,
@@ -492,11 +656,20 @@ class DistributedScheduler:
             if self.process_id == 0:
                 self.scopes[0].nodes[cons_idx].push(port, out)
             else:
-                self._push_remote(
-                    0, "push", cons_idx, port, 0, out.entries,
-                    out._consolidated, out._insert_only,
-                )
+                self._push_remote_batch(0, cons_idx, port, 0, out)
             return
+        if (
+            COLUMNAR_EXCHANGE
+            and out._entries is None
+            and out.columns is not None
+        ):
+            shards = columnar_shards(
+                partition_rule(consumer, port), out.columns, self.n_workers
+            )
+            if shards is not None and self._route_columnar(
+                cons_idx, port, out, shards
+            ):
+                return
         fn = self._partition_fn(consumer, port)
         parts: list[list] = [[] for _ in range(self.n_workers)]
         for key, row, diff in out:
@@ -516,18 +689,86 @@ class DistributedScheduler:
                     out._consolidated, out._insert_only,
                 )
 
+    def _route_columnar(
+        self,
+        cons_idx: int,
+        port: int,
+        out: DeltaBatch,
+        shards: np.ndarray,
+    ) -> bool:
+        """Route a columnar batch by a precomputed shard vector: local
+        shards push gathered ``Columns`` (no serialization at all), remote
+        shards ship dtype-tagged frames. Returns False — with NO pushes
+        performed — when some shard must go remote but the payload cannot
+        frame-encode, so the caller's row path handles the whole batch."""
+        cols = out.columns
+        workers = np.unique(shards).tolist()
+        if any(
+            self._owner(w)[0] != self.process_id for w in workers
+        ):
+            if not _frame_encodable(cols):
+                return False
+            try:
+                cols.kbytes()  # force lazy keys BEFORE any local push
+            except Exception:
+                return False
+        for worker in workers:
+            idx = np.flatnonzero(shards == worker)
+            part = cols.gather(idx)
+            process, scope_idx = self._owner(worker)
+            if process == self.process_id:
+                batch = DeltaBatch.from_columns(
+                    part,
+                    consolidated=out._consolidated,
+                    insert_only=out._insert_only,
+                )
+                batch._raw_insert_only = out._raw_insert_only
+                self.scopes[scope_idx].nodes[cons_idx].push(port, batch)
+            else:
+                frame = encode_columns_frame(part)
+                assert frame is not None  # encodability proven above
+                self._push_remote_columnar(
+                    process, "cpush", cons_idx, port, worker, frame,
+                    out._consolidated, out._insert_only,
+                    out._raw_insert_only,
+                )
+        return True
+
     def _apply_remote(self, deliveries: list[tuple]) -> bool:
         got = False
-        for (
-            kind, index, port_or_worker, worker, entries, consolidated,
-            insert_only,
-        ) in deliveries:
+        for delivery in deliveries:
             got = True
+            kind = delivery[0]
+            if kind in ("cpush", "cstate"):
+                (
+                    _kind, index, port_or_worker, worker, frame,
+                    consolidated, insert_only, raw_insert_only,
+                ) = delivery
+                EXCHANGE_STATS["columnar_frames_received"] += 1
+                _process, scope_idx = self._owner(worker)
+                batch = DeltaBatch.from_columns(
+                    decode_columns_frame(frame),
+                    consolidated=consolidated,
+                    insert_only=insert_only,
+                )
+                batch._raw_insert_only = raw_insert_only
+                if kind == "cstate":
+                    # lazy replica-state apply: rows materialise only if a
+                    # state-peeking consumer actually reads this replica
+                    self.scopes[scope_idx].nodes[index]._defer_state(batch)
+                else:
+                    self.scopes[scope_idx].nodes[index].push(
+                        port_or_worker, batch
+                    )
+                continue
+            (
+                kind, index, port_or_worker, worker, entries, consolidated,
+                insert_only,
+            ) = delivery
             _process, scope_idx = self._owner(worker)
             if kind == "state":
-                apply_batch_to_state(
-                    self.scopes[scope_idx].nodes[index].current,
-                    DeltaBatch(entries),
+                self.scopes[scope_idx].nodes[index]._defer_state(
+                    DeltaBatch(entries)
                 )
             else:
                 self._local_push(
@@ -554,7 +795,10 @@ class DistributedScheduler:
                     if out is None:
                         out = DeltaBatch()
                     out = out.consolidate() if out else out
-                    apply_batch_to_state(node.current, out)
+                    # defer like the sharded scheduler: an eager apply
+                    # would materialise columnar batches into rows before
+                    # the vectorized exchange ships them
+                    node._defer_state(out)
                     if out:
                         self._deliver(node, out)
             if did:
@@ -588,10 +832,26 @@ class DistributedScheduler:
                 continue
             if not batch:
                 continue
-            # full state on the primary replica
-            apply_batch_to_state(node.current, batch)
+            # full state on the primary replica (lazily — the property
+            # drains before anything reads it; sharded.py defers the same)
+            node._defer_state(batch)
+            if (
+                COLUMNAR_EXCHANGE
+                and batch._entries is not None
+                and len(batch) >= VECTOR_THRESHOLD
+            ):
+                # bulk source commits enter the exchange as arrays: the
+                # replica sharding and every consumer route below then run
+                # the vectorized kernel + wire frames, not per-row hashing
+                # (static sources arrive raw — consolidate first, since
+                # the columnar twin asserts unique-key +1 invariants)
+                cbatch = columnarize_entries(batch.consolidate())
+                if cbatch is not None:
+                    batch = cbatch
             # key-shard parts maintain replica state on workers > 0
-            if self.n_workers > 1:
+            if self.n_workers > 1 and not self._replicate_source_columnar(
+                node, batch
+            ):
                 parts: list[list] = [[] for _ in range(self.n_workers)]
                 for key, row, diff in batch:
                     parts[_shard_of(key, self.n_workers)].append((key, row, diff))
@@ -600,16 +860,54 @@ class DistributedScheduler:
                         continue
                     process, scope_idx = self._owner(worker)
                     if process == self.process_id:
-                        apply_batch_to_state(
-                            self.scopes[scope_idx].nodes[node.index].current,
-                            DeltaBatch(parts[worker]),
-                        )
+                        self.scopes[scope_idx].nodes[
+                            node.index
+                        ]._defer_state(DeltaBatch(parts[worker]))
                     else:
                         self._push_remote(
                             process, "state", node.index, 0, worker,
                             parts[worker], batch._consolidated,
                         )
             self._deliver(node, batch)
+
+    def _replicate_source_columnar(
+        self, node: Node, batch: DeltaBatch
+    ) -> bool:
+        """Key-shard the source batch for replica state WITHOUT building
+        per-row entries: same routing kernel, ``("key",)`` rule, state
+        frames on the wire. False = caller runs the row loop."""
+        if not (
+            COLUMNAR_EXCHANGE
+            and batch._entries is None
+            and batch.columns is not None
+        ):
+            return False
+        shards = columnar_shards(("key",), batch.columns, self.n_workers)
+        if shards is None:
+            return False
+        cols = batch.columns
+        workers = [w for w in np.unique(shards).tolist() if w != 0]
+        if any(
+            self._owner(w)[0] != self.process_id for w in workers
+        ) and not _frame_encodable(cols):
+            return False
+        for worker in workers:
+            part = cols.gather(np.flatnonzero(shards == worker))
+            process, scope_idx = self._owner(worker)
+            if process == self.process_id:
+                self.scopes[scope_idx].nodes[node.index]._defer_state(
+                    DeltaBatch.from_columns(
+                        part, consolidated=batch._consolidated
+                    )
+                )
+            else:
+                frame = encode_columns_frame(part)
+                assert frame is not None  # encodability proven above
+                self._push_remote_columnar(
+                    process, "cstate", node.index, 0, worker, frame,
+                    batch._consolidated, False, False,
+                )
+        return True
 
     def _mark_replica_sources(self) -> None:
         """Non-primary replicas never emit static rows themselves

@@ -1412,6 +1412,13 @@ class JoinNode(Node):
 
         out = DeltaBatch()
         freed: list[Pointer] = []
+        # custom-id joins must visit groups deterministically: with
+        # duplicate result ids the winner is the first group PROCESSED,
+        # and set order is per-process hash order (str hashes are salted)
+        # — sorting pins the winner across runs, processes and insertion
+        # orders
+        if self.id_spec is not None:
+            affected = sorted(affected, key=repr)
         for jk in affected:
             old = old_local[jk]
             new = self._local_output(jk)
@@ -1878,7 +1885,13 @@ class GroupbyNode(Node):
         self.set_id = set_id
         # instance groupbys derive ids like ref_scalar(*vals, instance=i)
         # (salt=b"inst", engine/value.py:377-381) so pointer_from with
-        # instance= addresses the groups
+        # instance= addresses the groups.
+        # COMPAT: earlier builds salted every group id with b"groupby";
+        # those keys are unreachable under the current derivation, so an
+        # operator snapshot written by such a build must be REJECTED at
+        # restore, never loaded — persistence.py guards this with
+        # STATE_FORMAT (restoring would strand every persisted group
+        # under a key no new row can ever touch).
         self._gkey_salt = b"inst" if instance_last else b""
         # gkey -> [by_vals, [reducer states], membership count]
         self._groups: dict[Pointer, list[Any]] = {}

@@ -214,6 +214,18 @@ class PersistentDriver:
 # -- operator snapshots -------------------------------------------------------
 
 
+#: Bumped whenever operator STATE derivation changes incompatibly — not
+#: just the operator sequence (the class-name signature guards that).
+#: Format 2: groupby group ids derive with salt b""/b"inst"
+#: (ref_scalar-compatible; graph.py GroupbyNode._gkey_salt). Format 1
+#: (implicit — older payloads carry no "format" key) salted group ids
+#: with b"groupby"; its persisted groupby keys are unreachable under the
+#: current derivation, so restoring one would strand every group under a
+#: key no new row can touch while fresh rows silently rebuild duplicates
+#: beside it. Stale snapshots are therefore REJECTED at restore.
+STATE_FORMAT = 2
+
+
 class OperatorSnapshotManager:
     """PersistenceMode.OPERATOR_PERSISTING: capture every operator's state
     at commit boundaries, restore it on startup and seek readers — no event
@@ -279,6 +291,7 @@ class OperatorSnapshotManager:
 
         scopes = self._scopes_of(scope)
         payload = {
+            "format": STATE_FORMAT,
             "sigs": [[type(n).__name__ for n in s.nodes] for s in scopes],
             "per_worker": [[n.op_state() for n in s.nodes] for s in scopes],
             "drivers": [self._driver_state(d) for d in drivers],
@@ -313,6 +326,14 @@ class OperatorSnapshotManager:
             payload = _pickle.loads(raw)
         except Exception:  # truncated/corrupt snapshot: cold start
             return None
+        fmt = payload.get("format", 1)
+        if fmt != STATE_FORMAT:
+            raise ValueError(
+                f"operator snapshot has state format {fmt}; this build "
+                f"writes format {STATE_FORMAT} (group-id salt change): "
+                "restoring would resurrect state under stale keys — clear "
+                "the persistence location or replay an input journal"
+            )
         scopes = self._scopes_of(scope)
         if "per_worker" in payload:
             sigs = payload["sigs"]

@@ -1,0 +1,162 @@
+"""Pure shard-routing kernel: vectorized worker assignment for columnar batches.
+
+Extracted from the sharded scheduler so the routing math is directly
+testable and shared by BOTH exchange paths — the in-process lockstep
+scheduler (engine/sharded.py) and the multiprocess TCP mesh
+(engine/distributed.py) call the same :func:`columnar_shards`, so a row can
+never land on a different worker depending on which transport carried it.
+
+The contract mirrors the reference's exchange pacts (timely exchange
+channels partition records by a hash of the key, never a per-row
+interpreted loop): given a partition rule from
+:func:`pathway_tpu.engine.sharded.partition_rule` and a
+:class:`~pathway_tpu.engine.batch.Columns` payload, produce an int64 worker
+id per row — or ``None`` whenever the vectorized assignment cannot be
+digest-identical to the per-row partitioners, in which case the caller
+falls back to the row path. The kernel never raises on data it cannot
+handle; ``None`` IS the error channel.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Sequence
+
+import numpy as np
+
+from pathway_tpu.engine.value import Pointer, hash_values, hash_values_batch
+
+if TYPE_CHECKING:  # pragma: no cover
+    from pathway_tpu.engine.batch import Columns
+
+__all__ = [
+    "columnar_shards",
+    "mod_u128_bytes",
+    "shards_of_values",
+]
+
+
+def _shard_of(value: Any, n: int) -> int:
+    """Per-row worker assignment — THE definition of which worker owns a
+    value; everything vectorized below must agree with it bit for bit."""
+    if isinstance(value, Pointer):
+        return int(value) % n
+    try:
+        return int(hash_values((value,), salt=b"shard")) % n
+    except TypeError:
+        return int(hash_values((repr(value),), salt=b"shard")) % n
+
+
+def mod_u128_bytes(kb: np.ndarray, n: int) -> np.ndarray:
+    """Vectorized ``int.from_bytes(row, "little") % n`` over an ``(m, 16)``
+    uint8 matrix of little-endian 128-bit integers (key digests).
+
+    The halves fold via ``(hi * 2**64 + lo) % n ==
+    ((hi % n) * (2**64 % n) + lo % n) % n``; every intermediate stays below
+    ``n**2``, so the arithmetic is uint64-exact for any realistic worker
+    count (n < 2**32)."""
+    kb = np.ascontiguousarray(kb)
+    lo = kb[:, :8].copy().view(np.uint64).ravel()
+    hi = kb[:, 8:].copy().view(np.uint64).ravel()
+    nn = np.uint64(n)
+    base = np.uint64((1 << 64) % n)
+    return (((hi % nn) * base + lo % nn) % nn).astype(np.int64)
+
+
+def shards_of_values(values: Sequence[Any], n: int) -> np.ndarray:
+    """Batched ``_shard_of``: one :func:`hash_values_batch` call builds the
+    digest matrix for every non-Pointer value, one vectorized mod folds it
+    to worker ids. Callers pass DISTINCT representatives (factorize
+    output), so the remaining Python loop runs per distinct key inside a
+    single call — not per row on the exchange hot path."""
+    shards = np.empty(len(values), np.int64)
+    rows: list[tuple] = []
+    where: list[int] = []
+    for i, v in enumerate(values):
+        if isinstance(v, Pointer):
+            shards[i] = int(v) % n
+        else:
+            rows.append((v,))
+            where.append(i)
+    if rows:
+        kb = hash_values_batch(rows, salt=b"shard", on_type_error="repr")
+        shards[np.asarray(where, np.int64)] = mod_u128_bytes(kb, n)
+    return shards
+
+
+def _object_codes(col: np.ndarray) -> np.ndarray:
+    """Dense int64 codes for a non-sortable (object-dtype) column, keyed
+    by the value's hash_values DIGEST — the exact identity the per-row
+    partitioners use. Dict equality would be coarser (a tz-aware datetime
+    equals its rebased twin but digests differently), which could route
+    one logical key to different workers depending on which class member
+    a batch sees first.
+
+    One ``hash_values_batch`` call computes every digest; the codes come
+    from a single ``np.unique`` over the digest matrix. (Code order
+    differs from first-seen order, which is fine: ``factorize_multi``
+    consumes only the identity classes, never the code values.)"""
+    kb = hash_values_batch(
+        [(v,) for v in col.tolist()], on_type_error="repr"
+    )
+    _uniq, inverse = np.unique(kb, axis=0, return_inverse=True)
+    return inverse.ravel().astype(np.int64, copy=False)
+
+
+def columnar_shards(
+    rule: tuple, columns: "Columns", n: int
+) -> np.ndarray | None:
+    """Vectorized worker assignment for a columnar batch, or ``None`` when
+    the routing rule needs the row path.
+
+    Digest-identical to the per-row partitioners (engine/sharded.py):
+    row-key routing is the full 128-bit pointer mod n; column routing
+    hashes per DISTINCT value (``factorize_multi``) and maps back through
+    the inverse index. Fallback rules (→ ``None``, never an exception):
+
+    - ``("pin",)`` rules — the caller pushes the whole batch to worker 0
+      without consulting a shard table;
+    - float columns containing NaN — ``np.unique`` collapses
+      distinct-bit NaNs that the per-row digests keep apart;
+    - column dtypes outside bool/int/float/unicode/object;
+    - key-bytes derivation failure for ``("key",)`` batches.
+    """
+    kind = rule[0]
+    if kind in ("cols", "col"):
+        if kind == "cols":
+            idxs = list(rule[1])
+            if len(idxs) == 0:
+                return np.full(columns.n, _shard_of((), n), np.int64)
+            bare = False  # by_cols hashes the value TUPLE
+        else:
+            c = rule[1]
+            if c is None:
+                return np.full(columns.n, _shard_of(None, n), np.int64)
+            idxs = [c]
+            bare = True  # by_col hashes the bare value
+        from pathway_tpu.engine.device import factorize_multi
+
+        arrays = []
+        for c in idxs:
+            col = columns.cols[c]
+            if col.dtype.kind in "bifU":
+                if col.dtype.kind == "f" and np.isnan(col).any():
+                    return None
+                arrays.append(col)
+            elif col.dtype == object:
+                arrays.append(_object_codes(col))
+            else:
+                return None
+        first, inverse = factorize_multi(arrays)
+        reps = zip(*(columns.cols[c][first].tolist() for c in idxs))
+        if bare:
+            table = shards_of_values([t[0] for t in reps], n)
+        else:
+            table = shards_of_values(list(reps), n)
+        return table[inverse]
+    if kind != "key":
+        return None  # "pin" never reaches a shard table (fn is None earlier)
+    try:
+        kb = columns.kbytes()
+    except Exception:  # lazy key thunk failed: the row path derives keys
+        return None
+    return mod_u128_bytes(kb, n)

@@ -29,7 +29,12 @@ from __future__ import annotations
 
 from typing import Any, Callable, Sequence
 
-from pathway_tpu.engine.batch import DeltaBatch, apply_batch_to_state
+from pathway_tpu.engine.batch import (
+    DeltaBatch,
+    apply_batch_to_state,
+    columnarize_entries,
+)
+from pathway_tpu.engine.device import VECTOR_THRESHOLD
 from pathway_tpu.engine.graph import (
     DeduplicateNode,
     ErrorLogNode,
@@ -43,43 +48,18 @@ from pathway_tpu.engine.graph import (
     StaticSource,
     SubscribeNode,
 )
-from pathway_tpu.engine.value import Pointer, hash_values
+# the vectorized routing math lives in engine/routing.py; `_shard_of` and
+# `_object_codes` are re-exported here because the partitioner closures
+# below and older call sites (engine/distributed.py, tests) address them
+# through this module
+from pathway_tpu.engine.routing import (  # noqa: F401 — re-exports
+    _object_codes,
+    _shard_of,
+    columnar_shards,
+)
+from pathway_tpu.engine.value import Pointer
 
 Entry = tuple
-
-
-def _shard_of(value: Any, n: int) -> int:
-    if isinstance(value, Pointer):
-        return int(value) % n
-    try:
-        return int(hash_values((value,), salt=b"shard")) % n
-    except TypeError:
-        return int(hash_values((repr(value),), salt=b"shard")) % n
-
-
-def _object_codes(col) -> "Any":
-    """Dense int64 codes for a non-sortable (object-dtype) column, keyed
-    by the value's hash_values DIGEST — the exact identity the per-row
-    partitioners use. Dict equality would be coarser (a tz-aware
-    datetime equals its rebased twin but digests differently), which
-    could route one logical key to different workers depending on which
-    class member a batch sees first."""
-    import numpy as np
-
-    index: dict = {}
-    inverse = np.empty(len(col), np.int64)
-    n_codes = 0
-    for i, v in enumerate(col.tolist()):
-        try:
-            d = hash_values((v,))
-        except TypeError:
-            d = hash_values((repr(v),))
-        code = index.get(d)
-        if code is None:
-            code = index[d] = n_codes
-            n_codes += 1
-        inverse[i] = code
-    return inverse
 
 
 def partition_rule(consumer: Node, port: int) -> tuple:
@@ -354,16 +334,41 @@ class ShardedScheduler:
         - replicas w>0 keep their row-key shard, so consumers that peek at
           an input's ``current`` (zip/update/ix source side) find exactly
           the rows whose downstream parts they receive."""
+        if batch._entries is not None and len(batch) >= VECTOR_THRESHOLD:
+            # bulk source commits enter the exchange as arrays so the
+            # replica sharding and consumer routes below run the
+            # vectorized kernel, not a per-row hash loop (static sources
+            # arrive raw — consolidate first, since the columnar twin
+            # asserts unique-key +1 invariants)
+            cbatch = columnarize_entries(batch.consolidate())
+            if cbatch is not None:
+                batch = cbatch
         replica0 = self.scopes[0].nodes[node.index]
         replica0._defer_state(batch)
         if self.n > 1:
-            parts: list[list[Entry]] = [[] for _ in range(self.n)]
-            for key, row, diff in batch:
-                parts[_shard_of(key, self.n)].append((key, row, diff))
-            for w in range(1, self.n):
-                if parts[w]:
-                    replica = self.scopes[w].nodes[node.index]
-                    replica._defer_state(DeltaBatch(parts[w]))
+            shards = None
+            if batch._entries is None and batch.columns is not None:
+                shards = columnar_shards(("key",), batch.columns, self.n)
+            if shards is not None:
+                import numpy as np
+
+                for w in range(1, self.n):
+                    idx = np.flatnonzero(shards == w)
+                    if len(idx):
+                        self.scopes[w].nodes[node.index]._defer_state(
+                            DeltaBatch.from_columns(
+                                batch.columns.gather(idx),
+                                consolidated=batch._consolidated,
+                            )
+                        )
+            else:
+                parts: list[list[Entry]] = [[] for _ in range(self.n)]
+                for key, row, diff in batch:
+                    parts[_shard_of(key, self.n)].append((key, row, diff))
+                for w in range(1, self.n):
+                    if parts[w]:
+                        replica = self.scopes[w].nodes[node.index]
+                        replica._defer_state(DeltaBatch(parts[w]))
         self._deliver(0, replica0, batch)
 
     def finish(self) -> None:

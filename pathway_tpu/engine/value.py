@@ -34,6 +34,7 @@ __all__ = [
     "DateTimeNaive",
     "DateTimeUtc",
     "hash_values",
+    "hash_values_batch",
     "ref_scalar",
     "unsafe_make_pointer",
     "value_type_of",
@@ -319,14 +320,19 @@ def _feed(h: "hashlib._Hash", value: Any) -> None:
         _feed(h, repr(value))
 
 
-def hash_values(values: Iterable[Any], *, salt: bytes = b"") -> Pointer:
-    """Stable 128-bit key from a sequence of values (Key::for_values analog).
+#: pre-personalized hasher, cloned per digest — blake2b parameter-block
+#: construction costs more than copy(), and every key derivation pays it
+_BASE_HASHER = hashlib.blake2b(digest_size=16, person=b"pw-tpu-key")
+
+
+def _digest16(values: Iterable[Any], salt: bytes) -> bytes:
+    """The 16-byte little-endian digest behind :func:`hash_values`.
 
     Digest-identical fast path: common scalar types append to one buffer
     flushed in a single ``update`` (join/groupby key derivation calls this
     per output row — the per-value ``_feed`` dispatch dominated join time).
     """
-    h = hashlib.blake2b(digest_size=16, person=b"pw-tpu-key")
+    h = _BASE_HASHER.copy()
     buf = bytearray(salt)
     for value in values:
         t = type(value)
@@ -361,7 +367,48 @@ def hash_values(values: Iterable[Any], *, salt: bytes = b"") -> Pointer:
             _feed(h, value)
     if buf:
         h.update(bytes(buf))
-    return Pointer(int.from_bytes(h.digest(), "little"))
+    return h.digest()
+
+
+def hash_values(values: Iterable[Any], *, salt: bytes = b"") -> Pointer:
+    """Stable 128-bit key from a sequence of values (Key::for_values analog)."""
+    return Pointer(int.from_bytes(_digest16(values, salt), "little"))
+
+
+def hash_values_batch(
+    rows: "Iterable[Iterable[Any]]",
+    *,
+    salt: bytes = b"",
+    on_type_error: str = "raise",
+) -> np.ndarray:
+    """Digest matrix for many value tuples in ONE call: row ``i`` of the
+    returned ``(len(rows), 16)`` uint8 array is the little-endian digest of
+    ``hash_values(rows[i], salt=salt)``.
+
+    The shard-routing kernel (engine/routing.py) feeds DISTINCT key
+    representatives through here, so routing an object column hashes once
+    per call instead of once per row at Python-closure granularity, and the
+    byte matrix flows straight into the vectorized 128-bit mod
+    (routing.mod_u128_bytes) without boxing a Pointer per value.
+
+    ``on_type_error="repr"`` re-hashes ``repr`` of the row's values when a
+    digest raises TypeError — the exact fallback the per-row partitioners
+    (sharded._shard_of) use, kept here so batch and scalar paths cannot
+    drift.
+    """
+    repr_fallback = on_type_error == "repr"
+    out = bytearray()
+    n = 0
+    for row in rows:
+        try:
+            d = _digest16(row, salt)
+        except TypeError:
+            if not repr_fallback:
+                raise
+            d = _digest16(tuple(repr(v) for v in row), salt)
+        out += d
+        n += 1
+    return np.frombuffer(bytes(out), np.uint8).reshape(n, 16)
 
 
 def _hash_values_slow(values: Iterable[Any], *, salt: bytes = b"") -> Pointer:

@@ -178,11 +178,12 @@ def knn_search_sharded(
     spec_state = DeviceKnnState(
         vectors=P(DATA_AXIS, None), valid=P(DATA_AXIS), norms=P(DATA_AXIS)
     )
-    fn = jax.shard_map(
+    from pathway_tpu.parallel.sharding import shard_map_norep
+
+    fn = shard_map_norep(
         local,
         mesh=mesh,
         in_specs=(spec_state, P()),
         out_specs=(P(), P()),
-        check_vma=False,
     )
     return fn(state, queries)

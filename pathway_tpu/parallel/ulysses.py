@@ -146,10 +146,11 @@ def ulysses_attention_sharded(
             k_valid=valid,
         )
 
-    return jax.shard_map(
+    from pathway_tpu.parallel.sharding import shard_map_norep
+
+    return shard_map_norep(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec, None if k_valid is None else mask_spec),
         out_specs=spec,
-        check_vma=False,
     )(q, k, v, k_valid)

@@ -192,6 +192,38 @@ class TestJoinDepth:
         s1, s2 = run_tables(j1, j2)
         assert set(s1.keys()) == set(s2.keys())
 
+    def test_duplicate_custom_join_id_winner_insertion_order_independent(
+        self,
+    ):
+        """Two rows in DIFFERENT join-key groups claim the same custom
+        result id: exactly one survives, and which one must not depend on
+        the order the rows were inserted — group visitation is repr-sorted,
+        so the k=1 group wins in every run, process and insertion order."""
+
+        def run(rows):
+            left = pw.debug.table_from_rows(
+                pw.schema_from_types(k=int, tag=str), rows
+            )
+            keyed = left.select(
+                k=pw.this.k,
+                tag=pw.this.tag,
+                # every row claims the SAME result id
+                rid=left.pointer_from(pw.this.k * 0),
+            )
+            right = pw.debug.table_from_rows(
+                pw.schema_from_types(k=int), [(1,), (2,)]
+            )
+            j = keyed.join(
+                right, keyed.k == right.k, id=keyed.rid
+            ).select(keyed.tag)
+            (snap,) = run_tables(j)
+            return sorted(snap.values())
+
+        rows = [(1, "first"), (2, "second")]
+        winner = run(rows)
+        assert winner == [("first",)]  # repr-least join key owns the id
+        assert run(list(reversed(rows))) == winner
+
 
 # -- reducers under retraction ------------------------------------------------
 

@@ -245,6 +245,47 @@ class TestStateRoundTrips:
         with pytest.raises(ValueError, match="operator snapshot"):
             mgr.restore(scope2, [])
 
+    def test_stale_state_format_checkpoint_rejected(self, tmp_path):
+        """Group ids changed salt (implicit ``b"groupby"`` -> explicit
+        instance salt), so a pre-change checkpoint would resurrect reducer
+        state under keys no current dataflow ever emits — silently frozen
+        aggregates. Restore must refuse such checkpoints loudly."""
+        import pickle
+
+        import pytest
+
+        from pathway_tpu.engine.graph import Scope
+        from pathway_tpu.engine.persistence import (
+            STATE_FORMAT,
+            OperatorSnapshotManager,
+        )
+
+        backend = Backend.filesystem(str(tmp_path / "s"))
+        mgr = OperatorSnapshotManager(backend)
+        scope1 = Scope()
+        scope1.input_session(1)
+        mgr.snapshot(scope1, [], 1)
+
+        # age the checkpoint: format 1 = the implicit-salt era; older
+        # payloads carry no "format" key at all, which reads as 1
+        payload = pickle.loads(backend.read(mgr.name))
+        assert payload["format"] == STATE_FORMAT
+        del payload["format"]
+        backend.write(mgr.name, pickle.dumps(payload, protocol=4))
+
+        scope2 = Scope()
+        scope2.input_session(1)
+        with pytest.raises(ValueError, match="state format 1"):
+            mgr.restore(scope2, [])
+
+        # a same-format checkpoint still restores fine (guard is not
+        # rejecting everything)
+        payload["format"] = STATE_FORMAT
+        backend.write(mgr.name, pickle.dumps(payload, protocol=4))
+        scope3 = Scope()
+        scope3.input_session(1)
+        assert mgr.restore(scope3, []) == 1
+
 
 _WORKER = r"""
 import os, sys

@@ -1,0 +1,418 @@
+"""The vectorized shard-routing kernel vs the per-row partitioners.
+
+`engine/routing.py` is THE worker-assignment contract: both exchange paths
+(in-process lockstep, multiprocess TCP mesh) call `columnar_shards`, and a
+row must land on the same worker no matter which transport carried it or
+whether the batch travelled as arrays or entries. These tests pin the
+kernel bit-for-bit against `_shard_of` — the scalar definition — over
+adversarial dtypes, then prove the columnar frame path actually engages
+across a real 3-process mesh with output identical to a single process.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.batch import Columns, DeltaBatch, columnarize_entries
+from pathway_tpu.engine.routing import (
+    _object_codes,
+    _shard_of,
+    columnar_shards,
+    mod_u128_bytes,
+    shards_of_values,
+)
+from pathway_tpu.engine.value import (
+    Json,
+    Pointer,
+    hash_values,
+    hash_values_batch,
+    ref_scalar,
+)
+
+NS = (2, 3, 4, 7)
+
+
+def _columns(cols: list[np.ndarray], keys: list[Pointer]) -> Columns:
+    assert all(len(c) == len(keys) for c in cols)
+    return Columns(len(keys), cols, kobjs=keys)
+
+
+def _obj(values: list) -> np.ndarray:
+    arr = np.empty(len(values), object)
+    arr[:] = values
+    return arr
+
+
+def _rows_of(columns: Columns) -> list[tuple]:
+    """Rows exactly as a row-path consumer would see them (to_entries)."""
+    return [r for _k, r, _d in DeltaBatch.from_columns(columns).entries]
+
+
+def _expect_cols(columns: Columns, cols: list[int], n: int) -> list[int]:
+    return [
+        _shard_of(tuple(row[c] for c in cols), n) for row in _rows_of(columns)
+    ]
+
+
+def _expect_col(columns: Columns, c: int | None, n: int) -> list[int]:
+    return [
+        _shard_of(row[c] if c is not None else None, n)
+        for row in _rows_of(columns)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ("key",) — full 128-bit pointer mod n
+# ---------------------------------------------------------------------------
+
+
+def test_key_rule_matches_per_row_including_low64_collisions():
+    rng = random.Random(7)
+    keys = [Pointer(rng.getrandbits(128)) for _ in range(64)]
+    # same low 64 bits, different high halves: a mod that folds only the
+    # low word would alias every pair
+    base = rng.getrandbits(64)
+    keys += [Pointer(base + (k << 64)) for k in range(1, 9)]
+    keys += [Pointer(0), Pointer((1 << 128) - 1), Pointer(1 << 64)]
+    cols = _columns([np.arange(len(keys))], keys)
+    for n in NS:
+        shards = columnar_shards(("key",), cols, n)
+        assert shards is not None
+        assert shards.tolist() == [_shard_of(k, n) for k in keys]
+
+
+def test_mod_u128_bytes_is_exact():
+    rng = random.Random(11)
+    values = [rng.getrandbits(128) for _ in range(200)] + [
+        0,
+        (1 << 128) - 1,
+        1 << 64,
+        (1 << 64) - 1,
+    ]
+    kb = np.frombuffer(
+        b"".join(v.to_bytes(16, "little") for v in values), np.uint8
+    ).reshape(len(values), 16)
+    for n in (2, 3, 7, 64, 1021):
+        assert mod_u128_bytes(kb, n).tolist() == [v % n for v in values]
+
+
+# ---------------------------------------------------------------------------
+# ("cols", ...) / ("col", ...) — value routing per distinct key
+# ---------------------------------------------------------------------------
+
+
+def test_multi_column_int_str_matches_per_row():
+    k = [ref_scalar(i) for i in range(40)]
+    c0 = np.array([i % 5 for i in range(40)])
+    c1 = np.array([f"g{i % 3}" for i in range(40)])
+    cols = _columns([c0, c1, np.arange(40.0)], k)
+    for n in NS:
+        shards = columnar_shards(("cols", [0, 1]), cols, n)
+        assert shards is not None
+        assert shards.tolist() == _expect_cols(cols, [0, 1], n)
+
+
+def test_bare_col_rule_hashes_bare_value_not_tuple():
+    k = [ref_scalar(i) for i in range(12)]
+    c0 = np.array([i % 4 for i in range(12)])
+    cols = _columns([c0], k)
+    for n in NS:
+        shards = columnar_shards(("col", 0), cols, n)
+        assert shards is not None
+        assert shards.tolist() == _expect_col(cols, 0, n)
+    # the distinction matters: hash(v) != hash((v,))
+    assert _shard_of(3, 7) != _shard_of((3,), 7) or _shard_of(3, 5) != _shard_of(
+        (3,), 5
+    )
+
+
+def test_pointer_column_routes_by_direct_mod():
+    rng = random.Random(3)
+    ptrs = [Pointer(rng.getrandbits(128)) for _ in range(20)]
+    ptrs[5] = ptrs[0]  # duplicates share a code
+    k = [ref_scalar(i) for i in range(20)]
+    cols = _columns([_obj(ptrs)], k)
+    for n in NS:
+        shards = columnar_shards(("col", 0), cols, n)
+        assert shards is not None
+        # bare Pointer values shard by int(value) % n, not by re-hashing
+        assert shards.tolist() == [int(p) % n for p in ptrs]
+
+
+def test_nan_float_column_falls_back_to_rows():
+    k = [ref_scalar(i) for i in range(4)]
+    cols = _columns([np.array([1.0, float("nan"), 2.0, 3.0])], k)
+    assert columnar_shards(("col", 0), cols, 3) is None
+    assert columnar_shards(("cols", [0]), cols, 3) is None
+    # NaN-free float columns stay vectorized
+    clean = _columns([np.array([1.0, 2.5, 2.5, 3.0])], k)
+    assert columnar_shards(("col", 0), clean, 3) is not None
+
+
+def test_int_valued_float_shards_with_int():
+    # hash_values folds 1.0 into the int encoding, so an int column and an
+    # int-valued float column of equal values route identically
+    k = [ref_scalar(i) for i in range(6)]
+    as_int = _columns([np.array([1, 2, 3, 1, 2, 3])], k)
+    as_float = _columns([np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0])], k)
+    for n in NS:
+        si = columnar_shards(("col", 0), as_int, n)
+        sf = columnar_shards(("col", 0), as_float, n)
+        assert si.tolist() == sf.tolist()
+        assert si.tolist() == _expect_col(as_int, 0, n)
+
+
+def test_object_column_mixed_types_matches_per_row():
+    values = [True, 1, "x", None, 3.5, (1, 2), "x", True, (1, 2), 0, False]
+    k = [ref_scalar(i) for i in range(len(values))]
+    cols = _columns([_obj(values)], k)
+    for n in NS:
+        shards = columnar_shards(("col", 0), cols, n)
+        assert shards is not None
+        assert shards.tolist() == _expect_col(cols, 0, n)
+    # True vs 1 are distinct logical keys (type-tagged digests)
+    assert (
+        hash_values((True,)) != hash_values((1,))
+    ), "bool/int digest collision would merge groups"
+
+
+def test_object_column_within_cols_rule():
+    values = [(i % 3, f"s{i % 2}") for i in range(18)]
+    k = [ref_scalar(i) for i in range(18)]
+    cols = _columns([_obj(values), np.arange(18)], k)
+    for n in NS:
+        shards = columnar_shards(("cols", [0, 1]), cols, n)
+        assert shards is not None
+        assert shards.tolist() == _expect_cols(cols, [0, 1], n)
+
+
+def test_constant_rules():
+    k = [ref_scalar(i) for i in range(5)]
+    cols = _columns([np.arange(5)], k)
+    # empty cols tuple: every row hashes the empty tuple
+    shards = columnar_shards(("cols", []), cols, 3)
+    assert shards.tolist() == [_shard_of((), 3)] * 5
+    # instance-less sort: constant None
+    shards = columnar_shards(("col", None), cols, 3)
+    assert shards.tolist() == [_shard_of(None, 3)] * 5
+
+
+def test_pin_and_unknown_rules_return_none():
+    k = [ref_scalar(i) for i in range(3)]
+    cols = _columns([np.arange(3)], k)
+    assert columnar_shards(("pin",), cols, 3) is None
+
+
+def test_randomized_property_vs_per_row_partitioners():
+    rng = random.Random(1234)
+    makers = [
+        lambda m: np.array([rng.randrange(-50, 50) for _ in range(m)]),
+        lambda m: np.array([rng.random() * 100 for _ in range(m)]),
+        lambda m: np.array([f"s{rng.randrange(8)}" for _ in range(m)]),
+        lambda m: np.array([bool(rng.randrange(2)) for _ in range(m)]),
+        lambda m: _obj(
+            [
+                rng.choice(
+                    [None, True, 2, "a", 2.5, (1, "b"), Pointer(rng.getrandbits(128))]
+                )
+                for _ in range(m)
+            ]
+        ),
+    ]
+    for trial in range(25):
+        m = rng.randrange(1, 60)
+        arity = rng.randrange(1, 4)
+        data = [rng.choice(makers)(m) for _ in range(arity)]
+        keys = [Pointer(rng.getrandbits(128)) for _ in range(m)]
+        cols = _columns(data, keys)
+        n = rng.choice(NS)
+        which = rng.randrange(3)
+        if which == 0:
+            rule = ("key",)
+            expect = [_shard_of(key, n) for key in keys]
+        elif which == 1:
+            sel = sorted(
+                rng.sample(range(arity), rng.randrange(1, arity + 1))
+            )
+            rule = ("cols", sel)
+            expect = _expect_cols(cols, sel, n)
+        else:
+            c = rng.randrange(arity)
+            rule = ("col", c)
+            expect = _expect_col(cols, c, n)
+        shards = columnar_shards(rule, cols, n)
+        if shards is None:
+            # only the documented fallbacks may bail
+            assert rule[0] != "key"
+            continue
+        assert shards.tolist() == expect, (trial, rule, n)
+
+
+# ---------------------------------------------------------------------------
+# batched hashing primitives
+# ---------------------------------------------------------------------------
+
+
+def test_hash_values_batch_matches_scalar_digests():
+    rows = [
+        (1, "a"),
+        (True,),
+        (2.5, None, "x"),
+        (Pointer(123), (1, 2)),
+        (),
+    ]
+    kb = hash_values_batch(rows, salt=b"shard")
+    for i, row in enumerate(rows):
+        expect = int(hash_values(row, salt=b"shard"))
+        assert int.from_bytes(kb[i].tobytes(), "little") == expect
+
+
+def test_hash_values_batch_type_error_repr_fallback():
+    # mixed-type dict keys make json.dumps(sort_keys=True) raise TypeError
+    poison = Json({1: "a", "b": 2})
+    with pytest.raises(TypeError):
+        hash_values((poison,))
+    with pytest.raises(TypeError):
+        hash_values_batch([(poison,)])
+    kb = hash_values_batch([(poison,)], on_type_error="repr")
+    expect = int(hash_values((repr(poison),)))
+    assert int.from_bytes(kb[0].tobytes(), "little") == expect
+    # and _shard_of takes the same repr detour, so routing still agrees
+    for n in NS:
+        expect_shard = int(hash_values((repr(poison),), salt=b"shard")) % n
+        assert _shard_of(poison, n) == expect_shard
+
+
+def test_shards_of_values_mixes_pointers_and_values():
+    rng = random.Random(5)
+    values = [Pointer(rng.getrandbits(128)), 3, "s", None, Pointer(17), 2.5]
+    for n in NS:
+        assert shards_of_values(values, n).tolist() == [
+            _shard_of(v, n) for v in values
+        ]
+
+
+def test_object_codes_group_by_digest_identity():
+    values = [True, 1, 1, "a", "a", None, True, 2.5]
+    codes = _object_codes(_obj(values))
+    groups = defaultdict(set)
+    for v, c in zip(values, codes.tolist()):
+        groups[int(c)].add((type(v).__name__, v))
+    # each code class holds exactly one logical (type, value) identity
+    for members in groups.values():
+        assert len(members) == 1
+    # True (bool) and 1 (int) must NOT share a code
+    code_true = codes[0]
+    code_one = codes[1]
+    assert code_true != code_one
+
+
+# ---------------------------------------------------------------------------
+# columnarize_entries — the row→columnar on-ramp the exchanges use
+# ---------------------------------------------------------------------------
+
+
+def test_columnarize_entries_round_trips():
+    entries = [
+        (ref_scalar(i), (i, float(i) * 0.5, f"s{i % 3}"), 1) for i in range(10)
+    ]
+    batch = DeltaBatch(entries)
+    batch = batch.consolidate()
+    cb = columnarize_entries(batch)
+    assert cb is not None and cb.columns is not None
+    assert cb.entries == entries
+    # mixed-type column degrades to object dtype but keeps exact values
+    entries = [(ref_scalar(i), (i if i % 2 else str(i),), 1) for i in range(8)]
+    cb = columnarize_entries(DeltaBatch(entries).consolidate())
+    assert cb is not None
+    assert cb.columns.cols[0].dtype == object
+    assert cb.entries == entries
+
+
+def test_columnarize_entries_rejects_ragged_and_nonconsolidated():
+    ragged = [
+        (ref_scalar(0), (1, 2), 1),
+        (ref_scalar(1), (1, 2, 3), 1),
+    ]
+    assert columnarize_entries(DeltaBatch(ragged).consolidate()) is None
+    raw = DeltaBatch([(ref_scalar(0), (1,), 1)])
+    assert columnarize_entries(raw) is None  # not consolidated yet
+
+
+# ---------------------------------------------------------------------------
+# 3-process mesh equivalence: columnar frames actually cross the wire
+# ---------------------------------------------------------------------------
+
+MESH_PROGRAM = """
+    import json, os
+    import pathway_tpu as pw
+
+    rows = pw.io.csv.read(
+        {indir!r},
+        schema=pw.schema_from_types(k=int, v=float),
+        mode="static",
+    )
+    agg = rows.groupby(pw.this.k).reduce(
+        k=pw.this.k, total=pw.reducers.sum(pw.this.v)
+    )
+    pw.io.csv.write(agg, {out!r})
+    pw.run()
+    from pathway_tpu.engine import distributed as dist
+    pid = os.environ.get("PATHWAY_PROCESS_ID", "0")
+    with open(os.path.join({statsdir!r}, "stats." + pid), "w") as fh:
+        json.dump(dist.EXCHANGE_STATS, fh)
+"""
+
+
+def test_three_process_columnar_frames_match_single_scope(tmp_path):
+    from tests.test_distributed import _read_csv, _spawn_program
+
+    indir = tmp_path / "in"
+    indir.mkdir()
+    n_rows = 1500
+    with open(indir / "rows.csv", "w") as fh:
+        fh.write("k,v\n")
+        fh.writelines(f"{i % 97},{float(i)}\n" for i in range(n_rows))
+
+    results = {}
+    for procs in (1, 3):
+        statsdir = tmp_path / f"stats{procs}"
+        statsdir.mkdir()
+        out = tmp_path / f"out{procs}.csv"
+        _spawn_program(
+            tmp_path,
+            MESH_PROGRAM.format(
+                indir=str(indir), out=str(out), statsdir=str(statsdir)
+            ),
+            processes=procs,
+        )
+        got = {
+            int(r["k"]): float(r["total"])
+            for r in _read_csv(out)
+            if int(r["diff"]) > 0
+        }
+        results[procs] = got
+        stats = [
+            json.loads((statsdir / f"stats.{pid}").read_text())
+            for pid in range(procs)
+        ]
+        sent = sum(s["columnar_frames_sent"] for s in stats)
+        received = sum(s["columnar_frames_received"] for s in stats)
+        if procs == 3:
+            # the probe: dtype-tagged frames REALLY crossed the TCP mesh
+            assert sent > 0, stats
+            assert received > 0, stats
+        else:
+            assert sent == 0
+
+    expected = {
+        k: float(sum(float(i) for i in range(n_rows) if i % 97 == k))
+        for k in range(97)
+    }
+    assert results[1] == expected
+    assert results[3] == results[1]
