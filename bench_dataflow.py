@@ -358,8 +358,15 @@ def run_all(emit=None) -> dict:
     """One pass over every workload -> {name: rows_per_sec}; consumed by
     bench.py so the dataflow line is tracked in BENCH_r{N}.json every
     round (VERDICT r2 #2). ``emit(name, value)`` fires as each leg
-    finishes, so a wall-budget abort still reports the completed legs."""
+    finishes, so a wall-budget abort still reports the completed legs.
+    The ``native`` entry reports whether the C kernels loaded and, per
+    kernel, how many times the hot paths actually engaged them over the
+    whole pass — a silent fallback to Python shows up as a zero counter,
+    not as an unexplained throughput regression."""
+    from pathway_tpu import native
+
     out = {}
+    native.reset_hit_counts()
 
     def record(name, value):
         out[name] = value
@@ -393,6 +400,13 @@ def run_all(emit=None) -> dict:
                 "mesh_groupby",
                 {k: v for k, v in leg.items() if k != "workload"},
             )
+    record(
+        "native",
+        {
+            "available": native.available(),
+            "hits": {k: v for k, v in native.hit_counts().items() if v},
+        },
+    )
     return out
 
 

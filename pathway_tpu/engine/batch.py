@@ -340,9 +340,11 @@ class DeltaBatch:
         if self._ccache is not None:
             return self._ccache
         if self._entries is None:
-            # columnar batches are constructed with their consolidation
-            # flags asserted by the producer; an unconsolidated one has
-            # no cheap columnar merge — materialise and fall through
+            got = self._consolidate_columns()
+            if got is not None:
+                return got
+            # no vectorized merge for this payload (object columns,
+            # NaN/-0.0 floats, unfetchable keys) — materialise rows
             self.entries  # noqa: B018 — force row form
         if _native is not None:
             merged, insert_only = _native.consolidate(self._entries)
@@ -390,6 +392,78 @@ class DeltaBatch:
             if diff != 0:
                 out._entries.append((slot[0], row, diff))
         out._consolidated = True
+        self._ccache = out
+        return out
+
+    def _consolidate_columns(self) -> "DeltaBatch | None":
+        """Vectorized consolidate for a columnar payload — merge duplicate
+        (key, row) rows without ever materialising row tuples, or ``None``
+        when bit equality and value equality could diverge (object columns,
+        NaN or -0.0 in a float column) or the keys cannot be fetched.
+
+        Identity matches the row path exactly: within a uniform-dtype
+        column, bit equality IS value equality once NaN (never equal) and
+        -0.0 (equal to +0.0 but bit-distinct) are excluded, so a structured
+        view over (key bytes, columns) groups precisely the rows the dict
+        slot ``(key, row)`` would merge."""
+        cols = self.columns
+        n = cols.n
+        if n == 0:
+            self._consolidated = True
+            self._insert_only = True
+            return self
+        for c in cols.cols:
+            if c.dtype.kind not in "bifU":
+                return None
+            if c.dtype.kind == "f" and (
+                np.isnan(c).any() or np.signbit(c[c == 0]).any()
+            ):
+                return None
+        try:
+            kb = np.ascontiguousarray(cols.kbytes())
+        except Exception:
+            return None
+        diffs = cols.diffs
+        # precheck mirroring the row path: positive diffs + unique keys
+        # means there is nothing to merge — flag in place, copy nothing
+        if diffs is None or (diffs > 0).all():
+            lo = np.sort(kb[:, :8].view(np.uint64).ravel())
+            if not (lo[1:] == lo[:-1]).any() or len(
+                np.unique(kb.view(np.dtype((np.void, 16))).ravel())
+            ) == n:
+                self._consolidated = True
+                self._insert_only = True
+                return self
+        rec = np.empty(
+            n,
+            dtype=[("k", (np.void, 16))]
+            + [(f"c{i}", c.dtype) for i, c in enumerate(cols.cols)],
+        )
+        rec["k"] = kb.view(np.dtype((np.void, 16))).ravel()
+        for i, c in enumerate(cols.cols):
+            rec[f"c{i}"] = c
+        _uniq, first, inverse = np.unique(
+            rec, return_index=True, return_inverse=True
+        )
+        sums = np.zeros(len(first), np.int64)
+        np.add.at(
+            sums,
+            inverse.ravel(),
+            np.int64(1) if diffs is None else diffs,
+        )
+        order = np.argsort(first, kind="stable")  # first-seen entry order
+        keep = sums[order] != 0
+        idx = first[order][keep]
+        newdiffs = sums[order][keep]
+        merged = Columns(
+            int(len(idx)),
+            [c[idx] for c in cols.cols],
+            kbytes=kb[idx],
+            diffs=None if (newdiffs == 1).all() else newdiffs,
+        )
+        out = DeltaBatch.from_columns(
+            merged, consolidated=True, insert_only=False
+        )
         self._ccache = out
         return out
 

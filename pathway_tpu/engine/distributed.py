@@ -58,8 +58,12 @@ from pathway_tpu.engine.graph import (
     Scope,
     StaticSource,
 )
-from pathway_tpu.engine.routing import columnar_shards
-from pathway_tpu.engine.sharded import _shard_of, partition_rule, partitioner
+from pathway_tpu.engine.routing import (
+    columnar_shards,
+    entry_shards,
+    shards_of_values,
+)
+from pathway_tpu.engine.sharded import partition_rule, partitioner
 from pathway_tpu.engine.value import Pointer
 
 _LEN = struct.Struct(">Q")
@@ -670,10 +674,19 @@ class DistributedScheduler:
                 cons_idx, port, out, shards
             ):
                 return
-        fn = self._partition_fn(consumer, port)
         parts: list[list] = [[] for _ in range(self.n_workers)]
-        for key, row, diff in out:
-            parts[fn(key, row)].append((key, row, diff))
+        shards = entry_shards(
+            partition_rule(consumer, port), out.entries, self.n_workers
+        )
+        if shards is not None:
+            # batched worker assignment (one digest kernel call), same
+            # per-row definition as the partitioner closures
+            for e, w in zip(out.entries, shards):
+                parts[w].append(e)
+        else:
+            fn = self._partition_fn(consumer, port)
+            for key, row, diff in out:
+                parts[fn(key, row)].append((key, row, diff))
         for worker, entries in enumerate(parts):
             if not entries:
                 continue
@@ -853,8 +866,11 @@ class DistributedScheduler:
                 node, batch
             ):
                 parts: list[list] = [[] for _ in range(self.n_workers)]
-                for key, row, diff in batch:
-                    parts[_shard_of(key, self.n_workers)].append((key, row, diff))
+                key_shards = shards_of_values(
+                    [e[0] for e in batch.entries], self.n_workers
+                )
+                for e, w in zip(batch.entries, key_shards):
+                    parts[w].append(e)
                 for worker in range(1, self.n_workers):
                     if not parts[worker]:
                         continue

@@ -237,8 +237,25 @@ class InputSession(Node):
                 out._raw_insert_only = True
             self._has_removals = False
             return out
-        out = DeltaBatch()
         state = self.current  # hoisted: property drains lazily-applied state
+        from pathway_tpu.native import kernels as _native
+
+        if (
+            _native is not None
+            and hasattr(_native, "session_overlay")
+            and type(state) is dict
+        ):
+            # the whole overlay resolution (upsert retractions, row-less
+            # removals against this commit's earlier updates) in one call
+            entries = _native.session_overlay(
+                self._buffer, state, self.upsert
+            )
+            if entries is not None:
+                self._buffer.clear()
+                self._has_removals = False
+                self._has_rowless_removals = False
+                return DeltaBatch(entries).consolidate()
+        out = DeltaBatch()
         # overlay of keys touched this commit: key -> row | None (absent row)
         overlay: dict[Pointer, tuple | None] = {}
 
@@ -880,13 +897,50 @@ def _match_join_pairs(la: np.ndarray, ra: np.ndarray):
     return l_idx, order[starts + offs]
 
 
+def _as_match_codes(arr: np.ndarray) -> np.ndarray | None:
+    """Reinterpret a join-key column as int64 codes whose equality is
+    exactly the column's value equality, or ``None`` when no such view
+    exists. Integers widen losslessly; uint64 reinterprets bitwise (a
+    bijection, so equality is preserved); floats widen to float64 (exact
+    for every narrower float), normalise -0.0 to +0.0 via ``+ 0.0``, and
+    reinterpret bits — sound only when NaN-free, since bit equality would
+    call equal-bit NaNs a match."""
+    k = arr.dtype.kind
+    if k in "bi":
+        return np.ascontiguousarray(arr, np.int64)
+    if k == "u":
+        if arr.dtype.itemsize == 8:
+            return np.ascontiguousarray(arr).view(np.int64)
+        return np.ascontiguousarray(arr, np.int64)
+    if k == "f":
+        f = np.ascontiguousarray(arr, np.float64) + 0.0
+        if np.isnan(f).any():
+            return None
+        return f.view(np.int64)
+    return None
+
+
 def _match_join_pairs_multi(
     l_arrays: "list[np.ndarray]", r_arrays: "list[np.ndarray]"
 ):
     """Multi-column join matching: reduce key TUPLES to joint integer
     codes (factorized over the concatenation of both sides, so equal
     tuples get equal codes across sides), then run the single-array
-    sort-based matcher. Columns arrive already dtype-unified."""
+    sort-based matcher. Columns arrive already dtype-unified.
+
+    With the native kernels loaded and every key column int64-codeable,
+    one hash-table kernel replaces the factorize + argsort + searchsorted
+    pipeline; its output ordering (probe index ascending, build index
+    ascending within a probe row) is the sort-based matcher's ordering,
+    so the paths are interchangeable pair for pair."""
+    from pathway_tpu.native import kernels as _native
+
+    if _native is not None and hasattr(_native, "match_pairs_i64"):
+        lc = [_as_match_codes(a) for a in l_arrays]
+        if all(c is not None for c in lc):
+            rc = [_as_match_codes(a) for a in r_arrays]
+            if all(c is not None for c in rc):
+                return _native.match_pairs_i64(lc, rc)
     from pathway_tpu.engine.device import factorize_multi
 
     if len(l_arrays) == 1:
@@ -1051,6 +1105,18 @@ class JoinNode(Node):
                 return None
             return _JoinSide(n, jks, kb, list(payload.cols))
         entries = batch.entries
+        if _native is not None and hasattr(_native, "entries_to_side"):
+            # one pass over the rows screens diffs/keys and fills every
+            # column typed (int64/float64/bool) or exact-object — no
+            # ColumnarView scan, no per-column list comprehension
+            got = _native.entries_to_side(
+                entries, list(on_cols), arity, Pointer
+            )
+            if got is not None:
+                kb, cols = got
+                if not batch._insert_only and not _keys_unique(kb, n):
+                    return None
+                return _JoinSide(n, [cols[c] for c in on_cols], kb, cols)
         view = device.ColumnarView(entries, from_entries=True)
         jks = []
         for c in on_cols:

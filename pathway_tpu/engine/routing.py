@@ -23,13 +23,21 @@ from typing import TYPE_CHECKING, Any, Sequence
 
 import numpy as np
 
-from pathway_tpu.engine.value import Pointer, hash_values, hash_values_batch
+from pathway_tpu.engine.value import (
+    ERROR,
+    Pointer,
+    _digest16,
+    hash_values,
+    hash_values_batch,
+)
+from pathway_tpu.native import kernels as _native
 
 if TYPE_CHECKING:  # pragma: no cover
     from pathway_tpu.engine.batch import Columns
 
 __all__ = [
     "columnar_shards",
+    "entry_shards",
     "mod_u128_bytes",
     "shards_of_values",
 ]
@@ -44,6 +52,15 @@ def _shard_of(value: Any, n: int) -> int:
         return int(hash_values((value,), salt=b"shard")) % n
     except TypeError:
         return int(hash_values((repr(value),), salt=b"shard")) % n
+
+
+def _shard_digest_fallback(value: Any) -> bytes:
+    """Digest for one value the native serializer bailed on — the tail of
+    :func:`_shard_of` (TypeError -> repr) as a bytes-returning closure."""
+    try:
+        return _digest16((value,), b"shard")
+    except TypeError:
+        return _digest16((repr(value),), b"shard")
 
 
 def mod_u128_bytes(kb: np.ndarray, n: int) -> np.ndarray:
@@ -63,11 +80,21 @@ def mod_u128_bytes(kb: np.ndarray, n: int) -> np.ndarray:
 
 
 def shards_of_values(values: Sequence[Any], n: int) -> np.ndarray:
-    """Batched ``_shard_of``: one :func:`hash_values_batch` call builds the
-    digest matrix for every non-Pointer value, one vectorized mod folds it
-    to worker ids. Callers pass DISTINCT representatives (factorize
-    output), so the remaining Python loop runs per distinct key inside a
-    single call — not per row on the exchange hot path."""
+    """Batched ``_shard_of``: when the native kernels are loaded, ONE
+    ``shard_values`` call serializes, digests, and mods every value
+    (Pointers short-circuit to ``int(v) % n`` on their key bytes);
+    otherwise one :func:`hash_values_batch` call builds the digest matrix
+    for every non-Pointer value and one vectorized mod folds it to worker
+    ids. Callers pass DISTINCT representatives (factorize output), so any
+    remaining per-value work runs per distinct key inside a single call —
+    not per row on the exchange hot path."""
+    if _native is not None and hasattr(_native, "shard_values"):
+        vlist = values if isinstance(values, list) else list(values)
+        got = _native.shard_values(
+            vlist, b"shard", n, Pointer, ERROR, _shard_digest_fallback
+        )
+        if got is not None:
+            return got
     shards = np.empty(len(values), np.int64)
     rows: list[tuple] = []
     where: list[int] = []
@@ -83,6 +110,29 @@ def shards_of_values(values: Sequence[Any], n: int) -> np.ndarray:
     return shards
 
 
+def entry_shards(rule: tuple, entries: "Sequence[tuple]", n: int) -> np.ndarray | None:
+    """Vectorized worker assignment for ROW entries — the row-path twin of
+    :func:`columnar_shards`. One :func:`shards_of_values` call per batch
+    replaces the per-row partitioner closure; the value extraction per
+    rule mirrors sharded.partitioner exactly (``by_cols`` hashes the
+    column TUPLE, ``by_col`` the bare value, ``by_key`` the row key).
+    ``None`` for rules without a shard table (``pin``)."""
+    kind = rule[0]
+    if kind == "key":
+        return shards_of_values([e[0] for e in entries], n)
+    if kind == "cols":
+        cols = rule[1]
+        return shards_of_values(
+            [tuple(e[1][c] for c in cols) for e in entries], n
+        )
+    if kind == "col":
+        c = rule[1]
+        if c is None:
+            return shards_of_values([None] * len(entries), n)
+        return shards_of_values([e[1][c] for e in entries], n)
+    return None
+
+
 def _object_codes(col: np.ndarray) -> np.ndarray:
     """Dense int64 codes for a non-sortable (object-dtype) column, keyed
     by the value's hash_values DIGEST — the exact identity the per-row
@@ -94,12 +144,31 @@ def _object_codes(col: np.ndarray) -> np.ndarray:
     One ``hash_values_batch`` call computes every digest; the codes come
     from a single ``np.unique`` over the digest matrix. (Code order
     differs from first-seen order, which is fine: ``factorize_multi``
-    consumes only the identity classes, never the code values.)"""
-    kb = hash_values_batch(
-        [(v,) for v in col.tolist()], on_type_error="repr"
-    )
+    consumes only the identity classes, never the code values.)
+
+    With the native kernels loaded the column array goes straight into
+    ``hash_tuples_batch`` in bare mode — no ``[(v,) for v in tolist()]``
+    materialization; the digests are identical by construction."""
+    if _native is not None and hasattr(_native, "hash_tuples_batch"):
+        kb = _native.hash_tuples_batch(
+            np.ascontiguousarray(col), b"", True, Pointer, ERROR,
+            _bare_digest_fallback,
+        )
+    else:
+        kb = hash_values_batch(
+            [(v,) for v in col.tolist()], on_type_error="repr"
+        )
     _uniq, inverse = np.unique(kb, axis=0, return_inverse=True)
     return inverse.ravel().astype(np.int64, copy=False)
+
+
+def _bare_digest_fallback(value: Any) -> bytes:
+    """Unsalted single-value digest with the repr-on-TypeError rule —
+    the per-item fallback ``_object_codes`` hands the native kernel."""
+    try:
+        return _digest16((value,), b"")
+    except TypeError:
+        return _digest16((repr(value),), b"")
 
 
 def columnar_shards(
@@ -115,10 +184,12 @@ def columnar_shards(
 
     - ``("pin",)`` rules — the caller pushes the whole batch to worker 0
       without consulting a shard table;
-    - float columns containing NaN — ``np.unique`` collapses
-      distinct-bit NaNs that the per-row digests keep apart;
     - column dtypes outside bool/int/float/unicode/object;
     - key-bytes derivation failure for ``("key",)`` batches.
+
+    NaN-containing float columns stay vectorized: they factorize over
+    their raw bit patterns, so distinct-bit NaNs keep the distinct
+    digests the per-row partitioners would compute.
     """
     kind = rule[0]
     if kind in ("cols", "col"):
@@ -140,7 +211,18 @@ def columnar_shards(
             col = columns.cols[c]
             if col.dtype.kind in "bifU":
                 if col.dtype.kind == "f" and np.isnan(col).any():
-                    return None
+                    # bit-pattern coding keeps distinct-bit NaNs apart —
+                    # the identity the per-row digests use, which value
+                    # factorization (NaN != NaN, payloads collapse)
+                    # cannot express. Splitting FINER than value equality
+                    # (+0.0 / -0.0 land in two classes) is safe: each
+                    # class representative digests to the same shard.
+                    arrays.append(
+                        np.ascontiguousarray(col).view(
+                            np.dtype(f"u{col.dtype.itemsize}")
+                        )
+                    )
+                    continue
                 arrays.append(col)
             elif col.dtype == object:
                 arrays.append(_object_codes(col))

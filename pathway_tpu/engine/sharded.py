@@ -56,6 +56,8 @@ from pathway_tpu.engine.routing import (  # noqa: F401 — re-exports
     _object_codes,
     _shard_of,
     columnar_shards,
+    entry_shards,
+    shards_of_values,
 )
 from pathway_tpu.engine.value import Pointer
 
@@ -221,8 +223,15 @@ class ShardedScheduler:
                         )
                     continue
             parts: list[list[Entry]] = [[] for _ in range(self.n)]
-            for key, row, diff in out:
-                parts[fn(key, row)].append((key, row, diff))
+            shards = entry_shards(
+                partition_rule(consumer, port), out.entries, self.n
+            )
+            if shards is not None:
+                for e, w in zip(out.entries, shards):
+                    parts[w].append(e)
+            else:
+                for key, row, diff in out:
+                    parts[fn(key, row)].append((key, row, diff))
             for w, entries in enumerate(parts):
                 if entries:
                     batch = DeltaBatch(entries)
@@ -363,8 +372,11 @@ class ShardedScheduler:
                         )
             else:
                 parts: list[list[Entry]] = [[] for _ in range(self.n)]
-                for key, row, diff in batch:
-                    parts[_shard_of(key, self.n)].append((key, row, diff))
+                key_shards = shards_of_values(
+                    [e[0] for e in batch.entries], self.n
+                )
+                for e, w in zip(batch.entries, key_shards):
+                    parts[w].append(e)
                 for w in range(1, self.n):
                     if parts[w]:
                         replica = self.scopes[w].nodes[node.index]

@@ -22,6 +22,8 @@ from typing import Any, Iterable
 
 import numpy as np
 
+from pathway_tpu.native import kernels as _native
+
 __all__ = [
     "Type",
     "Kind",
@@ -395,7 +397,46 @@ def hash_values_batch(
     digest raises TypeError — the exact fallback the per-row partitioners
     (sharded._shard_of) use, kept here so batch and scalar paths cannot
     drift.
+
+    When the native kernels are loaded, list/ndarray inputs run through
+    ``hash_tuples_batch`` — one C call serializes and digests every row;
+    values outside the native serializer's exact-type set come back here
+    per row through the fallback closure, so both paths stay
+    digest-identical by construction (enforced by tests/test_native_parity).
     """
+    repr_fallback = on_type_error == "repr"
+    if _native is not None and hasattr(_native, "hash_tuples_batch") and (
+        isinstance(rows, list)
+        or (
+            isinstance(rows, np.ndarray)
+            and rows.dtype == object
+            and rows.ndim == 1
+            and rows.flags.c_contiguous
+        )
+    ):
+
+        def _row_fallback(row: Any) -> bytes:
+            try:
+                return _digest16(row, salt)
+            except TypeError:
+                if not repr_fallback:
+                    raise
+                return _digest16(tuple(repr(v) for v in row), salt)
+
+        return _native.hash_tuples_batch(
+            rows, salt, False, Pointer, ERROR, _row_fallback
+        )
+    return _hash_values_batch_py(rows, salt=salt, on_type_error=on_type_error)
+
+
+def _hash_values_batch_py(
+    rows: "Iterable[Iterable[Any]]",
+    *,
+    salt: bytes = b"",
+    on_type_error: str = "raise",
+) -> np.ndarray:
+    """Pure-Python row loop behind :func:`hash_values_batch` — THE
+    reference behavior the native kernel must reproduce bit for bit."""
     repr_fallback = on_type_error == "repr"
     out = bytearray()
     n = 0

@@ -162,11 +162,11 @@ class PgWireConnection:
         connect_timeout: float = 10.0,
         sslmode: str = "prefer",
     ) -> None:
+        if sslmode not in ("disable", "prefer", "require", "verify-full"):
+            raise PgError(f"unsupported sslmode {sslmode!r}")
         self.sock = socket.create_connection(
             (host, port), timeout=connect_timeout
         )
-        if sslmode not in ("disable", "prefer", "require"):
-            raise PgError(f"unsupported sslmode {sslmode!r}")
         if sslmode != "disable":
             # SSLRequest: 'S' -> wrap in TLS, 'N' -> plaintext (libpq
             # 'require' errors on refusal, 'prefer' falls back)
@@ -176,14 +176,16 @@ class PgWireConnection:
                 import ssl
 
                 ctx = ssl.create_default_context()
-                # libpq sslmode=require does not verify certificates
-                ctx.check_hostname = False
-                ctx.verify_mode = ssl.CERT_NONE
+                if sslmode != "verify-full":
+                    # libpq: only verify-full checks the chain AND the
+                    # hostname; require accepts any certificate
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl.CERT_NONE
                 self.sock = ctx.wrap_socket(self.sock, server_hostname=host)
             elif answer != b"N":
                 raise PgError(f"unexpected SSLRequest answer {answer!r}")
-            elif sslmode == "require":
-                raise PgError("server refused SSL but sslmode=require")
+            elif sslmode in ("require", "verify-full"):
+                raise PgError(f"server refused SSL but sslmode={sslmode}")
         self._reader = _FrameReader(self.sock)
         self._in_txn = False
         params = (

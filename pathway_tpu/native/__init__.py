@@ -80,3 +80,18 @@ if os.environ.get("PATHWAY_TPU_DISABLE_NATIVE") != "1":
 
 def available() -> bool:
     return kernels is not None
+
+
+def hit_counts() -> dict[str, int]:
+    """Per-kernel invocation counters since process start (or the last
+    :func:`reset_hit_counts`); empty when the native module is absent.
+    bench_dataflow records this next to EXCHANGE_STATS so a silent import
+    regression shows up in the bench JSON, not just as a slowdown."""
+    if kernels is None or not hasattr(kernels, "hit_counts"):
+        return {}
+    return kernels.hit_counts()
+
+
+def reset_hit_counts() -> None:
+    if kernels is not None and hasattr(kernels, "reset_hit_counts"):
+        kernels.reset_hit_counts()

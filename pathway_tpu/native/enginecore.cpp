@@ -19,7 +19,73 @@
 #define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
 #include <numpy/arrayobject.h>
 
+#include <cmath>
+#include <vector>
+
 namespace {
+
+/* -- per-kernel hit counters -------------------------------------------------
+ *
+ * Cheap engagement probes: every kernel entry bumps its slot, and
+ * ``hit_counts()`` exposes the table as a dict. bench_dataflow records it
+ * next to EXCHANGE_STATS so a silent import regression (everything
+ * falling back to Python loops) is visible in the bench JSON, and the
+ * smoke test asserts the counters actually move on a groupby+join run.
+ */
+
+enum HitKernel {
+  H_EXTRACT_COLUMN = 0,
+  H_ENTRY_DIFFS,
+  H_CONSOLIDATE,
+  H_APPLY_STATE,
+  H_BUILD_ENTRIES,
+  H_FILTER_TRUTHY,
+  H_JOIN_INSERT_INNER,
+  H_POINTERS_TO_BYTES,
+  H_BYTES_TO_POINTERS,
+  H_ENTRY_KEYS_BYTES,
+  H_HASH_JOIN_PAIRS,
+  H_COLUMNS_TO_ENTRIES,
+  H_HASH_TUPLES_BATCH,
+  H_SHARD_VALUES,
+  H_ENTRIES_TO_SIDE,
+  H_MATCH_PAIRS_I64,
+  H_SESSION_OVERLAY,
+  H_N_KERNELS,
+};
+
+const char *const HIT_NAMES[H_N_KERNELS] = {
+    "extract_column",   "entry_diffs",      "consolidate",
+    "apply_state",      "build_entries",    "filter_truthy",
+    "join_insert_inner", "pointers_to_bytes", "bytes_to_pointers",
+    "entry_keys_bytes", "hash_join_pairs",  "columns_to_entries",
+    "hash_tuples_batch", "shard_values",    "entries_to_side",
+    "match_pairs_i64",  "session_overlay",
+};
+
+unsigned long long g_hits[H_N_KERNELS] = {0};
+
+#define HIT(id) (g_hits[id]++)
+
+PyObject *hit_counts(PyObject *, PyObject *) {
+  PyObject *out = PyDict_New();
+  if (!out) return nullptr;
+  for (int i = 0; i < H_N_KERNELS; i++) {
+    PyObject *v = PyLong_FromUnsignedLongLong(g_hits[i]);
+    if (!v || PyDict_SetItemString(out, HIT_NAMES[i], v) < 0) {
+      Py_XDECREF(v);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(v);
+  }
+  return out;
+}
+
+PyObject *reset_hit_counts(PyObject *, PyObject *) {
+  for (int i = 0; i < H_N_KERNELS; i++) g_hits[i] = 0;
+  Py_RETURN_NONE;
+}
 
 /* -- columnar extraction -----------------------------------------------------
  *
@@ -88,6 +154,7 @@ PyObject *extract_col_core(PyObject *seq, Py_ssize_t col, int from_entries) {
  * seq is a list of row tuples (from_entries=0) or (key,row,diff) entries
  * (from_entries=1). */
 PyObject *extract_column(PyObject *, PyObject *args) {
+  HIT(H_EXTRACT_COLUMN);
   PyObject *rows;
   Py_ssize_t col;
   int from_entries;
@@ -103,6 +170,7 @@ PyObject *extract_column(PyObject *, PyObject *args) {
 
 /* entry_diffs(entries) -> int64 ndarray of each entry's diff. */
 PyObject *entry_diffs(PyObject *, PyObject *args) {
+  HIT(H_ENTRY_DIFFS);
   PyObject *entries;
   if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &entries)) return nullptr;
   Py_ssize_t n = PyList_GET_SIZE(entries);
@@ -132,6 +200,7 @@ PyObject *entry_diffs(PyObject *, PyObject *args) {
  * None as first element means "already consolidated as-is" (the cheap
  * precheck passed); insert_only reports unique-key all-positive shape. */
 PyObject *consolidate(PyObject *, PyObject *args) {
+  HIT(H_CONSOLIDATE);
   PyObject *entries;
   if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &entries)) return nullptr;
   Py_ssize_t n = PyList_GET_SIZE(entries);
@@ -282,6 +351,7 @@ fail:
 /* apply_state(state_dict, entries, insert_only) -> None
  * Mirrors batch.apply_batch_to_state. */
 PyObject *apply_state(PyObject *, PyObject *args) {
+  HIT(H_APPLY_STATE);
   PyObject *state, *entries;
   int insert_only;
   if (!PyArg_ParseTuple(args, "O!O!p", &PyDict_Type, &state, &PyList_Type,
@@ -323,6 +393,7 @@ PyObject *apply_state(PyObject *, PyObject *args) {
  * the columnar expression path): row_i = (columns[0][i], columns[1][i],…),
  * keys/diffs reused from the input entries. */
 PyObject *build_entries(PyObject *, PyObject *args) {
+  HIT(H_BUILD_ENTRIES);
   PyObject *entries, *columns;
   if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &entries, &PyList_Type,
                         &columns))
@@ -367,6 +438,7 @@ PyObject *build_entries(PyObject *, PyObject *args) {
  * fallback) if any condition value is not a plain bool — error poisoning
  * and odd truthiness keep their row-wise semantics. */
 PyObject *filter_truthy(PyObject *, PyObject *args) {
+  HIT(H_FILTER_TRUTHY);
   PyObject *entries;
   Py_ssize_t col;
   if (!PyArg_ParseTuple(args, "O!n", &PyList_Type, &entries, &col))
@@ -683,6 +755,7 @@ int join_prescan(PyObject *entries, PyObject *cols, PyObject *error_obj,
  * `current` (the node's key->row state) is written alongside emission, so
  * the scheduler's apply_batch_to_state pass is skipped (_preapplied). */
 PyObject *join_insert_inner(PyObject *, PyObject *args) {
+  HIT(H_JOIN_INSERT_INNER);
   PyObject *le, *re, *lon, *ron, *larr, *rarr, *error_obj, *pointer_type,
       *current, *jrk_fn;
   if (!PyArg_ParseTuple(args, "O!O!O!O!O!O!OOOO", &PyList_Type, &le,
@@ -744,6 +817,7 @@ PyObject *pointer_from_bytes(PyTypeObject *pointer_type,
 
 /* pointers_to_bytes(keys_list) -> (n,16) uint8 ndarray | None (non-int) */
 PyObject *pointers_to_bytes(PyObject *, PyObject *args) {
+  HIT(H_POINTERS_TO_BYTES);
   PyObject *keys;
   if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &keys)) return nullptr;
   Py_ssize_t n = PyList_GET_SIZE(keys);
@@ -763,6 +837,7 @@ PyObject *pointers_to_bytes(PyObject *, PyObject *args) {
 
 /* bytes_to_pointers(arr, pointer_type) -> list of Pointer */
 PyObject *bytes_to_pointers(PyObject *, PyObject *args) {
+  HIT(H_BYTES_TO_POINTERS);
   PyObject *arr_obj, *pointer_type;
   if (!PyArg_ParseTuple(args, "O!O", &PyArray_Type, &arr_obj, &pointer_type))
     return nullptr;
@@ -799,6 +874,7 @@ PyObject *bytes_to_pointers(PyObject *, PyObject *args) {
  * hash_join_pairs tags _H_POINTER, which only matches hash_values for
  * genuine Pointers). */
 PyObject *entry_keys_bytes(PyObject *, PyObject *args) {
+  HIT(H_ENTRY_KEYS_BYTES);
   PyObject *entries, *pointer_type;
   if (!PyArg_ParseTuple(args, "O!O", &PyList_Type, &entries, &pointer_type))
     return nullptr;
@@ -827,6 +903,7 @@ PyObject *entry_keys_bytes(PyObject *, PyObject *args) {
 /* hash_join_pairs(lbytes, rbytes) -> (n,16) uint8 of
  * blake2b16("join" + 0x04 lk + 0x04 rk) — vectorized join_result_key. */
 PyObject *hash_join_pairs(PyObject *, PyObject *args) {
+  HIT(H_HASH_JOIN_PAIRS);
   PyObject *l_obj, *r_obj;
   if (!PyArg_ParseTuple(args, "O!O!", &PyArray_Type, &l_obj, &PyArray_Type,
                         &r_obj))
@@ -891,6 +968,7 @@ PyObject *cell_to_object(PyArrayObject *col, Py_ssize_t i) {
 /* columns_to_entries(keys_list, cols_list, diffs|None) -> entries list.
  * cols_list: 1-D ndarrays, one per column; diffs: int64 ndarray or None. */
 PyObject *columns_to_entries(PyObject *, PyObject *args) {
+  HIT(H_COLUMNS_TO_ENTRIES);
   PyObject *keys, *cols, *diffs_obj;
   if (!PyArg_ParseTuple(args, "O!O!O", &PyList_Type, &keys, &PyList_Type,
                         &cols, &diffs_obj))
@@ -965,6 +1043,706 @@ fail:
   return nullptr;
 }
 
+/* -- gen-2 kernels: batched digests, shard coding, side extraction -----------
+ *
+ * Everything below is digest- or result-identical to a pure-Python
+ * implementation that stays in the tree (engine/value.py `_digest16`/
+ * `_feed`, engine/routing.py `_shard_of`, graph.py `_side_from_batch` /
+ * `_match_join_pairs_multi`, `InputSession.flush`): the kernels bail —
+ * Py_RETURN_NONE, or a per-item Python fallback callable — the moment a
+ * value leaves the exact-type fast set, so the Python path remains THE
+ * definition of behavior and the property suite can assert bit equality.
+ */
+
+/* Streaming blake2b-128 (digest_size=16, personal "pw-tpu-key"): the
+ * b2b16_short core above only handles <=128-byte messages; value tuples
+ * (strings, nested tuples) need the full chunked update loop. */
+struct B2BCtx {
+  uint64_t h[8];
+  uint64_t t;       /* bytes fed into compress so far (incl. current) */
+  size_t buflen;
+  uint8_t buf[128];
+};
+
+void b2b_init(B2BCtx *c) {
+  for (int i = 0; i < 8; i++) c->h[i] = B2B_IV[i];
+  uint8_t param[64] = {0};
+  param[0] = 16;
+  param[2] = 1;
+  param[3] = 1;
+  memcpy(param + 48, "pw-tpu-key", 10);
+  uint64_t pw[8];
+  memcpy(pw, param, 64);
+  for (int i = 0; i < 8; i++) c->h[i] ^= pw[i];
+  c->t = 0;
+  c->buflen = 0;
+}
+
+void b2b_update(B2BCtx *c, const uint8_t *data, size_t len) {
+  while (len > 0) {
+    if (c->buflen == 128) {
+      /* flush a full buffer only when more input follows — the final
+       * block must go through b2b_final with the last flag set */
+      c->t += 128;
+      b2b_compress(c->h, c->buf, c->t, 0);
+      c->buflen = 0;
+    }
+    size_t take = 128 - c->buflen;
+    if (take > len) take = len;
+    memcpy(c->buf + c->buflen, data, take);
+    c->buflen += take;
+    data += take;
+    len -= take;
+  }
+}
+
+void b2b_final(B2BCtx *c, uint8_t out[16]) {
+  c->t += c->buflen;
+  memset(c->buf + c->buflen, 0, 128 - c->buflen);
+  b2b_compress(c->h, c->buf, c->t, 1);
+  memcpy(out, c->h, 16);
+}
+
+/* arbitrary PyLong -> 16-byte signed little-endian, matching
+ * int.to_bytes(16, "little", signed=True) including the OverflowError. */
+int long_to_signed16(PyObject *v, uint8_t out[16]) {
+  int overflow = 0;
+  long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+  if (!overflow) {
+    if (x == -1 && PyErr_Occurred()) return -1;
+    memcpy(out, &x, 8);
+    memset(out + 8, x < 0 ? 0xff : 0x00, 8);
+    return 0;
+  }
+#if PY_VERSION_HEX >= 0x030d0000
+  Py_ssize_t r = PyLong_AsNativeBytes(v, out, 16,
+                                      Py_ASNATIVEBYTES_LITTLE_ENDIAN);
+  if (r < 0) return -1;
+  if (r > 16) {
+    PyErr_SetString(PyExc_OverflowError, "int too big to convert");
+    return -1;
+  }
+  return 0;
+#else
+  return _PyLong_AsByteArray((PyLongObject *)v, out, 16, 1, 1);
+#endif
+}
+
+/* Feed one value's tagged serialization (engine/value.py `_feed` /
+ * `_digest16` byte stream) into the hash context.
+ * Returns 0 = fed, 1 = bail to the Python fallback (type outside the
+ * exact fast set), -1 = Python error set (propagates, matching the
+ * exception the Python path would raise: OverflowError on >128-bit
+ * ints, UnicodeEncodeError on surrogates). */
+int feed_value(B2BCtx *c, PyObject *v, PyObject *pointer_type,
+               PyObject *error_obj, int depth) {
+  if (depth > 32) return 1; /* pathological nesting: Python recursion rules */
+  PyTypeObject *t = Py_TYPE(v);
+  if ((PyObject *)t == pointer_type) {
+    uint8_t b[17];
+    b[0] = 0x04; /* _H_POINTER */
+    if (key_bytes(v, b + 1) < 0) return -1;
+    b2b_update(c, b, 17);
+    return 0;
+  }
+  if (v == Py_None) {
+    uint8_t b = 0x00; /* _H_NONE */
+    b2b_update(c, &b, 1);
+    return 0;
+  }
+  if (v == error_obj) {
+    uint8_t b = 0x0d; /* _H_ERROR */
+    b2b_update(c, &b, 1);
+    return 0;
+  }
+  if (t == &PyBool_Type) {
+    uint8_t b[2] = {0x01, (uint8_t)(v == Py_True ? 1 : 0)};
+    b2b_update(c, b, 2);
+    return 0;
+  }
+  if (t == &PyLong_Type) {
+    uint8_t b[17];
+    b[0] = 0x02; /* _H_INT */
+    if (long_to_signed16(v, b + 1) < 0) return -1;
+    b2b_update(c, b, 17);
+    return 0;
+  }
+  if (t == &PyFloat_Type) {
+    double f = PyFloat_AS_DOUBLE(v);
+    uint8_t b[17];
+    if (f == f && !std::isinf(f) && std::fabs(f) < 9223372036854775808.0 &&
+        std::trunc(f) == f) {
+      /* integral in-range floats hash as ints (engine equality) */
+      b[0] = 0x02;
+      long long x = (long long)f;
+      memcpy(b + 1, &x, 8);
+      memset(b + 9, x < 0 ? 0xff : 0x00, 8);
+      b2b_update(c, b, 17);
+    } else {
+      b[0] = 0x03; /* _H_FLOAT */
+      memcpy(b + 1, &f, 8);
+      b2b_update(c, b, 9);
+    }
+    return 0;
+  }
+  if (t == &PyUnicode_Type) {
+    Py_ssize_t len;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &len);
+    if (!s) return -1;
+    uint8_t hdr[9];
+    hdr[0] = 0x05; /* _H_STRING */
+    uint64_t l = (uint64_t)len;
+    memcpy(hdr + 1, &l, 8);
+    b2b_update(c, hdr, 9);
+    b2b_update(c, (const uint8_t *)s, (size_t)len);
+    return 0;
+  }
+  if (t == &PyBytes_Type) {
+    uint8_t hdr[9];
+    hdr[0] = 0x06; /* _H_BYTES */
+    uint64_t l = (uint64_t)PyBytes_GET_SIZE(v);
+    memcpy(hdr + 1, &l, 8);
+    b2b_update(c, hdr, 9);
+    b2b_update(c, (const uint8_t *)PyBytes_AS_STRING(v), (size_t)l);
+    return 0;
+  }
+  if (t == &PyTuple_Type || t == &PyList_Type) {
+    int is_tuple = t == &PyTuple_Type;
+    Py_ssize_t sz = is_tuple ? PyTuple_GET_SIZE(v) : PyList_GET_SIZE(v);
+    uint8_t hdr[9];
+    hdr[0] = 0x07; /* _H_TUPLE */
+    uint64_t l = (uint64_t)sz;
+    memcpy(hdr + 1, &l, 8);
+    b2b_update(c, hdr, 9);
+    for (Py_ssize_t i = 0; i < sz; i++) {
+      PyObject *item =
+          is_tuple ? PyTuple_GET_ITEM(v, i) : PyList_GET_ITEM(v, i);
+      int rc = feed_value(c, item, pointer_type, error_obj, depth + 1);
+      if (rc != 0) return rc;
+    }
+    return 0;
+  }
+  /* ndarray / datetime / Json / wrapper / np scalars / subclasses:
+   * the Python serializer owns these */
+  return 1;
+}
+
+/* call the per-item Python fallback; must return exactly 16 bytes */
+int fallback_digest(PyObject *fallback, PyObject *item, uint8_t out[16]) {
+  PyObject *d = PyObject_CallFunctionObjArgs(fallback, item, nullptr);
+  if (!d) return -1;
+  if (!PyBytes_Check(d) || PyBytes_GET_SIZE(d) != 16) {
+    Py_DECREF(d);
+    PyErr_SetString(PyExc_ValueError, "fallback must return 16 bytes");
+    return -1;
+  }
+  memcpy(out, PyBytes_AS_STRING(d), 16);
+  Py_DECREF(d);
+  return 0;
+}
+
+/* hash_tuples_batch(rows, salt, bare, Pointer, ERROR, fallback)
+ *   -> (n,16) uint8 digest matrix.
+ * rows: list (or 1-D object ndarray) of value tuples — or of bare values
+ * when bare is true (the object-column coding path hands the column array
+ * straight in; no [(v,) for v in col.tolist()] materialization).
+ * fallback(item) -> bytes16 computes any row the native serializer
+ * cannot, carrying the caller's on_type_error semantics. */
+PyObject *hash_tuples_batch(PyObject *, PyObject *args) {
+  HIT(H_HASH_TUPLES_BATCH);
+  PyObject *rows, *salt_obj, *pointer_type, *error_obj, *fallback;
+  int bare;
+  if (!PyArg_ParseTuple(args, "OO!pOOO", &rows, &PyBytes_Type, &salt_obj,
+                        &bare, &pointer_type, &error_obj, &fallback))
+    return nullptr;
+  Py_ssize_t n;
+  int is_list = PyList_Check(rows);
+  PyObject **items = nullptr;
+  if (is_list) {
+    n = PyList_GET_SIZE(rows);
+  } else if (PyArray_Check(rows)) {
+    PyArrayObject *a = (PyArrayObject *)rows;
+    if (PyArray_TYPE(a) != NPY_OBJECT || PyArray_NDIM(a) != 1 ||
+        !PyArray_IS_C_CONTIGUOUS(a)) {
+      PyErr_SetString(PyExc_ValueError,
+                      "rows must be a list or contiguous 1-D object array");
+      return nullptr;
+    }
+    n = PyArray_DIM(a, 0);
+    items = (PyObject **)PyArray_BYTES(a);
+  } else {
+    PyErr_SetString(PyExc_TypeError, "rows must be a list or object ndarray");
+    return nullptr;
+  }
+  const uint8_t *salt = (const uint8_t *)PyBytes_AS_STRING(salt_obj);
+  size_t saltlen = (size_t)PyBytes_GET_SIZE(salt_obj);
+  npy_intp dims[2] = {n, 16};
+  PyObject *out = PyArray_SimpleNew(2, dims, NPY_UINT8);
+  if (!out) return nullptr;
+  uint8_t *ob = (uint8_t *)PyArray_BYTES((PyArrayObject *)out);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *row = is_list ? PyList_GET_ITEM(rows, i) : items[i];
+    B2BCtx c;
+    b2b_init(&c);
+    if (saltlen) b2b_update(&c, salt, saltlen);
+    int rc = 0;
+    if (bare) {
+      rc = feed_value(&c, row, pointer_type, error_obj, 0);
+    } else if (PyTuple_CheckExact(row) || PyList_CheckExact(row)) {
+      int is_tuple = PyTuple_CheckExact(row);
+      Py_ssize_t sz =
+          is_tuple ? PyTuple_GET_SIZE(row) : PyList_GET_SIZE(row);
+      for (Py_ssize_t j = 0; j < sz; j++) {
+        PyObject *v =
+            is_tuple ? PyTuple_GET_ITEM(row, j) : PyList_GET_ITEM(row, j);
+        rc = feed_value(&c, v, pointer_type, error_obj, 0);
+        if (rc != 0) break;
+      }
+    } else {
+      rc = 1; /* exotic row container: fallback iterates it */
+    }
+    if (rc < 0) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    if (rc == 1) {
+      if (fallback_digest(fallback, row, ob + i * 16) < 0) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+    } else {
+      b2b_final(&c, ob + i * 16);
+    }
+  }
+  return out;
+}
+
+/* 16 little-endian bytes (u128) mod n — identical to Python int % n for
+ * the non-negative 128-bit keys/digests this is applied to. */
+inline int64_t mod_u128(const uint8_t b[16], uint64_t n) {
+  unsigned __int128 x;
+  memcpy(&x, b, 16); /* little-endian host assumed, as in b2b_compress */
+  return (int64_t)(x % n);
+}
+
+/* shard_values(values, salt, n, Pointer, ERROR, fallback)
+ *   -> int64[n] worker ids | None (whole-call bail).
+ * The batched routing._shard_of: exact Pointers take int(v) % n on their
+ * key bytes; everything else digests (salt + value) and folds mod n;
+ * values the native serializer cannot feed go through fallback(v) ->
+ * bytes16 (which carries the TypeError->repr rule). Pointer SUBCLASSES
+ * bail the whole call — isinstance semantics route them to int(v) % n,
+ * which only the Python path does safely for arbitrary ints. */
+PyObject *shard_values(PyObject *, PyObject *args) {
+  HIT(H_SHARD_VALUES);
+  PyObject *values, *salt_obj, *pointer_type, *error_obj, *fallback;
+  Py_ssize_t nshards;
+  if (!PyArg_ParseTuple(args, "O!O!nOOO", &PyList_Type, &values,
+                        &PyBytes_Type, &salt_obj, &nshards, &pointer_type,
+                        &error_obj, &fallback))
+    return nullptr;
+  if (nshards <= 0 || !PyType_Check(pointer_type)) Py_RETURN_NONE;
+  uint64_t nn = (uint64_t)nshards;
+  const uint8_t *salt = (const uint8_t *)PyBytes_AS_STRING(salt_obj);
+  size_t saltlen = (size_t)PyBytes_GET_SIZE(salt_obj);
+  Py_ssize_t n = PyList_GET_SIZE(values);
+  npy_intp dims[1] = {n};
+  PyObject *out = PyArray_SimpleNew(1, dims, NPY_INT64);
+  if (!out) return nullptr;
+  npy_int64 *od = (npy_int64 *)PyArray_BYTES((PyArrayObject *)out);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *v = PyList_GET_ITEM(values, i);
+    uint8_t digest[16];
+    if ((PyObject *)Py_TYPE(v) == pointer_type) {
+      if (key_bytes(v, digest) < 0) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+      od[i] = mod_u128(digest, nn);
+      continue;
+    }
+    if (PyObject_TypeCheck(v, (PyTypeObject *)pointer_type)) {
+      Py_DECREF(out);
+      Py_RETURN_NONE;
+    }
+    B2BCtx c;
+    b2b_init(&c);
+    if (saltlen) b2b_update(&c, salt, saltlen);
+    int rc = feed_value(&c, v, pointer_type, error_obj, 0);
+    if (rc < 0) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    if (rc == 1) {
+      if (fallback_digest(fallback, v, digest) < 0) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+    } else {
+      b2b_final(&c, digest);
+    }
+    od[i] = mod_u128(digest, nn);
+  }
+  return out;
+}
+
+/* entries_to_side(entries, on_cols, arity, Pointer)
+ *   -> (kb, [col ndarrays]) | None (bail to the Python path).
+ * One pass builds what JoinNode._side_from_batch assembles from row
+ * entries: the (n,16) key-byte matrix plus every column as a typed array
+ * (int64/float64/bool for clean exact-typed columns, object otherwise).
+ * Bails whenever the Python screens would: any diff != 1, a non-exact
+ * Pointer key, or a join-key column that is not cleanly numeric/bool
+ * (string join keys keep their Python-path handling). */
+PyObject *entries_to_side(PyObject *, PyObject *args) {
+  HIT(H_ENTRIES_TO_SIDE);
+  PyObject *entries, *on_cols, *pointer_type;
+  Py_ssize_t arity;
+  if (!PyArg_ParseTuple(args, "O!O!nO", &PyList_Type, &entries,
+                        &PyList_Type, &on_cols, &arity, &pointer_type))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(entries);
+  Py_ssize_t k = PyList_GET_SIZE(on_cols);
+  if (n == 0 || arity <= 0) Py_RETURN_NONE;
+  std::vector<char> is_jk((size_t)arity, 0);
+  for (Py_ssize_t c = 0; c < k; c++) {
+    Py_ssize_t idx = PyLong_AsSsize_t(PyList_GET_ITEM(on_cols, c));
+    if (idx == -1 && PyErr_Occurred()) return nullptr;
+    if (idx < 0 || idx >= arity) Py_RETURN_NONE;
+    is_jk[(size_t)idx] = 1;
+  }
+  npy_intp kdims[2] = {n, 16};
+  PyObject *kb = PyArray_SimpleNew(2, kdims, NPY_UINT8);
+  if (!kb) return nullptr;
+  uint8_t *kdata = (uint8_t *)PyArray_BYTES((PyArrayObject *)kb);
+  std::vector<ColKind> kinds((size_t)arity, K_UNSET);
+  /* pass 1: screens (shape, diffs, exact-Pointer keys) + column kinds */
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(entries, i);
+    if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 3) goto bail;
+    {
+      PyObject *key = PyTuple_GET_ITEM(e, 0);
+      PyObject *row = PyTuple_GET_ITEM(e, 1);
+      PyObject *diff = PyTuple_GET_ITEM(e, 2);
+      if (!PyLong_Check(diff) || PyLong_AsLong(diff) != 1) {
+        if (PyErr_Occurred()) goto fail;
+        goto bail;
+      }
+      if ((PyObject *)Py_TYPE(key) != pointer_type) goto bail;
+      if (key_bytes(key, kdata + i * 16) < 0) goto fail;
+      if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) != arity) goto bail;
+      for (Py_ssize_t c = 0; c < arity; c++) {
+        if (kinds[(size_t)c] == K_FAIL) continue;
+        PyTypeObject *t = Py_TYPE(PyTuple_GET_ITEM(row, c));
+        ColKind kc = t == &PyLong_Type    ? K_INT
+                     : t == &PyFloat_Type ? K_FLOAT
+                     : t == &PyBool_Type  ? K_BOOL
+                                          : K_FAIL;
+        if (kinds[(size_t)c] == K_UNSET)
+          kinds[(size_t)c] = kc;
+        else if (kinds[(size_t)c] != kc)
+          kinds[(size_t)c] = K_FAIL;
+      }
+    }
+  }
+  for (Py_ssize_t c = 0; c < arity; c++)
+    if (is_jk[(size_t)c] && kinds[(size_t)c] == K_FAIL)
+      goto bail; /* string/object join keys: Python path semantics */
+  /* pass 2: typed column fill */
+  {
+    PyObject *cols = PyList_New(arity);
+    if (!cols) goto fail;
+    for (Py_ssize_t c = 0; c < arity; c++) {
+      ColKind kind = kinds[(size_t)c];
+      npy_intp dims[1] = {n};
+      PyObject *arr = nullptr;
+      if (kind == K_INT) {
+        arr = PyArray_SimpleNew(1, dims, NPY_INT64);
+        if (!arr) goto fail_cols;
+        npy_int64 *d = (npy_int64 *)PyArray_BYTES((PyArrayObject *)arr);
+        for (Py_ssize_t i = 0; i < n; i++) {
+          PyObject *v = PyTuple_GET_ITEM(
+              PyTuple_GET_ITEM(PyList_GET_ITEM(entries, i), 1), c);
+          int overflow = 0;
+          long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+          if (overflow || (x == -1 && PyErr_Occurred())) {
+            PyErr_Clear();
+            Py_DECREF(arr);
+            arr = nullptr;
+            if (is_jk[(size_t)c]) {
+              Py_DECREF(cols);
+              goto bail; /* bigint join key: Python bails this side too */
+            }
+            kind = K_FAIL; /* bigint payload column: keep exact objects */
+            break;
+          }
+          d[i] = (npy_int64)x;
+        }
+      } else if (kind == K_FLOAT) {
+        arr = PyArray_SimpleNew(1, dims, NPY_FLOAT64);
+        if (!arr) goto fail_cols;
+        npy_double *d = (npy_double *)PyArray_BYTES((PyArrayObject *)arr);
+        for (Py_ssize_t i = 0; i < n; i++)
+          d[i] = PyFloat_AS_DOUBLE(PyTuple_GET_ITEM(
+              PyTuple_GET_ITEM(PyList_GET_ITEM(entries, i), 1), c));
+      } else if (kind == K_BOOL) {
+        arr = PyArray_SimpleNew(1, dims, NPY_BOOL);
+        if (!arr) goto fail_cols;
+        npy_bool *d = (npy_bool *)PyArray_BYTES((PyArrayObject *)arr);
+        for (Py_ssize_t i = 0; i < n; i++)
+          d[i] = PyTuple_GET_ITEM(
+                     PyTuple_GET_ITEM(PyList_GET_ITEM(entries, i), 1), c) ==
+                 Py_True;
+      }
+      if (kind == K_FAIL || kind == K_UNSET) {
+        arr = PyArray_SimpleNew(1, dims, NPY_OBJECT);
+        if (!arr) goto fail_cols;
+        PyObject **d = (PyObject **)PyArray_BYTES((PyArrayObject *)arr);
+        memset(d, 0, sizeof(PyObject *) * (size_t)n);
+        for (Py_ssize_t i = 0; i < n; i++) {
+          PyObject *v = PyTuple_GET_ITEM(
+              PyTuple_GET_ITEM(PyList_GET_ITEM(entries, i), 1), c);
+          Py_INCREF(v);
+          d[i] = v;
+        }
+      }
+      PyList_SET_ITEM(cols, c, arr);
+    }
+    return Py_BuildValue("(NN)", kb, cols);
+  fail_cols:
+    Py_DECREF(cols);
+    goto fail;
+  }
+bail:
+  Py_DECREF(kb);
+  Py_RETURN_NONE;
+fail:
+  Py_DECREF(kb);
+  return nullptr;
+}
+
+inline uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+/* match_pairs_i64(l_cols, r_cols) -> (l_idx, r_idx).
+ * Hash-join core over dtype-unified int64 code columns, result-identical
+ * to _match_join_pairs_multi INCLUDING output order: the larger side
+ * probes in row order (ties probe left), and each probe row's matches
+ * list the build side ascending. Runs GIL-free over raw buffers. */
+PyObject *match_pairs_i64(PyObject *, PyObject *args) {
+  HIT(H_MATCH_PAIRS_I64);
+  PyObject *l_cols, *r_cols;
+  if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &l_cols, &PyList_Type,
+                        &r_cols))
+    return nullptr;
+  Py_ssize_t k = PyList_GET_SIZE(l_cols);
+  if (k < 1 || PyList_GET_SIZE(r_cols) != k) {
+    PyErr_SetString(PyExc_ValueError, "need matching non-empty column lists");
+    return nullptr;
+  }
+  std::vector<const int64_t *> lp((size_t)k), rp((size_t)k);
+  Py_ssize_t nl = -1, nr = -1;
+  for (int side = 0; side < 2; side++) {
+    PyObject *cols = side == 0 ? l_cols : r_cols;
+    for (Py_ssize_t c = 0; c < k; c++) {
+      PyObject *col = PyList_GET_ITEM(cols, c);
+      if (!PyArray_Check(col)) {
+        PyErr_SetString(PyExc_TypeError, "columns must be ndarrays");
+        return nullptr;
+      }
+      PyArrayObject *a = (PyArrayObject *)col;
+      if (PyArray_NDIM(a) != 1 || PyArray_TYPE(a) != NPY_INT64 ||
+          !PyArray_IS_C_CONTIGUOUS(a)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "columns must be contiguous 1-D int64");
+        return nullptr;
+      }
+      Py_ssize_t len = PyArray_DIM(a, 0);
+      Py_ssize_t &expect = side == 0 ? nl : nr;
+      if (expect < 0)
+        expect = len;
+      else if (expect != len) {
+        PyErr_SetString(PyExc_ValueError, "column length mismatch");
+        return nullptr;
+      }
+      (side == 0 ? lp : rp)[(size_t)c] =
+          (const int64_t *)PyArray_BYTES(a);
+    }
+  }
+  /* probe = larger side; ties probe left (matches _match_join_pairs) */
+  int probe_is_left = nl >= nr;
+  const std::vector<const int64_t *> &pc = probe_is_left ? lp : rp;
+  const std::vector<const int64_t *> &bc = probe_is_left ? rp : lp;
+  Py_ssize_t np_ = probe_is_left ? nl : nr;
+  Py_ssize_t nb = probe_is_left ? nr : nl;
+  std::vector<int64_t> out_p, out_b;
+  if (np_ > 0 && nb > 0) {
+    Py_BEGIN_ALLOW_THREADS;
+    size_t cap = 8;
+    while ((Py_ssize_t)cap < 2 * nb) cap <<= 1;
+    std::vector<int64_t> head(cap, -1), nxt((size_t)nb);
+    /* reverse-order prepends leave each bucket chain ascending by index */
+    for (Py_ssize_t r = nb - 1; r >= 0; r--) {
+      uint64_t h = 0;
+      for (Py_ssize_t c = 0; c < k; c++)
+        h = mix64(h ^ (uint64_t)bc[(size_t)c][r]);
+      size_t b = (size_t)h & (cap - 1);
+      nxt[(size_t)r] = head[b];
+      head[b] = r;
+    }
+    for (Py_ssize_t i = 0; i < np_; i++) {
+      uint64_t h = 0;
+      for (Py_ssize_t c = 0; c < k; c++)
+        h = mix64(h ^ (uint64_t)pc[(size_t)c][i]);
+      for (int64_t j = head[(size_t)h & (cap - 1)]; j != -1;
+           j = nxt[(size_t)j]) {
+        int eq = 1;
+        for (Py_ssize_t c = 0; c < k; c++)
+          if (pc[(size_t)c][i] != bc[(size_t)c][j]) {
+            eq = 0;
+            break;
+          }
+        if (eq) {
+          out_p.push_back(i);
+          out_b.push_back(j);
+        }
+      }
+    }
+    Py_END_ALLOW_THREADS;
+  }
+  npy_intp dims[1] = {(npy_intp)out_p.size()};
+  PyObject *l_idx = PyArray_SimpleNew(1, dims, NPY_INT64);
+  PyObject *r_idx = PyArray_SimpleNew(1, dims, NPY_INT64);
+  if (!l_idx || !r_idx) {
+    Py_XDECREF(l_idx);
+    Py_XDECREF(r_idx);
+    return nullptr;
+  }
+  if (!out_p.empty()) {
+    memcpy(PyArray_BYTES((PyArrayObject *)(probe_is_left ? l_idx : r_idx)),
+           out_p.data(), out_p.size() * 8);
+    memcpy(PyArray_BYTES((PyArrayObject *)(probe_is_left ? r_idx : l_idx)),
+           out_b.data(), out_b.size() * 8);
+  }
+  return Py_BuildValue("(NN)", l_idx, r_idx);
+}
+
+/* session_overlay(buffer, state, upsert) -> entries list | None.
+ * The InputSession.flush overlay loops: resolve each buffered update
+ * against prior state plus this commit's earlier updates. `state` is
+ * only read; the overlay dict lives and dies here. Bails (None) on any
+ * malformed buffer entry; comparison errors (e.g. ndarray cells in a
+ * remove) propagate exactly as the Python loop would raise them. */
+PyObject *session_overlay(PyObject *, PyObject *args) {
+  HIT(H_SESSION_OVERLAY);
+  PyObject *buffer, *state;
+  int upsert;
+  if (!PyArg_ParseTuple(args, "O!O!p", &PyList_Type, &buffer, &PyDict_Type,
+                        &state, &upsert))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(buffer);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(buffer, i);
+    if (!PyTuple_Check(e) || PyTuple_GET_SIZE(e) != 3 ||
+        !PyLong_Check(PyTuple_GET_ITEM(e, 2)))
+      Py_RETURN_NONE;
+    if (upsert && PyTuple_GET_ITEM(e, 1) == Py_None) {
+      long long d = PyLong_AsLongLong(PyTuple_GET_ITEM(e, 2));
+      if (d == -1 && PyErr_Occurred()) return nullptr;
+      if (d > 0) Py_RETURN_NONE; /* Python path asserts on this shape */
+    }
+  }
+  PyObject *overlay = PyDict_New();
+  PyObject *out = PyList_New(0);
+  if (!overlay || !out) {
+    Py_XDECREF(overlay);
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *e = PyList_GET_ITEM(buffer, i);
+    PyObject *key = PyTuple_GET_ITEM(e, 0);
+    PyObject *row = PyTuple_GET_ITEM(e, 1);
+    PyObject *diff = PyTuple_GET_ITEM(e, 2);
+    long long d = PyLong_AsLongLong(diff);
+    if (d == -1 && PyErr_Occurred()) goto fail;
+    /* effective(key): overlay wins (None = removed), else prior state */
+    PyObject *prev = PyDict_GetItemWithError(overlay, key);
+    if (!prev) {
+      if (PyErr_Occurred()) goto fail;
+      prev = PyDict_GetItemWithError(state, key);
+      if (!prev && PyErr_Occurred()) goto fail;
+    }
+    if (prev == Py_None) prev = nullptr;
+    if (upsert) {
+      if (d > 0) {
+        if (prev) {
+          PyObject *retract = Py_BuildValue("(OOi)", key, prev, -1);
+          if (!retract || PyList_Append(out, retract) < 0) {
+            Py_XDECREF(retract);
+            goto fail;
+          }
+          Py_DECREF(retract);
+        }
+        PyObject *ins = Py_BuildValue("(OOi)", key, row, 1);
+        if (!ins || PyList_Append(out, ins) < 0) {
+          Py_XDECREF(ins);
+          goto fail;
+        }
+        Py_DECREF(ins);
+        if (PyDict_SetItem(overlay, key, row) < 0) goto fail;
+      } else if (prev) {
+        PyObject *retract = Py_BuildValue("(OOi)", key, prev, -1);
+        if (!retract || PyList_Append(out, retract) < 0) {
+          Py_XDECREF(retract);
+          goto fail;
+        }
+        Py_DECREF(retract);
+        if (PyDict_SetItem(overlay, key, Py_None) < 0) goto fail;
+      }
+    } else {
+      if (d < 0 && row == Py_None) {
+        if (!prev) continue; /* row-less removal of an absent key */
+        row = prev;
+      }
+      /* appending before the overlay update keeps `row` (possibly
+       * borrowed from the overlay) alive across the SetItem below; the
+       * Python loop's append-after order is observably identical */
+      PyObject *entry = PyTuple_New(3);
+      if (!entry) goto fail;
+      Py_INCREF(key);
+      PyTuple_SET_ITEM(entry, 0, key);
+      Py_INCREF(row);
+      PyTuple_SET_ITEM(entry, 1, row);
+      Py_INCREF(diff);
+      PyTuple_SET_ITEM(entry, 2, diff);
+      if (PyList_Append(out, entry) < 0) {
+        Py_DECREF(entry);
+        goto fail;
+      }
+      Py_DECREF(entry);
+      if (d > 0) {
+        if (PyDict_SetItem(overlay, key, row) < 0) goto fail;
+      } else {
+        PyObject *eff = prev ? prev : Py_None;
+        int eq = PyObject_RichCompareBool(eff, row, Py_EQ);
+        if (eq < 0) goto fail; /* e.g. ndarray cells: Python raises too */
+        if (eq && PyDict_SetItem(overlay, key, Py_None) < 0) goto fail;
+      }
+    }
+  }
+  Py_DECREF(overlay);
+  return out;
+fail:
+  Py_DECREF(overlay);
+  Py_DECREF(out);
+  return nullptr;
+}
+
 PyMethodDef methods[] = {
     {"pointers_to_bytes", pointers_to_bytes, METH_VARARGS,
      "pointers_to_bytes(keys) -> (n,16) uint8 | None"},
@@ -991,6 +1769,23 @@ PyMethodDef methods[] = {
      "extract_column(seq, col, from_entries) -> ndarray|None"},
     {"entry_diffs", entry_diffs, METH_VARARGS,
      "entry_diffs(entries) -> int64 ndarray"},
+    {"hash_tuples_batch", hash_tuples_batch, METH_VARARGS,
+     "hash_tuples_batch(rows, salt, bare, Pointer, ERROR, fallback) -> "
+     "(n,16) uint8"},
+    {"shard_values", shard_values, METH_VARARGS,
+     "shard_values(values, salt, n, Pointer, ERROR, fallback) -> "
+     "int64[n] | None"},
+    {"entries_to_side", entries_to_side, METH_VARARGS,
+     "entries_to_side(entries, on_cols, arity, Pointer) -> "
+     "(kb, cols) | None"},
+    {"match_pairs_i64", match_pairs_i64, METH_VARARGS,
+     "match_pairs_i64(l_cols, r_cols) -> (l_idx, r_idx)"},
+    {"session_overlay", session_overlay, METH_VARARGS,
+     "session_overlay(buffer, state, upsert) -> entries | None"},
+    {"hit_counts", hit_counts, METH_NOARGS,
+     "hit_counts() -> {kernel: calls}"},
+    {"reset_hit_counts", reset_hit_counts, METH_NOARGS,
+     "reset_hit_counts()"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,

@@ -144,14 +144,43 @@ def test_pointer_column_routes_by_direct_mod():
         assert shards.tolist() == [int(p) % n for p in ptrs]
 
 
-def test_nan_float_column_falls_back_to_rows():
+def test_nan_float_column_stays_vectorized():
+    vals = [1.0, float("nan"), 2.0, 3.0]
     k = [ref_scalar(i) for i in range(4)]
-    cols = _columns([np.array([1.0, float("nan"), 2.0, 3.0])], k)
-    assert columnar_shards(("col", 0), cols, 3) is None
-    assert columnar_shards(("cols", [0]), cols, 3) is None
-    # NaN-free float columns stay vectorized
+    cols = _columns([np.array(vals)], k)
+    for n in NS:
+        shards = columnar_shards(("col", 0), cols, n)
+        assert shards is not None
+        assert shards.tolist() == [_shard_of(v, n) for v in vals]
+        tup = columnar_shards(("cols", [0]), cols, n)
+        assert tup is not None
+        assert tup.tolist() == [_shard_of((v,), n) for v in vals]
+    # NaN-free float columns stay vectorized too
     clean = _columns([np.array([1.0, 2.5, 2.5, 3.0])], k)
     assert columnar_shards(("col", 0), clean, 3) is not None
+
+
+def test_mixed_bit_nans_route_like_per_row_digests():
+    """Property: NaN payload bits are routing identity — distinct-bit NaNs
+    shard exactly as the per-row partitioners digest them, and equal-bit
+    NaNs land together. -0.0/+0.0 split into two factor classes but must
+    still route to the same worker (they digest identically)."""
+    import struct
+
+    rng = random.Random(7)
+    payload_nans = [
+        struct.unpack("<d", struct.pack("<Q", 0x7FF8000000000000 | p))[0]
+        for p in (0, 1, 2, 0xDEAD, 0xBEEF, (1 << 51) - 1)
+    ]
+    neg_nan = struct.unpack("<d", struct.pack("<Q", 0xFFF8000000000001))[0]
+    pool = payload_nans + [neg_nan, 0.0, -0.0, 1.5, -2.25, 1e300]
+    vals = [pool[rng.randrange(len(pool))] for _ in range(64)]
+    k = [ref_scalar(i) for i in range(len(vals))]
+    cols = _columns([np.array(vals)], k)
+    for n in NS:
+        shards = columnar_shards(("col", 0), cols, n)
+        assert shards is not None
+        assert shards.tolist() == [_shard_of(v, n) for v in vals]
 
 
 def test_int_valued_float_shards_with_int():

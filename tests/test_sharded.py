@@ -380,15 +380,20 @@ class TestColumnarShardRouting:
         ]
         assert shards.tolist() == expected
 
-        # NaN routing values: np.unique collapses distinct-bit NaNs that
-        # hash_values keeps apart — the vectorized path must decline
+        # NaN routing values stay vectorized: bit-pattern coding keeps
+        # distinct-bit NaNs apart, matching the per-row digests exactly
         c0f = c0.astype(np.float64)
         c0f[3] = float("nan")
         nan_payload = Columns(600, [c0f, c1, c2], kobjs=keys)
         nan_batch = DeltaBatch.from_columns(
             nan_payload, consolidated=True, insert_only=True
         )
-        assert sched._columnar_shards(gb0, 0, nan_batch) is None
+        nan_shards = sched._columnar_shards(gb0, 0, nan_batch)
+        assert nan_shards is not None
+        assert nan_shards.tolist() == [
+            _shard_of((float(a), str(b)), n)
+            for a, b in zip(c0f.tolist(), c1.tolist())
+        ]
 
     def test_sharded_multikey_join_groupby_matches_single(self):
         """2-key join -> 2-key groupby over 4 workers equals the
