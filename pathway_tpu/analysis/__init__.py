@@ -1,0 +1,79 @@
+"""Pre-execution static analysis over the engine graph.
+
+The reference engine validates dataflow programs at graph-build time (its
+``Graph`` trait carries typed column properties end to end); this package
+is the equivalent floor for the TPU build: :func:`analyze_scope` walks a
+built :class:`~pathway_tpu.engine.graph.Scope` *before* the scheduler
+starts and returns a :class:`Report` of structured findings —
+
+1. dtype/schema propagation (``analysis.dtypes``) — contradictions that
+   would otherwise surface mid-stream as runtime ``Error`` values;
+2. dead-column / unused-operator detection (``analysis.usage``) — the
+   projection-pushdown report;
+3. shard-preservation / exchange-redundancy analysis (``analysis.shards``);
+4. UDF determinism & purity lint (``analysis.udf_lint``).
+
+Entry points: ``pathway_tpu.cli analyze prog.py`` (human-readable report,
+exit 0/1/2), ``Scope.run(strict=True)`` / ``pw.run(strict=True)`` (raise
+:class:`AnalysisError` on error-severity findings), ``tools/check.py``
+(pre-PR gate).
+"""
+
+from __future__ import annotations
+
+import traceback
+
+from pathway_tpu.analysis.findings import (  # noqa: F401 — public API
+    FINDING_CODES,
+    AnalysisError,
+    Finding,
+    Report,
+    Severity,
+)
+from pathway_tpu.analysis.runtime import analyze_only, enabled  # noqa: F401
+
+__all__ = [
+    "FINDING_CODES",
+    "AnalysisError",
+    "Finding",
+    "Report",
+    "Severity",
+    "analyze_only",
+    "analyze_scope",
+    "check_strict",
+    "enabled",
+]
+
+
+def analyze_scope(scope) -> Report:
+    """Run all four analyses over a built engine scope.
+
+    A crash inside one pass is recorded in ``report.internal_errors`` (the
+    CLI maps those to exit code 2) and never masks the other passes'
+    findings — an analyzer bug must not look like a program bug.
+    """
+    from pathway_tpu.analysis import dtypes, shards, udf_lint, usage
+
+    report = Report(node_count=len(scope.nodes))
+    passes = [
+        ("dtypes", dtypes.run_pass),
+        ("usage", usage.run_pass),
+        ("shards", shards.run_pass),
+        ("udf_lint", udf_lint.run_pass),
+    ]
+    for name, run in passes:
+        try:
+            run(scope, report)
+        except Exception:  # noqa: BLE001 — collected, not raised
+            tail = traceback.format_exc(limit=4)
+            report.internal_errors.append(f"pass {name!r} crashed: {tail}")
+    return report
+
+
+def check_strict(scope) -> Report:
+    """Analyze and raise :class:`AnalysisError` on error-severity findings
+    (the ``strict=True`` mode of ``Scope.run`` / ``pw.run``)."""
+    report = analyze_scope(scope)
+    if report.error_count:
+        raise AnalysisError(report)
+    return report

@@ -1,0 +1,814 @@
+"""Pass 1 — dtype/schema propagation over the engine Node DAG.
+
+Infers a set of possible :class:`~pathway_tpu.engine.value.Type` members for
+every column of every node, walking ``scope.nodes`` in construction order
+(inputs always precede their consumers, so the list is already
+topologically sorted).  Column types are ``frozenset[Type]``:
+
+- ``{Type.ANY}`` — unknown / opaque (the analysis stays silent);
+- ``Type.NONE`` as a member — the column is optional;
+- a concrete set with no valid interpretation for an operation —
+  a finding, because at runtime the same row would poison to ``Error``.
+
+Soundness rule: a finding is only emitted when the contradiction is
+*provable*, i.e. every concrete interpretation of the operand types fails.
+``ANY`` anywhere suppresses the check.  This keeps the pass silent on
+graphs built without schema hints while still catching the classic
+runtime-``Error`` sources (string minus int, join on disjoint key dtypes,
+sum over tuples, flatten over scalars).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from pathway_tpu.analysis.findings import Finding, Report, Severity
+from pathway_tpu.engine import expression as ex
+from pathway_tpu.engine import graph as g
+from pathway_tpu.engine.reducers import ReducerKind
+from pathway_tpu.engine.value import Type, value_type_of
+
+TS = frozenset  # frozenset[Type]
+
+ANY: TS = frozenset({Type.ANY})
+BOOL: TS = frozenset({Type.BOOL})
+INT: TS = frozenset({Type.INT})
+TUPLE: TS = frozenset({Type.TUPLE})
+POINTER: TS = frozenset({Type.POINTER})
+
+_NUMERIC = {Type.BOOL, Type.INT, Type.FLOAT}
+_INTISH = {Type.BOOL, Type.INT}
+_SEQ = {Type.TUPLE, Type.LIST}
+_DATES = {Type.DATE_TIME_NAIVE, Type.DATE_TIME_UTC}
+#: value kinds a FlattenNode can expand via list(value)
+_FLATTENABLE = {
+    Type.TUPLE,
+    Type.LIST,
+    Type.ARRAY,
+    Type.STRING,
+    Type.BYTES,
+    Type.JSON,
+}
+#: value kinds SUM-style numeric reducers accept
+_SUMMABLE = {
+    Type.BOOL,
+    Type.INT,
+    Type.FLOAT,
+    Type.DURATION,
+    Type.ARRAY,
+    Type.STRING,  # str concatenation via + still works in this engine
+    Type.BYTES,
+    Type.TUPLE,  # tuple concatenation
+    Type.LIST,
+}
+
+
+def _num2(lt: Type, rt: Type, float_result: bool = False) -> Type | None:
+    if lt in _NUMERIC and rt in _NUMERIC:
+        if float_result or Type.FLOAT in (lt, rt):
+            return Type.FLOAT
+        return Type.INT
+    return None
+
+
+def _binary_result(op: str, lt: Type, rt: Type) -> Type | None:
+    """Result type of ``lt op rt`` for concrete operand types, or None when
+    the pair is invalid.  Mirrors the runtime semantics of
+    ``expression._BINARY_OPS`` (plain Python operators + numpy ``@``)."""
+    arr = Type.ARRAY
+    if op in ("==", "!="):
+        return Type.BOOL
+    if op in ("<", "<=", ">", ">="):
+        if arr in (lt, rt) and (lt == rt or lt in _NUMERIC or rt in _NUMERIC):
+            return arr  # elementwise comparison
+        if lt in _NUMERIC and rt in _NUMERIC:
+            return Type.BOOL
+        if lt == rt and lt in (
+            Type.STRING,
+            Type.BYTES,
+            Type.DURATION,
+            Type.POINTER,
+            Type.DATE_TIME_NAIVE,
+            Type.DATE_TIME_UTC,
+            Type.TUPLE,
+            Type.LIST,
+        ):
+            return Type.BOOL
+        if lt in _SEQ and rt in _SEQ:
+            return Type.BOOL
+        return None
+    if arr in (lt, rt) and op != "@":
+        # numpy broadcasts arrays against numbers and other arrays
+        if lt == rt or lt in _NUMERIC or rt in _NUMERIC:
+            return arr
+        return None
+    if op == "+":
+        n = _num2(lt, rt)
+        if n is not None:
+            return n
+        if lt == rt and lt in (Type.STRING, Type.BYTES, Type.DURATION):
+            return lt
+        if lt in _SEQ and rt in _SEQ:
+            return Type.TUPLE
+        if lt in _DATES and rt == Type.DURATION:
+            return lt
+        if lt == Type.DURATION and rt in _DATES:
+            return rt
+        return None
+    if op == "-":
+        n = _num2(lt, rt)
+        if n is not None:
+            return n
+        if lt == rt and lt == Type.DURATION:
+            return Type.DURATION
+        if lt in _DATES and rt == lt:
+            return Type.DURATION
+        if lt in _DATES and rt == Type.DURATION:
+            return lt
+        return None
+    if op == "*":
+        n = _num2(lt, rt)
+        if n is not None:
+            return n
+        for a, b in ((lt, rt), (rt, lt)):
+            if b in _INTISH:
+                if a in (Type.STRING, Type.BYTES):
+                    return a
+                if a in _SEQ:
+                    return Type.TUPLE
+            if a == Type.DURATION and b in _NUMERIC:
+                return Type.DURATION
+        return None
+    if op == "/":
+        if lt in _NUMERIC and rt in _NUMERIC:
+            return Type.FLOAT
+        if lt == Type.DURATION and rt in _NUMERIC:
+            return Type.DURATION
+        if lt == Type.DURATION and rt == Type.DURATION:
+            return Type.FLOAT
+        return None
+    if op == "//":
+        n = _num2(lt, rt)
+        if n is not None:
+            return n
+        if lt == Type.DURATION and rt == Type.DURATION:
+            return Type.INT
+        if lt == Type.DURATION and rt in _NUMERIC:
+            return Type.DURATION
+        return None
+    if op == "%":
+        if lt == Type.STRING:
+            return Type.STRING  # printf-style formatting
+        n = _num2(lt, rt)
+        if n is not None:
+            return n
+        if lt == Type.DURATION and rt == Type.DURATION:
+            return Type.DURATION
+        return None
+    if op == "**":
+        return _num2(lt, rt)
+    if op in ("&", "|", "^"):
+        if lt in _INTISH and rt in _INTISH:
+            return Type.BOOL if lt == rt == Type.BOOL else Type.INT
+        return None
+    if op in ("<<", ">>"):
+        if lt in _INTISH and rt in _INTISH:
+            return Type.INT
+        return None
+    if op == "@":
+        if lt == arr and rt == arr:
+            return arr
+        return None
+    return None
+
+
+def _unary_result(op: str, t: Type) -> Type | None:
+    if op == "not":
+        return Type.BOOL
+    if op in ("-", "abs"):
+        if t in _NUMERIC:
+            return Type.INT if t in _INTISH else Type.FLOAT
+        if t in (Type.DURATION, Type.ARRAY):
+            return t
+        return None
+    if op == "~":
+        if t in _INTISH:
+            return Type.INT
+        if t == Type.ARRAY:
+            return t
+        return None
+    return None
+
+
+class _ExprTyper:
+    """Types one EngineExpression tree against its input column types."""
+
+    def __init__(self, pass_: "_DtypePass", node: g.Node, in_cols: list[TS]):
+        self.pass_ = pass_
+        self.node = node
+        self.in_cols = in_cols
+
+    def report(self, message: str, column: int | None = None) -> None:
+        self.pass_.report(
+            "PWA001", self.node, message, column=column
+        )
+
+    def infer(self, expr: ex.EngineExpression) -> TS:
+        if isinstance(expr, ex.ColumnRef):
+            if 0 <= expr.index < len(self.in_cols):
+                return self.in_cols[expr.index]
+            self.report(
+                f"column reference col[{expr.index}] is out of range "
+                f"(input has {len(self.in_cols)} columns)"
+            )
+            return ANY
+        if isinstance(expr, ex.KeyRef):
+            return POINTER
+        if isinstance(expr, ex.Const):
+            return frozenset({value_type_of(expr.value)})
+        if isinstance(expr, ex.Binary):
+            return self._binary(expr)
+        if isinstance(expr, ex.Unary):
+            return self._unary(expr)
+        if isinstance(expr, ex.BooleanChain):
+            for arg in expr.args:
+                self.infer(arg)
+            return BOOL
+        if isinstance(expr, ex.IfElse):
+            cond = self.infer(expr.cond)
+            if cond == frozenset({Type.NONE}):
+                self.report("if_else condition is always None")
+            return self.infer(expr.then) | self.infer(expr.otherwise)
+        if isinstance(expr, ex.IsNone):
+            self.infer(expr.arg)
+            return BOOL
+        if isinstance(expr, ex.Coalesce):
+            out: set[Type] = set()
+            all_optional = True
+            for arg in expr.args:
+                ts = self.infer(arg)
+                out |= set(ts) - {Type.NONE}
+                if Type.NONE not in ts and Type.ANY not in ts:
+                    all_optional = False
+                    break  # later args are never reached
+            if all_optional:
+                out.add(Type.NONE)
+            return frozenset(out) if out else frozenset({Type.NONE})
+        if isinstance(expr, ex.Require):
+            for dep in expr.deps:
+                self.infer(dep)
+            return self.infer(expr.value) | {Type.NONE}
+        if isinstance(expr, ex.MakeTuple):
+            for arg in expr.args:
+                self.infer(arg)
+            return TUPLE
+        if isinstance(expr, ex.SequenceGet):
+            seq = self.infer(expr.arg)
+            self.infer(expr.index)
+            if expr.default is not None:
+                self.infer(expr.default)
+            concrete = set(seq) - {Type.NONE}
+            indexable = _FLATTENABLE | {Type.ANY}
+            if concrete and not (concrete & indexable):
+                self.report(
+                    "sequence get over a value that is never a sequence "
+                    f"(type is {_fmt(seq)})"
+                )
+            return ANY
+        if isinstance(expr, ex.JsonGet):
+            self.infer(expr.arg)
+            self.infer(expr.index)
+            if expr.default is not None:
+                self.infer(expr.default)
+            return frozenset({Type.JSON, Type.NONE, Type.ANY})
+        if isinstance(expr, ex.Cast):
+            return self._cast(expr)
+        if isinstance(expr, ex.Convert):
+            target = {
+                "Int": Type.INT,
+                "Float": Type.FLOAT,
+                "Bool": Type.BOOL,
+                "String": Type.STRING,
+                "List": Type.TUPLE,
+            }.get(expr.target, Type.ANY)
+            self.infer(expr.arg)
+            return frozenset({target, Type.NONE})
+        if isinstance(expr, ex.Unwrap):
+            ts = self.infer(expr.arg)
+            if ts == frozenset({Type.NONE}):
+                self.report("unwrap() over an always-None value")
+                return ANY
+            out = set(ts) - {Type.NONE}
+            return frozenset(out) if out else ANY
+        if isinstance(expr, ex.FillError):
+            return self.infer(expr.arg) | self.infer(expr.fallback)
+        if isinstance(expr, ex.Apply):
+            for arg in expr.args:
+                self.infer(arg)
+            return _apply_return_type(expr.fn)
+        if isinstance(expr, ex.PointerFrom):
+            for arg in expr.args:
+                self.infer(arg)
+            if expr.instance is not None:
+                self.infer(expr.instance)
+            return POINTER
+        return ANY  # unknown expression kind: stay silent
+
+    def _operand(self, ts: TS, op: str, side: str) -> set[Type] | None:
+        """Concrete operand members for a binary/unary op; None = skip the
+        check (ANY present, or the operand is runtime-guarded None)."""
+        if Type.ANY in ts:
+            return None
+        concrete = set(ts) - {Type.NONE}
+        if not concrete:
+            if op not in ex._NONE_SAFE_OPS:
+                self.report(
+                    f"{side} operand of {op!r} is always None "
+                    "(the runtime reports every such row as Error)"
+                )
+            return None
+        return concrete
+
+    def _binary(self, expr: ex.Binary) -> TS:
+        lts = self.infer(expr.left)
+        rts = self.infer(expr.right)
+        left = self._operand(lts, expr.op, "left")
+        right = self._operand(rts, expr.op, "right")
+        if left is None or right is None:
+            return ANY
+        results = {
+            r
+            for lt in left
+            for rt in right
+            if (r := _binary_result(expr.op, lt, rt)) is not None
+        }
+        if not results:
+            self.report(
+                f"operator {expr.op!r} can never apply to operand types "
+                f"{_fmt(lts)} and {_fmt(rts)}"
+            )
+            return ANY
+        return frozenset(results)
+
+    def _unary(self, expr: ex.Unary) -> TS:
+        ts = self.infer(expr.arg)
+        operand = self._operand(ts, expr.op, "the")
+        if operand is None:
+            return ANY
+        results = {
+            r for t in operand if (r := _unary_result(expr.op, t)) is not None
+        }
+        if not results:
+            self.report(
+                f"unary {expr.op!r} can never apply to type {_fmt(ts)}"
+            )
+            return ANY
+        return frozenset(results)
+
+    def _cast(self, expr: ex.Cast) -> TS:
+        ts = self.infer(expr.arg)
+        target = {
+            "Int": Type.INT,
+            "Float": Type.FLOAT,
+            "Bool": Type.BOOL,
+            "String": Type.STRING,
+        }.get(expr.target, Type.ANY)
+        castable = {
+            # int()/float() accept numbers and numeric strings; bool() and
+            # str() accept anything
+            Type.INT: _NUMERIC | {Type.STRING},
+            Type.FLOAT: _NUMERIC | {Type.STRING},
+        }.get(target)
+        concrete = set(ts) - {Type.NONE}
+        if (
+            castable is not None
+            and concrete
+            and Type.ANY not in concrete
+            and not (concrete & castable)
+        ):
+            self.pass_.report(
+                "PWA008",
+                self.node,
+                f"cast to {expr.target} from type {_fmt(ts)} can never "
+                "succeed",
+                severity=Severity.WARNING,
+            )
+        out = {target}
+        if Type.NONE in ts or Type.ANY in ts:
+            out.add(Type.NONE)  # Cast passes None through
+        return frozenset(out)
+
+
+def _apply_return_type(fn) -> TS:
+    """Map a UDF's return annotation to an engine type when obvious."""
+    simple = {
+        "int": Type.INT,
+        "float": Type.FLOAT,
+        "bool": Type.BOOL,
+        "str": Type.STRING,
+        "bytes": Type.BYTES,
+        "tuple": Type.TUPLE,
+        "list": Type.LIST,
+    }
+    try:
+        ann = getattr(fn, "__annotations__", {}).get("return")
+    except Exception:  # noqa: BLE001
+        return ANY
+    if ann is None:
+        return ANY
+    name = ann if isinstance(ann, str) else getattr(ann, "__name__", None)
+    t = simple.get(name)
+    return frozenset({t}) if t is not None else ANY
+
+
+def _fmt(ts: TS) -> str:
+    names = sorted(t.name for t in ts)
+    return names[0] if len(names) == 1 else "{" + "|".join(names) + "}"
+
+
+def _comparable(lts: TS, rts: TS) -> bool:
+    """Can values of these types ever compare equal (join keys)?"""
+    lc = set(lts) - {Type.NONE}
+    rc = set(rts) - {Type.NONE}
+    if not lc or not rc or Type.ANY in lc or Type.ANY in rc:
+        return True
+    # numeric cross-equality (1 == 1.0 == True) and Pointer-as-int
+    groups = [_NUMERIC | {Type.POINTER}, _SEQ]
+    for gset in groups:
+        if lc & gset and rc & gset:
+            return True
+    return bool(lc & rc)
+
+
+class _DtypePass:
+    def __init__(self, scope: g.Scope, report: Report) -> None:
+        self.scope = scope
+        self.out = report
+        #: node index -> output column types
+        self.types: dict[int, list[TS]] = {}
+
+    def report(
+        self,
+        code: str,
+        node: g.Node,
+        message: str,
+        *,
+        column: int | None = None,
+        severity: Severity | None = None,
+    ) -> None:
+        from pathway_tpu.analysis.findings import FINDING_CODES
+
+        self.out.add(
+            Finding(
+                code=code,
+                message=message,
+                node_index=node.index,
+                node_name=node.name,
+                severity=severity or FINDING_CODES[code][0],
+                column=column,
+                trace=getattr(node, "trace", None) or None,
+            )
+        )
+
+    def run(self) -> dict[int, list[TS]]:
+        for node in self.scope.nodes:
+            try:
+                cols = self._infer_node(node)
+            except Exception:  # noqa: BLE001 — one bad node must not
+                cols = None  # silence the whole pass; fall through to ANY
+            if cols is None:
+                cols = [ANY] * node.arity
+            # robustness: never let a transfer-function bug corrupt widths
+            if len(cols) < node.arity:
+                cols = cols + [ANY] * (node.arity - len(cols))
+            elif len(cols) > node.arity:
+                cols = cols[: node.arity]
+            self.types[node.index] = cols
+        return self.types
+
+    def _in(self, node: g.Node, port: int = 0) -> list[TS]:
+        src = node.inputs[port]
+        return self.types.get(src.index, [ANY] * src.arity)
+
+    def _declared(self, node: g.Node) -> list[TS] | None:
+        """Schema hint attached by the framework runner (internals/runner.py
+        sets ``node.schema_types`` from the Table dtypes)."""
+        hint = getattr(node, "schema_types", None)
+        if hint is None or len(hint) != node.arity:
+            return None
+        return [frozenset(ts) for ts in hint]
+
+    # -- per-node transfer functions ---------------------------------------
+
+    def _infer_node(self, node: g.Node) -> list[TS] | None:
+        from pathway_tpu.engine import temporal as t
+        from pathway_tpu.engine.iterate import IterateNode
+
+        if isinstance(node, g.StaticSource):
+            return self._static_source(node)
+        if isinstance(node, g.InputSession):
+            return self._declared(node) or [ANY] * node.arity
+        if isinstance(node, g.ExpressionNode):
+            typer = _ExprTyper(self, node, self._in(node))
+            return [typer.infer(e) for e in node.expressions]
+        if isinstance(node, g.BatchApplyNode):
+            return self._declared(node) or [_apply_return_type(node.rows_fn)]
+        if isinstance(node, g.FilterNode):
+            return self._filter(node)
+        if isinstance(node, g.ConcatNode):
+            return self._concat(node)
+        if isinstance(node, g.ReindexNode):
+            self._require_pointer(node, self._in(node), node.key_col)
+            return self._in(node)
+        if isinstance(node, (g.KeyFilterNode, g.OverrideUniverseNode)):
+            return self._in(node)
+        if isinstance(node, g._RemoveErrorsNode):
+            return self._in(node)
+        if isinstance(node, g.ZipNode):
+            out: list[TS] = []
+            for port in range(len(node.inputs)):
+                out.extend(self._in(node, port))
+            return out
+        if isinstance(node, g.JoinNode):
+            return self._join(node)
+        if isinstance(node, g.GroupbyNode):
+            return self._groupby(node)
+        if isinstance(node, g.DeduplicateNode):
+            return self._in(node)
+        if isinstance(node, g.FlattenNode):
+            return self._flatten(node)
+        if isinstance(node, g.SortNode):
+            opt_ptr = frozenset({Type.POINTER, Type.NONE})
+            return [opt_ptr, opt_ptr]
+        if isinstance(node, g.IxNode):
+            return self._ix(node)
+        if isinstance(node, g.UpdateRowsNode):
+            a, b = self._in(node, 0), self._in(node, 1)
+            return [x | y for x, y in zip(a, b)]
+        if isinstance(node, g.UpdateCellsNode):
+            orig, upd = self._in(node, 0), self._in(node, 1)
+            out = []
+            for i, uc in enumerate(node.update_cols):
+                base = orig[i] if i < len(orig) else ANY
+                if uc >= 0 and uc < len(upd):
+                    out.append(base | upd[uc])
+                else:
+                    out.append(base)
+            return out
+        if isinstance(node, g.SubscribeNode):
+            return self._in(node)
+        if isinstance(node, g.ErrorLogNode):
+            return [frozenset({Type.STRING})]
+        if isinstance(node, (g.RecomputeNode, IterateNode)):
+            return self._declared(node) or [ANY] * node.arity
+        if isinstance(node, (t.BufferNode, t.FreezeNode)):
+            return self._in(node)
+        if isinstance(node, t.ForgetNode):
+            src = self._in(node)
+            return src + [BOOL] if node.mark else src
+        if isinstance(node, t.SessionAssignNode):
+            src = self._in(node)
+            time_ts = (
+                src[node.time_col] if node.time_col < len(src) else ANY
+            )
+            return src + [time_ts, time_ts]
+        if isinstance(node, (t.IntervalJoinNode, t.AsofJoinNode)):
+            return self._temporal_join(node)
+        if isinstance(node, t.AsofNowJoinNode):
+            return self._asof_now(node)
+        if isinstance(node, t.GradualBroadcastNode):
+            return self._in(node) + [ANY]
+        return self._declared(node)  # unknown node kind: hint or ANY
+
+    def _static_source(self, node: g.StaticSource) -> list[TS]:
+        declared = self._declared(node)
+        rows = node._rows[:100]
+        if not rows:
+            return declared or [ANY] * node.arity
+        cols: list[set[Type]] = [set() for _ in range(node.arity)]
+        for _key, row in rows:
+            for i in range(min(node.arity, len(row))):
+                try:
+                    cols[i].add(value_type_of(row[i]))
+                except Exception:  # noqa: BLE001
+                    cols[i].add(Type.ANY)
+        sampled = [frozenset(c) if c else ANY for c in cols]
+        if len(node._rows) > 100:
+            # partial sample: the tail may widen any column
+            sampled = [ts | {Type.ANY} for ts in sampled]
+        return sampled
+
+    def _filter(self, node: g.FilterNode) -> list[TS]:
+        src = self._in(node)
+        c = node.condition_col
+        cond = src[c] if 0 <= c < len(src) else ANY
+        if cond == frozenset({Type.NONE}):
+            self.report(
+                "PWA002",
+                node,
+                "filter condition column is always None — the output is "
+                "provably empty",
+                column=c,
+            )
+        elif Type.ANY not in cond and not (set(cond) & (_NUMERIC | {Type.NONE})):
+            self.report(
+                "PWA002",
+                node,
+                f"filter condition column has type {_fmt(cond)}, not a "
+                "boolean",
+                column=c,
+                severity=Severity.WARNING,
+            )
+        return src
+
+    def _concat(self, node: g.ConcatNode) -> list[TS]:
+        ins = [self._in(node, p) for p in range(len(node.inputs))]
+        out: list[TS] = []
+        for i in range(node.arity):
+            col_sets = [src[i] if i < len(src) else ANY for src in ins]
+            merged = frozenset().union(*col_sets)
+            concrete = [
+                set(ts) - {Type.NONE}
+                for ts in col_sets
+                if Type.ANY not in ts and set(ts) - {Type.NONE}
+            ]
+            if len(concrete) > 1:
+                base = concrete[0]
+                for other in concrete[1:]:
+                    if not _comparable(frozenset(base), frozenset(other)):
+                        self.report(
+                            "PWA007",
+                            node,
+                            "concat inputs disagree on the column type: "
+                            + " vs ".join(
+                                _fmt(frozenset(c)) for c in concrete
+                            ),
+                            column=i,
+                        )
+                        break
+            out.append(merged)
+        return out
+
+    def _require_pointer(
+        self, node: g.Node, src: list[TS], col: int, what: str = "key column"
+    ) -> None:
+        ts = src[col] if 0 <= col < len(src) else ANY
+        concrete = set(ts) - {Type.NONE}
+        if concrete and Type.ANY not in concrete and Type.POINTER not in concrete:
+            # int keys hash like pointers in this engine, so only flag
+            # types that can never act as a row id
+            if not (concrete & _INTISH):
+                self.report(
+                    "PWA004",
+                    node,
+                    f"{what} has type {_fmt(ts)}; a Pointer is required",
+                    column=col,
+                )
+
+    def _join(self, node: g.JoinNode) -> list[TS]:
+        left, right = self._in(node, 0), self._in(node, 1)
+        for lc, rc in zip(node.left_on, node.right_on):
+            lts = left[lc] if lc < len(left) else ANY
+            rts = right[rc] if rc < len(right) else ANY
+            if not _comparable(lts, rts):
+                self.report(
+                    "PWA003",
+                    node,
+                    f"join keys can never match: left col {lc} is "
+                    f"{_fmt(lts)}, right col {rc} is {_fmt(rts)}",
+                )
+        k = node.kind
+        lcols = list(left)
+        rcols = list(right)
+        if k in (g.JoinKind.RIGHT, g.JoinKind.OUTER):
+            lcols = [ts | {Type.NONE} for ts in lcols]
+        if k in (g.JoinKind.LEFT, g.JoinKind.OUTER):
+            rcols = [ts | {Type.NONE} for ts in rcols]
+        return lcols + rcols
+
+    def _groupby(self, node: g.GroupbyNode) -> list[TS]:
+        src = self._in(node)
+        out = [src[c] if c < len(src) else ANY for c in node.by_cols]
+        for reducer, arg_cols in node.reducers:
+            arg_ts = (
+                src[arg_cols[0]]
+                if arg_cols and arg_cols[0] < len(src)
+                else ANY
+            )
+            kind = getattr(reducer, "kind", None)
+            if kind in (ReducerKind.COUNT, ReducerKind.COUNT_DISTINCT):
+                out.append(INT)
+            elif kind in (ReducerKind.ARG_MIN, ReducerKind.ARG_MAX):
+                out.append(POINTER)
+            elif kind in (ReducerKind.SORTED_TUPLE, ReducerKind.TUPLE):
+                out.append(TUPLE)
+            elif kind == ReducerKind.NDARRAY:
+                out.append(frozenset({Type.ARRAY}))
+            elif kind == ReducerKind.SUM:
+                concrete = set(arg_ts) - {Type.NONE}
+                if (
+                    concrete
+                    and Type.ANY not in concrete
+                    and not (concrete & _SUMMABLE)
+                ):
+                    self.report(
+                        "PWA006",
+                        node,
+                        f"sum reducer over type {_fmt(arg_ts)} can never "
+                        "be computed",
+                        column=len(out),
+                    )
+                out.append(arg_ts)
+            elif kind in (
+                ReducerKind.MIN,
+                ReducerKind.MAX,
+                ReducerKind.ANY,
+                ReducerKind.UNIQUE,
+                ReducerKind.EARLIEST,
+                ReducerKind.LATEST,
+            ):
+                out.append(arg_ts)
+            else:  # STATEFUL and future kinds
+                out.append(ANY)
+        return out
+
+    def _flatten(self, node: g.FlattenNode) -> list[TS]:
+        src = self._in(node)
+        fc = node.flat_col
+        flat_ts = src[fc] if 0 <= fc < len(src) else ANY
+        concrete = set(flat_ts) - {Type.NONE}
+        if concrete and Type.ANY not in concrete and not (
+            concrete & _FLATTENABLE
+        ):
+            self.report(
+                "PWA005",
+                node,
+                f"flatten over type {_fmt(flat_ts)}, which is never a "
+                "sequence",
+                column=fc,
+            )
+        elem: TS
+        if concrete <= {Type.STRING}:
+            elem = frozenset({Type.STRING})
+        elif concrete <= {Type.BYTES}:
+            elem = INT
+        else:
+            elem = ANY
+        out = [elem if i == fc else ts for i, ts in enumerate(src)]
+        if node.with_origin:
+            out.append(POINTER)
+        return out
+
+    def _ix(self, node: g.IxNode) -> list[TS]:
+        keys_in = self._in(node, 0)
+        source_in = self._in(node, 1)
+        self._require_pointer(node, keys_in, node.key_col, "ix key column")
+        if node.optional:
+            return [ts | {Type.NONE} for ts in source_in]
+        return list(source_in)
+
+    def _temporal_join(self, node) -> list[TS]:
+        from pathway_tpu.engine.graph import JoinKind
+
+        left, right = self._in(node, 0), self._in(node, 1)
+        lt_ts = left[node.lt] if node.lt < len(left) else ANY
+        rt_ts = right[node.rt] if node.rt < len(right) else ANY
+        if not _comparable(lt_ts, rt_ts):
+            self.report(
+                "PWA003",
+                node,
+                f"temporal join time columns can never align: left is "
+                f"{_fmt(lt_ts)}, right is {_fmt(rt_ts)}",
+            )
+        lcols = list(left)
+        rcols = list(right)
+        if node.kind in (JoinKind.RIGHT, JoinKind.OUTER):
+            lcols = [ts | {Type.NONE} for ts in lcols]
+        if node.kind in (JoinKind.LEFT, JoinKind.OUTER):
+            rcols = [ts | {Type.NONE} for ts in rcols]
+        return lcols + rcols
+
+    def _asof_now(self, node) -> list[TS]:
+        from pathway_tpu.engine.graph import JoinKind
+
+        left, right = self._in(node, 0), self._in(node, 1)
+        for lc, rc in zip(node.left_on, node.right_on):
+            lts = left[lc] if lc < len(left) else ANY
+            rts = right[rc] if rc < len(right) else ANY
+            if not _comparable(lts, rts):
+                self.report(
+                    "PWA003",
+                    node,
+                    f"asof_now join keys can never match: left col {lc} is "
+                    f"{_fmt(lts)}, right col {rc} is {_fmt(rts)}",
+                )
+        rcols = list(right)
+        if node.kind in (JoinKind.LEFT, JoinKind.OUTER):
+            rcols = [ts | {Type.NONE} for ts in rcols]
+        return list(left) + rcols
+
+
+def run_pass(scope: g.Scope, report: Report) -> dict[int, list[TS]]:
+    """Run dtype propagation; returns the node->column-types map (used by
+    tests and future optimisation passes)."""
+    return _DtypePass(scope, report).run()

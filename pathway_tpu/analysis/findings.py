@@ -1,0 +1,178 @@
+"""Structured results of the pre-execution graph analyzer.
+
+Every analysis pass emits :class:`Finding` records tagged with a stable
+``PWAxxx`` code, a severity, and node provenance (index, name, build-site
+trace).  A :class:`Report` aggregates the findings for one engine
+:class:`~pathway_tpu.engine.graph.Scope` plus any internal analyzer
+failures — the latter are kept out of the findings list so an analyzer bug
+never masquerades as a program bug (the CLI maps them to exit code 2).
+
+Code ranges:
+
+- ``PWA0xx`` — dtype/schema contradictions (error severity unless noted)
+- ``PWA1xx`` — dead columns / unused operators
+- ``PWA2xx`` — shard/exchange advisories
+- ``PWA3xx`` — UDF determinism & purity lint
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+
+class Severity(str, Enum):
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+#: code -> (default severity, short title); the README table is generated
+#: from the same wording.
+FINDING_CODES: dict[str, tuple[Severity, str]] = {
+    "PWA001": (Severity.ERROR, "expression dtype contradiction"),
+    "PWA002": (Severity.ERROR, "filter condition is provably not usable"),
+    "PWA003": (Severity.ERROR, "join/temporal key dtype mismatch"),
+    "PWA004": (Severity.ERROR, "key column is provably not a pointer"),
+    "PWA005": (Severity.ERROR, "flatten over a provably non-sequence column"),
+    "PWA006": (Severity.ERROR, "reducer argument dtype invalid"),
+    "PWA007": (Severity.WARNING, "concat column dtype divergence"),
+    "PWA008": (Severity.WARNING, "cast/convert can never succeed"),
+    "PWA101": (Severity.WARNING, "dead column (never read downstream)"),
+    "PWA102": (Severity.WARNING, "unused operator (no consumer, no sink)"),
+    "PWA201": (Severity.INFO, "redundant exchange (already partitioned)"),
+    "PWA202": (Severity.INFO, "operator pins the stream to worker 0"),
+    "PWA301": (Severity.ERROR, "nondeterministic call in deterministic UDF"),
+    "PWA302": (Severity.WARNING, "order-sensitive set iteration in UDF"),
+    "PWA303": (Severity.WARNING, "UDF mutates ambient global state"),
+}
+
+
+@dataclass
+class Finding:
+    code: str
+    message: str
+    node_index: int
+    node_name: str
+    severity: Severity = Severity.ERROR
+    column: int | None = None
+    trace: str | None = None
+
+    def __post_init__(self) -> None:
+        assert self.code in FINDING_CODES, f"unknown finding code {self.code}"
+
+    @property
+    def title(self) -> str:
+        return FINDING_CODES[self.code][1]
+
+    def render(self) -> str:
+        where = f"{self.node_name}#{self.node_index}"
+        if self.column is not None:
+            where += f" col {self.column}"
+        line = f"{self.code} {self.severity.value:<7} {where}: {self.message}"
+        if self.trace:
+            line += f"  [{self.trace}]"
+        return line
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "code": self.code,
+            "severity": self.severity.value,
+            "message": self.message,
+            "node_index": self.node_index,
+            "node_name": self.node_name,
+            "column": self.column,
+            "trace": self.trace,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Finding":
+        return cls(
+            code=d["code"],
+            message=d["message"],
+            node_index=d["node_index"],
+            node_name=d["node_name"],
+            severity=Severity(d["severity"]),
+            column=d.get("column"),
+            trace=d.get("trace"),
+        )
+
+
+_SEV_ORDER = {Severity.ERROR: 0, Severity.WARNING: 1, Severity.INFO: 2}
+
+
+@dataclass
+class Report:
+    """All findings for one analyzed scope (or, in the CLI, a merge of
+    every scope a program built)."""
+
+    findings: list[Finding] = field(default_factory=list)
+    #: analyzer crashes (pass name + traceback tail) — never mixed into
+    #: ``findings``; any entry here means the analysis is incomplete
+    internal_errors: list[str] = field(default_factory=list)
+    node_count: int = 0
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def count(self, severity: Severity) -> int:
+        return sum(1 for f in self.findings if f.severity == severity)
+
+    @property
+    def error_count(self) -> int:
+        return self.count(Severity.ERROR)
+
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == Severity.ERROR]
+
+    def sorted_findings(self) -> list[Finding]:
+        return sorted(
+            self.findings,
+            key=lambda f: (_SEV_ORDER[f.severity], f.node_index, f.code),
+        )
+
+    def merge(self, other: "Report") -> None:
+        self.findings.extend(other.findings)
+        self.internal_errors.extend(other.internal_errors)
+        self.node_count += other.node_count
+
+    def render(self) -> str:
+        lines = [f"analyzed {self.node_count} operator(s)"]
+        for f in self.sorted_findings():
+            lines.append("  " + f.render())
+        for err in self.internal_errors:
+            lines.append(f"  INTERNAL ANALYZER ERROR: {err}")
+        lines.append(
+            "summary: "
+            f"{self.count(Severity.ERROR)} error(s), "
+            f"{self.count(Severity.WARNING)} warning(s), "
+            f"{self.count(Severity.INFO)} info"
+        )
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "node_count": self.node_count,
+            "findings": [f.to_dict() for f in self.findings],
+            "internal_errors": list(self.internal_errors),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Report":
+        return cls(
+            findings=[Finding.from_dict(f) for f in d.get("findings", [])],
+            internal_errors=list(d.get("internal_errors", [])),
+            node_count=d.get("node_count", 0),
+        )
+
+
+class AnalysisError(RuntimeError):
+    """Raised by strict mode when error-severity findings exist."""
+
+    def __init__(self, report: Report) -> None:
+        self.report = report
+        errors = report.errors()
+        lines = [f"{len(errors)} error-severity finding(s):"]
+        lines += ["  " + f.render() for f in errors]
+        super().__init__("\n".join(lines))

@@ -1,0 +1,55 @@
+"""Analyze-only execution mode (the machinery behind ``cli analyze``).
+
+``pathway_tpu.cli analyze prog.py`` runs ``prog.py`` in a subprocess with
+``PATHWAY_TPU_ANALYZE=1``.  In that mode the schedulers
+(``Scheduler.run_static/commit/finish``, ``ShardedScheduler.commit/finish``)
+call :func:`intercept` instead of executing: every scope that reaches a
+scheduler is analyzed exactly once and its report appended as one JSON
+line to ``PATHWAY_TPU_ANALYZE_OUT`` — the program builds its graphs
+normally, but no data ever flows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+#: scopes already analyzed this process; holds strong references so ids
+#: cannot be recycled
+_seen: list = []
+
+
+def enabled() -> bool:
+    """True when the process runs under ``cli analyze``."""
+    return os.environ.get("PATHWAY_TPU_ANALYZE") == "1"
+
+
+# the schedulers ask "should I skip execution?" — same predicate, named for
+# call-site readability (bench_dataflow keys its graph-only scaling off it)
+analyze_only = enabled
+
+
+def record_scope(scope) -> None:
+    """Analyze ``scope`` once and emit the report (JSONL file when
+    ``PATHWAY_TPU_ANALYZE_OUT`` is set, stderr otherwise)."""
+    if any(s is scope for s in _seen):
+        return
+    _seen.append(scope)
+    from pathway_tpu.analysis import analyze_scope
+
+    report = analyze_scope(scope)
+    out = os.environ.get("PATHWAY_TPU_ANALYZE_OUT")
+    if out:
+        with open(out, "a", encoding="utf-8") as f:
+            f.write(json.dumps(report.to_dict()) + "\n")
+    else:
+        print(report.render(), file=sys.stderr)
+
+
+def intercept(scope) -> bool:
+    """Scheduler gate: record + skip execution in analyze mode."""
+    if not enabled():
+        return False
+    record_scope(scope)
+    return True

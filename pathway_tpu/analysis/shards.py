@@ -1,0 +1,182 @@
+"""Pass 3 — shard-preservation analysis / exchange-redundancy report.
+
+Models how each operator's *output* batches are partitioned across workers
+under sharded execution (``engine/sharded.py`` delivers every producer →
+consumer edge through an exchange governed by ``partition_rule``).  The
+output partitioning of a node is a set of specs, each the normalized form
+of a partition rule:
+
+- ``("key",)``       — rows live on ``_shard_of(row_key)``
+- ``("cols", cols)`` — rows live on ``_shard_of(tuple(row[c] for c in cols))``
+- ``("col", c)``     — rows live on ``_shard_of(row[c])``
+
+An exchange into a consumer whose ``partition_rule`` is already in the
+producer's out-spec set provably moves no rows — flagged ``PWA201`` so an
+exchange-elision pass (or a human) can act on it, cross-checkable at
+runtime against ``EXCHANGE_STATS`` and ``native.hit_counts()``.
+
+Soundness notes (why the transfer functions below are what they are):
+
+- Groupby output keys are ``hash_values(by_vals, salt=gkey_salt)`` while
+  the exchange hashes with ``salt=b"shard"`` — so groupby output is *not*
+  key-partitioned, only cols-partitioned on its leading by-columns.
+- Join output carries the join-key values on both sides at known
+  positions, and matched rows hash identically through either side's
+  spec, so both specs hold.
+- A node whose arrival rule is ``("pin",)`` emits everything from worker
+  0; no partitioning property survives it.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.analysis.findings import Finding, Report, Severity
+from pathway_tpu.engine import graph as g
+from pathway_tpu.engine.sharded import partition_rule
+
+Spec = tuple
+
+
+def _norm(rule: tuple) -> Spec:
+    if rule[0] == "cols":
+        return ("cols", tuple(rule[1]))
+    return tuple(rule)
+
+
+def _spec_str(spec: Spec) -> str:
+    if spec[0] == "key":
+        return "by row key"
+    if spec[0] == "cols":
+        return f"by columns {list(spec[1])}"
+    return f"by column {spec[1]}"
+
+
+def _passthrough(node: g.Node) -> bool:
+    """Same keys, same column positions in = out."""
+    return isinstance(
+        node,
+        (
+            g.FilterNode,
+            g.KeyFilterNode,
+            g.OverrideUniverseNode,
+            g._RemoveErrorsNode,
+            g.DeduplicateNode,
+        ),
+    )
+
+
+def out_specs(node: g.Node) -> set[Spec]:
+    """Partitioning properties of ``node``'s output batches."""
+    arrival = _norm(partition_rule(node, 0))
+    if arrival[0] == "pin":
+        return set()
+    if isinstance(node, (g.StaticSource, g.InputSession)):
+        # sources are read whole on worker 0 and enter the exchange
+        # unpartitioned (sharded.py _route_source)
+        return set()
+    if isinstance(node, g.GroupbyNode):
+        # output rows land on the worker owning their by-values, and the
+        # by-values are the leading output columns
+        return {("cols", tuple(range(len(node.by_cols))))}
+    if isinstance(node, g.JoinNode):
+        la = node.inputs[0].arity
+        specs = {("cols", tuple(node.left_on))}
+        specs.add(("cols", tuple(la + c for c in node.right_on)))
+        if node.kind != g.JoinKind.INNER:
+            # padded (unmatched) rows carry None in the missing side's key
+            # columns yet still live on the surviving side's worker — only
+            # the surviving side's spec holds
+            specs = (
+                {("cols", tuple(node.left_on))}
+                if node.kind == g.JoinKind.LEFT
+                else {("cols", tuple(la + c for c in node.right_on))}
+                if node.kind == g.JoinKind.RIGHT
+                else set()
+            )
+        return specs
+    if _passthrough(node):
+        return {arrival}
+    if isinstance(
+        node,
+        (
+            g.ExpressionNode,
+            g.BatchApplyNode,
+            g.ConcatNode,
+            g.ZipNode,
+            g.UpdateRowsNode,
+            g.UpdateCellsNode,
+        ),
+    ):
+        # keys are preserved; column layout changes, so only a key-based
+        # arrival property survives
+        return {arrival} if arrival == ("key",) else set()
+    # rekeying / lookup / unknown kinds: nothing provable
+    return set()
+
+
+def run_pass(scope: g.Scope, report: Report) -> None:
+    from pathway_tpu.engine import temporal as t
+    from pathway_tpu.engine.graph import RecomputeNode
+    from pathway_tpu.engine.iterate import IterateNode
+
+    pinned_kinds = (
+        IterateNode,
+        RecomputeNode,
+        t.BufferNode,
+        t.ForgetNode,
+        t.FreezeNode,
+        t.SessionAssignNode,
+        t.IntervalJoinNode,
+        t.AsofJoinNode,
+        t.AsofNowJoinNode,
+        t.GradualBroadcastNode,
+    )
+    try:
+        from pathway_tpu.engine.external_index import ExternalIndexNode
+
+        pinned_kinds = pinned_kinds + (ExternalIndexNode,)
+    except ImportError:
+        pass
+
+    specs: dict[int, set[Spec]] = {}
+    for node in scope.nodes:
+        specs[node.index] = out_specs(node)
+        if isinstance(node, pinned_kinds):
+            report.add(
+                Finding(
+                    code="PWA202",
+                    message=(
+                        "globally-stateful operator funnels the stream "
+                        "through worker 0 under sharded execution"
+                    ),
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=Severity.INFO,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
+
+    for node in scope.nodes:
+        produced = specs[node.index]
+        if not produced:
+            continue
+        for consumer, port in node.consumers:
+            rule = _norm(partition_rule(consumer, port))
+            if rule[0] == "pin":
+                continue
+            if rule in produced:
+                report.add(
+                    Finding(
+                        code="PWA201",
+                        message=(
+                            f"exchange into {consumer.name}#{consumer.index} "
+                            f"(port {port}) is provably redundant: rows are "
+                            f"already partitioned {_spec_str(rule)} "
+                            "(cross-check: EXCHANGE_STATS / "
+                            "native.hit_counts())"
+                        ),
+                        node_index=node.index,
+                        node_name=node.name,
+                        severity=Severity.INFO,
+                        trace=getattr(node, "trace", None) or None,
+                    )
+                )

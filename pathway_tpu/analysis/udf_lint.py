@@ -1,0 +1,330 @@
+"""Pass 4 — UDF determinism / purity lint.
+
+AST-inspects the Python callables reachable from ``Apply`` expressions and
+``BatchApplyNode.rows_fn``.  Nondeterminism inside a UDF the engine
+believes is deterministic (``Apply.deterministic`` defaults True) silently
+breaks replay and checkpoint parity: a replayed run recomputes different
+values for the same keys, so retractions stop matching their insertions.
+
+Three checks:
+
+- ``PWA301`` (error) — calls into known nondeterminism sources
+  (``random``, ``time``, ``uuid``, ``secrets``, ``os.urandom``,
+  ``datetime.now``, ``id``) in a UDF marked deterministic;
+- ``PWA302`` (warning) — iteration order over a ``set`` literal /
+  comprehension / ``set()`` call feeding order-sensitive construction
+  (``for`` loops, ``list()``/``tuple()``/``join`` — ``sorted()`` is fine);
+- ``PWA303`` (warning) — ``global`` declarations that are assigned to,
+  i.e. ambient state mutation across rows.
+
+Builtins, C extensions, and callables whose source cannot be retrieved are
+skipped silently — the lint only ever inspects what it can parse, so it
+cannot produce false positives on opaque callables.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, Iterator
+
+from pathway_tpu.analysis.findings import Finding, Report, Severity
+from pathway_tpu.engine import expression as ex
+from pathway_tpu.engine import graph as g
+
+#: dotted-call prefixes that are nondeterministic across runs
+_NONDET_DOTTED = (
+    "random.",
+    "secrets.",
+    "np.random.",
+    "numpy.random.",
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.process_time",
+    "time.time_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "date.today",
+    "datetime.date.today",
+    "uuid.uuid1",
+    "uuid.uuid4",
+    "os.urandom",
+    "os.getpid",
+)
+
+#: bare names that are nondeterministic when called directly
+#: (``from random import random`` style imports, plus builtins)
+_NONDET_BARE = {
+    "id",
+    "urandom",
+    "uuid1",
+    "uuid4",
+    "random",
+    "randint",
+    "randrange",
+    "getrandbits",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "uniform",
+    "gauss",
+    "token_hex",
+    "token_bytes",
+    "perf_counter",
+    "monotonic",
+    "time_ns",
+}
+
+
+#: RNG constructors / reseeders that ARE deterministic when given an
+#: explicit seed argument (stdlib.ml._lsh, xpacks.llm.mocks style:
+#: ``np.random.default_rng(seed)``, ``random.Random(seed)``)
+_SEEDABLE_SUFFIXES = (".default_rng", ".RandomState", ".Random", ".seed")
+
+
+def _explicitly_seeded(name: str, call: "ast.Call") -> bool:
+    if not (call.args or call.keywords):
+        return False
+    return name.endswith(_SEEDABLE_SUFFIXES) or name in (
+        "default_rng",
+        "RandomState",
+        "Random",
+    )
+
+
+def _dotted_name(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_setish(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted_name(node.func) in ("set", "frozenset")
+    return False
+
+
+class _UdfVisitor(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.nondet_calls: list[str] = []
+        self.set_iterations: list[str] = []
+        self.global_names: set[str] = set()
+        self.mutated_globals: set[str] = set()
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.global_names.update(node.names)
+        self.generic_visit(node)
+
+    def _check_assign_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name) and target.id in self.global_names:
+            self.mutated_globals.add(target.id)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._check_assign_target(target)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_assign_target(node.target)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted_name(node.func)
+        if name is not None:
+            if _explicitly_seeded(name, node):
+                pass  # seeded RNG construction is deterministic
+            elif any(name == p or name.startswith(p) for p in _NONDET_DOTTED):
+                self.nondet_calls.append(name)
+            elif "." not in name and name in _NONDET_BARE:
+                self.nondet_calls.append(name)
+            # list(set(...)), tuple({...}), "".join(set(...)) — but
+            # sorted(set(...)) is deterministic
+            if name in ("list", "tuple") or name.endswith(".join"):
+                for arg in node.args:
+                    if _is_setish(arg):
+                        self.set_iterations.append(
+                            f"{name}() over a set"
+                        )
+        self.generic_visit(node)
+
+    def _check_iter(self, iter_node: ast.AST, where: str) -> None:
+        if _is_setish(iter_node):
+            self.set_iterations.append(where)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node.iter, "for loop over a set")
+        self.generic_visit(node)
+
+    def visit_comprehension_gens(self, generators) -> None:
+        for gen in generators:
+            self._check_iter(gen.iter, "comprehension over a set")
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self.visit_comprehension_gens(node.generators)
+        self.generic_visit(node)
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self.visit_comprehension_gens(node.generators)
+        self.generic_visit(node)
+
+    def visit_DictComp(self, node: ast.DictComp) -> None:
+        self.visit_comprehension_gens(node.generators)
+        self.generic_visit(node)
+
+
+def _candidate_functions(fn: Callable, depth: int = 0) -> Iterator[Callable]:
+    """The function itself plus user functions hidden behind wrapper
+    closures (the framework wraps UDFs in ``_make_kw_fn`` / executor
+    shells before they reach the engine)."""
+    if depth > 3 or not callable(fn):
+        return
+    seen = getattr(fn, "__wrapped__", None)
+    if seen is not None:
+        yield from _candidate_functions(seen, depth + 1)
+    if inspect.isfunction(fn):
+        yield fn
+        for cell in fn.__closure__ or ():
+            try:
+                inner = cell.cell_contents
+            except ValueError:
+                continue
+            if inspect.isfunction(inner):
+                yield from _candidate_functions(inner, depth + 1)
+    elif inspect.ismethod(fn):
+        yield from _candidate_functions(fn.__func__, depth + 1)
+    elif hasattr(fn, "__call__") and inspect.isfunction(
+        getattr(type(fn), "__call__", None)
+    ):
+        yield type(fn).__call__
+
+
+def _parse(fn: Callable) -> ast.AST | None:
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+        return ast.parse(source)
+    except (OSError, TypeError, SyntaxError, IndentationError, ValueError):
+        return None
+
+
+def lint_callable(
+    fn: Callable,
+    node: g.Node,
+    report: Report,
+    *,
+    deterministic: bool = True,
+    what: str = "UDF",
+) -> None:
+    seen_src: set[int] = set()
+    for candidate in _candidate_functions(fn):
+        code = getattr(candidate, "__code__", None)
+        if code is not None:
+            if id(code) in seen_src:
+                continue
+            seen_src.add(id(code))
+        # the framework's own wrapper shells (kw-arg adapters, executor
+        # shims) are not user code — but stdlib/xpacks UDFs are ours to lint
+        module = getattr(candidate, "__module__", "") or ""
+        if module.startswith(("pathway_tpu.internals", "pathway_tpu.engine")):
+            continue
+        tree = _parse(candidate)
+        if tree is None:
+            continue
+        visitor = _UdfVisitor()
+        visitor.visit(tree)
+        fname = getattr(candidate, "__name__", "<callable>")
+        if visitor.nondet_calls and deterministic:
+            calls = ", ".join(sorted(set(visitor.nondet_calls)))
+            report.add(
+                Finding(
+                    code="PWA301",
+                    message=(
+                        f"{what} {fname!r} calls nondeterministic "
+                        f"source(s) [{calls}] but is treated as "
+                        "deterministic — replay and checkpoint parity "
+                        "break (pass deterministic=False or remove the "
+                        "call)"
+                    ),
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=Severity.ERROR,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
+        for where in sorted(set(visitor.set_iterations)):
+            report.add(
+                Finding(
+                    code="PWA302",
+                    message=(
+                        f"{what} {fname!r}: {where} — set iteration order "
+                        "depends on hash seeding; wrap in sorted() for a "
+                        "stable order"
+                    ),
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=Severity.WARNING,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
+        if visitor.mutated_globals:
+            names = ", ".join(sorted(visitor.mutated_globals))
+            report.add(
+                Finding(
+                    code="PWA303",
+                    message=(
+                        f"{what} {fname!r} mutates global state "
+                        f"({names}) — per-row results depend on "
+                        "processing order"
+                    ),
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=Severity.WARNING,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
+
+
+def _apply_exprs(expr: ex.EngineExpression) -> Iterator[ex.Apply]:
+    if isinstance(expr, ex.Apply):
+        yield expr
+    for slot in getattr(type(expr), "__slots__", ()):
+        child = getattr(expr, slot, None)
+        if isinstance(child, ex.EngineExpression):
+            yield from _apply_exprs(child)
+        elif isinstance(child, (list, tuple)):
+            for item in child:
+                if isinstance(item, ex.EngineExpression):
+                    yield from _apply_exprs(item)
+
+
+def run_pass(scope: g.Scope, report: Report) -> None:
+    for node in scope.nodes:
+        if isinstance(node, g.ExpressionNode):
+            for expr in node.expressions:
+                for apply_expr in _apply_exprs(expr):
+                    lint_callable(
+                        apply_expr.fn,
+                        node,
+                        report,
+                        deterministic=apply_expr.deterministic,
+                        what="apply UDF",
+                    )
+        elif isinstance(node, g.BatchApplyNode):
+            lint_callable(
+                node.rows_fn,
+                node,
+                report,
+                deterministic=True,
+                what="batch-apply UDF",
+            )

@@ -1,0 +1,235 @@
+"""Pass 2 — dead-column and unused-operator detection.
+
+Backward liveness over the Node DAG: starting from the observation roots
+(SubscribeNode sinks; in sink-less engine graphs, every terminal node),
+each node kind maps the set of *used output columns* to the set of input
+columns it must read to produce them.  A column no consumer ever reads is
+dead — the projection-pushdown report.
+
+Severity policy: a dead column on a *source* (StaticSource/InputSession)
+is a warning — the program ingests data it never looks at; dead columns on
+intermediate operators are info-level (they are what a projection-pushdown
+optimisation would elide, not user-visible waste).  Operators with no
+consumers at all (in a graph that has sinks) are flagged once as unused
+instead of per-column.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.analysis.findings import Finding, Report, Severity
+from pathway_tpu.engine import expression as ex
+from pathway_tpu.engine import graph as g
+
+
+def expr_refs(expr: ex.EngineExpression, out: set[int] | None = None) -> set[int]:
+    """All input column indices an expression tree reads."""
+    if out is None:
+        out = set()
+    if isinstance(expr, ex.ColumnRef):
+        out.add(expr.index)
+        return out
+    children: list[ex.EngineExpression] = []
+    if isinstance(expr, ex.Binary):
+        children = [expr.left, expr.right]
+    elif isinstance(expr, ex.Unary):
+        children = [expr.arg]
+    elif isinstance(expr, (ex.BooleanChain, ex.MakeTuple, ex.Coalesce)):
+        children = list(expr.args)
+    elif isinstance(expr, ex.IfElse):
+        children = [expr.cond, expr.then, expr.otherwise]
+    elif isinstance(expr, (ex.IsNone, ex.Unwrap)):
+        children = [expr.arg]
+    elif isinstance(expr, ex.Require):
+        children = [expr.value, *expr.deps]
+    elif isinstance(expr, (ex.SequenceGet, ex.JsonGet)):
+        children = [expr.arg, expr.index]
+        if expr.default is not None:
+            children.append(expr.default)
+    elif isinstance(expr, (ex.Cast, ex.Convert)):
+        children = [expr.arg]
+    elif isinstance(expr, ex.FillError):
+        children = [expr.arg, expr.fallback]
+    elif isinstance(expr, ex.Apply):
+        children = list(expr.args)
+    elif isinstance(expr, ex.PointerFrom):
+        children = list(expr.args)
+        if expr.instance is not None:
+            children.append(expr.instance)
+    for child in children:
+        expr_refs(child, out)
+    return out
+
+
+def _all(node: g.Node) -> set[int]:
+    return set(range(node.arity))
+
+
+def input_needs(node: g.Node, used: set[int]) -> list[set[int]]:
+    """Input columns (one set per input port) ``node`` reads to produce the
+    ``used`` subset of its own output columns."""
+    from pathway_tpu.engine import temporal as t
+
+    if isinstance(node, g.ExpressionNode):
+        need: set[int] = set()
+        for i in used:
+            if i < len(node.expressions):
+                expr_refs(node.expressions[i], need)
+        return [need]
+    if isinstance(node, g.BatchApplyNode):
+        return [set(node.arg_cols)]
+    if isinstance(node, g.FilterNode):
+        return [used | {node.condition_col}]
+    if isinstance(node, g.ConcatNode):
+        return [set(used) for _ in node.inputs]
+    if isinstance(node, g.ReindexNode):
+        return [used | {node.key_col}]
+    if isinstance(node, g.KeyFilterNode):
+        # the extra inputs contribute keys only, never column values
+        return [set(used)] + [set() for _ in node.inputs[1:]]
+    if isinstance(node, (g.OverrideUniverseNode, g._RemoveErrorsNode)):
+        if isinstance(node, g._RemoveErrorsNode):
+            return [_all(node.inputs[0])]  # is_error() scans every value
+        return [set(used)]
+    if isinstance(node, g.ZipNode):
+        out: list[set[int]] = []
+        offset = 0
+        for inp in node.inputs:
+            out.append(
+                {i - offset for i in used if offset <= i < offset + inp.arity}
+            )
+            offset += inp.arity
+        return out
+    if isinstance(node, g.JoinNode):
+        la = node.inputs[0].arity
+        left = {i for i in used if i < la} | set(node.left_on)
+        right = {i - la for i in used if i >= la} | set(node.right_on)
+        if node.id_spec is not None and node.id_spec[1] is not None:
+            side, col = node.id_spec
+            (left if side == "left" else right).add(col)
+        return [left, right]
+    if isinstance(node, g.GroupbyNode):
+        need = set(node.by_cols)
+        nb = len(node.by_cols)
+        for j, (_reducer, arg_cols) in enumerate(node.reducers):
+            if nb + j in used:
+                need |= set(arg_cols)
+        return [need]
+    if isinstance(node, g.DeduplicateNode):
+        return [used | {node.value_col} | set(node.instance_cols)]
+    if isinstance(node, g.FlattenNode):
+        src_arity = node.inputs[0].arity
+        need = {i for i in used if i < src_arity}
+        need.add(node.flat_col)
+        return [need]
+    if isinstance(node, g.SortNode):
+        need = {node.key_col}
+        if node.instance_col is not None:
+            need.add(node.instance_col)
+        return [need]
+    if isinstance(node, g.IxNode):
+        return [{node.key_col}, set(used)]
+    if isinstance(node, g.UpdateRowsNode):
+        return [set(used), set(used)]
+    if isinstance(node, g.UpdateCellsNode):
+        upd = {
+            node.update_cols[i]
+            for i in used
+            if i < len(node.update_cols) and node.update_cols[i] >= 0
+        }
+        return [set(used), upd]
+    if isinstance(node, (g.SubscribeNode, g.ErrorLogNode)):
+        return [_all(inp) for inp in node.inputs]
+    if isinstance(node, (t.BufferNode, t.FreezeNode)):
+        return [used | {node.threshold_col, node.time_col}]
+    if isinstance(node, t.ForgetNode):
+        src_arity = node.inputs[0].arity
+        need = {i for i in used if i < src_arity}
+        return [need | {node.threshold_col, node.time_col}]
+    if isinstance(node, t.SessionAssignNode):
+        src_arity = node.inputs[0].arity
+        need = {i for i in used if i < src_arity} | {node.time_col}
+        if node.instance_col is not None:
+            need.add(node.instance_col)
+        return [need]
+    if isinstance(node, (t.IntervalJoinNode, t.AsofJoinNode)):
+        la = node.inputs[0].arity
+        left = {i for i in used if i < la} | {node.lt}
+        right = {i - la for i in used if i >= la} | {node.rt}
+        if node.li is not None:
+            left.add(node.li)
+        if node.ri is not None:
+            right.add(node.ri)
+        return [left, right]
+    if isinstance(node, t.AsofNowJoinNode):
+        la = node.inputs[0].arity
+        left = {i for i in used if i < la} | set(node.left_on)
+        right = {i - la for i in used if i >= la} | set(node.right_on)
+        return [left, right]
+    if isinstance(node, t.GradualBroadcastNode):
+        src_arity = node.inputs[0].arity
+        return [{i for i in used if i < src_arity}, {0, 1, 2}]
+    # unknown / opaque kinds (Iterate, Recompute, ExternalIndex, custom):
+    # assume every input column is read
+    return [_all(inp) for inp in node.inputs]
+
+
+def run_pass(scope: g.Scope, report: Report) -> dict[int, set[int]]:
+    """Backward liveness; returns node index -> used output columns."""
+    has_sinks = any(isinstance(n, g.SubscribeNode) for n in scope.nodes)
+    used: dict[int, set[int]] = {n.index: set() for n in scope.nodes}
+
+    for node in reversed(scope.nodes):
+        if isinstance(node, (g.SubscribeNode, g.ErrorLogNode)):
+            used[node.index] = _all(node)
+        elif not node.consumers and not has_sinks:
+            # engine-level graph driven by direct state reads (bench,
+            # engine tests): terminal state is the observable output
+            used[node.index] = _all(node)
+        needs = input_needs(node, used[node.index])
+        for port, inp in enumerate(node.inputs):
+            if port < len(needs):
+                used[inp.index] |= needs[port]
+
+    for node in scope.nodes:
+        if isinstance(node, (g.SubscribeNode, g.ErrorLogNode)):
+            continue
+        if not node.consumers:
+            if has_sinks:
+                report.add(
+                    Finding(
+                        code="PWA102",
+                        message=(
+                            "operator output is never consumed by any sink "
+                            "or downstream operator"
+                        ),
+                        node_index=node.index,
+                        node_name=node.name,
+                        severity=Severity.WARNING,
+                        trace=getattr(node, "trace", None) or None,
+                    )
+                )
+            continue
+        dead = sorted(set(range(node.arity)) - used[node.index])
+        if not dead:
+            continue
+        is_source = isinstance(node, (g.StaticSource, g.InputSession))
+        severity = Severity.WARNING if is_source else Severity.INFO
+        what = (
+            "ingested but never read — drop it at the source"
+            if is_source
+            else "computed but never read — a projection pushdown would "
+            "elide it"
+        )
+        for col in dead:
+            report.add(
+                Finding(
+                    code="PWA101",
+                    message=f"column is {what}",
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=severity,
+                    column=col,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
+    return used

@@ -9,16 +9,24 @@ connector, as with the reference's per-worker partitioned reads.
 
 ``spawn-from-env`` re-reads the full command from PATHWAY_SPAWN_ARGS —
 the container-deployment entry point (reference spawn_from_env).
+
+``python -m pathway_tpu.cli analyze prog.py args`` runs the program in
+graph-only mode (PATHWAY_TPU_ANALYZE=1): every dataflow graph the program
+builds is statically analyzed instead of executed, and a combined report
+is printed.  Exit codes: 0 = clean (info-level findings allowed), 1 =
+warning/error findings, 2 = the program or the analyzer itself failed.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import secrets
 import shlex
 import subprocess
 import sys
+import tempfile
 import uuid
 from typing import Sequence
 
@@ -70,6 +78,72 @@ def spawn(
     return 0
 
 
+def analyze(
+    program: str,
+    arguments: Sequence[str],
+    *,
+    as_json: bool = False,
+    errors_only: bool = False,
+    env: dict | None = None,
+) -> int:
+    """Run ``program`` under PATHWAY_TPU_ANALYZE=1 and report findings.
+
+    The child builds its graphs exactly as it would for a real run; the
+    schedulers intercept before any data flows and append one JSON report
+    per analyzed scope to a temp file, aggregated here."""
+    from pathway_tpu.analysis import Report, Severity
+
+    fd, out_path = tempfile.mkstemp(prefix="pathway-analyze-", suffix=".jsonl")
+    os.close(fd)
+    child_env = dict(os.environ if env is None else env)
+    child_env["PATHWAY_TPU_ANALYZE"] = "1"
+    child_env["PATHWAY_TPU_ANALYZE_OUT"] = out_path
+    try:
+        proc = subprocess.run(
+            [sys.executable, program, *arguments], env=child_env
+        )
+        if proc.returncode != 0:
+            print(
+                f"analyze: {program!r} exited with code {proc.returncode} "
+                "while building its graph",
+                file=sys.stderr,
+            )
+            return 2
+        merged = Report()
+        scope_count = 0
+        with open(out_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                merged.merge(Report.from_dict(json.loads(line)))
+                scope_count += 1
+        if scope_count == 0:
+            print(
+                f"analyze: {program!r} built no dataflow graph (nothing "
+                "reached a scheduler)",
+                file=sys.stderr,
+            )
+            return 2
+        if as_json:
+            print(json.dumps(merged.to_dict(), indent=2))
+        else:
+            print(f"analyzed {scope_count} graph(s)")
+            print(merged.render())
+        if merged.internal_errors:
+            return 2
+        if merged.error_count:
+            return 1
+        if not errors_only and merged.count(Severity.WARNING):
+            return 1
+        return 0
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -88,6 +162,22 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="run the command from the PATHWAY_SPAWN_ARGS env variable",
     )
 
+    p_analyze = sub.add_parser(
+        "analyze",
+        help="statically analyze the graphs a program builds, "
+        "without executing them",
+    )
+    p_analyze.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    p_analyze.add_argument(
+        "--errors-only",
+        action="store_true",
+        help="exit 1 only on error-severity findings (ignore warnings)",
+    )
+    p_analyze.add_argument("program")
+    p_analyze.add_argument("arguments", nargs=argparse.REMAINDER)
+
     args = parser.parse_args(argv)
     if args.command == "spawn":
         return spawn(
@@ -96,6 +186,13 @@ def main(argv: Sequence[str] | None = None) -> int:
             threads=args.threads,
             processes=args.processes,
             first_port=args.first_port,
+        )
+    if args.command == "analyze":
+        return analyze(
+            args.program,
+            args.arguments,
+            as_json=args.json,
+            errors_only=args.errors_only,
         )
     if args.command == "spawn-from-env":
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")

@@ -2792,6 +2792,22 @@ class Scope:
     def remove_errors_from_table(self, table: Node) -> Node:
         return _RemoveErrorsNode(self, table)
 
+    # -- execution ----------------------------------------------------------
+
+    def run(self, strict: bool = False, probe: bool = False) -> "Scheduler":
+        """Build-and-go convenience: pump every static source through one
+        commit and finish.  ``strict=True`` first runs the pre-execution
+        static analyzer (pathway_tpu.analysis) and raises
+        ``AnalysisError`` on any error-severity finding — the graph is
+        rejected before any state is created."""
+        if strict:
+            from pathway_tpu.analysis import check_strict
+
+            check_strict(self)
+        scheduler = Scheduler(self, probe=probe)
+        scheduler.run_static()
+        return scheduler
+
 
 class _RemoveErrorsNode(Node):
     def __init__(self, scope: Scope, source: Node) -> None:
@@ -2931,8 +2947,18 @@ class Scheduler:
         for node in self.scope.nodes:
             node.close()
 
+    def _analysis_intercept(self) -> bool:
+        """Under ``cli analyze`` (PATHWAY_TPU_ANALYZE=1) the scheduler
+        records the built graph for static analysis and skips execution."""
+        from pathway_tpu.analysis import runtime as _analysis_runtime
+
+        return _analysis_runtime.intercept(self.scope)
+
     def run_static(self) -> None:
         """Batch mode: all static sources at time 0, one commit, then end."""
+        if self._analysis_intercept():
+            self.time = 1
+            return
         for node in self.scope.nodes:
             if isinstance(node, StaticSource):
                 batch = node.initial_batch()
@@ -2944,6 +2970,10 @@ class Scheduler:
 
     def commit(self) -> int:
         """Streaming mode: flush all input sessions as one commit."""
+        if self._analysis_intercept():
+            time = self.time
+            self.time += 1
+            return time
         for node in self.scope.nodes:
             if isinstance(node, StaticSource):
                 batch = node.initial_batch()
@@ -2959,6 +2989,8 @@ class Scheduler:
         return time
 
     def finish(self) -> None:
+        if self._analysis_intercept():
+            return
         self.commit()
         self._end_nodes()
 

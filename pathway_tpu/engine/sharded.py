@@ -311,7 +311,19 @@ class ShardedScheduler:
 
         decay_device_batches()
 
+    def _analysis_intercept(self) -> bool:
+        """Analyze-only mode: the workers are identical replicas, so the
+        worker-0 scope (the superset — sinks attach there) is analyzed
+        once and execution is skipped."""
+        from pathway_tpu.analysis import runtime as _analysis_runtime
+
+        return _analysis_runtime.intercept(self.scopes[0])
+
     def commit(self) -> int:
+        if self._analysis_intercept():
+            time = self.time
+            self.time += 1
+            return time
         for w, scope in enumerate(self.scopes):
             for node in scope.nodes:
                 if isinstance(node, StaticSource):
@@ -384,6 +396,8 @@ class ShardedScheduler:
         self._deliver(0, replica0, batch)
 
     def finish(self) -> None:
+        if self._analysis_intercept():
+            return
         self.commit()
         for scope in self.scopes:
             for node in scope.nodes:

@@ -57,6 +57,7 @@ def run(
     monitoring_server_port: int | None = None,
     debug: bool = False,
     persistence_config: Any = None,
+    strict: bool = False,
     **kwargs: Any,
 ) -> None:
     """Execute the captured graph (reference: pw.run, internals/run.py:12).
@@ -64,7 +65,12 @@ def run(
     ``monitoring_level``: pw.MonitoringLevel (NONE/IN_OUT/ALL) — IN_OUT and
     ALL render a live rich dashboard; ``with_http_server`` additionally
     serves Prometheus metrics on port 20000 + PATHWAY_PROCESS_ID
-    (reference monitoring.py:56-228, http_server.rs:22)."""
+    (reference monitoring.py:56-228, http_server.rs:22).
+
+    ``strict=True`` runs the pre-execution static analyzer over the built
+    graph and raises ``pathway_tpu.analysis.AnalysisError`` on any
+    error-severity finding before any data flows."""
+    from pathway_tpu.analysis import runtime as _analysis_runtime
     from pathway_tpu.internals.config import get_pathway_config
     from pathway_tpu.internals.runner import (
         DistributedGraphRunner,
@@ -79,7 +85,15 @@ def run(
         persistence_config = config.replay_config
     threads = kwargs.get("threads") or config.threads
     processes = kwargs.get("processes") or config.processes
-    if processes > 1:
+    if _analysis_runtime.enabled():
+        # graph-only mode (cli analyze): one local worker, no connector
+        # drivers, no exchange sockets, no dashboards — the scheduler
+        # intercepts before any data flows, whatever the topology asks for
+        runner = GraphRunner(persistence_config=None, attach_drivers=False)
+        processes = threads = 1
+        monitoring_level = None
+        with_http_server = False
+    elif processes > 1:
         # multi-process: identical program per process, key-sharded TCP
         # exchange (engine/distributed.py; reference `pathway spawn`
         # cluster topology, config.rs:72-86)
@@ -145,6 +159,12 @@ def run(
         with run_span(lambda: getattr(runner, "scheduler", None)):
             if isinstance(runner, (ShardedGraphRunner, DistributedGraphRunner)):
                 runner.attach_sinks()
+                if strict:
+                    from pathway_tpu.analysis import check_strict
+
+                    # workers are identical replicas; worker 0 carries the
+                    # superset (sinks attach there only)
+                    check_strict(runner.workers[0].scope)
                 runner.run()
             else:
                 for sink in G.sinks:
@@ -152,6 +172,10 @@ def run(
                     driver = sink.attach(runner.scope, node)
                     if driver is not None:
                         runner.drivers.append(driver)
+                if strict:
+                    from pathway_tpu.analysis import check_strict
+
+                    check_strict(runner.scope)
                 runner.run()
     finally:
         if monitor is not None:

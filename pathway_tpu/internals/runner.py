@@ -325,8 +325,34 @@ class GraphRunner:
             node.error_log = self._error_log_node(log_id)
         node.name = f"{table._spec.kind}<{table._name}>"
         node.trace = table._trace
+        self._annotate_schema(node, table)
         self.nodes[table._id] = node
         return node
+
+    @staticmethod
+    def _annotate_schema(node: Node, table: "Table") -> None:
+        """Attach the framework-level dtypes as engine-type hints for the
+        static analyzer (pathway_tpu/analysis): ``node.schema_types`` is a
+        list of per-column ``frozenset[engine Type]`` possible-type sets.
+        Only attached when the built node's tuple layout matches the table
+        columns 1:1 (the base_layout invariant); the analyzer uses the
+        hint for source-like and opaque nodes and infers the rest."""
+        if node.arity != len(table._column_names):
+            return
+        hints = []
+        for name in table._column_names:
+            d = table._dtypes.get(name)
+            if d is None:
+                hints.append(frozenset({dt.EngineType.ANY}))
+                continue
+            try:
+                members = {d.strip_optional().to_engine()}
+                if d.is_optional():
+                    members.add(dt.EngineType.NONE)
+            except Exception:  # noqa: BLE001 — exotic dtype: stay opaque
+                members = {dt.EngineType.ANY}
+            hints.append(frozenset(members))
+        node.schema_types = hints
 
     def _project(self, node: Node, positions: Sequence[int]) -> Node:
         return self.scope.expression_table(node, [eex.ColumnRef(i) for i in positions])

@@ -7,14 +7,26 @@ control plane: CPython C++ kernels for per-row object plumbing
 source. Everything degrades gracefully to the pure-Python implementations
 when no toolchain is available — behavior is identical, only slower.
 
+A failed build or import is NOT silent: the first failure logs one
+structured warning (module path + exception) on the
+``pathway_tpu.native`` logger, and the reason stays queryable via
+:func:`load_error` — a several-fold slowdown should never have to be
+bisected back to a missing compiler.
+
+``PATHWAY_TPU_NATIVE_SO`` overrides the shared-object path entirely
+(tools/check.py points it at an ASan/UBSan-instrumented build so the
+parity suite exercises the sanitized kernels).
+
 Public surface:
 - ``available()`` — True when the compiled kernels are loaded.
 - ``kernels`` — the extension module or None.
+- ``load_error()`` — why the native module is absent (None when loaded).
 """
 
 from __future__ import annotations
 
 import importlib.util
+import logging
 import os
 import subprocess
 import sys
@@ -24,6 +36,28 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "enginecore.cpp")
 
 kernels = None
+
+#: why the native module is absent (None when loaded); see load_error()
+_load_error: str | None = None
+_warned = False
+
+
+def load_error() -> str | None:
+    """The reason the native extension is unavailable: a build/import
+    failure description, the disable-flag notice, or None when loaded."""
+    return _load_error
+
+
+def _note_failure(message: str, *, warn: bool = True) -> None:
+    global _load_error, _warned
+    _load_error = message
+    if warn and not _warned:
+        _warned = True
+        logging.getLogger("pathway_tpu.native").warning(
+            "native kernels unavailable, falling back to pure-Python "
+            "implementations (identical results, slower): %s",
+            message,
+        )
 
 
 def _so_path() -> str:
@@ -56,26 +90,41 @@ def _build() -> str | None:
         )
         os.replace(so + ".tmp", so)
         return so
-    except (subprocess.SubprocessError, OSError):
+    except (subprocess.SubprocessError, OSError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        _note_failure(
+            f"compiling {_SRC} failed: {type(e).__name__}: "
+            f"{detail.strip()[:500]}"
+        )
         return None
 
 
 def _load():
     global kernels
-    so = _build()
-    if so is None:
-        return
+    so = os.environ.get("PATHWAY_TPU_NATIVE_SO")
+    if so:
+        if not os.path.exists(so):
+            _note_failure(f"PATHWAY_TPU_NATIVE_SO={so} does not exist")
+            return
+    else:
+        so = _build()
+        if so is None:
+            return
     try:
         spec = importlib.util.spec_from_file_location("_enginecore", so)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         kernels = mod
-    except Exception:  # noqa: BLE001 — any load failure -> pure Python
+    except Exception as e:  # noqa: BLE001 — any load failure -> pure Python
         kernels = None
+        _note_failure(f"importing {so} failed: {type(e).__name__}: {e}")
 
 
 if os.environ.get("PATHWAY_TPU_DISABLE_NATIVE") != "1":
     _load()
+else:
+    # explicit opt-out is not a failure: record why, but don't warn
+    _note_failure("disabled via PATHWAY_TPU_DISABLE_NATIVE=1", warn=False)
 
 
 def available() -> bool:

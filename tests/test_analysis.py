@@ -1,0 +1,502 @@
+"""Static-analyzer tests (pathway_tpu.analysis).
+
+Covers the four passes — dtype propagation, dead-column/usage, shard
+redundancy, UDF determinism lint — over both engine-level scopes and
+pw-API pipelines, including the hard node kinds (iterate, temporal
+joins, flatten/sort) and strict mode.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.stdlib.temporal as tmp
+from pathway_tpu.analysis import (
+    FINDING_CODES,
+    AnalysisError,
+    Severity,
+    analyze_scope,
+    check_strict,
+)
+from pathway_tpu.engine import (
+    JoinKind,
+    ReducerKind,
+    Scheduler,
+    Scope,
+    make_reducer,
+    ref_scalar,
+)
+from pathway_tpu.engine import expression as ex
+from pathway_tpu.internals.runner import GraphRunner
+
+
+def k(i):
+    return ref_scalar(i)
+
+
+def static(scope, rows):
+    """rows: list of tuples; keys are synthesized."""
+    arity = len(rows[0]) if rows else 0
+    return scope.static_table(
+        [(k(i), row) for i, row in enumerate(rows)], arity
+    )
+
+
+def codes(report):
+    return sorted(f.code for f in report.findings)
+
+
+def error_codes(report):
+    return sorted(f.code for f in report.errors())
+
+
+def analyze_tables(*tables):
+    runner = GraphRunner()
+    for t in tables:
+        runner.build(t)
+    return analyze_scope(runner.scope)
+
+
+# -- dtype propagation -------------------------------------------------------
+
+
+class TestDtypePass:
+    def test_clean_engine_graph_is_clean(self):
+        scope = Scope()
+        t = static(scope, [(1, 2), (10, 20)])
+        scope.expression_table(
+            t, [ex.Binary("+", ex.ColumnRef(0), ex.ColumnRef(1))]
+        )
+        report = analyze_scope(scope)
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+    def test_int_minus_string_pwa001(self):
+        scope = Scope()
+        t = static(scope, [(1, "a"), (2, "b")])
+        scope.expression_table(
+            t, [ex.Binary("-", ex.ColumnRef(0), ex.ColumnRef(1))]
+        )
+        report = analyze_scope(scope)
+        assert "PWA001" in error_codes(report)
+
+    def test_filter_on_non_boolish_pwa002(self):
+        scope = Scope()
+        t = static(scope, [("yes",), ("no",)])
+        scope.filter_table(t, 0)
+        report = analyze_scope(scope)
+        assert "PWA002" in codes(report)
+
+    def test_join_key_type_mismatch_pwa003(self):
+        scope = Scope()
+        left = static(scope, [(1, 100.0)])
+        right = static(scope, [("one", "x")])
+        scope.join_tables(left, right, [0], [0], kind=JoinKind.INNER)
+        report = analyze_scope(scope)
+        assert "PWA003" in error_codes(report)
+
+    def test_join_compatible_keys_clean(self):
+        scope = Scope()
+        left = static(scope, [(1, 100.0)])
+        right = static(scope, [(1, "x")])
+        scope.join_tables(left, right, [0], [0], kind=JoinKind.INNER)
+        report = analyze_scope(scope)
+        assert "PWA003" not in codes(report)
+
+    def test_reindex_on_string_pwa004(self):
+        scope = Scope()
+        t = static(scope, [("a", 1)])
+        scope.reindex_table(t, 0)
+        report = analyze_scope(scope)
+        assert "PWA004" in error_codes(report)
+
+    def test_flatten_non_sequence_pwa005(self):
+        scope = Scope()
+        t = static(scope, [(3.5,)])
+        scope.flatten_table(t, 0)
+        report = analyze_scope(scope)
+        assert "PWA005" in error_codes(report)
+
+    def test_flatten_tuple_clean(self):
+        scope = Scope()
+        t = static(scope, [((1, 2, 3),)])
+        scope.flatten_table(t, 0)
+        report = analyze_scope(scope)
+        assert "PWA005" not in codes(report)
+        assert report.error_count == 0
+
+    def test_sum_over_datetime_column_pwa006(self):
+        import datetime
+
+        scope = Scope()
+        stamp = datetime.datetime(2020, 1, 1)
+        t = static(scope, [("a", stamp), ("b", stamp)])
+        scope.group_by_table(
+            t, by_cols=[0], reducers=[(make_reducer(ReducerKind.SUM), [1])]
+        )
+        report = analyze_scope(scope)
+        assert "PWA006" in error_codes(report)
+
+    def test_concat_divergent_columns_pwa007(self):
+        scope = Scope()
+        a = static(scope, [(1,)])
+        b = static(scope, [("one",)])
+        scope.concat_tables([a, b])
+        report = analyze_scope(scope)
+        assert "PWA007" in codes(report)
+
+    def test_impossible_cast_pwa008(self):
+        scope = Scope()
+        t = static(scope, [((1, 2),)])
+        scope.expression_table(t, [ex.Cast(ex.ColumnRef(0), "Int")])
+        report = analyze_scope(scope)
+        assert "PWA008" in codes(report)
+
+
+# -- dead columns / unused operators -----------------------------------------
+
+
+class TestUsagePass:
+    def test_dead_source_column_pwa101(self):
+        scope = Scope()
+        t = static(scope, [(1, "never-read"), (2, "never-read")])
+        out = scope.expression_table(t, [ex.ColumnRef(0)])
+        scope.subscribe_table(out)
+        report = analyze_scope(scope)
+        dead = [f for f in report.findings if f.code == "PWA101"]
+        assert any(
+            f.column == 1 and f.severity == Severity.WARNING for f in dead
+        )
+
+    def test_no_dead_columns_when_all_read(self):
+        scope = Scope()
+        t = static(scope, [(1, 2)])
+        out = scope.expression_table(
+            t, [ex.Binary("*", ex.ColumnRef(0), ex.ColumnRef(1))]
+        )
+        scope.subscribe_table(out)
+        report = analyze_scope(scope)
+        assert "PWA101" not in codes(report)
+
+    def test_unused_operator_pwa102(self):
+        scope = Scope()
+        t = static(scope, [(1,)])
+        live = scope.expression_table(t, [ex.ColumnRef(0)])
+        scope.subscribe_table(live)
+        # dangling second consumer: built but feeds no sink
+        scope.expression_table(t, [ex.Unary("-", ex.ColumnRef(0))])
+        report = analyze_scope(scope)
+        assert "PWA102" in codes(report)
+
+    def test_sinkless_graph_has_no_unused_operators(self):
+        # engine-style graphs read terminal .current directly: no sink is
+        # not a bug, so PWA102 must stay quiet
+        scope = Scope()
+        t = static(scope, [(1,)])
+        scope.expression_table(t, [ex.ColumnRef(0)])
+        report = analyze_scope(scope)
+        assert "PWA102" not in codes(report)
+
+
+# -- shard / exchange analysis -----------------------------------------------
+
+
+class TestShardPass:
+    def test_key_aligned_exchange_pwa201(self):
+        scope = Scope()
+        t = static(scope, [(1, True)])
+        e = scope.expression_table(t, [ex.ColumnRef(0), ex.ColumnRef(1)])
+        scope.filter_table(e, 1)
+        report = analyze_scope(scope)
+        redundant = [f for f in report.findings if f.code == "PWA201"]
+        assert redundant and all(
+            f.severity == Severity.INFO for f in redundant
+        )
+
+    def test_groupby_then_groupby_same_cols_pwa201(self):
+        scope = Scope()
+        t = static(scope, [("a", 1), ("a", 2), ("b", 3)])
+        g1 = scope.group_by_table(
+            t, by_cols=[0], reducers=[(make_reducer(ReducerKind.SUM), [1])]
+        )
+        scope.group_by_table(
+            g1, by_cols=[0], reducers=[(make_reducer(ReducerKind.COUNT), [])]
+        )
+        report = analyze_scope(scope)
+        assert "PWA201" in codes(report)
+
+    def test_groupby_after_source_not_redundant(self):
+        scope = Scope()
+        t = static(scope, [("a", 1)])
+        scope.group_by_table(
+            t, by_cols=[0], reducers=[(make_reducer(ReducerKind.SUM), [1])]
+        )
+        report = analyze_scope(scope)
+        assert "PWA201" not in codes(report)
+
+
+# -- UDF determinism lint ----------------------------------------------------
+
+
+def _noisy_udf(x):
+    import random
+
+    return x + random.random()
+
+
+def _pure_udf(x):
+    return 2 * x + 1
+
+
+def _seeded_rng_udf(x):
+    import numpy as np
+
+    rng = np.random.default_rng(x)  # explicit seed: deterministic
+    return float(rng.random())
+
+
+def _set_iterating_udf(x):
+    return list({x, x + 1, x + 2})[0]
+
+
+_LINT_SINK = []
+
+
+def _global_mutating_udf(x):
+    global _LINT_SINK
+    _LINT_SINK = _LINT_SINK + [x]
+    return x
+
+
+class TestUdfLint:
+    def _report_for(self, fn):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(x=int), [(1,), (2,)]
+        )
+        res = t.select(y=pw.apply(fn, t.x))
+        return analyze_tables(res)
+
+    def test_nondeterministic_udf_flagged_pwa301(self):
+        report = self._report_for(_noisy_udf)
+        assert "PWA301" in error_codes(report)
+
+    def test_pure_udf_not_flagged(self):
+        report = self._report_for(_pure_udf)
+        assert "PWA301" not in codes(report)
+        assert "PWA302" not in codes(report)
+        assert "PWA303" not in codes(report)
+
+    def test_seeded_rng_not_flagged(self):
+        report = self._report_for(_seeded_rng_udf)
+        assert "PWA301" not in codes(report)
+
+    def test_set_iteration_pwa302(self):
+        report = self._report_for(_set_iterating_udf)
+        assert "PWA302" in codes(report)
+
+    def test_global_mutation_pwa303(self):
+        report = self._report_for(_global_mutating_udf)
+        assert "PWA303" in codes(report)
+
+
+# -- hard node kinds ---------------------------------------------------------
+
+
+class TestHardNodes:
+    def test_iterate_graph_analyzes_clean(self):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(x=int), [(5,), (16,), (1,)]
+        )
+
+        def body(vals):
+            return {
+                "vals": vals.select(
+                    x=pw.apply(
+                        lambda v: v
+                        if v == 1
+                        else (v // 2 if v % 2 == 0 else 3 * v + 1),
+                        vals.x,
+                    )
+                )
+            }
+
+        res = pw.iterate(body, vals=t).vals
+        report = analyze_tables(res)
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+    def test_interval_join_analyzes_clean_and_pinned(self):
+        left = pw.debug.table_from_rows(
+            pw.schema_from_types(lt=int, lid=int), [(0, 1), (5, 2)]
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(rt=int, rid=int), [(1, 10), (6, 20)]
+        )
+        res = tmp.interval_join(
+            left, right, left.lt, right.rt, tmp.interval(-2, 2)
+        ).select(lid=left.lid, rid=right.rid)
+        report = analyze_tables(res)
+        assert report.error_count == 0
+        assert not report.internal_errors
+        # temporal joins run worker-0 pinned: the shard pass must say so
+        assert "PWA202" in codes(report)
+
+    def test_asof_join_analyzes_clean(self):
+        left = pw.debug.table_from_rows(
+            pw.schema_from_types(lt=int, lid=int), [(0, 1), (5, 2)]
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(rt=int, rid=int), [(1, 10), (6, 20)]
+        )
+        res = tmp.asof_join(
+            left, right, left.lt, right.rt, how="left"
+        ).select(lid=left.lid, rid=right.rid)
+        report = analyze_tables(res)
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+    def test_session_window_analyzes_clean(self):
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, k=str, v=int),
+            [(1, "a", 1), (2, "a", 2), (10, "a", 3)],
+        )
+        win = t.windowby(t.t, window=tmp.session(max_gap=3), instance=t.k)
+        res = win.reduce(
+            inst=pw.this["_pw_instance"], cnt=pw.reducers.count()
+        )
+        report = analyze_tables(res)
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+    def test_flatten_and_sort_engine_nodes(self):
+        scope = Scope()
+        t = static(scope, [((1, 2),), ((3,),)])
+        flat = scope.flatten_table(t, 0, with_origin=True)
+        scope.sort_table(flat, 0, None)
+        report = analyze_scope(scope)
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+
+# -- our own stdlib/xpacks pipelines must analyze without errors -------------
+
+
+class TestOwnCodeIsClean:
+    def test_pagerank_pipeline(self):
+        from pathway_tpu.stdlib.graphs import pagerank
+
+        edges = pw.debug.table_from_rows(
+            pw.schema_from_types(u=str, v=str),
+            [("b", "a"), ("c", "a"), ("a", "b")],
+        )
+        report = analyze_tables(pagerank(edges, iteration_limit=5))
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+    def test_fuzzy_match_pipeline(self):
+        from pathway_tpu.stdlib.ml import fuzzy_match_tables
+
+        left = pw.debug.table_from_rows(
+            pw.schema_from_types(txt=str), [("apple pie",)]
+        )
+        right = pw.debug.table_from_rows(
+            pw.schema_from_types(txt=str), [("apple tart",)]
+        )
+        report = analyze_tables(fuzzy_match_tables(left, right))
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+    def test_llm_mock_udf_pipeline(self):
+        from pathway_tpu.xpacks.llm import mocks
+
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(text=str), [("hello world",)]
+        )
+        emb = mocks.FakeEmbedder(dim=8)
+        out = docs.select(vec=emb(docs.text))
+        report = analyze_tables(out)
+        assert report.error_count == 0
+        assert not report.internal_errors
+
+
+# -- strict mode -------------------------------------------------------------
+
+
+class TestStrictMode:
+    def _broken_scope(self):
+        scope = Scope()
+        t = static(scope, [(1, "a")])
+        scope.expression_table(
+            t, [ex.Binary("-", ex.ColumnRef(0), ex.ColumnRef(1))]
+        )
+        return scope
+
+    def test_check_strict_raises_on_errors(self):
+        with pytest.raises(AnalysisError) as exc:
+            check_strict(self._broken_scope())
+        assert "PWA001" in str(exc.value)
+        assert exc.value.report.error_count >= 1
+
+    def test_scope_run_strict_raises_before_execution(self):
+        with pytest.raises(AnalysisError):
+            self._broken_scope().run(strict=True)
+
+    def test_scope_run_strict_executes_clean_graph(self):
+        scope = Scope()
+        t = static(scope, [(1, 2)])
+        out = scope.expression_table(
+            t, [ex.Binary("+", ex.ColumnRef(0), ex.ColumnRef(1))]
+        )
+        scope.run(strict=True)
+        assert set(out.current.values()) == {(3,)}
+
+    def test_scope_run_plain_matches_scheduler(self):
+        scope = Scope()
+        t = static(scope, [(4, 5)])
+        out = scope.expression_table(
+            t, [ex.Binary("*", ex.ColumnRef(0), ex.ColumnRef(1))]
+        )
+        scope.run()
+        assert set(out.current.values()) == {(20,)}
+
+    def test_warnings_do_not_raise(self):
+        scope = Scope()
+        a = static(scope, [(1,)])
+        b = static(scope, [("one",)])
+        scope.concat_tables([a, b])  # PWA007 warning only
+        check_strict(scope)  # no raise
+
+
+# -- report plumbing ---------------------------------------------------------
+
+
+class TestReport:
+    def test_every_emitted_code_is_registered(self):
+        assert set(FINDING_CODES) >= {
+            "PWA001",
+            "PWA003",
+            "PWA101",
+            "PWA201",
+            "PWA301",
+        }
+
+    def test_report_roundtrip(self):
+        scope = Scope()
+        t = static(scope, [(1, "a")])
+        scope.expression_table(
+            t, [ex.Binary("-", ex.ColumnRef(0), ex.ColumnRef(1))]
+        )
+        report = analyze_scope(scope)
+        from pathway_tpu.analysis import Report
+
+        again = Report.from_dict(report.to_dict())
+        assert codes(again) == codes(report)
+        assert again.error_count == report.error_count
+
+    def test_render_contains_summary(self):
+        scope = Scope()
+        t = static(scope, [(1,)])
+        scope.expression_table(t, [ex.ColumnRef(0)])
+        text = analyze_scope(scope).render()
+        assert "summary:" in text

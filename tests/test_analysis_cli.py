@@ -1,0 +1,87 @@
+"""Smoke tests for ``python -m pathway_tpu.cli analyze`` exit codes.
+
+Exit-code contract (documented in cli.py and README): 0 = clean (info
+findings allowed), 1 = warning/error findings, 2 = the program failed or
+never built a graph.  Each test spawns one real child interpreter, so
+these stay few and tiny.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pathway_tpu import cli
+
+_PRELUDE = """\
+from pathway_tpu.engine import Scheduler, Scope, ref_scalar
+from pathway_tpu.engine import expression as ex
+
+scope = Scope()
+"""
+
+CLEAN = _PRELUDE + """\
+t = scope.static_table([(ref_scalar(1), (1, 2))], 2)
+scope.expression_table(
+    t, [ex.Binary("+", ex.ColumnRef(0), ex.ColumnRef(1))]
+)
+Scheduler(scope).run_static()
+"""
+
+BROKEN = _PRELUDE + """\
+t = scope.static_table([(ref_scalar(1), (1, "a"))], 2)
+scope.expression_table(
+    t, [ex.Binary("-", ex.ColumnRef(0), ex.ColumnRef(1))]
+)
+Scheduler(scope).run_static()
+"""
+
+CRASHING = "raise SystemExit(3)\n"
+
+GRAPHLESS = "print('no graph here')\n"
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _analyze(tmp_path, name, source, **kwargs):
+    program = tmp_path / name
+    program.write_text(source)
+    # the child's sys.path[0] is tmp_path: make pathway_tpu importable
+    path = os.environ.get("PYTHONPATH")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO if not path else _REPO + os.pathsep + path,
+    }
+    return cli.analyze(str(program), [], env=env, **kwargs)
+
+
+def test_clean_program_exits_0(tmp_path, capsys):
+    assert _analyze(tmp_path, "clean.py", CLEAN) == 0
+    out = capsys.readouterr().out
+    assert "analyzed 1 graph(s)" in out
+
+
+def test_findings_exit_1(tmp_path, capsys):
+    assert _analyze(tmp_path, "broken.py", BROKEN) == 1
+    assert "PWA001" in capsys.readouterr().out
+
+
+def test_errors_only_still_fails_on_errors(tmp_path):
+    assert _analyze(tmp_path, "broken.py", BROKEN, errors_only=True) == 1
+
+
+def test_crashing_program_exits_2(tmp_path):
+    assert _analyze(tmp_path, "crash.py", CRASHING) == 2
+
+
+def test_graphless_program_exits_2(tmp_path):
+    assert _analyze(tmp_path, "empty.py", GRAPHLESS) == 2
+
+
+def test_json_output(tmp_path, capsys):
+    import json
+
+    assert _analyze(tmp_path, "broken.py", BROKEN, as_json=True) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert any(f["code"] == "PWA001" for f in payload["findings"])

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Single pre-PR gate: lint + static analyzer self-run + sanitized native.
+
+Usage: python tools/check.py [--skip-sanitized]
+
+Steps (each SKIPs gracefully when its toolchain is absent, FAILs on a real
+problem):
+
+1. ruff check — when ruff is installed (it is not baked into every
+   container image);
+2. analyzer self-run — ``python -m pathway_tpu.cli analyze
+   bench_dataflow.py`` must exit 0 (no warning/error findings on our own
+   pipelines);
+3. sanitized native build — recompile ``native/enginecore.cpp`` with
+   ``-fsanitize=address,undefined`` and run
+   ``tests/test_native_parity.py`` against the instrumented module
+   (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
+   under the Python interpreter.  Any sanitizer report fails the gate.
+
+Exit code 0 = every non-skipped step passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PASS, FAIL, SKIP = "PASS", "FAIL", "SKIP"
+
+
+def _report(name: str, status: str, detail: str = "") -> None:
+    line = f"[{status}] {name}"
+    if detail:
+        line += f" — {detail}"
+    print(line, flush=True)
+
+
+def step_ruff() -> str:
+    ruff = shutil.which("ruff")
+    cmd = [ruff, "check", "."] if ruff else None
+    if cmd is None:
+        # ruff may be importable without a console script
+        probe = subprocess.run(
+            [sys.executable, "-m", "ruff", "--version"],
+            capture_output=True,
+        )
+        if probe.returncode != 0:
+            _report("ruff check", SKIP, "ruff is not installed")
+            return SKIP
+        cmd = [sys.executable, "-m", "ruff", "check", "."]
+    proc = subprocess.run(cmd, cwd=REPO)
+    status = PASS if proc.returncode == 0 else FAIL
+    _report("ruff check", status)
+    return status
+
+
+def step_analyzer() -> str:
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu.cli",
+            "analyze",
+            "bench_dataflow.py",
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        "static analyzer self-run (cli analyze bench_dataflow.py)",
+        status,
+        f"exit code {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+def _sanitizer_runtime(gpp: str, name: str) -> str | None:
+    """Resolve libasan/libubsan via the compiler; None when unavailable."""
+    try:
+        out = subprocess.run(
+            [gpp, f"-print-file-name={name}"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        ).stdout.strip()
+    except (subprocess.SubprocessError, OSError):
+        return None
+    # an unresolved name echoes back without a directory
+    if out and os.path.isabs(out) and os.path.exists(out):
+        return out
+    return None
+
+
+def build_sanitized_so(out_dir: str) -> str | None:
+    """Compile enginecore.cpp with ASan+UBSan; None when the toolchain
+    can't do it (missing compiler or sanitizer libs)."""
+    gpp = shutil.which("g++")
+    if gpp is None:
+        return None
+    import numpy as np
+
+    src = os.path.join(REPO, "pathway_tpu", "native", "enginecore.cpp")
+    so = os.path.join(out_dir, "_enginecore_sanitized.so")
+    cmd = [
+        gpp,
+        "-O1",
+        "-g",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-fsanitize=address,undefined",
+        "-fno-sanitize-recover=all",
+        f"-I{sysconfig.get_path('include')}",
+        f"-I{np.get_include()}",
+        src,
+        "-o",
+        so,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        print(proc.stderr[-2000:], file=sys.stderr)
+        return None
+    return so
+
+
+def step_sanitized_native() -> str:
+    name = "sanitized native build + parity tests"
+    gpp = shutil.which("g++")
+    if gpp is None:
+        _report(name, SKIP, "no g++ toolchain")
+        return SKIP
+    libasan = _sanitizer_runtime(gpp, "libasan.so")
+    libubsan = _sanitizer_runtime(gpp, "libubsan.so")
+    if libasan is None:
+        _report(name, SKIP, "libasan not available to g++")
+        return SKIP
+    with tempfile.TemporaryDirectory(prefix="pathway-sanitized-") as tmp:
+        so = build_sanitized_so(tmp)
+        if so is None:
+            _report(name, SKIP, "sanitized compile failed (toolchain)")
+            return SKIP
+        preload = libasan if libubsan is None else f"{libasan}:{libubsan}"
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_TPU_NATIVE_SO": so,
+            # the interpreter itself is not ASan-instrumented: preload the
+            # runtime; CPython leaks are by design, don't report them
+            "LD_PRELOAD": preload,
+            "ASAN_OPTIONS": "detect_leaks=0:halt_on_error=1",
+            "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+        }
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytest",
+                "tests/test_native_parity.py",
+                "-q",
+                "-p",
+                "no:cacheprovider",
+            ],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        output = proc.stdout + proc.stderr
+        sys.stdout.write(proc.stdout[-4000:])
+        sanitizer_hit = (
+            "ERROR: AddressSanitizer" in output
+            or "runtime error:" in output
+            or "ERROR: LeakSanitizer" in output
+        )
+        if proc.returncode != 0 or sanitizer_hit:
+            if sanitizer_hit:
+                sys.stderr.write(output[-4000:])
+            _report(
+                name,
+                FAIL,
+                "sanitizer report" if sanitizer_hit else
+                f"pytest exit {proc.returncode}",
+            )
+            return FAIL
+    _report(name, PASS)
+    return PASS
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--skip-sanitized",
+        action="store_true",
+        help="skip the ASan/UBSan native rebuild (slow)",
+    )
+    args = parser.parse_args(argv)
+
+    results = [step_ruff(), step_analyzer()]
+    if args.skip_sanitized:
+        _report("sanitized native build + parity tests", SKIP, "--skip-sanitized")
+        results.append(SKIP)
+    else:
+        results.append(step_sanitized_native())
+
+    failed = results.count(FAIL)
+    print(
+        f"check: {results.count(PASS)} passed, "
+        f"{results.count(SKIP)} skipped, {failed} failed"
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
