@@ -983,6 +983,11 @@ def _maybe_run_dataflow(out: dict, timeout_s: float | None = None) -> None:
             out.update(_DATAFLOW_PREFETCH)
         else:
             out["dataflow_error"] = "dataflow prefetch still running"
+            # the suite is mid-leg, but every FINISHED leg already landed
+            # in _PARTIAL — report those as valid numbers, not nothing
+            for label, value in _PARTIAL.items():
+                if label.startswith("dataflow_"):
+                    out.setdefault(label, value)
         return
 
     def attempt() -> None:
@@ -1134,6 +1139,12 @@ def _probe_device_retrying() -> None:
         extra.update(_DATAFLOW_PREFETCH)
     else:
         _maybe_run_dataflow(extra, timeout_s=_budget_bounded(600.0))
+    # probe window exhausted (BENCH_r05 class: rc=124, parsed null): the
+    # dataflow suite may still be mid-leg, but each completed leg already
+    # emitted into _PARTIAL — fold those in so the outage line reports
+    # every measurement that actually finished
+    for label, value in _PARTIAL.items():
+        extra.setdefault(label, value)
     extra["probe_attempts"] = attempts[0]
     extra["probe_window_s"] = window
     print(

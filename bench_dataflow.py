@@ -223,6 +223,152 @@ def incremental_update():
     return rows_per_sec
 
 
+def fused_chain():
+    """Stateless chain (expr -> filter -> 8x expr) under streaming updates,
+    graph rewriter on vs off: the fused node evaluates the whole chain in
+    one sweep per delta and keeps ONE retraction state (the tail's)
+    instead of one per member (pathway_tpu.optimize.fuse)."""
+    n_stages = 8
+    n_base, n_commits, delta = 50_000, 100, 1000
+    if _analyze_only():
+        n_base, n_commits = 5_000, 1
+    rows = [(ref_scalar(i), (i, float(i) * 0.5)) for i in range(n_base)]
+
+    def once(optimize: bool) -> float:
+        scope = Scope()
+        sess = scope.input_session(2)
+        cur = scope.expression_table(
+            sess,
+            [
+                ex.ColumnRef(0),
+                ex.ColumnRef(1),
+                ex.Binary(">", ex.ColumnRef(0), ex.Const(100)),
+            ],
+        )
+        cur = scope.filter_table(cur, 2)
+        for _ in range(n_stages):
+            cur = scope.expression_table(
+                cur,
+                [
+                    ex.ColumnRef(0),
+                    ex.Binary(
+                        "+",
+                        ex.Binary(
+                            "*", ex.ColumnRef(1), ex.Const(1.0000001)
+                        ),
+                        ex.Const(0.5),
+                    ),
+                ],
+            )
+        sched = Scheduler(scope, optimize=optimize)
+        for key, row in rows:
+            sess.insert(key, row)
+        sched.commit()
+        if _analyze_only():
+            return 1.0  # graph-only mode: shapes checked, no timing
+        t = 0.0
+        for c in range(n_commits):
+            base = (c * delta) % (n_base - delta)
+            for i in range(base, base + delta):
+                key, row = rows[i]
+                sess.remove(key, row)
+                sess.insert(key, (row[0], row[1] + 1.0))
+            t += timed(sched.commit)
+        return t
+
+    def leg() -> dict:
+        from pathway_tpu.optimize import optimizer_stats
+
+        t_on = min(once(True) for _ in range(2))
+        stats = optimizer_stats()
+        t_off = min(once(False) for _ in range(2))
+        n_rows = n_commits * 2 * delta
+        return {
+            "rows": n_rows,
+            "optimized_rows_per_sec": round(n_rows / t_on),
+            "unoptimized_rows_per_sec": round(n_rows / t_off),
+            "speedup": round(t_off / t_on, 2),
+            "optimizer": stats,
+        }
+
+    return leg
+
+
+def pushdown_wide_source():
+    """Wide producer (12 computed columns, per-row Python UDFs), two
+    narrow consumers (3 distinct columns used between them): projection
+    pushdown (pathway_tpu.optimize.pushdown) narrows the producer to the
+    live columns, so 9 of 12 column evaluations never run. The columns
+    are deliberately non-vectorizable — expensive computed columns nobody
+    reads is the canonical pushdown win, while numpy-vectorized column
+    math is cheap enough to vanish into the ingest/sink noise floor. Two
+    consumers keep chain fusion out of the measurement (fusion needs a
+    single-consumer link), and the sinks are required — the rewriter only
+    narrows graphs whose outputs are observed through subscriptions."""
+    n_wide = 12
+    n = N // 5
+    if _analyze_only():
+        n = 5_000
+    rows = [(ref_scalar(i), (i, float(i))) for i in range(n)]
+
+    def once(optimize: bool) -> float:
+        scope = Scope()
+        sess = scope.input_session(2)
+        wide = scope.expression_table(
+            sess,
+            # col 0 consumes both source columns so the source stays
+            # fully live — the pushdown under test narrows THIS node
+            [
+                ex.Apply(
+                    lambda a, b: float(a) + b,
+                    (ex.ColumnRef(0), ex.ColumnRef(1)),
+                )
+            ]
+            + [
+                ex.Apply(
+                    lambda v, _k=float(c + 1): v * _k + 0.5,
+                    (ex.ColumnRef(1),),
+                )
+                for c in range(1, n_wide)
+            ],
+        )
+        narrow1 = scope.expression_table(
+            wide,
+            [ex.Binary("+", ex.ColumnRef(0), ex.ColumnRef(7))],
+        )
+        narrow2 = scope.expression_table(
+            wide,
+            [ex.Binary("*", ex.ColumnRef(3), ex.ColumnRef(7))],
+        )
+        sink = [0]
+
+        def on_change(key, row, time, diff):
+            sink[0] += diff
+
+        scope.subscribe_table(narrow1, on_change=on_change)
+        scope.subscribe_table(narrow2, on_change=on_change)
+        sched = Scheduler(scope, optimize=optimize)
+        for key, row in rows:
+            sess.insert(key, row)
+        return timed(sched.commit)
+
+    def leg() -> dict:
+        from pathway_tpu.optimize import optimizer_stats
+
+        t_on = min(once(True) for _ in range(2))
+        stats = optimizer_stats()
+        t_off = min(once(False) for _ in range(2))
+        return {
+            "rows": n,
+            "optimized_rows_per_sec": round(n / t_on),
+            "unoptimized_rows_per_sec": round(n / t_off),
+            "speedup": round(t_off / t_on, 2),
+            "optimizer": stats,
+        }
+
+    return leg
+
+
 def _free_ports(n: int) -> list[int]:
     """n distinct OS-assigned loopback ports (bound briefly, then freed)."""
     socks, ports = [], []
@@ -417,6 +563,10 @@ def run_all(emit=None) -> dict:
         round((N // 2 + 50_000) / min(run() for _ in range(2))),
     )
     record("incremental_update", incremental_update()())
+    # graph-rewriter legs: each reports optimize-on vs optimize-off
+    # throughput plus the optimizer_stats() snapshot of its optimized run
+    record("fused_chain", fused_chain()())
+    record("pushdown_wide_source", pushdown_wide_source()())
     if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
         try:
             leg = distributed_leg()
@@ -494,6 +644,11 @@ def main() -> None:
             }
         )
     )
+    for name, make in (
+        ("fused_chain", fused_chain),
+        ("pushdown_wide_source", pushdown_wide_source),
+    ):
+        print(json.dumps({"workload": name, **make()()}))
     # distributed leg: dtype-tagged columnar frames vs pickled row entries
     # over a real 2-process loopback TCP mesh
     if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):

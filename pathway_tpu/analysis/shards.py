@@ -113,6 +113,31 @@ def out_specs(node: g.Node) -> set[Spec]:
     return set()
 
 
+def redundant_edges(scope: g.Scope) -> list[tuple[int, int, int, Spec]]:
+    """Every exchange edge that provably moves no rows, as
+    ``(producer_index, consumer_index, port, rule)`` tuples.
+
+    This is both the PWA201 finding set and the exchange-elision oracle
+    consumed by ``pathway_tpu.optimize`` — one derivation, so the
+    analyzer and the rewriter can never disagree.
+    """
+    specs: dict[int, set[Spec]] = {
+        node.index: out_specs(node) for node in scope.nodes
+    }
+    edges: list[tuple[int, int, int, Spec]] = []
+    for node in scope.nodes:
+        produced = specs[node.index]
+        if not produced:
+            continue
+        for consumer, port in node.consumers:
+            rule = _norm(partition_rule(consumer, port))
+            if rule[0] == "pin":
+                continue
+            if rule in produced:
+                edges.append((node.index, consumer.index, port, rule))
+    return edges
+
+
 def run_pass(scope: g.Scope, report: Report) -> None:
     from pathway_tpu.engine import temporal as t
     from pathway_tpu.engine.graph import RecomputeNode
@@ -137,9 +162,7 @@ def run_pass(scope: g.Scope, report: Report) -> None:
     except ImportError:
         pass
 
-    specs: dict[int, set[Spec]] = {}
     for node in scope.nodes:
-        specs[node.index] = out_specs(node)
         if isinstance(node, pinned_kinds):
             report.add(
                 Finding(
@@ -155,28 +178,22 @@ def run_pass(scope: g.Scope, report: Report) -> None:
                 )
             )
 
-    for node in scope.nodes:
-        produced = specs[node.index]
-        if not produced:
-            continue
-        for consumer, port in node.consumers:
-            rule = _norm(partition_rule(consumer, port))
-            if rule[0] == "pin":
-                continue
-            if rule in produced:
-                report.add(
-                    Finding(
-                        code="PWA201",
-                        message=(
-                            f"exchange into {consumer.name}#{consumer.index} "
-                            f"(port {port}) is provably redundant: rows are "
-                            f"already partitioned {_spec_str(rule)} "
-                            "(cross-check: EXCHANGE_STATS / "
-                            "native.hit_counts())"
-                        ),
-                        node_index=node.index,
-                        node_name=node.name,
-                        severity=Severity.INFO,
-                        trace=getattr(node, "trace", None) or None,
-                    )
-                )
+    for prod, cons, port, rule in redundant_edges(scope):
+        node = scope.nodes[prod]
+        consumer = scope.nodes[cons]
+        report.add(
+            Finding(
+                code="PWA201",
+                message=(
+                    f"exchange into {consumer.name}#{consumer.index} "
+                    f"(port {port}) is provably redundant: rows are "
+                    f"already partitioned {_spec_str(rule)} "
+                    "(cross-check: EXCHANGE_STATS / "
+                    "native.hit_counts())"
+                ),
+                node_index=node.index,
+                node_name=node.name,
+                severity=Severity.INFO,
+                trace=getattr(node, "trace", None) or None,
+            )
+        )

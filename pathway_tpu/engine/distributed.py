@@ -63,7 +63,12 @@ from pathway_tpu.engine.routing import (
     entry_shards,
     shards_of_values,
 )
-from pathway_tpu.engine.sharded import partition_rule, partitioner
+from pathway_tpu.engine.sharded import (
+    _VERIFY_ELISION,
+    _assert_colocated,
+    partition_rule,
+    partitioner,
+)
 from pathway_tpu.engine.value import Pointer
 
 _LEN = struct.Struct(">Q")
@@ -107,14 +112,12 @@ COLUMNAR_EXCHANGE = os.environ.get(
 ).lower() not in ("0", "false")
 
 #: probe counters for tests/benchmarks: columnar frames this process
-#: encoded for / decoded from remote peers, and row-entry deliveries that
-#: took the pickle fallback. tests/test_shard_routing.py asserts the
-#: columnar path engaged cross-process through these.
-EXCHANGE_STATS = {
-    "columnar_frames_sent": 0,
-    "columnar_frames_received": 0,
-    "row_batches_sent": 0,
-}
+#: encoded for / decoded from remote peers, row-entry deliveries that took
+#: the pickle fallback, and optimizer-elided exchanges.  The dict now
+#: lives in engine/routing.py (shared with the in-process scheduler); the
+#: import below keeps every historical access path
+#: (``distributed.EXCHANGE_STATS``) pointing at the same object.
+from pathway_tpu.engine.routing import EXCHANGE_STATS  # noqa: E402
 
 _FRAME_MAGIC = b"PWCF"
 _FRAME_VERSION = 1
@@ -496,6 +499,11 @@ class DistributedScheduler:
                     "worker"
                 )
         self._parts: dict[tuple[int, int], Any] = {}
+        #: optimizer-proven redundant exchange edges; populated lazily by
+        #: _ensure_optimized AFTER the topology handshake, so the type-name
+        #: signatures above compare pre-rewrite graphs on every process
+        self._elided: set = set()
+        self._optimized = False
         #: deliveries queued for each remote process this round
         self._outbox: dict[int, list[tuple]] = {
             p: [] for p in range(n_processes) if p != process_id
@@ -524,6 +532,7 @@ class DistributedScheduler:
         self.transport.broadcast(
             ("topology", self.n_shared, self._shared_signature(), extra)
         )
+        self._ensure_optimized()
 
     def receive_topology(self) -> None:
         frame = self.transport.recv(0)
@@ -543,6 +552,23 @@ class DistributedScheduler:
             )
         for prod, cons, port in extra:
             self.extra_consumers.setdefault(prod, []).append((cons, port))
+        self._ensure_optimized()
+
+    def _ensure_optimized(self) -> None:
+        """Run the pre-execution rewriter once, after the topology
+        handshake: the decision inputs (shared region + producers with
+        off-process sink consumers) are then identical on every process,
+        so every replica graph mutates the same way."""
+        if self._optimized:
+            return
+        self._optimized = True
+        from pathway_tpu.optimize import optimize_scopes
+
+        self._elided = optimize_scopes(
+            self.scopes,
+            n_shared=self.n_shared,
+            protected=set(self.extra_consumers),
+        )
 
     # -- worker placement --------------------------------------------------
 
@@ -633,11 +659,28 @@ class DistributedScheduler:
 
     # -- exchange ----------------------------------------------------------
 
-    def _deliver(self, producer: Node, out: DeltaBatch) -> None:
+    def _deliver(
+        self, producer: Node, out: DeltaBatch, scope_idx: int = 0
+    ) -> None:
         """Split ``out`` per consumer; push each part to the consumer's
         replica on the owning worker (local) or queue it for the owning
-        process (remote)."""
+        process (remote).  ``scope_idx`` is the local replica that produced
+        ``out`` — elided edges stay on that worker."""
+        elided = self._elided
         for consumer, port in self.scopes[0].nodes[producer.index].consumers:
+            if (producer.index, consumer.index, port) in elided:
+                # optimizer-proven redundant exchange: skip the routing
+                # digests AND the PWCF encode/decode round-trip — the
+                # whole batch already lives on this worker's replica
+                if _VERIFY_ELISION:
+                    _assert_colocated(
+                        consumer, port, out,
+                        self.process_id * self.threads + scope_idx,
+                        self.n_workers,
+                    )
+                EXCHANGE_STATS["elided"] += 1
+                self.scopes[scope_idx].nodes[consumer.index].push(port, out)
+                continue
             self._route_part(consumer.index, port, consumer, out)
         # sink-side consumers exist only on process 0 / scope 0. Process 0
         # reads them from its own superset consumer lists above (for every
@@ -799,7 +842,7 @@ class DistributedScheduler:
         busy = False
         while True:
             did = False
-            for scope in self.scopes:
+            for scope_idx, scope in enumerate(self.scopes):
                 for node in scope.nodes:
                     if not node.has_pending():
                         continue
@@ -813,7 +856,7 @@ class DistributedScheduler:
                     # the vectorized exchange ships them
                     node._defer_state(out)
                     if out:
-                        self._deliver(node, out)
+                        self._deliver(node, out, scope_idx)
             if did:
                 busy = True
                 continue
@@ -976,6 +1019,7 @@ class DistributedScheduler:
     def commit_local(self) -> int:
         """One commit: coordinator flushes sources, then all processes run
         exchange rounds to global quiescence."""
+        self._ensure_optimized()  # no-op after the topology handshake
         self._mark_replica_sources()
         if self.process_id == 0:
             self._flush_sources()

@@ -2794,17 +2794,24 @@ class Scope:
 
     # -- execution ----------------------------------------------------------
 
-    def run(self, strict: bool = False, probe: bool = False) -> "Scheduler":
+    def run(
+        self,
+        strict: bool = False,
+        probe: bool = False,
+        optimize: bool = True,
+    ) -> "Scheduler":
         """Build-and-go convenience: pump every static source through one
         commit and finish.  ``strict=True`` first runs the pre-execution
         static analyzer (pathway_tpu.analysis) and raises
         ``AnalysisError`` on any error-severity finding — the graph is
-        rejected before any state is created."""
+        rejected before any state is created.  ``optimize=True`` (default)
+        runs the pre-execution graph rewriter (pathway_tpu.optimize);
+        ``PATHWAY_TPU_OPTIMIZE=0`` is the environment escape hatch."""
         if strict:
             from pathway_tpu.analysis import check_strict
 
             check_strict(self)
-        scheduler = Scheduler(self, probe=probe)
+        scheduler = Scheduler(self, probe=probe, optimize=optimize)
         scheduler.run_static()
         return scheduler
 
@@ -2862,7 +2869,16 @@ class Scheduler:
     Prometheus endpoint.
     """
 
-    def __init__(self, scope: Scope, probe: bool = False) -> None:
+    def __init__(
+        self, scope: Scope, probe: bool = False, optimize: bool = True
+    ) -> None:
+        if optimize:
+            from pathway_tpu.optimize import optimize_scopes
+
+            # single-worker: no exchanges to elide, but fusion/pushdown
+            # still apply (skips itself under PATHWAY_TPU_OPTIMIZE=0 and
+            # in analyze mode; idempotent per scope)
+            optimize_scopes([scope])
         self.scope = scope
         self.time = 0
         self.probe = probe

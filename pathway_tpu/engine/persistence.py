@@ -296,6 +296,11 @@ class OperatorSnapshotManager:
             "per_worker": [[n.op_state() for n in s.nodes] for s in scopes],
             "drivers": [self._driver_state(d) for d in drivers],
             "time": time,
+            # Graph-optimizer fingerprint (pathway_tpu.optimize): the exact
+            # rewrites applied to this graph. Operator state written under a
+            # rewritten graph (narrowed arities, fused chains) is only valid
+            # under the SAME rewrites, so restore refuses on mismatch.
+            "optimize": list(getattr(scopes[0], "_pw_opt_fingerprint", [])),
         }
         self.backend.write(self.name, _pickle.dumps(payload, protocol=4))
         import time as _time
@@ -344,8 +349,23 @@ class OperatorSnapshotManager:
         if [type(n).__name__ for n in scopes[0].nodes] != sigs[0]:
             raise ValueError(
                 "operator snapshot does not match this graph (operator "
-                "sequence changed); clear the persistence location or "
-                "use input-journal persistence across code changes"
+                "sequence changed — this includes toggling the graph "
+                "optimizer, which fuses stateless chains into "
+                "FusedChainNode; see PATHWAY_TPU_OPTIMIZE); clear the "
+                "persistence location or use input-journal persistence "
+                "across code changes"
+            )
+        want = list(getattr(scopes[0], "_pw_opt_fingerprint", []))
+        got = list(payload.get("optimize", []))
+        if want != got:
+            raise ValueError(
+                "operator snapshot was written under a different graph-"
+                f"optimizer plan (snapshot applied {len(got)} rewrites, "
+                f"this run applies {len(want)}): restoring would load "
+                "state into operators with a different column layout or "
+                "fusion boundary — rerun with the same "
+                "PATHWAY_TPU_OPTIMIZE setting, or clear the persistence "
+                "location / replay an input journal"
             )
         if len(per_worker) != len(scopes):
             # worker count changed: merge the old shards and re-split with

@@ -36,11 +36,24 @@ if TYPE_CHECKING:  # pragma: no cover
     from pathway_tpu.engine.batch import Columns
 
 __all__ = [
+    "EXCHANGE_STATS",
+    "batch_shards",
     "columnar_shards",
     "entry_shards",
     "mod_u128_bytes",
     "shards_of_values",
 ]
+
+#: exchange-path probe counters, shared by the in-process scheduler and
+#: the TCP mesh (engine/distributed.py re-exports this same dict object).
+#: ``elided`` counts deliveries that skipped routing entirely because the
+#: optimizer proved the exchange redundant (pathway_tpu.optimize.elide).
+EXCHANGE_STATS = {
+    "columnar_frames_sent": 0,
+    "columnar_frames_received": 0,
+    "row_batches_sent": 0,
+    "elided": 0,
+}
 
 
 def _shard_of(value: Any, n: int) -> int:
@@ -242,3 +255,16 @@ def columnar_shards(
     except Exception:  # lazy key thunk failed: the row path derives keys
         return None
     return mod_u128_bytes(kb, n)
+
+
+def batch_shards(rule: tuple, batch: "Any", n: int) -> np.ndarray | None:
+    """Worker id per row of a whole :class:`DeltaBatch` under ``rule`` —
+    columnar kernel when the payload allows it, entry fallback otherwise;
+    ``None`` for pin rules.  Debug/verification helper (the
+    ``PATHWAY_TPU_VERIFY_ELISION=1`` cross-check and the elision tests),
+    not an exchange hot path."""
+    if batch._entries is None and batch.columns is not None:
+        got = columnar_shards(rule, batch.columns, n)
+        if got is not None:
+            return got
+    return entry_shards(rule, batch.entries, n)

@@ -27,6 +27,7 @@ for multi-host (SURVEY §2.10 mapping).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Sequence
 
 from pathway_tpu.engine.batch import (
@@ -53,8 +54,10 @@ from pathway_tpu.engine.graph import (
 # below and older call sites (engine/distributed.py, tests) address them
 # through this module
 from pathway_tpu.engine.routing import (  # noqa: F401 — re-exports
+    EXCHANGE_STATS,
     _object_codes,
     _shard_of,
+    batch_shards,
     columnar_shards,
     entry_shards,
     shards_of_values,
@@ -62,6 +65,21 @@ from pathway_tpu.engine.routing import (  # noqa: F401 — re-exports
 from pathway_tpu.engine.value import Pointer
 
 Entry = tuple
+
+#: debug cross-check: recompute routing for every elided delivery and
+#: assert the whole batch is already co-located (optimizer soundness net)
+_VERIFY_ELISION = os.environ.get("PATHWAY_TPU_VERIFY_ELISION") == "1"
+
+
+def _assert_colocated(
+    consumer: Node, port: int, out: DeltaBatch, worker: int, n: int
+) -> None:
+    shards = batch_shards(partition_rule(consumer, port), out, n)
+    if shards is not None and len(shards) and not (shards == worker).all():
+        raise AssertionError(
+            f"elided exchange into {consumer.name}#{consumer.index} "
+            f"(port {port}) moved rows off worker {worker}"
+        )
 
 
 def partition_rule(consumer: Node, port: int) -> tuple:
@@ -148,7 +166,12 @@ def partitioner(
 class ShardedScheduler:
     """Lockstep commit pump over N identically-built scopes."""
 
-    def __init__(self, scopes: Sequence[Scope], probe: bool = False) -> None:
+    def __init__(
+        self,
+        scopes: Sequence[Scope],
+        probe: bool = False,
+        optimize: bool = True,
+    ) -> None:
         self.scopes = list(scopes)
         self.n = len(self.scopes)
         for scope in self.scopes:
@@ -172,6 +195,14 @@ class ShardedScheduler:
                     f"worker {w} scope diverged: the graph logic must build "
                     "the identical operator sequence on every worker"
                 )
+        #: (producer, consumer, port) edges the optimizer proved exchange-
+        #: redundant — _deliver pushes those straight to the co-located
+        #: replica (rewrites every replica scope in place, identically)
+        self._elided: set = set()
+        if optimize:
+            from pathway_tpu.optimize import optimize_scopes
+
+            self._elided = optimize_scopes(self.scopes)
         # partition function cache per (consumer index, port)
         self._parts: dict[tuple[int, int], Any] = {}
 
@@ -199,7 +230,16 @@ class ShardedScheduler:
         there only."""
         import numpy as np
 
+        elided = self._elided
         for consumer, port in self.scopes[0].nodes[producer.index].consumers:
+            if (producer.index, consumer.index, port) in elided:
+                # optimizer-proven redundant exchange: every row already
+                # lives on `worker` — skip the routing digests entirely
+                if _VERIFY_ELISION:
+                    _assert_colocated(consumer, port, out, worker, self.n)
+                EXCHANGE_STATS["elided"] += 1
+                self.scopes[worker].nodes[consumer.index].push(port, out)
+                continue
             fn = self._partition_fn(consumer, port)
             if fn is None:
                 target = self.scopes[0].nodes[consumer.index]

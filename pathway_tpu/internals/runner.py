@@ -1084,6 +1084,11 @@ class GraphRunner:
         from pathway_tpu.internals import parse_graph
 
         nodes = [self.build(t) for t in tables]
+        for node in nodes:
+            # capture reads node state directly, without a SubscribeNode —
+            # the graph optimizer must treat these as observed sinks (no
+            # fusion-inerting, no arity narrowing)
+            node._pw_observed = True
         # attach + consume INTERNAL sinks only (AsyncTransformer loopback
         # subscriptions — a capture without them would deadlock); user
         # output sinks stay registered for the eventual pw.run()
@@ -1190,6 +1195,11 @@ class ShardedGraphRunner:
         from pathway_tpu.internals import parse_graph
 
         replicas = [self.build(t) for t in tables]
+        for reps in replicas:
+            for node in reps:
+                # capture reads replica state without a SubscribeNode; the
+                # optimizer must leave these nodes intact on every worker
+                node._pw_observed = True
         # internal sinks: worker 0 only; build every sink table first so
         # SubscribeNodes land after all shared nodes (index alignment)
         remaining = [s for s in parse_graph.G.sinks if not s.internal]

@@ -1,0 +1,140 @@
+"""Pre-execution DAG rewriter: the analyzer's findings, acted on.
+
+Runs between graph capture and scheduling — every scheduler constructor
+calls :func:`optimize_scopes` on its replica scopes before any batch
+flows.  Three passes, in order:
+
+1. **projection pushdown** (:mod:`pathway_tpu.optimize.pushdown`) —
+   narrow StaticSource/Expression producers to the columns their
+   consumers actually read (the PWA101 dead-column set), shrinking every
+   downstream tuple, shard frame and checkpoint;
+2. **exchange elision** (:mod:`pathway_tpu.optimize.elide`) — mark the
+   provably redundant exchange edges (the PWA201 set) so the sharded and
+   distributed schedulers deliver those batches straight to the
+   co-located replica;
+3. **stateless-chain fusion** (:mod:`pathway_tpu.optimize.fuse`) —
+   collapse linear Expression/Filter runs into one FusedChainNode
+   evaluating the whole chain in a single columnar sweep per batch.
+
+All rewrites mutate the node list *in place* and never add or remove
+list slots: ``node.index == position`` is the invariant the sharded
+schedulers address replicas by, so fused interiors stay behind as inert
+placeholders.
+
+Control knobs: ``PATHWAY_TPU_OPTIMIZE=0`` disables every pass (the
+escape hatch, exercised by ``tools/check.py``); analyze mode
+(``PATHWAY_TPU_ANALYZE=1``) also disables them so ``cli analyze``
+reports on the graph the user wrote, not the rewritten one.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pathway_tpu.optimize import elide as _elide
+from pathway_tpu.optimize import fuse as _fuse
+from pathway_tpu.optimize import pushdown as _pushdown
+from pathway_tpu.optimize.fuse import FusedChainNode
+
+__all__ = [
+    "FusedChainNode",
+    "enabled",
+    "optimize_scopes",
+    "optimizer_stats",
+]
+
+_ZERO_STATS = {
+    "chains_fused": 0,
+    "nodes_fused": 0,
+    "columns_dropped": 0,
+    "exchanges_elided": 0,
+}
+
+#: counters from the most recent optimize_scopes() run in this process
+_LAST_STATS = dict(_ZERO_STATS)
+
+
+def enabled() -> bool:
+    """True unless ``PATHWAY_TPU_OPTIMIZE`` turns the rewriter off."""
+    return os.environ.get("PATHWAY_TPU_OPTIMIZE", "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
+
+def optimizer_stats() -> dict:
+    """Counters from the most recent :func:`optimize_scopes` run:
+    ``chains_fused``, ``nodes_fused``, ``columns_dropped``,
+    ``exchanges_elided``."""
+    return dict(_LAST_STATS)
+
+
+def optimize_scopes(
+    scopes: list, n_shared: int | None = None, protected=()
+) -> set[tuple[int, int, int]]:
+    """Rewrite the replica ``scopes`` in place; idempotent per graph.
+
+    ``scopes[0]`` is the primary (decision) scope; every rewrite is
+    replayed on the other replicas by node index.  ``n_shared`` bounds
+    the region replicated across workers/processes (the primary may carry
+    extra trailing sink nodes); ``protected`` adds node indices with
+    consumers this process cannot see (distributed followers pass the
+    announced sink-edge producers).
+
+    Returns the runtime exchange-elision set of
+    ``(producer_index, consumer_index, port)`` triples.
+    """
+    global _LAST_STATS
+    primary = scopes[0]
+    done = getattr(primary, "_pw_opt_elided", None)
+    if done is not None:
+        return done
+    from pathway_tpu.analysis import runtime as _aruntime
+
+    if not enabled() or _aruntime.enabled():
+        _LAST_STATS = dict(_ZERO_STATS)  # "last run" applied no rewrites
+        return set()
+    for i, node in enumerate(primary.nodes):
+        if not (isinstance(node.index, int) and node.index == i):
+            # external-index/device operators shadow ``.index`` with their
+            # index object, and every rewrite replay and elision triple
+            # keys off ``node.index == position`` — leave such graphs
+            # untouched (their operators also peek at input state in ways
+            # the rewrites must not disturb)
+            _LAST_STATS = dict(_ZERO_STATS)
+            primary._pw_opt_fingerprint = []
+            primary._pw_opt_elided = set()
+            return primary._pw_opt_elided
+    if n_shared is None:
+        n_shared = min(len(s.nodes) for s in scopes)
+    protected = set(protected)
+    for node in primary.nodes[:n_shared]:
+        if any(c.index >= n_shared for c, _p in node.consumers):
+            protected.add(node.index)
+
+    dropped, fingerprint = _pushdown.run(scopes, n_shared, protected)
+    marks = _elide.plan(primary, n_shared)
+    chains = _fuse.find_chains(primary, n_shared, protected)
+    runtime_marks = _elide.remap_through_fusion(marks, chains)
+    for scope in scopes:
+        for chain in chains:
+            _fuse.apply_chain(scope, chain)
+    for chain in chains:
+        fingerprint.append("fuse:" + ",".join(map(str, chain)))
+    if marks:
+        fingerprint.append(
+            "elide:" + ";".join("%d>%d.%d" % m for m in sorted(marks))
+        )
+
+    stats = {
+        "chains_fused": len(chains),
+        "nodes_fused": sum(len(c) for c in chains),
+        "columns_dropped": dropped,
+        "exchanges_elided": len(marks),
+    }
+    primary._pw_opt_stats = stats
+    primary._pw_opt_fingerprint = fingerprint
+    primary._pw_opt_elided = runtime_marks
+    _LAST_STATS = stats
+    return runtime_marks

@@ -1,0 +1,61 @@
+"""Exchange elision — mark provably redundant producer->consumer edges.
+
+The oracle is :func:`pathway_tpu.analysis.shards.redundant_edges` — the
+exact edge set the analyzer reports as PWA201 — so the analyzer and the
+rewriter can never disagree (a test asserts the counts match).  Marks are
+computed on the post-pushdown, pre-fusion graph; pushdown cannot change
+the set (it only narrows Expression/StaticSource producers, whose
+out-specs are arity-independent), and fusion only *renames* edges:
+
+- an edge into a chain head moves to the fused tail (the tail inherits
+  the head's input port and the head's ``("key",)`` arrival rule);
+- an intra-chain edge disappears from the runtime set entirely — the
+  exchange it crossed has been fused away, the strongest form of elision
+  (it still counts in ``optimizer_stats()["exchanges_elided"]``).
+
+At delivery time the sharded/distributed schedulers check the returned
+``(producer_index, consumer_index, port)`` set *before* running routing
+digests: a marked edge pushes the whole batch to the co-located replica,
+skipping ``columnar_shards``/``entry_shards`` and, on the TCP mesh, the
+PWCF encode/decode round-trip.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.analysis.shards import redundant_edges
+
+
+def plan(scope, n_shared: int) -> set[tuple[int, int, int]]:
+    """Elidable edges on the primary scope, restricted to the shared
+    (replicated) node region."""
+    marks = set()
+    for prod, cons, port, _rule in redundant_edges(scope):
+        if prod < n_shared and cons < n_shared:
+            marks.add((prod, cons, port))
+    return marks
+
+
+def remap_through_fusion(
+    marks: set[tuple[int, int, int]], chains: list[list[int]]
+) -> set[tuple[int, int, int]]:
+    """Rewrite pre-fusion marks into the post-fusion runtime set."""
+    head_tail: dict[int, int] = {}
+    member_chain: dict[int, int] = {}
+    for ci, chain in enumerate(chains):
+        head_tail[chain[0]] = chain[-1]
+        for idx in chain:
+            member_chain[idx] = ci
+    out = set()
+    for prod, cons, port in marks:
+        pc = member_chain.get(prod)
+        cc = member_chain.get(cons)
+        if pc is not None and cc is not None and pc == cc:
+            continue  # intra-chain: fused away entirely
+        if cc is not None:
+            # only the head receives external input; the edge now lands on
+            # the fused tail
+            cons = chains[cc][-1]
+        if pc is not None and prod != chains[pc][-1]:
+            continue  # interior producers no longer emit
+        out.add((prod, cons, port))
+    return out

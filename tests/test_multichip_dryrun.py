@@ -1,0 +1,31 @@
+"""Tier-1 promotion of the ``dryrun_multichip`` worker legs.
+
+``__graft_entry__.dryrun_multichip`` historically only ran inside the
+accelerator dry-run harness, so an engine regression in the sharded
+exchange path (the MULTICHIP_r05 class: a NameError in the delivery loop
+that only fires with n_workers > 1) could land without any tier-1 test
+failing. The worker leg needs no devices — it compares N-worker
+key-sharded execution against the 1-worker output — so it runs here on
+every suite pass.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import __graft_entry__ as graft
+
+
+@pytest.mark.parametrize("n_workers", [1, 2, 4])
+def test_sharded_wordcount_matches_single_worker(n_workers):
+    # raises AssertionError on divergence; any engine exception (the
+    # historical NameError class included) fails the suite outright
+    graft._run_sharded_wordcount(n_workers)
+
+
+@pytest.mark.parametrize("n_workers", [2, 3])
+def test_sharded_wordcount_with_optimizer_off(n_workers, monkeypatch):
+    # the same parity leg must hold with the graph rewriter disabled —
+    # the dry-run harness runs whichever mode the environment picks
+    monkeypatch.setenv("PATHWAY_TPU_OPTIMIZE", "0")
+    graft._run_sharded_wordcount(n_workers)

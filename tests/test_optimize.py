@@ -1,0 +1,755 @@
+"""Pre-execution graph rewriter (pathway_tpu.optimize): correctness.
+
+Three passes — stateless-chain fusion, projection pushdown, exchange
+elision — each rewrite must be observationally invisible: optimize-on
+and optimize-off runs produce bit-identical outputs (values, diffs,
+error logs) on the single-worker, sharded in-process, and TCP-mesh
+schedulers. The optimizer's elision oracle is the analyzer's PWA201
+pass, so the two counts must always agree.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.engine.graph as g
+from pathway_tpu.analysis import analyze_scope
+from pathway_tpu.engine import expression as ex
+from pathway_tpu.engine import sharded as sharded_mod
+from pathway_tpu.engine.graph import Scheduler, Scope
+from pathway_tpu.engine.persistence import (
+    MemoryBackend,
+    OperatorSnapshotManager,
+)
+from pathway_tpu.engine.reducers import CountReducer, SumReducer
+from pathway_tpu.engine.routing import EXCHANGE_STATS
+from pathway_tpu.engine.sharded import ShardedScheduler
+from pathway_tpu.engine.value import Pointer, ref_scalar
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import GraphRunner, ShardedGraphRunner
+from pathway_tpu.optimize import (
+    FusedChainNode,
+    optimize_scopes,
+    optimizer_stats,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def opt_on(monkeypatch):
+    """Tests asserting that rewrites HAPPEN must see the optimizer
+    enabled even when the ambient environment disables it (the
+    tools/check.py optimize-off leg reruns this file with
+    PATHWAY_TPU_OPTIMIZE=0; parity tests pass either way, but these
+    would vacuously fail)."""
+    monkeypatch.setenv("PATHWAY_TPU_OPTIMIZE", "1")
+
+
+# -- engine-level graph builders ----------------------------------------------
+
+
+def _chain_scope(with_sink=True, events=None):
+    """source -> expr -> filter -> expr -> expr (+ subscribe): one fusable
+    stateless chain with both vectorizable and pass-through columns."""
+    sc = Scope()
+    sess = sc.input_session(2)
+    e1 = sc.expression_table(
+        sess,
+        [
+            ex.ColumnRef(0),
+            ex.ColumnRef(1),
+            ex.Binary(">", ex.ColumnRef(0), ex.Const(10)),
+        ],
+    )
+    f1 = sc.filter_table(e1, 2)
+    e2 = sc.expression_table(
+        f1,
+        [ex.ColumnRef(0), ex.Binary("*", ex.ColumnRef(1), ex.Const(3.0))],
+    )
+    e3 = sc.expression_table(
+        e2,
+        [ex.ColumnRef(0), ex.Binary("+", ex.ColumnRef(1), ex.Const(1.0))],
+    )
+    if with_sink and events is not None:
+        sc.subscribe_table(
+            e3,
+            on_change=lambda k, row, t, d: events.append((k, row, t, d)),
+        )
+    return sc, sess, e3
+
+
+def _rows(n, start=0):
+    return [
+        (ref_scalar(i), (i, float(i) * 0.5)) for i in range(start, start + n)
+    ]
+
+
+def _run_chain(optimize, n=600, updates=True):
+    events: list = []
+    sc, sess, tail = _chain_scope(events=events)
+    sched = Scheduler(sc, optimize=optimize)
+    for k, r in _rows(n):
+        sess.insert(k, r)
+    sched.commit()
+    if updates:
+        # second commit with retractions + small batches (row path)
+        for k, r in _rows(50, start=100):
+            sess.remove(k, r)
+            sess.insert(k, (r[0], r[1] + 9.0))
+        sched.commit()
+    return sc, tail, sorted(events, key=lambda e: (int(e[0]), e[3], e[2]))
+
+
+# -- fusion ------------------------------------------------------------------
+
+
+class TestChainFusion:
+    def test_chain_fuses_and_reports_stats(self, opt_on):
+        sc, tail, _ = _run_chain(True)
+        stats = optimizer_stats()
+        assert stats["chains_fused"] == 1
+        assert stats["nodes_fused"] == 4  # e1, f1, e2, e3
+        assert isinstance(tail, FusedChainNode)
+
+    def test_event_stream_parity(self):
+        _, tail_off, ev_off = _run_chain(False)
+        _, tail_on, ev_on = _run_chain(True)
+        assert ev_off == ev_on
+        assert dict(tail_off.current) == dict(tail_on.current)
+
+    def test_insert_only_bulk_parity(self):
+        _, tail_off, ev_off = _run_chain(False, n=2000, updates=False)
+        _, tail_on, ev_on = _run_chain(True, n=2000, updates=False)
+        assert ev_off == ev_on
+        assert dict(tail_off.current) == dict(tail_on.current)
+
+    def test_interior_nodes_are_inert(self, opt_on):
+        sc, tail, _ = _run_chain(True)
+        interiors = [
+            node
+            for node in sc.nodes
+            if getattr(node, "_pw_fused_into", None) is not None
+        ]
+        assert len(interiors) == 3  # e1, f1, e2 fold into the e3 tail
+        for node in interiors:
+            assert node.consumers == []
+            assert node.inputs == []
+            assert not node.current  # never received a batch
+            # the node slot itself must survive: schedulers address
+            # replicas by scope.nodes[index]
+            assert sc.nodes[node.index] is node
+
+    def test_node_indices_are_stable_after_fusion(self):
+        sc, _, _ = _run_chain(True)
+        assert [n.index for n in sc.nodes] == list(range(len(sc.nodes)))
+
+    def test_filter_error_value_parity(self):
+        def run(optimize):
+            events: list = []
+            sc = Scope()
+            sess = sc.input_session(2)
+            e1 = sc.expression_table(
+                sess,
+                [
+                    ex.ColumnRef(0),
+                    # 1/x poisons x == 0 rows with ERROR
+                    ex.Binary("/", ex.Const(1.0), ex.ColumnRef(1)),
+                    ex.Binary(">", ex.ColumnRef(0), ex.Const(-1)),
+                ],
+            )
+            f1 = sc.filter_table(
+                sc.expression_table(
+                    e1,
+                    [
+                        ex.ColumnRef(0),
+                        ex.ColumnRef(1),
+                        ex.Binary("<", ex.ColumnRef(1), ex.Const(1e9)),
+                    ],
+                ),
+                2,
+            )
+            sc.subscribe_table(
+                f1,
+                on_change=lambda k, row, t, d: events.append((k, row, d)),
+            )
+            sched = Scheduler(sc, optimize=optimize)
+            for i in range(40):
+                sess.insert(ref_scalar(i), (i, float(i % 5)))
+            sched.commit()
+            log = sorted(sc.error_log_default.current.values())
+            return sorted(events, key=lambda e: (int(e[0]), e[2])), log
+
+        ev_off, log_off = run(False)
+        ev_on, log_on = run(True)
+        assert ev_off == ev_on
+        assert log_off == log_on
+        assert log_on  # the corpus actually exercised the error path
+
+    def test_nonvectorizable_udf_chain_parity(self):
+        def run(optimize):
+            events: list = []
+            sc = Scope()
+            sess = sc.input_session(2)
+            e1 = sc.expression_table(
+                sess,
+                [
+                    ex.ColumnRef(0),
+                    ex.Apply(lambda v: v * 2.0, (ex.ColumnRef(1),)),
+                ],
+            )
+            e2 = sc.expression_table(
+                e1,
+                [ex.Binary("+", ex.ColumnRef(0), ex.ColumnRef(1))],
+            )
+            sc.subscribe_table(
+                e2,
+                on_change=lambda k, row, t, d: events.append((k, row, d)),
+            )
+            sched = Scheduler(sc, optimize=optimize)
+            for k, r in _rows(500):
+                sess.insert(k, r)
+            sched.commit()
+            return sorted(events, key=lambda e: (int(e[0]), e[2]))
+
+        assert run(False) == run(True)
+
+    def test_observed_node_is_never_fused(self, opt_on):
+        # a mid-chain node whose state is read directly (capture path)
+        # must stay un-fused even though it links like a chain member
+        events: list = []
+        sc, sess, tail = _chain_scope(events=events)
+        mid = sc.nodes[2]  # the filter
+        mid._pw_observed = True
+        Scheduler(sc, optimize=True)
+        assert not isinstance(mid, FusedChainNode)
+        assert type(tail).__name__ == "FusedChainNode"  # e2->e3 still fuse
+
+    def test_env_escape_hatch(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_OPTIMIZE", "0")
+        sc, tail, _ = _run_chain(True)
+        assert not isinstance(tail, FusedChainNode)
+        assert optimizer_stats() == {
+            "chains_fused": 0,
+            "nodes_fused": 0,
+            "columns_dropped": 0,
+            "exchanges_elided": 0,
+        }
+
+    def test_optimize_is_idempotent(self, opt_on):
+        events: list = []
+        sc, _, _ = _chain_scope(events=events)
+        first = optimize_scopes([sc])
+        second = optimize_scopes([sc])  # cached, no double rewrite
+        assert first == second
+        assert sum(isinstance(n, FusedChainNode) for n in sc.nodes) == 1
+
+
+# -- projection pushdown -----------------------------------------------------
+
+
+class TestProjectionPushdown:
+    def _wide(self, optimize, n_wide=8):
+        events: list = []
+        sc = Scope()
+        rows = [
+            (ref_scalar(i), tuple(float(i + c) for c in range(n_wide)))
+            for i in range(50)
+        ]
+        src = sc.static_table(rows, n_wide)
+        e1 = sc.expression_table(
+            src, [ex.Binary("+", ex.ColumnRef(1), ex.ColumnRef(5))]
+        )
+        sc.subscribe_table(
+            e1, on_change=lambda k, row, t, d: events.append((k, row, d))
+        )
+        sc.run(optimize=optimize)
+        return sc, src, sorted(events, key=lambda e: (int(e[0]), e[2]))
+
+    def test_static_source_narrowed(self, opt_on):
+        sc, src, ev_on = self._wide(True)
+        assert src.arity == 2
+        assert all(len(r) == 2 for _, r in src._rows)
+        assert optimizer_stats()["columns_dropped"] == 6
+        _, src_off, ev_off = self._wide(False)
+        assert src_off.arity == 8
+        assert ev_on == ev_off
+
+    def test_expression_producer_narrowed(self, opt_on):
+        def run(optimize):
+            events: list = []
+            sc = Scope()
+            sess = sc.input_session(2)
+            wide = sc.expression_table(
+                sess,
+                [
+                    ex.Binary("*", ex.ColumnRef(1), ex.Const(float(c + 1)))
+                    for c in range(6)
+                ],
+            )
+            n1 = sc.expression_table(
+                wide, [ex.Binary("+", ex.ColumnRef(0), ex.ColumnRef(4))]
+            )
+            n2 = sc.expression_table(
+                wide, [ex.Binary("*", ex.ColumnRef(2), ex.ColumnRef(4))]
+            )
+            sc.subscribe_table(
+                n1, on_change=lambda k, row, t, d: events.append((k, row, d))
+            )
+            sc.subscribe_table(
+                n2, on_change=lambda k, row, t, d: events.append((k, row, d))
+            )
+            sched = Scheduler(sc, optimize=optimize)
+            for k, r in _rows(300):
+                sess.insert(k, r)
+            sched.commit()
+            return wide, sorted(
+                events, key=lambda e: (int(e[0]), e[2], repr(e[1]))
+            )
+
+        wide_on, ev_on = run(True)
+        wide_off, ev_off = run(False)
+        assert len(wide_on.expressions) == 3  # cols 0, 2, 4 survive
+        assert wide_on.arity == 3
+        assert len(wide_off.expressions) == 6
+        assert ev_on == ev_off
+
+    def test_no_narrowing_without_sinks(self):
+        sc = Scope()
+        rows = [(ref_scalar(i), (float(i), float(i), float(i))) for i in range(9)]
+        src = sc.static_table(rows, 3)
+        sc.expression_table(src, [ex.ColumnRef(0)])
+        sc.run(optimize=True)
+        # no SubscribeNode: intermediate .current reads are legal, so the
+        # pushdown pass must leave every producer at full width
+        assert src.arity == 3
+
+    def test_groupby_consumer_blocks_narrowing(self):
+        events: list = []
+        sc = Scope()
+        rows = [(ref_scalar(i), (i % 3, float(i), float(i))) for i in range(30)]
+        src = sc.static_table(rows, 3)
+        gb = sc.group_by_table(
+            src, by_cols=[0], reducers=[(SumReducer(), [1])]
+        )
+        sc.subscribe_table(
+            gb, on_change=lambda k, row, t, d: events.append((k, row, d))
+        )
+        sc.run(optimize=True)
+        # GroupbyNode pre-builds its columnar plan at __init__ — it is not
+        # a remappable consumer, so its producer keeps full arity even
+        # though column 2 is dead
+        assert src.arity == 3
+        assert events
+
+
+# -- exchange elision ---------------------------------------------------------
+
+
+def _sharded_scopes(n=3, events=None):
+    """Replicated graph with an elidable non-chain edge (expr -> concat)
+    and a fusable chain feeding a groupby."""
+    scopes = []
+    for w in range(n):
+        sc = Scope()
+        rows = [(Pointer(i), (i % 7, float(i))) for i in range(400)]
+        src = sc.static_table(rows, 2)
+        e1 = sc.expression_table(
+            src,
+            [ex.ColumnRef(0), ex.Binary("*", ex.ColumnRef(1), ex.Const(2.0))],
+        )
+        f1 = sc.filter_table(
+            sc.expression_table(
+                e1,
+                [
+                    ex.ColumnRef(0),
+                    ex.ColumnRef(1),
+                    ex.Binary(">", ex.ColumnRef(1), ex.Const(50.0)),
+                ],
+            ),
+            2,
+        )
+        gb = sc.group_by_table(
+            f1, by_cols=[0], reducers=[(SumReducer(), [1])]
+        )
+        e2 = sc.expression_table(
+            gb,
+            [ex.ColumnRef(0), ex.Binary("+", ex.ColumnRef(1), ex.Const(1.0))],
+        )
+        cc = sc.concat_tables(
+            [e2, sc.static_table([(Pointer(10**6), (99, -1.0))], 2)]
+        )
+        if w == 0 and events is not None:
+            sc.subscribe_table(
+                cc,
+                on_change=lambda k, row, t, d: events.append((k, row, d)),
+            )
+        scopes.append(sc)
+    return scopes
+
+
+class TestExchangeElision:
+    def _run(self, optimize, n=3):
+        events: list = []
+        scopes = _sharded_scopes(n, events)
+        sched = ShardedScheduler(scopes, optimize=optimize)
+        sched.finish()
+        return sched, sorted(
+            events, key=lambda e: (int(e[0]), e[2], repr(e[1]))
+        )
+
+    def test_sharded_parity_and_live_elision(self, opt_on):
+        _, ev_off = self._run(False)
+        before = EXCHANGE_STATS["elided"]
+        sched, ev_on = self._run(True)
+        assert ev_off == ev_on
+        assert sched._elided  # at least the expr -> concat edge survives
+        assert EXCHANGE_STATS["elided"] > before
+
+    def test_verify_mode_accepts_proven_elisions(self, monkeypatch):
+        # PATHWAY_TPU_VERIFY_ELISION recomputes the routing for every
+        # elided delivery — a mis-proof raises AssertionError here
+        monkeypatch.setattr(sharded_mod, "_VERIFY_ELISION", True)
+        _, ev_off = self._run(False)
+        _, ev_on = self._run(True)
+        assert ev_off == ev_on
+
+    def test_pwa201_count_matches_optimizer_stats(self, opt_on):
+        # the analyzer finding set IS the elision oracle: counts agree
+        events: list = []
+        [scope] = _sharded_scopes(1, events)
+        report = analyze_scope(scope)
+        pwa201 = [f for f in report.findings if f.code == "PWA201"]
+        optimize_scopes([_sharded_scopes(1, [])[0]])
+        assert optimizer_stats()["exchanges_elided"] == len(pwa201)
+        assert pwa201  # non-vacuous
+
+    def test_elision_disabled_with_optimizer_off(self):
+        sched, _ = self._run(False)
+        assert sched._elided == set()
+
+
+# -- framework parity corpus --------------------------------------------------
+
+
+def _corpus():
+    def groupby():
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=str, v=int),
+            [(f"k{i % 5}", i) for i in range(60)],
+        )
+        sel = t.select(k=t.k, v=t.v * 2 + 1)
+        flt = sel.filter(sel.v > 7)
+        return flt.groupby(flt.k).reduce(
+            k=flt.k, total=pw.reducers.sum(flt.v), cnt=pw.reducers.count()
+        )
+
+    def join():
+        orders = pw.debug.table_from_rows(
+            pw.schema_from_types(oid=int, cust=str, amount=float),
+            [(i, f"c{i % 4}", float(i) * 1.5) for i in range(40)],
+        )
+        custs = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str, region=str),
+            [(f"c{i}", f"r{i % 2}") for i in range(4)],
+        )
+        j = orders.join(custs, orders.cust == custs.name)
+        return j.select(
+            cust=orders.cust, region=custs.region, amount=orders.amount
+        )
+
+    def temporal():
+        import pathway_tpu.stdlib.temporal as tmp
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(t=int, k=str, v=int),
+            [(i % 23, f"k{i % 3}", i) for i in range(50)],
+        )
+        win = t.windowby(
+            t.t, window=tmp.tumbling(duration=10), instance=t.k
+        )
+        return win.reduce(
+            instance=pw.this["_pw_instance"],
+            start=pw.this["_pw_window_start"],
+            total=pw.reducers.sum(pw.this.v),
+        )
+
+    def iterate():
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(x=int), [(5,), (16,), (7,), (1,)]
+        )
+
+        def body(vals):
+            return {
+                "vals": vals.select(
+                    x=pw.apply(
+                        lambda v: v
+                        if v == 1
+                        else (v // 2 if v % 2 == 0 else 3 * v + 1),
+                        vals.x,
+                    )
+                )
+            }
+
+        return pw.iterate(body, vals=t).vals
+
+    return {
+        "groupby": groupby,
+        "join": join,
+        "temporal": temporal,
+        "iterate": iterate,
+    }
+
+
+def _capture(build, runner_factory, monkeypatch, optimize):
+    monkeypatch.setenv("PATHWAY_TPU_OPTIMIZE", "1" if optimize else "0")
+    G.clear()
+    try:
+        (state,) = runner_factory().capture(build())
+    finally:
+        G.clear()
+    return dict(state)
+
+
+@pytest.mark.parametrize("name", ["groupby", "join", "temporal", "iterate"])
+def test_single_worker_parity(name, monkeypatch):
+    build = _corpus()[name]
+    off = _capture(build, GraphRunner, monkeypatch, False)
+    on = _capture(build, GraphRunner, monkeypatch, True)
+    assert off == on
+
+
+@pytest.mark.parametrize("name", ["groupby", "join", "temporal", "iterate"])
+def test_sharded_parity(name, monkeypatch):
+    build = _corpus()[name]
+    off = _capture(
+        build, lambda: ShardedGraphRunner(3), monkeypatch, False
+    )
+    on = _capture(build, lambda: ShardedGraphRunner(3), monkeypatch, True)
+    assert off == on
+
+
+# -- TCP-mesh parity ----------------------------------------------------------
+
+
+MESH_PROGRAM = """
+    import os
+    import pathway_tpu as pw
+
+    words = pw.io.csv.read(
+        {indir!r},
+        schema=pw.schema_from_types(word=str, n=int),
+        mode="static",
+    )
+    sel = words.select(word=pw.this.word, n=pw.this.n * 3 + 1)
+    flt = sel.filter(sel.n > 10)
+    counts = flt.groupby(flt.word).reduce(
+        word=flt.word, total=pw.reducers.sum(flt.n)
+    )
+    pw.io.csv.write(counts, {out!r})
+    pw.run()
+"""
+
+
+def _free_port_base(n: int) -> int:
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        if base + n >= 65535:
+            continue
+        if all(_bindable(base + i) for i in range(n)):
+            return base
+    raise RuntimeError("no free port range found")
+
+
+def _bindable(port: int) -> bool:
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def _spawn_mesh(tmp_path, code: str, optimize: bool, out):
+    from pathway_tpu.cli import spawn
+
+    prog = tmp_path / f"prog_{int(optimize)}.py"
+    prog.write_text(textwrap.dedent(code))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PATHWAY_TPU_OPTIMIZE"] = "1" if optimize else "0"
+    env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+    rc = spawn(
+        sys.executable,
+        [str(prog)],
+        threads=1,
+        processes=3,
+        first_port=_free_port_base(3),
+        env=env,
+    )
+    assert rc == 0
+    with open(out, newline="") as fh:
+        rows = list(csv.DictReader(fh))
+    return sorted(
+        (r["word"], int(r["total"]))
+        for r in rows
+        if int(r["diff"]) > 0
+    )
+
+
+def test_mesh_parity_optimize_on_off(tmp_path):
+    indir = tmp_path / "in"
+    indir.mkdir()
+    with open(indir / "words.csv", "w") as fh:
+        fh.write("word,n\n")
+        fh.writelines(f"w{i % 11},{i % 9}\n" for i in range(300))
+    results = {}
+    for optimize in (False, True):
+        out = tmp_path / f"out_{int(optimize)}.csv"
+        results[optimize] = _spawn_mesh(
+            tmp_path,
+            MESH_PROGRAM.format(indir=str(indir), out=str(out)),
+            optimize,
+            out,
+        )
+    assert results[True] == results[False]
+    assert results[True]  # the pipeline produced rows
+
+
+# -- checkpoint compatibility -------------------------------------------------
+
+
+class TestCheckpointCompat:
+    def _snap(self, optimize, backend, restore_only=False):
+        events: list = []
+        sc, sess, tail = _chain_scope(events=events)
+        sched = Scheduler(sc, optimize=optimize)
+        mgr = OperatorSnapshotManager(backend)
+        if restore_only:
+            restored = mgr.restore(sc, [])
+            return sc, tail, restored
+        for k, r in _rows(600):
+            sess.insert(k, r)
+        sched.commit()
+        mgr.snapshot(sc, [], sched.time)
+        return sc, tail, None
+
+    def test_round_trip_same_optimize_mode(self, opt_on):
+        backend = MemoryBackend()
+        _, tail1, _ = self._snap(True, backend)
+        _, tail2, restored = self._snap(True, backend, restore_only=True)
+        assert restored is not None
+        assert dict(tail2.current) == dict(tail1.current)
+
+    def test_round_trip_unoptimized(self):
+        backend = MemoryBackend()
+        _, tail1, _ = self._snap(False, backend)
+        _, tail2, restored = self._snap(False, backend, restore_only=True)
+        assert restored is not None
+        assert dict(tail2.current) == dict(tail1.current)
+
+    def test_cross_restore_refused_fused_to_unfused(self, opt_on):
+        backend = MemoryBackend()
+        self._snap(True, backend)
+        with pytest.raises(ValueError, match="PATHWAY_TPU_OPTIMIZE|optimizer"):
+            self._snap(False, backend, restore_only=True)
+
+    def test_cross_restore_refused_unfused_to_fused(self, opt_on):
+        backend = MemoryBackend()
+        self._snap(False, backend)
+        with pytest.raises(ValueError, match="PATHWAY_TPU_OPTIMIZE|optimizer"):
+            self._snap(True, backend, restore_only=True)
+
+    def test_pushdown_only_mismatch_refused(self, opt_on):
+        # sigs stay identical (no fusion), only the pushdown fingerprint
+        # differs — the versioned "optimize" payload check must trip
+        def build(optimize, backend, restore_only=False):
+            sc = Scope()
+            rows = [
+                (ref_scalar(i), tuple(float(i + c) for c in range(6)))
+                for i in range(20)
+            ]
+            src = sc.static_table(rows, 6)
+            a = sc.expression_table(
+                src, [ex.Binary("+", ex.ColumnRef(1), ex.ColumnRef(3))]
+            )
+            b = sc.expression_table(
+                src, [ex.Binary("*", ex.ColumnRef(1), ex.ColumnRef(3))]
+            )
+            sc.subscribe_table(a, on_change=lambda *args: None)
+            sc.subscribe_table(b, on_change=lambda *args: None)
+            sched = Scheduler(sc, optimize=optimize)
+            mgr = OperatorSnapshotManager(backend)
+            if restore_only:
+                return mgr.restore(sc, [])
+            sched.run_static()
+            mgr.snapshot(sc, [], sched.time)
+
+        backend = MemoryBackend()
+        build(True, backend)
+        with pytest.raises(ValueError, match="optimizer"):
+            build(False, backend, restore_only=True)
+
+
+# -- optimizer stats surface --------------------------------------------------
+
+
+def test_exchange_stats_has_elided_counter():
+    assert "elided" in EXCHANGE_STATS
+    from pathway_tpu.engine import distributed as dist
+
+    # distributed re-exports the SAME dict object (historical import path)
+    assert dist.EXCHANGE_STATS is EXCHANGE_STATS
+
+
+def test_groupby_reducers_still_work_after_fused_input():
+    # chain feeding a groupby: the groupby consumes the fused tail's
+    # output exactly as it consumed the unfused filter's
+    def run(optimize):
+        sc = Scope()
+        sess = sc.input_session(2)
+        e1 = sc.expression_table(
+            sess,
+            [
+                ex.ColumnRef(0),
+                ex.ColumnRef(1),
+                ex.Binary(">", ex.ColumnRef(1), ex.Const(5.0)),
+            ],
+        )
+        f1 = sc.filter_table(e1, 2)
+        e2 = sc.expression_table(
+            f1,
+            [
+                ex.Binary("%", ex.ColumnRef(0), ex.Const(4)),
+                ex.ColumnRef(1),
+            ],
+        )
+        gb = sc.group_by_table(
+            e2,
+            by_cols=[0],
+            reducers=[(SumReducer(), [1]), (CountReducer(), [])],
+        )
+        sched = Scheduler(sc, optimize=optimize)
+        for k, r in _rows(400):
+            sess.insert(k, r)
+        sched.commit()
+        for k, r in _rows(30, start=50):
+            sess.remove(k, r)
+        sched.commit()
+        return sorted(gb.current.values())
+
+    assert run(False) == run(True)

@@ -11,7 +11,9 @@ problem):
 2. analyzer self-run — ``python -m pathway_tpu.cli analyze
    bench_dataflow.py`` must exit 0 (no warning/error findings on our own
    pipelines);
-3. sanitized native build — recompile ``native/enginecore.cpp`` with
+3. optimize-off parity — the optimizer parity + engine-core suites rerun
+   with ``PATHWAY_TPU_OPTIMIZE=0`` (the graph rewriter's escape hatch);
+4. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
    (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
@@ -78,6 +80,40 @@ def step_analyzer() -> str:
         "static analyzer self-run (cli analyze bench_dataflow.py)",
         status,
         f"exit code {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+def step_optimize_off() -> str:
+    """Re-run the optimizer parity + engine-core suites with the graph
+    rewriter disabled (PATHWAY_TPU_OPTIMIZE=0): proves the escape hatch
+    works and the unoptimized engine still passes its own semantics
+    tests — the parity corpus compares the two modes bit for bit."""
+    name = "optimize-off parity (PATHWAY_TPU_OPTIMIZE=0)"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_optimize.py",
+            "tests/test_engine_core.py",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+        ],
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_TPU_OPTIMIZE": "0",
+        },
+        timeout=900,
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        name,
+        status,
+        f"pytest exit {proc.returncode}" if status == FAIL else "",
     )
     return status
 
@@ -204,7 +240,7 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    results = [step_ruff(), step_analyzer()]
+    results = [step_ruff(), step_analyzer(), step_optimize_off()]
     if args.skip_sanitized:
         _report("sanitized native build + parity tests", SKIP, "--skip-sanitized")
         results.append(SKIP)
