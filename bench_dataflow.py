@@ -294,6 +294,108 @@ def fused_chain():
     return leg
 
 
+def metrics_overhead_leg():
+    """The fused_chain workload with the metrics plane fully engaged
+    (per-operator probes, StatsMonitor.on_commit, ingest->sink latency
+    histogram, flight-recorder commit events — everything pw.run with
+    MonitoringLevel.ALL would do per commit) vs. fully disengaged.
+    tools/check.py FAILs when the overhead exceeds 5%: the hot path must
+    stay allocation-free enough that observability is effectively free."""
+    n_stages = 8
+    n_base, n_commits, delta = 20_000, 60, 1000
+    if _analyze_only():
+        n_base, n_commits = 5_000, 1
+    rows = [(ref_scalar(i), (i, float(i) * 0.5)) for i in range(n_base)]
+
+    def once(metrics_on: bool) -> float:
+        from pathway_tpu.internals import metrics as _metrics
+
+        scope = Scope()
+        sess = scope.input_session(2)
+        cur = scope.expression_table(
+            sess,
+            [
+                ex.ColumnRef(0),
+                ex.ColumnRef(1),
+                ex.Binary(">", ex.ColumnRef(0), ex.Const(100)),
+            ],
+        )
+        cur = scope.filter_table(cur, 2)
+        for _ in range(n_stages):
+            cur = scope.expression_table(
+                cur,
+                [
+                    ex.ColumnRef(0),
+                    ex.Binary(
+                        "+",
+                        ex.Binary(
+                            "*", ex.ColumnRef(1), ex.Const(1.0000001)
+                        ),
+                        ex.Const(0.5),
+                    ),
+                ],
+            )
+        sched = Scheduler(scope, probe=metrics_on)
+        monitor = hist = None
+        if metrics_on:
+            from pathway_tpu.internals.monitoring import (
+                MonitoringLevel,
+                StatsMonitor,
+            )
+
+            monitor = StatsMonitor(MonitoringLevel.ALL)
+            monitor.scheduler = sched
+            hist = _metrics.REGISTRY.histogram(
+                "pathway_ingest_to_sink_latency_seconds"
+            )
+        for key, row in rows:
+            sess.insert(key, row)
+        sched.commit()
+        if _analyze_only():
+            return 1.0
+        t = 0.0
+        for c in range(n_commits):
+            base = (c * delta) % (n_base - delta)
+            for i in range(base, base + delta):
+                key, row = rows[i]
+                sess.remove(key, row)
+                sess.insert(key, (row[0], row[1] + 1.0))
+            if metrics_on:
+                t0 = time.perf_counter()
+                wall = time.monotonic()
+                sched.commit()
+                monitor.on_commit(c, wall)
+                hist.observe_n(time.monotonic() - wall, 2 * delta)
+                _metrics.FLIGHT.record("commit", time=c)
+                t += time.perf_counter() - t0
+            else:
+                t += timed(sched.commit)
+        return t
+
+    def leg() -> dict:
+        from pathway_tpu.internals import metrics as _metrics
+
+        # off first, then on: identical cache/alloc warmup order every run
+        t_off = min(once(False) for _ in range(3))
+        t_on = min(once(True) for _ in range(3))
+        hist = _metrics.REGISTRY.histogram(
+            "pathway_ingest_to_sink_latency_seconds"
+        )
+        out = {
+            "rows": n_commits * 2 * delta,
+            "metrics_off_s": round(t_off, 4),
+            "metrics_on_s": round(t_on, 4),
+            "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
+        }
+        for name, q in (("latency_p50_ms", 0.5), ("latency_p99_ms", 0.99)):
+            qv = hist.quantile(q)
+            if qv is not None:
+                out[name] = round(qv * 1000.0, 3)
+        return out
+
+    return leg
+
+
 def pushdown_wide_source():
     """Wide producer (12 computed columns, per-row Python UDFs), two
     narrow consumers (3 distinct columns used between them): projection
@@ -567,6 +669,9 @@ def run_all(emit=None) -> dict:
     # throughput plus the optimizer_stats() snapshot of its optimized run
     record("fused_chain", fused_chain()())
     record("pushdown_wide_source", pushdown_wide_source()())
+    # observability tax: the whole metrics plane on vs off over the same
+    # fused chain, plus the per-batch latency histogram's p50/p99
+    record("metrics_overhead", metrics_overhead_leg()())
     if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
         try:
             leg = distributed_leg()
@@ -647,6 +752,7 @@ def main() -> None:
     for name, make in (
         ("fused_chain", fused_chain),
         ("pushdown_wide_source", pushdown_wide_source),
+        ("metrics_overhead", metrics_overhead_leg),
     ):
         print(json.dumps({"workload": name, **make()()}))
     # distributed leg: dtype-tagged columnar frames vs pickled row entries

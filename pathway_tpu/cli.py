@@ -15,6 +15,11 @@ graph-only mode (PATHWAY_TPU_ANALYZE=1): every dataflow graph the program
 builds is statically analyzed instead of executed, and a combined report
 is printed.  Exit codes: 0 = clean (info-level findings allowed), 1 =
 warning/error findings, 2 = the program or the analyzer itself failed.
+
+``python -m pathway_tpu.cli stats <port|host:port|url>`` scrapes a live
+monitoring endpoint (pw.run with_http_server=True; port
+20000 + process_id) and pretty-prints the mesh-wide per-worker table plus
+per-family totals. ``--raw`` dumps the exposition text untouched.
 """
 
 from __future__ import annotations
@@ -144,6 +149,164 @@ def analyze(
             pass
 
 
+def _stats_url(target: str) -> str:
+    """Accept a bare port, host:port, or full URL; default path /metrics."""
+    from urllib.parse import urlparse
+
+    if target.isdigit():
+        return f"http://127.0.0.1:{target}/metrics"
+    if "://" not in target:
+        target = "http://" + target
+    if urlparse(target).path in ("", "/"):
+        target = target.rstrip("/") + "/metrics"
+    return target
+
+
+def _hist_quantile(buckets: list, q: float) -> float | None:
+    """Quantile from cumulative (upper_bound, count) pairs, interpolating
+    linearly within the bucket (the usual Prometheus histogram_quantile)."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    lo_bound, lo_count = 0.0, 0.0
+    for ub, c in buckets:
+        if c >= rank:
+            if ub == float("inf"):
+                return lo_bound
+            span = c - lo_count
+            if span <= 0:
+                return ub
+            return lo_bound + (ub - lo_bound) * (rank - lo_count) / span
+        lo_bound, lo_count = ub, c
+    return buckets[-1][0]
+
+
+def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
+    """Scrape a monitoring endpoint and pretty-print the mesh-wide table.
+
+    On a mesh leader the exposition carries every worker's piggybacked
+    snapshot under ``worker="<process_id>"`` labels, so one scrape shows
+    the whole cluster; rows without a worker label (the legacy local
+    series) print as ``(local)``."""
+    import urllib.request
+
+    url = _stats_url(target)
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except Exception as e:  # noqa: BLE001 — report any scrape failure
+        print(f"stats: scraping {url} failed: {e}", file=sys.stderr)
+        return 2
+    if raw:
+        sys.stdout.write(text)
+        return 0
+    from pathway_tpu.internals import metrics as _metrics
+
+    try:
+        families = _metrics.parse_prometheus_text(text)
+    except ValueError as e:
+        print(f"stats: {url} returned a malformed exposition: {e}",
+              file=sys.stderr)
+        return 2
+
+    def worker_of(labels: dict) -> str:
+        return labels.get("worker", "")
+
+    # -- per-worker mesh table -----------------------------------------------
+    sums: dict[str, dict[str, float]] = {}
+    lat: dict[str, list] = {}
+
+    def add(worker: str, col: str, value: float) -> None:
+        sums.setdefault(worker, {})[col] = (
+            sums.setdefault(worker, {}).get(col, 0.0) + value
+        )
+
+    col_of = {
+        "pathway_output_rows_total": "out_rows",
+        "pathway_operator_rows": "op_rows",
+        "pathway_operator_batches_total": "batches",
+        "pathway_operator_time_seconds": "op_ms",
+        "pathway_exchange_events_total": "exchanges",
+        "pathway_connector_entries_total": "ingested",
+    }
+    for fam_name, fam in families.items():
+        col = col_of.get(fam_name)
+        for name, labels, value in fam["samples"]:
+            w = worker_of(labels)
+            if col is not None:
+                add(w, col, value * (1000.0 if col == "op_ms" else 1.0))
+            elif (
+                fam_name == "pathway_ingest_to_sink_latency_seconds"
+                and name.endswith("_bucket")
+            ):
+                lat.setdefault(w, []).append((float(labels["le"]), value))
+    for w, buckets in lat.items():
+        buckets.sort()
+        sums.setdefault(w, {})
+        sums[w]["lat_n"] = buckets[-1][1] if buckets else 0.0
+        for col, q in (("lat_p50_ms", 0.5), ("lat_p99_ms", 0.99)):
+            qv = _hist_quantile(buckets, q)
+            if qv is not None:
+                sums[w][col] = qv * 1000.0
+
+    print(f"scraped {url}: {len(families)} families")
+    if sums:
+        cols = [
+            "out_rows", "ingested", "op_rows", "batches", "op_ms",
+            "exchanges", "lat_p50_ms", "lat_p99_ms", "lat_n",
+        ]
+        header = ["worker"] + cols
+        rows = []
+        for w in sorted(sums, key=lambda k: (k != "", k)):
+            vals = sums[w]
+            rows.append(
+                [w if w else "(local)"]
+                + [
+                    (f"{vals[c]:.2f}" if c.endswith("_ms")
+                     else f"{vals[c]:.0f}") if c in vals else "-"
+                    for c in cols
+                ]
+            )
+        widths = [
+            max(len(header[i]), *(len(r[i]) for r in rows))
+            for i in range(len(header))
+        ]
+        print()
+        print("  ".join(h.ljust(widths[i]) for i, h in enumerate(header)))
+        for r in rows:
+            print("  ".join(v.rjust(widths[i]) if i else v.ljust(widths[i])
+                            for i, v in enumerate(r)))
+
+    # -- per-family totals ---------------------------------------------------
+    print()
+    name_w = max((len(n) for n in families), default=6)
+    print(f"{'family'.ljust(name_w)}  {'type'.ljust(9)}  series  total")
+    for fam_name in sorted(families):
+        fam = families[fam_name]
+        if fam["type"] == "histogram":
+            series = {
+                tuple(sorted(la.items()))
+                for n, la, _ in fam["samples"] if n.endswith("_count")
+            }
+            total = sum(
+                v for n, _, v in fam["samples"] if n.endswith("_count")
+            )
+        else:
+            series = {
+                tuple(sorted(la.items())) for _, la, _ in fam["samples"]
+            }
+            total = sum(v for _, _, v in fam["samples"])
+        total_s = f"{total:.0f}" if float(total).is_integer() else f"{total:.4g}"
+        print(
+            f"{fam_name.ljust(name_w)}  {fam['type'].ljust(9)}  "
+            f"{len(series):>6}  {total_s}"
+        )
+    return 0
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -178,6 +341,20 @@ def main(argv: Sequence[str] | None = None) -> int:
     p_analyze.add_argument("program")
     p_analyze.add_argument("arguments", nargs=argparse.REMAINDER)
 
+    p_stats = sub.add_parser(
+        "stats",
+        help="scrape a /metrics endpoint and pretty-print the "
+        "mesh-wide table",
+    )
+    p_stats.add_argument(
+        "--raw", action="store_true",
+        help="dump the raw exposition text instead of the table",
+    )
+    p_stats.add_argument("--timeout", type=float, default=5.0)
+    p_stats.add_argument(
+        "target", help="port, host:port, or full URL of the endpoint"
+    )
+
     args = parser.parse_args(argv)
     if args.command == "spawn":
         return spawn(
@@ -194,6 +371,8 @@ def main(argv: Sequence[str] | None = None) -> int:
             as_json=args.json,
             errors_only=args.errors_only,
         )
+    if args.command == "stats":
+        return stats(args.target, raw=args.raw, timeout=args.timeout)
     if args.command == "spawn-from-env":
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")
         if not spawn_args:

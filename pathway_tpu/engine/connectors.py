@@ -26,6 +26,7 @@ from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.engine.graph import InputSession, Node, Scope
 from pathway_tpu.engine.value import Json, Pointer, hash_values, ref_scalar
+from pathway_tpu.internals import metrics as _metrics
 
 # -- parsed events ----------------------------------------------------------
 
@@ -310,6 +311,20 @@ class InputDriver:
         self.entries_total = 0
         self.batches_total = 0
         self.last_entry_wall: float | None = None
+        #: wall stamp of the oldest row fed to the session and not yet
+        #: committed; the runner pops it per commit to observe the
+        #: ingest->sink latency histogram
+        self.first_pending_wall: float | None = None
+        self._m_entries = _metrics.REGISTRY.counter(
+            "pathway_connector_entries_total",
+            "entries ingested per connector",
+            connector=self.source_name,
+        )
+        self._m_batches = _metrics.REGISTRY.counter(
+            "pathway_connector_batches_total",
+            "reader poll batches per connector",
+            connector=self.source_name,
+        )
         # synchronization group pacing (io/_synchronization.py): events
         # whose sync column runs ahead of the group wait here in order
         self.sync_group: Any = None
@@ -389,6 +404,8 @@ class InputDriver:
             self.entries_total += len(entries)
             self.batches_total += 1
             self.last_entry_wall = _time.monotonic()
+            self._m_entries.inc(len(entries))
+            self._m_batches.inc(1)
         replaces = self.reader.replaces_sources
         notify_source = getattr(self.session, "on_source", None)
         for payload, source_id, metadata in entries:
@@ -439,6 +456,8 @@ class InputDriver:
                 # backlogged inserts append into this same list when released
                 self._per_source_rows[source_id] = new_rows
         self._note_pending()
+        if produced and self.first_pending_wall is None:
+            self.first_pending_wall = _time.monotonic()
         if done:
             if self._sync_backlog:
                 # the group still holds events back; report idle until the

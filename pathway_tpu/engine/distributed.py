@@ -118,6 +118,7 @@ COLUMNAR_EXCHANGE = os.environ.get(
 #: import below keeps every historical access path
 #: (``distributed.EXCHANGE_STATS``) pointing at the same object.
 from pathway_tpu.engine.routing import EXCHANGE_STATS  # noqa: E402
+from pathway_tpu.internals import metrics as _metrics  # noqa: E402
 
 _FRAME_MAGIC = b"PWCF"
 _FRAME_VERSION = 1
@@ -459,6 +460,7 @@ class DistributedScheduler:
         n_processes: int,
         transport: MeshTransport,
         n_shared: int | None = None,
+        probe: bool = False,
     ) -> None:
         self.scopes = list(local_scopes)
         for scope in self.scopes:
@@ -470,7 +472,19 @@ class DistributedScheduler:
         self.n_workers = self.threads * n_processes
         self.transport = transport
         self.time = 0
-        self.stats: dict[int, Any] = {}  # monitoring surface parity
+        self.probe = probe
+        #: node index -> OperatorStats aggregated across LOCAL replicas
+        #: (populated by _drain_local under probe; same read surface as
+        #: Scheduler/ShardedScheduler for the monitor + mesh snapshots)
+        self.stats: dict[int, Any] = {}
+        #: peer process id -> last piggybacked metrics snapshot (leader
+        #: only; followers attach theirs to round frames bound for 0)
+        self.mesh_metrics: dict[int, dict] = {}
+        if probe:
+            self._queue_gauge = _metrics.REGISTRY.gauge(
+                "pathway_queue_depth",
+                "operators with pending delta batches (backpressure)",
+            )
         #: shared graph length: nodes with index >= n_shared exist only on
         #: process 0 / scope 0 (sink-side chains attached there). The
         #: runner measures it before attaching sink drivers; guessing it
@@ -833,6 +847,20 @@ class DistributedScheduler:
                 )
         return got
 
+    def _stats_of(self, node: Node):
+        from pathway_tpu.engine.graph import OperatorStats
+
+        st = self.stats.get(node.index)
+        if st is None:
+            st = self.stats[node.index] = OperatorStats()
+        return st
+
+    def _metrics_snapshot(self) -> dict:
+        """This process's registry snapshot plus its per-operator series —
+        the payload followers piggyback on round frames bound for the
+        leader (the mesh stats protocol)."""
+        return _metrics.full_snapshot(self)
+
     # -- commit ------------------------------------------------------------
 
     def _drain_local(self, time: int) -> bool:
@@ -840,13 +868,20 @@ class DistributedScheduler:
         error-log feedback); remote parts accumulate in the outbox.
         Returns True if anything was processed."""
         busy = False
+        probe = self.probe
+        if probe:
+            import time as _walltime
         while True:
             did = False
+            busy_nodes = 0
             for scope_idx, scope in enumerate(self.scopes):
                 for node in scope.nodes:
                     if not node.has_pending():
                         continue
                     did = True
+                    busy_nodes += 1
+                    if probe:
+                        t0 = _walltime.perf_counter()
                     out = node.process(time)
                     if out is None:
                         out = DeltaBatch()
@@ -855,8 +890,29 @@ class DistributedScheduler:
                     # would materialise columnar batches into rows before
                     # the vectorized exchange ships them
                     node._defer_state(out)
+                    if probe:
+                        st = self._stats_of(node)
+                        st.time_spent += _walltime.perf_counter() - t0
+                        st.batches += 1
+                        st.last_time = time
+                        cols = out.columns
+                        if cols is not None:
+                            if cols.diffs is None:
+                                st.insertions += cols.n
+                            else:
+                                pos = int((cols.diffs > 0).sum())
+                                st.insertions += pos
+                                st.deletions += cols.n - pos
+                        else:
+                            for _k, _r, d in out.consolidate():
+                                if d > 0:
+                                    st.insertions += 1
+                                else:
+                                    st.deletions += 1
                     if out:
                         self._deliver(node, out, scope_idx)
+            if probe:
+                self._queue_gauge.value = float(busy_nodes)
             if did:
                 busy = True
                 continue
@@ -986,15 +1042,25 @@ class DistributedScheduler:
         while True:
             busy = self._drain_local(time)
             my_bit = busy or any(self._outbox.values())
+            # mesh stats protocol: once this process goes quiet for the
+            # round, piggyback its metrics snapshot on the frame bound for
+            # the leader — no extra frames, no extra round-trips
+            snap = None
+            if self.process_id != 0 and not my_bit:
+                snap = self._metrics_snapshot()
             for peer in peers:
                 transport.send(
-                    peer, ("round", time, round_no, my_bit, self._outbox[peer])
+                    peer,
+                    (
+                        "round", time, round_no, my_bit, self._outbox[peer],
+                        snap if peer == 0 else None,
+                    ),
                 )
                 self._outbox[peer] = []
             global_busy = my_bit
             for peer in peers:
                 frame = transport.recv(peer)
-                kind, f_time, f_round, bit, deliveries = frame
+                kind, f_time, f_round, bit, deliveries, peer_snap = frame
                 if kind != "round" or f_time != time or f_round != round_no:
                     raise RuntimeError(
                         f"process {self.process_id}: protocol desync with "
@@ -1002,11 +1068,14 @@ class DistributedScheduler:
                         f"({time}, {round_no})"
                     )
                 self._apply_remote(deliveries)
+                if peer_snap is not None:
+                    self.mesh_metrics[peer] = peer_snap
                 global_busy = global_busy or bit
             round_no += 1
             any_work = any_work or global_busy
             if not global_busy:
                 break
+        _metrics.FLIGHT.record("exchange", time=time, rounds=round_no)
         if notify_time_end or any_work:
             for scope in self.scopes:
                 for node in scope.nodes:
@@ -1026,6 +1095,9 @@ class DistributedScheduler:
         time = self.time
         self._exchange_rounds(time)
         self.time += 1
+        _metrics.FLIGHT.record(
+            "commit", time=time, process=self.process_id
+        )
         return time
 
     def finish_local(self) -> None:

@@ -32,6 +32,14 @@ from pathway_tpu.engine.device import VECTOR_THRESHOLD
 from pathway_tpu.engine.expression import EngineExpression, EvalContext
 from pathway_tpu.engine.reducers import Reducer
 from pathway_tpu.engine.value import ERROR, Error, Pointer, hash_values, is_error, ref_scalar, rows_differ
+from pathway_tpu.internals import metrics as _metrics
+
+#: sink-side row counter; one shared series — the per-commit delta is what
+#: stamps the ingest->sink latency histogram (internals/runner.py)
+_OUTPUT_ROWS = _metrics.REGISTRY.counter(
+    "pathway_output_rows_total",
+    "rows delivered to subscribe sinks (insertions and retractions)",
+)
 
 
 class Node:
@@ -2532,13 +2540,24 @@ class SubscribeNode(Node):
 
     def process(self, time: int) -> DeltaBatch:
         batch = self.take(0)
+        rows = 0
+        retractions = 0
         for key, row, diff in batch:
             if self.skip_errors and any(is_error(v) for v in row):
                 self.report(key, "error value in output row")
                 continue
             self._saw_data = True
+            rows += 1
+            if diff < 0:
+                retractions += 1
             if self._on_change is not None:
                 self._on_change(key, row, time, diff)
+        if rows:
+            _OUTPUT_ROWS.inc(rows)
+        if retractions:
+            _metrics.FLIGHT.record(
+                "retractions", time=time, count=retractions, sink=self.index
+            )
         return batch
 
     def on_time_end(self, time: int) -> None:
@@ -2566,6 +2585,7 @@ class ErrorLogNode(Node):
     def log(self, message: str) -> None:
         key = hash_values((next(self._counter), message), salt=b"errlog")
         self.buffered.append((key, (message,), 1))
+        _metrics.FLIGHT.record("error", message=message)
 
     def flush_buffer(self) -> DeltaBatch | None:
         if not self.buffered:
@@ -2883,6 +2903,11 @@ class Scheduler:
         self.time = 0
         self.probe = probe
         self.stats: dict[int, OperatorStats] = {}
+        if probe:
+            self._queue_gauge = _metrics.REGISTRY.gauge(
+                "pathway_queue_depth",
+                "operators with pending delta batches (backpressure)",
+            )
 
     def _stats_of(self, node: Node) -> OperatorStats:
         st = self.stats.get(node.index)
@@ -2897,6 +2922,8 @@ class Scheduler:
             import time as _walltime
         while True:
             dirty = [n for n in scope.nodes if n.has_pending()]
+            if probe:
+                self._queue_gauge.value = float(len(dirty))
             if not dirty:
                 # flush error-log buffers; may create new pending work
                 flushed = False

@@ -30,6 +30,7 @@ from pathway_tpu.engine.value import (
     hash_values,
     hash_values_batch,
 )
+from pathway_tpu.internals import metrics as _metrics
 from pathway_tpu.native import kernels as _native
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -48,12 +49,20 @@ __all__ = [
 #: the TCP mesh (engine/distributed.py re-exports this same dict object).
 #: ``elided`` counts deliveries that skipped routing entirely because the
 #: optimizer proved the exchange redundant (pathway_tpu.optimize.elide).
-EXCHANGE_STATS = {
-    "columnar_frames_sent": 0,
-    "columnar_frames_received": 0,
-    "row_batches_sent": 0,
-    "elided": 0,
-}
+#: Writes mirror into the ``pathway_exchange_events_total{kind=...}``
+#: registry counters (internals/metrics.py) while this dict stays the
+#: authoritative alias all three import paths share.
+EXCHANGE_STATS = _metrics.MirroredCounterDict(
+    "pathway_exchange_events_total",
+    "kind",
+    {
+        "columnar_frames_sent": 0,
+        "columnar_frames_received": 0,
+        "row_batches_sent": 0,
+        "elided": 0,
+    },
+    help="exchange-path events by kind (mirrors EXCHANGE_STATS)",
+)
 
 
 def _shard_of(value: Any, n: int) -> int:

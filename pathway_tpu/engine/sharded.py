@@ -183,6 +183,13 @@ class ShardedScheduler:
         #: node index -> OperatorStats aggregated ACROSS workers (the
         #: monitoring surface reads .scope/.stats like the single Scheduler)
         self.stats: dict[int, Any] = {}
+        if probe:
+            from pathway_tpu.internals import metrics as _metrics
+
+            self._queue_gauge = _metrics.REGISTRY.gauge(
+                "pathway_queue_depth",
+                "operators with pending delta batches (backpressure)",
+            )
         sigs = [
             [type(node).__name__ for node in scope.nodes]
             for scope in self.scopes
@@ -297,11 +304,13 @@ class ShardedScheduler:
             import time as _walltime
         while True:
             busy = False
+            busy_nodes = 0
             for w, scope in enumerate(self.scopes):
                 for node in scope.nodes:
                     if not node.has_pending():
                         continue
                     busy = True
+                    busy_nodes += 1
                     if probe:
                         t0 = _walltime.perf_counter()
                     out = node.process(time)
@@ -332,6 +341,8 @@ class ShardedScheduler:
                                     st.deletions += 1
                     if out:
                         self._deliver(w, node, out)
+            if probe:
+                self._queue_gauge.value = float(busy_nodes)
             if busy:
                 continue
             flushed = False

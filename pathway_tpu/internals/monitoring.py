@@ -63,6 +63,10 @@ class StatsMonitor:
         self.wants_operator_stats = level == MonitoringLevel.ALL
         self.connectors: dict[str, ConnectorStats] = {}
         self.scheduler: Any = None
+        #: peer process id -> piggybacked metrics snapshot; the distributed
+        #: runner points this at DistributedScheduler.mesh_metrics so the
+        #: leader's endpoint exposes the whole mesh with worker labels
+        self.mesh_snapshots: dict[int, dict] = {}
         self.started = _time.monotonic()
         self.commits = 0
         self.output_rows = 0
@@ -120,6 +124,21 @@ class StatsMonitor:
                     str(st.batches),
                     f"{st.time_spent * 1000:.0f}ms",
                 )
+        for peer in sorted(self.mesh_snapshots):
+            snap = self.mesh_snapshots[peer]
+
+            def total(family: str) -> float:
+                fam = snap.get(family) or {}
+                return sum(
+                    s.get("value", 0.0) for s in fam.get("series", ())
+                )
+
+            table.add_row(
+                f"[worker {peer}]",
+                str(int(total("pathway_operator_rows"))),
+                str(int(total("pathway_operator_batches_total"))),
+                f"{total('pathway_operator_time_seconds') * 1000:.0f}ms",
+            )
         return table
 
     def start_live(self) -> None:
@@ -149,50 +168,76 @@ class StatsMonitor:
     # -- prometheus ----------------------------------------------------------
 
     def prometheus_text(self) -> str:
-        """OpenMetrics text format (reference http_server.rs:96-194:
-        input/output latency + per-connector counters)."""
-        lines = [
-            "# TYPE pathway_commits_total counter",
-            f"pathway_commits_total {self.commits}",
-            "# TYPE pathway_uptime_seconds gauge",
-            f"pathway_uptime_seconds {_time.monotonic() - self.started:.3f}",
+        """OpenMetrics text format (reference http_server.rs:96-194).
+
+        Three layers share one exposition, each family getting exactly one
+        HELP/TYPE block:
+
+        - the legacy unlabelled local series (commits, uptime, connector
+          entries, per-operator rows/time) — backwards compatible;
+        - this process's full registry snapshot (exchange counters, native
+          kernel hits/ns, optimizer stats, ingest->sink latency histogram)
+          under ``worker="<process_id>"``;
+        - in a mesh run, every follower's piggybacked snapshot under its
+          own ``worker`` label — the leader exposes the whole mesh.
+        """
+        import os
+
+        from pathway_tpu.internals import metrics as _metrics
+
+        legacy: dict = {}
+        samples = [
+            (
+                "pathway_commits_total",
+                "counter",
+                "commits completed by this run",
+                {},
+                self.commits,
+            ),
+            (
+                "pathway_uptime_seconds",
+                "gauge",
+                "seconds since the run started",
+                {},
+                _time.monotonic() - self.started,
+            ),
         ]
         if self._latency_ms is not None:
-            lines += [
-                "# TYPE pathway_commit_latency_ms gauge",
-                f"pathway_commit_latency_ms {self._latency_ms:.3f}",
-            ]
-        def esc(v: str) -> str:
-            # Prometheus exposition label escaping: \ " and newline
-            return (
-                v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+            samples.append(
+                (
+                    "pathway_commit_latency_ms",
+                    "gauge",
+                    "wall latency of the most recent commit",
+                    {},
+                    self._latency_ms,
+                )
             )
-
-        lines.append("# TYPE pathway_input_entries_total counter")
         # snapshot: the run thread inserts concurrently with scrapes
         for st in list(self.connectors.values()):
-            lines.append(
-                f'pathway_input_entries_total{{connector="{esc(st.name)}"}} '
-                f"{st.entries}"
+            samples.append(
+                (
+                    "pathway_input_entries_total",
+                    "counter",
+                    "entries ingested per connector",
+                    {"connector": st.name},
+                    st.entries,
+                )
             )
+        _metrics.merge_samples(legacy, samples)
         if self.scheduler is not None:
-            lines.append("# TYPE pathway_operator_rows gauge")
-            lines.append("# TYPE pathway_operator_time_seconds counter")
-            stats = dict(self.scheduler.stats)
-            for node in list(self.scheduler.scope.nodes):
-                st = stats.get(node.index)
-                if st is None:
-                    continue
-                label = f'operator="{esc(node.name)}",index="{node.index}"'
-                lines.append(
-                    f"pathway_operator_rows{{{label}}} "
-                    f"{st.insertions - st.deletions}"
-                )
-                lines.append(
-                    f"pathway_operator_time_seconds{{{label}}} "
-                    f"{st.time_spent:.6f}"
-                )
-        return "\n".join(lines) + "\n"
+            _metrics.merge_samples(
+                legacy,
+                _metrics.operator_samples(
+                    dict(self.scheduler.stats),
+                    list(self.scheduler.scope.nodes),
+                ),
+            )
+        worker = os.environ.get("PATHWAY_PROCESS_ID", "0")
+        snaps: dict[str, dict] = {"": legacy}
+        snaps[worker] = _metrics.full_snapshot(self.scheduler)
+        for peer in sorted(self.mesh_snapshots):
+            snaps[str(peer)] = self.mesh_snapshots[peer]
+        return _metrics.render_snapshots(snaps)
 
 
 class MonitoringHttpServer:
@@ -237,3 +282,8 @@ class MonitoringHttpServer:
     def stop(self) -> None:
         self.server.shutdown()
         self.server.server_close()
+        # join the serve thread so a raising run cannot leak it (nor keep
+        # the port bound through a lingering accept loop); idempotent
+        thread = getattr(self, "_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)

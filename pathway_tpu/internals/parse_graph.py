@@ -150,11 +150,16 @@ def run(
                     monitor, port=monitoring_server_port
                 )
 
+    from pathway_tpu.internals.metrics import FLIGHT
     from pathway_tpu.internals.telemetry import run_span, telemetry_enabled
 
     if telemetry_enabled():
         # per-operator stats feed the metrics sampler + operator spans
         runner.probe_stats = True
+    FLIGHT.record(
+        "run_start", threads=threads, processes=processes,
+        process_id=int(config.process_id),
+    )
     try:
         with run_span(lambda: getattr(runner, "scheduler", None)):
             if isinstance(runner, (ShardedGraphRunner, DistributedGraphRunner)):
@@ -177,6 +182,14 @@ def run(
 
                     check_strict(runner.scope)
                 runner.run()
+        FLIGHT.record("run_end")
+    except BaseException as exc:
+        # crash forensics from ANY worker: the last commits/exchanges/
+        # errors of this process land on disk before the raise surfaces
+        # (PATHWAY_TPU_FLIGHT_DIR picks where)
+        FLIGHT.record("run_error", error=repr(exc))
+        FLIGHT.dump(f"pw.run raised: {exc!r}")
+        raise
     finally:
         if monitor is not None:
             monitor.stop()

@@ -48,6 +48,47 @@ _CAST_NAMES = {
 
 
 from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
+from pathway_tpu.internals import metrics as _metrics
+
+#: ingest->sink latency, observed once per delta batch weighted by the
+#: rows the commit delivered to subscribe sinks
+_INGEST_LATENCY = _metrics.REGISTRY.histogram(
+    "pathway_ingest_to_sink_latency_seconds",
+    "end-to-end ingest->sink latency stamped per delta batch",
+)
+#: same series the sink nodes bump (engine/graph.py SubscribeNode)
+_OUT_ROWS = _metrics.REGISTRY.counter("pathway_output_rows_total")
+
+
+def _take_ingest_stamp(drivers: list) -> float | None:
+    """Pop the oldest pending-row wall stamp across connector drivers
+    (InputDriver.poll sets it when rows enter a session); the commit that
+    follows delivers those rows, closing the latency window."""
+    best = None
+    for d in drivers:
+        inner = getattr(d, "driver", d)
+        stamp = getattr(inner, "first_pending_wall", None)
+        if stamp is not None:
+            inner.first_pending_wall = None
+            if best is None or stamp < best:
+                best = stamp
+    return best
+
+
+def _observe_commit_latency(
+    stamp: float | None, commit_started: float, rows_before: float
+) -> None:
+    """Stamp the latency histogram with this commit's sink-row delta.
+    Rows without an ingest stamp (static data, replays) fall back to the
+    commit start so the histogram ``_count`` always equals the rows the
+    sinks produced."""
+    import time as _time
+
+    rows = int(_OUT_ROWS.value - rows_before)
+    if rows <= 0:
+        return
+    origin = stamp if stamp is not None else commit_started
+    _INGEST_LATENCY.observe_n(max(0.0, _time.monotonic() - origin), rows)
 
 
 def _pump_drivers(w0: "GraphRunner", drivers: list, on_data, on_idle=None) -> None:
@@ -1022,7 +1063,11 @@ class GraphRunner:
         sched.time += 1
         def on_data() -> None:
             commit_started = _time.monotonic()
+            stamp = _take_ingest_stamp(self.drivers)
+            rows_before = _OUT_ROWS.value
             time = sched.commit()
+            _observe_commit_latency(stamp, commit_started, rows_before)
+            _metrics.FLIGHT.record("commit", time=time)
             for driver in persistent:
                 driver.on_commit(time)
             if snapshot_mgr is not None:
@@ -1173,7 +1218,11 @@ class ShardedGraphRunner:
 
         def on_data() -> None:
             started = _time.monotonic()
+            stamp = _take_ingest_stamp(drivers)
+            rows_before = _OUT_ROWS.value
             time = sched.commit()
+            _observe_commit_latency(stamp, started, rows_before)
+            _metrics.FLIGHT.record("commit", time=time)
             for d in persistent:
                 d.on_commit(time)
             if snapshot_mgr is not None:
@@ -1334,10 +1383,22 @@ class DistributedGraphRunner:
                 n_shared=getattr(
                     self, "n_shared", len(self.workers[0].scope.nodes)
                 ),
+                # followers always probe: their piggybacked mesh snapshots
+                # must carry per-operator series for the leader's /metrics
+                # even though their own monitoring level is forced NONE
+                probe=(
+                    self.monitor is not None
+                    and getattr(self.monitor, "wants_operator_stats", True)
+                )
+                or getattr(self, "probe_stats", False)
+                or self.process_id != 0,
             )
             self.scheduler = sched  # telemetry sampler reads stats here
             if self.monitor is not None:
                 self.monitor.scheduler = sched
+                # live reference: the leader's endpoint renders follower
+                # snapshots as they arrive on round frames
+                self.monitor.mesh_snapshots = sched.mesh_metrics
             if self.process_id == 0:
                 sched.announce_topology()
                 self._coordinate(sched, transport)
@@ -1364,8 +1425,11 @@ class DistributedGraphRunner:
             nonlocal last_sign_of_life
             transport.raise_if_peer_dead()
             started = _time.monotonic()
+            stamp = _take_ingest_stamp(drivers)
+            rows_before = _OUT_ROWS.value
             transport.broadcast(("cmd", "commit"))
             time = sched.commit_local()
+            _observe_commit_latency(stamp, started, rows_before)
             for d in persistent:
                 d.on_commit(time)
             if self.monitor is not None:

@@ -199,6 +199,12 @@ class _MetricsSampler(threading.Thread):
 
     def stop(self) -> None:
         self._stop.set()
+        # join before the final sample: a raising run must not leave the
+        # sampler thread alive mid-_sample_once (shutdown hygiene — the
+        # regression test asserts no pw-telemetry thread survives pw.run)
+        if self.is_alive():
+            with contextlib.suppress(Exception):
+                self.join(timeout=5.0)
         # final sample: runs shorter than one interval still publish their
         # end-of-run process + operator counters
         with contextlib.suppress(Exception):

@@ -141,6 +141,15 @@ def hit_counts() -> dict[str, int]:
     return kernels.hit_counts()
 
 
+def kernel_ns() -> dict[str, int]:
+    """Cumulative wall nanoseconds spent inside each native kernel since
+    process start (or the last :func:`reset_hit_counts`); empty when the
+    native module is absent or the .so predates the timers."""
+    if kernels is None or not hasattr(kernels, "kernel_ns"):
+        return {}
+    return kernels.kernel_ns()
+
+
 def reset_hit_counts() -> None:
     if kernels is not None and hasattr(kernels, "reset_hit_counts"):
         kernels.reset_hit_counts()

@@ -20,6 +20,7 @@
 #include <numpy/arrayobject.h>
 
 #include <cmath>
+#include <ctime>
 #include <vector>
 
 namespace {
@@ -65,13 +66,40 @@ const char *const HIT_NAMES[H_N_KERNELS] = {
 
 unsigned long long g_hits[H_N_KERNELS] = {0};
 
-#define HIT(id) (g_hits[id]++)
+/* cumulative wall nanoseconds inside each kernel (scope of the HIT
+ * declaration to scope exit), feeding kernel_ns() and from there the
+ * pathway_native_kernel_ns_total registry series */
+unsigned long long g_ns[H_N_KERNELS] = {0};
 
-PyObject *hit_counts(PyObject *, PyObject *) {
+struct KTimer {
+  int id;
+  struct timespec t0;
+  explicit KTimer(int id_) : id(id_) {
+    g_hits[id_]++;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+  }
+  ~KTimer() {
+    struct timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    long long d = (long long)(t1.tv_sec - t0.tv_sec) * 1000000000LL +
+                  (long long)(t1.tv_nsec - t0.tv_nsec);
+    if (d > 0) g_ns[id] += (unsigned long long)d;
+  }
+};
+
+/* HIT(id) declares an RAII probe: bumps the hit counter on entry and
+ * accumulates nanoseconds until the enclosing scope exits. Every call
+ * site is a standalone statement, so the declaration is safe; __LINE__
+ * keeps names unique within one scope. */
+#define PW_HIT_CAT2(a, b) a##b
+#define PW_HIT_CAT(a, b) PW_HIT_CAT2(a, b)
+#define HIT(id) KTimer PW_HIT_CAT(_pw_ktimer_, __LINE__)(id)
+
+PyObject *counts_dict(const unsigned long long table[H_N_KERNELS]) {
   PyObject *out = PyDict_New();
   if (!out) return nullptr;
   for (int i = 0; i < H_N_KERNELS; i++) {
-    PyObject *v = PyLong_FromUnsignedLongLong(g_hits[i]);
+    PyObject *v = PyLong_FromUnsignedLongLong(table[i]);
     if (!v || PyDict_SetItemString(out, HIT_NAMES[i], v) < 0) {
       Py_XDECREF(v);
       Py_DECREF(out);
@@ -82,8 +110,15 @@ PyObject *hit_counts(PyObject *, PyObject *) {
   return out;
 }
 
+PyObject *hit_counts(PyObject *, PyObject *) { return counts_dict(g_hits); }
+
+PyObject *kernel_ns(PyObject *, PyObject *) { return counts_dict(g_ns); }
+
 PyObject *reset_hit_counts(PyObject *, PyObject *) {
-  for (int i = 0; i < H_N_KERNELS; i++) g_hits[i] = 0;
+  for (int i = 0; i < H_N_KERNELS; i++) {
+    g_hits[i] = 0;
+    g_ns[i] = 0;
+  }
   Py_RETURN_NONE;
 }
 
@@ -1784,6 +1819,8 @@ PyMethodDef methods[] = {
      "session_overlay(buffer, state, upsert) -> entries | None"},
     {"hit_counts", hit_counts, METH_NOARGS,
      "hit_counts() -> {kernel: calls}"},
+    {"kernel_ns", kernel_ns, METH_NOARGS,
+     "kernel_ns() -> {kernel: cumulative nanoseconds}"},
     {"reset_hit_counts", reset_hit_counts, METH_NOARGS,
      "reset_hit_counts()"},
     {nullptr, nullptr, 0, nullptr}};

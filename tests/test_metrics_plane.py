@@ -1,0 +1,530 @@
+"""Mesh-wide metrics plane: registry, exposition conformance, latency
+histograms, leader-aggregated /metrics, flight recorder, shutdown hygiene
+(reference: src/engine/http_server.rs:22-194, telemetry.rs:195-407)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals.monitoring import (
+    MonitoringHttpServer,
+    MonitoringLevel,
+    StatsMonitor,
+)
+from pathway_tpu.internals.parse_graph import G
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port_base(n: int) -> int:
+    """A base port such that base..base+n-1 are currently bindable."""
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        if base + n >= 65535:
+            continue
+        ok = True
+        for i in range(n):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + i))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port range found")
+
+
+def _scrape(port: int) -> str:
+    return (
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        )
+        .read()
+        .decode()
+    )
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_series(self):
+        r = _metrics.Registry()
+        c = r.counter("reqs_total", "requests", route="/a")
+        c.inc()
+        c.inc(4)
+        assert r.counter("reqs_total", route="/a") is c
+        assert r.counter("reqs_total", route="/b") is not c
+        g = r.gauge("depth")
+        g.set(3.0)
+        h = r.histogram("lat", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe_n(0.5, 3)
+        h.observe(5.0)
+        assert h.count == 5
+        assert h.counts == [1, 3, 1]
+        assert h.sum == pytest.approx(0.05 + 1.5 + 5.0)
+        snap = r.snapshot()
+        assert snap["reqs_total"]["kind"] == "counter"
+        assert len(snap["reqs_total"]["series"]) == 2
+        (hs,) = snap["lat"]["series"]
+        assert hs["count"] == 5 and hs["counts"] == [1, 3, 1]
+
+    def test_kind_conflict_raises(self):
+        r = _metrics.Registry()
+        r.counter("x_total")
+        with pytest.raises(ValueError):
+            r.gauge("x_total")
+
+    def test_histogram_bounds_must_increase(self):
+        with pytest.raises(ValueError):
+            _metrics.Histogram((1.0, 1.0, 2.0))
+        with pytest.raises(ValueError):
+            _metrics.Histogram((2.0, 1.0))
+
+    def test_quantile_interpolates(self):
+        h = _metrics.Histogram((1.0, 2.0, 4.0))
+        h.observe_n(0.5, 10)  # all in the first bucket
+        assert 0.0 < h.quantile(0.5) <= 1.0
+        h2 = _metrics.Histogram((1.0,))
+        assert h2.quantile(0.99) == 0.0  # empty
+
+    def test_observe_n_ignores_nonpositive(self):
+        h = _metrics.Histogram((1.0,))
+        h.observe_n(0.5, 0)
+        h.observe_n(0.5, -3)
+        assert h.count == 0
+
+    def test_broken_collector_does_not_break_snapshot(self):
+        r = _metrics.Registry()
+        r.counter("ok_total").inc()
+
+        def broken():
+            raise RuntimeError("collector exploded")
+
+        r.register_collector(broken)
+        snap = r.snapshot()
+        assert "ok_total" in snap
+
+
+class TestExpositionConformance:
+    def test_render_parse_roundtrip_with_hostile_labels(self):
+        r = _metrics.Registry()
+        hostile = 'we"ird\\name\nwith newline'
+        r.counter("evil_total", "hostile labels", connector=hostile).inc(7)
+        h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe_n(0.05, 3)
+        text = _metrics.render_snapshots({"": r.snapshot()})
+        families = _metrics.validate_exposition(text)
+        (name, labels, value) = families["evil_total"]["samples"][0]
+        assert labels["connector"] == hostile
+        assert value == 7
+        counts = {
+            la["le"]: v
+            for n, la, v in families["lat_seconds"]["samples"]
+            if n.endswith("_bucket")
+        }
+        assert counts == {"0.1": 3, "1": 3, "+Inf": 3}
+
+    def test_one_help_type_block_per_family_across_workers(self):
+        r = _metrics.Registry()
+        r.counter("shared_total", "shared").inc(1)
+        snap = r.snapshot()
+        text = _metrics.render_snapshots({"0": snap, "1": snap, "2": snap})
+        assert text.count("# TYPE shared_total counter") == 1
+        assert text.count("# HELP shared_total") == 1
+        families = _metrics.validate_exposition(text)
+        workers = {
+            la["worker"] for _n, la, _v in families["shared_total"]["samples"]
+        }
+        assert workers == {"0", "1", "2"}
+
+    def test_validate_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            _metrics.validate_exposition("orphan_metric 1\n")
+        with pytest.raises(ValueError):
+            _metrics.validate_exposition(
+                "# HELP h x\n# TYPE h histogram\n"
+                'h_bucket{le="1"} 1\nh_sum 1\n'  # no +Inf, no _count
+            )
+        with pytest.raises(ValueError):
+            _metrics.parse_prometheus_text("# COMMENT nope\n")
+        with pytest.raises(ValueError):
+            _metrics.parse_prometheus_text("# TYPE x frobnicator\n")
+
+    def test_monitor_exposition_is_conformant(self):
+        # the exchange counter family registers when the routing layer
+        # loads — make sure it's present regardless of test ordering
+        from pathway_tpu.engine import routing  # noqa: F401
+
+        monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+        monitor.connector('fs:"quo\\ted"').entries = 3
+        monitor.on_commit(1, time.monotonic())
+        families = _metrics.validate_exposition(monitor.prometheus_text())
+        assert "pathway_commits_total" in families
+        assert "pathway_uptime_seconds" in families
+        # registry families ride along under this process's worker label
+        assert "pathway_exchange_events_total" in families
+        assert "pathway_optimizer_chains_fused" in families
+        names = {
+            la.get("connector")
+            for _n, la, _v in families["pathway_input_entries_total"][
+                "samples"
+            ]
+        }
+        assert 'fs:"quo\\ted"' in names
+
+
+class TestExchangeStatsAbsorption:
+    def test_single_dict_alias_across_modules(self):
+        from pathway_tpu.engine import distributed, routing, sharded
+
+        assert routing.EXCHANGE_STATS is sharded.EXCHANGE_STATS
+        assert routing.EXCHANGE_STATS is distributed.EXCHANGE_STATS
+
+    def test_writes_mirror_into_registry_counter(self):
+        from pathway_tpu.engine.routing import EXCHANGE_STATS
+
+        c = _metrics.REGISTRY.counter(
+            "pathway_exchange_events_total", kind="elided"
+        )
+        EXCHANGE_STATS["elided"] += 1
+        assert c.value == float(EXCHANGE_STATS["elided"])
+        EXCHANGE_STATS["elided"] += 2
+        assert c.value == float(EXCHANGE_STATS["elided"])
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self):
+        fr = _metrics.FlightRecorder(maxlen=4)
+        for i in range(10):
+            fr.record("commit", time=i)
+        events = fr.snapshot()
+        assert len(events) == 4
+        assert [e["time"] for e in events] == [6, 7, 8, 9]
+        assert [e["seq"] for e in events] == [7, 8, 9, 10]
+
+    def test_dump_format(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_FLIGHT_DIR", str(tmp_path))
+        fr = _metrics.FlightRecorder(maxlen=8)
+        fr.record("error", message="boom")
+        path = fr.dump("test reason")
+        assert path is not None and os.path.exists(path)
+        assert os.path.basename(path).startswith("pathway_flight_p")
+        with open(path) as fh:
+            payload = json.load(fh)
+        assert payload["reason"] == "test reason"
+        assert payload["pid"] == os.getpid()
+        assert payload["events"][0]["kind"] == "error"
+        assert payload["events"][0]["message"] == "boom"
+
+
+class TestLiveScrapeSharded:
+    def test_scrape_during_sharded_run(self):
+        """The endpoint must serve conformant text WHILE a 2-worker
+        sharded run is pumping commits, and the final scrape must carry
+        the latency histogram with _count == output rows."""
+        from pathway_tpu.internals.runner import ShardedGraphRunner
+
+        G.clear()
+        rows_out = []
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(40):
+                    self.next(k=i % 4, v=i)
+                    if i % 10 == 9:
+                        self.commit()
+                        time.sleep(0.05)
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(k=int, v=int),
+            autocommit_duration_ms=None,
+        )
+        agg = t.groupby(pw.this.k).reduce(
+            k=pw.this.k, s=pw.reducers.sum(pw.this.v)
+        )
+        # single sink: every row pathway_output_rows_total counts lands in
+        # rows_out too, so the two tallies must match exactly
+        pw.io.subscribe(
+            agg,
+            on_change=lambda key, row, time, is_addition: rows_out.append(
+                row
+            ),
+        )
+
+        out_before = _metrics.REGISTRY.counter(
+            "pathway_output_rows_total"
+        ).value
+        hist = _metrics.REGISTRY.histogram(
+            "pathway_ingest_to_sink_latency_seconds"
+        )
+        count_before = hist.count
+
+        runner = ShardedGraphRunner(2)
+        monitor = StatsMonitor(MonitoringLevel.ALL)
+        runner.monitor = monitor
+        runner.attach_sinks()
+        server = MonitoringHttpServer(monitor, port=0)
+        mid_run: list[str] = []
+        done = threading.Event()
+
+        def poll():
+            while not done.is_set():
+                try:
+                    mid_run.append(_scrape(server.port))
+                except Exception:
+                    pass
+                time.sleep(0.02)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            runner.run()
+            done.set()
+            poller.join(timeout=5)
+            final = _scrape(server.port)
+        finally:
+            done.set()
+            server.stop()
+            G.clear()
+        assert mid_run, "no successful scrape during the run"
+        _metrics.validate_exposition(mid_run[-1])
+        families = _metrics.validate_exposition(final)
+        out_rows = _metrics.REGISTRY.counter(
+            "pathway_output_rows_total"
+        ).value
+        assert out_rows - out_before == len(rows_out) > 0
+        assert hist.count - count_before == out_rows - out_before
+        hist_counts = [
+            v
+            for n, _la, v in families[
+                "pathway_ingest_to_sink_latency_seconds"
+            ]["samples"]
+            if n.endswith("_count")
+        ]
+        assert sum(hist_counts) == hist.count
+        assert "pathway_operator_rows" in families
+        assert "pathway_queue_depth" in families
+
+
+class TestMeshAggregation:
+    def test_leader_metrics_cover_all_workers(self, tmp_path):
+        """3-process TCP mesh: one scrape of the LEADER endpoint reports
+        per-worker-labelled operator counters for every process, and the
+        ingest->sink latency histogram _count equals rows produced."""
+        from pathway_tpu.cli import spawn
+
+        indir = tmp_path / "in"
+        indir.mkdir()
+        words = [f"w{i % 17}" for i in range(300)]
+        with open(indir / "words.csv", "w") as fh:
+            fh.write("word\n")
+            fh.writelines(f"{w}\n" for w in words)
+        out = tmp_path / "out.csv"
+        scrape_path = tmp_path / "scrape.txt"
+        prog = tmp_path / "prog.py"
+        prog.write_text(
+            textwrap.dedent(
+                """
+                import os, urllib.request
+                import pathway_tpu as pw
+
+                pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+                port = int(os.environ["TEST_METRICS_PORT_BASE"]) + pid
+                words = pw.io.csv.read(
+                    {indir!r},
+                    schema=pw.schema_from_types(word=str),
+                    mode="static",
+                )
+                counts = words.groupby(pw.this.word).reduce(
+                    word=pw.this.word, count=pw.reducers.count()
+                )
+                pw.io.csv.write(counts, {out!r})
+                pw.run(
+                    with_http_server=True,
+                    monitoring_server_port=port,
+                    _keep_http_server=True,
+                )
+                if pid == 0:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{{port}}/metrics", timeout=10
+                    ).read().decode()
+                    with open({scrape!r}, "w") as fh:
+                        fh.write(body)
+                """.format(
+                    indir=str(indir),
+                    out=str(out),
+                    scrape=str(scrape_path),
+                )
+            )
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TEST_METRICS_PORT_BASE"] = str(_free_port_base(3))
+        env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+        rc = spawn(
+            sys.executable,
+            [str(prog)],
+            threads=1,
+            processes=3,
+            first_port=_free_port_base(3),
+            env=env,
+        )
+        assert rc == 0
+        families = _metrics.validate_exposition(scrape_path.read_text())
+
+        workers = {
+            la.get("worker")
+            for _n, la, _v in families["pathway_operator_rows"]["samples"]
+            if "worker" in la
+        }
+        assert {"0", "1", "2"} <= workers, workers
+
+        def worker0(family: str, suffix: str = "") -> float:
+            return sum(
+                v
+                for n, la, v in families[family]["samples"]
+                if la.get("worker") == "0"
+                and (not suffix or n.endswith(suffix))
+                and (suffix or n == family)
+            )
+
+        out_rows = worker0("pathway_output_rows_total")
+        hist_count = worker0(
+            "pathway_ingest_to_sink_latency_seconds", "_count"
+        )
+        with open(out) as fh:
+            produced = sum(1 for _ in fh) - 1  # minus header
+        assert out_rows == produced > 0
+        assert hist_count == out_rows
+
+
+class TestShutdownHygiene:
+    def test_failing_run_leaks_nothing_and_dumps_flight(
+        self, tmp_path, monkeypatch
+    ):
+        """A raising pw.run must stop the metrics sampler thread, release
+        the HTTP port, and leave a flight-recorder JSON dump behind."""
+        monkeypatch.setenv("PATHWAY_PROCESS_METRICS", "1")
+        monkeypatch.setenv("PATHWAY_TELEMETRY_INTERVAL_S", "0.05")
+        monkeypatch.setenv("PATHWAY_TPU_FLIGHT_DIR", str(tmp_path))
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(x=int), [(1,), (2,)]
+        )
+
+        def boom(key, row, time, is_addition):
+            raise RuntimeError("sink exploded")
+
+        pw.io.subscribe(t, on_change=boom)
+        port = _free_port_base(1)
+        with pytest.raises(RuntimeError, match="sink exploded"):
+            pw.run(with_http_server=True, monitoring_server_port=port)
+        # no leaked sampler thread
+        leaked = [
+            th
+            for th in threading.enumerate()
+            if th.name == "pw-telemetry" and th.is_alive()
+        ]
+        assert not leaked, leaked
+        # port released: plain re-bind (no SO_REUSEADDR) must succeed
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", port))
+        finally:
+            s.close()
+        # flight dump exists and records the failure
+        dumps = [
+            f
+            for f in os.listdir(tmp_path)
+            if f.startswith("pathway_flight_p") and f.endswith(".json")
+        ]
+        assert dumps, os.listdir(tmp_path)
+        with open(tmp_path / dumps[0]) as fh:
+            payload = json.load(fh)
+        assert "sink exploded" in payload["reason"]
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "run_start" in kinds
+        assert "run_error" in kinds
+
+
+class TestCliStats:
+    def test_stats_pretty_prints_table(self, capsys):
+        from pathway_tpu import cli
+
+        _metrics.REGISTRY.counter("pathway_output_rows_total").inc(0)
+        monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+        monitor.on_commit(1, time.monotonic())
+        server = MonitoringHttpServer(monitor, port=0)
+        try:
+            assert cli.main(["stats", str(server.port)]) == 0
+        finally:
+            server.stop()
+        out = capsys.readouterr().out
+        assert "worker" in out
+        assert "pathway_commits_total" in out
+
+    def test_stats_raw_dumps_exposition(self, capsys):
+        from pathway_tpu import cli
+
+        monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+        server = MonitoringHttpServer(monitor, port=0)
+        try:
+            assert cli.main(["stats", "--raw", str(server.port)]) == 0
+        finally:
+            server.stop()
+        out = capsys.readouterr().out
+        _metrics.validate_exposition(out)
+
+    def test_stats_unreachable_endpoint_exits_2(self):
+        from pathway_tpu import cli
+
+        port = _free_port_base(1)
+        assert cli.main(["stats", "--timeout", "1", str(port)]) == 2
+
+
+class TestNativeKernelTimers:
+    def test_kernel_ns_mirrors_hit_counts(self):
+        from pathway_tpu import native
+
+        if not native.available():
+            assert native.kernel_ns() == {}
+            pytest.skip("native kernels unavailable")
+        ns = native.kernel_ns()
+        hits = native.hit_counts()
+        assert set(ns) == set(hits)
+        assert all(
+            isinstance(v, int) and v >= 0 for v in ns.values()
+        )
+
+    def test_reset_zeroes_both(self):
+        from pathway_tpu import native
+
+        if not native.available():
+            pytest.skip("native kernels unavailable")
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int), [(i,) for i in range(200)]
+        )
+        r = t.select(b=pw.this.a + 1)
+        pw.debug.compute_and_print(r, include_id=False)
+        native.reset_hit_counts()
+        assert sum(native.hit_counts().values()) == 0
+        assert sum(native.kernel_ns().values()) == 0

@@ -13,7 +13,11 @@ problem):
    pipelines);
 3. optimize-off parity — the optimizer parity + engine-core suites rerun
    with ``PATHWAY_TPU_OPTIMIZE=0`` (the graph rewriter's escape hatch);
-4. sanitized native build — recompile ``native/enginecore.cpp`` with
+4. metrics overhead — the ``fused_chain`` workload with the metrics
+   plane fully on (per-operator probes + StatsMonitor + latency
+   histogram + flight recorder) vs fully off; FAILs when the overhead
+   exceeds 5% (observability must be effectively free);
+5. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
    (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
@@ -115,6 +119,50 @@ def step_optimize_off() -> str:
         status,
         f"pytest exit {proc.returncode}" if status == FAIL else "",
     )
+    return status
+
+
+def step_metrics_overhead() -> str:
+    """Gate the observability tax: bench_dataflow.metrics_overhead_leg
+    compares the fused_chain workload with every per-commit metrics hook
+    engaged vs none (best-of-3 each way); >5% overhead is a FAIL."""
+    name = "metrics overhead (fused_chain, ALL vs NONE)"
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('METRICS_OVERHEAD_JSON ' + json.dumps("
+        "b.metrics_overhead_leg()()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.SubprocessError as e:
+        _report(name, FAIL, f"bench leg did not finish: {e}")
+        return FAIL
+    import json
+
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("METRICS_OVERHEAD_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        _report(name, FAIL, f"bench leg exit {proc.returncode}")
+        return FAIL
+    overhead = payload["overhead_pct"]
+    detail = (
+        f"{overhead:+.2f}% "
+        f"(off {payload['metrics_off_s']}s, on {payload['metrics_on_s']}s, "
+        f"p50 {payload.get('latency_p50_ms', '?')}ms, "
+        f"p99 {payload.get('latency_p99_ms', '?')}ms)"
+    )
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
     return status
 
 
@@ -240,7 +288,12 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    results = [step_ruff(), step_analyzer(), step_optimize_off()]
+    results = [
+        step_ruff(),
+        step_analyzer(),
+        step_optimize_off(),
+        step_metrics_overhead(),
+    ]
     if args.skip_sanitized:
         _report("sanitized native build + parity tests", SKIP, "--skip-sanitized")
         results.append(SKIP)
