@@ -628,6 +628,141 @@ def distributed_leg(n_rows: int | None = None) -> dict:
     }
 
 
+_RECOVERY_PROGRAM = """
+import os
+import pathway_tpu as pw
+import pathway_tpu.engine.connectors as _conn
+from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+_orig_poll = _conn.FsReader.poll
+def _poll(self):
+    entries, done = _orig_poll(self)
+    if not entries and os.path.exists({stop!r}):
+        done = True
+    return entries, done
+_conn.FsReader.poll = _poll
+
+words = pw.io.plaintext.read({indir!r}, mode="streaming", persistent_id="w")
+counts = words.groupby(words.data).reduce(
+    word=words.data, cnt=pw.reducers.count()
+)
+pw.io.csv.write(counts, {out!r})
+pw.run(persistence_config=Config(
+    Backend.filesystem({store!r}),
+    persistence_mode=PersistenceMode.OPERATOR_PERSISTING,
+))
+"""
+
+
+def mesh_recovery_leg() -> dict:
+    """Fault-injected 3-process mesh: SIGKILL one non-leader worker at a
+    commit boundary, let the supervisor restart it and the mesh roll back
+    to its snapshot, and report how long detection and the full recovery
+    took (parsed from the leader's flight-recorder dump)."""
+    import glob as _glob
+    import shutil
+    import sys
+    import tempfile
+
+    from pathway_tpu.cli import spawn
+
+    root = tempfile.mkdtemp(prefix="pathway-bench-recovery-")
+    indir = os.path.join(root, "in")
+    os.makedirs(indir)
+    out = os.path.join(root, "out.csv")
+    stop = os.path.join(root, "stop")
+    flight = os.path.join(root, "flight")
+    prog = os.path.join(root, "prog.py")
+    with open(prog, "w") as fh:
+        fh.write(
+            _RECOVERY_PROGRAM.format(
+                indir=indir,
+                out=out,
+                stop=stop,
+                store=os.path.join(root, "store"),
+            )
+        )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+    env["PATHWAY_TPU_MESH_TIMEOUT"] = "30"
+    env["PATHWAY_TPU_RECOVER"] = "1"
+    env["PATHWAY_TPU_RECOVER_DEADLINE"] = "45"
+    env["PATHWAY_TPU_FLIGHT_DIR"] = flight
+    env["PATHWAY_TPU_FAULT_PLAN"] = json.dumps(
+        {"seed": 1, "faults": [
+            {"type": "kill", "process": 1, "at_commit": 2},
+        ]}
+    )
+
+    def _port_base(n: int) -> int:
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        return base
+
+    result: dict = {}
+
+    def run() -> None:
+        result["rc"] = spawn(
+            sys.executable, [prog], threads=1, processes=3,
+            first_port=_port_base(3), env=env,
+        )
+
+    try:
+        th = threading.Thread(target=run)
+        th.start()
+        for k in range(4):
+            with open(os.path.join(indir, f"f{k}.txt"), "w") as fh:
+                fh.write("\n".join(f"w{k}_{i}" for i in range(3)) + "\n")
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                try:
+                    with open(out) as oh:
+                        if f"w{k}_0" in oh.read():
+                            break
+                except OSError:
+                    pass
+                if not th.is_alive():
+                    raise RuntimeError(
+                        f"mesh exited rc={result.get('rc')} before "
+                        f"commit {k}"
+                    )
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"commit {k} never reached the sink")
+        with open(stop, "w"):
+            pass
+        th.join(timeout=90)
+        if result.get("rc") != 0:
+            raise RuntimeError(f"mesh exited rc={result.get('rc')}")
+        done_events = []
+        for path in _glob.glob(os.path.join(flight, "pathway_flight_*")):
+            with open(path) as fh:
+                payload = json.load(fh)
+            done_events.extend(
+                e for e in payload.get("events", [])
+                if e.get("kind") == "recovery_done"
+            )
+        if not done_events:
+            raise RuntimeError("no recovery_done event in flight dumps")
+        last = done_events[-1]
+        return {
+            "workload": "mesh_recovery",
+            "recoveries": len(done_events),
+            "detect_s": round(float(last["detect_s"]), 4),
+            "recovery_wall_s": round(float(last["wall_s"]), 4),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_all(emit=None) -> dict:
     """One pass over every workload -> {name: rows_per_sec}; consumed by
     bench.py so the dataflow line is tracked in BENCH_r{N}.json every
@@ -682,6 +817,16 @@ def run_all(emit=None) -> dict:
                 "mesh_groupby",
                 {k: v for k, v in leg.items() if k != "workload"},
             )
+        if not _analyze_only():
+            try:
+                leg = mesh_recovery_leg()
+            except Exception as exc:
+                record("mesh_recovery_error", repr(exc))
+            else:
+                record(
+                    "mesh_recovery",
+                    {k: v for k, v in leg.items() if k != "workload"},
+                )
     record(
         "native",
         {

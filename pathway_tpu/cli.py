@@ -46,6 +46,19 @@ def spawn(
     env: dict | None = None,
 ) -> int:
     env_base = dict(os.environ if env is None else env)
+    if env_base.get("PATHWAY_TPU_RECOVER", "").lower() in ("1", "true", "yes"):
+        # fault-tolerant runs need a control plane that can restart dead
+        # workers; hand the whole launch over to the supervisor
+        from pathway_tpu.engine.supervisor import MeshSupervisor
+
+        return MeshSupervisor(
+            program,
+            arguments,
+            threads=threads,
+            processes=processes,
+            first_port=first_port,
+            env=env_base,
+        ).run()
     run_id = str(uuid.uuid4())
     # fresh per-run key authenticating exchange-mesh frames (all processes
     # share it; engine/distributed.py rejects unauthenticated frames)

@@ -393,13 +393,52 @@ class InputDriver:
                 head_t = head_values[self.sync_col]
         self.sync_group.note_pending(self, head_t)
 
+    def _poll_reader(self) -> tuple[list, bool]:
+        """``reader.poll()`` with graceful degradation: transient I/O
+        errors (``OSError`` — a network filesystem hiccup, a vanished NFS
+        mount, a refused socket) get ``PATHWAY_TPU_CONNECTOR_RETRIES``
+        bounded retries (default 3, 0 disables) with exponential backoff
+        + jitter, counted in ``pathway_connector_retries_total``.  When
+        retries exhaust, the original error re-raises: fail-stop stays
+        the explicit fallback.  Non-I/O errors (parse bugs, type errors)
+        never retry."""
+        try:
+            return self.reader.poll()
+        except OSError:
+            retries = int(
+                os.environ.get("PATHWAY_TPU_CONNECTOR_RETRIES", "3")
+            )
+            if retries <= 0:
+                raise
+            import random as _random
+
+            counter = _metrics.REGISTRY.counter(
+                "pathway_connector_retries_total",
+                "connector reader polls retried after transient I/O "
+                "errors",
+            )
+            delay = 0.05
+            for attempt in range(retries):
+                counter.inc(1)
+                _time.sleep(delay * (0.5 + _random.random()))
+                delay = min(delay * 2, 2.0)
+                try:
+                    return self.reader.poll()
+                except OSError:
+                    if attempt == retries - 1:
+                        raise
+            raise  # unreachable; keeps the type checker honest
+
     def poll(self) -> str:
         if self.done:
             return "done"
         produced = False
         if self._sync_backlog:
             produced = self._drain_backlog()
-        entries, done = ([], self._done_pending) if self._done_pending else self.reader.poll()
+        if self._done_pending:
+            entries, done = [], True
+        else:
+            entries, done = self._poll_reader()
         if entries:
             self.entries_total += len(entries)
             self.batches_total += 1
@@ -553,8 +592,27 @@ class DsvFormatter(Formatter):
         return self._row(out)
 
 
+#: every live FileWriter, registered at construction.  Sink attachment
+#: returns no driver handle (subscribe_table wires callbacks directly),
+#: so mesh recovery reaches file sinks through this registry to rewind
+#: them past rolled-back commits.
+import weakref as _weakref
+
+FILE_WRITERS: "_weakref.WeakSet[FileWriter]" = _weakref.WeakSet()
+
+
 class FileWriter:
-    """Line-oriented file sink (reference: FileWriter data_storage.rs:630)."""
+    """Line-oriented file sink (reference: FileWriter data_storage.rs:630).
+
+    Tracks the byte offset at each commit boundary (a bounded trail of
+    recent commits) so a mesh-recovery rollback can truncate exactly the
+    lines of un-happened commits — the recovered run re-emits them with
+    identical timestamps, keeping outputs bit-identical to a fault-free
+    run."""
+
+    #: commit-boundary offsets kept per writer (matches the snapshot
+    #: ring depth with slack; older commits can no longer be rolled to)
+    _OFFSET_TRAIL = 8
 
     def __init__(self, path: str | os.PathLike, formatter: Formatter, column_names: Sequence[str]):
         self.path = os.fspath(path)
@@ -564,6 +622,9 @@ class FileWriter:
         header = formatter.header(self.column_names)
         if header:
             self._file.write(header + "\n")
+        self._header_end = self._file.tell()
+        self._commit_offsets: dict[int, int] = {}
+        FILE_WRITERS.add(self)
 
     def on_change(self, key: Pointer, values: tuple, time: int, diff: int) -> None:
         self._file.write(
@@ -573,6 +634,34 @@ class FileWriter:
     def on_time_end(self, time: int) -> None:
         if not self._file.closed:
             self._file.flush()
+            self._commit_offsets[time] = self._file.tell()
+            while len(self._commit_offsets) > self._OFFSET_TRAIL:
+                del self._commit_offsets[min(self._commit_offsets)]
+
+    def rewind_to(self, time: int) -> None:
+        """Truncate everything written after commit ``time`` (``-1`` =
+        back to the header).  No-op when nothing newer was written."""
+        if self._file.closed:
+            return
+        if time < 0:
+            offset = self._header_end
+        elif time in self._commit_offsets:
+            offset = self._commit_offsets[time]
+        else:
+            newer = [t for t in self._commit_offsets if t > time]
+            if not newer:
+                return  # nothing after `time` reached this sink
+            raise ValueError(
+                f"sink {self.path}: cannot rewind to commit {time} — "
+                f"its boundary offset is no longer tracked (trail keeps "
+                f"{self._OFFSET_TRAIL} commits)"
+            )
+        self._file.flush()
+        self._file.truncate(offset)
+        self._file.seek(offset)
+        self._commit_offsets = {
+            t: o for t, o in self._commit_offsets.items() if t <= time
+        }
 
     def on_end(self) -> None:
         if not self._file.closed:

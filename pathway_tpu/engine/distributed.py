@@ -96,9 +96,71 @@ def _mesh_secret() -> bytes:
     )
     return secret.encode() if secret else b""
 
-#: how long a process waits for a peer frame before declaring the run dead
-RECV_TIMEOUT = float(os.environ.get("PATHWAY_EXCHANGE_TIMEOUT", "600"))
+def _validated_float(name: str, default: float, minimum: float) -> float:
+    """Parse a float env knob with a clear startup error for nonsense."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a number (expected seconds, e.g. "
+            f"{name}={default:g})"
+        ) from None
+    if not value >= minimum or value != value or value == float("inf"):
+        raise ValueError(
+            f"{name}={raw!r} out of range: must be a finite number "
+            f">= {minimum:g} seconds"
+        )
+    return value
+
+
+def _validated_int(name: str, default: int, minimum: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer (e.g. {name}={default})"
+        ) from None
+    if value < minimum:
+        raise ValueError(
+            f"{name}={raw!r} out of range: must be >= {minimum}"
+        )
+    return value
+
+
+#: how long a process waits for a peer frame before declaring the run
+#: dead. ``PATHWAY_TPU_MESH_TIMEOUT`` is the canonical knob; the legacy
+#: ``PATHWAY_EXCHANGE_TIMEOUT`` spelling is honoured as a fallback.
+RECV_TIMEOUT = _validated_float(
+    "PATHWAY_TPU_MESH_TIMEOUT",
+    _validated_float("PATHWAY_EXCHANGE_TIMEOUT", 600.0, 0.001),
+    0.001,
+)
+#: a peer silent this long while the mesh is otherwise alive is declared
+#: hung (same recovery path as a dead socket); derived from the mesh
+#: timeout unless pinned explicitly
+SUSPICION_TIMEOUT = _validated_float(
+    "PATHWAY_TPU_MESH_SUSPICION", RECV_TIMEOUT, 0.001
+)
+#: per-peer receive-queue high-water mark — a flooding or stalled peer
+#: blocks (TCP backpressure) instead of growing leader memory unboundedly
+QUEUE_HWM = _validated_int("PATHWAY_TPU_MESH_QUEUE_HWM", 512, 1)
 _CONNECT_DEADLINE = 60.0
+
+
+class PeerLostError(RuntimeError):
+    """A peer's socket died, its frames timed out, or it announced an
+    abort mid-round.  Recoverable when a MeshSupervisor + operator
+    snapshots are configured; fail-stop otherwise."""
+
+    def __init__(self, message: str, peer: int | None = None) -> None:
+        super().__init__(message)
+        self.peer = peer
 
 
 # ---------------------------------------------------------------------------
@@ -248,16 +310,39 @@ class MeshTransport:
         self.process_id = process_id
         self.n = n_processes
         addrs = list(addresses or default_addresses(n_processes, first_port))
+        self._addrs = addrs
         self._socks: dict[int, socket.socket] = {}
+        # bounded per-peer queues: a flooding or stalled peer exerts TCP
+        # backpressure at the high-water mark instead of growing this
+        # process's memory without limit (frames are NEVER dropped — the
+        # round protocol cannot survive a missing frame)
         self._queues: dict[int, queue.Queue] = {
-            p: queue.Queue() for p in range(n_processes) if p != process_id
+            p: queue.Queue(maxsize=QUEUE_HWM)
+            for p in range(n_processes)
+            if p != process_id
         }
         self._send_locks: dict[int, threading.Lock] = {}
         self._threads: list[threading.Thread] = []
         self._closed = False
         #: peers whose socket closed/errored (set by the recv loops)
         self.dead_peers: set[int] = set()
+        #: per-peer monotonic arrival time of the most recent frame
+        #: (heartbeats included) — the liveness signal suspicion reads
+        self.last_seen: dict[int, float] = {
+            p: _walltime.monotonic()
+            for p in range(n_processes)
+            if p != process_id
+        }
         self._secret = _mesh_secret()
+        self._backpressure = _metrics.REGISTRY.gauge(
+            "pathway_mesh_recv_backpressure",
+            "receiver threads currently blocked on a full peer queue",
+        )
+        self._fault_plan = None
+        if os.environ.get("PATHWAY_TPU_FAULT_PLAN"):
+            from pathway_tpu.engine.faults import active_plan
+
+            self._fault_plan = active_plan()
         if n_processes == 1:
             return
         # bind only the configured interface (127.0.0.1 by default) — not
@@ -267,6 +352,7 @@ class MeshTransport:
         bind_host = os.environ.get(
             "PATHWAY_EXCHANGE_BIND", addrs[process_id][0]
         )
+        self._bind_host = bind_host
         loopback = ("127.0.0.1", "localhost", "::1")
         exposed = bind_host not in loopback or any(
             host not in loopback for host, _port in addrs
@@ -308,12 +394,15 @@ class MeshTransport:
         finally:
             listener.close()
         for peer, sock in self._socks.items():
-            self._send_locks[peer] = threading.Lock()
-            t = threading.Thread(
-                target=self._recv_loop, args=(peer, sock), daemon=True
-            )
-            t.start()
-            self._threads.append(t)
+            self._start_recv(peer, sock)
+
+    def _start_recv(self, peer: int, sock: socket.socket) -> None:
+        self._send_locks[peer] = threading.Lock()
+        t = threading.Thread(
+            target=self._recv_loop, args=(peer, sock), daemon=True
+        )
+        t.start()
+        self._threads.append(t)
 
     @staticmethod
     def _dial(addr: tuple[str, int]) -> socket.socket:
@@ -371,23 +460,73 @@ class MeshTransport:
         q = self._queues[peer]
         try:
             while True:
-                q.put(self._read_frame(sock))
+                frame = self._read_frame(sock)
+                self.last_seen[peer] = _walltime.monotonic()
+                if (
+                    isinstance(frame, tuple)
+                    and frame
+                    and frame[0] == "hb"
+                ):
+                    # transport-level heartbeat: liveness recorded above,
+                    # never surfaced to the round protocol
+                    continue
+                self._put(q, frame)
         except (ConnectionError, OSError, EOFError, pickle.PickleError):
             # mark BEFORE enqueueing: a coordinator that never recv()s
             # from this peer still observes the death via
             # raise_if_peer_dead() at its next pump tick — send-side
             # detection alone needs TWO sends after the RST (the first
-            # one buffers), which stalls fail-stop for idle streams
-            self.dead_peers.add(peer)
-            q.put(("__eof__", peer))
+            # one buffers), which stalls fail-stop for idle streams.
+            # A loop whose socket was replaced by reestablish() must not
+            # poison the fresh link.
+            if self._socks.get(peer) is sock and not self._closed:
+                self.dead_peers.add(peer)
+                self._put(q, ("__eof__", peer))
+
+    def _put(self, q: queue.Queue, frame: Any) -> None:
+        """Blocking put with a backpressure gauge: at the high-water mark
+        the receiver thread stalls, which stops reading the socket, which
+        pushes back on the sender via TCP flow control."""
+        try:
+            q.put_nowait(frame)
+            return
+        except queue.Full:
+            pass
+        self._backpressure.value += 1
+        try:
+            q.put(frame)
+        finally:
+            self._backpressure.value -= 1
 
     def raise_if_peer_dead(self) -> None:
         """Fail-stop promptly when any peer's socket closed (reference
-        teardown on worker loss, dataflow.rs:5854-5883)."""
-        if self.dead_peers and not self._closed:
+        teardown on worker loss, dataflow.rs:5854-5883).  A peer silent
+        past the suspicion timeout (hung, not dead) raises the same way —
+        its socket is torn down first so the two paths converge."""
+        if self._closed:
+            return
+        if not self.dead_peers:
+            now = _walltime.monotonic()
+            for peer, seen in self.last_seen.items():
+                if peer in self._socks and now - seen > SUSPICION_TIMEOUT:
+                    # a hung peer holds its socket open: close it so the
+                    # recv loop marks it dead like any other lost peer
+                    try:
+                        self._socks[peer].close()
+                    except OSError:
+                        pass
+                    self.dead_peers.add(peer)
+                    raise PeerLostError(
+                        f"process {self.process_id}: peer {peer} silent "
+                        f"for {now - seen:.1f}s (suspicion timeout "
+                        f"{SUSPICION_TIMEOUT:g}s) — suspected hung",
+                        peer=peer,
+                    )
+        if self.dead_peers:
             dead = sorted(self.dead_peers)
-            raise RuntimeError(
-                f"process {self.process_id}: peer(s) {dead} disconnected"
+            raise PeerLostError(
+                f"process {self.process_id}: peer(s) {dead} disconnected",
+                peer=dead[0],
             )
 
     def _send(self, peer: int, frame: Any) -> None:
@@ -405,12 +544,143 @@ class MeshTransport:
                 self._socks[peer].sendall(data)
 
     def send(self, peer: int, frame: Any) -> None:
+        plan = self._fault_plan
+        if plan is not None:
+            action = plan.on_send(self.process_id, peer, frame)
+            if action == "drop":
+                return
+            if action == "reset":
+                # synthetic RST: hard-close the socket mid-stream, then
+                # fall through so the send fails like a real reset would
+                try:
+                    self._socks[peer].close()
+                except OSError:
+                    pass
+            elif action == "dup":
+                try:
+                    self._send(peer, frame)
+                except OSError:
+                    pass
         try:
             self._send(peer, frame)
         except OSError as exc:
-            raise RuntimeError(
-                f"process {self.process_id}: lost connection to peer {peer}"
+            if self._retry_send(peer, frame):
+                return
+            raise PeerLostError(
+                f"process {self.process_id}: lost connection to peer "
+                f"{peer}",
+                peer=peer,
             ) from exc
+
+    def _retry_send(self, peer: int, frame: Any) -> bool:
+        """Bounded retry for transient send failures: redial the link with
+        exponential backoff + jitter (``PATHWAY_TPU_MESH_SEND_RETRIES``,
+        default 2; 0 disables).  A peer the recv loop already declared
+        dead is NOT retried — in-flight frames were lost, so transparent
+        resending would corrupt the round protocol; the rollback-based
+        recovery path owns that case."""
+        retries = _validated_int("PATHWAY_TPU_MESH_SEND_RETRIES", 2, 0)
+        if retries == 0 or self._closed or peer in self.dead_peers:
+            return False
+        import random as _random
+
+        delay = 0.05
+        for _attempt in range(retries):
+            _walltime.sleep(delay * (0.5 + _random.random()))
+            delay = min(delay * 2, 1.0)
+            try:
+                self._repair_link(peer, deadline=2.0)
+                self._send(peer, frame)
+            except (OSError, RuntimeError):
+                continue
+            _metrics.REGISTRY.counter(
+                "pathway_mesh_send_retries_total",
+                "mesh sends recovered by the bounded retry path",
+            ).inc(1)
+            return True
+        return False
+
+    def _repair_link(self, peer: int, deadline: float) -> None:
+        """Re-create the duplex socket to ``peer`` (dial-lower/accept-
+        higher, same as startup) and restart its receiver thread."""
+        old = self._socks.get(peer)
+        if peer < self.process_id:
+            sock = socket.create_connection(
+                self._addrs[peer], timeout=deadline
+            )
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[peer] = sock
+            self._send(peer, ("hello", self.process_id))
+        else:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(
+                (self._bind_host, self._addrs[self.process_id][1])
+            )
+            listener.listen(self.n)
+            listener.settimeout(deadline)
+            try:
+                conn, _addr = listener.accept()
+                conn.settimeout(None)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                frame = self._read_frame(conn)
+                if (
+                    not isinstance(frame, tuple)
+                    or len(frame) != 2
+                    or frame[0] != "hello"
+                    or frame[1] != peer
+                ):
+                    conn.close()
+                    raise RuntimeError(
+                        f"process {self.process_id}: expected hello from "
+                        f"peer {peer} on repair, got {frame!r}"
+                    )
+                self._socks[peer] = conn
+            finally:
+                listener.close()
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._start_recv(peer, self._socks[peer])
+
+    def reestablish(self, peer: int, deadline: float = 30.0) -> None:
+        """Reconnect to a restarted ``peer``: fresh socket, fresh (empty)
+        frame queue, fresh receiver thread, liveness state reset.  The
+        restarted process runs its normal constructor (bind, dial lower
+        ids, accept higher ids), so survivors mirror that from the other
+        side: lower ids accept the dial-in, higher ids dial its listener."""
+        self._queues[peer] = queue.Queue(maxsize=QUEUE_HWM)
+        end = _walltime.monotonic() + deadline
+        delay = 0.05
+        while True:
+            try:
+                self._repair_link(
+                    peer, deadline=max(0.1, end - _walltime.monotonic())
+                )
+                break
+            except (OSError, RuntimeError):
+                if _walltime.monotonic() > end:
+                    raise PeerLostError(
+                        f"process {self.process_id}: could not "
+                        f"re-establish the link to restarted peer {peer} "
+                        f"within {deadline:g}s",
+                        peer=peer,
+                    )
+                _walltime.sleep(delay)
+                delay = min(delay * 2, 0.5)
+        self.dead_peers.discard(peer)
+        self.last_seen[peer] = _walltime.monotonic()
+
+    def heartbeat(self, peer: int) -> None:
+        """Best-effort idle-time liveness frame; absorbed by the peer's
+        receiver thread (never enters its protocol queue)."""
+        try:
+            self._send(peer, ("hb", self.process_id, _walltime.time()))
+        except OSError:
+            pass  # the recv loop / send path owns failure detection
 
     def broadcast(self, frame: Any) -> None:
         for peer in self._queues:
@@ -420,13 +690,15 @@ class MeshTransport:
         try:
             frame = self._queues[peer].get(timeout=timeout)
         except queue.Empty:
-            raise RuntimeError(
+            raise PeerLostError(
                 f"process {self.process_id}: no frame from peer {peer} "
-                f"within {timeout}s — a peer likely crashed"
+                f"within {timeout}s — a peer likely crashed",
+                peer=peer,
             ) from None
         if isinstance(frame, tuple) and frame and frame[0] == "__eof__":
-            raise RuntimeError(
-                f"process {self.process_id}: peer {peer} disconnected"
+            raise PeerLostError(
+                f"process {self.process_id}: peer {peer} disconnected",
+                peer=peer,
             )
         return frame
 
@@ -522,6 +794,13 @@ class DistributedScheduler:
         self._outbox: dict[int, list[tuple]] = {
             p: [] for p in range(n_processes) if p != process_id
         }
+        #: peer process id -> wall-clock heartbeat stamp piggybacked on
+        #: its most recent round frame (liveness evidence for post-mortems;
+        #: the transport's monotonic ``last_seen`` drives suspicion)
+        self.peer_heartbeats: dict[int, float] = {}
+        #: a leader recover command that arrived MID-ROUND on a follower
+        #: (stashed by _recv_round for the runner's park loop to consume)
+        self._pending_recover: tuple | None = None
 
     # -- topology ----------------------------------------------------------
 
@@ -543,10 +822,20 @@ class DistributedScheduler:
                     extra.append((node.index, consumer.index, port))
         for prod, cons, port in extra:
             self.extra_consumers.setdefault(prod, []).append((cons, port))
-        self.transport.broadcast(
-            ("topology", self.n_shared, self._shared_signature(), extra)
+        # kept verbatim for recovery: a restarted follower re-runs the
+        # topology handshake against the SAME frame the originals saw
+        self._topology_frame = (
+            "topology", self.n_shared, self._shared_signature(), extra
         )
+        self.transport.broadcast(self._topology_frame)
         self._ensure_optimized()
+
+    def reannounce_to(self, peer: int) -> None:
+        """Re-send the stored topology frame to one restarted peer (its
+        fresh ``receive_topology`` runs the same divergence +
+        ``_ensure_optimized`` fingerprint checks the original did)."""
+        assert self.process_id == 0
+        self.transport.send(peer, self._topology_frame)
 
     def receive_topology(self) -> None:
         frame = self.transport.recv(0)
@@ -1034,47 +1323,107 @@ class DistributedScheduler:
                 if isinstance(node, StaticSource):
                     node._emitted = True
 
+    def _recv_round(self, peer: int, time: int, round_no: int) -> tuple:
+        """Receive one round frame from ``peer``, absorbing duplicated
+        frames of the previous round (fault injection / resent links) and
+        converting a peer's abort announcement into :class:`PeerLostError`
+        so this process parks for recovery instead of deadlocking on a
+        frame that will never come."""
+        while True:
+            frame = self.transport.recv(peer)
+            kind = frame[0]
+            if kind == "abort":
+                raise PeerLostError(
+                    f"process {self.process_id}: peer {peer} aborted "
+                    f"commit {frame[1]} round {frame[2]} (its own peer "
+                    "loss)",
+                    peer=peer,
+                )
+            if kind == "cmd" and len(frame) >= 3 and frame[1] == "recover":
+                # the leader started recovery while this follower was
+                # still waiting out the doomed round: stash the command
+                # for the park loop and leave the round
+                self._pending_recover = frame
+                raise PeerLostError(
+                    f"process {self.process_id}: leader announced "
+                    f"recovery of peer {frame[2]} mid-round",
+                    peer=frame[2],
+                )
+            if kind == "round" and (
+                frame[1] < time
+                or (frame[1] == time and frame[2] < round_no)
+            ):
+                continue  # duplicate of a frame already applied
+            return frame
+
+    def _announce_abort(self, time: int, round_no: int) -> None:
+        """Tell every still-reachable peer this process is leaving the
+        round: survivors unblock immediately instead of waiting out the
+        mesh timeout on a frame that will never arrive."""
+        for peer in sorted(self._outbox):
+            if peer in self.transport.dead_peers:
+                continue
+            try:
+                self.transport._send(peer, ("abort", time, round_no))
+            except OSError:
+                pass
+
     def _exchange_rounds(self, time: int, notify_time_end: bool = True) -> bool:
         transport = self.transport
         peers = sorted(self._outbox)
         round_no = 0
         any_work = False
-        while True:
-            busy = self._drain_local(time)
-            my_bit = busy or any(self._outbox.values())
-            # mesh stats protocol: once this process goes quiet for the
-            # round, piggyback its metrics snapshot on the frame bound for
-            # the leader — no extra frames, no extra round-trips
-            snap = None
-            if self.process_id != 0 and not my_bit:
-                snap = self._metrics_snapshot()
-            for peer in peers:
-                transport.send(
-                    peer,
-                    (
-                        "round", time, round_no, my_bit, self._outbox[peer],
-                        snap if peer == 0 else None,
-                    ),
-                )
-                self._outbox[peer] = []
-            global_busy = my_bit
-            for peer in peers:
-                frame = transport.recv(peer)
-                kind, f_time, f_round, bit, deliveries, peer_snap = frame
-                if kind != "round" or f_time != time or f_round != round_no:
-                    raise RuntimeError(
-                        f"process {self.process_id}: protocol desync with "
-                        f"peer {peer}: got {frame[:3]}, expected round "
-                        f"({time}, {round_no})"
+        try:
+            while True:
+                busy = self._drain_local(time)
+                my_bit = busy or any(self._outbox.values())
+                # mesh stats protocol: once this process goes quiet for the
+                # round, piggyback its metrics snapshot on the frame bound
+                # for the leader — no extra frames, no extra round-trips
+                snap = None
+                if self.process_id != 0 and not my_bit:
+                    snap = self._metrics_snapshot()
+                hb = _walltime.time()
+                for peer in peers:
+                    transport.send(
+                        peer,
+                        (
+                            "round", time, round_no, my_bit,
+                            self._outbox[peer],
+                            snap if peer == 0 else None,
+                            hb,
+                        ),
                     )
-                self._apply_remote(deliveries)
-                if peer_snap is not None:
-                    self.mesh_metrics[peer] = peer_snap
-                global_busy = global_busy or bit
-            round_no += 1
-            any_work = any_work or global_busy
-            if not global_busy:
-                break
+                    self._outbox[peer] = []
+                global_busy = my_bit
+                for peer in peers:
+                    frame = self._recv_round(peer, time, round_no)
+                    (
+                        kind, f_time, f_round, bit, deliveries, peer_snap,
+                        peer_hb,
+                    ) = frame
+                    if (
+                        kind != "round"
+                        or f_time != time
+                        or f_round != round_no
+                    ):
+                        raise RuntimeError(
+                            f"process {self.process_id}: protocol desync "
+                            f"with peer {peer}: got {frame[:3]}, expected "
+                            f"round ({time}, {round_no})"
+                        )
+                    self._apply_remote(deliveries)
+                    if peer_snap is not None:
+                        self.mesh_metrics[peer] = peer_snap
+                    self.peer_heartbeats[peer] = peer_hb
+                    global_busy = global_busy or bit
+                round_no += 1
+                any_work = any_work or global_busy
+                if not global_busy:
+                    break
+        except PeerLostError:
+            self._announce_abort(time, round_no)
+            raise
         _metrics.FLIGHT.record("exchange", time=time, rounds=round_no)
         if notify_time_end or any_work:
             for scope in self.scopes:
@@ -1114,6 +1463,67 @@ class DistributedScheduler:
         for scope in self.scopes:
             for node in scope.nodes:
                 node.close()
+
+    # -- recovery ----------------------------------------------------------
+
+    def discard_inflight(self) -> None:
+        """Drop every runtime-queued batch on this process — operator
+        pending queues, deferred state lag, unflushed input-session rows,
+        and the remote outbox.  Run before a snapshot rollback: anything
+        in flight belongs to a commit the rollback un-happens, and the
+        restored snapshot (plus re-driven connectors) re-derives it."""
+        for scope in self.scopes:
+            for node in scope.nodes:
+                node.pending.clear()
+                node._state_lag = []
+                node._state_lag_rows = 0
+                if isinstance(node, InputSession):
+                    node._buffer = []
+                    node._has_removals = False
+                    node._has_rowless_removals = False
+        for peer in self._outbox:
+            self._outbox[peer] = []
+
+    def resync(self, epoch: int) -> None:
+        """Post-rollback barrier: flush stale frames off every peer link.
+        Each process sends ``("sync", epoch)`` to every peer, then drains
+        each peer queue until the matching sync arrives — per-peer FIFO
+        ordering guarantees everything queued before it (orphaned round
+        frames, aborts, old syncs) is gone.  All sends precede all drains,
+        so the barrier cannot deadlock even with bounded queues."""
+        peers = sorted(self._outbox)
+        for peer in peers:
+            self.transport.send(peer, ("sync", epoch))
+        for peer in peers:
+            while True:
+                frame = self.transport.recv(peer)
+                if (
+                    isinstance(frame, tuple)
+                    and frame
+                    and frame[0] == "sync"
+                    and frame[1] == epoch
+                ):
+                    break
+
+    def rollback(self, to_time: int, snapshot_mgr, drivers: list) -> None:
+        """Roll this process back to the snapshot of commit ``to_time``
+        (``-1`` = cold state) and resume the clock after it.  The caller
+        runs :meth:`resync` afterwards so every peer crosses the same
+        epoch boundary before new rounds begin."""
+        self.discard_inflight()
+        if to_time >= 0:
+            restored = snapshot_mgr.restore(
+                self.scopes, drivers, at_time=to_time
+            )
+            self.time = int(restored) + 1
+        else:
+            self.time = max(self.time, 0)
+        _metrics.FLIGHT.record(
+            "recovery_rollback",
+            process=self.process_id,
+            to_time=to_time,
+            resumed_time=self.time,
+        )
 
     # -- monitoring surface parity ----------------------------------------
 

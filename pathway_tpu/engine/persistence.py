@@ -250,10 +250,17 @@ class OperatorSnapshotManager:
         backend: PersistenceBackend,
         snapshot_interval_ms: int = 0,
         name: str = "operator-snapshot",
+        retain: int = 1,
     ) -> None:
         self.backend = backend
         self.interval = snapshot_interval_ms / 1000.0
         self.name = name
+        #: how many commit-boundary snapshots to keep addressable by time
+        #: (``retain > 1`` additionally writes ``{name}-t{time}`` entries
+        #: so mesh recovery can roll every survivor back to a COMMON
+        #: commit, not just its own latest)
+        self.retain = max(1, int(retain))
+        self._ring: list[int] = []
         self._last_write = 0.0
 
     # -- capture -------------------------------------------------------------
@@ -302,7 +309,17 @@ class OperatorSnapshotManager:
             # under the SAME rewrites, so restore refuses on mismatch.
             "optimize": list(getattr(scopes[0], "_pw_opt_fingerprint", [])),
         }
-        self.backend.write(self.name, _pickle.dumps(payload, protocol=4))
+        blob = _pickle.dumps(payload, protocol=4)
+        self.backend.write(self.name, blob)
+        if self.retain > 1:
+            self.backend.write(f"{self.name}-t{time}", blob)
+            if time not in self._ring:
+                self._ring.append(time)
+            while len(self._ring) > self.retain:
+                stale = self._ring.pop(0)
+                # overwrite with an empty blob: PersistenceBackend has no
+                # delete, and restore treats empty as absent
+                self.backend.write(f"{self.name}-t{stale}", b"")
         import time as _time
 
         self._last_write = _time.monotonic()
@@ -318,14 +335,44 @@ class OperatorSnapshotManager:
 
     # -- restore -------------------------------------------------------------
 
-    def restore(self, scope: Any, drivers: list) -> int | None:
-        """Restore node + driver state; returns the snapshotted commit time
-        when a snapshot was found and applied (the scheduler must resume
-        *after* it so sink timestamps stay monotonic), else None."""
+    def latest_time(self) -> int | None:
+        """Peek the commit time of the latest snapshot without applying it
+        (mesh recovery's rejoin handshake advertises this)."""
         import pickle as _pickle
 
         raw = self.backend.read(self.name)
         if not raw:
+            return None
+        try:
+            payload = _pickle.loads(raw)
+        except Exception:
+            return None
+        return int(payload.get("time", 0))
+
+    def restore(
+        self, scope: Any, drivers: list, at_time: int | None = None
+    ) -> int | None:
+        """Restore node + driver state; returns the snapshotted commit time
+        when a snapshot was found and applied (the scheduler must resume
+        *after* it so sink timestamps stay monotonic), else None.
+
+        ``at_time`` selects a specific ring entry (``retain > 1``); the
+        plain latest snapshot is used when it already carries that time."""
+        import pickle as _pickle
+
+        raw = self.backend.read(self.name)
+        if at_time is not None and raw:
+            try:
+                if int(_pickle.loads(raw).get("time", 0)) != at_time:
+                    raw = self.backend.read(f"{self.name}-t{at_time}")
+            except Exception:
+                raw = self.backend.read(f"{self.name}-t{at_time}")
+        if not raw:
+            if at_time is not None:
+                raise ValueError(
+                    f"no operator snapshot at commit time {at_time} "
+                    f"under {self.name!r} (ring retains {self.retain})"
+                )
             return None
         try:
             payload = _pickle.loads(raw)

@@ -1,0 +1,235 @@
+"""MeshSupervisor: launch, monitor, and restart the worker processes of a
+distributed run.
+
+``pathway spawn`` delegates here when ``PATHWAY_TPU_RECOVER`` is enabled
+(cli.py); plain spawns keep the original launch-and-wait path.  The
+supervisor is the control plane of the fault-tolerance layer:
+
+- it launches the N worker processes with the same topology env wiring
+  as ``cli.spawn`` (PATHWAY_THREADS/PROCESSES/PROCESS_ID/FIRST_PORT/
+  RUN_ID, one shared PATHWAY_EXCHANGE_SECRET), remembering each child's
+  exact environment for restarts;
+- it watches for worker deaths.  A NON-LEADER worker that dies while the
+  leader is still running is relaunched with its saved environment — the
+  restarted process re-runs the whole program, reconnects the mesh,
+  re-runs the topology handshake, and rejoins from its latest operator
+  snapshot (internals/runner.py drives that protocol).  Restarts are
+  bounded by ``PATHWAY_TPU_MAX_RESTARTS`` (default 3, per run);
+- it services kill requests: the leader detects a HUNG (not dead) peer
+  via the heartbeat suspicion timeout and writes ``kill-<id>`` into
+  ``PATHWAY_TPU_SUPERVISOR_DIR``; the supervisor SIGKILLs that worker so
+  the ordinary death→restart path takes over;
+- leader death, restart-budget exhaustion, or a non-zero clean exit
+  tears the whole mesh down and propagates the exit code with the same
+  ``rc if rc > 0 else 128 - rc`` convention as ``cli.spawn``.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import signal
+import subprocess
+import sys
+import tempfile
+import time as _time
+import uuid
+from typing import Sequence
+
+
+class MeshSupervisor:
+    def __init__(
+        self,
+        program: str,
+        arguments: Sequence[str],
+        *,
+        threads: int = 1,
+        processes: int = 1,
+        first_port: int = 10000,
+        env: dict | None = None,
+        max_restarts: int | None = None,
+        poll_interval: float = 0.05,
+    ) -> None:
+        self.program = program
+        self.arguments = list(arguments)
+        self.threads = threads
+        self.processes = processes
+        self.first_port = first_port
+        if max_restarts is None:
+            try:
+                max_restarts = int(
+                    os.environ.get("PATHWAY_TPU_MAX_RESTARTS", "3")
+                )
+            except ValueError:
+                max_restarts = 3
+        self.max_restarts = max(0, max_restarts)
+        self.poll_interval = poll_interval
+        self.restarts = 0
+
+        env_base = dict(os.environ if env is None else env)
+        self.recovery = env_base.get(
+            "PATHWAY_TPU_RECOVER", ""
+        ).lower() in ("1", "true", "yes")
+        env_base.setdefault("PATHWAY_EXCHANGE_SECRET", secrets.token_hex(32))
+        env_base.setdefault("PATHWAY_RUN_ID", str(uuid.uuid4()))
+        self._kill_dir = tempfile.mkdtemp(prefix="pathway-supervisor-")
+        env_base["PATHWAY_TPU_SUPERVISOR_DIR"] = self._kill_dir
+        self._envs: list[dict] = []
+        for process_id in range(processes):
+            proc_env = env_base.copy()
+            proc_env["PATHWAY_THREADS"] = str(threads)
+            proc_env["PATHWAY_PROCESSES"] = str(processes)
+            proc_env["PATHWAY_FIRST_PORT"] = str(first_port)
+            proc_env["PATHWAY_PROCESS_ID"] = str(process_id)
+            self._envs.append(proc_env)
+        self._handles: list[subprocess.Popen | None] = [None] * processes
+        #: final exit code of each slot once it will not run again
+        self._final_rc: list[int | None] = [None] * processes
+        #: restarts per slot — stamped into the child env so a re-parsed
+        #: fault plan knows its kill fault already fired (engine/faults.py)
+        self._slot_restarts = [0] * processes
+
+    # -- process control -----------------------------------------------------
+
+    def _launch(self, process_id: int) -> None:
+        proc_env = dict(
+            self._envs[process_id],
+            PATHWAY_TPU_RESTART_COUNT=str(self._slot_restarts[process_id]),
+        )
+        self._handles[process_id] = subprocess.Popen(
+            [self.program, *self.arguments], env=proc_env
+        )
+
+    def _terminate_all(self) -> None:
+        for handle in self._handles:
+            if handle is not None and handle.poll() is None:
+                handle.terminate()
+        deadline = _time.monotonic() + 5.0
+        for handle in self._handles:
+            if handle is None:
+                continue
+            while handle.poll() is None:
+                if _time.monotonic() > deadline:
+                    handle.kill()
+                    break
+                _time.sleep(0.02)
+
+    def _service_kill_requests(self) -> None:
+        try:
+            names = os.listdir(self._kill_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("kill-"):
+                continue
+            try:
+                target = int(name.split("-", 1)[1])
+            except ValueError:
+                continue
+            try:
+                os.unlink(os.path.join(self._kill_dir, name))
+            except OSError:
+                pass
+            handle = (
+                self._handles[target]
+                if 0 <= target < self.processes
+                else None
+            )
+            if handle is not None and handle.poll() is None:
+                print(
+                    f"pathway supervisor: killing hung worker {target} "
+                    f"(pid {handle.pid}) on leader request",
+                    file=sys.stderr,
+                )
+                handle.send_signal(signal.SIGKILL)
+
+    # -- the supervision loop ------------------------------------------------
+
+    def run(self) -> int:
+        """Launch all workers and supervise until the mesh finishes or
+        dies; returns the aggregated exit code (``cli.spawn`` convention)."""
+        recovery = self.recovery
+        print(
+            f"Preparing {self.processes} process(es) "
+            f"({self.processes * self.threads} total workers) "
+            f"under supervision (recovery "
+            f"{'on' if recovery else 'off'})",
+            file=sys.stderr,
+        )
+        try:
+            for process_id in range(self.processes):
+                self._launch(process_id)
+            while True:
+                self._service_kill_requests()
+                leader = self._handles[0]
+                leader_rc = (
+                    self._final_rc[0]
+                    if self._final_rc[0] is not None
+                    else (None if leader is None else leader.poll())
+                )
+                for process_id in range(self.processes):
+                    if self._final_rc[process_id] is not None:
+                        continue
+                    handle = self._handles[process_id]
+                    rc = None if handle is None else handle.poll()
+                    if rc is None:
+                        continue
+                    if process_id == 0 or rc == 0 or not recovery:
+                        self._final_rc[process_id] = rc
+                        continue
+                    if leader_rc is not None:
+                        # the leader already finished: a late follower
+                        # death is a teardown artifact, not a failure to
+                        # recover from
+                        self._final_rc[process_id] = rc
+                        continue
+                    if self.restarts >= self.max_restarts:
+                        print(
+                            f"pathway supervisor: worker {process_id} "
+                            f"died (rc {rc}) with the restart budget "
+                            f"exhausted ({self.max_restarts}); tearing "
+                            "the mesh down",
+                            file=sys.stderr,
+                        )
+                        self._final_rc[process_id] = rc
+                        self._terminate_all()
+                        break
+                    self.restarts += 1
+                    self._slot_restarts[process_id] += 1
+                    print(
+                        f"pathway supervisor: worker {process_id} died "
+                        f"(rc {rc}); restarting "
+                        f"({self.restarts}/{self.max_restarts})",
+                        file=sys.stderr,
+                    )
+                    self._launch(process_id)
+                if all(rc is not None for rc in self._final_rc):
+                    break
+                if self._final_rc[0] is not None:
+                    # leader is done: give followers a moment to finish,
+                    # then stop waiting on them
+                    deadline = _time.monotonic() + 10.0
+                    while _time.monotonic() < deadline and any(
+                        h is not None and h.poll() is None
+                        for h in self._handles
+                    ):
+                        _time.sleep(self.poll_interval)
+                    self._terminate_all()
+                    for pid_, handle in enumerate(self._handles):
+                        if self._final_rc[pid_] is None:
+                            self._final_rc[pid_] = (
+                                handle.returncode
+                                if handle is not None
+                                and handle.returncode is not None
+                                else 1
+                            )
+                    break
+                _time.sleep(self.poll_interval)
+        finally:
+            self._terminate_all()
+        for rc in self._final_rc:
+            if rc is None:
+                return 1
+            if rc != 0:
+                return rc if rc > 0 else 128 - rc
+        return 0

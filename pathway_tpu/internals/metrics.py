@@ -25,6 +25,26 @@ plane: a bounded ring of recent structured events (commits, exchanges,
 retractions, errors) that ``pw.run`` dumps to a JSON file when a run
 raises, from any worker (``PATHWAY_TPU_FLIGHT_DIR`` picks the directory,
 ``PATHWAY_TPU_FLIGHT_EVENTS`` the ring size).
+
+The fault-tolerance layer (engine/distributed.py, engine/faults.py,
+internals/runner.py) reports through the same registry:
+
+- ``pathway_mesh_recoveries_total`` — mesh-wide recoveries completed
+  after a worker loss (leader increments after the post-rollback
+  resync barrier);
+- ``pathway_mesh_send_retries_total`` — mesh frames recovered by the
+  bounded send-retry path (transient socket errors, not peer deaths);
+- ``pathway_connector_retries_total`` — connector reader polls retried
+  after transient I/O errors;
+- ``pathway_mesh_recv_backpressure`` — receiver threads currently
+  blocked on a full per-peer frame queue
+  (``PATHWAY_TPU_MESH_QUEUE_HWM``);
+
+and the flight recorder carries the recovery lifecycle as events:
+``peer_dead``, ``recovery_start``, ``recovery_parked``,
+``recovery_remesh``, ``recovery_rollback``, ``recovery_done``,
+``fault_kill`` — every surviving worker dumps its ring when a peer is
+declared dead, so a post-mortem has one JSON file per worker.
 """
 
 from __future__ import annotations

@@ -9,6 +9,7 @@ engine expressions, and pumps the scheduler.
 
 from __future__ import annotations
 
+import os
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 from pathway_tpu.engine import expression as eex
@@ -1327,22 +1328,14 @@ class DistributedGraphRunner:
         from pathway_tpu.internals.license import check_worker_count
 
         check_worker_count(threads * processes)
-        from pathway_tpu.persistence import PersistenceMode
-
-        if (
-            persistence_config is not None
-            and getattr(persistence_config, "persistence_mode", None)
-            == PersistenceMode.OPERATOR_PERSISTING
-        ):
-            raise NotImplementedError(
-                "operator snapshots are single-process for now; use "
-                "input-journal persistence (PersistenceMode.PERSISTING) "
-                "with processes>1"
-            )
         self.threads = threads
         self.processes = processes
         self.process_id = process_id
         self.first_port = first_port
+        #: the full persistence config, kept on EVERY process: operator-
+        #: persisting meshes give each process its own snapshot manager
+        #: (journal/UDF-cache wiring below stays primary-only)
+        self.persistence = persistence_config
         primary = process_id == 0
         self.workers = [
             GraphRunner(
@@ -1352,6 +1345,7 @@ class DistributedGraphRunner:
             for i in range(threads)
         ]
         self.monitor: Any = None
+        self._epoch = 0
 
     def build(self, table: "Table") -> list[Node]:
         return [w.build(table) for w in self.workers]
@@ -1409,36 +1403,223 @@ class DistributedGraphRunner:
         finally:
             transport.close()
 
+    # -- fault tolerance ----------------------------------------------------
+
+    def _snapshot_manager(self):
+        """Per-process operator snapshot manager, or None when persistence
+        is absent / not OPERATOR_PERSISTING.  Every process snapshots its
+        OWN replica states under a process-qualified name, keeping a small
+        ring of recent commits so the mesh can roll back to a COMMON one."""
+        if self.persistence is None:
+            return None
+        from pathway_tpu.engine.persistence import OperatorSnapshotManager
+        from pathway_tpu.persistence import PersistenceMode
+
+        if (
+            getattr(self.persistence, "persistence_mode", None)
+            != PersistenceMode.OPERATOR_PERSISTING
+        ):
+            return None
+        return OperatorSnapshotManager(
+            self.persistence.backend,
+            getattr(self.persistence, "snapshot_interval_ms", 0),
+            name=f"operator-snapshot-p{self.process_id}",
+            retain=3,
+        )
+
+    @staticmethod
+    def _recovery_enabled(snapshot_mgr) -> bool:
+        """Worker recovery is OPT-IN: it needs both the env switch and an
+        operator-snapshot backend.  Everything else fail-stops, exactly as
+        before this layer existed."""
+        return snapshot_mgr is not None and os.environ.get(
+            "PATHWAY_TPU_RECOVER", ""
+        ).lower() in ("1", "true", "yes")
+
+    @staticmethod
+    def _recover_deadline() -> float:
+        try:
+            return max(
+                1.0,
+                float(os.environ.get("PATHWAY_TPU_RECOVER_DEADLINE", "60")),
+            )
+        except ValueError:
+            return 60.0
+
+    @staticmethod
+    def _fault_plan():
+        if not os.environ.get("PATHWAY_TPU_FAULT_PLAN"):
+            return None
+        from pathway_tpu.engine.faults import active_plan
+
+        return active_plan()
+
+    @staticmethod
+    def _request_kill(peer: int) -> None:
+        """Ask the MeshSupervisor (if one launched this mesh) to SIGKILL a
+        suspected-hung worker so the death→restart path takes over; a
+        no-op without a supervisor (the caller then fail-stops on the
+        reestablish deadline)."""
+        sup_dir = os.environ.get("PATHWAY_TPU_SUPERVISOR_DIR")
+        if not sup_dir:
+            return
+        try:
+            with open(
+                os.path.join(sup_dir, f"kill-{peer}"), "w"
+            ) as fh:
+                fh.write(str(os.getpid()))
+        except OSError:
+            pass
+
+    def _rewind_sinks(self, to_time: int) -> None:
+        """Truncate file sinks past the rollback point so re-driven
+        commits land exactly once.  Callback sinks (pw.io.subscribe) have
+        no rewind seam: re-driven commits reach them at-least-once — a
+        documented recovery limit."""
+        from pathway_tpu.engine.connectors import FILE_WRITERS
+
+        for writer in list(FILE_WRITERS):
+            writer.rewind_to(to_time)
+
+    def _recover_mesh(
+        self, sched, transport, snapshot_mgr, dead_peer: int, drivers: list
+    ) -> None:
+        """Leader-side recovery: park survivors, get the dead worker
+        restarted (supervisor), re-mesh, re-handshake, roll every process
+        back to the restarted worker's snapshot, and resync the links."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        self._epoch += 1
+        epoch = self._epoch
+        _metrics.FLIGHT.record(
+            "peer_dead", peer=dead_peer, time=sched.time, epoch=epoch
+        )
+        _metrics.FLIGHT.dump(f"peer {dead_peer} lost (leader view)")
+        _metrics.FLIGHT.record(
+            "recovery_start", peer=dead_peer, epoch=epoch
+        )
+        deadline = self._recover_deadline()
+        # survivors park in `recovering` (their own PeerLostError or this
+        # command gets them there) and re-mesh toward the restarted worker
+        for peer in sorted(sched._outbox):
+            if peer == dead_peer or peer in transport.dead_peers:
+                continue
+            transport.send(peer, ("cmd", "recover", dead_peer, epoch))
+        # a hung (not dead) worker must actually die before its restart
+        # can bind the exchange port again
+        self._request_kill(dead_peer)
+        detect_s = _time.monotonic() - t0
+        transport.reestablish(dead_peer, deadline=deadline)
+        sched.reannounce_to(dead_peer)
+        frame = transport.recv(dead_peer, timeout=deadline)
+        if not (
+            isinstance(frame, tuple) and frame and frame[0] == "rejoin"
+        ):
+            raise RuntimeError(
+                f"process 0: expected the restarted worker {dead_peer}'s "
+                f"rejoin frame, got {frame!r}"
+            )
+        rejoin_time = int(frame[1])
+        if rejoin_time < 0:
+            raise RuntimeError(
+                f"process 0: restarted worker {dead_peer} has no operator "
+                "snapshot to resume from (it died before its first commit "
+                "boundary); cold-starting one worker of a warm mesh would "
+                "diverge state — fail-stop"
+            )
+        transport.broadcast(("cmd", "rollback", rejoin_time, epoch))
+        sched.rollback(rejoin_time, snapshot_mgr, drivers)
+        self._rewind_sinks(rejoin_time)
+        sched.resync(epoch)
+        _metrics.REGISTRY.counter(
+            "pathway_mesh_recoveries_total",
+            "mesh-wide recoveries completed after a worker loss",
+        ).inc(1)
+        _metrics.FLIGHT.record(
+            "recovery_done",
+            peer=dead_peer,
+            epoch=epoch,
+            to_time=rejoin_time,
+            detect_s=round(detect_s, 6),
+            wall_s=round(_time.monotonic() - t0, 6),
+        )
+        _metrics.FLIGHT.dump(f"peer {dead_peer} recovered (leader view)")
+
+    # -- the two run loops --------------------------------------------------
+
     def _coordinate(self, sched, transport) -> None:
         import time as _time
+
+        from pathway_tpu.engine.distributed import (
+            RECV_TIMEOUT,
+            PeerLostError,
+        )
 
         w0 = self.workers[0]
         drivers = list(w0.drivers)
         persistent = [d for d in drivers if hasattr(d, "replay")]
         for d in persistent:
             d.replay()
+        snapshot_mgr = self._snapshot_manager()
+        recovery = self._recovery_enabled(snapshot_mgr)
+        fault_plan = self._fault_plan()
+        if snapshot_mgr is not None:
+            # startup rejoin protocol: collect every follower's latest
+            # snapshot time, roll the whole mesh back to the oldest
+            # common commit, then barrier — a plain cold start runs the
+            # same path with T = -1
+            times = [snapshot_mgr.latest_time()]
+            for peer in sorted(sched._outbox):
+                frame = transport.recv(peer)
+                if not (
+                    isinstance(frame, tuple)
+                    and frame
+                    and frame[0] == "rejoin"
+                ):
+                    raise RuntimeError(
+                        f"process 0: expected peer {peer}'s rejoin frame, "
+                        f"got {frame!r}"
+                    )
+                times.append(frame[1])
+            common = min(
+                (t if t is not None else -1) for t in times
+            )
+            transport.broadcast(("cmd", "rollback", common, self._epoch))
+            sched.rollback(common, snapshot_mgr, drivers)
+            sched.resync(self._epoch)
         transport.broadcast(("cmd", "commit"))
         sched.commit_local()
         last_sign_of_life = _time.monotonic()
 
         def on_data() -> None:
             nonlocal last_sign_of_life
-            transport.raise_if_peer_dead()
             started = _time.monotonic()
-            stamp = _take_ingest_stamp(drivers)
-            rows_before = _OUT_ROWS.value
-            transport.broadcast(("cmd", "commit"))
-            time = sched.commit_local()
+            try:
+                transport.raise_if_peer_dead()
+                stamp = _take_ingest_stamp(drivers)
+                rows_before = _OUT_ROWS.value
+                transport.broadcast(("cmd", "commit"))
+                time = sched.commit_local()
+            except PeerLostError as exc:
+                if not recovery or exc.peer is None or exc.peer == 0:
+                    raise
+                self._recover_mesh(
+                    sched, transport, snapshot_mgr, exc.peer, drivers
+                )
+                return  # the rolled-back commit re-drives on the next poll
             _observe_commit_latency(stamp, started, rows_before)
             for d in persistent:
                 d.on_commit(time)
+            if snapshot_mgr is not None:
+                snapshot_mgr.on_commit(sched.scopes, drivers, time)
+            if fault_plan is not None:
+                fault_plan.on_commit(self.process_id, time)
             if self.monitor is not None:
                 w0.monitor = self.monitor
                 w0._sync_monitor_connectors()
                 self.monitor.on_commit(time, started)
             last_sign_of_life = started
-
-        from pathway_tpu.engine.distributed import RECV_TIMEOUT
 
         # pings must always undercut the followers' recv timeout, or a
         # quiet stream trips spurious peer-crash errors
@@ -1447,10 +1628,19 @@ class DistributedGraphRunner:
         def on_idle() -> None:
             # fail-stop promptly when a peer's socket closed — the
             # send path alone needs TWO sends after the RST to notice
-            transport.raise_if_peer_dead()
+            nonlocal last_sign_of_life
+            try:
+                transport.raise_if_peer_dead()
+            except PeerLostError as exc:
+                if not recovery or exc.peer is None or exc.peer == 0:
+                    raise
+                self._recover_mesh(
+                    sched, transport, snapshot_mgr, exc.peer, drivers
+                )
+                last_sign_of_life = _time.monotonic()
+                return
             # keep follower recv timeouts from tripping during long quiet
             # stretches of a streaming run
-            nonlocal last_sign_of_life
             if _time.monotonic() - last_sign_of_life > ping_every:
                 transport.broadcast(("cmd", "ping"))
                 last_sign_of_life = _time.monotonic()
@@ -1460,21 +1650,126 @@ class DistributedGraphRunner:
         sched.finish_local()
         for d in persistent:
             d.on_commit(sched.time)
+        if snapshot_mgr is not None:
+            snapshot_mgr.snapshot(sched.scopes, drivers, sched.time)
 
     def _follow(self, sched, transport) -> None:
+        from pathway_tpu.engine.distributed import PeerLostError
+
+        snapshot_mgr = self._snapshot_manager()
+        recovery = self._recovery_enabled(snapshot_mgr)
+        fault_plan = self._fault_plan()
+        deadline = self._recover_deadline()
+        if snapshot_mgr is not None:
+            latest = snapshot_mgr.latest_time()
+            transport.send(
+                0, ("rejoin", latest if latest is not None else -1)
+            )
         while True:
-            kind, cmd = transport.recv(0)
+            frame = transport.recv(0)  # leader-link loss is fatal here
+            kind = frame[0]
             if kind != "cmd":
                 raise RuntimeError(
                     f"process {self.process_id}: expected a coordinator "
                     f"command, got {kind!r}"
                 )
+            cmd = frame[1]
             if cmd == "ping":
+                # answer so the leader's suspicion clock sees an idle-but-
+                # alive follower (absorbed by its receiver thread)
+                transport.heartbeat(0)
                 continue
             if cmd == "commit":
-                sched.commit_local()
+                try:
+                    time = sched.commit_local()
+                except PeerLostError as exc:
+                    if not recovery or exc.peer is None or exc.peer == 0:
+                        raise
+                    self._park_for_recovery(sched, transport, exc.peer)
+                    continue
+                if snapshot_mgr is not None:
+                    snapshot_mgr.on_commit(sched.scopes, [], time)
+                if fault_plan is not None:
+                    fault_plan.on_commit(self.process_id, time)
+            elif cmd == "recover":
+                # a peer died; this follower survived without noticing
+                # (or already parked — _park_for_recovery consumed the
+                # command and re-meshed; this branch is the idle path)
+                _dead = frame[2]
+                _metrics.FLIGHT.record(
+                    "peer_dead",
+                    peer=_dead,
+                    time=sched.time,
+                    epoch=frame[3],
+                )
+                _metrics.FLIGHT.dump(
+                    f"peer {_dead} lost (survivor view)"
+                )
+                transport.reestablish(_dead, deadline=deadline)
+                _metrics.FLIGHT.record(
+                    "recovery_remesh", peer=_dead, epoch=frame[3]
+                )
+            elif cmd == "rollback":
+                sched.rollback(frame[2], snapshot_mgr, [])
+                sched.resync(frame[3])
             elif cmd == "finish":
                 sched.finish_local()
+                if snapshot_mgr is not None:
+                    snapshot_mgr.snapshot(sched.scopes, [], sched.time)
                 return
             else:
                 raise RuntimeError(f"unknown coordinator command {cmd!r}")
+
+    def _park_for_recovery(self, sched, transport, dead_peer: int) -> None:
+        """Survivor path when a peer dies MID-COMMIT: dump forensics, then
+        park in `recovering` — drain the leader link (with backoff, under
+        a bounded deadline) until its recover command arrives, and re-mesh
+        toward the restarted worker.  The subsequent rollback command is
+        handled by the normal follow loop."""
+        import random as _random
+        import time as _time
+
+        from pathway_tpu.engine.distributed import PeerLostError
+
+        _metrics.FLIGHT.record(
+            "peer_dead", peer=dead_peer, time=sched.time
+        )
+        _metrics.FLIGHT.dump(f"peer {dead_peer} lost (survivor view)")
+        _metrics.FLIGHT.record("recovery_parked", peer=dead_peer)
+        deadline = self._recover_deadline()
+        end = _time.monotonic() + deadline
+        wait = 0.05
+        frame = sched._pending_recover
+        sched._pending_recover = None
+        while True:
+            if frame is not None:
+                if (
+                    isinstance(frame, tuple)
+                    and len(frame) >= 3
+                    and frame[0] == "cmd"
+                    and frame[1] == "recover"
+                ):
+                    break
+                # stale commit/round debris from the aborted exchange
+                frame = None
+            remaining = end - _time.monotonic()
+            if remaining <= 0:
+                raise PeerLostError(
+                    f"process {self.process_id}: no recovery command "
+                    f"within {deadline:g}s of losing peer {dead_peer} — "
+                    "fail-stop",
+                    peer=dead_peer,
+                )
+            try:
+                frame = transport.recv(
+                    0, timeout=min(remaining, wait)
+                )
+            except PeerLostError:
+                if 0 in transport.dead_peers:
+                    raise  # the leader itself is gone: fatal
+                frame = None  # just a poll timeout: keep waiting
+            wait = min(wait * 2, 1.0) * (0.75 + 0.5 * _random.random())
+        transport.reestablish(frame[2], deadline=deadline)
+        _metrics.FLIGHT.record(
+            "recovery_remesh", peer=frame[2], epoch=frame[3]
+        )

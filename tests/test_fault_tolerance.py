@@ -1,0 +1,456 @@
+"""Fault-tolerant mesh: supervised recovery, fault injection, bounded
+retries (engine/faults.py, engine/supervisor.py, the recovery protocol in
+engine/distributed.py + internals/runner.py).
+
+The chaos tests spawn a real TCP mesh with operator persistence and a
+``FaultPlan`` that SIGKILLs a non-leader worker at a commit boundary; the
+supervisor restarts it, the mesh rolls back to the dead worker's last
+snapshot, and the sink bytes must match a fault-free run bit for bit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.cli import spawn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port_base(n: int) -> int:
+    """A base port such that base..base+n-1 are currently bindable."""
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        if base + n >= 65535:
+            continue
+        ok = True
+        for i in range(n):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + i))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port range found")
+
+
+# Streaming wordcount over a directory the test feeds file by file; a
+# STOP file ends the (otherwise unbounded) streaming read so the run
+# finishes cleanly and the leader can dump its metrics registry — the
+# same families /metrics serves.
+CHAOS_PROGRAM = """
+    import os
+    import pathway_tpu as pw
+    import pathway_tpu.engine.connectors as _conn
+    from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+    _orig_poll = _conn.FsReader.poll
+    def _poll(self):
+        entries, done = _orig_poll(self)
+        if not entries and os.path.exists({stop!r}):
+            done = True
+        return entries, done
+    _conn.FsReader.poll = _poll
+
+    words = pw.io.plaintext.read(
+        {indir!r}, mode="streaming", persistent_id="w"
+    )
+    counts = words.groupby(words.data).reduce(
+        word=words.data, cnt=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, {out!r})
+    pw.run(persistence_config=Config(
+        Backend.filesystem({store!r}),
+        persistence_mode=PersistenceMode.OPERATOR_PERSISTING,
+    ))
+    if os.environ.get("PATHWAY_PROCESS_ID") == "0":
+        from pathway_tpu.internals import metrics as _m
+        with open({metrics_out!r}, "w") as fh:
+            fh.write(_m.render_snapshots({{"": _m.full_snapshot()}}))
+"""
+
+
+def _run_chaos(
+    tmp_path, tag: str, *, processes: int = 3, n_files: int = 7,
+    extra_env: dict | None = None,
+):
+    """Spawn the chaos program, pace input one file per commit (file k+1
+    is written only after file k's rows reach the sink — both the faulted
+    and the fault-free timeline see the same commit boundaries), stop the
+    stream, and return (sink bytes, metrics exposition text)."""
+    indir = tmp_path / f"in-{tag}"
+    indir.mkdir()
+    out = tmp_path / f"out-{tag}.csv"
+    stop = tmp_path / f"stop-{tag}"
+    metrics_out = tmp_path / f"metrics-{tag}.txt"
+    prog = tmp_path / f"prog-{tag}.py"
+    prog.write_text(
+        textwrap.dedent(
+            CHAOS_PROGRAM.format(
+                indir=str(indir),
+                out=str(out),
+                store=str(tmp_path / f"store-{tag}"),
+                stop=str(stop),
+                metrics_out=str(metrics_out),
+            )
+        )
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+    env["PATHWAY_TPU_MESH_TIMEOUT"] = "30"
+    env["PATHWAY_TPU_RECOVER_DEADLINE"] = "45"
+    env.update(extra_env or {})
+    result: dict = {}
+
+    def run() -> None:
+        result["rc"] = spawn(
+            sys.executable,
+            [str(prog)],
+            threads=1,
+            processes=processes,
+            first_port=_free_port_base(processes),
+            env=env,
+        )
+
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        for k in range(n_files):
+            lines = [f"w{k}_{i}" for i in range(3)] + ["common"]
+            (indir / f"f{k}.txt").write_text("\n".join(lines) + "\n")
+            marker = f"w{k}_0"
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if out.exists() and marker in out.read_text():
+                    break
+                if not th.is_alive():
+                    raise AssertionError(
+                        f"mesh exited early (rc={result.get('rc')}) "
+                        f"before file {k} committed"
+                    )
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"file {k} never reached the sink (rc="
+                    f"{result.get('rc')})"
+                )
+        stop.write_text("")
+        th.join(timeout=90)
+    finally:
+        stop.write_text("")
+        th.join(timeout=10)
+    assert not th.is_alive(), "mesh did not shut down after STOP"
+    assert result.get("rc") == 0, f"mesh exited rc={result.get('rc')}"
+    metrics_text = (
+        metrics_out.read_text() if metrics_out.exists() else ""
+    )
+    return out.read_bytes(), metrics_text
+
+
+def _canonical(sink_bytes: bytes) -> list[bytes]:
+    """Sink lines sorted: each carries (row, commit time, diff), so this
+    is the multiset of timestamped deltas.  Row order WITHIN a commit is
+    arrival order off the peer sockets and differs between two fault-free
+    runs already — the recovery guarantee is over the timestamped
+    content, not socket scheduling."""
+    return sorted(sink_bytes.splitlines())
+
+
+def test_kill_one_worker_recovers_bit_identical(tmp_path):
+    """SIGKILL a non-leader worker at a commit boundary mid-stream: the
+    supervisor restarts it, the mesh rolls back to its snapshot, resumes,
+    and the sink is bit-identical to a fault-free run — with at least one
+    completed recovery visible in the /metrics families."""
+    baseline, _ = _run_chaos(tmp_path, "baseline")
+
+    flight_dir = tmp_path / "flight"
+    flight_dir.mkdir()
+    plan = json.dumps(
+        {"seed": 7, "faults": [
+            {"type": "kill", "process": 1, "at_commit": 3},
+        ]}
+    )
+    faulted, metrics_text = _run_chaos(
+        tmp_path,
+        "faulted",
+        extra_env={
+            "PATHWAY_TPU_RECOVER": "1",
+            "PATHWAY_TPU_FAULT_PLAN": plan,
+            "PATHWAY_TPU_FLIGHT_DIR": str(flight_dir),
+        },
+    )
+    assert _canonical(faulted) == _canonical(baseline), (
+        "recovered run's sink differs from the fault-free run"
+    )
+    recovered = [
+        line
+        for line in metrics_text.splitlines()
+        if line.startswith("pathway_mesh_recoveries_total")
+        and not line.startswith("#")
+    ]
+    assert recovered, "pathway_mesh_recoveries_total missing from /metrics"
+    assert sum(float(line.rsplit(" ", 1)[1]) for line in recovered) >= 1
+    # every surviving worker dumped forensics when the peer died, and the
+    # leader's dump carries the full recovery lifecycle
+    dumps = list(flight_dir.glob("pathway_flight_*.json"))
+    assert dumps, "no flight-recorder dumps on peer death"
+    merged = "".join(p.read_text() for p in dumps)
+    assert "peer_dead" in merged
+    assert "recovery_done" in merged
+
+
+def test_fault_plan_frame_delay_dup_drop_tolerated(tmp_path):
+    """Frame-level faults the mesh absorbs without recovery: delayed and
+    duplicated round frames (stale duplicates are absorbed by the round
+    receive loop) and dropped heartbeats (pure liveness signal). The run
+    completes with the exact fault-free sink."""
+    baseline, _ = _run_chaos(tmp_path, "nofault", processes=2, n_files=4)
+    plan = json.dumps(
+        {"seed": 3, "faults": [
+            {"type": "delay", "process": 1, "kind": "round",
+             "count": 3, "ms": 40},
+            {"type": "dup", "process": 1, "kind": "round", "count": 2},
+            {"type": "drop", "process": 1, "kind": "hb", "count": 2},
+        ]}
+    )
+    faulted, _ = _run_chaos(
+        tmp_path,
+        "framefault",
+        processes=2,
+        n_files=4,
+        extra_env={"PATHWAY_TPU_FAULT_PLAN": plan},
+    )
+    assert _canonical(faulted) == _canonical(baseline)
+
+
+class _FlakyReader:
+    """Reader whose poll raises OSError ``failures`` times, then yields."""
+
+    def __init__(self, failures: int) -> None:
+        self.failures = failures
+        self.calls = 0
+
+    def poll(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise OSError("transient I/O hiccup")
+        return [("payload", "src", {"path": "src", "deleted": False})], True
+
+
+def _driver_with(reader):
+    from pathway_tpu.engine.connectors import InputDriver
+
+    return InputDriver(None, reader, None, source_name="flaky")
+
+
+def _retry_counter():
+    from pathway_tpu.internals import metrics as m
+
+    return m.REGISTRY.counter(
+        "pathway_connector_retries_total",
+        "connector reader polls retried after transient I/O errors",
+    )
+
+
+def test_connector_retry_recovers_transient_errors(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_CONNECTOR_RETRIES", "3")
+    before = _retry_counter().value
+    reader = _FlakyReader(failures=2)
+    entries, done = _driver_with(reader)._poll_reader()
+    assert done and entries[0][0] == "payload"
+    assert reader.calls == 3
+    assert _retry_counter().value - before == 2
+
+
+def test_connector_retry_exhaustion_fail_stops(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_CONNECTOR_RETRIES", "2")
+    reader = _FlakyReader(failures=10)
+    with pytest.raises(OSError):
+        _driver_with(reader)._poll_reader()
+    assert reader.calls == 3  # first try + 2 retries, then fail-stop
+
+
+def test_connector_retry_disabled_reraises_immediately(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_CONNECTOR_RETRIES", "0")
+    reader = _FlakyReader(failures=10)
+    with pytest.raises(OSError):
+        _driver_with(reader)._poll_reader()
+    assert reader.calls == 1
+
+
+def _tiny_persisted_graph(tmp_path):
+    import pathway_tpu as pw
+    from pathway_tpu.internals.runner import GraphRunner
+
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    (data / "a.txt").write_text("apple\nbanana\napple\n")
+    words = pw.io.plaintext.read(str(data), mode="static", persistent_id="w")
+    counts = words.groupby(words.data).reduce(
+        word=words.data, cnt=pw.reducers.count()
+    )
+    runner = GraphRunner()
+    runner.build(counts)
+    for d in runner.drivers:
+        d.poll()
+    from pathway_tpu.engine.graph import Scheduler
+
+    Scheduler(runner.scope).commit()
+    return runner
+
+
+def test_snapshot_ring_restores_at_time(tmp_path):
+    """``retain > 1`` keeps a ring of commit-boundary snapshots
+    addressable by time; entries that fell off the ring refuse loudly."""
+    from pathway_tpu.engine.persistence import OperatorSnapshotManager
+    from pathway_tpu.persistence import Backend
+
+    runner = _tiny_persisted_graph(tmp_path)
+    mgr = OperatorSnapshotManager(
+        Backend.filesystem(str(tmp_path / "store")),
+        0,
+        name="ring",
+        retain=3,
+    )
+    for t in (1, 2, 3, 4):
+        mgr.snapshot(runner.scope, runner.drivers, t)
+    assert mgr.latest_time() == 4
+    assert mgr.restore(runner.scope, runner.drivers, at_time=2) == 2
+    assert mgr.restore(runner.scope, runner.drivers, at_time=4) == 4
+    with pytest.raises(ValueError, match="no operator snapshot at commit"):
+        mgr.restore(runner.scope, runner.drivers, at_time=1)
+
+
+def test_recovery_refuses_mismatched_optimizer_fingerprint(tmp_path):
+    """A restarted worker must not load state written under a different
+    graph-optimizer plan — the regression the rejoin handshake's
+    fingerprint check exists for."""
+    from pathway_tpu.engine.persistence import OperatorSnapshotManager
+    from pathway_tpu.persistence import Backend
+
+    runner = _tiny_persisted_graph(tmp_path)
+    mgr = OperatorSnapshotManager(
+        Backend.filesystem(str(tmp_path / "store")),
+        0,
+        name="fp",
+        retain=2,
+    )
+    mgr.snapshot(runner.scope, runner.drivers, 1)
+    runner.scope._pw_opt_fingerprint = ["phantom-rewrite"]
+    with pytest.raises(ValueError, match="optimizer plan"):
+        mgr.restore(runner.scope, runner.drivers, at_time=1)
+
+
+def test_mesh_timeout_env_validation(monkeypatch):
+    from pathway_tpu.engine.distributed import _validated_float
+
+    monkeypatch.setenv("PATHWAY_TPU_MESH_TIMEOUT", "2.5")
+    assert _validated_float("PATHWAY_TPU_MESH_TIMEOUT", 600.0, 0.001) == 2.5
+    monkeypatch.setenv("PATHWAY_TPU_MESH_TIMEOUT", "banana")
+    with pytest.raises(ValueError, match="PATHWAY_TPU_MESH_TIMEOUT"):
+        _validated_float("PATHWAY_TPU_MESH_TIMEOUT", 600.0, 0.001)
+    monkeypatch.setenv("PATHWAY_TPU_MESH_TIMEOUT", "-3")
+    with pytest.raises(ValueError, match="PATHWAY_TPU_MESH_TIMEOUT"):
+        _validated_float("PATHWAY_TPU_MESH_TIMEOUT", 600.0, 0.001)
+
+
+def test_fault_plan_parsing(monkeypatch, tmp_path):
+    from pathway_tpu.engine.faults import FaultPlan, reset_plan
+
+    monkeypatch.setenv(
+        "PATHWAY_TPU_FAULT_PLAN",
+        '{"seed": 5, "faults": [{"type": "kill", "process": 1, '
+        '"at_commit": 2}]}',
+    )
+    reset_plan()
+    plan = FaultPlan.from_env()
+    assert plan is not None and plan.seed == 5
+    assert plan.faults[0].type == "kill"
+
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text('{"faults": [{"type": "drop", "process": 0}]}')
+    monkeypatch.setenv("PATHWAY_TPU_FAULT_PLAN", str(plan_file))
+    plan = FaultPlan.from_env()
+    assert plan.faults[0].type == "drop"
+
+    monkeypatch.setenv("PATHWAY_TPU_FAULT_PLAN", "{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        FaultPlan.from_env()
+
+    with pytest.raises(ValueError, match="unknown fault type"):
+        FaultPlan({"faults": [{"type": "melt", "process": 0}]})
+    reset_plan()
+
+
+def test_fault_plan_restart_credit(monkeypatch):
+    """A restarted worker re-parses the same plan; the supervisor's
+    PATHWAY_TPU_RESTART_COUNT stamp marks its kill fault already fired,
+    or every incarnation would kill itself again."""
+    from pathway_tpu.engine.faults import FaultPlan
+
+    monkeypatch.setenv("PATHWAY_TPU_RESTART_COUNT", "1")
+    plan = FaultPlan(
+        {"faults": [{"type": "kill", "process": 1, "at_commit": 2}]}
+    )
+    # would SIGKILL this very test process without the credit
+    plan.on_commit(1, 2)
+    plan.on_commit(1, 3)
+    assert plan.faults[0].count == 0
+
+
+_SUP_SCRIPT = """
+import os, sys, time
+pid = int(os.environ["PATHWAY_PROCESS_ID"])
+restarts = int(os.environ.get("PATHWAY_TPU_RESTART_COUNT", "0"))
+if pid == 1 and restarts < {die_until}:
+    sys.exit(3)
+time.sleep(0.8)
+sys.exit(0)
+"""
+
+
+def _supervisor(tmp_path, die_until: int, max_restarts: int):
+    from pathway_tpu.engine.supervisor import MeshSupervisor
+
+    prog = tmp_path / "sup_prog.py"
+    prog.write_text(_SUP_SCRIPT.format(die_until=die_until))
+    env = dict(os.environ)
+    env["PATHWAY_TPU_RECOVER"] = "1"
+    return MeshSupervisor(
+        sys.executable,
+        [str(prog)],
+        threads=1,
+        processes=2,
+        first_port=_free_port_base(2),
+        env=env,
+        max_restarts=max_restarts,
+    )
+
+
+def test_supervisor_restarts_dead_worker(tmp_path):
+    sup = _supervisor(tmp_path, die_until=2, max_restarts=3)
+    assert sup.run() == 0
+    assert sup.restarts == 2
+
+
+def test_supervisor_restart_budget_fail_stops(tmp_path):
+    sup = _supervisor(tmp_path, die_until=99, max_restarts=1)
+    assert sup.run() != 0
+    assert sup.restarts == 1

@@ -17,7 +17,11 @@ problem):
    plane fully on (per-operator probes + StatsMonitor + latency
    histogram + flight recorder) vs fully off; FAILs when the overhead
    exceeds 5% (observability must be effectively free);
-5. sanitized native build — recompile ``native/enginecore.cpp`` with
+5. chaos smoke — a real 3-process TCP mesh with operator persistence
+   and a fault-injected SIGKILL of a non-leader worker mid-stream must
+   recover (supervised restart + snapshot rollback) to the exact
+   fault-free sink, within a bounded wall budget;
+6. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
    (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
@@ -279,6 +283,42 @@ def step_sanitized_native() -> str:
     return PASS
 
 
+def step_chaos_smoke() -> str:
+    """Fast fault-tolerance smoke: a real 3-process TCP mesh with one
+    fault-injected SIGKILL mid-stream must recover to the fault-free
+    sink (tests/test_fault_tolerance.py kill test), under a bounded
+    wall budget."""
+    name = "chaos smoke (kill + recover, 3-process mesh)"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytest",
+                "tests/test_fault_tolerance.py::"
+                "test_kill_one_worker_recovers_bit_identical",
+                "-q",
+                "-p",
+                "no:cacheprovider",
+            ],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=420,
+        )
+    except subprocess.TimeoutExpired:
+        _report(name, FAIL, "wall budget (420s) exceeded")
+        return FAIL
+    if proc.returncode != 0:
+        sys.stdout.write((proc.stdout + proc.stderr)[-4000:])
+        _report(name, FAIL, f"pytest exit {proc.returncode}")
+        return FAIL
+    _report(name, PASS)
+    return PASS
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -293,6 +333,7 @@ def main(argv=None) -> int:
         step_analyzer(),
         step_optimize_off(),
         step_metrics_overhead(),
+        step_chaos_smoke(),
     ]
     if args.skip_sanitized:
         _report("sanitized native build + parity tests", SKIP, "--skip-sanitized")
