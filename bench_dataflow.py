@@ -654,19 +654,12 @@ pw.run(persistence_config=Config(
 """
 
 
-def mesh_recovery_leg() -> dict:
-    """Fault-injected 3-process mesh: SIGKILL one non-leader worker at a
-    commit boundary, let the supervisor restart it and the mesh roll back
-    to its snapshot, and report how long detection and the full recovery
-    took (parsed from the leader's flight-recorder dump)."""
-    import glob as _glob
-    import shutil
-    import sys
-    import tempfile
-
-    from pathway_tpu.cli import spawn
-
-    root = tempfile.mkdtemp(prefix="pathway-bench-recovery-")
+def _fault_mesh_harness(root: str) -> tuple[str, dict, str, str, str, str]:
+    """Write the streaming-wordcount recovery program into ``root`` and
+    build its worker environment (persistence on, recovery on, flight
+    dumps into ``root/flight``).  Returns ``(prog, env, indir, out,
+    stop, flight)`` — shared by the recovery / leader-failover / rescale
+    bench legs."""
     indir = os.path.join(root, "in")
     os.makedirs(indir)
     out = os.path.join(root, "out.csv")
@@ -694,62 +687,103 @@ def mesh_recovery_leg() -> dict:
     env["PATHWAY_TPU_RECOVER"] = "1"
     env["PATHWAY_TPU_RECOVER_DEADLINE"] = "45"
     env["PATHWAY_TPU_FLIGHT_DIR"] = flight
+    return prog, env, indir, out, stop, flight
+
+
+def _mesh_port_base(n: int) -> int:
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    base = probe.getsockname()[1]
+    probe.close()
+    return base
+
+
+def _pace_files(
+    indir: str,
+    out: str,
+    th: threading.Thread,
+    result: dict,
+    n_files: int = 4,
+    after_commit=None,
+) -> None:
+    """Feed ``n_files`` input files one commit apart (each waits for its
+    marker row to land in the sink), optionally calling
+    ``after_commit(k)`` once file ``k`` has committed — the hook the
+    rescale leg uses to fire its request mid-stream."""
+    for k in range(n_files):
+        with open(os.path.join(indir, f"f{k}.txt"), "w") as fh:
+            fh.write("\n".join(f"w{k}_{i}" for i in range(3)) + "\n")
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                with open(out) as oh:
+                    if f"w{k}_0" in oh.read():
+                        break
+            except OSError:
+                pass
+            if not th.is_alive():
+                raise RuntimeError(
+                    f"mesh exited rc={result.get('rc')} before "
+                    f"commit {k}"
+                )
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(f"commit {k} never reached the sink")
+        if after_commit is not None:
+            after_commit(k)
+
+
+def _flight_events(flight: str, kind: str) -> list[dict]:
+    import glob as _glob
+
+    events = []
+    for path in _glob.glob(os.path.join(flight, "pathway_flight_*")):
+        with open(path) as fh:
+            payload = json.load(fh)
+        events.extend(
+            e for e in payload.get("events", [])
+            if e.get("kind") == kind
+        )
+    return events
+
+
+def mesh_recovery_leg() -> dict:
+    """Fault-injected 3-process mesh: SIGKILL one non-leader worker at a
+    commit boundary, let the supervisor restart it and the mesh roll back
+    to its snapshot, and report how long detection and the full recovery
+    took (parsed from the leader's flight-recorder dump)."""
+    import shutil
+    import sys
+    import tempfile
+
+    from pathway_tpu.cli import spawn
+
+    root = tempfile.mkdtemp(prefix="pathway-bench-recovery-")
+    prog, env, indir, out, stop, flight = _fault_mesh_harness(root)
     env["PATHWAY_TPU_FAULT_PLAN"] = json.dumps(
         {"seed": 1, "faults": [
             {"type": "kill", "process": 1, "at_commit": 2},
         ]}
     )
 
-    def _port_base(n: int) -> int:
-        probe = socket.socket()
-        probe.bind(("127.0.0.1", 0))
-        base = probe.getsockname()[1]
-        probe.close()
-        return base
-
     result: dict = {}
 
     def run() -> None:
         result["rc"] = spawn(
             sys.executable, [prog], threads=1, processes=3,
-            first_port=_port_base(3), env=env,
+            first_port=_mesh_port_base(3), env=env,
         )
 
     try:
         th = threading.Thread(target=run)
         th.start()
-        for k in range(4):
-            with open(os.path.join(indir, f"f{k}.txt"), "w") as fh:
-                fh.write("\n".join(f"w{k}_{i}" for i in range(3)) + "\n")
-            deadline = time.monotonic() + 90
-            while time.monotonic() < deadline:
-                try:
-                    with open(out) as oh:
-                        if f"w{k}_0" in oh.read():
-                            break
-                except OSError:
-                    pass
-                if not th.is_alive():
-                    raise RuntimeError(
-                        f"mesh exited rc={result.get('rc')} before "
-                        f"commit {k}"
-                    )
-                time.sleep(0.05)
-            else:
-                raise RuntimeError(f"commit {k} never reached the sink")
+        _pace_files(indir, out, th, result)
         with open(stop, "w"):
             pass
         th.join(timeout=90)
         if result.get("rc") != 0:
             raise RuntimeError(f"mesh exited rc={result.get('rc')}")
-        done_events = []
-        for path in _glob.glob(os.path.join(flight, "pathway_flight_*")):
-            with open(path) as fh:
-                payload = json.load(fh)
-            done_events.extend(
-                e for e in payload.get("events", [])
-                if e.get("kind") == "recovery_done"
-            )
+        done_events = _flight_events(flight, "recovery_done")
         if not done_events:
             raise RuntimeError("no recovery_done event in flight dumps")
         last = done_events[-1]
@@ -758,6 +792,124 @@ def mesh_recovery_leg() -> dict:
             "recoveries": len(done_events),
             "detect_s": round(float(last["detect_s"]), 4),
             "recovery_wall_s": round(float(last["wall_s"]), 4),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def leader_failover_leg() -> dict:
+    """Fault-injected 3-process mesh: SIGKILL the LEADER (process 0) at
+    a commit boundary.  The survivors detect the loss, run the
+    epoch-stamped election (lowest live rank becomes interim leader),
+    re-mesh toward the supervisor-restarted process 0, and roll back to
+    the last common commit.  Reports detection, election, and full
+    failover (detection -> state re-meshed/rejoin sent) wall times,
+    parsed from the survivors' flight dumps."""
+    import shutil
+    import sys
+    import tempfile
+
+    from pathway_tpu.cli import spawn
+
+    root = tempfile.mkdtemp(prefix="pathway-bench-failover-")
+    prog, env, indir, out, stop, flight = _fault_mesh_harness(root)
+    env["PATHWAY_TPU_MAX_RESTARTS"] = "4"
+    env["PATHWAY_TPU_FAULT_PLAN"] = json.dumps(
+        {"seed": 2, "faults": [
+            {"type": "kill", "process": 0, "at_commit": 2},
+        ]}
+    )
+
+    result: dict = {}
+
+    def run() -> None:
+        result["rc"] = spawn(
+            sys.executable, [prog], threads=1, processes=3,
+            first_port=_mesh_port_base(3), env=env,
+        )
+
+    try:
+        th = threading.Thread(target=run)
+        th.start()
+        _pace_files(indir, out, th, result)
+        with open(stop, "w"):
+            pass
+        th.join(timeout=90)
+        if result.get("rc") != 0:
+            raise RuntimeError(f"mesh exited rc={result.get('rc')}")
+        elections = _flight_events(flight, "election_done")
+        failovers = _flight_events(flight, "leader_failover_done")
+        deaths = _flight_events(flight, "leader_dead")
+        if not elections or not failovers:
+            raise RuntimeError(
+                "no election_done/leader_failover_done in flight dumps"
+            )
+        detect = [
+            float(e["detect_s"]) for e in deaths
+            if e.get("detect_s") is not None
+        ]
+        last = elections[-1]
+        return {
+            "workload": "leader_failover",
+            "elections": len(elections),
+            "detect_s": round(max(detect), 4) if detect else None,
+            "election_s": round(float(last["wall_s"]), 4),
+            "failover_s": round(
+                max(float(e["wall_s"]) for e in failovers), 4
+            ),
+            "rollback_target": last.get("rollback_target"),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def rescale_leg() -> dict:
+    """Live N→M rescale mid-stream (3 -> 2): pace a few commits, request
+    the rescale, and report the supervisor's request -> quiesce ->
+    re-shard -> relaunch wall time plus the exact state-transfer volume
+    (moved keys, from the routing kernels) of the re-shard step."""
+    import shutil
+    import sys
+    import tempfile
+
+    from pathway_tpu.engine.supervisor import MeshSupervisor
+
+    root = tempfile.mkdtemp(prefix="pathway-bench-rescale-")
+    prog, env, indir, out, stop, flight = _fault_mesh_harness(root)
+    env["PATHWAY_TPU_SUPERVISOR_DIR"] = os.path.join(root, "sup")
+
+    sup = MeshSupervisor(
+        sys.executable, [prog], threads=1, processes=3,
+        first_port=_mesh_port_base(3), env=env,
+    )
+    result: dict = {}
+
+    def run() -> None:
+        result["rc"] = sup.run()
+
+    def after_commit(k: int) -> None:
+        if k == 1:
+            sup.rescale(2)
+
+    try:
+        th = threading.Thread(target=run)
+        th.start()
+        _pace_files(indir, out, th, result, after_commit=after_commit)
+        with open(stop, "w"):
+            pass
+        th.join(timeout=90)
+        if result.get("rc") != 0:
+            raise RuntimeError(f"mesh exited rc={result.get('rc')}")
+        if sup.rescales < 1 or sup.last_rescale_wall_s is None:
+            raise RuntimeError("rescale never completed")
+        report = sup.last_rescale_report or {}
+        return {
+            "workload": "rescale",
+            "rescales": sup.rescales,
+            "rescale_wall_s": round(sup.last_rescale_wall_s, 4),
+            "quiesce_time": report.get("time"),
+            "source_rows": report.get("source_rows"),
+            "moved_keys": report.get("moved_keys"),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -818,15 +970,24 @@ def run_all(emit=None) -> dict:
                 {k: v for k, v in leg.items() if k != "workload"},
             )
         if not _analyze_only():
-            try:
-                leg = mesh_recovery_leg()
-            except Exception as exc:
-                record("mesh_recovery_error", repr(exc))
-            else:
-                record(
-                    "mesh_recovery",
-                    {k: v for k, v in leg.items() if k != "workload"},
-                )
+            # the elastic-mesh legs each spawn a real supervised mesh:
+            # follower kill + recovery, leader kill + election failover,
+            # and a live 3->2 rescale; each reports its detection /
+            # election / state-transfer wall times
+            for leg_name, make_leg in (
+                ("mesh_recovery", mesh_recovery_leg),
+                ("leader_failover", leader_failover_leg),
+                ("rescale", rescale_leg),
+            ):
+                try:
+                    leg = make_leg()
+                except Exception as exc:
+                    record(f"{leg_name}_error", repr(exc))
+                else:
+                    record(
+                        leg_name,
+                        {k: v for k, v in leg.items() if k != "workload"},
+                    )
     record(
         "native",
         {

@@ -16,6 +16,11 @@ builds is statically analyzed instead of executed, and a combined report
 is printed.  Exit codes: 0 = clean (info-level findings allowed), 1 =
 warning/error findings, 2 = the program or the analyzer itself failed.
 
+``python -m pathway_tpu.cli rescale M`` asks a live supervised mesh
+(PATHWAY_TPU_RECOVER=1 spawn) to rescale to M processes: the supervisor
+quiesces the mesh at a commit boundary, re-shards the operator
+snapshots, and relaunches — sink output stays bit-identical.
+
 ``python -m pathway_tpu.cli stats <port|host:port|url>`` scrapes a live
 monitoring endpoint (pw.run with_http_server=True; port
 20000 + process_id) and pretty-prints the mesh-wide per-worker table plus
@@ -320,6 +325,55 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
     return 0
 
 
+def rescale(
+    target_processes: int, *, supervisor_dir: str | None = None
+) -> int:
+    """Ask a live supervised mesh to rescale to ``target_processes``.
+
+    Writes a ``rescale`` request file into the supervisor's control
+    directory (``--supervisor-dir`` or PATHWAY_TPU_SUPERVISOR_DIR —
+    launch the run with that variable preset so other terminals can
+    find it).  The supervisor quiesces the mesh at its next commit
+    boundary, re-shards the operator snapshots, and relaunches at the
+    new size; sink output stays bit-identical."""
+    sup_dir = supervisor_dir or os.environ.get("PATHWAY_TPU_SUPERVISOR_DIR")
+    if not sup_dir:
+        print(
+            "rescale: no supervisor directory — pass --supervisor-dir "
+            "or set PATHWAY_TPU_SUPERVISOR_DIR to the value the "
+            "supervised run was launched with",
+            file=sys.stderr,
+        )
+        return 2
+    if not os.path.isdir(sup_dir):
+        print(
+            f"rescale: supervisor directory {sup_dir!r} does not exist "
+            "(is the supervised run alive?)",
+            file=sys.stderr,
+        )
+        return 2
+    if target_processes < 1:
+        print(
+            f"rescale: target process count must be >= 1, "
+            f"got {target_processes}",
+            file=sys.stderr,
+        )
+        return 2
+    from pathway_tpu.engine.supervisor import RESCALE_REQUEST
+
+    path = os.path.join(sup_dir, RESCALE_REQUEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(str(target_processes))
+    os.replace(tmp, path)
+    print(
+        f"rescale: requested {target_processes} processes "
+        f"(request file {path})",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -354,6 +408,19 @@ def main(argv: Sequence[str] | None = None) -> int:
     p_analyze.add_argument("program")
     p_analyze.add_argument("arguments", nargs=argparse.REMAINDER)
 
+    p_rescale = sub.add_parser(
+        "rescale",
+        help="ask a live supervised mesh to rescale to a new process "
+        "count (quiesce + re-shard + relaunch, bit-identical sinks)",
+    )
+    p_rescale.add_argument(
+        "--supervisor-dir",
+        default=None,
+        help="control directory of the supervised run (defaults to "
+        "PATHWAY_TPU_SUPERVISOR_DIR)",
+    )
+    p_rescale.add_argument("target_processes", type=int)
+
     p_stats = sub.add_parser(
         "stats",
         help="scrape a /metrics endpoint and pretty-print the "
@@ -383,6 +450,10 @@ def main(argv: Sequence[str] | None = None) -> int:
             args.arguments,
             as_json=args.json,
             errors_only=args.errors_only,
+        )
+    if args.command == "rescale":
+        return rescale(
+            args.target_processes, supervisor_dir=args.supervisor_dir
         )
     if args.command == "stats":
         return stats(args.target, raw=args.raw, timeout=args.timeout)

@@ -608,7 +608,18 @@ class FileWriter:
     recent commits) so a mesh-recovery rollback can truncate exactly the
     lines of un-happened commits — the recovered run re-emits them with
     identical timestamps, keeping outputs bit-identical to a fault-free
-    run."""
+    run.
+
+    The trail is also made *durable*: every commit atomically rewrites a
+    ``<path>.pw-offsets`` sidecar (run id + header end + trail).  A
+    process relaunched under the SAME ``PATHWAY_RUN_ID`` (supervised
+    restart after a full-mesh crash, or a rescale relaunch) resumes the
+    existing sink file instead of truncating it: the tail past the last
+    recorded commit boundary is dropped (those lines belonged to commits
+    that never became durable) and the restored trail lets the startup
+    rollback rewind to the mesh's last common commit — exactly-once
+    output across a cold restart.  A fresh run gets a fresh run id, so it
+    never resumes a stale file."""
 
     #: commit-boundary offsets kept per writer (matches the snapshot
     #: ring depth with slack; older commits can no longer be rolled to)
@@ -618,13 +629,69 @@ class FileWriter:
         self.path = os.fspath(path)
         self.formatter = formatter
         self.column_names = list(column_names)
-        self._file = open(self.path, "w", encoding="utf-8")
-        header = formatter.header(self.column_names)
-        if header:
-            self._file.write(header + "\n")
-        self._header_end = self._file.tell()
-        self._commit_offsets: dict[int, int] = {}
+        self._offsets_path = self.path + ".pw-offsets"
+        self._run_id = os.environ.get("PATHWAY_RUN_ID", "")
+        resumed = self._try_resume()
+        if not resumed:
+            self._file = open(self.path, "w", encoding="utf-8")
+            header = formatter.header(self.column_names)
+            if header:
+                self._file.write(header + "\n")
+            self._header_end = self._file.tell()
+            self._commit_offsets: dict[int, int] = {}
         FILE_WRITERS.add(self)
+
+    def _try_resume(self) -> bool:
+        """Reopen an existing sink file when the durable offset sidecar
+        proves it belongs to THIS run (same ``PATHWAY_RUN_ID``)."""
+        if not self._run_id or not os.path.exists(self.path):
+            return False
+        try:
+            with open(self._offsets_path, "r", encoding="utf-8") as fh:
+                meta = _json.load(fh)
+        except (OSError, ValueError):
+            return False
+        if meta.get("run_id") != self._run_id:
+            return False
+        try:
+            offsets = {
+                int(t): int(o) for t, o in meta["offsets"].items()
+            }
+            header_end = int(meta["header_end"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        self._file = open(self.path, "r+", encoding="utf-8")
+        self._header_end = header_end
+        self._commit_offsets = offsets
+        # drop any partially written tail: bytes past the newest durable
+        # commit boundary belong to a commit that never became durable
+        durable_end = max(offsets.values()) if offsets else header_end
+        self._file.truncate(durable_end)
+        self._file.seek(durable_end)
+        return True
+
+    def _persist_offsets(self) -> None:
+        """Atomically rewrite the sidecar (tmp + replace) so a crash
+        leaves either the old or the new trail, never a torn one."""
+        if not self._run_id:
+            return
+        tmp = self._offsets_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                _json.dump(
+                    {
+                        "run_id": self._run_id,
+                        "header_end": self._header_end,
+                        "offsets": {
+                            str(t): o
+                            for t, o in self._commit_offsets.items()
+                        },
+                    },
+                    fh,
+                )
+            os.replace(tmp, self._offsets_path)
+        except OSError:
+            pass
 
     def on_change(self, key: Pointer, values: tuple, time: int, diff: int) -> None:
         self._file.write(
@@ -637,6 +704,7 @@ class FileWriter:
             self._commit_offsets[time] = self._file.tell()
             while len(self._commit_offsets) > self._OFFSET_TRAIL:
                 del self._commit_offsets[min(self._commit_offsets)]
+            self._persist_offsets()
 
     def rewind_to(self, time: int) -> None:
         """Truncate everything written after commit ``time`` (``-1`` =
@@ -662,6 +730,7 @@ class FileWriter:
         self._commit_offsets = {
             t: o for t, o in self._commit_offsets.items() if t <= time
         }
+        self._persist_offsets()
 
     def on_end(self) -> None:
         if not self._file.closed:

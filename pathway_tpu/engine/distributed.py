@@ -40,6 +40,7 @@ import socket
 import struct
 import threading
 import time as _walltime
+import warnings
 from typing import Any, Sequence
 
 import numpy as np
@@ -151,6 +152,117 @@ SUSPICION_TIMEOUT = _validated_float(
 #: blocks (TCP backpressure) instead of growing leader memory unboundedly
 QUEUE_HWM = _validated_int("PATHWAY_TPU_MESH_QUEUE_HWM", 512, 1)
 _CONNECT_DEADLINE = 60.0
+
+
+class MeshConfigWarning(UserWarning):
+    """Structured warning for contradictory mesh knob combinations, in the
+    analyzer's PW-code style (``PWF`` = pathway fault-tolerance)."""
+
+    def __init__(self, code: str, message: str) -> None:
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+def retry_backoff_ceiling_s(retries: int) -> float:
+    """Worst-case wall time the bounded send-retry path can spend before
+    giving up: per attempt, the jittered backoff sleep (delay starts at
+    50ms, doubles, caps at 1s, jitter factor <= 1.5) plus the 2s
+    ``_repair_link`` dial deadline."""
+    total = 0.0
+    delay = 0.05
+    for _ in range(max(0, retries)):
+        total += delay * 1.5 + 2.0
+        delay = min(delay * 2, 1.0)
+    return total
+
+
+_KNOBS_VALIDATED = False
+
+
+def validate_mesh_knobs(*, _force: bool = False) -> list[MeshConfigWarning]:
+    """Cross-check independently tuned mesh knobs at startup (once per
+    process; tests pass ``_force=True`` after monkeypatching the env).
+
+    PWF001: the send-retry backoff ceiling must stay below the suspicion
+    timeout — otherwise a sender can still be inside its retry loop when
+    the peer declares *it* hung, turning one transient link glitch into a
+    mutual-suspicion recovery storm.  Recomputed from the environment (not
+    the module constants) so tests can exercise contradictory settings
+    without reloading the module."""
+    global _KNOBS_VALIDATED
+    if _KNOBS_VALIDATED and not _force:
+        return []
+    _KNOBS_VALIDATED = True
+    recv_timeout = _validated_float(
+        "PATHWAY_TPU_MESH_TIMEOUT",
+        _validated_float("PATHWAY_EXCHANGE_TIMEOUT", 600.0, 0.001),
+        0.001,
+    )
+    suspicion = _validated_float(
+        "PATHWAY_TPU_MESH_SUSPICION", recv_timeout, 0.001
+    )
+    retries = _validated_int("PATHWAY_TPU_MESH_SEND_RETRIES", 2, 0)
+    found: list[MeshConfigWarning] = []
+    ceiling = retry_backoff_ceiling_s(retries)
+    if ceiling >= suspicion:
+        found.append(
+            MeshConfigWarning(
+                "PWF001",
+                f"mesh send-retry backoff ceiling ({ceiling:.2f}s for "
+                f"PATHWAY_TPU_MESH_SEND_RETRIES={retries}) is not below "
+                f"the suspicion timeout (PATHWAY_TPU_MESH_SUSPICION="
+                f"{suspicion:g}s) — a retrying sender can be declared "
+                f"hung mid-retry; raise the suspicion timeout or lower "
+                f"the retry count",
+            )
+        )
+    for w in found:
+        warnings.warn(w, stacklevel=2)
+    return found
+
+
+def elect_leader(survivors: set[int] | list[int]) -> int:
+    """Deterministic leader election: the lowest-rank live worker wins.
+    Every survivor computes the same answer locally from the same
+    membership view, so no voting round is needed — the epoch stamp on
+    the election command is what serialises concurrent views."""
+    if not survivors:
+        raise ValueError("cannot elect a leader from an empty mesh")
+    return min(survivors)
+
+
+class EpochFence:
+    """Per-command-kind epoch fencing.
+
+    Recovery-control frames (``recover``, ``rollback``, ``elect``, …)
+    carry the mesh epoch that issued them.  A frame whose epoch is not
+    newer than the last one *processed* for that kind is stale — either a
+    zombie ex-leader flushing its socket buffer after being fenced out,
+    or a fault-injected duplicate of a command we already executed — and
+    must be ignored rather than re-executed (re-running a rollback would
+    deadlock the resync barrier).  Startup commands are stamped epoch 0
+    and pass against the initial floor of -1."""
+
+    def __init__(self) -> None:
+        self._last: dict[str, int] = {}
+
+    def admit(self, kind: str, epoch: int) -> bool:
+        """True (and advances the fence) when the frame is fresh."""
+        if epoch <= self._last.get(kind, -1):
+            _metrics.REGISTRY.counter(
+                "pathway_mesh_fenced_frames_total",
+                "stale epoch-stamped control frames rejected by fencing",
+            ).inc(1)
+            _metrics.FLIGHT.record(
+                "fenced_frame", frame_kind=kind, epoch=epoch,
+                fence=self._last.get(kind, -1),
+            )
+            return False
+        self._last[kind] = epoch
+        return True
+
+    def floor(self, kind: str) -> int:
+        return self._last.get(kind, -1)
 
 
 class PeerLostError(RuntimeError):
@@ -343,6 +455,7 @@ class MeshTransport:
             from pathway_tpu.engine.faults import active_plan
 
             self._fault_plan = active_plan()
+        validate_mesh_knobs()
         if n_processes == 1:
             return
         # bind only the configured interface (127.0.0.1 by default) — not
@@ -801,6 +914,9 @@ class DistributedScheduler:
         #: a leader recover command that arrived MID-ROUND on a follower
         #: (stashed by _recv_round for the runner's park loop to consume)
         self._pending_recover: tuple | None = None
+        #: per-kind epoch fence: rejects control frames from fenced-out
+        #: zombie leaders and fault-injected duplicates (see EpochFence)
+        self.fence = EpochFence()
 
     # -- topology ----------------------------------------------------------
 
@@ -820,6 +936,9 @@ class DistributedScheduler:
             for consumer, port in node.consumers:
                 if consumer.index >= self.n_shared:
                     extra.append((node.index, consumer.index, port))
+        # rebuilt from scratch: announce may run again after a leader
+        # restart, and appending twice would double-deliver to sinks
+        self.extra_consumers = {}
         for prod, cons, port in extra:
             self.extra_consumers.setdefault(prod, []).append((cons, port))
         # kept verbatim for recovery: a restarted follower re-runs the
@@ -853,6 +972,10 @@ class DistributedScheduler:
                 f"{self.process_id} has {self.n_shared} "
                 f"{self._shared_signature()[:6]}...)"
             )
+        # rebuilt, not appended: survivors re-run this handshake against a
+        # restarted or newly elected leader, and duplicate consumer edges
+        # would double-deliver every sink row
+        self.extra_consumers = {}
         for prod, cons, port in extra:
             self.extra_consumers.setdefault(prod, []).append((cons, port))
         self._ensure_optimized()
@@ -1340,6 +1463,14 @@ class DistributedScheduler:
                     peer=peer,
                 )
             if kind == "cmd" and len(frame) >= 3 and frame[1] == "recover":
+                if (
+                    len(frame) >= 4
+                    and frame[3] <= self.fence.floor("recover")
+                ):
+                    # fault-injected duplicate of a recovery we already
+                    # ran: fenced, not re-triggered
+                    self.fence.admit("recover", frame[3])
+                    continue
                 # the leader started recovery while this follower was
                 # still waiting out the doomed round: stash the command
                 # for the park loop and leave the round
@@ -1349,6 +1480,11 @@ class DistributedScheduler:
                     f"recovery of peer {frame[2]} mid-round",
                     peer=frame[2],
                 )
+            if kind in ("sync", "rejoin", "elect", "elect-ack"):
+                # recovery-era debris: a duplicated sync barrier frame or
+                # a late election frame that survived the resync drain is
+                # never legitimate inside a round — absorb it
+                continue
             if kind == "round" and (
                 frame[1] < time
                 or (frame[1] == time and frame[2] < round_no)

@@ -26,7 +26,9 @@ Plan format (JSON object)::
        {"type": "reset", "process": 1, "peer": 0, "after_sends": 10}
      ]}
 
-Selectors: ``process`` (required — which worker the fault lives in),
+Selectors: ``process`` (required — which worker the fault lives in;
+``"*"``, ``"all"`` or ``-1`` match every worker, so a wildcard ``kill``
+at one commit is a total-mesh kill — the cold-restart scenario),
 ``peer`` (optional — only frames bound for that peer), ``kind``
 (optional — only frames whose tuple tag matches, e.g. ``"round"``,
 ``"hb"``, ``"cmd"``), ``count`` (how many frames to affect; default 1),
@@ -58,7 +60,14 @@ class _Fault:
         self.type = spec["type"]
         if self.type not in ("kill", "drop", "delay", "dup", "reset"):
             raise ValueError(f"unknown fault type {self.type!r}")
-        self.process = int(spec["process"])
+        # process "*" / "all" / -1 matches every worker — the total-kill
+        # spelling used by the cold-restart scenario (a kill fault with a
+        # wildcard process takes the whole mesh down at one commit)
+        proc = spec["process"]
+        if proc in ("*", "all"):
+            self.process = -1
+        else:
+            self.process = int(proc)
         self.peer = spec.get("peer")
         self.kind = spec.get("kind")
         self.count = int(spec.get("count", 1))
@@ -66,6 +75,9 @@ class _Fault:
         self.after_sends = int(spec.get("after_sends", 0))
         self.ms = float(spec.get("ms", 0.0))
         self._sends_seen = 0
+
+    def matches_process(self, process_id: int) -> bool:
+        return self.process == -1 or self.process == process_id
 
     def matches_frame(self, peer: int, frame: Any) -> bool:
         if self.count <= 0:
@@ -127,7 +139,7 @@ class FaultPlan:
         for f in self.faults:
             if (
                 f.type == "kill"
-                and f.process == process_id
+                and f.matches_process(process_id)
                 and f.at_commit is not None
                 and time >= int(f.at_commit)
                 and f.count > 0
@@ -149,7 +161,7 @@ class FaultPlan:
         this frame: ``"send"`` (default), ``"drop"``, ``"dup"``, or
         ``"reset"``; a ``delay`` fault sleeps here and then sends."""
         for f in self.faults:
-            if f.process != process_id or f.type == "kill":
+            if not f.matches_process(process_id) or f.type == "kill":
                 continue
             if not f.matches_frame(peer, frame):
                 continue

@@ -616,6 +616,164 @@ def _reshard_worker_states(
     return out
 
 
+def reshard_process_snapshots(
+    backend: PersistenceBackend,
+    old_processes: int,
+    new_processes: int,
+    threads: int,
+    scopes: list,
+    *,
+    n_shared: int,
+) -> dict:
+    """Rewrite the per-process operator snapshots of an N-process mesh
+    for an M-process mesh (the ``MeshSupervisor.rescale`` state step).
+
+    Every process of a quiesced mesh left ``operator-snapshot-p{pid}``
+    at the same commit boundary.  This merges them into the global
+    worker-state list (global worker id = ``pid * threads + scope_idx``,
+    the mesh exchange numbering), re-splits it through
+    :func:`_reshard_worker_states` — i.e. through the SAME routing the
+    live exchange uses, so a re-sharded groupby lands exactly where its
+    next delta will — and writes one snapshot per NEW process.  Scale-in
+    merges the departing processes' shards; scale-out deals new shards
+    to the added processes.  Stale snapshots of processes beyond the new
+    count are blanked so a later scale-OUT cannot resurrect them.
+
+    ``scopes`` are the helper process's own worker scopes (scope 0 full
+    with the sink chain, replicas shared-only up to ``n_shared``) —
+    the graph is rebuilt by re-running the program, exactly like a
+    restarted worker.  Returns a report dict (old/new sizes, commit
+    time, exact moved-key count from ``engine/routing.reshard_moves``).
+    """
+    import pickle as _pickle
+
+    from pathway_tpu.engine.graph import InputSession, StaticSource
+    from pathway_tpu.engine.routing import reshard_moves
+
+    if old_processes < 1 or new_processes < 1:
+        raise ValueError("process counts must be >= 1")
+
+    def _load(name: str) -> dict | None:
+        raw = backend.read(name)
+        if not raw:
+            return None
+        try:
+            return _pickle.loads(raw)
+        except Exception:
+            return None
+
+    payloads: list[dict] = []
+    for p in range(old_processes):
+        payload = _load(f"operator-snapshot-p{p}")
+        if payload is None:
+            raise ValueError(
+                f"rescale: no operator snapshot for process {p} "
+                f"(expected {old_processes} quiesced snapshots)"
+            )
+        payloads.append(payload)
+    for p, payload in enumerate(payloads):
+        fmt = payload.get("format", 1)
+        if fmt != STATE_FORMAT:
+            raise ValueError(
+                f"rescale: process {p} snapshot has state format {fmt}; "
+                f"this build writes format {STATE_FORMAT}"
+            )
+    t_common = min(int(pl.get("time", 0)) for pl in payloads)
+    for p, payload in enumerate(payloads):
+        if int(payload.get("time", 0)) != t_common:
+            ring = _load(f"operator-snapshot-p{p}-t{t_common}")
+            if ring is None:
+                raise ValueError(
+                    f"rescale: process {p} has no snapshot at the "
+                    f"common commit time {t_common} (ring rotated?)"
+                )
+            payloads[p] = ring
+    base = payloads[0]
+    fp = list(base.get("optimize", []))
+    for p, payload in enumerate(payloads[1:], start=1):
+        if list(payload.get("optimize", [])) != fp:
+            raise ValueError(
+                f"rescale: process {p} snapshot was written under a "
+                "different graph-optimizer plan than process 0"
+            )
+    if list(getattr(scopes[0], "_pw_opt_fingerprint", [])) != fp:
+        raise ValueError(
+            "rescale: snapshots were written under a different graph-"
+            "optimizer plan than this process applies — rerun with the "
+            "same PATHWAY_TPU_OPTIMIZE setting"
+        )
+    full_sig = [type(n).__name__ for n in scopes[0].nodes]
+    if base["sigs"][0] != full_sig:
+        raise ValueError(
+            "rescale: operator snapshot does not match this graph "
+            "(operator sequence changed); clear the persistence "
+            "location instead of rescaling across code changes"
+        )
+    shared_sig = full_sig[:n_shared]
+    for p, payload in enumerate(payloads[1:], start=1):
+        if payload["sigs"][0][: len(shared_sig)] != shared_sig:
+            raise ValueError(
+                f"rescale: process {p} snapshot does not match the "
+                "shared graph prefix"
+            )
+
+    global_per_worker: list[list[dict]] = []
+    for payload in payloads:
+        global_per_worker.extend(payload["per_worker"])
+    virtual = [scopes[0]] * (new_processes * threads)
+    new_per_worker = _reshard_worker_states(global_per_worker, virtual)
+
+    # exact state-transfer volume: source rows are fully mirrored on old
+    # worker 0, so its key set is the authoritative row population
+    src_keys: list = []
+    for i, node in enumerate(scopes[0].nodes[:n_shared]):
+        if isinstance(node, (StaticSource, InputSession)):
+            cur = (
+                global_per_worker[0][i].get("current")
+                if i < len(global_per_worker[0])
+                else None
+            )
+            if isinstance(cur, dict):
+                src_keys.extend(cur.keys())
+    moved = reshard_moves(
+        src_keys, old_processes * threads, new_processes * threads
+    )
+
+    for q in range(new_processes):
+        states = new_per_worker[q * threads:(q + 1) * threads]
+        if q == 0:
+            sigs = [[type(n).__name__ for n in s.nodes] for s in scopes]
+            states = [states[0]] + [st[:n_shared] for st in states[1:]]
+            drivers = base.get("drivers", [])
+        else:
+            sigs = [list(shared_sig) for _ in range(threads)]
+            states = [st[:n_shared] for st in states]
+            drivers = []
+        payload = {
+            "format": STATE_FORMAT,
+            "sigs": sigs,
+            "per_worker": states,
+            "drivers": drivers,
+            "time": t_common,
+            "optimize": fp,
+        }
+        blob = _pickle.dumps(payload, protocol=4)
+        backend.write(f"operator-snapshot-p{q}", blob)
+        backend.write(f"operator-snapshot-p{q}-t{t_common}", blob)
+    for q in range(new_processes, old_processes):
+        # blank departed processes' snapshots: a later scale-OUT must
+        # never rejoin from this run's stale shard
+        backend.write(f"operator-snapshot-p{q}", b"")
+    return {
+        "old_processes": old_processes,
+        "new_processes": new_processes,
+        "threads": threads,
+        "time": t_common,
+        "source_rows": len(src_keys),
+        "moved_keys": moved,
+    }
+
+
 class ObjectStoreBackend(PersistenceBackend):
     """Persistence over an S3-shaped object store (reference:
     src/persistence/backends/s3.rs). ``client`` needs get_object/put_object/

@@ -42,6 +42,7 @@ __all__ = [
     "columnar_shards",
     "entry_shards",
     "mod_u128_bytes",
+    "reshard_moves",
     "shards_of_values",
 ]
 
@@ -130,6 +131,22 @@ def shards_of_values(values: Sequence[Any], n: int) -> np.ndarray:
         kb = hash_values_batch(rows, salt=b"shard", on_type_error="repr")
         shards[np.asarray(where, np.int64)] = mod_u128_bytes(kb, n)
     return shards
+
+
+def reshard_moves(keys: Sequence[Any], n_old: int, n_new: int) -> int:
+    """How many of ``keys`` change owners when the worker count goes
+    ``n_old`` → ``n_new`` — the state-transfer volume of a snapshot
+    re-shard (``engine/persistence.reshard_process_snapshots`` reports
+    it per rescale).  Both assignments run through
+    :func:`shards_of_values`, i.e. the exact digests live routing uses,
+    so the count is exact rather than the ``1 - n_old/n_new`` estimate
+    a consistent-hash analysis would give."""
+    if not len(keys) or n_old == n_new:
+        return 0
+    vlist = keys if isinstance(keys, list) else list(keys)
+    old = shards_of_values(vlist, n_old)
+    new = shards_of_values(vlist, n_new)
+    return int(np.count_nonzero(old != new))
 
 
 def entry_shards(rule: tuple, entries: "Sequence[tuple]", n: int) -> np.ndarray | None:

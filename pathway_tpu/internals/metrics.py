@@ -40,11 +40,31 @@ internals/runner.py) reports through the same registry:
   blocked on a full per-peer frame queue
   (``PATHWAY_TPU_MESH_QUEUE_HWM``);
 
-and the flight recorder carries the recovery lifecycle as events:
+The elastic-mesh layer (leader failover + rescale) adds:
+
+- ``pathway_mesh_epoch`` — gauge; current mesh recovery epoch (bumped
+  by every recovery and every leader election; frames stamped with an
+  older epoch are fenced);
+- ``pathway_mesh_fenced_frames_total`` — stale epoch-stamped command
+  frames rejected by the fence (zombie ex-leader or fault-injected
+  duplicates);
+- ``pathway_mesh_elections_total`` / ``pathway_mesh_election_seconds``
+  — leader elections completed after losing process 0, and their
+  detection→election-complete wall time (interim leader observes);
+- ``pathway_mesh_rescales_total`` / ``pathway_mesh_rescale_seconds``
+  — completed N→M rescales and their quiesce→relaunch wall time
+  (relaunched leader surfaces both from the supervisor's env stamps).
+
+Each family renders on the leader ``/metrics`` with exactly one
+HELP/TYPE block (the registry keys families by name).
+
+The flight recorder carries the recovery lifecycle as events:
 ``peer_dead``, ``recovery_start``, ``recovery_parked``,
 ``recovery_remesh``, ``recovery_rollback``, ``recovery_done``,
-``fault_kill`` — every surviving worker dumps its ring when a peer is
-declared dead, so a post-mortem has one JSON file per worker.
+``fault_kill``, ``leader_dead``, ``election_done``,
+``leader_failover_done``, ``fenced_frame``, ``quiesce``, ``reshard`` —
+every surviving worker dumps its ring when a peer is declared dead, so
+a post-mortem has one JSON file per worker.
 """
 
 from __future__ import annotations

@@ -1363,6 +1363,13 @@ class DistributedGraphRunner:
             MeshTransport,
         )
 
+        if os.environ.get("PATHWAY_TPU_RESHARD"):
+            # one-shot re-shard helper (MeshSupervisor rescale): the same
+            # program, launched with the NEW process count, rewrites the
+            # per-process operator snapshots instead of joining a mesh
+            return self._reshard_snapshots(
+                int(os.environ["PATHWAY_TPU_RESHARD"])
+            )
         transport = MeshTransport(
             self.process_id, self.processes, self.first_port
         )
@@ -1403,7 +1410,86 @@ class DistributedGraphRunner:
         finally:
             transport.close()
 
+    # -- rescale -------------------------------------------------------------
+
+    def _reshard_snapshots(self, old_processes: int):
+        """Re-shard the mesh's per-process operator snapshots from
+        ``old_processes`` to ``self.processes`` worker processes.
+
+        Runs in a dedicated helper child between the quiesced old mesh and
+        the relaunched new one: the graph is already built (the program ran
+        normally up to ``pw.run``), so the live routing partitioners are
+        available.  The helper applies the same graph-optimizer plan the
+        mesh would (announce_topology + _ensure_optimized inputs), so node
+        classes match the snapshot signatures."""
+        import json as _json
+
+        if self.persistence is None:
+            raise RuntimeError(
+                "PATHWAY_TPU_RESHARD requires persistence "
+                "(PersistenceMode.OPERATOR_PERSISTING)"
+            )
+        scopes = [w.scope for w in self.workers]
+        n_shared = getattr(self, "n_shared", len(scopes[0].nodes))
+        protected = set()
+        for node in scopes[0].nodes[:n_shared]:
+            for consumer, _port in node.consumers:
+                if consumer.index >= n_shared:
+                    protected.add(node.index)
+        from pathway_tpu.optimize import optimize_scopes
+
+        optimize_scopes(scopes, n_shared=n_shared, protected=protected)
+        from pathway_tpu.engine.persistence import (
+            reshard_process_snapshots,
+        )
+
+        report = reshard_process_snapshots(
+            self.persistence.backend,
+            old_processes,
+            self.processes,
+            self.threads,
+            scopes,
+            n_shared=n_shared,
+        )
+        _metrics.FLIGHT.record("reshard", **report)
+        print("PATHWAY_RESHARD_JSON " + _json.dumps(report), flush=True)
+        return None
+
     # -- fault tolerance ----------------------------------------------------
+
+    def _note_epoch(self) -> None:
+        _metrics.REGISTRY.gauge(
+            "pathway_mesh_epoch",
+            "current mesh recovery epoch (bumped by every recovery or "
+            "leader election; frames from older epochs are fenced)",
+        ).set(self._epoch)
+
+    def _report_rescale_metrics(self) -> None:
+        """A leader relaunched after ``MeshSupervisor.rescale`` carries
+        the supervisor's rescale stamps in its environment: surface them
+        as metric families on this (fresh) process's registry so the
+        leader ``/metrics`` reports the cumulative rescale history."""
+        try:
+            rescales = int(os.environ.get("PATHWAY_TPU_RESCALED", "0"))
+        except ValueError:
+            rescales = 0
+        if rescales <= 0:
+            return
+        _metrics.REGISTRY.counter(
+            "pathway_mesh_rescales_total",
+            "completed N->M mesh rescales (quiesce + re-shard + relaunch)",
+        ).inc(rescales)
+        try:
+            wall = float(os.environ.get("PATHWAY_TPU_RESCALE_WALL_S", ""))
+        except ValueError:
+            wall = None
+        if wall is not None:
+            _metrics.REGISTRY.histogram(
+                "pathway_mesh_rescale_seconds",
+                "wall time of the most recent rescale, quiesce request "
+                "to relaunch",
+                buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+            ).observe(wall)
 
     def _snapshot_manager(self):
         """Per-process operator snapshot manager, or None when persistence
@@ -1492,6 +1578,7 @@ class DistributedGraphRunner:
         t0 = _time.monotonic()
         self._epoch += 1
         epoch = self._epoch
+        self._note_epoch()
         _metrics.FLIGHT.record(
             "peer_dead", peer=dead_peer, time=sched.time, epoch=epoch
         )
@@ -1564,12 +1651,18 @@ class DistributedGraphRunner:
         snapshot_mgr = self._snapshot_manager()
         recovery = self._recovery_enabled(snapshot_mgr)
         fault_plan = self._fault_plan()
+        self._report_rescale_metrics()
+        common = -1
         if snapshot_mgr is not None:
             # startup rejoin protocol: collect every follower's latest
             # snapshot time, roll the whole mesh back to the oldest
             # common commit, then barrier — a plain cold start runs the
-            # same path with T = -1
+            # same path with T = -1.  Rejoin frames carry each survivor's
+            # mesh epoch: a leader restarted after failover must resume
+            # ABOVE the epochs the survivors advanced to, or its rollback
+            # command would be rejected by their fences as a zombie's.
             times = [snapshot_mgr.latest_time()]
+            peer_epochs = [0]
             for peer in sorted(sched._outbox):
                 frame = transport.recv(peer)
                 if not (
@@ -1582,14 +1675,75 @@ class DistributedGraphRunner:
                         f"got {frame!r}"
                     )
                 times.append(frame[1])
+                peer_epochs.append(
+                    int(frame[2]) if len(frame) >= 3 else 0
+                )
             common = min(
                 (t if t is not None else -1) for t in times
             )
+            self._epoch = max([self._epoch] + peer_epochs) + 1
+            self._note_epoch()
             transport.broadcast(("cmd", "rollback", common, self._epoch))
+            sched.fence.admit("rollback", self._epoch)
             sched.rollback(common, snapshot_mgr, drivers)
+            # the resumed sink files may carry commits newer than the
+            # mesh's last COMMON snapshot (a cold restart lost them):
+            # truncate so re-driven commits land exactly once
+            self._rewind_sinks(common)
             sched.resync(self._epoch)
-        transport.broadcast(("cmd", "commit"))
-        sched.commit_local()
+        quiesce_path = None
+        sup_dir = os.environ.get("PATHWAY_TPU_SUPERVISOR_DIR")
+        if sup_dir and snapshot_mgr is not None:
+            quiesce_path = os.path.join(sup_dir, "quiesce")
+
+        def maybe_quiesce(committed_time: int | None) -> None:
+            """Service a supervisor rescale request: stop at a commit
+            boundary, force a durable snapshot of it on every process,
+            and exit with the quiesce code so the supervisor can re-shard
+            and relaunch."""
+            if quiesce_path is None or not os.path.exists(quiesce_path):
+                return
+            from pathway_tpu.engine.supervisor import EXIT_QUIESCED
+
+            try:
+                if committed_time is None:
+                    # idle stream: every polled row has been committed
+                    # (on_data commits per poll batch), so the current
+                    # state IS the state at the last commit — quiesce
+                    # there rather than cutting an empty commit, which
+                    # would shift later commit timestamps off the
+                    # uninterrupted run's and break sink bit-identity.
+                    # sched.time is the NEXT commit's stamp; the last
+                    # committed boundary is one behind it.
+                    committed_time = sched.time - 1
+                transport.broadcast(("cmd", "quiesce", committed_time))
+            except PeerLostError:
+                # a peer died mid-quiesce: skip this attempt and let the
+                # ordinary recovery paths run — the marker file stays, so
+                # quiesce retries at the next boundary after recovery
+                return
+            snapshot_mgr.snapshot(sched.scopes, drivers, committed_time)
+            _metrics.FLIGHT.record(
+                "quiesce", time=committed_time, process=self.process_id
+            )
+            _metrics.FLIGHT.dump("quiesced for rescale")
+            raise SystemExit(EXIT_QUIESCED)
+
+        if common < 0:
+            # fresh start: the initial barrier commit establishes time 1
+            # and flushes static sources.  A mesh RESUMED from a common
+            # snapshot must skip it — the restored state is already at
+            # the rollback boundary, and an extra (empty) commit here
+            # would shift every later commit timestamp off the
+            # uninterrupted run's numbering, breaking sink bit-identity.
+            transport.broadcast(("cmd", "commit"))
+            barrier_time = sched.commit_local()
+            if snapshot_mgr is not None:
+                # followers snapshot EVERY commit (including this one);
+                # the leader must too, or a worker that dies before the
+                # first data commit forces a rollback to a boundary the
+                # leader cannot restore
+                snapshot_mgr.on_commit(sched.scopes, drivers, barrier_time)
         last_sign_of_life = _time.monotonic()
 
         def on_data() -> None:
@@ -1620,6 +1774,7 @@ class DistributedGraphRunner:
                 w0._sync_monitor_connectors()
                 self.monitor.on_commit(time, started)
             last_sign_of_life = started
+            maybe_quiesce(time)
 
         # pings must always undercut the followers' recv timeout, or a
         # quiet stream trips spurious peer-crash errors
@@ -1639,6 +1794,7 @@ class DistributedGraphRunner:
                 )
                 last_sign_of_life = _time.monotonic()
                 return
+            maybe_quiesce(None)
             # keep follower recv timeouts from tripping during long quiet
             # stretches of a streaming run
             if _time.monotonic() - last_sign_of_life > ping_every:
@@ -1663,10 +1819,19 @@ class DistributedGraphRunner:
         if snapshot_mgr is not None:
             latest = snapshot_mgr.latest_time()
             transport.send(
-                0, ("rejoin", latest if latest is not None else -1)
+                0,
+                ("rejoin", latest if latest is not None else -1,
+                 self._epoch),
             )
         while True:
-            frame = transport.recv(0)  # leader-link loss is fatal here
+            try:
+                frame = transport.recv(0)
+            except PeerLostError:
+                # the leader itself died or hung: dump forensics and —
+                # with recovery on — elect an interim leader, take over
+                # its duties, and rejoin its restarted successor
+                self._leader_failover(sched, transport, snapshot_mgr)
+                continue
             kind = frame[0]
             if kind != "cmd":
                 raise RuntimeError(
@@ -1683,9 +1848,24 @@ class DistributedGraphRunner:
                 try:
                     time = sched.commit_local()
                 except PeerLostError as exc:
-                    if not recovery or exc.peer is None or exc.peer == 0:
+                    if exc.peer == 0 or 0 in transport.dead_peers:
+                        self._leader_failover(
+                            sched, transport, snapshot_mgr
+                        )
+                        continue
+                    if not recovery or exc.peer is None:
                         raise
-                    self._park_for_recovery(sched, transport, exc.peer)
+                    try:
+                        self._park_for_recovery(sched, transport, exc.peer)
+                    except PeerLostError as parked:
+                        # the leader died while this survivor was parked
+                        # waiting for its recovery command
+                        if parked.peer == 0 or 0 in transport.dead_peers:
+                            self._leader_failover(
+                                sched, transport, snapshot_mgr
+                            )
+                        else:
+                            raise
                     continue
                 if snapshot_mgr is not None:
                     snapshot_mgr.on_commit(sched.scopes, [], time)
@@ -1694,7 +1874,10 @@ class DistributedGraphRunner:
             elif cmd == "recover":
                 # a peer died; this follower survived without noticing
                 # (or already parked — _park_for_recovery consumed the
-                # command and re-meshed; this branch is the idle path)
+                # command and re-meshed; this branch is the idle path).
+                # Fencing makes fault-injected duplicates no-ops.
+                if not sched.fence.admit("recover", frame[3]):
+                    continue
                 _dead = frame[2]
                 _metrics.FLIGHT.record(
                     "peer_dead",
@@ -1710,8 +1893,24 @@ class DistributedGraphRunner:
                     "recovery_remesh", peer=_dead, epoch=frame[3]
                 )
             elif cmd == "rollback":
+                # a re-processed rollback would deadlock in resync, so a
+                # zombie ex-leader's (or a duplicated) command is fenced
+                if not sched.fence.admit("rollback", frame[3]):
+                    continue
+                self._epoch = max(self._epoch, int(frame[3]))
+                self._note_epoch()
                 sched.rollback(frame[2], snapshot_mgr, [])
                 sched.resync(frame[3])
+            elif cmd == "quiesce":
+                from pathway_tpu.engine.supervisor import EXIT_QUIESCED
+
+                if snapshot_mgr is not None:
+                    snapshot_mgr.snapshot(sched.scopes, [], frame[2])
+                _metrics.FLIGHT.record(
+                    "quiesce", time=frame[2], process=self.process_id
+                )
+                _metrics.FLIGHT.dump("quiesced for rescale")
+                raise SystemExit(EXIT_QUIESCED)
             elif cmd == "finish":
                 sched.finish_local()
                 if snapshot_mgr is not None:
@@ -1719,6 +1918,162 @@ class DistributedGraphRunner:
                 return
             else:
                 raise RuntimeError(f"unknown coordinator command {cmd!r}")
+
+    def _leader_failover(self, sched, transport, snapshot_mgr) -> None:
+        """Follower-side response to losing the leader (process 0).
+
+        Every survivor dumps its flight ring first — leader loss must
+        leave forensics whether or not failover is possible.  With
+        recovery off that is the whole story: fail-stop, and the
+        supervisor reports EXIT_LEADER_LOST.
+
+        With recovery on, survivors run a deterministic epoch-stamped
+        election: the lowest live rank becomes the *interim leader* and
+        takes over the leader-only duties that cannot wait for the
+        restart — the supervisor kill request (a HUNG ex-leader must
+        actually die before its successor can bind the exchange port)
+        and the aggregation of survivor metrics snapshots.  Everyone
+        then re-meshes toward the supervisor-restarted process 0,
+        re-runs the topology handshake against it, and sends an
+        epoch-stamped rejoin; the restarted leader resumes coordination
+        (rollback to the last common commit) above the survivors'
+        epoch, so any frame a zombie ex-leader manages to flush is
+        rejected by the epoch fence (and its replaced socket).  A
+        cascading survivor death during the window fail-stops on the
+        election deadline."""
+        import time as _time
+
+        from pathway_tpu.engine.distributed import (
+            PeerLostError,
+            elect_leader,
+        )
+
+        recovery = self._recovery_enabled(snapshot_mgr)
+        last_seen = getattr(transport, "last_seen", {}).get(0)
+        _metrics.FLIGHT.record(
+            "leader_dead",
+            process=self.process_id,
+            time=sched.time,
+            epoch=self._epoch,
+            recovery=recovery,
+            # silence on the leader link before it was declared dead —
+            # the detection latency (suspicion timeout or socket close)
+            detect_s=(
+                None
+                if last_seen is None
+                else round(_time.monotonic() - last_seen, 6)
+            ),
+        )
+        _metrics.FLIGHT.dump("leader (process 0) lost")
+        if not recovery:
+            raise PeerLostError(
+                f"process {self.process_id}: leader (process 0) lost "
+                "and recovery is disabled — fail-stop (flight ring "
+                "dumped)",
+                peer=0,
+            )
+        t0 = _time.monotonic()
+        deadline = self._recover_deadline()
+        end = t0 + deadline
+        survivors = sorted(
+            p
+            for p in range(self.processes)
+            if p != 0 and p not in transport.dead_peers
+        )
+        epoch = self._epoch + 1
+        interim = elect_leader(survivors)
+        others = [p for p in survivors if p != self.process_id]
+        latest = snapshot_mgr.latest_time()
+        latest = -1 if latest is None else latest
+        if self.process_id == interim:
+            for peer in others:
+                transport.send(peer, ("elect", epoch, interim))
+            rejoin_times = [latest]
+            for peer in others:
+                # collect the survivor's ack, absorbing round/abort
+                # debris its broken commit may have left on the link
+                while True:
+                    remaining = max(0.1, end - _time.monotonic())
+                    frame = transport.recv(peer, timeout=remaining)
+                    if (
+                        isinstance(frame, tuple)
+                        and len(frame) >= 4
+                        and frame[0] == "elect-ack"
+                        and frame[1] == epoch
+                    ):
+                        break
+                rejoin_times.append(frame[2])
+                if frame[3] is not None:
+                    sched.mesh_metrics[peer] = frame[3]
+            self._request_kill(0)
+            _metrics.REGISTRY.counter(
+                "pathway_mesh_elections_total",
+                "leader elections completed after losing process 0",
+            ).inc(1)
+            _metrics.REGISTRY.histogram(
+                "pathway_mesh_election_seconds",
+                "leader-loss detection to election-complete wall time",
+                buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0),
+            ).observe(_time.monotonic() - t0)
+            _metrics.FLIGHT.record(
+                "election_done",
+                interim=interim,
+                epoch=epoch,
+                survivors=survivors,
+                rollback_target=min(rejoin_times),
+                wall_s=round(_time.monotonic() - t0, 6),
+            )
+        else:
+            while True:
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    raise PeerLostError(
+                        f"process {self.process_id}: no election from "
+                        f"interim leader {interim} within {deadline:g}s "
+                        "of losing the leader — fail-stop",
+                        peer=interim,
+                    )
+                try:
+                    frame = transport.recv(
+                        interim, timeout=min(remaining, 1.0)
+                    )
+                except PeerLostError:
+                    if interim in transport.dead_peers:
+                        raise  # cascade: the interim died too
+                    continue  # poll timeout: keep waiting
+                if (
+                    isinstance(frame, tuple)
+                    and len(frame) >= 3
+                    and frame[0] == "elect"
+                    and frame[1] > self._epoch
+                ):
+                    epoch = int(frame[1])
+                    break
+            transport.send(
+                interim,
+                ("elect-ack", epoch, latest,
+                 sched._metrics_snapshot()),
+            )
+        self._epoch = epoch
+        self._note_epoch()
+        sched.fence.admit("elect", epoch)
+        # re-mesh toward the restarted process 0 and re-run the startup
+        # handshake; the normal follow loop takes the rollback from there
+        transport.reestablish(
+            0, deadline=max(1.0, end - _time.monotonic())
+        )
+        sched.receive_topology()
+        transport.send(0, ("rejoin", latest, self._epoch))
+        _metrics.FLIGHT.record(
+            "leader_failover_done",
+            process=self.process_id,
+            epoch=self._epoch,
+            wall_s=round(_time.monotonic() - t0, 6),
+        )
+        # second dump so the on-disk forensics cover the whole failover
+        # lifecycle (the first dump happened at leader_dead, before the
+        # election outcome existed)
+        _metrics.FLIGHT.dump("leader failover complete")
 
     def _park_for_recovery(self, sched, transport, dead_peer: int) -> None:
         """Survivor path when a peer dies MID-COMMIT: dump forensics, then
@@ -1745,11 +2100,18 @@ class DistributedGraphRunner:
             if frame is not None:
                 if (
                     isinstance(frame, tuple)
-                    and len(frame) >= 3
+                    and len(frame) >= 4
                     and frame[0] == "cmd"
                     and frame[1] == "recover"
                 ):
-                    break
+                    # a duplicated (fault-injected or zombie-leader)
+                    # recover from an already-handled epoch is fenced;
+                    # a fresh one advances the fence so the idle-path
+                    # handler won't re-run it
+                    if sched.fence.admit("recover", frame[3]):
+                        break
+                    frame = None
+                    continue
                 # stale commit/round debris from the aborted exchange
                 frame = None
             remaining = end - _time.monotonic()

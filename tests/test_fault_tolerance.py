@@ -87,12 +87,17 @@ CHAOS_PROGRAM = """
 
 def _run_chaos(
     tmp_path, tag: str, *, processes: int = 3, n_files: int = 7,
-    extra_env: dict | None = None,
+    extra_env: dict | None = None, mid=None, port_span: int | None = None,
 ):
     """Spawn the chaos program, pace input one file per commit (file k+1
     is written only after file k's rows reach the sink — both the faulted
     and the fault-free timeline see the same commit boundaries), stop the
-    stream, and return (sink bytes, metrics exposition text)."""
+    stream, and return (sink bytes, metrics exposition text).
+
+    ``mid=(k, fn)`` invokes ``fn()`` right after file ``k`` reaches the
+    sink — the hook the rescale tests use to file a live rescale request
+    mid-stream.  ``port_span`` reserves more ports than ``processes``
+    when the mesh will scale OUT past its launch size."""
     indir = tmp_path / f"in-{tag}"
     indir.mkdir()
     out = tmp_path / f"out-{tag}.csv"
@@ -125,7 +130,7 @@ def _run_chaos(
             [str(prog)],
             threads=1,
             processes=processes,
-            first_port=_free_port_base(processes),
+            first_port=_free_port_base(port_span or processes),
             env=env,
         )
 
@@ -151,6 +156,8 @@ def _run_chaos(
                     f"file {k} never reached the sink (rc="
                     f"{result.get('rc')})"
                 )
+            if mid is not None and k == mid[0]:
+                mid[1]()
         stop.write_text("")
         th.join(timeout=90)
     finally:
@@ -171,6 +178,28 @@ def _canonical(sink_bytes: bytes) -> list[bytes]:
     runs already — the recovery guarantee is over the timestamped
     content, not socket scheduling."""
     return sorted(sink_bytes.splitlines())
+
+
+@pytest.fixture(scope="module")
+def chaos_baseline(tmp_path_factory):
+    """ONE fault-free 3-process reference run shared by every elastic-mesh
+    test in this module.  Sharing is sound because the pacing protocol
+    pins commit timestamps (file k lands in the same commit in every run)
+    and the delta content is worker-count independent — so the same
+    canonical sink is the oracle for leader failover, rescale (either
+    direction), cold restart, and the soak matrix."""
+    tmp = tmp_path_factory.mktemp("chaos-shared")
+    sink, _ = _run_chaos(tmp, "shared-baseline")
+    return _canonical(sink)
+
+
+def _metric_total(metrics_text: str, family: str) -> float:
+    """Sum of all samples of ``family`` in a /metrics exposition."""
+    return sum(
+        float(line.rsplit(" ", 1)[1])
+        for line in metrics_text.splitlines()
+        if line.startswith(family) and not line.startswith("#")
+    )
 
 
 def test_kill_one_worker_recovers_bit_identical(tmp_path):
@@ -238,6 +267,227 @@ def test_fault_plan_frame_delay_dup_drop_tolerated(tmp_path):
         extra_env={"PATHWAY_TPU_FAULT_PLAN": plan},
     )
     assert _canonical(faulted) == _canonical(baseline)
+
+
+def test_leader_kill_fails_over_bit_identical(tmp_path, chaos_baseline):
+    """SIGKILL the LEADER (process 0) at a commit boundary: every
+    survivor dumps its flight recorder, the lowest-rank live worker is
+    elected interim leader (taking over metrics aggregation and the
+    supervisor kill request), the dead epoch is fenced, and the restarted
+    process 0 rejoins via rollback — sink bit-identical to the
+    fault-free run."""
+    flight_dir = tmp_path / "flight-leader"
+    flight_dir.mkdir()
+    plan = json.dumps(
+        {"seed": 13, "faults": [
+            {"type": "kill", "process": 0, "at_commit": 3},
+        ]}
+    )
+    faulted, metrics_text = _run_chaos(
+        tmp_path,
+        "leaderkill",
+        extra_env={
+            "PATHWAY_TPU_RECOVER": "1",
+            "PATHWAY_TPU_MAX_RESTARTS": "4",
+            "PATHWAY_TPU_FAULT_PLAN": plan,
+            "PATHWAY_TPU_FLIGHT_DIR": str(flight_dir),
+        },
+    )
+    assert _canonical(faulted) == chaos_baseline, (
+        "failed-over run's sink differs from the fault-free run"
+    )
+    # the restarted leader adopts an epoch above every survivor fence and
+    # announces it as a gauge
+    assert _metric_total(metrics_text, "pathway_mesh_epoch") >= 1
+    dumps = list(flight_dir.glob("pathway_flight_*.json"))
+    assert dumps, "survivors did not dump flight recorders on leader death"
+    merged = "".join(p.read_text() for p in dumps)
+    assert "leader_dead" in merged
+    assert "election_done" in merged
+    assert "leader_failover_done" in merged
+
+
+def test_total_kill_cold_restart_exactly_once(tmp_path, chaos_baseline):
+    """A wildcard kill fault takes the WHOLE mesh down at one commit; the
+    supervisor restarts every slot, the restarted mesh rolls back to the
+    last common snapshot, and the durable sink sidecar truncates the
+    uncommitted tail — exactly-once output, bit for bit."""
+    plan = json.dumps(
+        {"seed": 17, "faults": [
+            {"type": "kill", "process": "*", "at_commit": 4},
+        ]}
+    )
+    faulted, metrics_text = _run_chaos(
+        tmp_path,
+        "totalkill",
+        extra_env={
+            "PATHWAY_TPU_RECOVER": "1",
+            "PATHWAY_TPU_MAX_RESTARTS": "8",
+            "PATHWAY_TPU_FAULT_PLAN": plan,
+        },
+    )
+    assert _canonical(faulted) == chaos_baseline, (
+        "cold-restarted run's sink differs from the fault-free run"
+    )
+    assert _metric_total(metrics_text, "pathway_mesh_epoch") >= 1
+
+
+def test_faults_during_in_progress_recovery_bit_identical(
+    tmp_path, chaos_baseline
+):
+    """Frame-level faults landing INSIDE a recovery window: the restarted
+    worker's rejoin is duplicated (absorbed as fenced debris), the
+    leader's recovery-era command frames are delayed, and the
+    survivor-to-survivor exchange link takes a synthetic RST around the
+    recovery resync.  The mesh still converges to the fault-free sink
+    with at least one completed recovery on /metrics."""
+    plan = json.dumps(
+        {"seed": 11, "faults": [
+            {"type": "kill", "process": 1, "at_commit": 3},
+            {"type": "dup", "process": 1, "kind": "rejoin", "count": 1},
+            {"type": "delay", "process": 0, "kind": "cmd", "peer": 2,
+             "count": 3, "ms": 60, "after_sends": 3},
+            {"type": "reset", "process": 2, "peer": 1, "after_sends": 5},
+        ]}
+    )
+    faulted, metrics_text = _run_chaos(
+        tmp_path,
+        "recoveryfaults",
+        extra_env={
+            "PATHWAY_TPU_RECOVER": "1",
+            "PATHWAY_TPU_MAX_RESTARTS": "4",
+            "PATHWAY_TPU_FAULT_PLAN": plan,
+        },
+    )
+    assert _canonical(faulted) == chaos_baseline
+    assert _metric_total(metrics_text, "pathway_mesh_recoveries_total") >= 1
+
+
+def test_rescale_scale_in_bit_identical(tmp_path, chaos_baseline):
+    """Live 3 → 2 rescale mid-stream via the CLI request file: the
+    supervisor quiesces the mesh at a commit boundary, re-shards the
+    operator snapshots through the routing kernels, and relaunches at the
+    new size — sink bit-identical, rescale visible on /metrics."""
+    from pathway_tpu.cli import rescale as cli_rescale
+
+    sup_dir = tmp_path / "sup-in"
+
+    def request():
+        assert cli_rescale(2, supervisor_dir=str(sup_dir)) == 0
+
+    resized, metrics_text = _run_chaos(
+        tmp_path,
+        "scalein",
+        processes=3,
+        extra_env={
+            "PATHWAY_TPU_RECOVER": "1",
+            "PATHWAY_TPU_SUPERVISOR_DIR": str(sup_dir),
+        },
+        mid=(2, request),
+    )
+    assert _canonical(resized) == chaos_baseline, (
+        "scale-in run's sink differs from the uninterrupted run"
+    )
+    assert _metric_total(metrics_text, "pathway_mesh_rescales_total") >= 1
+
+
+def test_rescale_scale_out_bit_identical(tmp_path, chaos_baseline):
+    """Live 2 → 3 rescale mid-stream: new worker slots join with
+    re-sharded state.  Compared against the 3-process reference — valid
+    because the timestamped delta multiset is worker-count
+    independent."""
+    from pathway_tpu.cli import rescale as cli_rescale
+
+    sup_dir = tmp_path / "sup-out"
+
+    def request():
+        assert cli_rescale(3, supervisor_dir=str(sup_dir)) == 0
+
+    resized, metrics_text = _run_chaos(
+        tmp_path,
+        "scaleout",
+        processes=2,
+        port_span=3,
+        extra_env={
+            "PATHWAY_TPU_RECOVER": "1",
+            "PATHWAY_TPU_SUPERVISOR_DIR": str(sup_dir),
+        },
+        mid=(2, request),
+    )
+    assert _canonical(resized) == chaos_baseline, (
+        "scale-out run's sink differs from the uninterrupted run"
+    )
+    assert _metric_total(metrics_text, "pathway_mesh_rescales_total") >= 1
+
+
+def test_leader_death_exhausted_budget_dumps_flight_and_exit_code(tmp_path):
+    """Regression baseline for the failover path: when restarting CANNOT
+    help (restart budget 0), leader death must still produce forensics
+    from every surviving worker plus the distinct EXIT_LEADER_LOST
+    supervisor exit code — never a silent hang."""
+    from pathway_tpu.engine.supervisor import EXIT_LEADER_LOST
+
+    indir = tmp_path / "in-leaderlost"
+    indir.mkdir()
+    flight_dir = tmp_path / "flight-leaderlost"
+    flight_dir.mkdir()
+    out = tmp_path / "out-leaderlost.csv"
+    prog = tmp_path / "prog-leaderlost.py"
+    prog.write_text(
+        textwrap.dedent(
+            CHAOS_PROGRAM.format(
+                indir=str(indir),
+                out=str(out),
+                store=str(tmp_path / "store-leaderlost"),
+                stop=str(tmp_path / "stop-leaderlost"),
+                metrics_out=str(tmp_path / "m-leaderlost.txt"),
+            )
+        )
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+    env["PATHWAY_TPU_MESH_TIMEOUT"] = "30"
+    env["PATHWAY_TPU_RECOVER_DEADLINE"] = "20"
+    env["PATHWAY_TPU_RECOVER"] = "1"
+    env["PATHWAY_TPU_MAX_RESTARTS"] = "0"
+    env["PATHWAY_TPU_FLIGHT_DIR"] = str(flight_dir)
+    env["PATHWAY_TPU_FAULT_PLAN"] = json.dumps(
+        {"seed": 31, "faults": [
+            {"type": "kill", "process": 0, "at_commit": 2},
+        ]}
+    )
+    result: dict = {}
+
+    def run() -> None:
+        result["rc"] = spawn(
+            sys.executable,
+            [str(prog)],
+            threads=1,
+            processes=3,
+            first_port=_free_port_base(3),
+            env=env,
+        )
+
+    th = threading.Thread(target=run)
+    th.start()
+    # pace: file 0 lands in the startup commit (time 1); file 1 commits
+    # at time 2, where the kill fault fires on the leader
+    (indir / "f0.txt").write_text("w0\ncommon\n")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and th.is_alive():
+        if out.exists() and "w0" in out.read_text():
+            break
+        time.sleep(0.05)
+    (indir / "f1.txt").write_text("w1\ncommon\n")
+    th.join(timeout=120)
+    assert not th.is_alive(), "supervisor did not terminate on leader loss"
+    assert result.get("rc") == EXIT_LEADER_LOST
+    dumps = list(flight_dir.glob("pathway_flight_*.json"))
+    assert dumps, "no survivor flight dumps on unrecoverable leader death"
+    merged = "".join(p.read_text() for p in dumps)
+    assert "leader_dead" in merged
 
 
 class _FlakyReader:
@@ -454,3 +704,245 @@ def test_supervisor_restart_budget_fail_stops(tmp_path):
     sup = _supervisor(tmp_path, die_until=99, max_restarts=1)
     assert sup.run() != 0
     assert sup.restarts == 1
+
+
+_LEADER_DEATH_SCRIPT = """
+import os, signal, time
+pid = int(os.environ["PATHWAY_PROCESS_ID"])
+if pid == 0:
+    time.sleep(0.3)
+    os.kill(os.getpid(), signal.SIGKILL)
+time.sleep(30)
+"""
+
+
+def test_supervisor_unrecovered_leader_death_exits_75(tmp_path):
+    """Without recovery, a signal-killed leader maps to the distinct,
+    documented EXIT_LEADER_LOST code (75) rather than 128+9, so triage
+    can tell 'leader lost' from 'a worker crashed'."""
+    from pathway_tpu.engine.supervisor import EXIT_LEADER_LOST, MeshSupervisor
+
+    prog = tmp_path / "leader_death.py"
+    prog.write_text(_LEADER_DEATH_SCRIPT)
+    env = dict(os.environ)
+    env.pop("PATHWAY_TPU_RECOVER", None)
+    sup = MeshSupervisor(
+        sys.executable,
+        [str(prog)],
+        threads=1,
+        processes=2,
+        first_port=_free_port_base(2),
+        env=env,
+        max_restarts=3,
+    )
+    assert sup.run() == EXIT_LEADER_LOST
+
+
+def test_supervisor_rescale_request_file_roundtrip(tmp_path, monkeypatch):
+    """``MeshSupervisor.rescale`` and the CLI write the same request file
+    the supervisor polls; the CLI validates its inputs."""
+    from pathway_tpu.cli import rescale as cli_rescale
+    from pathway_tpu.engine.supervisor import RESCALE_REQUEST
+
+    sup_dir = tmp_path / "supdir"
+    sup_dir.mkdir()
+    assert cli_rescale(4, supervisor_dir=str(sup_dir)) == 0
+    assert (sup_dir / RESCALE_REQUEST).read_text().strip() == "4"
+    assert cli_rescale(0, supervisor_dir=str(sup_dir)) == 2
+    assert cli_rescale(3, supervisor_dir=str(tmp_path / "missing")) == 2
+    monkeypatch.delenv("PATHWAY_TPU_SUPERVISOR_DIR", raising=False)
+    assert cli_rescale(3, supervisor_dir=None) == 2  # no dir anywhere
+
+
+def test_mesh_knob_contradiction_warns_pwf001(monkeypatch):
+    """The send-retry backoff ceiling and the suspicion timeout are tuned
+    by independent env knobs; a ceiling at or above the suspicion window
+    means a retrying sender can be declared hung MID-RETRY — flagged at
+    mesh startup as a structured PWF001 warning."""
+    from pathway_tpu.engine import distributed as d
+
+    monkeypatch.setenv("PATHWAY_TPU_MESH_SUSPICION", "1")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_SEND_RETRIES", "4")
+    with pytest.warns(d.MeshConfigWarning, match="PWF001"):
+        found = d.validate_mesh_knobs(_force=True)
+    assert [w.code for w in found] == ["PWF001"]
+    assert "suspicion" in str(found[0])
+
+    monkeypatch.setenv("PATHWAY_TPU_MESH_SUSPICION", "60")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_SEND_RETRIES", "2")
+    assert d.validate_mesh_knobs(_force=True) == []
+
+
+def test_retry_backoff_ceiling_monotone():
+    from pathway_tpu.engine.distributed import retry_backoff_ceiling_s
+
+    assert retry_backoff_ceiling_s(0) == 0.0
+    assert retry_backoff_ceiling_s(3) > retry_backoff_ceiling_s(1) > 0.0
+
+
+def test_epoch_fence_rejects_stale_and_tracks_floor():
+    from pathway_tpu.engine.distributed import EpochFence
+
+    fence = EpochFence()
+    assert fence.floor("rollback") == -1
+    assert fence.admit("rollback", 0)
+    assert not fence.admit("rollback", 0)  # exact duplicate
+    assert not fence.admit("rollback", -1)  # zombie ex-leader frame
+    assert fence.admit("rollback", 3)
+    assert fence.floor("rollback") == 3
+    assert fence.admit("elect", 1)  # kinds fence independently
+
+
+def test_elect_leader_lowest_rank_deterministic():
+    from pathway_tpu.engine.distributed import elect_leader
+
+    assert elect_leader({2, 1, 3}) == 1
+    assert elect_leader([5]) == 5
+    with pytest.raises(ValueError, match="empty mesh"):
+        elect_leader(set())
+
+
+def test_fault_plan_wildcard_process_matches_all():
+    from pathway_tpu.engine.faults import FaultPlan
+
+    plan = FaultPlan(
+        {"faults": [{"type": "drop", "process": "*", "kind": "hb",
+                     "count": 9}]}
+    )
+    fault = plan.faults[0]
+    assert fault.process == -1
+    assert fault.matches_process(0)
+    assert fault.matches_process(7)
+    plan = FaultPlan(
+        {"faults": [{"type": "kill", "process": "all", "at_commit": 2}]}
+    )
+    assert plan.faults[0].process == -1
+
+
+def test_reshard_moves_counts_ownership_changes():
+    from pathway_tpu.engine.routing import reshard_moves, shards_of_values
+
+    keys = [f"key-{i}" for i in range(64)]
+    assert reshard_moves(keys, 3, 3) == 0
+    assert reshard_moves([], 2, 3) == 0
+    moved = reshard_moves(keys, 2, 3)
+    import numpy as np
+
+    expect = int(
+        np.count_nonzero(
+            shards_of_values(keys, 2) != shards_of_values(keys, 3)
+        )
+    )
+    assert moved == expect
+    assert 0 < moved < len(keys)
+
+
+def test_elastic_metric_families_render_one_help_block_each():
+    """The new elastic-mesh families each render exactly one HELP/TYPE
+    block on an exposition — the acceptance bar for the leader /metrics
+    page."""
+    from pathway_tpu.internals import metrics as m
+
+    m.REGISTRY.gauge("pathway_mesh_epoch", "current mesh epoch").set(2)
+    m.REGISTRY.counter(
+        "pathway_mesh_rescales_total", "completed live rescales"
+    ).inc(1)
+    m.REGISTRY.counter(
+        "pathway_mesh_elections_total", "completed leader elections"
+    ).inc(1)
+    m.REGISTRY.counter(
+        "pathway_mesh_fenced_frames_total",
+        "stale epoch-stamped control frames rejected by fencing",
+    ).inc(1)
+    m.REGISTRY.histogram(
+        "pathway_mesh_election_seconds",
+        "leader election wall time",
+        buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60),
+    ).observe(0.02)
+    m.REGISTRY.histogram(
+        "pathway_mesh_rescale_seconds",
+        "live rescale wall time",
+        buckets=(0.5, 1, 2.5, 5, 10, 30, 60, 120),
+    ).observe(1.2)
+    text = m.render_snapshots({"": m.full_snapshot()})
+    for family in (
+        "pathway_mesh_epoch",
+        "pathway_mesh_rescales_total",
+        "pathway_mesh_elections_total",
+        "pathway_mesh_fenced_frames_total",
+        "pathway_mesh_election_seconds",
+        "pathway_mesh_rescale_seconds",
+    ):
+        assert text.count(f"# HELP {family} ") == 1, family
+        assert text.count(f"# TYPE {family} ") == 1, family
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: seed matrix over fault kind × target × phase
+# ---------------------------------------------------------------------------
+
+_SOAK_LEGS = [
+    # (tag, seed, faults, rescale_to) — kill/drop/delay/dup ×
+    # {leader, follower} × {steady, during-rescale}; every leg must land
+    # the exact fault-free sink (the exactly-once invariant).
+    ("kill-follower-steady", 21,
+     [{"type": "kill", "process": 1, "at_commit": 3}], None),
+    ("kill-leader-steady", 22,
+     [{"type": "kill", "process": 0, "at_commit": 4}], None),
+    ("drop-follower-steady", 23,
+     [{"type": "drop", "process": 2, "kind": "hb", "count": 3}], None),
+    ("delay-leader-steady", 24,
+     [{"type": "delay", "process": 0, "kind": "cmd", "count": 3,
+       "ms": 60}], None),
+    ("dup-follower-steady", 25,
+     [{"type": "dup", "process": 1, "kind": "round", "count": 2}], None),
+    ("kill-follower-during-rescale", 26,
+     [{"type": "kill", "process": 2, "at_commit": 4}], 2),
+    ("kill-leader-during-rescale", 27,
+     [{"type": "kill", "process": 0, "at_commit": 4}], 2),
+    ("delay-follower-during-rescale", 28,
+     [{"type": "delay", "process": 1, "kind": "round", "count": 3,
+       "ms": 60}], 2),
+    ("dup-leader-during-rescale", 29,
+     [{"type": "dup", "process": 0, "kind": "hb", "count": 2}], 2),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "tag,seed,faults,rescale_to",
+    _SOAK_LEGS,
+    ids=[leg[0] for leg in _SOAK_LEGS],
+)
+def test_chaos_soak_matrix(
+    tmp_path, chaos_baseline, tag, seed, faults, rescale_to
+):
+    """Seed-matrix chaos soak: ≥8 FaultPlan seeds across fault kind,
+    fault target, and mesh phase.  A leg that requests a rescale races
+    the quiesce against the fault on purpose — whichever interleaving
+    the scheduler produces (rescale completes first, fault aborts the
+    quiesce, or the fault hits the resized mesh), the sink must equal
+    the fault-free reference."""
+    from pathway_tpu.cli import rescale as cli_rescale
+
+    sup_dir = tmp_path / f"sup-{tag}"
+    extra = {
+        "PATHWAY_TPU_RECOVER": "1",
+        "PATHWAY_TPU_MAX_RESTARTS": "8",
+        "PATHWAY_TPU_FAULT_PLAN": json.dumps(
+            {"seed": seed, "faults": faults}
+        ),
+    }
+    mid = None
+    if rescale_to is not None:
+        extra["PATHWAY_TPU_SUPERVISOR_DIR"] = str(sup_dir)
+
+        def request():
+            assert cli_rescale(rescale_to, supervisor_dir=str(sup_dir)) == 0
+
+        mid = (2, request)
+    faulted, _ = _run_chaos(tmp_path, tag, extra_env=extra, mid=mid)
+    assert _canonical(faulted) == chaos_baseline, (
+        f"soak leg {tag!r} (seed {seed}) violated the exactly-once "
+        "sink invariant"
+    )

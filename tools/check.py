@@ -17,10 +17,12 @@ problem):
    plane fully on (per-operator probes + StatsMonitor + latency
    histogram + flight recorder) vs fully off; FAILs when the overhead
    exceeds 5% (observability must be effectively free);
-5. chaos smoke — a real 3-process TCP mesh with operator persistence
-   and a fault-injected SIGKILL of a non-leader worker mid-stream must
-   recover (supervised restart + snapshot rollback) to the exact
-   fault-free sink, within a bounded wall budget;
+5. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
+   mesh with operator persistence: a follower SIGKILL (supervised
+   restart + rollback), a LEADER SIGKILL (epoch-fenced election
+   failover), and a SIGKILL injected while a live N→M rescale is
+   quiescing; every leg must land the exact fault-free sink, within a
+   bounded wall budget;
 6. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
@@ -283,12 +285,30 @@ def step_sanitized_native() -> str:
     return PASS
 
 
-def step_chaos_smoke() -> str:
-    """Fast fault-tolerance smoke: a real 3-process TCP mesh with one
-    fault-injected SIGKILL mid-stream must recover to the fault-free
-    sink (tests/test_fault_tolerance.py kill test), under a bounded
-    wall budget."""
-    name = "chaos smoke (kill + recover, 3-process mesh)"
+#: the chaos gate's three fixed-seed legs — one follower kill (seed 7),
+#: one LEADER kill exercising election + epoch fencing (seed 13), and one
+#: kill racing a live rescale's quiesce (seed 26).  All three share one
+#: fault-free baseline (module-scoped fixture), so a single pytest
+#: invocation runs four real TCP meshes.
+CHAOS_GATE_NODES = [
+    "tests/test_fault_tolerance.py::"
+    "test_kill_one_worker_recovers_bit_identical",
+    "tests/test_fault_tolerance.py::"
+    "test_leader_kill_fails_over_bit_identical",
+    "tests/test_fault_tolerance.py::"
+    "test_chaos_soak_matrix[kill-follower-during-rescale]",
+]
+
+CHAOS_GATE_BUDGET_S = 600
+
+
+def step_chaos_gate() -> str:
+    """Bounded-wall-time chaos gate: three fixed FaultPlan seeds over a
+    real 3-process TCP mesh with operator persistence — follower kill +
+    supervised recovery, leader kill + election failover, and a kill
+    injected while a live rescale is quiescing.  Every leg must land the
+    exact fault-free sink."""
+    name = "chaos gate (3 fixed seeds: kill / leader-kill / rescale+kill)"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         proc = subprocess.run(
@@ -296,8 +316,7 @@ def step_chaos_smoke() -> str:
                 sys.executable,
                 "-m",
                 "pytest",
-                "tests/test_fault_tolerance.py::"
-                "test_kill_one_worker_recovers_bit_identical",
+                *CHAOS_GATE_NODES,
                 "-q",
                 "-p",
                 "no:cacheprovider",
@@ -306,10 +325,10 @@ def step_chaos_smoke() -> str:
             env=env,
             capture_output=True,
             text=True,
-            timeout=420,
+            timeout=CHAOS_GATE_BUDGET_S,
         )
     except subprocess.TimeoutExpired:
-        _report(name, FAIL, "wall budget (420s) exceeded")
+        _report(name, FAIL, f"wall budget ({CHAOS_GATE_BUDGET_S}s) exceeded")
         return FAIL
     if proc.returncode != 0:
         sys.stdout.write((proc.stdout + proc.stderr)[-4000:])
@@ -333,7 +352,7 @@ def main(argv=None) -> int:
         step_analyzer(),
         step_optimize_off(),
         step_metrics_overhead(),
-        step_chaos_smoke(),
+        step_chaos_gate(),
     ]
     if args.skip_sanitized:
         _report("sanitized native build + parity tests", SKIP, "--skip-sanitized")
