@@ -396,6 +396,98 @@ def metrics_overhead_leg():
     return leg
 
 
+def trace_overhead_leg():
+    """The fused_chain workload with distributed tracing at the DEFAULT
+    sampling interval vs. off — both paths run the begin/end commit
+    bracket the real runners use, so the measured delta is exactly what
+    enabling PATHWAY_TPU_TRACE=1 costs a live run.  tools/check.py FAILs
+    when the overhead exceeds 5%, the same gate as metrics_overhead."""
+    n_stages = 8
+    n_base, n_commits, delta = 20_000, 60, 1000
+    if _analyze_only():
+        n_base, n_commits = 5_000, 1
+    rows = [(ref_scalar(i), (i, float(i) * 0.5)) for i in range(n_base)]
+
+    def once(trace_on: bool) -> float:
+        from pathway_tpu.internals import tracing as _tracing
+
+        scope = Scope()
+        sess = scope.input_session(2)
+        cur = scope.expression_table(
+            sess,
+            [
+                ex.ColumnRef(0),
+                ex.ColumnRef(1),
+                ex.Binary(">", ex.ColumnRef(0), ex.Const(100)),
+            ],
+        )
+        cur = scope.filter_table(cur, 2)
+        for _ in range(n_stages):
+            cur = scope.expression_table(
+                cur,
+                [
+                    ex.ColumnRef(0),
+                    ex.Binary(
+                        "+",
+                        ex.Binary(
+                            "*", ex.ColumnRef(1), ex.Const(1.0000001)
+                        ),
+                        ex.Const(0.5),
+                    ),
+                ],
+            )
+        sched = Scheduler(scope, probe=False)
+        # default sample interval (16), fresh ring + counters per run
+        _tracing.TRACER.configure(enabled=trace_on, sample=16, clear=True)
+        try:
+            for key, row in rows:
+                sess.insert(key, row)
+            sched.commit()
+            if _analyze_only():
+                return 1.0
+            t = 0.0
+            for c in range(n_commits):
+                base = (c * delta) % (n_base - delta)
+                for i in range(base, base + delta):
+                    key, row = rows[i]
+                    sess.remove(key, row)
+                    sess.insert(key, (row[0], row[1] + 1.0))
+                # both paths run the identical bracket the runners use;
+                # with tracing off begin() is a single boolean test
+                t0 = time.perf_counter()
+                ctx = _tracing.TRACER.begin(
+                    sched.time, origin_mono=time.monotonic()
+                )
+                sched.commit()
+                if ctx is not None:
+                    _tracing.TRACER.end(sched.time - 1)
+                t += time.perf_counter() - t0
+            return t
+        finally:
+            _tracing.TRACER.configure(enabled=False, clear=True)
+
+    def leg() -> dict:
+        from pathway_tpu.internals import tracing as _tracing
+
+        # interleaved off/on pairs: machine drift during the measurement
+        # lands on both sides instead of biasing whichever ran last
+        t_off = min(once(False) for _ in range(1))
+        t_on = min(once(True) for _ in range(1))
+        for _ in range(3):
+            t_off = min(t_off, once(False))
+            t_on = min(t_on, once(True))
+        out = {
+            "rows": n_commits * 2 * delta,
+            "trace_off_s": round(t_off, 4),
+            "trace_on_s": round(t_on, 4),
+            "sample_interval": _tracing.TRACER.base_interval,
+            "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
+        }
+        return out
+
+    return leg
+
+
 def pushdown_wide_source():
     """Wide producer (12 computed columns, per-row Python UDFs), two
     narrow consumers (3 distinct columns used between them): projection
@@ -959,6 +1051,8 @@ def run_all(emit=None) -> dict:
     # observability tax: the whole metrics plane on vs off over the same
     # fused chain, plus the per-batch latency histogram's p50/p99
     record("metrics_overhead", metrics_overhead_leg()())
+    # tracing tax: sampled span recording at the default interval vs off
+    record("trace_overhead", trace_overhead_leg()())
     if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
         try:
             leg = distributed_leg()
@@ -1059,6 +1153,7 @@ def main() -> None:
         ("fused_chain", fused_chain),
         ("pushdown_wide_source", pushdown_wide_source),
         ("metrics_overhead", metrics_overhead_leg),
+        ("trace_overhead", trace_overhead_leg),
     ):
         print(json.dumps({"workload": name, **make()()}))
     # distributed leg: dtype-tagged columnar frames vs pickled row entries

@@ -301,9 +301,13 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
     # -- per-family totals ---------------------------------------------------
     print()
     name_w = max((len(n) for n in families), default=6)
-    print(f"{'family'.ljust(name_w)}  {'type'.ljust(9)}  series  total")
+    print(
+        f"{'family'.ljust(name_w)}  {'type'.ljust(9)}  series  total"
+        "      p50      p95      p99"
+    )
     for fam_name in sorted(families):
         fam = families[fam_name]
+        quants = ""
         if fam["type"] == "histogram":
             series = {
                 tuple(sorted(la.items()))
@@ -311,6 +315,9 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
             }
             total = sum(
                 v for n, _, v in fam["samples"] if n.endswith("_count")
+            )
+            quants = "  ".join(
+                f"{q:>7}" for q in _family_percentiles(fam["samples"])
             )
         else:
             series = {
@@ -320,9 +327,106 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
         total_s = f"{total:.0f}" if float(total).is_integer() else f"{total:.4g}"
         print(
             f"{fam_name.ljust(name_w)}  {fam['type'].ljust(9)}  "
-            f"{len(series):>6}  {total_s}"
+            f"{len(series):>6}  {total_s.rjust(5)}"
+            + (f"  {quants}" if quants else "")
         )
     return 0
+
+
+def _family_percentiles(
+    samples: list, qs: tuple = (0.5, 0.95, 0.99)
+) -> list[str]:
+    """p50/p95/p99 of one histogram family, aggregated across every
+    series (mesh-wide: worker labels just add counts).  Cumulative
+    ``_bucket`` counts sum across series per ``le`` bound, so the merged
+    sequence is itself a valid cumulative histogram."""
+    merged: dict[float, float] = {}
+    for n, la, v in samples:
+        if not n.endswith("_bucket") or "le" not in la:
+            continue
+        le = la["le"]
+        ub = float("inf") if le in ("+Inf", "inf") else float(le)
+        merged[ub] = merged.get(ub, 0.0) + v
+    buckets = sorted(merged.items())
+    out = []
+    for q in qs:
+        val = _hist_quantile(buckets, q)
+        if val is None:
+            out.append("-")
+        elif val == 0 or 0.001 <= abs(val) < 10000:
+            out.append(f"{val:.4g}")
+        else:
+            out.append(f"{val:.2e}")
+    return out
+
+
+def trace(target: str, *, as_json: bool = False) -> int:
+    """Validate and summarize exported Chrome trace files.
+
+    ``target`` is one ``pathway_trace_*.json`` file or a directory of
+    them (a run's ``PATHWAY_TPU_TRACE_DIR``).  Each file is checked
+    against the Chrome trace-event invariants (complete X events or
+    matched B/E pairs, monotonic timestamps per track) and its
+    per-commit critical-path summaries are printed.  Exit 2 when any
+    file fails validation — the timeline itself is for Perfetto
+    (https://ui.perfetto.dev) or chrome://tracing."""
+    import glob as _glob
+
+    from pathway_tpu.internals import tracing as _tracing
+
+    if os.path.isdir(target):
+        paths = sorted(
+            _glob.glob(os.path.join(target, "pathway_trace_*.json"))
+        )
+        if not paths:
+            print(f"no pathway_trace_*.json files in {target}",
+                  file=sys.stderr)
+            return 2
+    else:
+        paths = [target]
+    rc = 0
+    reports = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                obj = json.load(fh)
+            events = _tracing.validate_chrome_trace(obj)
+        except (OSError, ValueError) as exc:
+            print(f"{path}: INVALID — {exc}", file=sys.stderr)
+            rc = 2
+            continue
+        other = obj.get("otherData", {}) if isinstance(obj, dict) else {}
+        reports.append(
+            {
+                "file": path,
+                "events": len(events),
+                "worker": other.get("worker"),
+                "traces": other.get("traces", []),
+            }
+        )
+    if as_json:
+        print(json.dumps(reports, indent=1))
+        return rc
+    for rep in reports:
+        print(f"{rep['file']}: {rep['events']} events, "
+              f"{len(rep['traces'])} trace(s)")
+        for t in rep["traces"]:
+            cp = t.get("critical_path", {})
+            chain = cp.get("chain", [])
+            head = " -> ".join(s["name"] for s in chain[:6])
+            if len(chain) > 6:
+                head += " -> ..."
+            print(
+                f"  {t.get('trace_id')}  commit={t.get('commit_time')}  "
+                f"wall={cp.get('wall_s', 0) * 1000:.2f}ms  "
+                f"host={cp.get('host_compute_s', 0) * 1000:.2f}ms  "
+                f"exchange={cp.get('exchange_s', 0) * 1000:.2f}ms  "
+                f"queue={cp.get('queue_wait_s', 0) * 1000:.2f}ms  "
+                f"device={cp.get('device_s', 0) * 1000:.2f}ms"
+            )
+            if head:
+                print(f"    chain: {head}")
+    return rc
 
 
 def rescale(
@@ -435,6 +539,21 @@ def main(argv: Sequence[str] | None = None) -> int:
         "target", help="port, host:port, or full URL of the endpoint"
     )
 
+    p_trace = sub.add_parser(
+        "trace",
+        help="validate + summarize exported Chrome trace files "
+        "(pathway_trace_*.json; load them in Perfetto for the timeline)",
+    )
+    p_trace.add_argument(
+        "--json", action="store_true",
+        help="emit the per-trace summaries as JSON",
+    )
+    p_trace.add_argument(
+        "target",
+        help="a trace file, or a directory of pathway_trace_*.json "
+        "dumps (PATHWAY_TPU_TRACE_DIR)",
+    )
+
     args = parser.parse_args(argv)
     if args.command == "spawn":
         return spawn(
@@ -457,6 +576,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         )
     if args.command == "stats":
         return stats(args.target, raw=args.raw, timeout=args.timeout)
+    if args.command == "trace":
+        return trace(args.target, as_json=args.json)
     if args.command == "spawn-from-env":
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")
         if not spawn_args:

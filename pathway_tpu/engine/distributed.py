@@ -58,6 +58,7 @@ from pathway_tpu.engine.graph import (
     Node,
     Scope,
     StaticSource,
+    SubscribeNode,
 )
 from pathway_tpu.engine.routing import (
     columnar_shards,
@@ -293,6 +294,7 @@ COLUMNAR_EXCHANGE = os.environ.get(
 #: (``distributed.EXCHANGE_STATS``) pointing at the same object.
 from pathway_tpu.engine.routing import EXCHANGE_STATS  # noqa: E402
 from pathway_tpu.internals import metrics as _metrics  # noqa: E402
+from pathway_tpu.internals import tracing as _tracing  # noqa: E402
 
 _FRAME_MAGIC = b"PWCF"
 _FRAME_VERSION = 1
@@ -328,6 +330,24 @@ def encode_columns_frame(columns: Columns) -> bytes | None:
     """
     if not _frame_encodable(columns):
         return None
+    trace = _tracing.current()
+    if trace is not None:
+        t0 = _walltime.perf_counter()
+        frame = _encode_columns_frame(columns)
+        trace.span(
+            "pwcf-encode",
+            "exchange",
+            t0,
+            _walltime.perf_counter(),
+            rows=columns.n,
+            cols=len(columns.cols),
+            bytes=0 if frame is None else len(frame),
+        )
+        return frame
+    return _encode_columns_frame(columns)
+
+
+def _encode_columns_frame(columns: Columns) -> bytes | None:
     try:
         kb = np.ascontiguousarray(columns.kbytes(), np.uint8)
     except Exception:  # lazy key thunk failed: row path derives the keys
@@ -865,6 +885,9 @@ class DistributedScheduler:
         #: peer process id -> last piggybacked metrics snapshot (leader
         #: only; followers attach theirs to round frames bound for 0)
         self.mesh_metrics: dict[int, dict] = {}
+        #: peer process id -> spans piggybacked for the in-flight sampled
+        #: trace (leader only; the runner assembles + clears per commit)
+        self.trace_peer_spans: dict[int, list] = {}
         if probe:
             self._queue_gauge = _metrics.REGISTRY.gauge(
                 "pathway_queue_depth",
@@ -1281,8 +1304,7 @@ class DistributedScheduler:
         Returns True if anything was processed."""
         busy = False
         probe = self.probe
-        if probe:
-            import time as _walltime
+        trace = _tracing.current()
         while True:
             did = False
             busy_nodes = 0
@@ -1292,7 +1314,7 @@ class DistributedScheduler:
                         continue
                     did = True
                     busy_nodes += 1
-                    if probe:
+                    if probe or trace is not None:
                         t0 = _walltime.perf_counter()
                     out = node.process(time)
                     if out is None:
@@ -1302,6 +1324,18 @@ class DistributedScheduler:
                     # would materialise columnar batches into rows before
                     # the vectorized exchange ships them
                     node._defer_state(out)
+                    if trace is not None:
+                        trace.span(
+                            getattr(node, "name", None)
+                            or type(node).__name__,
+                            "sink"
+                            if isinstance(node, SubscribeNode)
+                            else "op",
+                            t0,
+                            _walltime.perf_counter(),
+                            node=node.index,
+                            scope=scope_idx,
+                        )
                     if probe:
                         st = self._stats_of(node)
                         st.time_spent += _walltime.perf_counter() - t0
@@ -1511,14 +1545,26 @@ class DistributedScheduler:
         any_work = False
         try:
             while True:
+                # re-fetched per round: a follower adopts the leader's
+                # trace context from the round-0 frame, so rounds >= 1
+                # (and the drain they gate) see it active
+                ctx = _tracing.current()
                 busy = self._drain_local(time)
                 my_bit = busy or any(self._outbox.values())
                 # mesh stats protocol: once this process goes quiet for the
                 # round, piggyback its metrics snapshot on the frame bound
                 # for the leader — no extra frames, no extra round-trips
                 snap = None
+                spans = None
                 if self.process_id != 0 and not my_bit:
                     snap = self._metrics_snapshot()
+                    # trace protocol, same shape: a quiet follower ships
+                    # its span list to the leader; the last quiescent
+                    # round carries the complete set (leader keeps the
+                    # latest copy per peer)
+                    if ctx is not None:
+                        spans = ("spans", _tracing.TRACER.take_spans())
+                trace_out = _tracing.TRACER.ctx_frame()
                 hb = _walltime.time()
                 for peer in peers:
                     transport.send(
@@ -1528,15 +1574,27 @@ class DistributedScheduler:
                             self._outbox[peer],
                             snap if peer == 0 else None,
                             hb,
+                            trace_out if self.process_id == 0
+                            else (spans if peer == 0 else None),
                         ),
                     )
                     self._outbox[peer] = []
                 global_busy = my_bit
                 for peer in peers:
+                    if ctx is not None:
+                        t0 = _walltime.perf_counter()
                     frame = self._recv_round(peer, time, round_no)
+                    if ctx is not None:
+                        ctx.span(
+                            f"recv-wait:p{peer}",
+                            "wait",
+                            t0,
+                            _walltime.perf_counter(),
+                            round=round_no,
+                        )
                     (
                         kind, f_time, f_round, bit, deliveries, peer_snap,
-                        peer_hb,
+                        peer_hb, trace_el,
                     ) = frame
                     if (
                         kind != "round"
@@ -1548,7 +1606,24 @@ class DistributedScheduler:
                             f"with peer {peer}: got {frame[:3]}, expected "
                             f"round ({time}, {round_no})"
                         )
-                    self._apply_remote(deliveries)
+                    if trace_el is not None:
+                        if trace_el[0] == "ctx" and self.process_id != 0:
+                            ctx = _tracing.TRACER.adopt(trace_el)
+                        elif trace_el[0] == "spans" and self.process_id == 0:
+                            self.trace_peer_spans[peer] = trace_el[1]
+                    if ctx is not None and deliveries:
+                        t0 = _walltime.perf_counter()
+                        self._apply_remote(deliveries)
+                        ctx.span(
+                            f"apply:p{peer}",
+                            "exchange",
+                            t0,
+                            _walltime.perf_counter(),
+                            deliveries=len(deliveries),
+                            round=round_no,
+                        )
+                    else:
+                        self._apply_remote(deliveries)
                     if peer_snap is not None:
                         self.mesh_metrics[peer] = peer_snap
                     self.peer_heartbeats[peer] = peer_hb
@@ -1583,6 +1658,10 @@ class DistributedScheduler:
         _metrics.FLIGHT.record(
             "commit", time=time, process=self.process_id
         )
+        if self.process_id != 0:
+            # adopted context ends with the commit; its spans already
+            # rode the final quiescent round's frame to the leader
+            _tracing.TRACER.drop()
         return time
 
     def finish_local(self) -> None:
@@ -1596,6 +1675,8 @@ class DistributedScheduler:
         # sinks tear down in close() only after the settlement delivers them
         self._exchange_rounds(self.time, notify_time_end=False)
         self.time += 1
+        if self.process_id != 0:
+            _tracing.TRACER.drop()
         for scope in self.scopes:
             for node in scope.nodes:
                 node.close()
@@ -1620,6 +1701,20 @@ class DistributedScheduler:
         for peer in self._outbox:
             self._outbox[peer] = []
 
+    def prune_mesh_metrics(self, dead: Sequence[int] = ()) -> None:
+        """Drop piggybacked metrics snapshots (and pending trace spans)
+        of peers that no longer exist: explicitly named dead peers, the
+        transport's dead set, and ids beyond the current mesh width —
+        so the aggregated ``/metrics`` exposition stops rendering their
+        ``worker=`` label sets."""
+        gone = set(dead) | set(self.transport.dead_peers)
+        for peer in list(self.mesh_metrics):
+            if peer in gone or peer >= self.n_processes:
+                self.mesh_metrics.pop(peer, None)
+        for peer in list(self.trace_peer_spans):
+            if peer in gone or peer >= self.n_processes:
+                self.trace_peer_spans.pop(peer, None)
+
     def resync(self, epoch: int) -> None:
         """Post-rollback barrier: flush stale frames off every peer link.
         Each process sends ``("sync", epoch)`` to every peer, then drains
@@ -1627,6 +1722,10 @@ class DistributedScheduler:
         ordering guarantees everything queued before it (orphaned round
         frames, aborts, old syncs) is gone.  All sends precede all drains,
         so the barrier cannot deadlock even with bounded queues."""
+        # raise the trace fence with the mesh epoch: context tuples a
+        # fenced-out zombie leader stamped before this barrier are
+        # rejected by TraceRecorder.adopt
+        _tracing.TRACER.epoch = max(_tracing.TRACER.epoch, int(epoch))
         peers = sorted(self._outbox)
         for peer in peers:
             self.transport.send(peer, ("sync", epoch))

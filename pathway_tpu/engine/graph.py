@@ -33,6 +33,7 @@ from pathway_tpu.engine.expression import EngineExpression, EvalContext
 from pathway_tpu.engine.reducers import Reducer
 from pathway_tpu.engine.value import ERROR, Error, Pointer, hash_values, is_error, ref_scalar, rows_differ
 from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing as _tracing
 
 #: sink-side row counter; one shared series — the per-commit delta is what
 #: stamps the ingest->sink latency histogram (internals/runner.py)
@@ -2554,6 +2555,9 @@ class SubscribeNode(Node):
                 self._on_change(key, row, time, diff)
         if rows:
             _OUTPUT_ROWS.inc(rows)
+            tr = _tracing.current()
+            if tr is not None:
+                tr.note_sink(rows)
         if retractions:
             _metrics.FLIGHT.record(
                 "retractions", time=time, count=retractions, sink=self.index
@@ -2918,7 +2922,8 @@ class Scheduler:
     def propagate(self, time: int) -> None:
         scope = self.scope
         probe = self.probe
-        if probe:
+        trace = _tracing.current()
+        if probe or trace is not None:
             import time as _walltime
         while True:
             dirty = [n for n in scope.nodes if n.has_pending()]
@@ -2939,7 +2944,7 @@ class Scheduler:
             for node in scope.nodes:
                 if not node.has_pending():
                     continue
-                if probe:
+                if probe or trace is not None:
                     t0 = _walltime.perf_counter()
                 out = node.process(time)
                 if out is None:
@@ -2947,6 +2952,16 @@ class Scheduler:
                 # no eager consolidation: consumers consolidate in take()
                 # (cached), lazy state drain consolidates before applying
                 node._defer_state(out)
+                if trace is not None:
+                    t1 = _walltime.perf_counter()
+                    trace.span(
+                        getattr(node, "name", None)
+                        or type(node).__name__,
+                        "sink" if isinstance(node, SubscribeNode) else "op",
+                        t0,
+                        t1,
+                        node=node.index,
+                    )
                 if probe:
                     st = self._stats_of(node)
                     st.time_spent += _walltime.perf_counter() - t0

@@ -299,8 +299,11 @@ class ShardedScheduler:
         return st
 
     def propagate(self, time: int) -> None:
+        from pathway_tpu.internals import tracing as _tracing
+
         probe = self.probe
-        if probe:
+        trace = _tracing.current()
+        if probe or trace is not None:
             import time as _walltime
         while True:
             busy = False
@@ -311,7 +314,7 @@ class ShardedScheduler:
                         continue
                     busy = True
                     busy_nodes += 1
-                    if probe:
+                    if probe or trace is not None:
                         t0 = _walltime.perf_counter()
                     out = node.process(time)
                     if out is None:
@@ -320,6 +323,18 @@ class ShardedScheduler:
                     # would materialise columnar batches before the
                     # vectorized exchange can route them
                     node._defer_state(out)
+                    if trace is not None:
+                        trace.span(
+                            getattr(node, "name", None)
+                            or type(node).__name__,
+                            "sink"
+                            if isinstance(node, SubscribeNode)
+                            else "op",
+                            t0,
+                            _walltime.perf_counter(),
+                            node=node.index,
+                            shard=w,
+                        )
                     if probe:
                         st = self._stats_of(node)
                         st.time_spent += _walltime.perf_counter() - t0

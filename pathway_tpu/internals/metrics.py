@@ -654,6 +654,27 @@ class MirroredCounterDict(dict):
 
 # -- flight recorder ---------------------------------------------------------
 
+# Optional callback returning the id of the in-flight sampled trace, if
+# any (internals/tracing.py registers one at import; this module stays
+# free of engine imports).  Flight events and dumps reference it so
+# crash forensics can be joined against exported traces.
+_TRACE_ID_PROVIDER = None
+
+
+def set_trace_id_provider(fn) -> None:
+    global _TRACE_ID_PROVIDER
+    _TRACE_ID_PROVIDER = fn
+
+
+def _active_trace_id():
+    fn = _TRACE_ID_PROVIDER
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:
+        return None
+
 
 class FlightRecorder:
     """Bounded ring of recent structured events; dumped to JSON when a
@@ -671,9 +692,13 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max(1, maxlen))
         self._seq = 0
+        self._dumps = 0
 
     def record(self, kind: str, **fields: Any) -> None:
         event = {"kind": kind, "wall": _time.time(), **fields}
+        trace_id = _active_trace_id()
+        if trace_id is not None:
+            event.setdefault("trace_id", trace_id)
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq
@@ -697,15 +722,23 @@ class FlightRecorder:
             )
             os.makedirs(directory, exist_ok=True)
             process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+            with self._lock:
+                self._dumps += 1
+                dump_no = self._dumps
+            # pid + per-recorder counter in the name: concurrent workers
+            # (and repeated dumps from one worker) sharing a FLIGHT_DIR
+            # never clobber each other
             path = os.path.join(
                 directory,
-                f"pathway_flight_p{process_id}_pid{os.getpid()}.json",
+                f"pathway_flight_p{process_id}"
+                f"_pid{os.getpid()}_{dump_no:03d}.json",
             )
             payload = {
                 "reason": reason,
                 "process_id": process_id,
                 "pid": os.getpid(),
                 "dumped_at": _time.time(),
+                "trace_id": _active_trace_id(),
                 "events": self.snapshot(),
             }
             with open(path, "w") as fh:

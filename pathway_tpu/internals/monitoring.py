@@ -235,7 +235,16 @@ class StatsMonitor:
         worker = os.environ.get("PATHWAY_PROCESS_ID", "0")
         snaps: dict[str, dict] = {"": legacy}
         snaps[worker] = _metrics.full_snapshot(self.scheduler)
+        # defensive stale-incarnation filter: recovery/failover prune the
+        # scheduler's mesh_metrics dict (this dict aliases it) when a
+        # worker dies; a rescale that shrank the mesh relaunches with a
+        # narrower width, so snapshots beyond it are a dead incarnation's
+        # (a normally-finished peer's closed socket is NOT death — its
+        # final snapshot stays visible)
+        width = getattr(self.scheduler, "n_processes", None)
         for peer in sorted(self.mesh_snapshots):
+            if width is not None and peer >= width:
+                continue
             snaps[str(peer)] = self.mesh_snapshots[peer]
         return _metrics.render_snapshots(snaps)
 

@@ -50,6 +50,7 @@ _CAST_NAMES = {
 
 from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing as _tracing
 
 #: ingest->sink latency, observed once per delta batch weighted by the
 #: rows the commit delivered to subscribe sinks
@@ -61,19 +62,27 @@ _INGEST_LATENCY = _metrics.REGISTRY.histogram(
 _OUT_ROWS = _metrics.REGISTRY.counter("pathway_output_rows_total")
 
 
-def _take_ingest_stamp(drivers: list) -> float | None:
+def _take_ingest_stamp(
+    drivers: list,
+) -> tuple[float | None, list[str]]:
     """Pop the oldest pending-row wall stamp across connector drivers
     (InputDriver.poll sets it when rows enter a session); the commit that
-    follows delivers those rows, closing the latency window."""
+    follows delivers those rows, closing the latency window.  Also
+    returns the source names whose stamps were popped — the tracing
+    ingest-wait span labels itself with them."""
     best = None
+    sources: list[str] = []
     for d in drivers:
         inner = getattr(d, "driver", d)
         stamp = getattr(inner, "first_pending_wall", None)
         if stamp is not None:
             inner.first_pending_wall = None
+            name = getattr(inner, "source_name", None)
+            if name:
+                sources.append(str(name))
             if best is None or stamp < best:
                 best = stamp
-    return best
+    return best, sources
 
 
 def _observe_commit_latency(
@@ -1064,11 +1073,16 @@ class GraphRunner:
         sched.time += 1
         def on_data() -> None:
             commit_started = _time.monotonic()
-            stamp = _take_ingest_stamp(self.drivers)
+            stamp, sources = _take_ingest_stamp(self.drivers)
             rows_before = _OUT_ROWS.value
+            ctx = _tracing.TRACER.begin(
+                sched.time, origin_mono=stamp, sources=sources
+            )
             time = sched.commit()
             _observe_commit_latency(stamp, commit_started, rows_before)
             _metrics.FLIGHT.record("commit", time=time)
+            if ctx is not None:
+                _tracing.TRACER.end(time)
             for driver in persistent:
                 driver.on_commit(time)
             if snapshot_mgr is not None:
@@ -1079,6 +1093,7 @@ class GraphRunner:
 
         _pump_drivers(self, self.drivers, on_data)
         sched.finish()
+        _tracing.TRACER.export()
         for driver in persistent:
             driver.on_commit(sched.time)
         if snapshot_mgr is not None:
@@ -1219,11 +1234,16 @@ class ShardedGraphRunner:
 
         def on_data() -> None:
             started = _time.monotonic()
-            stamp = _take_ingest_stamp(drivers)
+            stamp, sources = _take_ingest_stamp(drivers)
             rows_before = _OUT_ROWS.value
+            ctx = _tracing.TRACER.begin(
+                sched.time, origin_mono=stamp, sources=sources
+            )
             time = sched.commit()
             _observe_commit_latency(stamp, started, rows_before)
             _metrics.FLIGHT.record("commit", time=time)
+            if ctx is not None:
+                _tracing.TRACER.end(time)
             for d in persistent:
                 d.on_commit(time)
             if snapshot_mgr is not None:
@@ -1235,6 +1255,7 @@ class ShardedGraphRunner:
 
         _pump_drivers(w0, drivers, on_data)
         sched.finish()
+        _tracing.TRACER.export()
         for d in persistent:
             d.on_commit(sched.time)
         if snapshot_mgr is not None:
@@ -1583,6 +1604,13 @@ class DistributedGraphRunner:
             "peer_dead", peer=dead_peer, time=sched.time, epoch=epoch
         )
         _metrics.FLIGHT.dump(f"peer {dead_peer} lost (leader view)")
+        # abandon the in-flight sampled trace AFTER the dump, so the dump
+        # references its trace id; drop the dead incarnation's piggybacked
+        # metrics snapshot and spans so the aggregated /metrics stops
+        # rendering stale worker label sets
+        _tracing.TRACER.drop()
+        sched.mesh_metrics.pop(dead_peer, None)
+        sched.trace_peer_spans.pop(dead_peer, None)
         _metrics.FLIGHT.record(
             "recovery_start", peer=dead_peer, epoch=epoch
         )
@@ -1751,8 +1779,14 @@ class DistributedGraphRunner:
             started = _time.monotonic()
             try:
                 transport.raise_if_peer_dead()
-                stamp = _take_ingest_stamp(drivers)
+                stamp, sources = _take_ingest_stamp(drivers)
                 rows_before = _OUT_ROWS.value
+                # begin BEFORE the broadcast: the context tuple rides the
+                # first exchange round's frames so followers adopt it at
+                # commit start
+                ctx = _tracing.TRACER.begin(
+                    sched.time, origin_mono=stamp, sources=sources
+                )
                 transport.broadcast(("cmd", "commit"))
                 time = sched.commit_local()
             except PeerLostError as exc:
@@ -1762,6 +1796,11 @@ class DistributedGraphRunner:
                     sched, transport, snapshot_mgr, exc.peer, drivers
                 )
                 return  # the rolled-back commit re-drives on the next poll
+            if ctx is not None:
+                _tracing.TRACER.end(
+                    time, peer_spans=dict(sched.trace_peer_spans)
+                )
+                sched.trace_peer_spans.clear()
             _observe_commit_latency(stamp, started, rows_before)
             for d in persistent:
                 d.on_commit(time)
@@ -1804,6 +1843,7 @@ class DistributedGraphRunner:
         _pump_drivers(w0, drivers, on_data, on_idle)
         transport.broadcast(("cmd", "finish"))
         sched.finish_local()
+        _tracing.TRACER.export()  # leader holds the assembled mesh traces
         for d in persistent:
             d.on_commit(sched.time)
         if snapshot_mgr is not None:
@@ -1965,6 +2005,7 @@ class DistributedGraphRunner:
             ),
         )
         _metrics.FLIGHT.dump("leader (process 0) lost")
+        _tracing.TRACER.drop()  # after the dump — it references the id
         if not recovery:
             raise PeerLostError(
                 f"process {self.process_id}: leader (process 0) lost "
@@ -1986,6 +2027,11 @@ class DistributedGraphRunner:
         latest = snapshot_mgr.latest_time()
         latest = -1 if latest is None else latest
         if self.process_id == interim:
+            # interim leader inherits /metrics aggregation: start from a
+            # clean slate so the dead leader's (and any other dead
+            # incarnation's) worker label sets don't linger in the
+            # rendered exposition
+            sched.prune_mesh_metrics(dead=(0,))
             for peer in others:
                 transport.send(peer, ("elect", epoch, interim))
             rejoin_times = [latest]
@@ -2090,6 +2136,7 @@ class DistributedGraphRunner:
             "peer_dead", peer=dead_peer, time=sched.time
         )
         _metrics.FLIGHT.dump(f"peer {dead_peer} lost (survivor view)")
+        _tracing.TRACER.drop()  # after the dump — it references the id
         _metrics.FLIGHT.record("recovery_parked", peer=dead_peer)
         deadline = self._recover_deadline()
         end = _time.monotonic() + deadline

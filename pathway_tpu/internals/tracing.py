@@ -1,0 +1,705 @@
+"""Sampled per-commit distributed tracing: spans, critical path, export.
+
+The metrics plane (internals/metrics.py) answers *how much*; this module
+answers *why*: for a sampled delta-batch commit it records a tree of
+spans — connector ingest wait, every operator ``process()`` (including
+FusedChainNode sweeps), exchange encode/apply, mesh recv waits, sink
+emit — across every worker of a TCP mesh, and assembles them on the
+leader into one trace with per-worker tracks.
+
+Design constraints, matching the metrics plane:
+
+- **lock-cheap, allocation-free when idle** — tracing is off unless
+  ``PATHWAY_TPU_TRACE=1``; when on, only every Nth commit is sampled
+  (``PATHWAY_TPU_TRACE_SAMPLE``, default 16) and the hot-path guard for
+  an unsampled commit is one attribute load (:func:`current` returning
+  ``None``).  Assembled traces live in a bounded ring like the
+  :class:`~pathway_tpu.internals.metrics.FlightRecorder`.
+- **mesh-transparent** — the leader decides sampling at commit start
+  and piggybacks the trace context on the round frames it already
+  sends (the 8th element, next to the metrics snapshot slot); quiet
+  followers piggyback their span lists back on frames bound for the
+  leader.  No extra frames, no extra round trips.
+- **epoch-fenced** — the context tuple carries the mesh recovery
+  epoch; a context stamped by a fenced-out zombie leader is ignored
+  (:meth:`TraceRecorder.adopt`), and recovery/failover paths drop the
+  in-flight context after the flight-recorder dump (which references
+  its trace id — see ``metrics.set_trace_id_provider``).
+- **self-limiting** — the recorder measures its own per-sampled-commit
+  bookkeeping cost and doubles the sampling interval when the
+  amortized overhead approaches the 5%% observability gate, decaying
+  back toward the configured base when it is comfortably under
+  (:meth:`TraceRecorder._adapt`).
+
+Span timestamps are microseconds since the epoch, derived from one
+per-process wall anchor plus ``perf_counter`` deltas — monotonic per
+worker track by construction, which is exactly the invariant the
+Chrome trace-event export (:func:`chrome_trace`) needs and
+:func:`validate_chrome_trace` enforces.
+
+Critical-path attribution (:func:`critical_path`) buckets each traced
+commit's wall time into ``queue_wait`` (connector ingest wait plus
+mesh recv blocking), ``exchange`` (PWCF encode + decode/apply),
+``device`` (native ``kernel_ns`` deltas), and ``host_compute`` (the
+residual) — the four sum to the commit wall exactly, so downstream
+consumers (bench JSON, the async-device-pipeline work) can trust the
+decomposition.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time as _time
+from collections import deque
+from typing import Any
+
+from pathway_tpu.internals import metrics as _metrics
+
+__all__ = [
+    "TraceContext",
+    "TraceRecorder",
+    "TRACER",
+    "current",
+    "critical_path",
+    "chrome_trace",
+    "validate_chrome_trace",
+]
+
+#: spans kept per commit per worker before dropping (bounds frame size)
+MAX_SPANS = 2048
+
+#: amortized (overhead / interval) share of commit wall that triggers an
+#: interval doubling — half the 5% observability gate, for headroom
+OVERHEAD_TARGET = 0.02
+
+# one per-process clock anchor: wall time is captured once, every span
+# timestamp is the anchor plus a perf_counter/monotonic delta — so per-
+# worker timestamps are strictly monotonic even if the system clock steps
+_ANCHOR_WALL = _time.time()
+_ANCHOR_PERF = _time.perf_counter()
+_ANCHOR_MONO = _time.monotonic()
+
+
+def perf_to_wall(t: float) -> float:
+    return _ANCHOR_WALL + (t - _ANCHOR_PERF)
+
+
+def mono_to_wall(t: float) -> float:
+    return _ANCHOR_WALL + (t - _ANCHOR_MONO)
+
+
+def _us(wall: float) -> int:
+    return int(wall * 1e6)
+
+
+def _kernel_ns_snapshot() -> dict | None:
+    try:
+        from pathway_tpu import native
+
+        kernel_ns = getattr(native, "kernel_ns", None)
+        if kernel_ns is None:
+            return None
+        return dict(kernel_ns())
+    except Exception:
+        return None
+
+
+class TraceContext:
+    """The in-flight sampled commit: identity plus the span accumulator.
+
+    Created by the leader (:meth:`TraceRecorder.begin`) or adopted from
+    the leader's round-frame context tuple on a follower
+    (:meth:`TraceRecorder.adopt`, ``remote=True``)."""
+
+    __slots__ = (
+        "trace_id",
+        "commit_time",
+        "origin_wall",
+        "epoch",
+        "pid",
+        "remote",
+        "begin_wall",
+        "spans",
+        "dropped",
+        "sink_rows",
+        "native_ns0",
+        "overhead_s",
+    )
+
+    def __init__(
+        self,
+        trace_id: str,
+        commit_time: int,
+        origin_wall: float,
+        epoch: int,
+        pid: int,
+        remote: bool = False,
+    ) -> None:
+        self.trace_id = trace_id
+        self.commit_time = int(commit_time)
+        self.origin_wall = float(origin_wall)
+        self.epoch = int(epoch)
+        self.pid = int(pid)
+        self.remote = remote
+        self.begin_wall = perf_to_wall(_time.perf_counter())
+        self.spans: list[dict] = []
+        self.dropped = 0
+        self.sink_rows = 0
+        self.native_ns0: dict | None = None
+        self.overhead_s = 0.0
+
+    def span(
+        self, name: str, cat: str, t0: float, t1: float, **args: Any
+    ) -> None:
+        """Record one completed span from perf_counter stamps ``t0``/``t1``
+        (taken by the instrumented call site around the work)."""
+        if len(self.spans) >= MAX_SPANS:
+            self.dropped += 1
+            return
+        ev: dict = {
+            "name": name,
+            "cat": cat,
+            "ts": _us(perf_to_wall(t0)),
+            "dur": max(0, int((t1 - t0) * 1e6)),
+            "pid": self.pid,
+        }
+        if args:
+            ev["args"] = args
+        self.spans.append(ev)
+
+    def note_sink(self, rows: int) -> None:
+        self.sink_rows += int(rows)
+
+
+class TraceRecorder:
+    """Process-wide sampling trace recorder (singleton: :data:`TRACER`).
+
+    The engine's only hot-path contact points are :func:`current` (one
+    attribute read, ``None`` when the running commit is unsampled) and
+    :meth:`begin` (a counter bump + modulo when tracing is enabled, a
+    single boolean test when it is not)."""
+
+    def __init__(
+        self,
+        enabled: bool | None = None,
+        sample: int | None = None,
+        maxlen: int | None = None,
+    ) -> None:
+        if maxlen is None:
+            try:
+                maxlen = int(os.environ.get("PATHWAY_TPU_TRACE_RING", "64"))
+            except ValueError:
+                maxlen = 64
+        self._lock = threading.Lock()
+        self._traces: deque = deque(maxlen=max(1, maxlen))
+        self._ctx: TraceContext | None = None
+        self._count = 0
+        self._export_seq = 0
+        self._overhead_ema: float | None = None
+        self.epoch = 0
+        self.configure(enabled=enabled, sample=sample)
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(
+        self,
+        enabled: bool | None = None,
+        sample: int | None = None,
+        clear: bool = False,
+    ) -> None:
+        """(Re)read the knobs; tests and benches call this directly
+        instead of mutating the environment."""
+        if enabled is None:
+            enabled = os.environ.get("PATHWAY_TPU_TRACE", "").lower() in (
+                "1",
+                "true",
+                "yes",
+            )
+        if sample is None:
+            try:
+                sample = int(
+                    os.environ.get("PATHWAY_TPU_TRACE_SAMPLE", "16")
+                )
+            except ValueError:
+                sample = 16
+        self.enabled = bool(enabled)
+        self.base_interval = max(1, int(sample))
+        self.interval = self.base_interval
+        try:
+            self.worker_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        except ValueError:
+            self.worker_id = 0
+        self._ctx = None
+        self._overhead_ema = None
+        if clear:
+            with self._lock:
+                self._traces.clear()
+            self._count = 0
+            self._export_seq = 0
+
+    # -- commit lifecycle ----------------------------------------------------
+
+    def begin(
+        self,
+        commit_time: int,
+        origin_mono: float | None = None,
+        sources: list[str] | None = None,
+    ) -> TraceContext | None:
+        """Leader/local-side sampling decision at commit start.
+
+        ``origin_mono`` is the connector ingest stamp
+        (``InputDriver.first_pending_wall``, a ``time.monotonic`` value)
+        popped by the runner — the trace's time zero.  Returns the
+        active context when this commit is sampled, else ``None``."""
+        if not self.enabled:
+            return None
+        self._count += 1
+        if (self._count - 1) % self.interval:
+            return None
+        t0 = _time.perf_counter()
+        now_wall = perf_to_wall(t0)
+        origin_wall = (
+            mono_to_wall(origin_mono) if origin_mono is not None else now_wall
+        )
+        origin_wall = min(origin_wall, now_wall)
+        ctx = TraceContext(
+            trace_id=(
+                f"t{self.worker_id:02d}-{os.getpid():x}-{self._count:06x}"
+            ),
+            commit_time=commit_time,
+            origin_wall=origin_wall,
+            epoch=self.epoch,
+            pid=self.worker_id,
+        )
+        ctx.native_ns0 = _kernel_ns_snapshot()
+        if now_wall - origin_wall > 1e-6:
+            # the connector-ingest wait, synthesized as the first span —
+            # rendered on the track, but bucketed via the begin/origin
+            # delta (not the "wait" category) to avoid double counting
+            ev: dict = {
+                "name": "ingest-wait",
+                "cat": "queue",
+                "ts": _us(origin_wall),
+                "dur": max(0, int((now_wall - origin_wall) * 1e6)),
+                "pid": self.worker_id,
+            }
+            if sources:
+                ev["args"] = {"sources": sources}
+            ctx.spans.append(ev)
+        self._ctx = ctx
+        ctx.overhead_s += _time.perf_counter() - t0
+        return ctx
+
+    def ctx_frame(self) -> tuple | None:
+        """The context tuple the leader piggybacks on round frames —
+        ``("ctx", trace_id, commit_time, origin_wall, epoch)``."""
+        ctx = self._ctx
+        if ctx is None or ctx.remote:
+            return None
+        return ("ctx", ctx.trace_id, ctx.commit_time, ctx.origin_wall,
+                ctx.epoch)
+
+    def adopt(self, payload: tuple) -> TraceContext | None:
+        """Follower-side: activate the leader's trace context from a
+        round-frame tuple.  A context stamped with an epoch below this
+        process's fence floor is a zombie ex-leader's — ignored."""
+        epoch = int(payload[4])
+        if epoch < self.epoch:
+            return None
+        self.epoch = epoch
+        ctx = self._ctx
+        if ctx is not None and ctx.trace_id == payload[1]:
+            return ctx
+        ctx = TraceContext(
+            trace_id=str(payload[1]),
+            commit_time=int(payload[2]),
+            origin_wall=float(payload[3]),
+            epoch=epoch,
+            pid=self.worker_id,
+            remote=True,
+        )
+        self._ctx = ctx
+        return ctx
+
+    def take_spans(self) -> list[dict]:
+        """Copy of the active context's spans so far — what a quiet
+        follower piggybacks to the leader (the leader keeps the latest
+        copy per peer, so the final quiescent round wins)."""
+        ctx = self._ctx
+        return list(ctx.spans) if ctx is not None else []
+
+    def drop(self) -> None:
+        """Abandon the in-flight context (followers at commit end;
+        every process on recovery/failover — call AFTER the flight
+        dump so forensics still reference the trace id)."""
+        self._ctx = None
+
+    def end(
+        self, commit_time: int, peer_spans: dict | None = None
+    ) -> dict | None:
+        """Leader/local-side commit end: assemble the trace (local +
+        piggybacked peer spans), attribute the critical path, ring it,
+        and feed the adaptive sampler."""
+        ctx = self._ctx
+        self._ctx = None
+        if ctx is None or ctx.remote:
+            return None
+        t_end = _time.perf_counter()
+        end_wall = perf_to_wall(t_end)
+        kernels: dict[str, int] = {}
+        device_s = 0.0
+        if ctx.native_ns0 is not None:
+            now_ns = _kernel_ns_snapshot() or {}
+            for k, ns in now_ns.items():
+                d = int(ns) - int(ctx.native_ns0.get(k, 0))
+                if d > 0:
+                    kernels[k] = d
+            device_s = sum(kernels.values()) / 1e9
+        workers: dict[int, list] = {}
+        if peer_spans:
+            for peer, spans in sorted(peer_spans.items()):
+                if spans:
+                    workers[int(peer)] = list(spans)
+        trace: dict = {
+            "trace_id": ctx.trace_id,
+            "commit_time": int(commit_time),
+            "epoch": ctx.epoch,
+            "worker": ctx.pid,
+            "origin_wall": ctx.origin_wall,
+            "begin_wall": ctx.begin_wall,
+            "end_wall": end_wall,
+            "spans": ctx.spans,
+            "workers": workers,
+            "sink_rows": ctx.sink_rows,
+            "dropped_spans": ctx.dropped,
+            "device_kernel_ns": kernels,
+            "device_s": device_s,
+        }
+        trace["critical_path"] = critical_path(trace)
+        with self._lock:
+            self._traces.append(trace)
+        overhead = ctx.overhead_s + (_time.perf_counter() - t_end)
+        self._adapt(overhead, max(end_wall - ctx.begin_wall, 0.0))
+        return trace
+
+    def _adapt(self, overhead_s: float, commit_wall_s: float) -> None:
+        """Keep the amortized tracing cost under the overhead target by
+        doubling the sampling interval when a sampled commit's
+        bookkeeping is too large a share of the (interval-amortized)
+        commit wall, decaying back toward the configured base when the
+        cost is comfortably below it."""
+        amortized = overhead_s / max(1, self.interval)
+        ratio = amortized / max(commit_wall_s, 1e-6)
+        ema = self._overhead_ema
+        self._overhead_ema = ratio if ema is None else 0.5 * ema + 0.5 * ratio
+        if self._overhead_ema > OVERHEAD_TARGET:
+            self.interval = min(self.interval * 2, 4096)
+            self._overhead_ema /= 2.0  # doubling halves the amortized cost
+        elif (
+            self.interval > self.base_interval
+            and self._overhead_ema < OVERHEAD_TARGET / 4.0
+        ):
+            self.interval = max(self.base_interval, self.interval // 2)
+            self._overhead_ema *= 2.0
+
+    # -- read side -----------------------------------------------------------
+
+    def traces(self) -> list[dict]:
+        with self._lock:
+            return list(self._traces)
+
+    def active_trace_id(self) -> str | None:
+        ctx = self._ctx
+        return ctx.trace_id if ctx is not None else None
+
+    def summary(self) -> dict:
+        """Structured roll-up for bench JSON: trace count, span volume,
+        the mean critical-path buckets, and the last commit's full
+        breakdown."""
+        traces = self.traces()
+        if not traces:
+            return {"traces": 0, "sample_interval": self.interval}
+        n = len(traces)
+        keys = (
+            "wall_s",
+            "host_compute_s",
+            "exchange_s",
+            "queue_wait_s",
+            "device_s",
+        )
+        mean = {
+            k: round(sum(t["critical_path"][k] for t in traces) / n, 6)
+            for k in keys
+        }
+        spans = sum(
+            len(t["spans"]) + sum(len(v) for v in t["workers"].values())
+            for t in traces
+        )
+        return {
+            "traces": n,
+            "spans": spans,
+            "sample_interval": self.interval,
+            "critical_path_mean": mean,
+            "last": traces[-1]["critical_path"],
+        }
+
+    def export(self, directory: str | None = None) -> str | None:
+        """Dump the ring as one Chrome trace-event JSON file
+        (``pathway_trace_p<worker>_pid<pid>_<n>.json``) into
+        ``directory`` / ``PATHWAY_TPU_TRACE_DIR`` / the system temp
+        dir.  Returns the path, or None when there is nothing to dump
+        or the dump itself fails (export must never mask a run)."""
+        traces = self.traces()
+        if not traces:
+            return None
+        try:
+            directory = (
+                directory
+                or os.environ.get("PATHWAY_TPU_TRACE_DIR")
+                or tempfile.gettempdir()
+            )
+            os.makedirs(directory, exist_ok=True)
+            self._export_seq += 1
+            path = os.path.join(
+                directory,
+                f"pathway_trace_p{self.worker_id}"
+                f"_pid{os.getpid()}_{self._export_seq:03d}.json",
+            )
+            payload = chrome_trace(traces)
+            payload["otherData"] = {
+                "worker": self.worker_id,
+                "pid": os.getpid(),
+                "traces": [
+                    {
+                        "trace_id": t["trace_id"],
+                        "commit_time": t["commit_time"],
+                        "epoch": t["epoch"],
+                        "sink_rows": t["sink_rows"],
+                        "critical_path": t["critical_path"],
+                    }
+                    for t in traces
+                ],
+            }
+            with open(path, "w") as fh:
+                json.dump(payload, fh, default=repr)
+            return path
+        except Exception:
+            return None
+
+
+# -- critical-path attribution ------------------------------------------------
+
+
+def critical_path(trace: dict) -> dict:
+    """Bucket a trace's wall time (origin -> commit end) into
+    queue-wait / exchange / device / host-compute, plus the serialized
+    chain of significant spans in timestamp order.
+
+    The buckets sum to ``wall_s`` exactly by construction: queue-wait is
+    the ingest wait (begin - origin) plus measured recv blocking,
+    exchange is measured encode/apply time, device is the native
+    ``kernel_ns`` delta, and host-compute is the residual (clamped at
+    zero, flagged via ``clamped``)."""
+    wall = max(1e-9, trace["end_wall"] - trace["origin_wall"])
+    queue = max(0.0, trace["begin_wall"] - trace["origin_wall"])
+    exchange = 0.0
+    for s in trace["spans"]:
+        cat = s.get("cat")
+        dur = s.get("dur", 0) / 1e6
+        if cat == "wait":
+            queue += dur
+        elif cat == "exchange":
+            exchange += dur
+    device = float(trace.get("device_s", 0.0))
+    host = wall - queue - exchange - device
+    clamped = host < 0.0
+    host = max(0.0, host)
+    chain: list[dict] = []
+    for s in sorted(trace["spans"], key=lambda s: s["ts"]):
+        if s.get("cat") == "commit":
+            continue
+        dur_ms = s.get("dur", 0) / 1000.0
+        if dur_ms >= wall * 1000.0 * 0.01 or s.get("cat") in (
+            "wait",
+            "exchange",
+            "queue",
+        ):
+            chain.append(
+                {
+                    "name": s["name"],
+                    "cat": s.get("cat", ""),
+                    "ms": round(dur_ms, 3),
+                }
+            )
+            if len(chain) >= 64:
+                break
+    return {
+        "wall_s": round(wall, 6),
+        "host_compute_s": round(host, 6),
+        "exchange_s": round(exchange, 6),
+        "queue_wait_s": round(queue, 6),
+        "device_s": round(device, 6),
+        "clamped": clamped,
+        "chain": chain,
+    }
+
+
+# -- Chrome trace-event export ------------------------------------------------
+
+
+def chrome_trace(traces: list[dict]) -> dict:
+    """Render assembled traces as a Chrome trace-event JSON object
+    (Perfetto/chrome://tracing loadable): complete ``"X"`` events on one
+    track per worker (``pid``/``tid`` = worker id), a root ``commit``
+    span per worker per trace for containment parentage, and ``"M"``
+    metadata events naming the tracks.  Events are sorted by timestamp,
+    so each track's sequence is monotonic — the invariant
+    :func:`validate_chrome_trace` checks."""
+    events: list[dict] = []
+    pids: set[int] = set()
+    for trace in traces:
+        groups: dict[int, list[dict]] = {}
+        for s in trace["spans"]:
+            groups.setdefault(int(s.get("pid", trace["worker"])), []).append(s)
+        for peer, spans in trace["workers"].items():
+            for s in spans:
+                groups.setdefault(int(s.get("pid", peer)), []).append(s)
+        for wid, spans in sorted(groups.items()):
+            if not spans:
+                continue
+            pids.add(wid)
+            start = min(s["ts"] for s in spans)
+            end = max(s["ts"] + s.get("dur", 0) for s in spans)
+            root_args: dict = {
+                "trace": trace["trace_id"],
+                "commit_time": trace["commit_time"],
+            }
+            if wid == trace["worker"]:
+                root_args["critical_path"] = {
+                    k: v
+                    for k, v in trace["critical_path"].items()
+                    if k != "chain"
+                }
+                if trace["device_kernel_ns"]:
+                    root_args["device_kernel_ns"] = trace["device_kernel_ns"]
+            events.append(
+                {
+                    "name": f"commit {trace['commit_time']}",
+                    "cat": "commit",
+                    "ph": "X",
+                    "ts": start,
+                    "dur": max(0, end - start),
+                    "pid": wid,
+                    "tid": wid,
+                    "args": root_args,
+                }
+            )
+            for s in spans:
+                ev = {
+                    "name": s["name"],
+                    "cat": s.get("cat", ""),
+                    "ph": "X",
+                    "ts": s["ts"],
+                    "dur": s.get("dur", 0),
+                    "pid": wid,
+                    "tid": wid,
+                    "args": dict(
+                        s.get("args") or {}, trace=trace["trace_id"]
+                    ),
+                }
+                events.append(ev)
+    # a root span shares its start ts with its first child: emit the
+    # longer (enclosing) event first so viewers nest them correctly
+    events.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+    meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": wid,
+            "tid": wid,
+            "args": {"name": f"worker {wid}"},
+        }
+        for wid in sorted(pids)
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(obj: Any) -> list[dict]:
+    """Strict Chrome trace-event conformance check (the trace-export
+    gate in tools/check.py): the object is a ``{"traceEvents": [...]}``
+    dict or a bare event list; every event is ``"X"`` (with a numeric
+    non-negative ``dur``), a matched ``"B"``/``"E"`` pair, or ``"M"``
+    metadata; and timestamps are monotonic non-decreasing per
+    ``(pid, tid)`` track.  Returns the event list; raises
+    ``ValueError`` on any violation."""
+    if isinstance(obj, list):
+        events = obj
+    elif isinstance(obj, dict):
+        events = obj.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError("trace object has no traceEvents list")
+    else:
+        raise ValueError(f"not a trace object: {type(obj).__name__}")
+    last_ts: dict[tuple, float] = {}
+    open_begins: dict[tuple, list] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i}: not an object")
+        ph = ev.get("ph")
+        if ph == "M":
+            continue
+        if ph not in ("X", "B", "E"):
+            raise ValueError(f"event {i}: unsupported phase {ph!r}")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            raise ValueError(f"event {i}: missing/non-numeric ts")
+        track = (ev.get("pid"), ev.get("tid"))
+        if ts < last_ts.get(track, float("-inf")):
+            raise ValueError(
+                f"event {i}: non-monotonic ts on track {track}"
+            )
+        last_ts[track] = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(
+                    f"event {i}: X event needs a non-negative dur"
+                )
+        elif ph == "B":
+            open_begins.setdefault(track, []).append(ev.get("name"))
+        else:  # "E"
+            stack = open_begins.get(track)
+            if not stack:
+                raise ValueError(
+                    f"event {i}: E without a matching B on track {track}"
+                )
+            stack.pop()
+    for track, stack in open_begins.items():
+        if stack:
+            raise ValueError(
+                f"track {track}: unclosed B events {stack!r}"
+            )
+    return events
+
+
+#: the process-wide recorder every instrumented hot path consults
+TRACER = TraceRecorder()
+
+
+def current() -> TraceContext | None:
+    """The active sampled-commit context, or None — THE hot-path guard;
+    call once per batch/sweep, not per row."""
+    return TRACER._ctx
+
+
+def _active_trace_id() -> str | None:
+    ctx = TRACER._ctx
+    return ctx.trace_id if ctx is not None else None
+
+
+# flight-recorder integration: every event recorded (and every dump
+# written) while a sampled commit is in flight references its trace id
+_metrics.set_trace_id_provider(_active_trace_id)

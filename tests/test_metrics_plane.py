@@ -189,6 +189,80 @@ class TestExpositionConformance:
         assert 'fs:"quo\\ted"' in names
 
 
+class TestRendererEdgeCases:
+    """Renderer corner cases a live mesh produces: an empty registry
+    snapshot, a counter only followers report, and a histogram family
+    where one worker has observed nothing yet."""
+
+    def test_empty_registry_snapshot_renders_empty(self):
+        text = _metrics.render_snapshots({"": {}})
+        assert text.strip() == ""
+        assert _metrics.validate_exposition(text) == {}
+
+    def test_follower_only_counter_keeps_one_help_type_block(self):
+        def counter(value: float) -> dict:
+            return {
+                "kind": "counter",
+                "help": "rows seen",
+                "buckets": None,
+                "series": [{"labels": {}, "value": value}],
+            }
+
+        # the leader ("") has never bumped this family — only followers
+        text = _metrics.render_snapshots(
+            {
+                "": {},
+                "1": {"rows_seen_total": counter(3.0)},
+                "2": {"rows_seen_total": counter(4.0)},
+            }
+        )
+        assert text.count("# HELP rows_seen_total") == 1
+        assert text.count("# TYPE rows_seen_total counter") == 1
+        families = _metrics.validate_exposition(text)
+        samples = families["rows_seen_total"]["samples"]
+        assert {la["worker"]: v for _n, la, v in samples} == {
+            "1": 3.0,
+            "2": 4.0,
+        }
+
+    def test_histogram_with_zero_observation_worker(self):
+        def hist(counts: list, count: int, total: float) -> dict:
+            return {
+                "kind": "histogram",
+                "help": "latency",
+                "buckets": [0.1, 1.0],
+                "series": [
+                    {
+                        "labels": {},
+                        "counts": counts,
+                        "sum": total,
+                        "count": count,
+                    }
+                ],
+            }
+
+        text = _metrics.render_snapshots(
+            {
+                "0": {"lat_seconds": hist([1, 2], 5, 1.5)},
+                "1": {"lat_seconds": hist([0, 0], 0, 0.0)},
+            }
+        )
+        assert text.count("# HELP lat_seconds") == 1
+        assert text.count("# TYPE lat_seconds histogram") == 1
+        families = _metrics.validate_exposition(text)
+        by_worker: dict = {}
+        for n, la, v in families["lat_seconds"]["samples"]:
+            by_worker.setdefault(la["worker"], {})[
+                (n, la.get("le", ""))
+            ] = v
+        # the idle worker still renders a complete, all-zero series
+        assert by_worker["1"][("lat_seconds_count", "")] == 0
+        assert by_worker["1"][("lat_seconds_bucket", "+Inf")] == 0
+        assert by_worker["0"][("lat_seconds_count", "")] == 5
+        assert by_worker["0"][("lat_seconds_bucket", "0.1")] == 1
+        assert by_worker["0"][("lat_seconds_bucket", "1")] == 3
+
+
 class TestExchangeStatsAbsorption:
     def test_single_dict_alias_across_modules(self):
         from pathway_tpu.engine import distributed, routing, sharded

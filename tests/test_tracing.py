@@ -1,0 +1,713 @@
+"""Mesh-wide distributed tracing: sampling span recorder, critical-path
+attribution, Chrome trace-event export, trace-context propagation over
+the TCP mesh, and trace survival across worker kill -> recovery
+(reference: PR "Mesh-wide distributed tracing")."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port_base(n: int) -> int:
+    """A base port such that base..base+n-1 are currently bindable."""
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        if base + n >= 65535:
+            continue
+        ok = True
+        for i in range(n):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + i))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port range found")
+
+
+def _recorder(sample: int = 1) -> tracing.TraceRecorder:
+    r = tracing.TraceRecorder()
+    r.configure(enabled=True, sample=sample, clear=True)
+    return r
+
+
+@pytest.fixture
+def global_tracer():
+    """The process-wide TRACER, enabled for the test and fully reset
+    afterwards so the rest of the suite sees tracing off."""
+    tracing.TRACER.configure(enabled=True, sample=1, clear=True)
+    yield tracing.TRACER
+    tracing.TRACER.drop()
+    tracing.TRACER.configure(enabled=False, clear=True)
+    tracing.TRACER.epoch = 0
+
+
+class TestSampling:
+    def test_first_commit_always_sampled(self):
+        r = _recorder(sample=4)
+        assert r.begin(1) is not None
+
+    def test_interval_counts_commits_not_samples(self):
+        r = _recorder(sample=4)
+        # pin the interval: on zero-work commits the adaptive sampler
+        # (rightly) backs off, which is not what this test measures
+        r._adapt = lambda *a: None
+        sampled = []
+        for t in range(1, 10):
+            ctx = r.begin(t)
+            if ctx is not None:
+                sampled.append(t)
+                r.end(t)
+        # (count - 1) % 4 == 0 -> commits 1, 5, 9
+        assert sampled == [1, 5, 9]
+
+    def test_disabled_recorder_samples_nothing(self):
+        r = tracing.TraceRecorder()
+        r.configure(enabled=False, sample=1, clear=True)
+        assert r.begin(1) is None
+        assert r.traces() == []
+
+    def test_trace_ids_unique_and_worker_stamped(self):
+        r = _recorder()
+        r._adapt = lambda *a: None  # see above: pin the interval
+        a = r.begin(1)
+        r.end(1)
+        b = r.begin(2)
+        r.end(2)
+        assert a.trace_id != b.trace_id
+        assert a.trace_id.startswith(f"t{r.worker_id:02d}-")
+
+
+class TestSpansAndOverflow:
+    def test_span_overflow_increments_dropped(self):
+        r = _recorder()
+        ctx = r.begin(1)
+        t0 = time.perf_counter()
+        for _ in range(tracing.MAX_SPANS + 10):
+            ctx.span("s", "op", t0, t0)
+        assert len(ctx.spans) <= tracing.MAX_SPANS
+        assert ctx.dropped >= 10
+
+    def test_take_spans_is_a_copy(self):
+        r = _recorder()
+        ctx = r.begin(1)
+        ctx.span("s", "op", time.perf_counter(), time.perf_counter())
+        taken = r.take_spans()
+        n = len(ctx.spans)
+        taken.append({"name": "bogus"})
+        assert len(ctx.spans) == n
+
+    def test_drop_abandons_context(self):
+        r = _recorder()
+        assert r.begin(1) is not None
+        r.drop()
+        assert r.active_trace_id() is None
+        assert r.end(1) is None
+        assert r.traces() == []
+
+
+class TestEpochFence:
+    def test_adopt_rejects_lower_epoch(self):
+        r = _recorder()
+        r.epoch = 2
+        assert r.adopt(("ctx", "tzz-1", 5, 123.0, 1)) is None
+
+    def test_adopt_accepts_and_raises_epoch(self):
+        r = _recorder()
+        r.epoch = 1
+        ctx = r.adopt(("ctx", "tzz-2", 5, 123.0, 3))
+        assert ctx is not None and ctx.remote
+        assert r.epoch == 3
+        # remote contexts never re-broadcast and never ring locally
+        assert r.ctx_frame() is None
+        assert r.end(5) is None
+        assert r.traces() == []
+
+    def test_adopt_is_idempotent_per_trace_id(self):
+        r = _recorder()
+        a = r.adopt(("ctx", "tzz-3", 5, 123.0, 0))
+        b = r.adopt(("ctx", "tzz-3", 5, 123.0, 0))
+        assert a is b
+
+    def test_resync_fences_the_global_tracer(self, global_tracer):
+        from pathway_tpu.engine.distributed import DistributedScheduler
+
+        sched = DistributedScheduler.__new__(DistributedScheduler)
+        sched._outbox = {}  # no peers: the barrier is a no-op
+        sched.resync(epoch=2)
+        assert global_tracer.epoch >= 2
+
+
+class TestCriticalPath:
+    def test_buckets_sum_to_wall_by_construction(self):
+        origin = 1000.0
+        trace = {
+            "origin_wall": origin,
+            "begin_wall": origin + 0.010,
+            "end_wall": origin + 0.100,
+            "device_s": 0.005,
+            "spans": [
+                {"name": "recv-wait:p1", "cat": "wait",
+                 "ts": int((origin + 0.02) * 1e6), "dur": 20_000, "pid": 0},
+                {"name": "pwcf-encode", "cat": "exchange",
+                 "ts": int((origin + 0.05) * 1e6), "dur": 30_000, "pid": 0},
+            ],
+        }
+        cp = tracing.critical_path(trace)
+        assert cp["wall_s"] == pytest.approx(0.100)
+        assert cp["queue_wait_s"] == pytest.approx(0.030)  # ingest + wait
+        assert cp["exchange_s"] == pytest.approx(0.030)
+        assert cp["device_s"] == pytest.approx(0.005)
+        assert cp["host_compute_s"] == pytest.approx(0.035)
+        assert not cp["clamped"]
+        total = (
+            cp["queue_wait_s"] + cp["exchange_s"]
+            + cp["device_s"] + cp["host_compute_s"]
+        )
+        assert total == pytest.approx(cp["wall_s"], rel=0.05)
+        assert [c["name"] for c in cp["chain"]] == [
+            "recv-wait:p1", "pwcf-encode"
+        ]
+
+    def test_host_residual_clamps_at_zero(self):
+        trace = {
+            "origin_wall": 0.0,
+            "begin_wall": 0.0,
+            "end_wall": 0.010,
+            "device_s": 0.0,
+            "spans": [
+                {"name": "apply:p1", "cat": "exchange",
+                 "ts": 0, "dur": 50_000, "pid": 0},
+            ],
+        }
+        cp = tracing.critical_path(trace)
+        assert cp["clamped"]
+        assert cp["host_compute_s"] == 0.0
+
+    def test_end_attributes_a_real_commit(self):
+        r = _recorder()
+        ctx = r.begin(7, origin_mono=time.monotonic() - 0.05)
+        t0 = time.perf_counter()
+        time.sleep(0.01)
+        t1 = time.perf_counter()
+        ctx.span("map<t>", "op", t0, t1)
+        ctx.span("pwcf-encode", "exchange", t1, time.perf_counter())
+        ctx.note_sink(12)
+        trace = r.end(7)
+        assert trace is not None
+        assert trace["sink_rows"] == 12
+        cp = trace["critical_path"]
+        # the 50 ms connector wait dominates and lands in queue-wait
+        assert cp["queue_wait_s"] >= 0.04
+        if not cp["clamped"]:
+            total = (
+                cp["queue_wait_s"] + cp["exchange_s"]
+                + cp["device_s"] + cp["host_compute_s"]
+            )
+            assert total == pytest.approx(cp["wall_s"], rel=0.05)
+        # the synthesized ingest-wait span leads the chain
+        assert cp["chain"][0]["name"] == "ingest-wait"
+
+
+class TestAdaptiveSampling:
+    def test_interval_doubles_under_overhead(self):
+        r = _recorder(sample=2)
+        r._adapt(overhead_s=1.0, commit_wall_s=0.001)
+        assert r.interval == 4
+        r._adapt(overhead_s=1.0, commit_wall_s=0.001)
+        assert r.interval == 8
+
+    def test_interval_capped(self):
+        r = _recorder(sample=2)
+        for _ in range(20):
+            r._adapt(overhead_s=10.0, commit_wall_s=0.001)
+        assert r.interval == 4096
+
+    def test_interval_decays_toward_base(self):
+        r = _recorder(sample=2)
+        r.interval = 8
+        r._overhead_ema = 0.0
+        r._adapt(overhead_s=0.0, commit_wall_s=1.0)
+        assert r.interval == 4
+        for _ in range(10):
+            r._overhead_ema = 0.0
+            r._adapt(overhead_s=0.0, commit_wall_s=1.0)
+        assert r.interval == r.base_interval == 2
+
+
+class TestChromeExport:
+    def _one_trace(self, r: tracing.TraceRecorder) -> dict:
+        ctx = r.begin(3, origin_mono=time.monotonic() - 0.01)
+        t0 = time.perf_counter()
+        ctx.span("filter<t>", "op", t0, time.perf_counter())
+        peer_spans = {
+            1: [{"name": "apply:p0", "cat": "exchange",
+                 "ts": ctx.spans[0]["ts"], "dur": 5, "pid": 1}],
+        }
+        return r.end(3, peer_spans=peer_spans)
+
+    def test_chrome_trace_validates_and_covers_workers(self):
+        r = _recorder()
+        trace = self._one_trace(r)
+        obj = tracing.chrome_trace([trace])
+        events = tracing.validate_chrome_trace(obj)
+        xs = [e for e in events if e.get("ph") == "X"]
+        assert {e["pid"] for e in xs} == {0, 1}
+        roots = [e for e in xs if e["name"].startswith("commit ")]
+        assert roots and roots[0]["args"]["trace"] == trace["trace_id"]
+        assert all(
+            e.get("args", {}).get("trace") == trace["trace_id"] for e in xs
+        )
+        metas = [e for e in obj["traceEvents"] if e.get("ph") == "M"]
+        assert {e["args"]["name"] for e in metas} >= {"worker 0", "worker 1"}
+
+    def test_export_writes_valid_file(self, tmp_path):
+        r = _recorder()
+        self._one_trace(r)
+        path = r.export(str(tmp_path))
+        assert path is not None and os.path.exists(path)
+        base = os.path.basename(path)
+        assert base.startswith("pathway_trace_p") and base.endswith(
+            "_001.json"
+        )
+        obj = json.loads(open(path).read())
+        tracing.validate_chrome_trace(obj)
+        other = obj["otherData"]
+        assert other["traces"] and other["traces"][0]["critical_path"]
+
+    def test_export_empty_ring_writes_nothing(self, tmp_path):
+        r = _recorder()
+        assert r.export(str(tmp_path)) is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_validate_rejects_x_without_dur(self):
+        with pytest.raises(ValueError):
+            tracing.validate_chrome_trace(
+                [{"ph": "X", "name": "a", "ts": 1, "pid": 0, "tid": 0}]
+            )
+
+    def test_validate_rejects_nonmonotonic_track(self):
+        with pytest.raises(ValueError):
+            tracing.validate_chrome_trace([
+                {"ph": "X", "name": "a", "ts": 10, "dur": 1,
+                 "pid": 0, "tid": 0},
+                {"ph": "X", "name": "b", "ts": 5, "dur": 1,
+                 "pid": 0, "tid": 0},
+            ])
+
+    def test_validate_rejects_unmatched_begin(self):
+        with pytest.raises(ValueError):
+            tracing.validate_chrome_trace(
+                [{"ph": "B", "name": "a", "ts": 1, "pid": 0, "tid": 0}]
+            )
+
+    def test_validate_rejects_unknown_phase(self):
+        with pytest.raises(ValueError):
+            tracing.validate_chrome_trace(
+                [{"ph": "Q", "name": "a", "ts": 1, "pid": 0, "tid": 0}]
+            )
+
+
+class TestFlightIntegration:
+    """Satellite: flight records/dumps reference the in-flight trace id,
+    and repeated dumps from one process never clobber each other."""
+
+    def test_flight_record_carries_trace_id(self, global_tracer):
+        ctx = global_tracer.begin(1)
+        fr = _metrics.FlightRecorder()
+        fr.record("commit", time=1)
+        (event,) = fr.snapshot()
+        assert event["trace_id"] == ctx.trace_id
+
+    def test_flight_dump_names_do_not_collide(
+        self, tmp_path, monkeypatch, global_tracer
+    ):
+        monkeypatch.setenv("PATHWAY_TPU_FLIGHT_DIR", str(tmp_path))
+        ctx = global_tracer.begin(1)
+        fr = _metrics.FlightRecorder()
+        fr.record("commit", time=1)
+        p1 = fr.dump("first")
+        p2 = fr.dump("second")
+        assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+        assert p1.endswith("_001.json") and p2.endswith("_002.json")
+        assert os.path.basename(p1).startswith("pathway_flight_p")
+        payload = json.loads(open(p1).read())
+        assert payload["trace_id"] == ctx.trace_id
+        assert payload["events"][0]["trace_id"] == ctx.trace_id
+
+    def test_no_trace_id_when_tracing_off(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_FLIGHT_DIR", str(tmp_path))
+        fr = _metrics.FlightRecorder()
+        fr.record("commit", time=1)
+        payload = json.loads(open(fr.dump("quiet")).read())
+        assert payload["trace_id"] is None
+        assert "trace_id" not in payload["events"][0]
+
+
+class TestPruneMeshMetrics:
+    def test_prunes_dead_and_out_of_width_peers(self):
+        from pathway_tpu.engine.distributed import DistributedScheduler
+
+        class _Transport:
+            dead_peers = {3}
+
+        sched = DistributedScheduler.__new__(DistributedScheduler)
+        sched.transport = _Transport()
+        sched.n_processes = 4
+        sched.mesh_metrics = {1: {}, 2: {}, 3: {}, 5: {}}
+        sched.trace_peer_spans = {1: [], 3: [], 7: []}
+        sched.prune_mesh_metrics(dead=(2,))
+        assert set(sched.mesh_metrics) == {1}
+        assert set(sched.trace_peer_spans) == {1}
+
+
+class TestCli:
+    def test_trace_subcommand_reads_export_dir(
+        self, tmp_path, capsys, global_tracer
+    ):
+        from pathway_tpu import cli
+
+        ctx = global_tracer.begin(1, origin_mono=time.monotonic() - 0.01)
+        t0 = time.perf_counter()
+        ctx.span("filter<t>", "op", t0, time.perf_counter())
+        global_tracer.end(1)
+        assert global_tracer.export(str(tmp_path)) is not None
+        assert cli.main(["trace", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert ctx.trace_id in out
+        assert "wall=" in out
+
+    def test_trace_subcommand_json_mode(
+        self, tmp_path, capsys, global_tracer
+    ):
+        from pathway_tpu import cli
+
+        global_tracer.begin(1)
+        global_tracer.end(1)
+        path = global_tracer.export(str(tmp_path))
+        assert cli.main(["trace", "--json", path]) == 0
+        reports = json.loads(capsys.readouterr().out)
+        assert reports and reports[0]["file"] == path
+
+    def test_trace_subcommand_rejects_invalid_file(self, tmp_path, capsys):
+        from pathway_tpu import cli
+
+        bad = tmp_path / "pathway_trace_bad.json"
+        bad.write_text(json.dumps(
+            {"traceEvents": [{"ph": "Q", "name": "a", "ts": 1}]}
+        ))
+        assert cli.main(["trace", str(bad)]) == 2
+
+    def test_stats_renders_histogram_percentiles(self, capsys):
+        from pathway_tpu import cli
+        from pathway_tpu.internals.monitoring import (
+            MonitoringHttpServer,
+            MonitoringLevel,
+            StatsMonitor,
+        )
+
+        h = _metrics.REGISTRY.histogram(
+            "test_trace_cli_seconds", "cli percentile fixture",
+            buckets=(0.1, 1.0, 10.0),
+        )
+        for v in (0.05, 0.05, 0.5, 0.5, 0.5, 5.0):
+            h.observe(v)
+        monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+        server = MonitoringHttpServer(monitor, port=0)
+        try:
+            assert cli.main(["stats", str(server.port)]) == 0
+        finally:
+            server.stop()
+        out = capsys.readouterr().out
+        header = next(
+            line for line in out.splitlines() if "family" in line
+        )
+        assert "p50" in header and "p95" in header and "p99" in header
+        row = next(
+            line for line in out.splitlines()
+            if "test_trace_cli_seconds" in line
+        )
+        # p50 falls in the (0.1, 1.0] bucket, p99 in (1.0, 10.0]
+        assert "-" not in row.split()[-3:]
+
+
+class TestMeshAssembledTrace:
+    def test_three_process_trace_covers_ingest_to_sink(self, tmp_path):
+        """3-process TCP mesh with tracing on: the leader's exported
+        Chrome trace is valid, spans every worker, and covers the whole
+        commit path (ingest wait -> operators -> exchange -> sink) under
+        one consistent trace id."""
+        from pathway_tpu.cli import spawn
+
+        indir = tmp_path / "in"
+        indir.mkdir()
+        with open(indir / "words.csv", "w") as fh:
+            fh.write("word\n")
+            fh.writelines(f"w{i % 17}\n" for i in range(600))
+        out = tmp_path / "out.csv"
+        trace_dir = tmp_path / "traces"
+        trace_dir.mkdir()
+        prog = tmp_path / "prog.py"
+        prog.write_text(
+            textwrap.dedent(
+                """
+                import pathway_tpu as pw
+
+                words = pw.io.csv.read(
+                    {indir!r},
+                    schema=pw.schema_from_types(word=str),
+                    mode="static",
+                )
+                counts = words.groupby(pw.this.word).reduce(
+                    word=pw.this.word, count=pw.reducers.count()
+                )
+                pw.io.csv.write(counts, {out!r})
+                pw.run()
+                """.format(indir=str(indir), out=str(out))
+            )
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PATHWAY_TPU_TRACE"] = "1"
+        env["PATHWAY_TPU_TRACE_SAMPLE"] = "1"
+        env["PATHWAY_TPU_TRACE_DIR"] = str(trace_dir)
+        env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+        rc = spawn(
+            sys.executable,
+            [str(prog)],
+            threads=1,
+            processes=3,
+            first_port=_free_port_base(3),
+            env=env,
+        )
+        assert rc == 0
+        exports = sorted(trace_dir.glob("pathway_trace_p0_*.json"))
+        assert exports, "leader exported no trace file"
+
+        pids: set[int] = set()
+        cats: set[str] = set()
+        ids_per_trace: dict[str, set] = {}
+        for path in exports:
+            obj = json.loads(path.read_text())
+            events = tracing.validate_chrome_trace(obj)
+            for e in events:
+                if e.get("ph") != "X":
+                    continue
+                pids.add(e["pid"])
+                if e.get("cat"):
+                    cats.add(e["cat"])
+                tid = e.get("args", {}).get("trace")
+                assert tid, f"X event without trace id: {e['name']}"
+                ids_per_trace.setdefault(tid, set()).add(e["pid"])
+            for t in obj["otherData"]["traces"]:
+                cp = t["critical_path"]
+                if not cp["clamped"]:
+                    total = (
+                        cp["queue_wait_s"] + cp["exchange_s"]
+                        + cp["device_s"] + cp["host_compute_s"]
+                    )
+                    assert total == pytest.approx(
+                        cp["wall_s"], rel=0.05, abs=1e-6
+                    )
+        # every worker contributed spans to the assembled trace set
+        assert pids == {0, 1, 2}, pids
+        assert "op" in cats and "sink" in cats
+        assert cats & {"exchange", "wait"}, cats
+        # the data commit's trace spans multiple workers
+        assert any(len(p) >= 2 for p in ids_per_trace.values())
+
+
+# -- trace survival across worker kill -> recovery ---------------------------
+
+TRACED_CHAOS_PROGRAM = """
+    import os
+    import pathway_tpu as pw
+    import pathway_tpu.engine.connectors as _conn
+    from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+    _orig_poll = _conn.FsReader.poll
+    def _poll(self):
+        entries, done = _orig_poll(self)
+        if not entries and os.path.exists({stop!r}):
+            done = True
+        return entries, done
+    _conn.FsReader.poll = _poll
+
+    pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    words = pw.io.plaintext.read(
+        {indir!r}, mode="streaming", persistent_id="w"
+    )
+    counts = words.groupby(words.data).reduce(
+        word=words.data, cnt=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, {out!r})
+    pw.run(
+        with_http_server=(pid == 0),
+        monitoring_server_port=int(os.environ["TEST_METRICS_PORT_BASE"]),
+        persistence_config=Config(
+            Backend.filesystem({store!r}),
+            persistence_mode=PersistenceMode.OPERATOR_PERSISTING,
+        ),
+    )
+"""
+
+
+class TestTraceSurvivesRecovery:
+    def test_kill_recover_keeps_traces_and_prunes_scrape(self, tmp_path):
+        """SIGKILL worker 1 at a commit boundary mid-stream with tracing
+        on (sample=1): flight forensics reference trace ids, the leader
+        keeps exporting well-formed traces after the recovery epoch, and
+        a LIVE leader scrape after recovery shows only live worker label
+        sets (the stale-incarnation prune)."""
+        from pathway_tpu.cli import spawn
+
+        indir = tmp_path / "in"
+        indir.mkdir()
+        out = tmp_path / "out.csv"
+        stop = tmp_path / "stop"
+        flight_dir = tmp_path / "flight"
+        flight_dir.mkdir()
+        trace_dir = tmp_path / "traces"
+        trace_dir.mkdir()
+        prog = tmp_path / "prog.py"
+        prog.write_text(
+            textwrap.dedent(
+                TRACED_CHAOS_PROGRAM.format(
+                    indir=str(indir),
+                    out=str(out),
+                    store=str(tmp_path / "store"),
+                    stop=str(stop),
+                )
+            )
+        )
+        metrics_port = _free_port_base(1)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+        # more generous than the test_fault_tolerance defaults: this file
+        # sorts last in the suite, where a restarted worker's cold
+        # re-import of the full stack is at its slowest
+        env["PATHWAY_TPU_MESH_TIMEOUT"] = "60"
+        env["PATHWAY_TPU_RECOVER_DEADLINE"] = "90"
+        env["PATHWAY_TPU_RECOVER"] = "1"
+        env["PATHWAY_TPU_FAULT_PLAN"] = json.dumps(
+            {"seed": 7, "faults": [
+                {"type": "kill", "process": 1, "at_commit": 3},
+            ]}
+        )
+        env["PATHWAY_TPU_FLIGHT_DIR"] = str(flight_dir)
+        env["PATHWAY_TPU_TRACE"] = "1"
+        env["PATHWAY_TPU_TRACE_SAMPLE"] = "1"
+        env["PATHWAY_TPU_TRACE_DIR"] = str(trace_dir)
+        env["TEST_METRICS_PORT_BASE"] = str(metrics_port)
+        result: dict = {}
+
+        def run() -> None:
+            result["rc"] = spawn(
+                sys.executable,
+                [str(prog)],
+                threads=1,
+                processes=3,
+                first_port=_free_port_base(3),
+                env=env,
+            )
+
+        scraped: dict = {}
+        th = threading.Thread(target=run)
+        th.start()
+        try:
+            for k in range(7):
+                lines = [f"w{k}_{i}" for i in range(3)] + ["common"]
+                (indir / f"f{k}.txt").write_text("\n".join(lines) + "\n")
+                marker = f"w{k}_0"
+                deadline = time.monotonic() + 90
+                while time.monotonic() < deadline:
+                    if out.exists() and marker in out.read_text():
+                        break
+                    if not th.is_alive():
+                        raise AssertionError(
+                            f"mesh exited early (rc={result.get('rc')}) "
+                            f"before file {k} committed"
+                        )
+                    time.sleep(0.05)
+                else:
+                    raise AssertionError(
+                        f"file {k} never reached the sink "
+                        f"(rc={result.get('rc')})"
+                    )
+                if k == 5:
+                    # well past the at_commit=3 kill: the mesh has
+                    # recovered — scrape the live leader endpoint
+                    scraped["body"] = (
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{metrics_port}/metrics",
+                            timeout=10,
+                        ).read().decode()
+                    )
+            stop.write_text("")
+            th.join(timeout=90)
+        finally:
+            stop.write_text("")
+            th.join(timeout=10)
+        assert not th.is_alive(), "mesh did not shut down after STOP"
+        assert result.get("rc") == 0, f"mesh exited rc={result.get('rc')}"
+
+        # (1) post-recovery scrape: conformant, and every worker label
+        # names a live incarnation — no stale sets from the dead peer
+        families = _metrics.validate_exposition(scraped["body"])
+        workers: set[str] = set()
+        for fam in families.values():
+            for _n, labels, _v in fam["samples"]:
+                if "worker" in labels:
+                    workers.add(labels["worker"])
+        assert workers == {"0", "1", "2"}, workers
+
+        # (2) flight forensics reference trace ids (sample=1 means every
+        # commit event carries one; the dump's own trace_id is the
+        # in-flight commit when the peer died mid-commit)
+        dumps = list(flight_dir.glob("pathway_flight_*.json"))
+        assert dumps, "no flight-recorder dumps on peer death"
+        ids: set[str] = set()
+        for p in dumps:
+            payload = json.loads(p.read_text())
+            assert "trace_id" in payload
+            if payload["trace_id"]:
+                ids.add(payload["trace_id"])
+            for event in payload["events"]:
+                if event.get("trace_id"):
+                    ids.add(event["trace_id"])
+        assert ids, "no flight event references a trace id"
+
+        # (3) the leader's export validates and contains post-recovery
+        # traces stamped with the bumped epoch
+        exports = sorted(trace_dir.glob("pathway_trace_p0_*.json"))
+        assert exports, "leader exported no trace file"
+        epochs: list[int] = []
+        for path in exports:
+            obj = json.loads(path.read_text())
+            tracing.validate_chrome_trace(obj)
+            epochs += [t["epoch"] for t in obj["otherData"]["traces"]]
+        assert epochs and max(epochs) >= 1, epochs

@@ -17,13 +17,19 @@ problem):
    plane fully on (per-operator probes + StatsMonitor + latency
    histogram + flight recorder) vs fully off; FAILs when the overhead
    exceeds 5% (observability must be effectively free);
-5. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
+5. trace overhead — the same workload with sampled distributed tracing
+   at the default interval vs off; FAILs when the overhead exceeds 5%
+   (the same bar the metrics plane clears);
+6. trace export — a small traced program runs end-to-end and the
+   exported file must satisfy the Chrome trace-event schema invariants
+   (complete X / matched B-E events, monotonic timestamps per track);
+7. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
    mesh with operator persistence: a follower SIGKILL (supervised
    restart + rollback), a LEADER SIGKILL (epoch-fenced election
    failover), and a SIGKILL injected while a live N→M rescale is
    quiescing; every leg must land the exact fault-free sink, within a
    bounded wall budget;
-6. sanitized native build — recompile ``native/enginecore.cpp`` with
+8. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
    (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
@@ -170,6 +176,123 @@ def step_metrics_overhead() -> str:
     status = PASS if overhead <= 5.0 else FAIL
     _report(name, status, detail)
     return status
+
+
+def step_trace_overhead() -> str:
+    """Gate the tracing tax: bench_dataflow.trace_overhead_leg compares
+    the fused_chain workload with sampled span recording at the default
+    interval vs off (interleaved best-of-4 each way); >5% is a FAIL."""
+    name = "trace overhead (fused_chain, default sampling vs off)"
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('TRACE_OVERHEAD_JSON ' + json.dumps("
+        "b.trace_overhead_leg()()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.SubprocessError as e:
+        _report(name, FAIL, f"bench leg did not finish: {e}")
+        return FAIL
+    import json
+
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("TRACE_OVERHEAD_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        _report(name, FAIL, f"bench leg exit {proc.returncode}")
+        return FAIL
+    overhead = payload["overhead_pct"]
+    detail = (
+        f"{overhead:+.2f}% "
+        f"(off {payload['trace_off_s']}s, on {payload['trace_on_s']}s, "
+        f"1/{payload['sample_interval']} sampling)"
+    )
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
+    return status
+
+
+def step_trace_export() -> str:
+    """Run a small traced program end-to-end (every commit sampled) and
+    validate the exported file against the Chrome trace-event schema
+    invariants: JSON parses, every event is a complete X (or matched
+    B/E) with non-negative duration, timestamps monotonic per track."""
+    name = "trace export (Chrome trace-event schema)"
+    program = (
+        "import pathway_tpu as pw\n"
+        "import os\n"
+        "d = os.environ['TRACE_CHECK_IN']\n"
+        "t = pw.io.csv.read(d, schema=pw.schema_from_types(k=int, v=int),"
+        " mode='static')\n"
+        "t2 = t.select(pw.this.k, w=pw.this.v * 2)\n"
+        "agg = t2.groupby(pw.this.k).reduce(pw.this.k,"
+        " total=pw.reducers.sum(pw.this.w))\n"
+        "pw.io.csv.write(agg, os.path.join(d, '..', 'out.csv'))\n"
+        "pw.run(monitoring_level=pw.MonitoringLevel.NONE)\n"
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        in_dir = os.path.join(tmp, "in")
+        trace_dir = os.path.join(tmp, "traces")
+        os.makedirs(in_dir)
+        os.makedirs(trace_dir)
+        with open(os.path.join(in_dir, "a.csv"), "w") as fh:
+            fh.write("k,v\n")
+            for i in range(200):
+                fh.write(f"{i % 5},{i}\n")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", program],
+                cwd=REPO,
+                env={
+                    **os.environ,
+                    "JAX_PLATFORMS": "cpu",
+                    "PATHWAY_TPU_TRACE": "1",
+                    "PATHWAY_TPU_TRACE_SAMPLE": "1",
+                    "PATHWAY_TPU_TRACE_DIR": trace_dir,
+                    "TRACE_CHECK_IN": in_dir,
+                    "PYTHONPATH": REPO,
+                },
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+        except subprocess.SubprocessError as e:
+            _report(name, FAIL, f"traced program did not finish: {e}")
+            return FAIL
+        if proc.returncode != 0:
+            sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+            _report(name, FAIL, f"traced program exit {proc.returncode}")
+            return FAIL
+        import glob
+        import json
+
+        sys.path.insert(0, REPO)
+        from pathway_tpu.internals import tracing
+
+        paths = sorted(glob.glob(os.path.join(trace_dir, "pathway_trace_*.json")))
+        if not paths:
+            _report(name, FAIL, "no trace file exported")
+            return FAIL
+        events = 0
+        for path in paths:
+            try:
+                with open(path) as fh:
+                    obj = json.load(fh)
+                events += len(tracing.validate_chrome_trace(obj))
+            except ValueError as e:
+                _report(name, FAIL, f"{os.path.basename(path)}: {e}")
+                return FAIL
+        _report(name, PASS, f"{len(paths)} file(s), {events} events")
+        return PASS
 
 
 def _sanitizer_runtime(gpp: str, name: str) -> str | None:
@@ -352,6 +475,8 @@ def main(argv=None) -> int:
         step_analyzer(),
         step_optimize_off(),
         step_metrics_overhead(),
+        step_trace_overhead(),
+        step_trace_export(),
         step_chaos_gate(),
     ]
     if args.skip_sanitized:
