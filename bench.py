@@ -376,6 +376,8 @@ def pipeline_leg() -> dict:
     def pct(p: float) -> float:
         return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))] if lat_ms else float("nan")
 
+    from pathway_tpu.engine import device_pipeline as _device_pipeline
+
     return {
         "pipeline_docs_per_sec": docs_per_sec,
         "query_p50_ms": pct(0.50),
@@ -385,6 +387,7 @@ def pipeline_leg() -> dict:
         "n_queries": len(latencies),
         "n_query_timeouts": len(timeouts),
         "critical_path": trace_summary,
+        "device_pipeline": _device_pipeline.PIPELINE.stats(),
         "_capacity": capacity,
         "_embedder": embedder,  # reused by the device-latency leg
     }
@@ -1052,9 +1055,15 @@ def _probe_device_retrying() -> None:
             os.environ.get("BENCH_DEVICE_PROBE_S", "1800"),
         )
     )
-    # the probe window must fit inside the wall budget with headroom for
-    # the outage JSON + dataflow join (the BENCH_r05 failure mode: the
-    # default 1800s window alone overran the harness deadline)
+    # a dead probe must not eat the whole window (BENCH_r05: rc=124 with
+    # ZERO parsed legs): with a wall budget set, probing gets at most a
+    # fraction of it — the rest stays reserved for the host dataflow
+    # legs, so the run always emits their JSON inside the deadline
+    if WALL_BUDGET_S > 0:
+        fraction = float(os.environ.get("BENCH_PROBE_FRACTION", "0.25"))
+        window = min(window, WALL_BUDGET_S * max(0.05, min(1.0, fraction)))
+    # ... and must always fit inside what remains of the budget, with
+    # headroom for the outage JSON + dataflow join
     window = _budget_bounded(window, headroom=10.0)
     gap = float(os.environ.get("BENCH_REPROBE_GAP_S", "120"))
     start = time.time()
@@ -1166,6 +1175,9 @@ def _probe_device_retrying() -> None:
                 "unit": "docs/sec",
                 "vs_baseline": None,
                 "error": error,
+                # structured marker: downstream BENCH_r* parsers key on
+                # this instead of regexing the error text
+                "device_unreachable": True,
                 "extra": extra,
             }
         ),

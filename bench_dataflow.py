@@ -488,6 +488,103 @@ def trace_overhead_leg():
     return leg
 
 
+def async_device_overhead_leg():
+    """The fused_chain workload with one fake device batch injected per
+    commit — a plain numpy handle whose decay is a no-cost ``asarray``
+    — comparing the async pipeline machinery (staging queue + Condition
+    + completion worker, PATHWAY_TPU_ASYNC_DEVICE=1) against the inline
+    synchronous decay (=0). With device work reduced to nothing, the
+    measured delta is exactly what the pipeline's bookkeeping costs a
+    commit; tools/check.py FAILs above 5%, the same gate as
+    metrics_overhead/trace_overhead."""
+    n_stages = 8
+    n_base, n_commits, delta = 20_000, 60, 1000
+    if _analyze_only():
+        n_base, n_commits = 5_000, 1
+    rows = [(ref_scalar(i), (i, float(i) * 0.5)) for i in range(n_base)]
+
+    def once(async_on: bool) -> float:
+        import numpy as np
+
+        from pathway_tpu.engine import device_pipeline as _dp
+        from pathway_tpu.engine.device import DeviceBatchHandle
+
+        scope = Scope()
+        sess = scope.input_session(2)
+        cur = scope.expression_table(
+            sess,
+            [
+                ex.ColumnRef(0),
+                ex.ColumnRef(1),
+                ex.Binary(">", ex.ColumnRef(0), ex.Const(100)),
+            ],
+        )
+        cur = scope.filter_table(cur, 2)
+        for _ in range(n_stages):
+            cur = scope.expression_table(
+                cur,
+                [
+                    ex.ColumnRef(0),
+                    ex.Binary(
+                        "+",
+                        ex.Binary(
+                            "*", ex.ColumnRef(1), ex.Const(1.0000001)
+                        ),
+                        ex.Const(0.5),
+                    ),
+                ],
+            )
+        sched = Scheduler(scope, probe=False)
+        prev = os.environ.get("PATHWAY_TPU_ASYNC_DEVICE")
+        os.environ["PATHWAY_TPU_ASYNC_DEVICE"] = "1" if async_on else "0"
+        fake = np.zeros((delta, 16), np.float32)
+        try:
+            _dp.PIPELINE.configure()
+            for key, row in rows:
+                sess.insert(key, row)
+            sched.commit()
+            if _analyze_only():
+                return 1.0
+            t = 0.0
+            handles = []  # keep the lazy handles alive like real rows do
+            for c in range(n_commits):
+                base = (c * delta) % (n_base - delta)
+                for i in range(base, base + delta):
+                    key, row = rows[i]
+                    sess.remove(key, row)
+                    sess.insert(key, (row[0], row[1] + 1.0))
+                t0 = time.perf_counter()
+                # the fake device batch this commit "produced": staging /
+                # decay runs inside sched.commit's boundary either way
+                handles.append(DeviceBatchHandle(fake))
+                sched.commit()
+                t += time.perf_counter() - t0
+            _dp.PIPELINE.drain()
+            return t
+        finally:
+            if prev is None:
+                os.environ.pop("PATHWAY_TPU_ASYNC_DEVICE", None)
+            else:
+                os.environ["PATHWAY_TPU_ASYNC_DEVICE"] = prev
+            _dp.PIPELINE.configure()
+
+    def leg() -> dict:
+        # interleaved off/on pairs: machine drift lands on both sides
+        t_off = min(once(False) for _ in range(1))
+        t_on = min(once(True) for _ in range(1))
+        for _ in range(3):
+            t_off = min(t_off, once(False))
+            t_on = min(t_on, once(True))
+        return {
+            "rows": n_commits * 2 * delta,
+            "async_off_s": round(t_off, 4),
+            "async_on_s": round(t_on, 4),
+            "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
+        }
+
+    return leg
+
+
 def pushdown_wide_source():
     """Wide producer (12 computed columns, per-row Python UDFs), two
     narrow consumers (3 distinct columns used between them): projection
@@ -1053,6 +1150,9 @@ def run_all(emit=None) -> dict:
     record("metrics_overhead", metrics_overhead_leg()())
     # tracing tax: sampled span recording at the default interval vs off
     record("trace_overhead", trace_overhead_leg()())
+    # async device pipeline tax: staging/completion machinery with a
+    # synchronous fake device vs the inline decay path
+    record("async_device_overhead", async_device_overhead_leg()())
     if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
         try:
             leg = distributed_leg()
@@ -1154,6 +1254,7 @@ def main() -> None:
         ("pushdown_wide_source", pushdown_wide_source),
         ("metrics_overhead", metrics_overhead_leg),
         ("trace_overhead", trace_overhead_leg),
+        ("async_device_overhead", async_device_overhead_leg),
     ):
         print(json.dumps({"workload": name, **make()()}))
     # distributed leg: dtype-tagged columnar frames vs pickled row entries

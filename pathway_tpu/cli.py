@@ -236,6 +236,7 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
     # -- per-worker mesh table -----------------------------------------------
     sums: dict[str, dict[str, float]] = {}
     lat: dict[str, list] = {}
+    dev_lat: dict[str, list] = {}
 
     def add(worker: str, col: str, value: float) -> None:
         sums.setdefault(worker, {})[col] = (
@@ -249,6 +250,8 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
         "pathway_operator_time_seconds": "op_ms",
         "pathway_exchange_events_total": "exchanges",
         "pathway_connector_entries_total": "ingested",
+        "pathway_device_queue_depth": "dev_q",
+        "pathway_device_occupancy_ratio": "dev_occ",
     }
     for fam_name, fam in families.items():
         col = col_of.get(fam_name)
@@ -261,6 +264,11 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
                 and name.endswith("_bucket")
             ):
                 lat.setdefault(w, []).append((float(labels["le"]), value))
+            elif (
+                fam_name == "pathway_device_dispatch_complete_seconds"
+                and name.endswith("_bucket")
+            ):
+                dev_lat.setdefault(w, []).append((float(labels["le"]), value))
     for w, buckets in lat.items():
         buckets.sort()
         sums.setdefault(w, {})
@@ -269,12 +277,20 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
             qv = _hist_quantile(buckets, q)
             if qv is not None:
                 sums[w][col] = qv * 1000.0
+    for w, buckets in dev_lat.items():
+        # device-pipeline dispatch->complete latency (async device stage)
+        buckets.sort()
+        sums.setdefault(w, {})
+        qv = _hist_quantile(buckets, 0.99)
+        if qv is not None:
+            sums[w]["dev_p99_ms"] = qv * 1000.0
 
     print(f"scraped {url}: {len(families)} families")
     if sums:
         cols = [
             "out_rows", "ingested", "op_rows", "batches", "op_ms",
             "exchanges", "lat_p50_ms", "lat_p99_ms", "lat_n",
+            "dev_q", "dev_occ", "dev_p99_ms",
         ]
         header = ["worker"] + cols
         rows = []
@@ -283,7 +299,7 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
             rows.append(
                 [w if w else "(local)"]
                 + [
-                    (f"{vals[c]:.2f}" if c.endswith("_ms")
+                    (f"{vals[c]:.2f}" if c.endswith("_ms") or c == "dev_occ"
                      else f"{vals[c]:.0f}") if c in vals else "-"
                     for c in cols
                 ]

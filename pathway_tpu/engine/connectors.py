@@ -336,6 +336,21 @@ class InputDriver:
         self._sync_backlog: Any = _collections.deque()
         self._done_pending = False
 
+    def effective_autocommit_s(self) -> float:
+        """The autocommit window scaled by device-pipeline pressure: a
+        congested device stage wants fewer, fatter commits, so the
+        adaptive controller widens ingest windows (up to 4x) while
+        commits are staged in flight. Host-only programs and the
+        synchronous path (``PATHWAY_TPU_ASYNC_DEVICE=0``) always see the
+        configured window unchanged; a 0-window connector (queries)
+        stays immediate — scaling zero keeps retrieval overlapped with
+        ingest instead of stalled behind it."""
+        if self.autocommit_s <= 0.0:
+            return self.autocommit_s
+        from pathway_tpu.engine import device_pipeline
+
+        return self.autocommit_s * device_pipeline.ingest_window_scale()
+
     def _key_for(self, values: tuple, source_id: str, index: int) -> Pointer:
         if self.pk is not None:
             return ref_scalar(*[values[i] for i in self.pk])

@@ -448,14 +448,31 @@ class DeviceBatchHandle:
 
 
 def decay_device_batches() -> None:
-    """End-of-commit hook (called by every scheduler): download + release
-    all device batches produced this commit. Keeps HBM bounded by one
-    commit while letting any device operator in the commit consume the
-    batch transfer-free regardless of sweep order."""
+    """Synchronous end-of-commit hook: download + release all device
+    batches produced this commit. Keeps HBM bounded by one commit while
+    letting any device operator in the commit consume the batch
+    transfer-free regardless of sweep order. This is the bit-exact spec
+    the async pipeline (engine/device_pipeline.py) is measured against;
+    schedulers now route the boundary through
+    ``device_pipeline.commit_boundary`` which falls back to this
+    behaviour under ``PATHWAY_TPU_ASYNC_DEVICE=0``."""
     if _LIVE_HANDLES:
         for handle in list(_LIVE_HANDLES):
             handle.decay()
         _LIVE_HANDLES.clear()
+
+
+def stage_device_batches() -> list:
+    """Detach and return this commit's live device batches without
+    decaying them — the async pipeline's staging primitive. The caller
+    (``DevicePipeline.commit_boundary``) owns completion; the WeakSet is
+    cleared so the next commit accumulates a fresh generation. Returns
+    ``[]`` on host-only commits, making the boundary near-free."""
+    if not _LIVE_HANDLES:
+        return []
+    handles = list(_LIVE_HANDLES)
+    _LIVE_HANDLES.clear()
+    return handles
 
 
 class LazyDeviceVector:

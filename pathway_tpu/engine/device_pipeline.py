@@ -1,0 +1,500 @@
+"""Async device pipeline: double-buffered commit staging between the host
+dataflow and device work.
+
+The synchronous engine serializes host and device per commit: every
+scheduler sweep ends in :func:`~pathway_tpu.engine.device.decay_device_batches`,
+a blocking device->host download of every device batch the commit
+produced, so connector ingest for commit N+1 cannot start until commit
+N's device work (embed dispatch, index scatter, D2H DMA) has fully
+retired.  On the streaming RAG bench that barrier is most of the ~20x
+gap between `pw.run` throughput and the device embed ceiling.
+
+This module turns the barrier into a pipeline stage:
+
+- **staging queue (host->device)** — at each commit boundary the
+  scheduler hands the commit's live :class:`DeviceBatchHandle` set to
+  :meth:`DevicePipeline.commit_boundary` instead of decaying it inline.
+  The handles' D2H DMA is *started* (``copy_to_host_async``) but not
+  awaited; the host thread returns to the connector poll loop and
+  ingests commit N+1 while the device crunches commit N.  jax dispatch
+  stays async end to end — the only ``block_until_ready``-equivalent
+  wait is the completion worker's ``decay()``.
+- **completion queue (device->host)** — a single daemon worker pops
+  staged commits strictly FIFO and completes them (awaits the DMA,
+  releases HBM), so commit completion is **in order** by construction:
+  commit N's device effects are fully host-resident before commit N+1's
+  are.  Exactly-once/checkpoint semantics are preserved by the runner
+  calling :meth:`drain_until` before persistence/snapshot ``on_commit``
+  hooks — a checkpoint for commit N can only be cut after N completed.
+- **double buffering / backpressure** — at most ``depth`` commits
+  (default 2, ``PATHWAY_TPU_DEVICE_INFLIGHT``) may be in flight;
+  staging commit N+depth blocks until commit N retires, bounding HBM to
+  ``depth`` commits' worth of batches (the sync path bounds it to 1).
+- **feedback-driven batch sizing** — :class:`AdaptiveBatchController`
+  reads the PR-5 queue-depth gauge and the PR-8 critical-path buckets
+  each device commit and adapts the device micro-batch size (consumed
+  by ``BatchExecutor`` via :func:`suggested_batch_size`) and the
+  connector autocommit window scale (:func:`ingest_window_scale`,
+  consumed by ``InputDriver.effective_autocommit_s``): when the device
+  stage is the bottleneck it grows batches/windows to amortize dispatch,
+  when the host residual dominates it shrinks them to start overlap
+  earlier — TeleRAG-style lookahead, driven by measurement instead of
+  a static schedule.
+
+``PATHWAY_TPU_ASYNC_DEVICE=0`` is the escape hatch: the commit boundary
+then decays inline, bit-identical to the pre-pipeline engine (PR-2
+style: the synchronous path stays the spec; tests/test_device_pipeline.py
+holds the two modes to bit-identical sinks on all three schedulers).
+
+Occupancy is first-class: ``pathway_device_queue_depth`` (staged +
+in-completion commits), ``pathway_device_occupancy_ratio`` (EMA share
+of wall time the completion stage is busy), and the
+``pathway_device_dispatch_complete_seconds`` histogram (commit-boundary
+dispatch -> completion retire latency) all live on the PR-5 registry,
+so they ride the mesh snapshot piggyback to the leader ``/metrics``
+and render in ``cli stats``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from collections import deque
+
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing as _tracing
+
+__all__ = [
+    "AdaptiveBatchController",
+    "DevicePipeline",
+    "PIPELINE",
+    "async_enabled",
+    "commit_boundary",
+    "drain",
+    "drain_until",
+    "reset",
+    "suggested_batch_size",
+    "ingest_window_scale",
+]
+
+#: dispatch->complete latency bucket bounds, seconds — device commits
+#: retire in the 100us..1s band on live hardware, slower over remote links
+DISPATCH_BUCKETS = (
+    0.0001,
+    0.00025,
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+)
+
+
+def async_enabled() -> bool:
+    """The escape hatch: ``PATHWAY_TPU_ASYNC_DEVICE=0`` restores the
+    synchronous inline-decay commit boundary (the bit-exact spec)."""
+    return os.environ.get("PATHWAY_TPU_ASYNC_DEVICE", "1").lower() not in (
+        "0",
+        "false",
+        "no",
+    )
+
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+class AdaptiveBatchController:
+    """Feedback loop closing PRs 5-8's measurement machinery into sizing.
+
+    Inputs, read once per *device* commit (host-only commits never touch
+    the controller):
+
+    - pipeline pressure — staged depth and whether staging had to block
+      on the in-flight bound (the device stage is saturated);
+    - completion-stage occupancy (EMA, 0..1);
+    - the host queue-depth gauge (``pathway_queue_depth``, PR 5);
+    - the last sampled commit's critical-path buckets (PR 8), when
+      tracing is on — ``host_compute_s`` vs ``device_s`` decides which
+      side of the pipe is the bottleneck when occupancy is ambiguous.
+
+    Outputs:
+
+    - ``batch_size`` — suggested device micro-batch rows; consumed by
+      ``BatchExecutor`` (it only ever *narrows* the user's configured
+      ``max_batch_size``, never exceeds it);
+    - ``depth`` — staged-commit bound (double buffering by default);
+    - ``window_scale`` — multiplier on connector autocommit windows
+      (1.0..4.0): a saturated device stage wants fewer, fatter commits.
+
+    The rules are deliberately monotone and clamped so the loop cannot
+    oscillate unboundedly: saturation doubles the batch and widens the
+    window; an idle completion stage with a host-bound critical path
+    halves the batch and narrows the window back toward 1.0.
+    """
+
+    #: occupancy below which the device stage counts as starved
+    IDLE_OCCUPANCY = 0.25
+
+    def __init__(self) -> None:
+        self.min_batch = _env_int("PATHWAY_TPU_DEVICE_BATCH_MIN", 32)
+        self.max_batch = _env_int("PATHWAY_TPU_DEVICE_BATCH_MAX", 65536)
+        self.batch_size = _env_int(
+            "PATHWAY_TPU_DEVICE_BATCH", 1024, floor=self.min_batch
+        )
+        self.depth = _env_int("PATHWAY_TPU_DEVICE_INFLIGHT", 2)
+        self.window_scale = 1.0
+        self.ticks = 0
+        self.grows = 0
+        self.shrinks = 0
+        self._queue_gauge = None
+
+    def _host_queue_depth(self) -> float:
+        g = self._queue_gauge
+        if g is None:
+            g = self._queue_gauge = _metrics.REGISTRY.gauge(
+                "pathway_queue_depth",
+                "operators with pending delta batches (backpressure)",
+            )
+        return g.value
+
+    @staticmethod
+    def _last_critical_path() -> dict | None:
+        if not _tracing.TRACER.enabled:
+            return None
+        traces = _tracing.TRACER.traces()
+        return traces[-1]["critical_path"] if traces else None
+
+    def observe(
+        self, *, staged_depth: int, blocked: bool, occupancy: float
+    ) -> None:
+        """One device-commit tick of the feedback loop."""
+        self.ticks += 1
+        if blocked or staged_depth >= self.depth:
+            # the completion stage is the bottleneck: amortize dispatch
+            # with fatter device batches and fewer, larger commits
+            self.batch_size = min(self.max_batch, self.batch_size * 2)
+            self.window_scale = min(4.0, self.window_scale * 1.25)
+            self.grows += 1
+            return
+        if occupancy < self.IDLE_OCCUPANCY:
+            cp = self._last_critical_path()
+            host_bound = cp is None or cp.get("host_compute_s", 0.0) >= cp.get(
+                "device_s", 0.0
+            )
+            if host_bound and self._host_queue_depth() >= 0.0:
+                # device starved while the host sweats: smaller batches
+                # reach the device sooner, and the ingest window relaxes
+                # back toward its configured value
+                if self.batch_size > self.min_batch:
+                    self.batch_size = max(
+                        self.min_batch, self.batch_size // 2
+                    )
+                    self.shrinks += 1
+                self.window_scale = max(1.0, self.window_scale / 1.25)
+
+    def stats(self) -> dict:
+        return {
+            "batch_size": self.batch_size,
+            "depth": self.depth,
+            "window_scale": round(self.window_scale, 3),
+            "ticks": self.ticks,
+            "grows": self.grows,
+            "shrinks": self.shrinks,
+        }
+
+
+class DevicePipeline:
+    """Process-wide staging/completion pipe (singleton: :data:`PIPELINE`).
+
+    Hot-path contract: a commit with no device batches costs one WeakSet
+    truthiness test (identical to the sync path) — the lock, the worker
+    thread, and the metrics handles are only touched by device commits.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        #: FIFO of (commit_time, handles, dispatch_perf) awaiting completion
+        self._staged: deque = deque()
+        self._active_time: int | None = None
+        self._completed_time = -1
+        self._worker: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._busy_s = 0.0
+        self._occ_mark: float | None = None
+        self._occupancy = 0.0
+        self.controller = AdaptiveBatchController()
+        self._g_depth = _metrics.REGISTRY.gauge(
+            "pathway_device_queue_depth",
+            "device-pipeline commits staged or completing",
+        )
+        self._g_occ = _metrics.REGISTRY.gauge(
+            "pathway_device_occupancy_ratio",
+            "EMA share of wall time the device completion stage is busy",
+        )
+        self._h_latency = _metrics.REGISTRY.histogram(
+            "pathway_device_dispatch_complete_seconds",
+            "device commit dispatch -> in-order completion latency",
+            buckets=DISPATCH_BUCKETS,
+        )
+        self._c_commits = _metrics.REGISTRY.counter(
+            "pathway_device_pipeline_commits_total",
+            "device commits retired through the async pipeline",
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def configure(self) -> None:
+        """Drain outstanding work and re-read the env knobs — tests and
+        benches call this between runs instead of mutating the singleton."""
+        self.drain()
+        with self._cv:
+            self._error = None
+            self._completed_time = -1
+            self._busy_s = 0.0
+            self._occ_mark = None
+            self._occupancy = 0.0
+            self._g_occ.value = 0.0
+        self.controller = AdaptiveBatchController()
+
+    def _ensure_worker(self) -> None:
+        w = self._worker
+        if w is None or not w.is_alive():
+            self._worker = threading.Thread(
+                target=self._run_completions,
+                name="pw-device-pipeline",
+                daemon=True,
+            )
+            self._worker.start()
+
+    def _raise_pending(self) -> None:
+        err = self._error
+        if err is not None:
+            self._error = None
+            raise err
+
+    # -- staging side (scheduler thread) -------------------------------------
+
+    def commit_boundary(self, time: int) -> None:
+        """End-of-commit hook, replacing the inline decay barrier.
+
+        Sync mode (``PATHWAY_TPU_ASYNC_DEVICE=0``): decay inline —
+        bit-identical to the pre-pipeline engine.  Async mode: start the
+        D2H DMA for every live handle, stage the commit on the FIFO
+        (blocking only when ``depth`` commits are already in flight),
+        and return to the host sweep immediately."""
+        from pathway_tpu.engine import device as _device
+
+        handles = _device.stage_device_batches()
+        if not handles:
+            return
+        if not async_enabled():
+            for handle in handles:
+                handle.decay()
+            return
+        self._raise_pending()
+        t0 = _time.perf_counter()
+        for handle in handles:
+            handle.prefetch()  # start the DMA; never await it here
+        self._ensure_worker()
+        blocked = False
+        ctx = _tracing.current()
+        with self._cv:
+            while (
+                len(self._staged)
+                + (1 if self._active_time is not None else 0)
+                >= self.controller.depth
+            ):
+                blocked = True
+                if ctx is not None:
+                    bp0 = _time.perf_counter()
+                self._cv.wait(timeout=60.0)
+                if ctx is not None:
+                    # genuine pipeline stall: host blocked on the device
+                    # stage — attributed to the queue_wait bucket
+                    ctx.span(
+                        "device-backpressure",
+                        "wait",
+                        bp0,
+                        _time.perf_counter(),
+                        inflight=len(self._staged),
+                    )
+                self._raise_pending()
+            self._staged.append((int(time), handles, t0))
+            self._g_depth.value = float(
+                len(self._staged)
+                + (1 if self._active_time is not None else 0)
+            )
+            self._cv.notify_all()
+            staged_depth = len(self._staged)
+            occupancy = self._occupancy
+        self.controller.observe(
+            staged_depth=staged_depth, blocked=blocked, occupancy=occupancy
+        )
+        if ctx is not None:
+            ctx.span(
+                "device-dispatch",
+                "pipeline",
+                t0,
+                _time.perf_counter(),
+                batches=len(handles),
+                inflight=staged_depth,
+            )
+
+    # -- completion side (worker thread) -------------------------------------
+
+    def _run_completions(self) -> None:
+        while True:
+            with self._cv:
+                while not self._staged:
+                    self._cv.wait()
+                time_, handles, t_dispatch = self._staged.popleft()
+                self._active_time = time_
+                self._g_depth.value = float(len(self._staged) + 1)
+                self._cv.notify_all()
+            t0 = _time.perf_counter()
+            err: BaseException | None = None
+            try:
+                for handle in handles:
+                    handle.decay()
+            except BaseException as e:  # noqa: BLE001 — surfaced on main thread
+                err = e
+            t1 = _time.perf_counter()
+            with self._cv:
+                self._busy_s += t1 - t0
+                mark = self._occ_mark
+                self._occ_mark = t1
+                if mark is not None and t1 > mark:
+                    ratio = min(1.0, (t1 - t0) / (t1 - mark))
+                    self._occupancy = (
+                        0.8 * self._occupancy + 0.2 * ratio
+                    )
+                    self._g_occ.value = round(self._occupancy, 4)
+                self._completed_time = time_
+                self._active_time = None
+                self._g_depth.value = float(len(self._staged))
+                self._h_latency.observe(max(0.0, t1 - t_dispatch))
+                self._c_commits.inc()
+                if err is not None and self._error is None:
+                    self._error = err
+                self._cv.notify_all()
+
+    # -- barriers (runner thread) --------------------------------------------
+
+    def drain_until(self, time: int) -> None:
+        """Block until every staged commit at or before ``time`` has
+        completed — THE exactly-once seam: the runner calls this before
+        persistence/snapshot ``on_commit`` hooks so a checkpoint for
+        commit N is only cut once N's device effects are host-resident."""
+        if self._worker is None:
+            return
+        with self._cv:
+            while (self._staged and self._staged[0][0] <= time) or (
+                self._active_time is not None and self._active_time <= time
+            ):
+                self._cv.wait(timeout=60.0)
+        self._raise_pending()
+
+    def drain(self) -> None:
+        """Complete everything in flight (run end, pre-snapshot, tests)."""
+        if self._worker is None:
+            return
+        with self._cv:
+            while self._staged or self._active_time is not None:
+                self._cv.wait(timeout=60.0)
+        self._raise_pending()
+
+    def reset(self) -> None:
+        """Recovery path: the in-flight commits belong to a timeline a
+        snapshot rollback un-happens.  Completing them is still correct
+        (decay only frees HBM and fills host twins) — so drain, then
+        drop any queued error: the rolled-back timeline re-derives."""
+        try:
+            self.drain()
+        except BaseException:  # noqa: BLE001 — rolled-back work may not raise
+            pass
+        with self._cv:
+            self._error = None
+            self._completed_time = -1
+
+    # -- read side -----------------------------------------------------------
+
+    def inflight(self) -> int:
+        with self._cv:
+            return len(self._staged) + (
+                1 if self._active_time is not None else 0
+            )
+
+    def completed_time(self) -> int:
+        return self._completed_time
+
+    def occupancy(self) -> float:
+        return self._occupancy
+
+    def stats(self) -> dict:
+        """Structured roll-up for bench JSON."""
+        return {
+            "enabled": async_enabled(),
+            "inflight": self.inflight(),
+            "completed_commits": int(self._c_commits.value),
+            "occupancy_ratio": round(self._occupancy, 4),
+            "dispatch_complete_p50_ms": round(
+                self._h_latency.quantile(0.5) * 1000.0, 3
+            ),
+            "dispatch_complete_p99_ms": round(
+                self._h_latency.quantile(0.99) * 1000.0, 3
+            ),
+            "controller": self.controller.stats(),
+        }
+
+
+#: the process-wide pipeline every scheduler's commit boundary feeds
+PIPELINE = DevicePipeline()
+
+
+def commit_boundary(time: int) -> None:
+    PIPELINE.commit_boundary(time)
+
+
+def drain() -> None:
+    PIPELINE.drain()
+
+
+def drain_until(time: int) -> None:
+    PIPELINE.drain_until(time)
+
+
+def reset() -> None:
+    PIPELINE.reset()
+
+
+def suggested_batch_size() -> int | None:
+    """The adaptive controller's current device micro-batch suggestion;
+    None in sync mode (executors then use their configured cap).  A
+    ``BatchExecutor`` sizer only ever narrows the configured
+    ``max_batch_size`` with this value, never exceeds it."""
+    if not async_enabled():
+        return None
+    return PIPELINE.controller.batch_size
+
+
+def ingest_window_scale() -> float:
+    """Multiplier for connector autocommit windows (1.0 when the
+    pipeline is off or idle).  Only a congested device stage widens the
+    window — host-only programs never see a changed commit cadence."""
+    if not async_enabled() or PIPELINE.inflight() == 0:
+        return 1.0
+    return PIPELINE.controller.window_scale

@@ -1640,9 +1640,9 @@ class DistributedScheduler:
             for scope in self.scopes:
                 for node in scope.nodes:
                     node.on_time_end(time)
-        from pathway_tpu.engine.device import decay_device_batches
+        from pathway_tpu.engine import device_pipeline
 
-        decay_device_batches()
+        device_pipeline.commit_boundary(time)
         return any_work
 
     def commit_local(self) -> int:
@@ -1677,6 +1677,9 @@ class DistributedScheduler:
         self.time += 1
         if self.process_id != 0:
             _tracing.TRACER.drop()
+        from pathway_tpu.engine import device_pipeline
+
+        device_pipeline.drain()
         for scope in self.scopes:
             for node in scope.nodes:
                 node.close()
@@ -1689,6 +1692,9 @@ class DistributedScheduler:
         and the remote outbox.  Run before a snapshot rollback: anything
         in flight belongs to a commit the rollback un-happens, and the
         restored snapshot (plus re-driven connectors) re-derives it."""
+        from pathway_tpu.engine import device_pipeline
+
+        device_pipeline.reset()
         for scope in self.scopes:
             for node in scope.nodes:
                 node.pending.clear()

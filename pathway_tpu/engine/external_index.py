@@ -22,6 +22,19 @@ import numpy as np
 from pathway_tpu.engine.batch import DeltaBatch
 from pathway_tpu.engine.graph import Node, Scope
 from pathway_tpu.engine.value import Pointer, is_error
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing as _tracing
+
+#: device dispatch volume on the KNN path — how many index mutations and
+#: query probes each commit pushes through the pipeline
+_KNN_UPDATES = _metrics.REGISTRY.counter(
+    "pathway_device_knn_updates_total",
+    "key add/remove mutations dispatched to the device KNN index",
+)
+_KNN_QUERIES = _metrics.REGISTRY.counter(
+    "pathway_device_knn_queries_total",
+    "query vectors dispatched to the device KNN search",
+)
 
 
 class ExternalIndex(Protocol):
@@ -451,11 +464,28 @@ class ExternalIndexNode(Node):
             else:
                 rm_keys.append(key)
         # removes first so a same-commit delete+insert of a key nets to add
-        if rm_keys:
-            add_set = set(add_keys)
-            self.index.remove([k_ for k_ in rm_keys if k_ not in add_set])
-        if add_keys:
-            self.index.add(add_keys, add_vecs)
+        if rm_keys or add_keys:
+            import time as _t
+
+            t0 = _t.perf_counter()
+            if rm_keys:
+                add_set = set(add_keys)
+                self.index.remove(
+                    [k_ for k_ in rm_keys if k_ not in add_set]
+                )
+            if add_keys:
+                self.index.add(add_keys, add_vecs)
+            _KNN_UPDATES.inc(len(rm_keys) + len(add_keys))
+            ctx = _tracing.current()
+            if ctx is not None:
+                ctx.span(
+                    "knn-update",
+                    "pipeline",
+                    t0,
+                    _t.perf_counter(),
+                    adds=len(add_keys),
+                    removes=len(rm_keys),
+                )
 
         # 2. answer new queries as-of-now; retract answers of deleted queries
         out = DeltaBatch()
@@ -479,8 +509,22 @@ class ExternalIndexNode(Node):
                     limit = int(lv)
             pending.append((key, vec, limit))
         if pending:
+            import time as _t
+
             max_k = max(limit for _k, _v, limit in pending)
+            t0 = _t.perf_counter()
             results = self.index.search([v for _k, v, _l in pending], max_k)
+            _KNN_QUERIES.inc(len(pending))
+            ctx = _tracing.current()
+            if ctx is not None:
+                ctx.span(
+                    "knn-search",
+                    "pipeline",
+                    t0,
+                    _t.perf_counter(),
+                    queries=len(pending),
+                    k=max_k,
+                )
             for (key, _vec, limit), hits in zip(pending, results):
                 hits = hits[:limit]
                 # re-query of a live key replaces its previous answer (unless

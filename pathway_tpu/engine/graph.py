@@ -2990,9 +2990,9 @@ class Scheduler:
                         consumer.push(port, out)
         for node in scope.nodes:
             node.on_time_end(time)
-        from pathway_tpu.engine.device import decay_device_batches
+        from pathway_tpu.engine import device_pipeline
 
-        decay_device_batches()
+        device_pipeline.commit_boundary(time)
 
     def _end_nodes(self) -> None:
         """Run on_end hooks; they may inject final batches (buffer flush) —
@@ -3002,6 +3002,9 @@ class Scheduler:
         if any(n.has_pending() for n in self.scope.nodes):
             self.propagate(self.time)
             self.time += 1
+        from pathway_tpu.engine import device_pipeline
+
+        device_pipeline.drain()
         for node in self.scope.nodes:
             node.close()
 

@@ -373,9 +373,9 @@ class ShardedScheduler:
         for scope in self.scopes:
             for node in scope.nodes:
                 node.on_time_end(time)
-        from pathway_tpu.engine.device import decay_device_batches
+        from pathway_tpu.engine import device_pipeline
 
-        decay_device_batches()
+        device_pipeline.commit_boundary(time)
 
     def _analysis_intercept(self) -> bool:
         """Analyze-only mode: the workers are identical replicas, so the
@@ -473,6 +473,9 @@ class ShardedScheduler:
         ):
             self.propagate(self.time)
             self.time += 1
+        from pathway_tpu.engine import device_pipeline
+
+        device_pipeline.drain()
         for scope in self.scopes:
             for node in scope.nodes:
                 node.close()

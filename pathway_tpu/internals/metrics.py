@@ -55,6 +55,19 @@ The elastic-mesh layer (leader failover + rescale) adds:
   — completed N→M rescales and their quiesce→relaunch wall time
   (relaunched leader surfaces both from the supervisor's env stamps).
 
+The async device pipeline (engine/device_pipeline.py) adds:
+
+- ``pathway_device_queue_depth`` — gauge; commits currently staged in or
+  completing through the device pipeline (0 when idle or synchronous);
+- ``pathway_device_occupancy_ratio`` — gauge; EMA share of wall time the
+  completion stage is busy (1.0 = the device is the bottleneck);
+- ``pathway_device_dispatch_complete_seconds`` — histogram; commit
+  dispatch → in-order completion latency;
+- ``pathway_device_pipeline_commits_total`` — device commits retired
+  through the async path;
+- ``pathway_device_knn_updates_total`` / ``pathway_device_knn_queries_total``
+  — mutation and query volume dispatched to the device KNN index.
+
 Each family renders on the leader ``/metrics`` with exactly one
 HELP/TYPE block (the registry keys families by name).
 

@@ -48,6 +48,7 @@ _CAST_NAMES = {
 }
 
 
+from pathway_tpu.engine import device_pipeline as _device_pipeline
 from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 from pathway_tpu.internals import metrics as _metrics
 from pathway_tpu.internals import tracing as _tracing
@@ -139,8 +140,9 @@ def _pump_drivers(w0: "GraphRunner", drivers: list, on_data, on_idle=None) -> No
                 pending = True
             elif status == "data":
                 produced = True
-                ac_deadline = _time.monotonic() + getattr(
-                    d, "autocommit_s", 0.0
+                eff = getattr(d, "effective_autocommit_s", None)
+                ac_deadline = _time.monotonic() + (
+                    eff() if eff is not None else getattr(d, "autocommit_s", 0.0)
                 )
                 deadline = min(deadline, ac_deadline) if pending else ac_deadline
                 pending = True
@@ -1083,6 +1085,10 @@ class GraphRunner:
             _metrics.FLIGHT.record("commit", time=time)
             if ctx is not None:
                 _tracing.TRACER.end(time)
+            if persistent or snapshot_mgr is not None:
+                # exactly-once seam: a checkpoint/offset for commit N may
+                # only be cut once N's staged device work has completed
+                _device_pipeline.drain_until(time)
             for driver in persistent:
                 driver.on_commit(time)
             if snapshot_mgr is not None:
@@ -1244,6 +1250,9 @@ class ShardedGraphRunner:
             _metrics.FLIGHT.record("commit", time=time)
             if ctx is not None:
                 _tracing.TRACER.end(time)
+            if persistent or snapshot_mgr is not None:
+                # exactly-once seam: checkpoint only fully-completed commits
+                _device_pipeline.drain_until(time)
             for d in persistent:
                 d.on_commit(time)
             if snapshot_mgr is not None:
@@ -1802,6 +1811,9 @@ class DistributedGraphRunner:
                 )
                 sched.trace_peer_spans.clear()
             _observe_commit_latency(stamp, started, rows_before)
+            if persistent or snapshot_mgr is not None:
+                # exactly-once seam: checkpoint only fully-completed commits
+                _device_pipeline.drain_until(time)
             for d in persistent:
                 d.on_commit(time)
             if snapshot_mgr is not None:
@@ -1908,6 +1920,9 @@ class DistributedGraphRunner:
                             raise
                     continue
                 if snapshot_mgr is not None:
+                    # exactly-once seam (follower): a per-worker snapshot
+                    # for commit N waits for N's staged device work
+                    _device_pipeline.drain_until(time)
                     snapshot_mgr.on_commit(sched.scopes, [], time)
                 if fault_plan is not None:
                     fault_plan.on_commit(self.process_id, time)

@@ -434,6 +434,16 @@ class TraceRecorder:
             k: round(sum(t["critical_path"][k] for t in traces) / n, 6)
             for k in keys
         }
+        # mean bucket shares as fractions of the mean wall — computed
+        # from the means (not averaged per-trace) so older ring entries
+        # without a "shares" field cannot skew the roll-up
+        mean["shares"] = _bucket_shares(
+            mean["wall_s"],
+            mean["host_compute_s"],
+            mean["exchange_s"],
+            mean["queue_wait_s"],
+            mean["device_s"],
+        )
         spans = sum(
             len(t["spans"]) + sum(len(v) for v in t["workers"].values())
             for t in traces
@@ -542,8 +552,24 @@ def critical_path(trace: dict) -> dict:
         "exchange_s": round(exchange, 6),
         "queue_wait_s": round(queue, 6),
         "device_s": round(device, 6),
+        # per-bucket shares as fractions of commit wall: the docs/s
+        # trajectory and the bucket trajectory stay comparable across
+        # BENCH_r* files regardless of absolute commit duration
+        "shares": _bucket_shares(wall, host, exchange, queue, device),
         "clamped": clamped,
         "chain": chain,
+    }
+
+
+def _bucket_shares(
+    wall: float, host: float, exchange: float, queue: float, device: float
+) -> dict:
+    w = max(wall, 1e-9)
+    return {
+        "host_compute": round(host / w, 4),
+        "exchange": round(exchange / w, 4),
+        "queue_wait": round(queue / w, 4),
+        "device": round(device / w, 4),
     }
 
 

@@ -144,17 +144,28 @@ class BatchExecutor(Executor):
     and returns a list of results — the jit-microbatch entry point.
 
     ``max_batch_size`` splits oversized commits so padded device buffers
-    stay bounded.
+    stay bounded.  ``sizer`` (optional callable -> int | None) lets the
+    device pipeline's adaptive controller narrow the chunk size at run
+    time; it can only shrink below the configured cap, never exceed it.
     """
 
     kind = "batch"
 
-    def __init__(self, max_batch_size: int | None = None) -> None:
+    def __init__(
+        self,
+        max_batch_size: int | None = None,
+        sizer: Callable[[], int | None] | None = None,
+    ) -> None:
         self.max_batch_size = max_batch_size
+        self.sizer = sizer
 
     def run(self, fn, rows, retry=None):
         out: list[RowResult] = []
         step = self.max_batch_size or len(rows) or 1
+        if self.sizer is not None:
+            suggested = self.sizer()
+            if suggested:
+                step = max(1, min(step, int(suggested)))
         for start in range(0, len(rows), step):
             chunk = rows[start : start + step]
             cols = tuple(list(c) for c in zip(*chunk))
@@ -191,5 +202,8 @@ def async_executor(
     return AsyncExecutor(capacity=capacity, timeout=timeout)
 
 
-def batch_executor(max_batch_size: int | None = None) -> BatchExecutor:
-    return BatchExecutor(max_batch_size=max_batch_size)
+def batch_executor(
+    max_batch_size: int | None = None,
+    sizer: Callable[[], int | None] | None = None,
+) -> BatchExecutor:
+    return BatchExecutor(max_batch_size=max_batch_size, sizer=sizer)

@@ -48,6 +48,14 @@ def _resolve_device_resident(device_resident: "bool | None") -> bool:
     )
 
 
+def _adaptive_sizer():
+    """Device-pipeline feedback into the embed micro-batch: the adaptive
+    controller can only narrow the configured ``max_batch_size``."""
+    from pathway_tpu.engine import device_pipeline
+
+    return device_pipeline.suggested_batch_size()
+
+
 def _rows_from_device(vecs_dev: Any, real: int, device_resident: bool) -> list:
     """Device batch -> per-row cells: lazy device rows (prefetched host
     twin) or eager numpy."""
@@ -202,7 +210,9 @@ class TpuEncoderEmbedder(UDF):
 
         super().__init__(
             embed_batch,
-            executor=batch_executor(max_batch_size=max_batch_size),
+            executor=batch_executor(
+                max_batch_size=max_batch_size, sizer=_adaptive_sizer
+            ),
             deterministic=True,
             cache_strategy=cache_strategy,
             cache_name=(
@@ -314,7 +324,9 @@ class TpuImageEmbedder(UDF):
             weights_part = f"seed{seed}"
         super().__init__(
             embed_batch,
-            executor=batch_executor(max_batch_size=max_batch_size),
+            executor=batch_executor(
+                max_batch_size=max_batch_size, sizer=_adaptive_sizer
+            ),
             deterministic=True,
             cache_strategy=cache_strategy,
             cache_name=f"TpuImageEmbedder:{preset}:{weights_part}",

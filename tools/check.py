@@ -13,23 +13,29 @@ problem):
    pipelines);
 3. optimize-off parity — the optimizer parity + engine-core suites rerun
    with ``PATHWAY_TPU_OPTIMIZE=0`` (the graph rewriter's escape hatch);
-4. metrics overhead — the ``fused_chain`` workload with the metrics
+4. async-device parity — the device-pipeline suite rerun with
+   ``PATHWAY_TPU_ASYNC_DEVICE=0`` (the async pipeline's escape hatch;
+   the suite itself holds async-on/off to bit-identical sinks);
+5. metrics overhead — the ``fused_chain`` workload with the metrics
    plane fully on (per-operator probes + StatsMonitor + latency
    histogram + flight recorder) vs fully off; FAILs when the overhead
    exceeds 5% (observability must be effectively free);
-5. trace overhead — the same workload with sampled distributed tracing
+6. trace overhead — the same workload with sampled distributed tracing
    at the default interval vs off; FAILs when the overhead exceeds 5%
    (the same bar the metrics plane clears);
-6. trace export — a small traced program runs end-to-end and the
+7. async-device overhead — the same workload with a zero-cost fake
+   device batch staged per commit, pipeline on vs inline decay; FAILs
+   when the machinery costs more than 5%;
+8. trace export — a small traced program runs end-to-end and the
    exported file must satisfy the Chrome trace-event schema invariants
    (complete X / matched B-E events, monotonic timestamps per track);
-7. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
+9. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
    mesh with operator persistence: a follower SIGKILL (supervised
    restart + rollback), a LEADER SIGKILL (epoch-fenced election
    failover), and a SIGKILL injected while a live N→M rescale is
    quiescing; every leg must land the exact fault-free sink, within a
    bounded wall budget;
-8. sanitized native build — recompile ``native/enginecore.cpp`` with
+10. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
    (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
@@ -408,6 +414,84 @@ def step_sanitized_native() -> str:
     return PASS
 
 
+def step_async_parity() -> str:
+    """Re-run the device-pipeline suite with the async pipeline disabled
+    (PATHWAY_TPU_ASYNC_DEVICE=0): proves the escape hatch works and that
+    the parity corpus — which holds async-on and async-off to
+    bit-identical sinks across all three schedulers — passes from the
+    synchronous side too."""
+    name = "async-device parity (PATHWAY_TPU_ASYNC_DEVICE=0)"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_device_pipeline.py",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+        ],
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_TPU_ASYNC_DEVICE": "0",
+        },
+        timeout=900,
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        name,
+        status,
+        f"pytest exit {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+def step_async_overhead() -> str:
+    """Gate the async-pipeline tax: bench_dataflow.async_device_overhead_leg
+    runs the fused_chain workload with one fake (synchronous, zero-cost)
+    device batch staged per commit, async machinery on vs inline decay
+    (interleaved best-of-4 each way); >5% overhead is a FAIL — the
+    pipeline must be free when the device is."""
+    name = "async-device overhead (fused_chain, fake device, on vs off)"
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('ASYNC_OVERHEAD_JSON ' + json.dumps("
+        "b.async_device_overhead_leg()()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.SubprocessError as e:
+        _report(name, FAIL, f"bench leg did not finish: {e}")
+        return FAIL
+    import json
+
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("ASYNC_OVERHEAD_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        _report(name, FAIL, f"bench leg exit {proc.returncode}")
+        return FAIL
+    overhead = payload["overhead_pct"]
+    detail = (
+        f"{overhead:+.2f}% "
+        f"(off {payload['async_off_s']}s, on {payload['async_on_s']}s)"
+    )
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
+    return status
+
+
 #: the chaos gate's three fixed-seed legs — one follower kill (seed 7),
 #: one LEADER kill exercising election + epoch fencing (seed 13), and one
 #: kill racing a live rescale's quiesce (seed 26).  All three share one
@@ -474,8 +558,10 @@ def main(argv=None) -> int:
         step_ruff(),
         step_analyzer(),
         step_optimize_off(),
+        step_async_parity(),
         step_metrics_overhead(),
         step_trace_overhead(),
+        step_async_overhead(),
         step_trace_export(),
         step_chaos_gate(),
     ]
