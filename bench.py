@@ -28,7 +28,15 @@ stdlib/indexing/nearest_neighbors.py:170) is CPU-bound on the embedder, so
 docs/sec is the honest comparison axis.
 
 Env knobs: BENCH_DOCS (default 20000), BENCH_QUERIES (64), BENCH_SECONDS
-(device-leg duration, 5).
+(device-leg duration, 5). Time budgets: BENCH_WALL_BUDGET_S bounds the
+whole run (watchdog guarantees a JSON line lands inside it);
+BENCH_LEG_TIMEOUT_S bounds each leg, overridable per leg via
+BENCH_LEG_TIMEOUT_<NAME>_S — legs that no longer fit the wall budget are
+skipped and marked in ``leg_errors`` instead of tripping an rc=124 kill.
+When the accelerator probe exhausts its window, the host-fallback RAG
+leg (numpy hashing embedder + HostKnnIndex) still produces a real
+headline number, marked ``host_fallback``; BENCH_SKIP_HOST_FALLBACK=1
+disables it.
 """
 
 from __future__ import annotations
@@ -376,6 +384,7 @@ def pipeline_leg() -> dict:
     def pct(p: float) -> float:
         return lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))] if lat_ms else float("nan")
 
+    from pathway_tpu.engine import device_ops as _device_ops
     from pathway_tpu.engine import device_pipeline as _device_pipeline
 
     return {
@@ -388,8 +397,162 @@ def pipeline_leg() -> dict:
         "n_query_timeouts": len(timeouts),
         "critical_path": trace_summary,
         "device_pipeline": _device_pipeline.PIPELINE.stats(),
+        # per-operator host/device placement decisions + kernel hit
+        # counts from the device-resident operator layer
+        "device_ops": _device_ops.stats(),
         "_capacity": capacity,
         "_embedder": embedder,  # reused by the device-latency leg
+    }
+
+
+def host_fallback_pipeline_leg() -> dict:
+    """Accelerator-free twin of ``pipeline_leg``: the identical engine
+    dataflow (python connector -> embedder UDF -> DataIndex -> as-of-now
+    query -> subscribe sinks) with a pure-numpy hashing embedder and the
+    HostKnnIndex, so a dead device tunnel still yields a real (host)
+    ``streaming_rag_pipeline_docs_per_sec`` instead of a null headline
+    (BENCH_r04 failure mode). The number measures the ENGINE ingest path
+    — connector, UDF executor, scheduler, index maintenance — with the
+    device work swapped for its bit-exact host spec."""
+    import zlib
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.stdlib.indexing import DataIndex, HostKnnFactory
+
+    G.clear()
+    dim = 128
+    n_docs = int(os.environ.get("BENCH_FALLBACK_DOCS", str(N_DOCS)))
+
+    def embed_text(text: str) -> np.ndarray:
+        # deterministic token feature-hashing (crc32, not the salted
+        # builtin hash), unit norm — numpy-only, so it runs with the
+        # accelerator (and jax) completely unreachable
+        vec = np.zeros(dim, np.float32)
+        for tok in text.split():
+            h = zlib.crc32(tok.encode())
+            vec[h % dim] += 1.0 if (h >> 16) & 1 else -1.0
+        n = float(np.linalg.norm(vec))
+        return vec / n if n > 0 else vec
+
+    corpus = [_doc_text(i) for i in range(n_docs)]
+    ingest_done = threading.Event()
+    answer_seen = threading.Event()
+    timing = {"run_start": 0.0, "ingest_end": 0.0}
+    doc_embs: dict = {}
+    answers: dict = {}
+    latencies: list[float] = []
+    timeouts: list[int] = []
+
+    class DocFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            timing["run_start"] = time.perf_counter()
+            for i in range(n_docs):
+                self.next(doc_id=i, text=corpus[i])
+
+    class QueryFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            ingest_done.wait()
+            for i in range(N_QUERIES):
+                answer_seen.clear()
+                t0 = time.perf_counter()
+                self.next(query_id=i, text=_doc_text(i * 37 % n_docs))
+                if answer_seen.wait(timeout=120.0):
+                    latencies.append(time.perf_counter() - t0)
+                else:
+                    timeouts.append(i)
+
+    docs = pw.io.python.read(
+        DocFeed(),
+        schema=pw.schema_from_types(doc_id=int, text=str),
+        autocommit_duration_ms=100,
+    )
+    docs = docs.select(
+        doc_id=pw.this.doc_id, emb=pw.apply(embed_text, pw.this.text)
+    )
+    queries = pw.io.python.read(
+        QueryFeed(),
+        schema=pw.schema_from_types(query_id=int, text=str),
+        autocommit_duration_ms=None,
+    )
+    queries = queries.select(
+        query_id=pw.this.query_id,
+        qemb=pw.apply(embed_text, pw.this.text),
+    )
+    index = DataIndex(
+        docs,
+        HostKnnFactory(
+            dimensions=dim,
+            capacity=1 << max(10, (n_docs - 1).bit_length()),
+        ),
+        docs.emb,
+    )
+    res = index.query_as_of_now(queries, queries.qemb, number_of_matches=K)
+
+    n_ingested = [0]
+    perf_counter = time.perf_counter
+
+    def on_doc(key, row, time, is_addition):
+        if is_addition:
+            doc_embs[key] = (
+                row["doc_id"], np.asarray(row["emb"], np.float32)
+            )
+            n_ingested[0] += 1
+            if n_ingested[0] == n_docs:
+                timing["ingest_end"] = perf_counter()
+                ingest_done.set()
+
+    def on_answer(key, row, time, is_addition):
+        if is_addition:
+            answers[row["query_id"]] = (
+                tuple(row["_pw_index_reply_ids"]),
+                np.asarray(row["qemb"], np.float32),
+            )
+            answer_seen.set()
+
+    pw.io.subscribe(docs, on_change=on_doc)
+    pw.io.subscribe(res, on_change=on_answer)
+    pw.run()
+
+    elapsed = timing["ingest_end"] - timing["run_start"]
+    docs_per_sec = n_docs / elapsed if elapsed > 0 else None
+
+    # recall@K vs exact numpy over the same embeddings — HostKnnIndex IS
+    # exact search, so this is a correctness check, not an ANN tradeoff
+    keys = list(doc_embs)
+    recalls = []
+    if keys:
+        mat = np.stack([doc_embs[k][1] for k in keys])
+        norms = np.linalg.norm(mat, axis=1)
+        for _qid, (hit_keys, qvec) in answers.items():
+            scores = mat @ qvec / np.maximum(
+                norms * np.linalg.norm(qvec), 1e-30
+            )
+            exact = {keys[j] for j in np.argsort(-scores)[:K]}
+            if exact:
+                recalls.append(
+                    len(exact.intersection(hit_keys)) / len(exact)
+                )
+    lat_ms = sorted(1000.0 * x for x in latencies)
+
+    def pct(p: float):
+        if not lat_ms:
+            return None
+        return round(lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3)
+
+    return {
+        "pipeline_docs_per_sec": docs_per_sec,
+        "host_fallback": True,
+        "embedder": f"crc32 feature hashing, dim {dim} (numpy)",
+        "index": "HostKnnIndex (bit-exact host spec of the HBM KNN)",
+        "query_p50_ms": pct(0.50),
+        "query_p95_ms": pct(0.95),
+        "recall_at_10": (
+            round(float(np.mean(recalls)), 4) if recalls else None
+        ),
+        "n_docs": n_docs,
+        "n_queries": len(latencies),
+        "n_query_timeouts": len(timeouts),
     }
 
 
@@ -1167,12 +1330,36 @@ def _probe_device_retrying() -> None:
         extra.setdefault(label, value)
     extra["probe_attempts"] = attempts[0]
     extra["probe_window_s"] = window
+    # device gone for good: run the RAG pipeline with the numpy embedder
+    # + HostKnnIndex so the headline metric is a real (host) number with
+    # a host_fallback marker instead of null (BENCH_r04 failure mode)
+    value = None
+    fb_budget = _budget_bounded(600.0, headroom=15.0)
+    if fb_budget > 30.0 and os.environ.get(
+        "BENCH_SKIP_HOST_FALLBACK", ""
+    ) not in ("1", "true"):
+        fallback, fb_err, _t = _run_bounded(
+            host_fallback_pipeline_leg, fb_budget
+        )
+        if fallback is not None:
+            value = fallback.pop("pipeline_docs_per_sec")
+            extra.update(fallback)
+        else:
+            extra["host_fallback_error"] = fb_err
     print(
         json.dumps(
             {
                 "metric": "streaming_rag_pipeline_docs_per_sec",
-                "value": None,
-                "unit": "docs/sec",
+                "value": round(value, 1) if value else None,
+                "unit": (
+                    "docs/sec end-to-end through pw.run (python "
+                    "connector -> hashing embedder UDF -> host KNN "
+                    "index), HOST FALLBACK — accelerator unreachable"
+                    if value
+                    else "docs/sec"
+                ),
+                # the device baseline measures a different embedder:
+                # never compare the host-fallback number against it
                 "vs_baseline": None,
                 "error": error,
                 # structured marker: downstream BENCH_r* parsers key on
@@ -1183,7 +1370,8 @@ def _probe_device_retrying() -> None:
         ),
         flush=True,
     )
-    os._exit(3)
+    # a valid host headline is a degraded success, not an outage
+    os._exit(0 if value else 3)
 
 
 def _run_bounded(fn, timeout_s: float):
@@ -1234,6 +1422,16 @@ def _device_alive(timeout_s: float) -> bool:
     return bool(ok)
 
 
+def _leg_budget(name: str, default: float) -> float:
+    """Per-leg time budget: ``BENCH_LEG_TIMEOUT_<NAME>_S`` overrides the
+    global ``BENCH_LEG_TIMEOUT_S``, and both clamp to what remains of
+    the wall budget — a leg that cannot fit is skipped AND MARKED in
+    the JSON instead of running into the watchdog's rc=124 kill."""
+    env = os.environ.get(f"BENCH_LEG_TIMEOUT_{name.upper()}_S")
+    budget = float(env) if env else default
+    return _budget_bounded(budget, headroom=20.0)
+
+
 def main() -> None:
     _install_budget_watchdog()
     _probe_device_retrying()
@@ -1245,11 +1443,19 @@ def main() -> None:
     stuck: list = []  # abandoned worker threads that may still hold G
 
     def bounded(name: str, fn):
-        """Run one device-touching leg, time-bounded; after a failure,
-        re-probe the tunnel and skip remaining device legs if it is gone
-        — a mid-bench outage still emits every number captured so far."""
+        """Run one device-touching leg, time-bounded per leg; after a
+        failure, re-probe the tunnel and skip remaining device legs if
+        it is gone — a mid-bench outage still emits every number
+        captured so far."""
         if not alive[0]:
             errors[name] = "skipped: accelerator lost earlier in the run"
+            return None
+        budget = _leg_budget(name, leg_timeout)
+        if budget < 5.0:
+            errors[name] = (
+                "skipped: wall budget exhausted before this leg "
+                f"({budget:.0f}s remaining)"
+            )
             return None
         # an abandoned (timed-out) worker may still be mutating the
         # shared parse graph; give it a grace period, and if it will not
@@ -1264,7 +1470,7 @@ def main() -> None:
                 )
                 return None
             stuck.remove(t)
-        result, err, worker = _run_bounded(fn, leg_timeout)
+        result, err, worker = _run_bounded(fn, budget)
         if err is not None:
             errors[name] = err
             if worker.is_alive():

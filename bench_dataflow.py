@@ -585,6 +585,173 @@ def async_device_overhead_leg():
     return leg
 
 
+def device_ops_leg():
+    """Device-resident operator kernels (engine/device_ops.py) vs the
+    host kernels over the groupby-sum / join-inner workloads:
+    PATHWAY_TPU_DEVICE_OPS=1 forces every representable batch through
+    the JAX kernels (bit-exact against the host spec by construction),
+    =0 is the host path. Reports rows/sec each way plus the kernel hit
+    counts and the placement decisions the policy recorded — the bench
+    evidence that the kernels actually engaged."""
+    n = 5_000 if _analyze_only() else min(N, 200_000)
+    n_right = 20_000
+    gb_rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(n)]
+    l_rows = [
+        (ref_scalar(("l", i)), (i % n_right, float(i)))
+        for i in range(n // 2)
+    ]
+    r_rows = [
+        (ref_scalar(("r", i)), (i, f"name{i}")) for i in range(n_right)
+    ]
+
+    def gb_once() -> float:
+        scope = Scope()
+        sess = scope.input_session(2)
+        scope.group_by_table(
+            sess,
+            by_cols=[0],
+            reducers=[
+                (make_reducer(ReducerKind.SUM), [1]),
+                (make_reducer(ReducerKind.COUNT), []),
+            ],
+        )
+        sched = Scheduler(scope)
+        for key, row in gb_rows:
+            sess.insert(key, row)
+        return timed(sched.commit)
+
+    def join_once() -> float:
+        scope = Scope()
+        left = scope.input_session(2)
+        right = scope.input_session(2)
+        scope.join_tables(
+            left, right, left_on=[0], right_on=[0], kind="inner"
+        )
+        sched = Scheduler(scope)
+        for key, row in l_rows:
+            left.insert(key, row)
+        for key, row in r_rows:
+            right.insert(key, row)
+        return timed(sched.commit)
+
+    def leg() -> dict:
+        try:
+            import jax
+        except Exception as exc:  # noqa: BLE001 — report, don't sink
+            return {"skipped": f"jax unavailable: {exc!r}"}
+        from pathway_tpu.engine import device_ops as _dops
+        from pathway_tpu.optimize.placement import POLICY
+
+        prev = os.environ.get("PATHWAY_TPU_DEVICE_OPS")
+        try:
+            os.environ["PATHWAY_TPU_DEVICE_OPS"] = "0"
+            gb_host = min(gb_once() for _ in range(2))
+            join_host = min(join_once() for _ in range(2))
+            os.environ["PATHWAY_TPU_DEVICE_OPS"] = "1"
+            _dops.reset_counters()
+            POLICY.reset()
+            gb_once()  # warm the jit kernels outside the timed runs
+            join_once()
+            gb_dev = min(gb_once() for _ in range(2))
+            join_dev = min(join_once() for _ in range(2))
+            hits = _dops.hit_counts()
+            placement = POLICY.decisions()
+        finally:
+            if prev is None:
+                os.environ.pop("PATHWAY_TPU_DEVICE_OPS", None)
+            else:
+                os.environ["PATHWAY_TPU_DEVICE_OPS"] = prev
+        n_join = n // 2 + n_right
+        return {
+            "rows": n,
+            "backend": jax.default_backend(),
+            "groupby_host_rows_per_sec": round(n / gb_host),
+            "groupby_device_rows_per_sec": round(n / gb_dev),
+            "join_host_rows_per_sec": round(n_join / join_host),
+            "join_device_rows_per_sec": round(n_join / join_dev),
+            "device_kernel_hits": hits,
+            "placement": placement,
+        }
+
+    return leg
+
+
+def device_ops_overhead_leg():
+    """Streaming groupby commits with the device-ops hooks in their
+    no-device configuration (PATHWAY_TPU_DEVICE_OPS=0: one cached env
+    check per columnar batch) vs the hooks stubbed out entirely — the
+    measured delta is what the placement machinery costs every
+    host-only deployment. tools/check.py FAILs above 5%, the same gate
+    as metrics_overhead/trace_overhead."""
+    import gc
+
+    n_base, n_commits, delta = 20_000, 200, 1000
+    if _analyze_only():
+        n_base, n_commits = 5_000, 1
+    rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(n_base)]
+
+    def once(stubbed: bool) -> float:
+        prev_env = os.environ.get("PATHWAY_TPU_DEVICE_OPS")
+        os.environ["PATHWAY_TPU_DEVICE_OPS"] = "0"
+        orig = graph_mod._device_ops_active
+        if stubbed:
+            graph_mod._device_ops_active = lambda: None
+        try:
+            scope = Scope()
+            sess = scope.input_session(2)
+            scope.group_by_table(
+                sess,
+                by_cols=[0],
+                reducers=[(make_reducer(ReducerKind.SUM), [1])],
+            )
+            sched = Scheduler(scope)
+            for key, row in rows:
+                sess.insert(key, row)
+            sched.commit()
+            if _analyze_only():
+                return 1.0
+            t = 0.0
+            # GC pauses landing on one side would swamp the per-batch
+            # hook cost under measurement (a cached env check)
+            gc.disable()
+            try:
+                for c in range(n_commits):
+                    base = (c * delta) % (n_base - delta)
+                    for i in range(base, base + delta):
+                        key, row = rows[i]
+                        sess.remove(key, row)
+                        sess.insert(key, (row[0], row[1] + 1.0))
+                    t += timed(sched.commit)
+            finally:
+                gc.enable()
+            return t
+        finally:
+            graph_mod._device_ops_active = orig
+            if prev_env is None:
+                os.environ.pop("PATHWAY_TPU_DEVICE_OPS", None)
+            else:
+                os.environ["PATHWAY_TPU_DEVICE_OPS"] = prev_env
+
+    def leg() -> dict:
+        # one discarded warmup per side (allocator + code caches), then
+        # interleaved off/on pairs so machine drift lands on both sides
+        once(True)
+        once(False)
+        t_off = min(once(True) for _ in range(1))
+        t_on = min(once(False) for _ in range(1))
+        for _ in range(4):
+            t_off = min(t_off, once(True))
+            t_on = min(t_on, once(False))
+        return {
+            "rows": n_commits * 2 * delta,
+            "hooks_stubbed_s": round(t_off, 4),
+            "hooks_disabled_s": round(t_on, 4),
+            "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
+        }
+
+    return leg
+
+
 def pushdown_wide_source():
     """Wide producer (12 computed columns, per-row Python UDFs), two
     narrow consumers (3 distinct columns used between them): projection
@@ -1153,6 +1320,11 @@ def run_all(emit=None) -> dict:
     # async device pipeline tax: staging/completion machinery with a
     # synchronous fake device vs the inline decay path
     record("async_device_overhead", async_device_overhead_leg()())
+    # device-resident operator kernels: forced-device vs host rows/sec
+    # (+ kernel hit counts and placement decisions), and the no-device
+    # overhead of the placement hooks
+    record("device_ops", device_ops_leg()())
+    record("device_ops_overhead", device_ops_overhead_leg()())
     if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
         try:
             leg = distributed_leg()
@@ -1255,6 +1427,8 @@ def main() -> None:
         ("metrics_overhead", metrics_overhead_leg),
         ("trace_overhead", trace_overhead_leg),
         ("async_device_overhead", async_device_overhead_leg),
+        ("device_ops", device_ops_leg),
+        ("device_ops_overhead", device_ops_overhead_leg),
     ):
         print(json.dumps({"workload": name, **make()()}))
     # distributed leg: dtype-tagged columnar frames vs pickled row entries

@@ -237,6 +237,10 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
     sums: dict[str, dict[str, float]] = {}
     lat: dict[str, list] = {}
     dev_lat: dict[str, list] = {}
+    # device-resident operator kernels: (worker, kernel/op) -> value
+    dev_ops_hits: dict[tuple[str, str], float] = {}
+    dev_ops_ns: dict[tuple[str, str], float] = {}
+    dev_ops_place: dict[tuple[str, str], float] = {}
 
     def add(worker: str, col: str, value: float) -> None:
         sums.setdefault(worker, {})[col] = (
@@ -269,6 +273,14 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
                 and name.endswith("_bucket")
             ):
                 dev_lat.setdefault(w, []).append((float(labels["le"]), value))
+            elif fam_name == "pathway_device_ops_kernel_hits_total":
+                key = (w, labels.get("kernel", "?"))
+                dev_ops_hits[key] = dev_ops_hits.get(key, 0.0) + value
+            elif fam_name == "pathway_device_ops_kernel_ns_total":
+                key = (w, labels.get("kernel", "?"))
+                dev_ops_ns[key] = dev_ops_ns.get(key, 0.0) + value
+            elif fam_name == "pathway_device_ops_placement":
+                dev_ops_place[(w, labels.get("op", "?"))] = value
     for w, buckets in lat.items():
         buckets.sort()
         sums.setdefault(w, {})
@@ -313,6 +325,25 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
         for r in rows:
             print("  ".join(v.rjust(widths[i]) if i else v.ljust(widths[i])
                             for i, v in enumerate(r)))
+
+    # -- device-resident operators -------------------------------------------
+    if dev_ops_hits or dev_ops_place:
+        print()
+        print("device ops:")
+        for (w, kernel) in sorted(dev_ops_hits):
+            ms = dev_ops_ns.get((w, kernel), 0.0) / 1e6
+            print(
+                f"  {(w or '(local)'):<10}  kernel {kernel:<16}"
+                f"  hits={dev_ops_hits[(w, kernel)]:.0f}"
+                f"  device_ms={ms:.2f}"
+            )
+        for (w, op) in sorted(dev_ops_place):
+            where = (
+                "device" if dev_ops_place[(w, op)] >= 1.0 else "host"
+            )
+            print(
+                f"  {(w or '(local)'):<10}  op     {op:<16}  -> {where}"
+            )
 
     # -- per-family totals ---------------------------------------------------
     print()

@@ -446,6 +446,8 @@ class DevicePipeline:
 
     def stats(self) -> dict:
         """Structured roll-up for bench JSON."""
+        from pathway_tpu.engine import device_ops as _dops
+
         return {
             "enabled": async_enabled(),
             "inflight": self.inflight(),
@@ -458,6 +460,12 @@ class DevicePipeline:
                 self._h_latency.quantile(0.99) * 1000.0, 3
             ),
             "controller": self.controller.stats(),
+            # the device-resident operator kernels share the pipe's
+            # device: their launch volume belongs in the same roll-up
+            "device_ops": {
+                "enabled": _dops.enabled(),
+                "hit_counts": _dops.hit_counts(),
+            },
         }
 
 

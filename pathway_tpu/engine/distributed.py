@@ -1305,6 +1305,14 @@ class DistributedScheduler:
         busy = False
         probe = self.probe
         trace = _tracing.current()
+        # traced runs attribute device-resident operator kernel time to
+        # the launching span (same per-node split the sharded pump emits)
+        _dops = None
+        if trace is not None:
+            from pathway_tpu.engine import device_ops as _device_ops
+
+            if _device_ops.enabled():
+                _dops = _device_ops
         while True:
             did = False
             busy_nodes = 0
@@ -1316,6 +1324,7 @@ class DistributedScheduler:
                     busy_nodes += 1
                     if probe or trace is not None:
                         t0 = _walltime.perf_counter()
+                    dns0 = _dops.total_ns() if _dops is not None else 0
                     out = node.process(time)
                     if out is None:
                         out = DeltaBatch()
@@ -1325,6 +1334,11 @@ class DistributedScheduler:
                     # the vectorized exchange ships them
                     node._defer_state(out)
                     if trace is not None:
+                        extra = {}
+                        if _dops is not None:
+                            dns = _dops.total_ns() - dns0
+                            if dns:
+                                extra["device_ns"] = dns
                         trace.span(
                             getattr(node, "name", None)
                             or type(node).__name__,
@@ -1335,6 +1349,7 @@ class DistributedScheduler:
                             _walltime.perf_counter(),
                             node=node.index,
                             scope=scope_idx,
+                            **extra,
                         )
                     if probe:
                         st = self._stats_of(node)

@@ -15,10 +15,12 @@ top-k. Host state is only the key<->slot mapping.
 
 from __future__ import annotations
 
-from typing import Any, Protocol, Sequence
+import time as _time
+from typing import Any, NamedTuple, Protocol, Sequence
 
 import numpy as np
 
+from pathway_tpu.engine import device_ops as _dops
 from pathway_tpu.engine.batch import DeltaBatch
 from pathway_tpu.engine.graph import Node, Scope
 from pathway_tpu.engine.value import Pointer, is_error
@@ -167,6 +169,7 @@ class DeviceKnnIndex:
         n = len(slots)
         if n == 0:
             return
+        t0 = _time.perf_counter_ns()
         b = _bucket(n)
         slots_arr = np.full((b,), 0, np.int32)
         slots_arr[:n] = slots
@@ -182,6 +185,9 @@ class DeviceKnnIndex:
             jnp.asarray(vec_arr),
             jnp.asarray(valid_arr),
             jnp.asarray(enabled),
+        )
+        _dops.record_kernel(
+            "knn_update", _time.perf_counter_ns() - t0, hits=n
         )
 
     def add(self, keys: Sequence[Pointer], vectors: Sequence[Any]) -> None:
@@ -285,6 +291,7 @@ class DeviceKnnIndex:
         enabled[:n] = True
         idx_pad = np.zeros((b,), np.int32)
         idx_pad[:n] = indices
+        t0 = _time.perf_counter_ns()
         enabled_dev = jnp.asarray(enabled)
         gathered = _gather_pad(
             dev, jnp.asarray(idx_pad), enabled_dev
@@ -295,6 +302,9 @@ class DeviceKnnIndex:
             gathered,
             enabled_dev,
             enabled_dev,
+        )
+        _dops.record_kernel(
+            "knn_update", _time.perf_counter_ns() - t0, hits=n
         )
         return True
 
@@ -377,6 +387,7 @@ class DeviceKnnIndex:
             for i, vec in enumerate(queries):
                 q[i] = np.asarray(vec, np.float32).reshape(self.dim)
             q_dev = jnp.asarray(q)
+        t0 = _time.perf_counter_ns()
         if self.mesh is not None:
             scores, slots = knn_search_sharded(
                 self.state, q_dev, k_eff, self.mesh, self.metric
@@ -386,12 +397,159 @@ class DeviceKnnIndex:
                 self.state, q_dev, k_eff, self.metric
             )
         packed = np.asarray(_pack_results(scores, slots))
+        _dops.record_kernel(
+            "knn_search", _time.perf_counter_ns() - t0, hits=n
+        )
         scores = packed[0].view(np.float32)[:n]
         slots = packed[1][:n]
         out: list[list[tuple[Pointer, float]]] = []
         for i in range(n):
             hits = []
             for score, slot in zip(scores[i], slots[i]):
+                key = self.slot_to_key.get(int(slot))
+                if key is not None and np.isfinite(score):
+                    hits.append((key, float(score)))
+            out.append(hits)
+        return out
+
+
+class _HostKnnState(NamedTuple):
+    """NumPy twin of ops.knn.DeviceKnnState (same field contract)."""
+
+    vectors: np.ndarray  # [capacity, dim]
+    valid: np.ndarray  # [capacity] bool
+    norms: np.ndarray  # [capacity] float32 — squared L2 norms
+
+
+class HostKnnIndex(DeviceKnnIndex):
+    """CPU/NumPy twin of :class:`DeviceKnnIndex` — the bit-exact host spec
+    for the device KNN kernels (PR-2 parity discipline), and the
+    accelerator-free engine behind the streaming-RAG host-fallback bench
+    leg.
+
+    It *inherits* the slot allocator, bucket padding, replacement and
+    growth logic (the behaviors that decide slot ids and therefore tie
+    order), overriding only the device seams: state lives in NumPy
+    arrays, the scatter update and the masked matmul + top-k run on
+    host.  Tie-breaking matches ``lax.top_k`` (lowest slot first) via a
+    stable descending argsort.  Float reduction order is the one seam a
+    host spec cannot pin per-platform; the parity corpus uses exactly
+    representable values so any order sums identically, and the
+    check.py parity gate validates the real device per platform.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "cos",
+        capacity: int = 1024,
+        dtype: Any = None,
+        mesh: Any = None,
+    ) -> None:
+        self.dim = dim
+        self.metric = metric
+        self.capacity = capacity
+        self.dtype = np.float32
+        self.mesh = None  # host search never shards
+        self.state = _HostKnnState(
+            vectors=np.zeros((capacity, dim), np.float32),
+            valid=np.zeros((capacity,), bool),
+            norms=np.zeros((capacity,), np.float32),
+        )
+        self.key_to_slot = {}
+        self.slot_to_key = {}
+        self._free = list(range(capacity - 1, -1, -1))
+
+    def _grow(self) -> None:
+        old = self.state
+        new_capacity = self.capacity * 2
+        vectors = np.zeros((new_capacity, self.dim), np.float32)
+        valid = np.zeros((new_capacity,), bool)
+        norms = np.zeros((new_capacity,), np.float32)
+        vectors[: self.capacity] = old.vectors
+        valid[: self.capacity] = old.valid
+        norms[: self.capacity] = old.norms
+        self.state = _HostKnnState(vectors, valid, norms)
+        self._free = (
+            list(range(new_capacity - 1, self.capacity - 1, -1)) + self._free
+        )
+        self.capacity = new_capacity
+
+    def _add_device_run(
+        self, keys: Sequence[Pointer], dev: Any, indices: Sequence[int]
+    ) -> bool:
+        # lazy device rows materialise through their (prefetched) host
+        # twin on the general path — a host index never touches HBM
+        return False
+
+    def _apply(
+        self, slots: list[int], vecs: np.ndarray, set_valid: list[bool]
+    ) -> None:
+        n = len(slots)
+        if n == 0:
+            return
+        vecs = np.asarray(vecs, np.float32).reshape(n, self.dim)
+        idx = np.asarray(slots, np.int64)
+        self.state.vectors[idx] = vecs
+        self.state.valid[idx] = np.asarray(set_valid, bool)
+        # same formula as ops.knn.knn_update: f32 square-sum of the row
+        self.state.norms[idx] = np.sum(vecs * vecs, axis=-1)
+
+    def op_state(self) -> dict:
+        # explicit copies: the host arrays mutate in place, and a snapshot
+        # must not alias live state (the device version copies via jax→np)
+        return {
+            "vectors": self.state.vectors.copy(),
+            "valid": self.state.valid.copy(),
+            "norms": self.state.norms.copy(),
+            "key_to_slot": dict(self.key_to_slot),
+            "free": list(self._free),
+            "capacity": self.capacity,
+        }
+
+    def restore_op_state(self, state: dict) -> None:
+        self.capacity = state["capacity"]
+        self.state = _HostKnnState(
+            vectors=np.asarray(state["vectors"], np.float32),
+            valid=np.asarray(state["valid"], bool),
+            norms=np.asarray(state["norms"], np.float32),
+        )
+        self.key_to_slot = dict(state["key_to_slot"])
+        self.slot_to_key = {s: k for k, s in self.key_to_slot.items()}
+        self._free = list(state["free"])
+
+    def search(
+        self, queries: Sequence[Any], k: int
+    ) -> list[list[tuple[Pointer, float]]]:
+        n = len(queries)
+        if n == 0:
+            return []
+        k_eff = min(k, self.capacity)
+        q = np.zeros((n, self.dim), np.float32)
+        for i, vec in enumerate(queries):
+            q[i] = np.asarray(vec, np.float32).reshape(self.dim)
+        db = self.state.vectors
+        dots = q @ db.T  # f32 matmul — ops.knn uses Precision.HIGHEST
+        if self.metric == "dot":
+            scores = dots
+        elif self.metric == "cos":
+            qn = np.sqrt(np.sum(q * q, axis=-1, keepdims=True))
+            dbn = np.sqrt(self.state.norms)[None, :]
+            scores = dots / np.maximum(qn * dbn, np.float32(1e-30))
+        elif self.metric == "l2sq":
+            qn = np.sum(q * q, axis=-1, keepdims=True)
+            scores = -(qn + self.state.norms[None, :] - 2.0 * dots)
+        else:
+            raise ValueError(f"unknown metric {self.metric!r}")
+        scores = np.where(self.state.valid[None, :], scores, -np.inf)
+        # lax.top_k tie contract: highest score first, lowest slot among
+        # equals — a stable argsort on the negated scores reproduces it
+        order = np.argsort(-scores, axis=1, kind="stable")[:, :k_eff]
+        top = np.take_along_axis(scores, order, axis=1)
+        out: list[list[tuple[Pointer, float]]] = []
+        for i in range(n):
+            hits = []
+            for score, slot in zip(top[i], order[i]):
                 key = self.slot_to_key.get(int(slot))
                 if key is not None and np.isfinite(score):
                     hits.append((key, float(score)))
@@ -420,7 +578,10 @@ class ExternalIndexNode(Node):
         limit_col: int | None = None,
     ) -> None:
         super().__init__(scope, [index_table, query_table], 2)
-        self.index = index
+        # NOT ``self.index`` — that is the node's scope position
+        # (Node.index), which every scheduler uses to address replicas;
+        # shadowing it breaks sharded delivery for index pipelines
+        self.ext_index = index
         self.index_col = index_col
         self.query_col = query_col
         self.k = k
@@ -428,12 +589,12 @@ class ExternalIndexNode(Node):
 
     def op_state(self) -> dict:
         state = super().op_state()
-        index_state = getattr(self.index, "op_state", None)
+        index_state = getattr(self.ext_index, "op_state", None)
         if index_state is None:
             # silently skipping would resume with an empty index while the
             # reader has already seeked past the rows that populated it
             raise TypeError(
-                f"{type(self.index).__name__} does not implement "
+                f"{type(self.ext_index).__name__} does not implement "
                 "op_state/restore_op_state, so it cannot be used with "
                 "PersistenceMode.OPERATOR_PERSISTING"
             )
@@ -442,8 +603,8 @@ class ExternalIndexNode(Node):
 
     def restore_op_state(self, state: dict) -> None:
         super().restore_op_state(state)
-        if "index" in state and hasattr(self.index, "restore_op_state"):
-            self.index.restore_op_state(state["index"])
+        if "index" in state and hasattr(self.ext_index, "restore_op_state"):
+            self.ext_index.restore_op_state(state["index"])
 
     def process(self, time: int) -> DeltaBatch:
         index_batch = self.take(0)
@@ -470,11 +631,11 @@ class ExternalIndexNode(Node):
             t0 = _t.perf_counter()
             if rm_keys:
                 add_set = set(add_keys)
-                self.index.remove(
+                self.ext_index.remove(
                     [k_ for k_ in rm_keys if k_ not in add_set]
                 )
             if add_keys:
-                self.index.add(add_keys, add_vecs)
+                self.ext_index.add(add_keys, add_vecs)
             _KNN_UPDATES.inc(len(rm_keys) + len(add_keys))
             ctx = _tracing.current()
             if ctx is not None:
@@ -513,7 +674,7 @@ class ExternalIndexNode(Node):
 
             max_k = max(limit for _k, _v, limit in pending)
             t0 = _t.perf_counter()
-            results = self.index.search([v for _k, v, _l in pending], max_k)
+            results = self.ext_index.search([v for _k, v, _l in pending], max_k)
             _KNN_QUERIES.inc(len(pending))
             ctx = _tracing.current()
             if ctx is not None:

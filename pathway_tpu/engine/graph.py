@@ -23,6 +23,7 @@ Key design points vs the reference:
 from __future__ import annotations
 
 import itertools
+import time as _time
 from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
@@ -847,6 +848,15 @@ class _JoinSide:
 _JOIN_FLOAT_EXACT = 1 << 53
 
 
+def _device_ops_active():
+    """The device_ops module when the JAX operator kernels may engage,
+    else None.  The disabled case is one cached env check — the PR-2
+    zero-overhead discipline for escape-hatched machinery."""
+    from pathway_tpu.engine import device_ops as _dops
+
+    return _dops if _dops.enabled() else None
+
+
 def _unify_join_col(a: "_JoinSide", b: "_JoinSide", i: int):
     """Key column ``i`` of two sides cast to one comparison dtype matching
     Python dict-key equality (True == 1 == 1.0), or None when vectorized
@@ -1209,13 +1219,42 @@ class JoinNode(Node):
         if ls.n and rs.n:
             plan.append((ls, rs))
         matches = []
+        # measurement-driven placement of the pair matcher: the device
+        # matcher is pair-for-pair identical to the host one, so the
+        # choice is pure economics (observed ns/row each side)
+        _dops = _device_ops_active() if plan else None
+        use_device = False
+        t0_ns = 0
+        if _dops is not None:
+            from pathway_tpu.optimize.placement import POLICY
+
+            match_rows = sum(l.n + r.n for l, r in plan)
+            t0_ns = _time.perf_counter_ns()
+            use_device = POLICY.choose("join", self.index, match_rows)
         for l, r in plan:
             uni = _unify_join_keys(l, r)
             if uni is None:
                 return None
-            l_idx, r_idx = _match_join_pairs_multi(*uni)
+            got = None
+            if use_device:
+                try:
+                    got = _dops.match_pairs(*uni)
+                except Exception:
+                    got = None  # device trouble: host matcher is the spec
+            if got is None:
+                l_idx, r_idx = _match_join_pairs_multi(*uni)
+            else:
+                l_idx, r_idx = got
             if len(l_idx):
                 matches.append((l, r, l_idx, r_idx))
+        if _dops is not None:
+            POLICY.record(
+                "join",
+                self.index,
+                use_device,
+                match_rows,
+                _time.perf_counter_ns() - t0_ns,
+            )
         # all screens passed: commit the block appends, then emit
         if ls.n:
             self._blocks_left.append(ls)
@@ -1722,13 +1761,40 @@ class _ColumnarGroups:
         else:
             raws, inverse = _factorize_bys(bys)
         nu = len(raws)
-        gdiffs = device.segment_count(inverse, diffs, nu)
+        # device placement: launch the segment reductions as one batch of
+        # device scatter-adds and fetch AFTER the group-id resolution loop
+        # below, so the kernels overlap the host dict walk; any device
+        # trouble falls back to the host kernels (the bit-exact spec)
+        job = None
+        gdiffs = None
         deltas: list[np.ndarray | None] = []
-        for ri, col in enumerate(vals):
-            if col is None:
-                deltas.append(None)
-            else:
-                deltas.append(device.segment_sum(inverse, col, diffs, nu))
+        gb_idx = node.index if isinstance(node.index, int) else -1
+        t0_ns = 0
+        _dops = _device_ops_active()
+        if _dops is not None:
+            from pathway_tpu.optimize.placement import POLICY
+
+            t0_ns = _time.perf_counter_ns()
+            if POLICY.choose("groupby", gb_idx, n):
+                try:
+                    job = _dops.segment_reduce_dispatch(
+                        inverse, diffs, vals, nu
+                    )
+                except Exception:
+                    job = None
+        if job is None:
+            gdiffs = device.segment_count(inverse, diffs, nu)
+            for col in vals:
+                deltas.append(
+                    None
+                    if col is None
+                    else device.segment_sum(inverse, col, diffs, nu)
+                )
+            if _dops is not None:
+                POLICY.record(
+                    "groupby", gb_idx, False, n,
+                    _time.perf_counter_ns() - t0_ns,
+                )
         # resolve group ids (creating new groups), all before mutation
         index = self.index
         gis = np.empty(nu, np.int64)
@@ -1753,6 +1819,14 @@ class _ColumnarGroups:
                 self.size = gi + 1
                 created.append(i)
             gis[i] = gi
+        if job is not None:
+            # the scatter-adds ran while the dict walk above resolved
+            # group ids; materialise their results now
+            gdiffs, deltas = job.fetch()
+            POLICY.record(
+                "groupby", gb_idx, True, n,
+                _time.perf_counter_ns() - t0_ns,
+            )
         # int64 accumulator headroom: degrade before any mutation
         for ri, delta in enumerate(deltas):
             if delta is None:

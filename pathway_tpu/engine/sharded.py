@@ -305,6 +305,15 @@ class ShardedScheduler:
         trace = _tracing.current()
         if probe or trace is not None:
             import time as _walltime
+        # traced runs attribute device-resident operator kernel time to
+        # the span that launched it (critical-path analysis needs the
+        # per-node split, not just the global kernel_ns bucket)
+        _dops = None
+        if trace is not None:
+            from pathway_tpu.engine import device_ops as _device_ops
+
+            if _device_ops.enabled():
+                _dops = _device_ops
         while True:
             busy = False
             busy_nodes = 0
@@ -316,6 +325,7 @@ class ShardedScheduler:
                     busy_nodes += 1
                     if probe or trace is not None:
                         t0 = _walltime.perf_counter()
+                    dns0 = _dops.total_ns() if _dops is not None else 0
                     out = node.process(time)
                     if out is None:
                         out = DeltaBatch()
@@ -324,6 +334,11 @@ class ShardedScheduler:
                     # vectorized exchange can route them
                     node._defer_state(out)
                     if trace is not None:
+                        extra = {}
+                        if _dops is not None:
+                            dns = _dops.total_ns() - dns0
+                            if dns:
+                                extra["device_ns"] = dns
                         trace.span(
                             getattr(node, "name", None)
                             or type(node).__name__,
@@ -334,6 +349,7 @@ class ShardedScheduler:
                             _walltime.perf_counter(),
                             node=node.index,
                             shard=w,
+                            **extra,
                         )
                     if probe:
                         st = self._stats_of(node)

@@ -815,5 +815,49 @@ def _optimizer_collector() -> list[tuple]:
     ]
 
 
+def _device_ops_collector() -> list[tuple]:
+    """The device-operator twin of :func:`_native_collector`: JAX kernel
+    hit/ns counters plus the placement policy's current per-operator
+    decision (1 = device, 0 = host).  Rides the mesh snapshot piggyback
+    like every registered collector, so leader ``/metrics`` and ``cli
+    stats`` see every worker's device placement."""
+    from pathway_tpu.engine import device_ops
+    from pathway_tpu.optimize import placement
+
+    out = []
+    for kernel, hits in device_ops.hit_counts().items():
+        out.append(
+            (
+                "pathway_device_ops_kernel_hits_total",
+                "counter",
+                "JAX device operator kernel launches (device_ops.hit_counts)",
+                {"kernel": kernel},
+                hits,
+            )
+        )
+    for kernel, ns in device_ops.kernel_ns().items():
+        out.append(
+            (
+                "pathway_device_ops_kernel_ns_total",
+                "counter",
+                "cumulative host-observed nanoseconds per device kernel",
+                {"kernel": kernel},
+                ns,
+            )
+        )
+    for op, st in placement.POLICY.decisions().items():
+        out.append(
+            (
+                "pathway_device_ops_placement",
+                "gauge",
+                "current operator placement (1 = device, 0 = host)",
+                {"op": op},
+                1 if st["device"] else 0,
+            )
+        )
+    return out
+
+
 REGISTRY.register_collector(_native_collector)
 REGISTRY.register_collector(_optimizer_collector)
+REGISTRY.register_collector(_device_ops_collector)

@@ -96,15 +96,31 @@ def _us(wall: float) -> int:
 
 
 def _kernel_ns_snapshot() -> dict | None:
+    """Per-kernel cumulative ns across every kernel plane: the C++ host
+    kernels (native.kernel_ns) and the JAX device operator kernels
+    (engine.device_ops), the latter prefixed ``device_ops.`` — span
+    deltas over this snapshot feed the critical-path ``kernel_ns``
+    bucket, so device-resident operators show up as device time."""
+    out: dict | None = None
     try:
         from pathway_tpu import native
 
         kernel_ns = getattr(native, "kernel_ns", None)
-        if kernel_ns is None:
-            return None
-        return dict(kernel_ns())
+        if kernel_ns is not None:
+            out = dict(kernel_ns())
     except Exception:
-        return None
+        out = None
+    try:
+        from pathway_tpu.engine import device_ops
+
+        dns = device_ops.kernel_ns()
+        if dns:
+            out = dict(out) if out else {}
+            for name, ns in dns.items():
+                out["device_ops." + name] = ns
+    except Exception:
+        pass
+    return out
 
 
 class TraceContext:

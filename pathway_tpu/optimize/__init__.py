@@ -14,7 +14,16 @@ flows.  Three passes, in order:
    co-located replica;
 3. **stateless-chain fusion** (:mod:`pathway_tpu.optimize.fuse`) —
    collapse linear Expression/Filter runs into one FusedChainNode
-   evaluating the whole chain in a single columnar sweep per batch.
+   evaluating the whole chain in a single columnar sweep per batch;
+4. **device placement** (:mod:`pathway_tpu.optimize.placement`) —
+   annotation-only: mark the operators eligible for the JAX device
+   kernels (groupby segment reduction, join pair matcher, external KNN
+   index) and seed the measurement-driven placement policy that
+   arbitrates host vs device per batch at runtime.  Unlike the
+   rewriting passes it also runs on graphs whose operators shadow
+   ``node.index`` (external indexes), since it never keys a rewrite off
+   the index; it is a no-op unless ``PATHWAY_TPU_DEVICE_OPS`` enables
+   device ops.
 
 All rewrites mutate the node list *in place* and never add or remove
 list slots: ``node.index == position`` is the invariant the sharded
@@ -33,6 +42,7 @@ import os
 
 from pathway_tpu.optimize import elide as _elide
 from pathway_tpu.optimize import fuse as _fuse
+from pathway_tpu.optimize import placement as _placement
 from pathway_tpu.optimize import pushdown as _pushdown
 from pathway_tpu.optimize.fuse import FusedChainNode
 
@@ -95,6 +105,14 @@ def optimize_scopes(
     if not enabled() or _aruntime.enabled():
         _LAST_STATS = dict(_ZERO_STATS)  # "last run" applied no rewrites
         return set()
+    # placement is annotation-only, so it may run before the index guard
+    # below — external-index graphs are skipped by the rewrites but are
+    # exactly where KNN placement applies
+    dev_eligible, dev_placed = _placement.run_pass(scopes)
+    dev_stats = {
+        "device_eligible": dev_eligible,
+        "device_placed": dev_placed,
+    }
     for i, node in enumerate(primary.nodes):
         if not (isinstance(node.index, int) and node.index == i):
             # external-index/device operators shadow ``.index`` with their
@@ -102,7 +120,7 @@ def optimize_scopes(
             # keys off ``node.index == position`` — leave such graphs
             # untouched (their operators also peek at input state in ways
             # the rewrites must not disturb)
-            _LAST_STATS = dict(_ZERO_STATS)
+            _LAST_STATS = dict(_ZERO_STATS, **dev_stats)
             primary._pw_opt_fingerprint = []
             primary._pw_opt_elided = set()
             return primary._pw_opt_elided
@@ -132,6 +150,7 @@ def optimize_scopes(
         "nodes_fused": sum(len(c) for c in chains),
         "columns_dropped": dropped,
         "exchanges_elided": len(marks),
+        **dev_stats,
     }
     primary._pw_opt_stats = stats
     primary._pw_opt_fingerprint = fingerprint

@@ -1,0 +1,218 @@
+"""Measurement-driven host/device placement for device-resident operators.
+
+The optimizer's fourth pass — but unlike pushdown/elide/fuse it rewrites
+nothing: it only *annotates* eligible operators (groupby, join, external
+KNN index) and seeds the process-wide :data:`POLICY`, which then decides
+host vs device per operator per batch at runtime from observed cost.
+
+Why runtime and not plan time: the right placement depends on batch
+size and on the actual device (a 200-row commit loses to kernel-launch
+latency; a 2M-row commit wins by an order of magnitude), both of which
+the plan cannot know.  The policy keeps an EMA of ns/row for each side
+of each operator, bootstraps by probing both sides, then follows the
+cheaper side with hysteresis (a side must win by 20% to flip the
+decision) and a periodic re-probe of the losing side so a placement can
+recover when batch shapes drift.
+
+The pass is annotation-only on purpose: it runs even for graphs the
+rewriting passes skip (external-index operators shadow ``node.index``,
+which disables index-keyed rewrites — exactly the graphs the KNN
+placement matters for), and it costs nothing when
+``PATHWAY_TPU_DEVICE_OPS`` leaves device ops disabled (one cached env
+check, then return).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["PlacementPolicy", "POLICY", "min_rows", "run_pass"]
+
+#: EMA smoothing for observed ns/row
+_ALPHA = 0.3
+
+
+def min_rows() -> int:
+    """Batches below this row count stay on host in auto mode — kernel
+    launch latency dominates tiny commits (forced mode ignores this so
+    CI exercises the kernels on toy batches)."""
+    try:
+        return max(
+            0, int(os.environ.get("PATHWAY_TPU_DEVICE_OPS_MIN_ROWS", "512"))
+        )
+    except ValueError:
+        return 512
+
+
+class PlacementPolicy:
+    """Per-operator host/device arbitration from observed kernel cost.
+
+    Keyed by ``(op kind, operator position)`` — replicas of one operator
+    across shards share a key, so their samples pool into one decision
+    (the sharded scheduler runs replicas lockstep on one thread; the
+    distributed scheduler pools per process, which is the granularity
+    that owns a device)."""
+
+    #: calls of each side to observe before judging
+    PROBE_CALLS = 3
+    #: a side must be this factor cheaper to flip the decision
+    HYSTERESIS = 1.2
+    #: re-probe the losing side every this many calls
+    REPROBE_EVERY = 256
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: dict = {}
+
+    def _entry(self, key) -> dict:
+        st = self._stats.get(key)
+        if st is None:
+            st = self._stats[key] = {
+                "host_calls": 0,
+                "device_calls": 0,
+                "host_ns_per_row": None,
+                "device_ns_per_row": None,
+                "rows": 0,
+                "device": False,
+            }
+        return st
+
+    def seed(self, kind: str, index: int, device: bool | None = None) -> None:
+        """Register an eligible operator (the optimizer pass calls this so
+        ``decisions()`` lists every candidate before the first batch)."""
+        with self._lock:
+            st = self._entry((kind, index))
+            if device is not None:
+                st["device"] = device
+
+    def choose(self, kind: str, index: int, n_rows: int) -> bool:
+        """True → run this batch on device.  Called on the batch hot path,
+        so the disabled case must stay one cached env check."""
+        from pathway_tpu.engine import device_ops as _dops
+
+        if not _dops.enabled():
+            return False
+        if _dops.forced():
+            return True
+        if n_rows < min_rows():
+            return False
+        with self._lock:
+            st = self._entry((kind, index))
+            # bootstrap: measure both sides before judging
+            if st["device_calls"] < self.PROBE_CALLS:
+                return True
+            if st["host_calls"] < self.PROBE_CALLS:
+                return False
+            total = st["host_calls"] + st["device_calls"]
+            if total % self.REPROBE_EVERY == 0:
+                return not st["device"]  # refresh the losing side's EMA
+            d = st["device_ns_per_row"]
+            h = st["host_ns_per_row"]
+            if st["device"]:
+                if d is not None and h is not None and h * self.HYSTERESIS < d:
+                    st["device"] = False
+            else:
+                if d is not None and h is not None and d * self.HYSTERESIS < h:
+                    st["device"] = True
+            return st["device"]
+
+    def record(
+        self, kind: str, index: int, device: bool, n_rows: int, ns: int
+    ) -> None:
+        """Fold one observed execution into the EMA for its side."""
+        per_row = float(ns) / max(1, n_rows)
+        with self._lock:
+            st = self._entry((kind, index))
+            side = "device" if device else "host"
+            st[side + "_calls"] += 1
+            key = side + "_ns_per_row"
+            prev = st[key]
+            st[key] = (
+                per_row
+                if prev is None
+                else (1.0 - _ALPHA) * prev + _ALPHA * per_row
+            )
+            st["rows"] += int(n_rows)
+            if device and not st["device"] and st["host_calls"] == 0:
+                # forced/bootstrap device runs count as a device placement
+                st["device"] = True
+
+    def decisions(self) -> dict:
+        """Snapshot for cli stats / bench JSON: ``{"kind:index": {...}}``."""
+        out = {}
+        with self._lock:
+            for (kind, index), st in sorted(
+                self._stats.items(), key=lambda kv: (kv[0][0], kv[0][1])
+            ):
+                out[f"{kind}:{index}"] = {
+                    "device": bool(st["device"]),
+                    "host_calls": st["host_calls"],
+                    "device_calls": st["device_calls"],
+                    "host_ns_per_row": (
+                        None
+                        if st["host_ns_per_row"] is None
+                        else round(st["host_ns_per_row"], 1)
+                    ),
+                    "device_ns_per_row": (
+                        None
+                        if st["device_ns_per_row"] is None
+                        else round(st["device_ns_per_row"], 1)
+                    ),
+                    "rows": st["rows"],
+                }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+#: the process-wide policy every operator hook consults
+POLICY = PlacementPolicy()
+
+
+def run_pass(scopes: list) -> tuple[int, int]:
+    """The optimizer's placement pass: annotate eligible operators and
+    seed the policy.  Returns ``(eligible, placed_on_device)`` for the
+    optimizer's stats surface.  Must cost ~nothing when device ops are
+    disabled — that case is one cached env check."""
+    from pathway_tpu.engine import device_ops as _dops
+
+    if not _dops.enabled():
+        return 0, 0
+    from pathway_tpu.engine.graph import GroupbyNode, JoinNode
+
+    force = _dops.forced()
+    eligible = 0
+    placed = 0
+    seen: set = set()
+    for scope in scopes:
+        for pos, node in enumerate(scope.nodes):
+            kind = None
+            if isinstance(node, GroupbyNode):
+                kind = "groupby"
+            elif isinstance(node, JoinNode) and getattr(
+                node, "_columnar_ok", False
+            ):
+                kind = "join"
+            elif type(node).__name__ == "ExternalIndexNode":
+                kind = "knn"
+            if kind is None:
+                continue
+            node._device_ops_eligible = kind
+            if (kind, pos) in seen:
+                continue  # replica of an operator already counted
+            seen.add((kind, pos))
+            eligible += 1
+            # KNN indexes are structurally placed (the factory chose the
+            # engine); groupby/join start on device only when forced
+            device = force or (
+                kind == "knn"
+                and type(getattr(node, "ext_index", None)).__name__
+                == "DeviceKnnIndex"
+            )
+            POLICY.seed(kind, pos, device=device or None)
+            if device:
+                placed += 1
+    return eligible, placed

@@ -12,6 +12,7 @@ query arrival and are revised only when the query row itself changes.
 from pathway_tpu.stdlib.indexing.data_index import (
     BruteForceKnnFactory,
     DataIndex,
+    HostKnnFactory,
     InnerIndexFactory,
     TpuKnnFactory,
 )
@@ -24,6 +25,7 @@ from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex
 
 __all__ = [
     "BruteForceKnnFactory",
+    "HostKnnFactory",
     "HybridIndex",
     "LshKnnFactory",
     "USearchKnnFactory",

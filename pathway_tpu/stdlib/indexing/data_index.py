@@ -55,6 +55,22 @@ class BruteForceKnnFactory(TpuKnnFactory):
     """Reference-compatible name (nearest_neighbors.py:170); same engine."""
 
 
+class HostKnnFactory(TpuKnnFactory):
+    """CPU/NumPy twin of :class:`TpuKnnFactory` — builds the
+    :class:`~pathway_tpu.engine.external_index.HostKnnIndex` bit-exact
+    host spec.  Used by the parity corpus and as the accelerator-free
+    fallback for the streaming-RAG bench when the device probe fails."""
+
+    def build(self) -> Any:
+        from pathway_tpu.engine.external_index import HostKnnIndex
+
+        return HostKnnIndex(
+            dim=self.dimensions,
+            metric=self.metric,
+            capacity=self.capacity,
+        )
+
+
 class DataIndex:
     """An index over ``data_table`` with retrieval as engine dataflow.
 

@@ -1,0 +1,734 @@
+"""Device-resident operator kernels (engine/device_ops.py): parity.
+
+``PATHWAY_TPU_DEVICE_OPS=1`` forces every representable groupby / join
+batch through the JAX kernels and ``=0`` pins the host path; the two
+runs must be bit-identical — sink values, diffs, error logs and
+checkpoint round trips — on the single-worker, sharded in-process and
+TCP-mesh schedulers (the same discipline tests/test_optimize.py
+applies to the graph rewriter).  The corpus deliberately includes
+retractions, NaN float keys and values, empty commits and cancelling
+delta batches, and the KNN host/device index twins.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import pathway_tpu as pw
+import pathway_tpu.engine.graph as g
+from pathway_tpu.engine import device
+from pathway_tpu.engine import device_ops as dops
+from pathway_tpu.engine.external_index import (
+    DeviceKnnIndex,
+    ExternalIndexNode,
+    HostKnnIndex,
+)
+from pathway_tpu.engine.graph import Scheduler, Scope
+from pathway_tpu.engine.persistence import (
+    MemoryBackend,
+    OperatorSnapshotManager,
+)
+from pathway_tpu.engine.reducers import CountReducer, SumReducer
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.runner import GraphRunner, ShardedGraphRunner
+from pathway_tpu.stdlib.indexing import (
+    DataIndex,
+    HostKnnFactory,
+    TpuKnnFactory,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _set(monkeypatch, on: bool) -> None:
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "1" if on else "0")
+
+
+def _canon(obj):
+    """NaN-safe, ndarray-safe canonical form for equality asserts."""
+    if isinstance(obj, np.ndarray):
+        obj = obj.tolist()
+    if isinstance(obj, (list, tuple)):
+        return tuple(_canon(x) for x in obj)
+    if isinstance(obj, float) and obj != obj:
+        return "NaN"
+    return obj
+
+
+# -- direct kernel parity -----------------------------------------------------
+
+
+class TestSegmentReduce:
+    def _check(self, inverse, diffs, vals, nu):
+        gd, deltas = dops.segment_reduce_dispatch(
+            inverse, diffs, vals, nu
+        ).fetch()
+        ref_gd = device.segment_count(inverse, diffs, nu)
+        assert gd.dtype == ref_gd.dtype
+        assert np.array_equal(gd, ref_gd)
+        for got, col in zip(deltas, vals):
+            if col is None:
+                assert got is None
+                continue
+            ref = device.segment_sum(inverse, col, diffs, nu)
+            if ref.size:
+                # bitwise, not tolerance: the device kernel only
+                # reorders exact additions, so it owes the host spec
+                # every bit (empty outputs carry no observable dtype —
+                # np.bincount types them int64 regardless of weights)
+                assert got.dtype == ref.dtype
+                assert np.array_equal(
+                    got.view(np.int64), ref.view(np.int64)
+                )
+
+    def test_int_and_float_columns_with_retractions(self):
+        rng = np.random.default_rng(7)
+        n, nu = 777, 13
+        inverse = rng.integers(0, nu, n).astype(np.int64)
+        diffs = rng.choice([-1, 1], n).astype(np.int64)
+        vals = [
+            rng.integers(-1000, 1000, n).astype(np.int64),
+            None,
+            (rng.integers(-64, 64, n) * 0.25).astype(np.float64),
+        ]
+        self._check(inverse, diffs, vals, nu)
+
+    def test_nan_float_values_poison_identically(self):
+        inverse = np.array([0, 1, 0, 1, 2], np.int64)
+        diffs = np.array([1, 1, -1, 1, 1], np.int64)
+        col = np.array([1.5, np.nan, 1.5, 2.0, 3.0], np.float64)
+        gd, (delta,) = dops.segment_reduce_dispatch(
+            inverse, diffs, [col], 3
+        ).fetch()
+        ref = device.segment_sum(inverse, col, diffs, 3)
+        assert _canon(delta.tolist()) == _canon(ref.tolist())
+        assert np.isnan(delta[1]) and not np.isnan(delta[0])
+
+    def test_empty_batch(self):
+        empty_i = np.empty(0, np.int64)
+        self._check(empty_i, empty_i, [np.empty(0, np.float64)], 0)
+
+    def test_groups_without_rows_report_zero(self):
+        # nu larger than max(inverse)+1: trailing groups get exact zeros
+        inverse = np.array([0, 0], np.int64)
+        diffs = np.array([1, -1], np.int64)
+        self._check(inverse, diffs, [np.array([2.5, 2.5])], 5)
+
+
+class TestMatchPairs:
+    def _host(self, l_arrays, r_arrays):
+        return g._match_join_pairs_multi(l_arrays, r_arrays)
+
+    def _assert_same(self, l_arrays, r_arrays):
+        got = dops.match_pairs(l_arrays, r_arrays)
+        assert got is not None
+        ref = self._host(l_arrays, r_arrays)
+        assert np.array_equal(got[0], ref[0])
+        assert np.array_equal(got[1], ref[1])
+
+    def test_int_keys_with_duplicates(self):
+        rng = np.random.default_rng(3)
+        la = rng.integers(0, 40, 300).astype(np.int64)
+        ra = rng.integers(0, 40, 90).astype(np.int64)
+        self._assert_same([la], [ra])
+        self._assert_same([ra], [la])  # swap rule (smaller haystack)
+
+    def test_multi_column_keys(self):
+        rng = np.random.default_rng(5)
+        l0 = rng.integers(0, 9, 200).astype(np.int64)
+        l1 = (rng.integers(0, 5, 200) * 0.5).astype(np.float64)
+        r0 = rng.integers(0, 9, 60).astype(np.int64)
+        r1 = (rng.integers(0, 5, 60) * 0.5).astype(np.float64)
+        self._assert_same([l0, l1], [r0, r1])
+
+    def test_empty_side(self):
+        la = np.array([1, 2, 3], np.int64)
+        got = dops.match_pairs([la], [np.empty(0, np.int64)])
+        assert got is not None and len(got[0]) == 0 == len(got[1])
+
+    def test_no_matches(self):
+        self._assert_same(
+            [np.array([1, 2], np.int64)], [np.array([7, 8], np.int64)]
+        )
+
+    def test_negative_zero_float_keys_unify(self):
+        la = np.array([0.0, 1.0], np.float64)
+        ra = np.array([-0.0, 2.0], np.float64)
+        self._assert_same([la], [ra])  # -0.0 == 0.0 must match
+
+    def test_nan_float_keys_decline_to_host(self):
+        # NaN breaks the bit-equality code view: the device matcher
+        # must refuse (None) so the caller keeps the host spec
+        la = np.array([1.0, np.nan], np.float64)
+        ra = np.array([1.0, np.nan], np.float64)
+        assert dops.match_pairs([la], [ra]) is None
+
+
+# -- engine-level parity (retractions / empty / cancelling batches) -----------
+
+
+def _feed_groupby(sess, sched, nan_vals=False):
+    live = {}
+
+    def ins(i, row):
+        k = ref_scalar(i)
+        live[i] = row
+        sess.insert(k, row)
+
+    def rm(i):
+        sess.remove(ref_scalar(i), live.pop(i))
+
+    for i in range(600):
+        v = float("nan") if nan_vals and i % 97 == 0 else i * 0.5
+        ins(i, (i % 7, i, v))
+    sched.commit()
+    for i in range(100, 150):  # retract + reinsert modified
+        rm(i)
+        ins(i, (i % 7, i + 1000, i * 0.25))
+    sched.commit()
+    sched.commit()  # empty commit
+    ins(10_000, (3, 1, 1.0))  # cancelling batch: net-zero delta
+    rm(10_000)
+    sched.commit()
+    for i in [k for k in list(live) if live[k][0] == 6]:
+        rm(i)  # retract an entire group to extinction
+    sched.commit()
+
+
+def _run_groupby(on, monkeypatch, nan_vals=False):
+    _set(monkeypatch, on)
+    events: list = []
+    sc = Scope()
+    sess = sc.input_session(3)
+    gb = sc.group_by_table(
+        sess,
+        by_cols=[0],
+        reducers=[(SumReducer(), [1]), (SumReducer(), [2]), (CountReducer(), [])],
+    )
+    sc.subscribe_table(
+        gb, on_change=lambda k, row, t, d: events.append((k, row, t, d))
+    )
+    sched = Scheduler(sc)
+    _feed_groupby(sess, sched, nan_vals=nan_vals)
+    ev = sorted(
+        (_canon(e) for e in events),
+        key=lambda e: (int(e[0]), e[2], e[3], repr(e[1])),
+    )
+    cur = {k: _canon(v) for k, v in gb.current.items()}
+    return cur, ev
+
+
+def test_engine_groupby_parity(monkeypatch):
+    dops.reset_counters()
+    cur_off, ev_off = _run_groupby(False, monkeypatch)
+    assert not dops.hit_counts()  # host run launched no kernels
+    cur_on, ev_on = _run_groupby(True, monkeypatch)
+    assert cur_on == cur_off
+    assert ev_on == ev_off
+    assert dops.hit_counts().get("segment_reduce", 0) > 0  # non-vacuous
+
+
+def test_engine_groupby_parity_nan_values(monkeypatch):
+    cur_off, ev_off = _run_groupby(False, monkeypatch, nan_vals=True)
+    cur_on, ev_on = _run_groupby(True, monkeypatch, nan_vals=True)
+    assert cur_on == cur_off
+    assert ev_on == ev_off
+    assert any("NaN" in repr(v) for v in cur_on.values())
+
+
+def _run_join(on, monkeypatch, kind="inner", float_keys=False, nan=False):
+    _set(monkeypatch, on)
+    events: list = []
+    sc = Scope()
+    left = sc.input_session(2)
+    right = sc.input_session(2)
+    j = sc.join_tables(left, right, left_on=[0], right_on=[0], kind=kind)
+    sc.subscribe_table(
+        j, on_change=lambda k, row, t, d: events.append((k, row, t, d))
+    )
+    sched = Scheduler(sc)
+
+    def key(i):
+        if not float_keys:
+            return i % 11
+        if nan and i % 13 == 0:
+            return float("nan")
+        return float(i % 11) * 0.5
+
+    lrows = {i: (key(i), float(i)) for i in range(240)}
+    for i, r in lrows.items():
+        left.insert(ref_scalar(("l", i)), r)
+    sched.commit()
+    rrows = {i: (key(i), float(100 + i)) for i in range(11)}
+    for i, r in rrows.items():
+        right.insert(ref_scalar(("r", i)), r)
+    sched.commit()
+    sched.commit()  # empty commit
+    for i in range(30, 60):  # left-side retraction batch
+        left.remove(ref_scalar(("l", i)), lrows.pop(i))
+    sched.commit()
+    right.remove(ref_scalar(("r", 4)), rrows.pop(4))  # kill a match key
+    right.insert(ref_scalar(("r", 40)), (key(7), 777.0))  # second match row
+    sched.commit()
+    ev = sorted(
+        (_canon(e) for e in events),
+        key=lambda e: (int(e[0]), e[2], e[3], repr(e[1])),
+    )
+    cur = {k: _canon(v) for k, v in j.current.items()}
+    return cur, ev
+
+
+@pytest.mark.parametrize("kind", ["inner", "left"])
+def test_engine_join_parity(kind, monkeypatch):
+    dops.reset_counters()
+    cur_off, ev_off = _run_join(False, monkeypatch, kind=kind)
+    cur_on, ev_on = _run_join(True, monkeypatch, kind=kind)
+    assert cur_on == cur_off
+    assert ev_on == ev_off
+    if kind == "inner":  # the columnar matcher path is inner-join only
+        assert dops.hit_counts().get("match_pairs", 0) > 0
+
+
+def test_engine_join_parity_float_keys(monkeypatch):
+    cur_off, ev_off = _run_join(False, monkeypatch, float_keys=True)
+    cur_on, ev_on = _run_join(True, monkeypatch, float_keys=True)
+    assert cur_on == cur_off and ev_on == ev_off
+
+
+def test_engine_join_parity_nan_keys(monkeypatch):
+    # NaN keys force the device matcher to decline per-batch; outputs
+    # must stay identical to a host-only run
+    cur_off, ev_off = _run_join(
+        False, monkeypatch, float_keys=True, nan=True
+    )
+    cur_on, ev_on = _run_join(True, monkeypatch, float_keys=True, nan=True)
+    assert cur_on == cur_off and ev_on == ev_off
+
+
+def test_error_log_parity(monkeypatch):
+    from pathway_tpu.engine import expression as ex
+
+    def run(on):
+        _set(monkeypatch, on)
+        events: list = []
+        sc = Scope()
+        sess = sc.input_session(2)
+        e1 = sc.expression_table(
+            sess,
+            [
+                ex.Binary("%", ex.ColumnRef(0), ex.Const(5)),
+                # 1/x poisons x == 0 rows with ERROR
+                ex.Binary("/", ex.Const(1.0), ex.ColumnRef(1)),
+            ],
+        )
+        gb = sc.group_by_table(
+            e1, by_cols=[0], reducers=[(SumReducer(), [1]), (CountReducer(), [])]
+        )
+        sc.subscribe_table(
+            gb, on_change=lambda k, row, t, d: events.append((k, row, d))
+        )
+        sched = Scheduler(sc)
+        for i in range(400):
+            sess.insert(ref_scalar(i), (i, float(i % 5)))
+        sched.commit()
+        log = sorted(sc.error_log_default.current.values())
+        ev = sorted(
+            (_canon(e) for e in events),
+            key=lambda e: (int(e[0]), e[2], repr(e[1])),
+        )
+        return ev, log
+
+    ev_off, log_off = run(False)
+    ev_on, log_on = run(True)
+    assert ev_off == ev_on
+    assert log_off == log_on
+    assert log_on  # the corpus actually exercised the error path
+
+
+# -- framework parity corpus --------------------------------------------------
+
+
+def _corpus():
+    def groupby():
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=str, v=int, w=float),
+            [(f"k{i % 5}", i, i * 0.25) for i in range(300)],
+        )
+        sel = t.select(k=t.k, v=t.v * 2 + 1, w=t.w)
+        flt = sel.filter(sel.v > 7)
+        return flt.groupby(flt.k).reduce(
+            k=flt.k,
+            total=pw.reducers.sum(flt.v),
+            wsum=pw.reducers.sum(flt.w),
+            cnt=pw.reducers.count(),
+        )
+
+    def join():
+        orders = pw.debug.table_from_rows(
+            pw.schema_from_types(oid=int, cust=str, amount=float),
+            [(i, f"c{i % 7}", float(i) * 1.5) for i in range(280)],
+        )
+        custs = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str, region=str),
+            [(f"c{i}", f"r{i % 2}") for i in range(7)],
+        )
+        j = orders.join(custs, orders.cust == custs.name)
+        return j.select(
+            cust=orders.cust, region=custs.region, amount=orders.amount
+        )
+
+    def join_groupby():
+        # join feeding a groupby: the two device kernels composed
+        orders = pw.debug.table_from_rows(
+            pw.schema_from_types(oid=int, cust=str, amount=float),
+            [(i, f"c{i % 4}", float(i)) for i in range(300)],
+        )
+        custs = pw.debug.table_from_rows(
+            pw.schema_from_types(name=str, region=str),
+            [(f"c{i}", f"r{i % 2}") for i in range(4)],
+        )
+        j = orders.join(custs, orders.cust == custs.name).select(
+            region=custs.region, amount=orders.amount
+        )
+        return j.groupby(j.region).reduce(
+            region=j.region,
+            total=pw.reducers.sum(j.amount),
+            cnt=pw.reducers.count(),
+        )
+
+    def knn():
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(doc=int, emb=tuple),
+            [
+                (i, tuple(float((i * 7 + j * 3) % 13 - 6) for j in range(4)))
+                for i in range(40)
+            ],
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(q=int, qemb=tuple),
+            [
+                (i, tuple(float((i * 5 + j) % 13 - 6) for j in range(4)))
+                for i in range(9)
+            ],
+        )
+        index = DataIndex(
+            docs, TpuKnnFactory(dimensions=4, capacity=8), docs.emb
+        )
+        return index.query_as_of_now(
+            queries, queries.qemb, number_of_matches=3
+        )
+
+    return {
+        "groupby": groupby,
+        "join": join,
+        "join_groupby": join_groupby,
+        "knn": knn,
+    }
+
+
+def _capture(build, runner_factory, monkeypatch, on):
+    _set(monkeypatch, on)
+    G.clear()
+    try:
+        (state,) = runner_factory().capture(build())
+    finally:
+        G.clear()
+    return {k: _canon(v) for k, v in state.items()}
+
+
+@pytest.mark.parametrize("name", sorted(_corpus()))
+def test_single_worker_parity(name, monkeypatch):
+    build = _corpus()[name]
+    off = _capture(build, GraphRunner, monkeypatch, False)
+    on = _capture(build, GraphRunner, monkeypatch, True)
+    assert off == on
+
+
+@pytest.mark.parametrize("name", sorted(_corpus()))
+def test_sharded_parity(name, monkeypatch):
+    build = _corpus()[name]
+    off = _capture(build, lambda: ShardedGraphRunner(3), monkeypatch, False)
+    on = _capture(build, lambda: ShardedGraphRunner(3), monkeypatch, True)
+    assert off == on
+
+
+# -- KNN host/device twins ----------------------------------------------------
+
+
+def _ivec(seed, dim=6):
+    # small-integer-valued float32 vectors: every sum/product below is
+    # exactly representable, so host numpy and device jax agree bitwise
+    return np.array(
+        [(seed * 7 + j * 5) % 11 - 5 for j in range(dim)], np.float32
+    )
+
+
+@pytest.mark.parametrize("metric", ["cos", "dot", "l2sq"])
+def test_knn_index_twins_bitwise(metric):
+    dev = DeviceKnnIndex(dim=6, metric=metric, capacity=8)
+    host = HostKnnIndex(dim=6, metric=metric, capacity=8)
+    keys = [ref_scalar(i) for i in range(20)]
+    vecs = [_ivec(i) for i in range(20)]
+    for ix in (dev, host):
+        ix.add(keys, vecs)  # growth past capacity 8
+        ix.remove(keys[3:8])
+        ix.add(keys[4:6], [_ivec(100 + i) for i in range(2)])  # re-add
+    queries = [_ivec(50 + i) for i in range(5)]
+    for k in (1, 3, 64):  # k past live count clamps identically
+        got = dev.search(queries, k)
+        ref = host.search(queries, k)
+        assert _canon(got) == _canon(ref)
+    assert dev.search([], 3) == host.search([], 3) == []
+
+
+def test_knn_factory_parity(monkeypatch):
+    # the full DataIndex dataflow built on each twin: identical tables
+    def build(factory_cls):
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(doc=int, emb=tuple),
+            [
+                (i, tuple(float((i * 7 + j * 3) % 13 - 6) for j in range(4)))
+                for i in range(40)
+            ],
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(q=int, qemb=tuple),
+            [
+                (i, tuple(float((i * 5 + j) % 13 - 6) for j in range(4)))
+                for i in range(9)
+            ],
+        )
+        index = DataIndex(
+            docs, factory_cls(dimensions=4, capacity=8), docs.emb
+        )
+        return index.query_as_of_now(
+            queries, queries.qemb, number_of_matches=3
+        )
+
+    device_state = _capture(
+        lambda: build(TpuKnnFactory), GraphRunner, monkeypatch, True
+    )
+    host_state = _capture(
+        lambda: build(HostKnnFactory), GraphRunner, monkeypatch, False
+    )
+    assert device_state == host_state
+
+
+def test_knn_engine_node_parity_with_retractions():
+    def run(index):
+        sc = Scope()
+        index_in = sc.input_session(arity=1)
+        query_in = sc.input_session(arity=1)
+        node = ExternalIndexNode(
+            sc, index_in, query_in, index, index_col=0, query_col=0, k=3
+        )
+        sched = Scheduler(sc)
+        for i in range(12):
+            index_in.insert(ref_scalar(i), (tuple(_ivec(i).tolist()),))
+        sched.commit()
+        for i in range(4):
+            index_in.remove(ref_scalar(i), (tuple(_ivec(i).tolist()),))
+        sched.commit()
+        for i in range(4):
+            query_in.insert(
+                ref_scalar(("q", i)), (tuple(_ivec(30 + i).tolist()),)
+            )
+        sched.commit()
+        return {k: _canon(v) for k, v in node.current.items()}
+
+    dev = run(DeviceKnnIndex(dim=6, capacity=4))
+    host = run(HostKnnIndex(dim=6, capacity=4))
+    assert dev == host
+
+
+# -- checkpoint compatibility -------------------------------------------------
+
+
+class TestCheckpointCompat:
+    """Placement is a runtime decision, not graph structure: a snapshot
+    taken with device ops forced must restore under a host-only run (and
+    vice versa) with identical state — unlike the optimizer, there is no
+    fingerprint to refuse on."""
+
+    def _snap(self, on, backend, monkeypatch, restore_only=False):
+        _set(monkeypatch, on)
+        sc = Scope()
+        sess = sc.input_session(3)
+        gb = sc.group_by_table(
+            sess,
+            by_cols=[0],
+            reducers=[(SumReducer(), [1]), (SumReducer(), [2])],
+        )
+        sched = Scheduler(sc)
+        mgr = OperatorSnapshotManager(backend)
+        if restore_only:
+            restored = mgr.restore(sc, [])
+            return gb, restored
+        for i in range(600):
+            sess.insert(ref_scalar(i), (i % 7, i, i * 0.5))
+        sched.commit()
+        for i in range(100, 150):
+            sess.remove(ref_scalar(i), (i % 7, i, i * 0.5))
+        sched.commit()
+        mgr.snapshot(sc, [], sched.time)
+        return gb, None
+
+    @pytest.mark.parametrize("snap_on,restore_on", [(True, False), (False, True)])
+    def test_cross_restore(self, snap_on, restore_on, monkeypatch):
+        backend = MemoryBackend()
+        gb1, _ = self._snap(snap_on, backend, monkeypatch)
+        gb2, restored = self._snap(
+            restore_on, backend, monkeypatch, restore_only=True
+        )
+        assert restored is not None
+        assert {k: _canon(v) for k, v in gb2.current.items()} == {
+            k: _canon(v) for k, v in gb1.current.items()
+        }
+
+
+# -- TCP-mesh parity ----------------------------------------------------------
+
+
+MESH_PROGRAM = """
+    import pathway_tpu as pw
+
+    words = pw.io.csv.read(
+        {indir!r},
+        schema=pw.schema_from_types(word=str, n=int),
+        mode="static",
+    )
+    sel = words.select(word=pw.this.word, n=pw.this.n * 3 + 1)
+    flt = sel.filter(sel.n > 10)
+    counts = flt.groupby(flt.word).reduce(
+        word=flt.word, total=pw.reducers.sum(flt.n)
+    )
+    dims = pw.debug.table_from_rows(
+        pw.schema_from_types(word=str, tag=str),
+        [("w%d" % i, "t%d" % (i % 3)) for i in range(11)],
+    )
+    joined = counts.join(dims, counts.word == dims.word).select(
+        word=counts.word, total=counts.total, tag=dims.tag
+    )
+    pw.io.csv.write(joined, {out!r})
+    pw.run()
+"""
+
+
+def _free_port_base(n: int) -> int:
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        if base + n >= 65535:
+            continue
+        if all(_bindable(base + i) for i in range(n)):
+            return base
+    raise RuntimeError("no free port range found")
+
+
+def _bindable(port: int) -> bool:
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def _spawn_mesh(tmp_path, code: str, on: bool, out):
+    from pathway_tpu.cli import spawn
+
+    prog = tmp_path / f"prog_{int(on)}.py"
+    prog.write_text(textwrap.dedent(code))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PATHWAY_TPU_DEVICE_OPS"] = "1" if on else "0"
+    env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+    rc = spawn(
+        sys.executable,
+        [str(prog)],
+        threads=1,
+        processes=3,
+        first_port=_free_port_base(3),
+        env=env,
+    )
+    assert rc == 0
+    with open(out, newline="") as fh:
+        rows = list(csv.DictReader(fh))
+    return sorted(
+        (r["word"], int(r["total"]), r["tag"])
+        for r in rows
+        if int(r["diff"]) > 0
+    )
+
+
+def test_mesh_parity_device_ops_on_off(tmp_path):
+    indir = tmp_path / "in"
+    indir.mkdir()
+    with open(indir / "words.csv", "w") as fh:
+        fh.write("word,n\n")
+        fh.writelines(f"w{i % 11},{i % 9}\n" for i in range(300))
+    results = {}
+    for on in (False, True):
+        out = tmp_path / f"out_{int(on)}.csv"
+        results[on] = _spawn_mesh(
+            tmp_path,
+            MESH_PROGRAM.format(indir=str(indir), out=str(out)),
+            on,
+            out,
+        )
+    assert results[True] == results[False]
+    assert results[True]  # the pipeline produced rows
+
+
+# -- env contract + counters --------------------------------------------------
+
+
+def test_enabled_env_contract(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "0")
+    assert not dops.enabled() and not dops.forced()
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "off")
+    assert not dops.enabled()
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "1")
+    assert dops.enabled() and dops.forced()
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "force")
+    assert dops.enabled() and dops.forced()
+
+
+def test_stats_shape(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "1")
+    dops.reset_counters()
+    s = dops.stats()
+    assert s["enabled"] is True
+    assert s["hit_counts"] == {} and s["kernel_ns"] == {}
+    dops.record_kernel("segment_reduce", 1234)
+    s = dops.stats()
+    assert s["hit_counts"] == {"segment_reduce": 1}
+    assert dops.total_ns() == 1234
+    assert "placement" in s
+    dops.reset_counters()
+
+
+def test_placement_policy_forced_ignores_min_rows(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "1")
+    from pathway_tpu.optimize.placement import PlacementPolicy
+
+    pol = PlacementPolicy()
+    assert pol.choose("groupby", 0, 1)  # forced: even a 1-row batch
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "0")
+    assert not dops.enabled()

@@ -26,16 +26,24 @@ problem):
 7. async-device overhead — the same workload with a zero-cost fake
    device batch staged per commit, pipeline on vs inline decay; FAILs
    when the machinery costs more than 5%;
-8. trace export — a small traced program runs end-to-end and the
+8. device-ops parity — the device-vs-host parity corpus
+   (tests/test_device_ops.py) rerun with ``PATHWAY_TPU_DEVICE_OPS=1``
+   under ``JAX_PLATFORMS=cpu``: every representable groupby/join batch
+   goes through the JAX kernels and must land bit-identical sinks;
+9. device-ops placement overhead — the placement hooks (policy lookup +
+   env check per commit) with no device present, stubbed vs live; FAILs
+   when the machinery costs more than 5% (one retry absorbs timer
+   noise — the hook cost is nanoseconds against millisecond commits);
+10. trace export — a small traced program runs end-to-end and the
    exported file must satisfy the Chrome trace-event schema invariants
    (complete X / matched B-E events, monotonic timestamps per track);
-9. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
+11. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
    mesh with operator persistence: a follower SIGKILL (supervised
    restart + rollback), a LEADER SIGKILL (epoch-fenced election
    failover), and a SIGKILL injected while a live N→M rescale is
    quiescing; every leg must land the exact fault-free sink, within a
    bounded wall budget;
-10. sanitized native build — recompile ``native/enginecore.cpp`` with
+12. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
    (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
@@ -492,6 +500,97 @@ def step_async_overhead() -> str:
     return status
 
 
+def step_device_ops_parity() -> str:
+    """Re-run the device-vs-host parity corpus with the device kernels
+    FORCED (PATHWAY_TPU_DEVICE_OPS=1) on the CPU backend: every
+    representable groupby/join batch goes through the JAX kernels and
+    the sinks, error logs and checkpoints must stay bit-identical to
+    the host spec — the same discipline the optimize-off step applies
+    to the graph rewriter."""
+    name = "device-ops parity (PATHWAY_TPU_DEVICE_OPS=1, cpu backend)"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/test_device_ops.py",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+        ],
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_TPU_DEVICE_OPS": "1",
+        },
+        timeout=900,
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        name,
+        status,
+        f"pytest exit {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+def _device_ops_overhead_once() -> tuple[float | None, str]:
+    """One run of the placement-overhead leg: (overhead_pct, detail)."""
+    import json
+
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('DEVICE_OPS_OVERHEAD_JSON ' + json.dumps("
+        "b.device_ops_overhead_leg()()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.SubprocessError as e:
+        return None, f"bench leg did not finish: {e}"
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("DEVICE_OPS_OVERHEAD_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        return None, f"bench leg exit {proc.returncode}"
+    overhead = payload["overhead_pct"]
+    detail = (
+        f"{overhead:+.2f}% "
+        f"(stubbed {payload['hooks_stubbed_s']}s, "
+        f"live {payload['hooks_disabled_s']}s)"
+    )
+    return overhead, detail
+
+
+def step_device_ops_overhead() -> str:
+    """Gate the no-device tax: bench_dataflow.device_ops_overhead_leg
+    times the groupby/join workload with the placement hooks stubbed
+    out entirely vs live-but-disabled (interleaved best-of-5 pairs);
+    >5% overhead is a FAIL.  The hook cost is nanoseconds against
+    millisecond commits, so a failure is retried once — two
+    consecutive >5% readings are signal, one is timer noise."""
+    name = "device-ops placement overhead (no device, hooks vs stubbed)"
+    overhead, detail = _device_ops_overhead_once()
+    if overhead is not None and overhead > 5.0:
+        overhead, detail = _device_ops_overhead_once()
+        detail += " [retried]"
+    if overhead is None:
+        _report(name, FAIL, detail)
+        return FAIL
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
+    return status
+
+
 #: the chaos gate's three fixed-seed legs — one follower kill (seed 7),
 #: one LEADER kill exercising election + epoch fencing (seed 13), and one
 #: kill racing a live rescale's quiesce (seed 26).  All three share one
@@ -562,6 +661,8 @@ def main(argv=None) -> int:
         step_metrics_overhead(),
         step_trace_overhead(),
         step_async_overhead(),
+        step_device_ops_parity(),
+        step_device_ops_overhead(),
         step_trace_export(),
         step_chaos_gate(),
     ]
