@@ -556,6 +556,264 @@ def host_fallback_pipeline_leg() -> dict:
     }
 
 
+def _serving_ingest_run(
+    dim: int, corpus: list, embed, serve: bool,
+    n_queries: int, n_clients: int,
+    ingest_rate: float, qps: float,
+) -> dict:
+    """One pass of the crc32/HostKnn ingest pipeline; with ``serve``
+    the snapshot read plane is enabled and ``n_clients`` HTTP clients
+    drive at least ``n_queries`` KNN queries at the per-process query
+    server WHILE ingest is live.  Both sides are PACED (``ingest_rate``
+    docs/s, ``qps`` queries/s open-loop): a live connector source has
+    its own arrival rate, so the overhead gate asks whether serving
+    stalls that cadence — not how two closed loops split the GIL.
+    Returns ingest docs/sec plus (serving runs only) client-observed
+    latencies and server-side counters."""
+    import json as _json
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu import serving as _serving
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.stdlib.indexing import DataIndex, HostKnnFactory
+
+    G.clear()
+    n_docs = len(corpus)
+    ingest_done = threading.Event()
+    first_commit = threading.Event()
+    target_met = threading.Event()
+    stop = threading.Event()
+    timing = {"run_start": 0.0, "ingest_end": 0.0}
+    latencies: list[float] = []
+    lat_lock = threading.Lock()
+    issued = [0]
+    shed_client = [0]
+    bad_status: list = []
+    clients: list[threading.Thread] = []
+    qvecs = [embed(corpus[i * 131 % n_docs]) for i in range(64)]
+
+    def client(url: str, cid: int) -> None:
+        rng = np.random.default_rng(cid)
+        interval = n_clients / qps if qps > 0 else 0.0
+        next_t = time.perf_counter() + (cid % n_clients) * (
+            interval / max(1, n_clients)
+        )
+        while not stop.is_set() and not (
+            ingest_done.is_set() and issued[0] >= n_queries
+        ):
+            if interval > 0:
+                delay = next_t - time.perf_counter()
+                if delay > 0:
+                    stop.wait(delay)
+                next_t += interval
+            vec = qvecs[int(rng.integers(0, len(qvecs)))]
+            body = _json.dumps({"vector": vec.tolist(), "k": K}).encode()
+            req = urllib.request.Request(
+                url + "/serving/query",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    resp.read()
+                    code = resp.status
+            except urllib.error.HTTPError as exc:
+                code = exc.code
+            except OSError:
+                stop.wait(0.05)  # server gone or socket refused: back off
+                continue
+            dt = time.perf_counter() - t0
+            with lat_lock:
+                issued[0] += 1
+                if code == 200:
+                    latencies.append(dt)
+                elif code == 503:
+                    shed_client[0] += 1
+                else:
+                    bad_status.append(code)
+                if issued[0] >= n_queries:
+                    target_met.set()
+
+    class DocFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            # doc 0 + first-commit wait happen OUTSIDE the timed window
+            # (both modes), so docs/sec measures steady-state ingest —
+            # with the query load already running in the serving pass
+            self.next(doc_id=0, text=corpus[0])
+            first_commit.wait(30.0)
+            start = time.perf_counter()
+            timing["run_start"] = start
+            for i in range(1, n_docs):
+                if ingest_rate > 0:
+                    delay = start + i / ingest_rate - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                self.next(doc_id=i, text=corpus[i])
+            if serve:
+                # hold the run (and its query server) open until the
+                # clients reach the query target — the tail queries are
+                # still served in-run, against the final snapshots
+                target_met.wait(60.0)
+
+    class QueryFeed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            pass  # keeps the index node reachable; serving answers reads
+
+    docs = pw.io.python.read(
+        DocFeed(),
+        schema=pw.schema_from_types(doc_id=int, text=str),
+        autocommit_duration_ms=100,
+    )
+    docs = docs.select(
+        doc_id=pw.this.doc_id, emb=pw.apply(embed, pw.this.text)
+    )
+    queries = pw.io.python.read(
+        QueryFeed(),
+        schema=pw.schema_from_types(query_id=int, text=str),
+        autocommit_duration_ms=None,
+    )
+    queries = queries.select(
+        query_id=pw.this.query_id, qemb=pw.apply(embed, pw.this.text)
+    )
+    index = DataIndex(
+        docs,
+        HostKnnFactory(
+            dimensions=dim,
+            capacity=1 << max(10, (n_docs - 1).bit_length()),
+        ),
+        docs.emb,
+    )
+    res = index.query_as_of_now(queries, queries.qemb, number_of_matches=K)
+
+    n_ingested = [0]
+    perf_counter = time.perf_counter
+
+    def on_doc(key, row, time, is_addition):
+        if is_addition:
+            n_ingested[0] += 1
+            if not first_commit.is_set():
+                if serve:
+                    srv = _serving.query_server()
+                    if srv is not None and not clients:
+                        for cid in range(n_clients):
+                            t = threading.Thread(
+                                target=client,
+                                args=(srv.url, cid),
+                                daemon=True,
+                            )
+                            clients.append(t)
+                            t.start()
+                first_commit.set()
+            if n_ingested[0] == n_docs:
+                timing["ingest_end"] = perf_counter()
+                ingest_done.set()
+
+    pw.io.subscribe(docs, on_change=on_doc)
+    pw.io.subscribe(
+        res, on_change=lambda key, row, time, is_addition: None
+    )
+    if serve:
+        os.environ["PATHWAY_TPU_SERVING"] = "1"
+    try:
+        pw.run(monitoring_level=None)
+    finally:
+        if serve:
+            os.environ.pop("PATHWAY_TPU_SERVING", None)
+        stop.set()
+    for t in clients:
+        t.join(5.0)
+    elapsed = timing["ingest_end"] - timing["run_start"]
+    out: dict = {
+        "docs_per_sec": (n_docs - 1) / elapsed if elapsed > 0 else None,
+    }
+    if serve:
+        from pathway_tpu.serving import server as _srv_mod
+
+        lat_ms = sorted(1000.0 * x for x in latencies)
+
+        def pct(p: float):
+            if not lat_ms:
+                return None
+            return round(
+                lat_ms[min(len(lat_ms) - 1, int(p * len(lat_ms)))], 3
+            )
+
+        out.update(
+            {
+                "n_queries": issued[0],
+                "n_ok": len(lat_ms),
+                "shed_503": shed_client[0],
+                "bad_status": sorted(set(bad_status)),
+                "query_p50_ms": pct(0.50),
+                "query_p95_ms": pct(0.95),
+                "query_p99_ms": pct(0.99),
+                "server_shed_total": _srv_mod._SHED.value,
+                "server_latency_p99_ms": round(
+                    _srv_mod._LATENCY.quantile(0.99) * 1000.0, 3
+                ),
+                "server_latency_count": _srv_mod._LATENCY.count,
+                "batch_dispatches": _srv_mod._BATCHED.count,
+                "batch_queries": _srv_mod._BATCHED.sum,
+            }
+        )
+    return out
+
+
+def serving_plane_leg() -> dict:
+    """Snapshot read plane under load: the crc32/HostKnn ingest pipeline
+    runs twice — serving off (baseline ingest rate), then serving on
+    with >= BENCH_SERVING_QUERIES concurrent HTTP KNN queries from
+    BENCH_SERVING_CLIENTS client threads against the live-updating
+    index.  Reports the ingest overhead the read plane costs (gate:
+    <= 5%) and client-observed query latency percentiles (gate: p99
+    < 50 ms host fallback), plus server-side shed/batch counters."""
+    import zlib
+
+    dim = 128
+    n_docs = int(os.environ.get("BENCH_SERVING_DOCS", "20000"))
+    n_queries = int(os.environ.get("BENCH_SERVING_QUERIES", "1000"))
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "32"))
+    ingest_rate = float(
+        os.environ.get("BENCH_SERVING_INGEST_RATE", "1000")
+    )
+    qps = float(os.environ.get("BENCH_SERVING_QPS", "60"))
+
+    def embed(text: str) -> np.ndarray:
+        vec = np.zeros(dim, np.float32)
+        for tok in text.split():
+            h = zlib.crc32(tok.encode())
+            vec[h % dim] += 1.0 if (h >> 16) & 1 else -1.0
+        n = float(np.linalg.norm(vec))
+        return vec / n if n > 0 else vec
+
+    corpus = [_doc_text(i) for i in range(n_docs)]
+    # client sockets need headroom beyond the worker pool
+    os.environ.setdefault("PATHWAY_TPU_SERVING_QUEUE", "512")
+    baseline = _serving_ingest_run(
+        dim, corpus, embed, False, n_queries, n_clients, ingest_rate, qps
+    )
+    serving = _serving_ingest_run(
+        dim, corpus, embed, True, n_queries, n_clients, ingest_rate, qps
+    )
+    base_dps = baseline["docs_per_sec"] or 0.0
+    serve_dps = serving.pop("docs_per_sec") or 0.0
+    overhead = (
+        round(100.0 * (1.0 - serve_dps / base_dps), 2) if base_dps else None
+    )
+    return {
+        "baseline_docs_per_sec": round(base_dps, 1),
+        "serving_docs_per_sec": round(serve_dps, 1),
+        "ingest_overhead_pct": overhead,
+        "n_docs": n_docs,
+        "n_clients": n_clients,
+        "ingest_rate_target": ingest_rate,
+        "qps_target": qps,
+        **serving,
+    }
+
+
 def _device_query_latency_ms(embedder, capacity: int, m: int = 64) -> float:
     """Device-only KNN query latency (embed bucket-8 + gather + search +
     result pack), amortized over ``m`` back-to-back dispatches so the
@@ -1479,10 +1737,17 @@ def main() -> None:
                 alive[0] = False
         return result
 
+    def skipped(flag: str) -> bool:
+        return os.environ.get(flag, "") in ("1", "true")
+
     # two runs, keep the better: host<->device tunnel turnaround varies
     # ~10x run-to-run (the device leg itself is stable), and the second
     # run reuses every warm jit specialization
-    first = bounded("pipeline", pipeline_leg)
+    first = (
+        None
+        if skipped("BENCH_SKIP_PIPELINE")
+        else bounded("pipeline", pipeline_leg)
+    )
     second = (
         bounded("pipeline_warm", pipeline_leg)
         if first is not None
@@ -1520,14 +1785,41 @@ def main() -> None:
         ("config2_vector_store", "BENCH_SKIP_VECTOR_STORE", vector_store_leg),
         ("config3_reranker", "BENCH_SKIP_RERANKER", reranker_leg),
     ):
-        if os.environ.get(flag, "") in ("1", "true"):
+        if skipped(flag):
             continue
         result = bounded(name, fn)
         if result is not None:
             stats[name] = result
-    dev = bounded("device_only", device_only_leg)
+    dev = (
+        None
+        if skipped("BENCH_SKIP_DEVICE_ONLY")
+        else bounded("device_only", device_only_leg)
+    )
     if dev is not None:
         stats["device_docs_per_sec"] = round(dev, 1)
+    # snapshot read plane: host-only serving leg — runs regardless of
+    # tunnel state (like the dataflow suite), so a dead device still
+    # yields the serving-latency numbers
+    if not skipped("BENCH_SKIP_SERVING"):
+        budget = _leg_budget("serving_plane", min(leg_timeout, 600.0))
+        blocked = next((t for t in stuck if t.is_alive()), None)
+        if budget < 5.0:
+            errors["serving_plane"] = (
+                "skipped: wall budget exhausted before this leg "
+                f"({budget:.0f}s remaining)"
+            )
+        elif blocked is not None:
+            errors["serving_plane"] = (
+                "skipped: an earlier timed-out leg still holds the engine"
+            )
+        else:
+            result, err, worker = _run_bounded(serving_plane_leg, budget)
+            if err is not None:
+                errors["serving_plane"] = err
+                if worker.is_alive():
+                    stuck.append(worker)
+            else:
+                stats["serving_plane"] = result
     # host dataflow workloads (wordcount/join/groupby/filter at 1M rows
     # + incremental phase) tracked in the same JSON line every round;
     # needs no device, so it runs last regardless of tunnel state (and

@@ -241,6 +241,13 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
     dev_ops_hits: dict[tuple[str, str], float] = {}
     dev_ops_ns: dict[tuple[str, str], float] = {}
     dev_ops_place: dict[tuple[str, str], float] = {}
+    # snapshot read plane: per-worker serving counters / histograms
+    srv_reqs: dict[str, float] = {}
+    srv_shed: dict[str, float] = {}
+    srv_lat: dict[str, list] = {}
+    srv_stale: dict[str, float] = {}
+    srv_seq: dict[str, float] = {}
+    srv_uptime: dict[str, float] = {}
 
     def add(worker: str, col: str, value: float) -> None:
         sums.setdefault(worker, {})[col] = (
@@ -281,6 +288,23 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
                 dev_ops_ns[key] = dev_ops_ns.get(key, 0.0) + value
             elif fam_name == "pathway_device_ops_placement":
                 dev_ops_place[(w, labels.get("op", "?"))] = value
+            elif fam_name == "pathway_serving_requests_total":
+                srv_reqs[w] = srv_reqs.get(w, 0.0) + value
+            elif fam_name == "pathway_serving_shed_total":
+                srv_shed[w] = srv_shed.get(w, 0.0) + value
+            elif (
+                fam_name == "pathway_serving_latency_seconds"
+                and name.endswith("_bucket")
+            ):
+                le = labels["le"]
+                ub = float("inf") if le in ("+Inf", "inf") else float(le)
+                srv_lat.setdefault(w, []).append((ub, value))
+            elif fam_name == "pathway_serving_snapshot_staleness_seconds":
+                srv_stale[w] = value
+            elif fam_name == "pathway_serving_snapshot_seq":
+                srv_seq[w] = value
+            elif fam_name == "pathway_serving_uptime_seconds":
+                srv_uptime[w] = value
     for w, buckets in lat.items():
         buckets.sort()
         sums.setdefault(w, {})
@@ -343,6 +367,34 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
             )
             print(
                 f"  {(w or '(local)'):<10}  op     {op:<16}  -> {where}"
+            )
+
+    # -- snapshot read plane -------------------------------------------------
+    if srv_reqs or srv_shed or srv_stale:
+        print()
+        print("serving:")
+        workers = sorted(
+            set(srv_reqs) | set(srv_shed) | set(srv_stale) | set(srv_lat),
+            key=lambda k: (k != "", k),
+        )
+        for w in workers:
+            reqs = srv_reqs.get(w, 0.0)
+            uptime = srv_uptime.get(w, 0.0)
+            qps = f"{reqs / uptime:.1f}" if uptime > 0 else "-"
+            buckets = sorted(srv_lat.get(w, []))
+            quants = []
+            for q in (0.50, 0.95, 0.99):
+                qv = _hist_quantile(buckets, q) if buckets else None
+                quants.append(f"{qv * 1000.0:.2f}" if qv is not None else "-")
+            stale = srv_stale.get(w)
+            print(
+                f"  {(w or '(local)'):<10}"
+                f"  reqs={reqs:.0f}  qps={qps}"
+                f"  p50_ms={quants[0]}  p95_ms={quants[1]}"
+                f"  p99_ms={quants[2]}"
+                f"  shed={srv_shed.get(w, 0.0):.0f}"
+                f"  snapshot_seq={srv_seq.get(w, 0.0):.0f}"
+                + (f"  staleness_s={stale:.3f}" if stale is not None else "")
             )
 
     # -- per-family totals ---------------------------------------------------
@@ -455,9 +507,16 @@ def trace(target: str, *, as_json: bool = False) -> int:
         print(json.dumps(reports, indent=1))
         return rc
     for rep in reports:
+        commits = [
+            t for t in rep["traces"] if t.get("kind", "commit") != "serving"
+        ]
+        queries = [
+            t for t in rep["traces"] if t.get("kind") == "serving"
+        ]
         print(f"{rep['file']}: {rep['events']} events, "
-              f"{len(rep['traces'])} trace(s)")
-        for t in rep["traces"]:
+              f"{len(commits)} commit trace(s), "
+              f"{len(queries)} query trace(s)")
+        for t in commits:
             cp = t.get("critical_path", {})
             chain = cp.get("chain", [])
             head = " -> ".join(s["name"] for s in chain[:6])
@@ -473,6 +532,22 @@ def trace(target: str, *, as_json: bool = False) -> int:
             )
             if head:
                 print(f"    chain: {head}")
+        if queries:
+            # per-endpoint rollup: sampled serving spans from the read
+            # plane (knn-batch / table-lookup)
+            by_name: dict[str, list[float]] = {}
+            for t in queries:
+                for span in t.get("spans", []):
+                    by_name.setdefault(span.get("name", "?"), []).append(
+                        span.get("dur", 0) / 1000.0
+                    )
+            for name in sorted(by_name):
+                ms = sorted(by_name[name])
+                print(
+                    f"  query {name:<14} n={len(ms)}  "
+                    f"mean={sum(ms) / len(ms):.2f}ms  "
+                    f"max={ms[-1]:.2f}ms"
+                )
     return rc
 
 

@@ -1780,6 +1780,14 @@ class DistributedScheduler:
             to_time=to_time,
             resumed_time=self.time,
         )
+        from pathway_tpu import serving as _serving
+
+        if _serving.enabled():
+            # Readers must never observe commits the mesh rolled back
+            # past; publish() self-heals at the next commit, but the
+            # window between rollback and re-commit would otherwise
+            # serve retracted state.
+            _serving.STORE.truncate(to_time)
 
     # -- monitoring surface parity ----------------------------------------
 

@@ -350,6 +350,36 @@ class DeviceKnnIndex:
         self.slot_to_key = {s: k for k, s in self.key_to_slot.items()}
         self._free = list(state["free"])
 
+    # -- read snapshots ------------------------------------------------------
+
+    def read_view(self) -> "DeviceKnnIndex":
+        """Immutable search-only twin at the current state, for the
+        serving plane's per-commit snapshots.
+
+        ``knn_update`` DONATES its input buffers (the scatter reuses
+        them), so the view cannot alias ``self.state`` — it takes a
+        device-side copy (HBM->HBM, no host transfer).  The slot maps
+        are host dicts and copy shallowly.  The view's ``search`` is the
+        exact production path, so snapshot reads are bit-identical to a
+        synchronous read at the same commit."""
+        import jax.numpy as jnp
+
+        view = object.__new__(type(self))
+        view.dim = self.dim
+        view.metric = self.metric
+        view.capacity = self.capacity
+        view.dtype = self.dtype
+        view.mesh = self.mesh
+        view.state = type(self.state)(
+            jnp.copy(self.state.vectors),
+            jnp.copy(self.state.valid),
+            jnp.copy(self.state.norms),
+        )
+        view.key_to_slot = dict(self.key_to_slot)
+        view.slot_to_key = dict(self.slot_to_key)
+        view._free = []
+        return view
+
     # -- search --------------------------------------------------------------
 
     def search(
@@ -459,6 +489,7 @@ class HostKnnIndex(DeviceKnnIndex):
         self.key_to_slot = {}
         self.slot_to_key = {}
         self._free = list(range(capacity - 1, -1, -1))
+        self._cow_shared = False
 
     def _grow(self) -> None:
         old = self.state
@@ -470,6 +501,7 @@ class HostKnnIndex(DeviceKnnIndex):
         valid[: self.capacity] = old.valid
         norms[: self.capacity] = old.norms
         self.state = _HostKnnState(vectors, valid, norms)
+        self._cow_shared = False  # growth allocated fresh arrays
         self._free = (
             list(range(new_capacity - 1, self.capacity - 1, -1)) + self._free
         )
@@ -488,6 +520,16 @@ class HostKnnIndex(DeviceKnnIndex):
         n = len(slots)
         if n == 0:
             return
+        if self._cow_shared:
+            # a read view shares these arrays: clone before the in-place
+            # scatter so the published snapshot stays frozen (the device
+            # twin gets this for free — knn_update is functional)
+            self.state = _HostKnnState(
+                self.state.vectors.copy(),
+                self.state.valid.copy(),
+                self.state.norms.copy(),
+            )
+            self._cow_shared = False
         vecs = np.asarray(vecs, np.float32).reshape(n, self.dim)
         idx = np.asarray(slots, np.int64)
         self.state.vectors[idx] = vecs
@@ -517,6 +559,26 @@ class HostKnnIndex(DeviceKnnIndex):
         self.key_to_slot = dict(state["key_to_slot"])
         self.slot_to_key = {s: k for k, s in self.key_to_slot.items()}
         self._free = list(state["free"])
+        self._cow_shared = False
+
+    def read_view(self) -> "HostKnnIndex":
+        """Copy-on-write read view: the view SHARES the live arrays and
+        both sides are flagged, so the next in-place scatter on either
+        clones first (``_apply``) — publishing an idle index costs two
+        dict copies, not an array copy."""
+        view = object.__new__(type(self))
+        view.dim = self.dim
+        view.metric = self.metric
+        view.capacity = self.capacity
+        view.dtype = self.dtype
+        view.mesh = self.mesh
+        view.state = self.state
+        view.key_to_slot = dict(self.key_to_slot)
+        view.slot_to_key = dict(self.slot_to_key)
+        view._free = []
+        view._cow_shared = True
+        self._cow_shared = True
+        return view
 
     def search(
         self, queries: Sequence[Any], k: int

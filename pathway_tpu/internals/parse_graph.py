@@ -150,8 +150,15 @@ def run(
                     monitor, port=monitoring_server_port
                 )
 
+    from pathway_tpu import serving as _serving
     from pathway_tpu.internals.metrics import FLIGHT
     from pathway_tpu.internals.telemetry import run_span, telemetry_enabled
+
+    query_server = None
+    if _serving.enabled() and not _analysis_runtime.enabled():
+        # the serving plane is per-process: every mesh member answers
+        # queries from its own shard's snapshots on 21000 + process_id
+        query_server = _serving.start_server()
 
     if telemetry_enabled():
         # per-operator stats feed the metrics sampler + operator spans
@@ -195,6 +202,8 @@ def run(
             monitor.stop()
         if http_server is not None and not kwargs.get("_keep_http_server"):
             http_server.stop()
+        if query_server is not None and not kwargs.get("_keep_http_server"):
+            _serving.stop_server()
         G.clear()
 
 

@@ -49,6 +49,7 @@ _CAST_NAMES = {
 
 
 from pathway_tpu.engine import device_pipeline as _device_pipeline
+from pathway_tpu import serving as _serving
 from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 from pathway_tpu.internals import metrics as _metrics
 from pathway_tpu.internals import tracing as _tracing
@@ -1026,6 +1027,9 @@ class GraphRunner:
 
         t0 = _time.monotonic()
         sched.run_static()
+        if _serving.enabled():
+            _device_pipeline.drain_until(sched.time)
+            _serving.publish_on_commit([self.scope], sched.time)
         if self.monitor is not None:
             self._sync_monitor_connectors()
             self.monitor.on_commit(0, t0)
@@ -1085,14 +1089,19 @@ class GraphRunner:
             _metrics.FLIGHT.record("commit", time=time)
             if ctx is not None:
                 _tracing.TRACER.end(time)
-            if persistent or snapshot_mgr is not None:
+            serving = _serving.enabled()
+            if persistent or snapshot_mgr is not None or serving:
                 # exactly-once seam: a checkpoint/offset for commit N may
                 # only be cut once N's staged device work has completed
+                # (read snapshots sit on the same seam: a published view
+                # must contain all of commit N, none of N+1)
                 _device_pipeline.drain_until(time)
             for driver in persistent:
                 driver.on_commit(time)
             if snapshot_mgr is not None:
                 snapshot_mgr.on_commit(self.scope, self.drivers, time)
+            if serving:
+                _serving.publish_on_commit([self.scope], time)
             if self.monitor is not None:
                 self._sync_monitor_connectors()
                 self.monitor.on_commit(time, commit_started)
@@ -1250,13 +1259,18 @@ class ShardedGraphRunner:
             _metrics.FLIGHT.record("commit", time=time)
             if ctx is not None:
                 _tracing.TRACER.end(time)
-            if persistent or snapshot_mgr is not None:
+            serving = _serving.enabled()
+            if persistent or snapshot_mgr is not None or serving:
                 # exactly-once seam: checkpoint only fully-completed commits
                 _device_pipeline.drain_until(time)
             for d in persistent:
                 d.on_commit(time)
             if snapshot_mgr is not None:
                 snapshot_mgr.on_commit(scopes, drivers, time)
+            if serving:
+                # one snapshot spanning every worker replica: reads merge
+                # the key-sharded views back into the synchronous answer
+                _serving.publish_on_commit(scopes, time)
             if self.monitor is not None:
                 w0.monitor = self.monitor
                 w0._sync_monitor_connectors()
@@ -1264,6 +1278,10 @@ class ShardedGraphRunner:
 
         _pump_drivers(w0, drivers, on_data)
         sched.finish()
+        if not drivers and _serving.enabled():
+            # static run: the single up-front commit bypassed on_data
+            _device_pipeline.drain_until(sched.time)
+            _serving.publish_on_commit(scopes, sched.time)
         _tracing.TRACER.export()
         for d in persistent:
             d.on_commit(sched.time)
@@ -1811,13 +1829,18 @@ class DistributedGraphRunner:
                 )
                 sched.trace_peer_spans.clear()
             _observe_commit_latency(stamp, started, rows_before)
-            if persistent or snapshot_mgr is not None:
+            serving = _serving.enabled()
+            if persistent or snapshot_mgr is not None or serving:
                 # exactly-once seam: checkpoint only fully-completed commits
                 _device_pipeline.drain_until(time)
             for d in persistent:
                 d.on_commit(time)
             if snapshot_mgr is not None:
                 snapshot_mgr.on_commit(sched.scopes, drivers, time)
+            if serving:
+                # leader publishes its own shard; followers publish theirs
+                # in _follow — rollback republication truncates stale views
+                _serving.publish_on_commit(sched.scopes, time)
             if fault_plan is not None:
                 fault_plan.on_commit(self.process_id, time)
             if self.monitor is not None:
@@ -1919,11 +1942,15 @@ class DistributedGraphRunner:
                         else:
                             raise
                     continue
-                if snapshot_mgr is not None:
+                serving = _serving.enabled()
+                if snapshot_mgr is not None or serving:
                     # exactly-once seam (follower): a per-worker snapshot
                     # for commit N waits for N's staged device work
                     _device_pipeline.drain_until(time)
-                    snapshot_mgr.on_commit(sched.scopes, [], time)
+                    if snapshot_mgr is not None:
+                        snapshot_mgr.on_commit(sched.scopes, [], time)
+                    if serving:
+                        _serving.publish_on_commit(sched.scopes, time)
                 if fault_plan is not None:
                     fault_plan.on_commit(self.process_id, time)
             elif cmd == "recover":

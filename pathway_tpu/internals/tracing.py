@@ -213,6 +213,7 @@ class TraceRecorder:
         self._traces: deque = deque(maxlen=max(1, maxlen))
         self._ctx: TraceContext | None = None
         self._count = 0
+        self._query_count = 0
         self._export_seq = 0
         self._overhead_ema: float | None = None
         self.epoch = 0
@@ -421,6 +422,67 @@ class TraceRecorder:
             self.interval = max(self.base_interval, self.interval // 2)
             self._overhead_ema *= 2.0
 
+    # -- serving-plane query traces ------------------------------------------
+
+    def record_query(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        commit_time: int = 0,
+        **args: Any,
+    ) -> dict | None:
+        """Record one served query (or query micro-batch) as a standalone
+        ``kind="serving"`` trace in the same ring.
+
+        Queries run on serving threads CONCURRENTLY with commits, so
+        they never touch the single-slot commit context (``_ctx``) —
+        each call assembles its own one-span trace.  Sampling uses its
+        own counter at the same interval, so query volume cannot starve
+        commit traces (and vice versa).  ``commit_time`` is the served
+        snapshot's commit time: ``cli trace`` correlates query spans
+        with the commit that published their view."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._query_count += 1
+            if (self._query_count - 1) % self.interval:
+                return None
+        origin_wall = perf_to_wall(t0)
+        end_wall = perf_to_wall(t1)
+        span: dict = {
+            "name": name,
+            "cat": "serving",
+            "ts": _us(origin_wall),
+            "dur": max(0, int((t1 - t0) * 1e6)),
+            "pid": self.worker_id,
+        }
+        if args:
+            span["args"] = dict(args)
+        trace: dict = {
+            "kind": "serving",
+            "trace_id": (
+                f"q{self.worker_id:02d}-{os.getpid():x}"
+                f"-{self._query_count:06x}"
+            ),
+            "commit_time": int(commit_time),
+            "epoch": self.epoch,
+            "worker": self.worker_id,
+            "origin_wall": origin_wall,
+            "begin_wall": origin_wall,
+            "end_wall": end_wall,
+            "spans": [span],
+            "workers": {},
+            "sink_rows": 0,
+            "dropped_spans": 0,
+            "device_kernel_ns": {},
+            "device_s": 0.0,
+        }
+        trace["critical_path"] = critical_path(trace)
+        with self._lock:
+            self._traces.append(trace)
+        return trace
+
     # -- read side -----------------------------------------------------------
 
     def traces(self) -> list[dict]:
@@ -434,10 +496,31 @@ class TraceRecorder:
     def summary(self) -> dict:
         """Structured roll-up for bench JSON: trace count, span volume,
         the mean critical-path buckets, and the last commit's full
-        breakdown."""
-        traces = self.traces()
+        breakdown.  Serving-plane query traces are rolled up separately
+        (``query_traces`` / ``query_ms_mean``) so query latency cannot
+        skew the commit critical-path means."""
+        all_traces = self.traces()
+        queries = [t for t in all_traces if t.get("kind") == "serving"]
+        traces = [t for t in all_traces if t.get("kind") != "serving"]
+        query_summary: dict = {}
+        if queries:
+            query_summary = {
+                "query_traces": len(queries),
+                "query_ms_mean": round(
+                    sum(
+                        (t["end_wall"] - t["origin_wall"]) for t in queries
+                    )
+                    / len(queries)
+                    * 1000.0,
+                    3,
+                ),
+            }
         if not traces:
-            return {"traces": 0, "sample_interval": self.interval}
+            return {
+                "traces": 0,
+                "sample_interval": self.interval,
+                **query_summary,
+            }
         n = len(traces)
         keys = (
             "wall_s",
@@ -470,6 +553,7 @@ class TraceRecorder:
             "sample_interval": self.interval,
             "critical_path_mean": mean,
             "last": traces[-1]["critical_path"],
+            **query_summary,
         }
 
     def export(self, directory: str | None = None) -> str | None:
@@ -501,10 +585,16 @@ class TraceRecorder:
                 "traces": [
                     {
                         "trace_id": t["trace_id"],
+                        "kind": t.get("kind", "commit"),
                         "commit_time": t["commit_time"],
                         "epoch": t["epoch"],
                         "sink_rows": t["sink_rows"],
                         "critical_path": t["critical_path"],
+                        **(
+                            {"spans": t["spans"]}
+                            if t.get("kind") == "serving"
+                            else {}
+                        ),
                     }
                     for t in traces
                 ],
@@ -629,7 +719,11 @@ def chrome_trace(traces: list[dict]) -> dict:
                     root_args["device_kernel_ns"] = trace["device_kernel_ns"]
             events.append(
                 {
-                    "name": f"commit {trace['commit_time']}",
+                    "name": (
+                        f"query @{trace['commit_time']}"
+                        if trace.get("kind") == "serving"
+                        else f"commit {trace['commit_time']}"
+                    ),
                     "cat": "commit",
                     "ph": "X",
                     "ts": start,

@@ -1,0 +1,92 @@
+"""Snapshot read plane: serve queries off-dataflow from per-commit views.
+
+Opt-in via ``PATHWAY_TPU_SERVING=1``: every runner (single-worker,
+sharded, TCP mesh leader AND followers) then publishes an immutable
+:class:`~pathway_tpu.serving.snapshot.ReadSnapshot` of groupby/join/KNN
+operator state into the process-wide :data:`STORE` at each commit
+boundary — after ``DevicePipeline.drain_until``, so views sit exactly on
+the exactly-once seam — and ``pw.run`` starts a
+:class:`~pathway_tpu.serving.server.QueryServer` on
+``21000 + PATHWAY_PROCESS_ID``.
+
+Env knobs:
+
+- ``PATHWAY_TPU_SERVING`` — enable the plane (default off)
+- ``PATHWAY_TPU_SNAPSHOT_DEPTH`` — retained snapshots (default 3)
+- ``PATHWAY_TPU_SERVING_PORT_BASE`` — port base (default 21000)
+- ``PATHWAY_TPU_SERVING_QUEUE`` — admission queue bound (default 256)
+- ``PATHWAY_TPU_SERVING_THREADS`` — worker pool size (default 8)
+- ``PATHWAY_TPU_SERVING_BATCH_WINDOW_MS`` — KNN micro-batch packing
+  window (default 2 ms)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+from pathway_tpu.serving.snapshot import STORE, ReadSnapshot, SnapshotStore
+
+__all__ = [
+    "STORE",
+    "ReadSnapshot",
+    "SnapshotStore",
+    "enabled",
+    "publish_on_commit",
+    "start_server",
+    "stop_server",
+    "query_server",
+]
+
+_lock = threading.Lock()
+_server: Any = None
+
+
+def enabled() -> bool:
+    return os.environ.get("PATHWAY_TPU_SERVING", "").lower() in (
+        "1",
+        "true",
+        "yes",
+    )
+
+
+def publish_on_commit(scopes: list, time: int) -> None:
+    """Runner-side publication hook (call only when :func:`enabled`,
+    after the device pipeline drained through ``time``)."""
+    STORE.publish(scopes, time)
+
+
+def start_server() -> Any:
+    """Start (or return) this process's query server.  A bind failure is
+    recorded and swallowed: serving is an accessory plane and must never
+    take the dataflow down."""
+    global _server
+    with _lock:
+        if _server is not None:
+            return _server
+        try:
+            from pathway_tpu.serving.server import QueryServer
+
+            _server = QueryServer().start()
+        except OSError as exc:
+            from pathway_tpu.internals.metrics import FLIGHT
+
+            FLIGHT.record("serving_bind_failed", error=repr(exc))
+            _server = None
+        return _server
+
+
+def stop_server() -> None:
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()
+
+
+def query_server() -> Any:
+    """The live :class:`QueryServer` or None.  (Named to avoid the
+    package attribute ``serving.server`` — the submodule — which Python
+    binds on first import.)"""
+    return _server

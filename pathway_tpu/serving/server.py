@@ -1,0 +1,535 @@
+"""Concurrent HTTP query front over the snapshot store.
+
+The read-plane counterpart of the monitoring endpoint and the same port
+scheme one block up: each process serves its own shard's snapshots on
+``21000 + PATHWAY_PROCESS_ID`` (``PATHWAY_TPU_SERVING_PORT_BASE``
+overrides the base), loopback only.  Three mechanisms keep thousands of
+concurrent queries off the dataflow's back:
+
+- **Admission control**: accepted connections enter a bounded queue
+  drained by a fixed thread pool; when the queue is full the connection
+  is shed immediately with ``503`` + ``Retry-After`` (never queued
+  behind work that cannot be served in time), and once a request is
+  admitted it is always answered — possibly from a stale snapshot,
+  never with a 5xx.
+- **Micro-batching**: concurrently-arriving KNN queries are packed into
+  one snapshot ``search`` call, sized by the PR-9
+  ``AdaptiveBatchController`` (the same controller that sizes device
+  update batches, so serving batches track device backpressure) within
+  a short packing window (``PATHWAY_TPU_SERVING_BATCH_WINDOW_MS``).
+- **Snapshot reads**: every answer comes from a refcounted immutable
+  :class:`~pathway_tpu.serving.snapshot.ReadSnapshot` — queries touch
+  no operator state and hold no scheduler lock.
+
+Endpoints (all JSON):
+
+- ``GET  /serving/health``  — liveness + snapshot seq/commit/staleness
+- ``GET  /serving/stats``   — request/shed counters, latency quantiles
+- ``POST /serving/query``   — ``{"vector": [...] | "vectors": [[...]],
+  "k": 10}`` -> KNN hits from the newest snapshot
+- ``POST /serving/lookup``  — ``{"keys": [...]}`` -> operator rows by
+  repr-stringified key (point reads on groupby/join state)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time as _time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing as _tracing
+from pathway_tpu.serving import snapshot as _snapshot
+
+__all__ = ["QueryServer", "BASE_PORT", "serving_port"]
+
+BASE_PORT = 21000
+
+_LAT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+_REQS = {
+    ep: _metrics.REGISTRY.counter(
+        "pathway_serving_requests_total",
+        "admitted serving requests by endpoint",
+        endpoint=ep,
+    )
+    for ep in ("query", "lookup", "health", "stats", "other")
+}
+_SHED = _metrics.REGISTRY.counter(
+    "pathway_serving_shed_total",
+    "connections shed at admission (503 + Retry-After)",
+)
+_LATENCY = _metrics.REGISTRY.histogram(
+    "pathway_serving_latency_seconds",
+    "per-request serving latency (admission to response flush)",
+    buckets=_LAT_BUCKETS,
+)
+_BATCHED = _metrics.REGISTRY.histogram(
+    "pathway_serving_batch_queries",
+    "KNN queries packed per snapshot search dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+_EMPTY = _metrics.REGISTRY.counter(
+    "pathway_serving_no_snapshot_total",
+    "admitted queries answered 200-with-empty because no snapshot exists yet",
+)
+
+_started_wall: list[float] = []  # first QueryServer.start() in this process
+
+
+def _collect_uptime():
+    if _started_wall:
+        yield (
+            "pathway_serving_uptime_seconds",
+            "gauge",
+            "seconds since this process's query server started",
+            {},
+            _time.time() - _started_wall[0],
+        )
+
+
+_metrics.REGISTRY.register_collector(_collect_uptime)
+
+
+def serving_port(process_id: int | None = None) -> int:
+    base = int(os.environ.get("PATHWAY_TPU_SERVING_PORT_BASE", BASE_PORT))
+    if process_id is None:
+        process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    return base + process_id
+
+
+def _suggested_batch() -> int:
+    """Micro-batch capacity from the device pipeline's adaptive
+    controller — when the device side is backpressured the controller
+    grows its batches, and serving follows so queries amortize into
+    fewer top_k dispatches."""
+    try:
+        from pathway_tpu.engine import device_pipeline as _dp
+
+        return max(1, int(_dp.PIPELINE.controller.batch_size))
+    except Exception:
+        return 1024
+
+
+class _MicroBatcher:
+    """Packs concurrently-arriving KNN queries into one snapshot search."""
+
+    def __init__(self, store: "_snapshot.SnapshotStore", window_s: float):
+        self.store = store
+        self.window_s = max(0.0, window_s)
+        self._cv = threading.Condition()
+        self._pending: list[dict] = []
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self.dispatches = 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="pw-serving-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def submit(self, vectors: np.ndarray, k: int, timeout: float = 30.0):
+        """Enqueue ``vectors`` ([n, dim]) and block until the batcher
+        answers.  Returns ``(hits, snapshot_meta)``; hits is None only
+        when no snapshot has ever been published."""
+        item = {
+            "vecs": vectors,
+            "k": int(k),
+            "event": threading.Event(),
+            "hits": None,
+            "meta": None,
+            "error": None,
+        }
+        with self._cv:
+            self._pending.append(item)
+            self._cv.notify_all()
+        if not item["event"].wait(timeout):
+            raise TimeoutError("serving batcher did not answer in time")
+        if item["error"] is not None:
+            raise item["error"]
+        return item["hits"], item["meta"]
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait(0.25)
+                if self._stop:
+                    pending, self._pending = self._pending, []
+                else:
+                    # packing window: wait briefly for more arrivals, up
+                    # to the controller-suggested batch capacity
+                    cap = _suggested_batch()
+                    deadline = _time.perf_counter() + self.window_s
+                    while (
+                        sum(len(i["vecs"]) for i in self._pending) < cap
+                        and not self._stop
+                    ):
+                        left = deadline - _time.perf_counter()
+                        if left <= 0:
+                            break
+                        self._cv.wait(left)
+                    pending, self._pending = self._pending, []
+            if not pending:
+                if self._stop:
+                    return
+                continue
+            self._dispatch(pending)
+            if self._stop:
+                with self._cv:
+                    leftover, self._pending = self._pending, []
+                if leftover:
+                    self._dispatch(leftover)
+                return
+
+    def _dispatch(self, pending: list[dict]) -> None:
+        t0 = _time.perf_counter()
+        snap = self.store.acquire_latest()
+        try:
+            n = sum(len(i["vecs"]) for i in pending)
+            if snap is None:
+                for item in pending:
+                    item["hits"] = None
+                    item["meta"] = None
+                return
+            max_k = max(i["k"] for i in pending)
+            flat = [vec for item in pending for vec in item["vecs"]]
+            try:
+                results = snap.search(flat, max_k)
+            except LookupError as exc:
+                for item in pending:
+                    item["error"] = exc
+                return
+            meta = {
+                "seq": snap.seq,
+                "commit_time": snap.commit_time,
+                "staleness_s": round(snap.staleness_s(), 6),
+            }
+            self.dispatches += 1
+            _BATCHED.observe_n(float(n), 1)
+            pos = 0
+            for item in pending:
+                rows = results[pos : pos + len(item["vecs"])]
+                item["hits"] = [r[: item["k"]] for r in rows]
+                item["meta"] = meta
+                pos += len(item["vecs"])
+            _tracing.TRACER.record_query(
+                "knn-batch",
+                t0,
+                _time.perf_counter(),
+                commit_time=snap.commit_time,
+                queries=n,
+                requests=len(pending),
+                k=max_k,
+            )
+        except Exception as exc:  # noqa: BLE001 — fail the waiters, not the loop
+            for item in pending:
+                if item["error"] is None and item["hits"] is None:
+                    item["error"] = exc
+        finally:
+            if snap is not None:
+                snap.release()
+            for item in pending:
+                item["event"].set()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # default HTTP/1.0 + Connection: close — one bounded-pool turn per
+    # connection, so admission control maps 1:1 to requests
+    server_version = "PathwayServing/1.0"
+
+    def log_message(self, fmt: str, *args) -> None:  # noqa: A003
+        pass  # the metrics registry is the access log
+
+    # -- helpers -------------------------------------------------------------
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    # -- endpoints -----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        t0 = _time.perf_counter()
+        try:
+            if self.path.startswith("/serving/health"):
+                _REQS["health"].inc()
+                self._json(200, dict(self.server.store.stats(), ok=True))
+            elif self.path.startswith("/serving/stats"):
+                _REQS["stats"].inc()
+                self._json(200, self.server.serving_stats())
+            else:
+                _REQS["other"].inc()
+                self._json(404, {"error": f"unknown path {self.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            _LATENCY.observe(_time.perf_counter() - t0)
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server contract
+        t0 = _time.perf_counter()
+        try:
+            if self.path.startswith("/serving/query"):
+                _REQS["query"].inc()
+                self._query(t0)
+            elif self.path.startswith("/serving/lookup"):
+                _REQS["lookup"].inc()
+                self._lookup()
+            else:
+                _REQS["other"].inc()
+                self._json(404, {"error": f"unknown path {self.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except (ValueError, KeyError, TypeError) as exc:
+            # malformed request — a client error, not a serving failure
+            try:
+                self._json(400, {"error": repr(exc)})
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        finally:
+            _LATENCY.observe(_time.perf_counter() - t0)
+
+    def _query(self, t0: float) -> None:
+        req = self._body()
+        if "vectors" in req:
+            vecs = np.asarray(req["vectors"], np.float32)
+        else:
+            vecs = np.asarray([req["vector"]], np.float32)
+        if vecs.ndim != 2:
+            raise ValueError("vector(s) must be rank-1 / rank-2")
+        k = int(req.get("k", 10))
+        hits, meta = self.server.batcher.submit(vecs, k)
+        if hits is None:
+            # admitted before the first commit: answer empty-but-valid
+            # (stale by definition), never a 5xx
+            _EMPTY.inc()
+            self._json(
+                200,
+                {"hits": [[] for _ in range(len(vecs))], "snapshot": None},
+            )
+            return
+        self._json(
+            200,
+            {
+                "hits": [
+                    [[repr(key), score] for key, score in row]
+                    for row in hits
+                ],
+                "snapshot": meta,
+            },
+        )
+
+    def _lookup(self) -> None:
+        req = self._body()
+        keys = [str(key) for key in req.get("keys", [])]
+        node = req.get("node")
+        snap = self.server.store.acquire_latest()
+        if snap is None:
+            _EMPTY.inc()
+            self._json(200, {"rows": {}, "snapshot": None})
+            return
+        try:
+            t0 = _time.perf_counter()
+            table = {repr(key): row for key, row in snap.table(node).items()}
+            rows = (
+                {key: table.get(key) for key in keys} if keys else table
+            )
+            meta = {
+                "seq": snap.seq,
+                "commit_time": snap.commit_time,
+                "staleness_s": round(snap.staleness_s(), 6),
+            }
+            _tracing.TRACER.record_query(
+                "table-lookup",
+                t0,
+                _time.perf_counter(),
+                commit_time=snap.commit_time,
+                keys=len(keys),
+            )
+        finally:
+            snap.release()
+        self._json(200, {"rows": rows, "snapshot": meta})
+
+
+class _BoundedHTTPServer(HTTPServer):
+    """HTTP server with bounded-queue admission and a fixed worker pool.
+
+    ``process_request`` (the accept-loop side) either enqueues the
+    connection or sheds it with a raw 503 — it never blocks and never
+    spawns a thread per connection, so a query flood degrades into fast
+    503s instead of an unbounded thread pile-up."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+    # shedding is OUR bounded queue's job: a deep listen backlog keeps
+    # the kernel from dropping SYNs under bursts (a dropped SYN costs
+    # the client a ~1s retransmit, which would read as serving latency)
+    request_queue_size = 512
+
+    def __init__(
+        self, addr, handler, store, batcher, queue_size: int, threads: int
+    ) -> None:
+        super().__init__(addr, handler)
+        self.store = store
+        self.batcher = batcher
+        self.started_wall = _time.time()
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, queue_size))
+        self._pool = [
+            threading.Thread(
+                target=self._worker, name=f"pw-serving-{i}", daemon=True
+            )
+            for i in range(max(1, threads))
+        ]
+        for t in self._pool:
+            t.start()
+
+    def process_request(self, request, client_address) -> None:
+        try:
+            self._queue.put_nowait((request, client_address))
+        except queue.Full:
+            _SHED.inc()
+            try:
+                request.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Retry-After: 1\r\n"
+                    b"Content-Length: 0\r\n"
+                    b"Connection: close\r\n\r\n"
+                )
+            except OSError:
+                pass
+            self.shutdown_request(request)
+
+    def _worker(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            request, client_address = item
+            try:
+                self.finish_request(request, client_address)
+            except Exception:  # noqa: BLE001 — one bad socket, not the pool
+                pass
+            finally:
+                self.shutdown_request(request)
+
+    def stop_pool(self) -> None:
+        for _ in self._pool:
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                break
+        for t in self._pool:
+            t.join(timeout=2.0)
+
+    def serving_stats(self) -> dict:
+        uptime = max(1e-9, _time.time() - self.started_wall)
+        requests = sum(c.value for c in _REQS.values())
+        return {
+            "uptime_s": round(uptime, 3),
+            "requests": requests,
+            "qps": round(requests / uptime, 2),
+            "shed": _SHED.value,
+            "no_snapshot": _EMPTY.value,
+            "latency_ms": {
+                "p50": round(_LATENCY.quantile(0.50) * 1000.0, 3),
+                "p95": round(_LATENCY.quantile(0.95) * 1000.0, 3),
+                "p99": round(_LATENCY.quantile(0.99) * 1000.0, 3),
+                "count": _LATENCY.count,
+            },
+            "batch": {
+                "dispatches": self.batcher.dispatches,
+                "queries": _BATCHED.sum,
+            },
+            "snapshot": self.store.stats(),
+        }
+
+
+class QueryServer:
+    """Lifecycle wrapper: bind, pump, stop.  One per process, started by
+    ``pw.run`` when ``PATHWAY_TPU_SERVING=1`` (mirrors
+    ``MonitoringHttpServer``)."""
+
+    def __init__(
+        self,
+        store: "_snapshot.SnapshotStore" | None = None,
+        port: int | None = None,
+        queue_size: int | None = None,
+        threads: int | None = None,
+        batch_window_ms: float | None = None,
+    ) -> None:
+        self.store = store if store is not None else _snapshot.STORE
+        self.port = port if port is not None else serving_port()
+        if queue_size is None:
+            queue_size = int(
+                os.environ.get("PATHWAY_TPU_SERVING_QUEUE", "256")
+            )
+        if threads is None:
+            threads = int(os.environ.get("PATHWAY_TPU_SERVING_THREADS", "8"))
+        if batch_window_ms is None:
+            batch_window_ms = float(
+                os.environ.get("PATHWAY_TPU_SERVING_BATCH_WINDOW_MS", "2")
+            )
+        self.batcher = _MicroBatcher(self.store, batch_window_ms / 1000.0)
+        self.httpd = _BoundedHTTPServer(
+            ("127.0.0.1", self.port),
+            _Handler,
+            self.store,
+            self.batcher,
+            queue_size,
+            threads,
+        )
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "QueryServer":
+        if not _started_wall:
+            _started_wall.append(self.httpd.started_wall)
+        self.batcher.start()
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="pw-serving-http",
+            daemon=True,
+        )
+        self._thread.start()
+        _metrics.FLIGHT.record("serving_start", port=self.port)
+        return self
+
+    def stop(self) -> None:
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            self.httpd.stop_pool()
+        finally:
+            self.batcher.stop()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+        _metrics.FLIGHT.record("serving_stop", port=self.port)

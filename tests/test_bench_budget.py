@@ -88,3 +88,49 @@ def test_outage_json_lands_within_wall_budget():
     assert outage.get("value") is None
     err = outage.get("error") or ""
     assert "accelerator" in err or "wall budget" in err, outage
+
+
+def test_slow_serving_leg_is_marked_not_killed():
+    """A serving leg that cannot finish inside its per-leg budget must be
+    abandoned and MARKED in ``leg_errors`` — the run still exits 0 with a
+    parseable JSON verdict, never an rc=124 harness kill."""
+    env = dict(os.environ)
+    env.pop("BENCH_WALL_BUDGET_S", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        # every other leg off: this test times ONLY the serving leg path
+        BENCH_SKIP_PIPELINE="1",
+        BENCH_SKIP_QUERY_LOAD="1",
+        BENCH_SKIP_FLASH_PARITY="1",
+        BENCH_SKIP_DECODE="1",
+        BENCH_SKIP_MULTIMODAL="1",
+        BENCH_SKIP_VECTOR_STORE="1",
+        BENCH_SKIP_RERANKER="1",
+        BENCH_SKIP_DEVICE_ONLY="1",
+        BENCH_SKIP_DATAFLOW="1",
+        BENCH_SKIP_HOST_FALLBACK="1",
+        # a deliberately unfinishable leg: far more paced-ingest work
+        # than the leg budget allows
+        BENCH_SERVING_DOCS="2000000",
+        BENCH_SERVING_INGEST_RATE="500",
+        BENCH_LEG_TIMEOUT_SERVING_PLANE_S="10",
+        PYTHONPATH=str(REPO),
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env=env,
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=240,  # outer net only — the leg budget must do the work
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    verdicts = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{") and "leg_errors" in line
+    ]
+    assert verdicts, proc.stdout
+    leg_errors = verdicts[-1]["extra"]["leg_errors"]
+    assert "serving_plane" in leg_errors, leg_errors
+    assert "did not complete" in leg_errors["serving_plane"], leg_errors

@@ -34,10 +34,18 @@ problem):
    env check per commit) with no device present, stubbed vs live; FAILs
    when the machinery costs more than 5% (one retry absorbs timer
    noise — the hook cost is nanoseconds against millisecond commits);
-10. trace export — a small traced program runs end-to-end and the
+10. serving parity — the snapshot read plane's invariant corpus: a
+   published view must equal a synchronous read at the same commit
+   (single-worker, sharded, live KNN dataflow), COW views freeze,
+   refcounts never free mid-query, restore refuses format/fingerprint
+   mismatches;
+11. serving ingest overhead — bench.serving_plane_leg with paced HTTP
+   query load vs no serving; FAILs when serving costs ingest more than
+   5% or the client latency histogram is degenerate;
+12. trace export — a small traced program runs end-to-end and the
    exported file must satisfy the Chrome trace-event schema invariants
    (complete X / matched B-E events, monotonic timestamps per track);
-11. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
+13. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
    mesh with operator persistence: a follower SIGKILL (supervised
    restart + rollback), a LEADER SIGKILL (epoch-fenced election
    failover), and a SIGKILL injected while a live N→M rescale is
@@ -591,6 +599,123 @@ def step_device_ops_overhead() -> str:
     return status
 
 
+#: serving-parity gate: the snapshot read plane's invariant corpus —
+#: COW view freezing, refcounted reclamation, restore refusals, and the
+#: published-view == synchronous-read parity runs (single-worker,
+#: sharded, live KNN dataflow)
+SERVING_PARITY_NODES = [
+    "tests/test_serving.py::TestKnnReadViews",
+    "tests/test_serving.py::TestSnapshotStore",
+    "tests/test_serving.py::test_single_worker_snapshot_bit_identical_to_sync_read",
+    "tests/test_serving.py::test_sharded_snapshot_merges_to_sync_read",
+    "tests/test_serving.py::test_knn_snapshot_search_matches_dataflow_answer",
+]
+
+
+def step_serving_parity() -> str:
+    """Snapshot read-plane parity: a published view must be bit-identical
+    to a synchronous read of the same operators at the same commit, COW
+    views must freeze, refcounts must never free mid-query, and
+    format/fingerprint mismatches must be refused on restore."""
+    name = "serving parity (snapshot view == sync read, COW, refcounts)"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            *SERVING_PARITY_NODES,
+            "-q",
+            "-p",
+            "no:cacheprovider",
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=600,
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        name,
+        status,
+        f"pytest exit {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+def _serving_overhead_once() -> tuple[float | None, str]:
+    """One small serving_plane_leg run: (ingest_overhead_pct, detail)."""
+    import json
+
+    code = (
+        "import json, bench;"
+        "print('SERVING_OVERHEAD_JSON ' + json.dumps("
+        "bench.serving_plane_leg()))"
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # a small-but-real pass: paced ingest + paced open-loop queries,
+        # big enough that the serving window spans many commits
+        "BENCH_SERVING_DOCS": "4000",
+        "BENCH_SERVING_INGEST_RATE": "2000",
+        "BENCH_SERVING_QUERIES": "200",
+        "BENCH_SERVING_QPS": "100",
+        "BENCH_SERVING_CLIENTS": "16",
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+    except subprocess.SubprocessError as e:
+        return None, f"bench leg did not finish: {e}"
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("SERVING_OVERHEAD_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        return None, f"bench leg exit {proc.returncode}"
+    p99 = payload.get("query_p99_ms")
+    if not isinstance(p99, (int, float)) or not 0.0 < p99 < 500.0:
+        return None, f"latency smoke failed: query_p99_ms={p99!r}"
+    if payload.get("bad_status"):
+        return None, f"non-200/503 answers: {payload['bad_status']}"
+    overhead = payload["ingest_overhead_pct"]
+    if overhead is None:
+        return None, "no baseline ingest rate"
+    detail = (
+        f"{overhead:+.2f}% ingest overhead "
+        f"(baseline {payload['baseline_docs_per_sec']} -> serving "
+        f"{payload['serving_docs_per_sec']} docs/s), "
+        f"query p99 {p99}ms, shed {payload.get('shed_503', 0)}"
+    )
+    return overhead, detail
+
+
+def step_serving_overhead() -> str:
+    """Gate the read plane's ingest tax: bench.serving_plane_leg runs the
+    paced-ingest pipeline with serving off vs serving on under paced
+    HTTP query load; >5% ingest slowdown is a FAIL, as is a degenerate
+    latency histogram (no p99, or p99 outside the smoke bound).  One
+    retry absorbs scheduler noise — two consecutive failures are
+    signal."""
+    name = "serving ingest overhead (paced query load vs no serving)"
+    overhead, detail = _serving_overhead_once()
+    if overhead is not None and overhead > 5.0:
+        overhead, detail = _serving_overhead_once()
+        detail += " [retried]"
+    if overhead is None:
+        _report(name, FAIL, detail)
+        return FAIL
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
+    return status
+
+
 #: the chaos gate's three fixed-seed legs — one follower kill (seed 7),
 #: one LEADER kill exercising election + epoch fencing (seed 13), and one
 #: kill racing a live rescale's quiesce (seed 26).  All three share one
@@ -663,6 +788,8 @@ def main(argv=None) -> int:
         step_async_overhead(),
         step_device_ops_parity(),
         step_device_ops_overhead(),
+        step_serving_parity(),
+        step_serving_overhead(),
         step_trace_export(),
         step_chaos_gate(),
     ]
