@@ -7,7 +7,13 @@ executed by a host-side commit scheduler with the compute path (embedders,
 rerankers, vector search, decode) on TPU via JAX/XLA/Pallas.
 """
 
-from pathway_tpu.engine.value import (
+from pathway_tpu.internals import lockwatch as _lockwatch
+
+# PATHWAY_TPU_LOCKWATCH=1: wrap Lock/RLock creation BEFORE the runtime
+# modules below instantiate theirs, so the order recorder sees them all
+_lockwatch.maybe_install()
+
+from pathway_tpu.engine.value import (  # noqa: E402
     ERROR,
     DateTimeNaive,
     DateTimeUtc,

@@ -1,0 +1,602 @@
+"""Lock-discipline lint over the runtime source tree (``PWC4xx``).
+
+The threaded runtime (metrics samplers, heartbeat/election threads, the
+device-pipeline completion worker, the serving pool) shares state under
+a small set of locks.  The discipline is declared in the source with
+``# guarded-by: <lock>`` comments on the attribute assignments in
+``__init__``::
+
+    self._staged = deque()  # guarded-by: self._cv
+
+and this pass enforces it syntactically:
+
+- ``PWC401`` — a guarded attribute is written (assigned, subscripted,
+  deleted, or mutated through ``append``/``pop``/``update``/…) outside a
+  ``with <lock>:`` block.  ``__init__`` is exempt (construction is
+  single-threaded), and so are methods whose name ends in ``_locked``
+  (the caller-holds-the-lock convention, e.g. ``_truncate_locked``).
+- ``PWC402`` — two locks are acquired in inconsistent orders somewhere
+  in the analyzed file set (a potential deadlock cycle).  Nesting is
+  tracked through ``with`` blocks and one level of intra-module calls.
+- ``PWC403`` — a blocking call (socket I/O, ``queue.get()`` with no
+  timeout, ``time.sleep``, device sync, subprocess) runs while a lock is
+  held.  ``cv.wait()`` on the *held* condition is exempt — it releases.
+- ``PWC404`` — a thread-target function loops on an unbounded
+  ``.get()`` / ``.wait()``: shutdown can hang the daemon forever.
+- ``PWC405`` — a ``guarded-by`` comment names a lock that never appears
+  in the class (annotation typo).
+
+A ``# pwc-ok: PWC403 <reason>`` trailing comment waives one code on
+that line (see ``analysis.source``).
+
+Condition variables are unified with the lock they wrap: the lint
+resolves ``self._cv = threading.Condition(self._lock)`` so holding
+either name satisfies a guard on the other.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from pathway_tpu.analysis.findings import Report
+from pathway_tpu.analysis.source import SourceModule, emit
+
+#: receivers that look like locks when used as a ``with`` context
+_LOCKISH = re.compile(r"(lock|mutex|_cv\b|cond)", re.IGNORECASE)
+
+#: method calls that mutate their receiver in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "rotate",
+}
+
+#: calls that block unconditionally
+_BLOCKING_ALWAYS = {
+    "sleep", "accept", "connect", "sendall", "recv", "recv_into",
+    "urlopen", "block_until_ready", "check_output", "check_call",
+    "getaddrinfo",
+}
+_BLOCKING_DOTTED = {"subprocess.run", "subprocess.Popen"}
+
+#: calls that block unless bounded by a ``timeout=`` argument
+_BLOCKING_NO_TIMEOUT = {"wait", "wait_for", "result"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _has_timeout(call: ast.Call, attr: str | None = None) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            )
+    # positional timeouts: wait(t), result(t), wait_for(pred, t)
+    if attr in ("wait", "result") and call.args:
+        a = call.args[0]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    if attr == "wait_for" and len(call.args) >= 2:
+        a = call.args[1]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    return False
+
+
+def _is_queue_get(call: ast.Call) -> bool:
+    """``q.get()`` with zero positional args and no bound — ``dict.get``
+    always passes the key positionally, so this shape is queue-like."""
+    if not isinstance(call.func, ast.Attribute) or call.func.attr != "get":
+        return False
+    if call.args:
+        return False
+    if _has_timeout(call):
+        return False
+    for kw in call.keywords:
+        if kw.arg == "block":
+            return False
+    return True
+
+
+def _expr_nodes(node: ast.AST):
+    """Walk an expression/statement without descending into nested
+    function/class scopes (they are analyzed as their own scopes)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ) and n is not node:
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@dataclass
+class _FuncInfo:
+    qualname: str
+    node: ast.AST
+    cls: str | None
+    mod: SourceModule
+    #: lock ids acquired anywhere in the body (for one-level call edges)
+    acquires: set[str] = field(default_factory=set)
+    #: (qualname-candidates, held-at-callsite, line)
+    calls: list[tuple[list[str], tuple[str, ...], int]] = field(
+        default_factory=list
+    )
+
+
+class _ModuleLint:
+    def __init__(self, mod: SourceModule, report: Report) -> None:
+        self.mod = mod
+        self.report = report
+        #: class -> attr -> lock text as annotated (e.g. "self._lock")
+        self.guards: dict[str, dict[str, str]] = {}
+        #: class -> alias groups of lock names (cv <-> wrapped lock)
+        self.aliases: dict[str, list[set[str]]] = {}
+        #: class -> every lock-ish name seen in a with/acquire/__init__
+        self.seen_locks: dict[str, set[str]] = {}
+        #: guard annotations at module scope: global var -> lock text
+        self.module_guards: dict[str, str] = {}
+        self.thread_targets: set[str] = set()
+        self.funcs: list[_FuncInfo] = []
+
+    # -- discovery --------------------------------------------------------
+
+    def discover(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    t = _dotted(kw.value)
+                    if t:
+                        self.thread_targets.add(t.split(".")[-1])
+        for stmt in self.mod.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                lock = self.mod.guard_comments.get(stmt.lineno)
+                if lock:
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.module_guards[t.id] = lock
+        for stmt in self.mod.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._discover_class(stmt)
+
+    def _discover_class(self, cls: ast.ClassDef) -> None:
+        guards: dict[str, str] = {}
+        aliases: list[set[str]] = []
+        seen: set[str] = set()
+        for fn in ast.walk(cls):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    lock = self.mod.guard_comments.get(node.lineno)
+                    for t in targets:
+                        td = _dotted(t)
+                        if not td or not td.startswith("self."):
+                            continue
+                        if lock:
+                            guards[td[len("self."):]] = lock
+                        if fn.name == "__init__":
+                            if _LOCKISH.search(td):
+                                seen.add(td)
+                            # unify Condition(lock) with its inner lock
+                            v = node.value if isinstance(node, ast.Assign) \
+                                else node.value
+                            if isinstance(v, ast.Call):
+                                vf = _dotted(v.func) or ""
+                                if vf.split(".")[-1] == "Condition" and v.args:
+                                    inner = _dotted(v.args[0])
+                                    if inner:
+                                        aliases.append({td, inner})
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        t = _dotted(item.context_expr)
+                        if t and _LOCKISH.search(t):
+                            seen.add(t)
+                elif isinstance(node, ast.Call):
+                    f = _dotted(node.func)
+                    if f and f.endswith(".acquire"):
+                        seen.add(f[: -len(".acquire")])
+        self.guards[cls.name] = guards
+        self.aliases[cls.name] = aliases
+        self.seen_locks[cls.name] = seen
+        # PWC405: annotation names a lock the class never touches
+        for attr, lock in guards.items():
+            if lock in self.module_guards.values():
+                continue
+            known = seen | {
+                a for group in aliases for a in group
+            }
+            if lock not in known and f"self.{lock}" not in known:
+                for line, name in self.mod.guard_comments.items():
+                    if name == lock:
+                        emit(
+                            self.report, self.mod, "PWC405", line,
+                            f"attribute {cls.name}.{attr} is guarded by "
+                            f"{lock!r}, but that lock is never created or "
+                            f"acquired in class {cls.name}",
+                        )
+                        break
+
+    # -- alias closure ----------------------------------------------------
+
+    def _closure(self, cls: str | None, names: tuple[str, ...]) -> set[str]:
+        out = set(names)
+        for group in self.aliases.get(cls or "", []):
+            if out & group:
+                out |= group
+        return out
+
+    def _holds(self, cls: str | None, held: tuple[str, ...], lock: str) -> bool:
+        closed = self._closure(cls, held)
+        return lock in closed or f"self.{lock}" in closed
+
+    # -- per-function walk ------------------------------------------------
+
+    def lock_id(self, cls: str | None, text: str) -> str:
+        """Normalize a lock name for the cross-file order graph."""
+        if text.startswith("self.") and cls:
+            return f"{cls}.{text[len('self.'):]}"
+        if "." not in text:
+            return f"{self.mod.stem}.{text}"
+        return text
+
+    def analyze_functions(self) -> None:
+        def visit_scope(
+            fn: ast.AST, cls: str | None, qual: str
+        ) -> None:
+            info = _FuncInfo(qualname=qual, node=fn, cls=cls, mod=self.mod)
+            self.funcs.append(info)
+            is_target = fn.name in self.thread_targets
+            exempt_401 = fn.name == "__init__" or fn.name.endswith("_locked")
+            self._walk_block(
+                fn.body, (), info, cls,
+                loop_depth=0, is_target=is_target, exempt_401=exempt_401,
+            )
+            for st in ast.walk(fn):
+                if (
+                    isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and st is not fn
+                ):
+                    visit_scope(st, cls, f"{qual}.{st.name}")
+
+        for stmt in self.mod.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_scope(stmt, None, stmt.name)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        visit_scope(sub, stmt.name, f"{stmt.name}.{sub.name}")
+
+    def _walk_block(
+        self,
+        stmts: list[ast.stmt],
+        held: tuple[str, ...],
+        info: _FuncInfo,
+        cls: str | None,
+        *,
+        loop_depth: int,
+        is_target: bool,
+        exempt_401: bool,
+    ) -> None:
+        kw = dict(loop_depth=loop_depth, is_target=is_target,
+                  exempt_401=exempt_401)
+        for st in stmts:
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # separate scope
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired: list[str] = []
+                for item in st.items:
+                    t = _dotted(item.context_expr)
+                    if t and _LOCKISH.search(t):
+                        acquired.append(t)
+                    else:
+                        self._scan(item.context_expr, held, info, cls, **kw)
+                for t in acquired:
+                    tid = self.lock_id(cls, t)
+                    info.acquires.add(tid)
+                    for h in held:
+                        hid = self.lock_id(cls, h)
+                        if hid != tid and not (
+                            self._closure(cls, (h,)) & self._closure(cls, (t,))
+                        ):
+                            _ORDER_EDGES.setdefault(hid, {}).setdefault(
+                                tid, (self.mod, st.lineno)
+                            )
+                self._walk_block(
+                    st.body, held + tuple(acquired), info, cls, **kw
+                )
+            elif isinstance(st, ast.If):
+                self._scan(st.test, held, info, cls, **kw)
+                self._walk_block(st.body, held, info, cls, **kw)
+                self._walk_block(st.orelse, held, info, cls, **kw)
+            elif isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+                header = st.test if isinstance(st, ast.While) else st.iter
+                self._scan(header, held, info, cls, **kw)
+                inner = dict(kw)
+                inner["loop_depth"] = loop_depth + 1
+                self._walk_block(st.body, held, info, cls, **inner)
+                self._walk_block(st.orelse, held, info, cls, **inner)
+            elif isinstance(st, ast.Try):
+                for block in (st.body, st.orelse, st.finalbody):
+                    self._walk_block(block, held, info, cls, **kw)
+                for handler in st.handlers:
+                    self._walk_block(handler.body, held, info, cls, **kw)
+            else:
+                self._scan(st, held, info, cls, **kw)
+
+    # -- expression checks ------------------------------------------------
+
+    def _scan(
+        self,
+        node: ast.AST | None,
+        held: tuple[str, ...],
+        info: _FuncInfo,
+        cls: str | None,
+        *,
+        loop_depth: int,
+        is_target: bool,
+        exempt_401: bool,
+    ) -> None:
+        if node is None:
+            return
+        for n in _expr_nodes(node):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    n.targets if isinstance(n, ast.Assign) else [n.target]
+                )
+                for t in targets:
+                    self._check_write(t, held, cls, n.lineno, exempt_401)
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    self._check_write(t, held, cls, n.lineno, exempt_401)
+            elif isinstance(n, ast.Call):
+                self._check_call(
+                    n, held, info, cls,
+                    loop_depth=loop_depth, is_target=is_target,
+                    exempt_401=exempt_401,
+                )
+
+    def _guard_for(self, cls: str | None, target: ast.AST) -> tuple[str, str] | None:
+        """(attr, lock) when ``target`` writes a guarded location."""
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        td = _dotted(target)
+        if td is None:
+            return None
+        if td.startswith("self.") and cls:
+            attr = td[len("self."):].split(".")[0]
+            lock = self.guards.get(cls, {}).get(attr)
+            if lock:
+                return attr, lock
+        elif "." not in td:
+            lock = self.module_guards.get(td)
+            if lock:
+                return td, lock
+        return None
+
+    def _check_write(
+        self,
+        target: ast.AST,
+        held: tuple[str, ...],
+        cls: str | None,
+        line: int,
+        exempt_401: bool,
+    ) -> None:
+        if exempt_401:
+            return
+        hit = self._guard_for(cls, target)
+        if hit is None:
+            return
+        attr, lock = hit
+        if self._holds(cls, held, lock):
+            return
+        where = f"{cls}.{attr}" if cls else attr
+        emit(
+            self.report, self.mod, "PWC401", line,
+            f"write to {where} (guarded-by {lock}) without holding {lock}",
+        )
+
+    def _check_call(
+        self,
+        call: ast.Call,
+        held: tuple[str, ...],
+        info: _FuncInfo,
+        cls: str | None,
+        *,
+        loop_depth: int,
+        is_target: bool,
+        exempt_401: bool,
+    ) -> None:
+        f = call.func
+        fd = _dotted(f)
+        attr = None
+        recv = None
+        if isinstance(f, ast.Attribute):
+            attr = f.attr
+            recv = _dotted(f.value)
+        elif isinstance(f, ast.Name):
+            attr = f.id
+        line = call.lineno
+
+        # PWC401 via in-place mutation of a guarded container
+        if (
+            not exempt_401
+            and attr in _MUTATORS
+            and recv is not None
+        ):
+            hit = self._guard_for(cls, f.value)
+            if hit is not None:
+                a, lock = hit
+                if not self._holds(cls, held, lock):
+                    where = f"{cls}.{a}" if cls else a
+                    emit(
+                        self.report, self.mod, "PWC401", line,
+                        f"{attr}() mutates {where} (guarded-by {lock}) "
+                        f"without holding {lock}",
+                    )
+
+        # record intra-module call edges for the lock-order graph
+        if held and fd:
+            candidates: list[str] = []
+            if fd.startswith("self.") and cls and "." not in fd[5:]:
+                candidates.append(f"{cls}.{fd[5:]}")
+            elif "." not in fd:
+                candidates.append(fd)
+            if candidates:
+                info.calls.append(
+                    (candidates, held, line)
+                )
+
+        if not attr:
+            return
+
+        # PWC404: unbounded wait in a daemon/thread-target loop
+        if is_target and loop_depth > 0:
+            if _is_queue_get(call):
+                emit(
+                    self.report, self.mod, "PWC404", line,
+                    f"thread target {info.qualname} loops on "
+                    f"{recv or '?'}.get() with no timeout — shutdown can "
+                    "hang this thread",
+                )
+            elif attr in ("wait", "wait_for") and not _has_timeout(call, attr):
+                emit(
+                    self.report, self.mod, "PWC404", line,
+                    f"thread target {info.qualname} loops on "
+                    f"{recv or '?'}.{attr}() with no timeout — shutdown "
+                    "can hang this thread",
+                )
+
+        # PWC403: blocking call while a lock is held
+        if not held:
+            return
+        blocking: str | None = None
+        if attr in _BLOCKING_ALWAYS or (fd in _BLOCKING_DOTTED):
+            blocking = f"{fd or attr}()"
+        elif attr in _BLOCKING_NO_TIMEOUT and not _has_timeout(call, attr):
+            # waiting on the held condition releases it — that is the
+            # point of a CV — so only foreign waits are blocking here
+            if not (recv and self._holds(cls, held, recv)):
+                blocking = f"{fd or attr}() with no timeout"
+        elif _is_queue_get(call):
+            blocking = f"{fd or attr}() with no timeout"
+        if blocking:
+            locks = ", ".join(held)
+            emit(
+                self.report, self.mod, "PWC403", line,
+                f"blocking {blocking} while holding {locks}",
+            )
+
+
+#: cross-file lock-order graph: lock -> lock -> (module, line) witness
+_ORDER_EDGES: dict[str, dict[str, tuple[SourceModule, int]]] = {}
+
+
+def _propagate_call_edges(lints: list[_ModuleLint]) -> None:
+    """One level of interprocedural nesting: calling ``f()`` while
+    holding A adds A -> (every lock f acquires, transitively)."""
+    by_name: dict[str, list[_FuncInfo]] = {}
+    for lint in lints:
+        for fn in lint.funcs:
+            by_name.setdefault(fn.qualname, []).append(fn)
+            by_name.setdefault(fn.qualname.split(".")[-1], []).append(fn)
+
+    closure_cache: dict[int, set[str]] = {}
+
+    def closure(fn: _FuncInfo, depth: int = 0) -> set[str]:
+        key = id(fn)
+        if key in closure_cache:
+            return closure_cache[key]
+        closure_cache[key] = set(fn.acquires)  # break recursion cycles
+        out = set(fn.acquires)
+        if depth < 3:
+            for candidates, _held, _line in fn.calls:
+                for cand in candidates:
+                    for callee in by_name.get(cand, []):
+                        if callee is not fn:
+                            out |= closure(callee, depth + 1)
+        closure_cache[key] = out
+        return out
+
+    for lint in lints:
+        for fn in lint.funcs:
+            for candidates, held, line in fn.calls:
+                acquired: set[str] = set()
+                for cand in candidates:
+                    for callee in by_name.get(cand, []):
+                        if callee is not fn:
+                            acquired |= closure(callee)
+                for h in held:
+                    hid = lint.lock_id(fn.cls, h)
+                    for tid in acquired:
+                        if tid != hid:
+                            _ORDER_EDGES.setdefault(hid, {}).setdefault(
+                                tid, (lint.mod, line)
+                            )
+
+
+def _report_cycles(report: Report) -> None:
+    seen_cycles: set[frozenset[str]] = set()
+    path: list[str] = []
+    on_path: set[str] = set()
+    visited: set[str] = set()
+
+    def dfs(node: str) -> None:
+        visited.add(node)
+        on_path.add(node)
+        path.append(node)
+        for nxt in sorted(_ORDER_EDGES.get(node, {})):
+            if nxt in on_path:
+                cycle = path[path.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    mod, line = _ORDER_EDGES[node][nxt]
+                    emit(
+                        report, mod, "PWC402", line,
+                        "inconsistent lock order (deadlock cycle): "
+                        + " -> ".join(cycle),
+                    )
+            elif nxt not in visited:
+                dfs(nxt)
+        path.pop()
+        on_path.discard(node)
+
+    for node in sorted(_ORDER_EDGES):
+        if node not in visited:
+            dfs(node)
+
+
+def run_pass(modules: list[SourceModule], report: Report) -> None:
+    _ORDER_EDGES.clear()
+    lints = []
+    for mod in modules:
+        lint = _ModuleLint(mod, report)
+        lint.discover()
+        lint.analyze_functions()
+        lints.append(lint)
+    _propagate_call_edges(lints)
+    _report_cycles(report)

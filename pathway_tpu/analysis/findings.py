@@ -13,6 +13,12 @@ Code ranges:
 - ``PWA1xx`` — dead columns / unused operators
 - ``PWA2xx`` — shard/exchange advisories
 - ``PWA3xx`` — UDF determinism & purity lint
+- ``PWC4xx`` — runtime lock-discipline lint (source-level, ``analysis.concurrency``)
+- ``PWC5xx`` — scheduler/mesh protocol invariants (source-level, ``analysis.protocol``)
+
+``PWC`` findings come from the *source tree*, not a built graph, so their
+provenance fields are reinterpreted: ``node_name`` is the relative file
+path and ``node_index`` the 1-based line number.
 """
 
 from __future__ import annotations
@@ -46,6 +52,17 @@ FINDING_CODES: dict[str, tuple[Severity, str]] = {
     "PWA301": (Severity.ERROR, "nondeterministic call in deterministic UDF"),
     "PWA302": (Severity.WARNING, "order-sensitive set iteration in UDF"),
     "PWA303": (Severity.WARNING, "UDF mutates ambient global state"),
+    "PWA304": (Severity.WARNING, "caching decorator on UDF breaks replay"),
+    "PWA305": (Severity.WARNING, "mutable default argument on UDF"),
+    "PWC401": (Severity.ERROR, "guarded attribute written without its lock"),
+    "PWC402": (Severity.ERROR, "inconsistent lock acquisition order (cycle)"),
+    "PWC403": (Severity.WARNING, "blocking call while holding a lock"),
+    "PWC404": (Severity.WARNING, "unbounded wait in daemon loop"),
+    "PWC405": (Severity.WARNING, "guarded-by names an unknown lock"),
+    "PWC501": (Severity.ERROR, "commit hook runs before device drain"),
+    "PWC502": (Severity.ERROR, "rollback path cannot reach snapshot truncate"),
+    "PWC503": (Severity.ERROR, "mesh frame arity drift between encode/decode"),
+    "PWC504": (Severity.ERROR, "follower frame handler missing epoch fence"),
 }
 
 

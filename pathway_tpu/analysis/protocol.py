@@ -1,0 +1,379 @@
+"""Scheduler/mesh protocol invariant checks (``PWC5xx``).
+
+These are source-level cross-checks of the invariants the threaded
+runtime's correctness rests on — the ones that have historically broken
+as silent drift between two distant call sites:
+
+- ``PWC501`` — **commit seam ordering.**  A checkpoint, operator
+  snapshot, or read-snapshot publish for commit N may only be cut once
+  N's staged device work has drained.  In any function that runs a
+  commit hook (``publish_on_commit`` or a snapshot manager's
+  ``on_commit``), a ``drain_until``/``drain`` call must appear *earlier
+  in the same function body*.
+- ``PWC502`` — **rollback reaches truncate.**  Readers must never
+  observe commits the mesh rolled back past: every function whose name
+  mentions ``rollback`` must reach a ``truncate`` call through the
+  analyzed call graph.
+- ``PWC503`` — **frame arity agreement.**  For each mesh frame kind
+  (first element of a tuple passed to ``send``/``broadcast``), every
+  encode site that builds a *fixed-shape* frame must agree on arity
+  with every decode site that destructures it — the 6-tuple→8-tuple
+  drift class.  Variable-length command frames are checked against the
+  highest subscript a decoder reads.
+- ``PWC504`` — **epoch-fence coverage.**  Any function that dispatches
+  on a fenced control-frame kind (``== "recover"`` / ``"rollback"`` /
+  ``"elect"``) must call ``fence.admit("<kind>", …)`` somewhere in the
+  same function, so zombie-leader/duplicated commands stay no-ops.
+
+All four checks run over the whole analyzed file set at once, so
+encode/decode pairs living in different modules still cross-check.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from pathway_tpu.analysis.findings import Report
+from pathway_tpu.analysis.source import SourceModule, emit
+
+#: commit hooks that must follow a device drain
+_HOOK_PUBLISH = "publish_on_commit"
+_HOOK_ON_COMMIT = "on_commit"
+#: ``.on_commit`` only counts when the receiver is snapshot machinery —
+#: monitor/fault-plan hooks sit outside the exactly-once seam
+_SNAPSHOT_RECV = "snapshot"
+_DRAIN_CALLS = {"drain_until", "drain"}
+
+_SEND_CALLS = {"send", "_send", "broadcast"}
+_FENCED_KINDS = {"recover", "rollback", "elect"}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _call_attr(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function body without descending into nested defs."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _functions(mod: SourceModule):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+@dataclass
+class _FrameKind:
+    #: (module, line, arity) per tuple-literal encode site
+    encodes: list[tuple[SourceModule, int, int]] = field(default_factory=list)
+    #: (module, line, arity) per fixed tuple-unpack decode site
+    unpacks: list[tuple[SourceModule, int, int]] = field(default_factory=list)
+
+
+# -- PWC501 ----------------------------------------------------------------
+
+
+def _check_commit_ordering(mod: SourceModule, report: Report) -> None:
+    for fn in _functions(mod):
+        drains: list[int] = []
+        hooks: list[tuple[int, str]] = []
+        for n in _own_nodes(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            attr = _call_attr(n)
+            if attr in _DRAIN_CALLS:
+                drains.append(n.lineno)
+            elif attr == _HOOK_PUBLISH:
+                hooks.append((n.lineno, _HOOK_PUBLISH))
+            elif attr == _HOOK_ON_COMMIT and isinstance(
+                n.func, ast.Attribute
+            ):
+                recv = _dotted(n.func.value) or ""
+                if _SNAPSHOT_RECV in recv:
+                    hooks.append((n.lineno, f"{recv}.on_commit"))
+        if not hooks:
+            continue
+        first_drain = min(drains) if drains else None
+        for line, what in hooks:
+            if first_drain is None:
+                emit(
+                    report, mod, "PWC501", line,
+                    f"{what}() in {fn.name} has no preceding "
+                    "device_pipeline drain — staged device work for this "
+                    "commit may be missing from the cut state",
+                )
+            elif line < first_drain:
+                emit(
+                    report, mod, "PWC501", line,
+                    f"{what}() in {fn.name} runs before the drain at "
+                    f"line {first_drain} — commit hooks must follow "
+                    "drain_until",
+                )
+
+
+# -- PWC502 ----------------------------------------------------------------
+
+
+def _check_rollback_truncate(
+    modules: list[SourceModule], report: Report
+) -> None:
+    defs: dict[str, list[tuple[SourceModule, ast.AST]]] = {}
+    for mod in modules:
+        for fn in _functions(mod):
+            defs.setdefault(fn.name, []).append((mod, fn))
+
+    reach_cache: dict[int, bool] = {}
+
+    def reaches_truncate(fn: ast.AST, depth: int = 0) -> bool:
+        key = id(fn)
+        if key in reach_cache:
+            return reach_cache[key]
+        reach_cache[key] = False  # break recursion
+        out = False
+        for n in _own_nodes(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            attr = _call_attr(n)
+            if attr and "truncate" in attr:
+                out = True
+                break
+            if attr and depth < 4:
+                for _m, callee in defs.get(attr, []):
+                    if callee is not fn and reaches_truncate(
+                        callee, depth + 1
+                    ):
+                        out = True
+                        break
+            if out:
+                break
+        reach_cache[key] = out
+        return out
+
+    for mod in modules:
+        for fn in _functions(mod):
+            if "rollback" not in fn.name:
+                continue
+            if not reaches_truncate(fn):
+                emit(
+                    report, mod, "PWC502", fn.lineno,
+                    f"rollback path {fn.name}() never reaches a snapshot "
+                    "truncate() — readers could observe rolled-back "
+                    "commits",
+                )
+
+
+# -- PWC503 ----------------------------------------------------------------
+
+
+def _collect_frames(
+    modules: list[SourceModule],
+) -> tuple[
+    dict[str, _FrameKind],
+    list[tuple[SourceModule, int, int, frozenset[str]]],
+]:
+    kinds: dict[str, _FrameKind] = {}
+    #: indexed decode sites: (module, line, max index, kinds the decoded
+    #: variable is compared against — one var can carry several kinds)
+    sub_checks: list[tuple[SourceModule, int, int, frozenset[str]]] = []
+
+    def kind_for(name: str) -> _FrameKind:
+        return kinds.setdefault(name, _FrameKind())
+
+    for mod in modules:
+        for fn in _functions(mod):
+            # encode sites: tuple literals handed to send/broadcast
+            for n in _own_nodes(fn):
+                if isinstance(n, ast.Call) and _call_attr(n) in _SEND_CALLS:
+                    for arg in n.args:
+                        if (
+                            isinstance(arg, ast.Tuple)
+                            and arg.elts
+                            and isinstance(arg.elts[0], ast.Constant)
+                            and isinstance(arg.elts[0].value, str)
+                        ):
+                            kind_for(arg.elts[0].value).encodes.append(
+                                (mod, n.lineno, len(arg.elts))
+                            )
+            # decode sites: variables assigned from a recv-ish call
+            recv_vars: set[str] = set()
+            for n in _own_nodes(fn):
+                if (
+                    isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)
+                ):
+                    attr = _call_attr(n.value) or ""
+                    if "recv" in attr:
+                        recv_vars.add(n.targets[0].id)
+            if not recv_vars:
+                continue
+            # fixed unpacks: (a, b, ...) = frame, kind named by a later
+            # comparison of the first target against a string constant
+            unpack_first: dict[str, tuple[SourceModule, int, int]] = {}
+            sub_max: dict[str, int] = {}
+            sub_line: dict[str, int] = {}
+            var_kinds: dict[str, set[str]] = {}
+            # two sub-passes: _own_nodes yields statements in stack
+            # order, so a ``kind == "round"`` comparison can be visited
+            # before the unpack that binds ``kind`` — collect every
+            # unpack/subscript first, then resolve the comparisons
+            for n in _own_nodes(fn):
+                if (
+                    isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Tuple)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in recv_vars
+                ):
+                    elts = n.targets[0].elts
+                    if elts and all(isinstance(e, ast.Name) for e in elts):
+                        unpack_first[elts[0].id] = (mod, n.lineno, len(elts))
+                if (
+                    isinstance(n, ast.Subscript)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in recv_vars
+                    and isinstance(n.slice, ast.Constant)
+                    and isinstance(n.slice.value, int)
+                ):
+                    v = n.value.id
+                    if n.slice.value > sub_max.get(v, -1):
+                        sub_max[v] = n.slice.value
+                        sub_line[v] = n.lineno
+            for n in _own_nodes(fn):
+                if isinstance(n, ast.Compare) and len(n.ops) == 1 and (
+                    isinstance(n.ops[0], (ast.Eq, ast.NotEq))
+                ):
+                    left, right = n.left, n.comparators[0]
+                    if not (
+                        isinstance(right, ast.Constant)
+                        and isinstance(right.value, str)
+                    ):
+                        continue
+                    # frame[0] == "kind"
+                    if (
+                        isinstance(left, ast.Subscript)
+                        and isinstance(left.value, ast.Name)
+                        and left.value.id in recv_vars
+                        and isinstance(left.slice, ast.Constant)
+                        and left.slice.value == 0
+                    ):
+                        var_kinds.setdefault(left.value.id, set()).add(
+                            right.value
+                        )
+                    # kind == "round" where kind was the first unpack name
+                    elif (
+                        isinstance(left, ast.Name)
+                        and left.id in unpack_first
+                    ):
+                        m, line, arity = unpack_first[left.id]
+                        kind_for(right.value).unpacks.append((m, line, arity))
+            for var, names in var_kinds.items():
+                if var in sub_max:
+                    sub_checks.append(
+                        (mod, sub_line[var], sub_max[var], frozenset(names))
+                    )
+    return kinds, sub_checks
+
+
+def _check_frame_arity(modules: list[SourceModule], report: Report) -> None:
+    kinds, sub_checks = _collect_frames(modules)
+    for name, fk in sorted(kinds.items()):
+        if not fk.encodes:
+            continue
+        if fk.unpacks:
+            expected = fk.unpacks[0][2]
+            for m, line, arity in fk.unpacks[1:]:
+                if arity != expected:
+                    emit(
+                        report, m, "PWC503", line,
+                        f"frame kind {name!r} is destructured into "
+                        f"{arity} fields here but {expected} elsewhere",
+                    )
+            for m, line, arity in fk.encodes:
+                if arity != expected:
+                    emit(
+                        report, m, "PWC503", line,
+                        f"frame kind {name!r} encoded with {arity} "
+                        f"element(s) but decoders destructure "
+                        f"{expected} — encode/decode drift",
+                    )
+    for m, line, max_idx, names in sub_checks:
+        arities = [
+            a
+            for name in names
+            for _m, _l, a in kinds.get(name, _FrameKind()).encodes
+        ]
+        if not arities:
+            continue  # no literal encode site in the analyzed set
+        if max_idx >= max(arities):
+            shown = "/".join(sorted(names))
+            emit(
+                report, m, "PWC503", line,
+                f"decoder reads {shown!r} frame element [{max_idx}] "
+                f"but no encoder builds more than {max(arities)} "
+                "element(s)",
+            )
+
+
+# -- PWC504 ----------------------------------------------------------------
+
+
+def _check_epoch_fences(mod: SourceModule, report: Report) -> None:
+    for fn in _functions(mod):
+        dispatched: dict[str, int] = {}
+        admitted: set[str] = set()
+        for n in _own_nodes(fn):
+            if isinstance(n, ast.Compare) and len(n.ops) == 1 and isinstance(
+                n.ops[0], (ast.Eq, ast.NotEq)
+            ):
+                right = n.comparators[0]
+                if (
+                    isinstance(right, ast.Constant)
+                    and isinstance(right.value, str)
+                    and right.value in _FENCED_KINDS
+                ):
+                    dispatched.setdefault(right.value, n.lineno)
+            elif isinstance(n, ast.Call) and _call_attr(n) == "admit":
+                if n.args and isinstance(n.args[0], ast.Constant):
+                    admitted.add(n.args[0].value)
+        for kind, line in sorted(dispatched.items()):
+            if kind not in admitted:
+                emit(
+                    report, mod, "PWC504", line,
+                    f"{fn.name}() dispatches on control frame "
+                    f"{kind!r} without fencing it "
+                    f'(fence.admit("{kind}", epoch)) — a zombie leader '
+                    "or duplicated command would be re-executed",
+                )
+
+
+def run_pass(modules: list[SourceModule], report: Report) -> None:
+    for mod in modules:
+        _check_commit_ordering(mod, report)
+        _check_epoch_fences(mod, report)
+    _check_rollback_truncate(modules, report)
+    _check_frame_arity(modules, report)

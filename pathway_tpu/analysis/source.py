@@ -1,0 +1,159 @@
+"""Source-tree analysis driver for the runtime concurrency & protocol
+passes.
+
+The graph passes (``analysis.dtypes`` … ``analysis.udf_lint``) need a
+built :class:`~pathway_tpu.engine.graph.Scope`; the ``PWC`` passes lint
+the *runtime's own source* instead — the threads, locks, and mesh
+protocol that execute the graph.  This module owns the shared plumbing:
+
+- collecting ``.py`` files from a mix of file and directory targets,
+- parsing them once into :class:`SourceModule` records shared by both
+  passes (``analysis.concurrency`` and ``analysis.protocol``),
+- per-line suppression comments (``# pwc-ok: PWC403`` waives one code on
+  that line, bare ``# pwc-ok`` waives them all — every waiver should
+  carry a reason in the trailing text),
+- the same crash isolation as :func:`analyze_scope`: a pass that raises
+  lands in ``report.internal_errors`` (CLI exit 2), never in findings.
+
+``PWC`` findings reuse :class:`Finding` with ``node_name`` = relative
+file path and ``node_index`` = 1-based line number.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import traceback
+from dataclasses import dataclass, field
+
+from pathway_tpu.analysis.findings import Finding, Report, Severity
+
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+SUPPRESS_RE = re.compile(r"#\s*pwc-ok(?::\s*([A-Z0-9, ]+))?")
+
+
+@dataclass
+class SourceModule:
+    path: str
+    rel: str
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    #: line -> waived codes for that line ({"*"} = all)
+    suppress: dict[int, set[str]] = field(default_factory=dict)
+    #: line -> lock name from a ``# guarded-by:`` comment
+    guard_comments: dict[int, str] = field(default_factory=dict)
+
+    @property
+    def stem(self) -> str:
+        return os.path.splitext(os.path.basename(self.path))[0]
+
+
+def collect_files(targets: list[str]) -> list[str]:
+    """Expand files/directories into a sorted list of ``.py`` paths."""
+    out: list[str] = []
+    for target in targets:
+        if os.path.isdir(target):
+            for dirpath, dirnames, filenames in os.walk(target):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__"
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(dirpath, name))
+        else:
+            out.append(target)
+    seen: set[str] = set()
+    uniq = []
+    for p in out:
+        ap = os.path.abspath(p)
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append(ap)
+    return uniq
+
+
+def load_module(path: str, root: str | None = None) -> SourceModule:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    mod = SourceModule(
+        path=path,
+        rel=rel,
+        source=source,
+        tree=ast.parse(source, filename=path),
+        lines=source.splitlines(),
+    )
+    for i, line in enumerate(mod.lines, start=1):
+        if "#" not in line:
+            continue
+        g = GUARD_RE.search(line)
+        if g:
+            mod.guard_comments[i] = g.group(1)
+        s = SUPPRESS_RE.search(line)
+        if s:
+            codes = s.group(1)
+            mod.suppress[i] = (
+                {c.strip() for c in codes.split(",") if c.strip()}
+                if codes
+                else {"*"}
+            )
+    return mod
+
+
+def emit(
+    report: Report,
+    mod: SourceModule,
+    code: str,
+    line: int,
+    message: str,
+    severity: Severity | None = None,
+) -> None:
+    """Add a finding unless the line (or a standalone waiver comment on
+    the line above) carries a matching waiver."""
+    waived = mod.suppress.get(line, set()) | mod.suppress.get(line - 1, set())
+    if "*" in waived or code in waived:
+        return
+    from pathway_tpu.analysis.findings import FINDING_CODES
+
+    report.add(
+        Finding(
+            code=code,
+            message=message,
+            node_index=line,
+            node_name=mod.rel,
+            severity=severity or FINDING_CODES[code][0],
+        )
+    )
+
+
+def analyze_paths(targets: list[str], root: str | None = None) -> Report:
+    """Run the concurrency + protocol passes over source targets.
+
+    Mirrors :func:`pathway_tpu.analysis.analyze_scope`: each pass is
+    crash-isolated into ``internal_errors``; ``node_count`` counts the
+    files analyzed.
+    """
+    from pathway_tpu.analysis import concurrency, protocol
+
+    if root is None:
+        root = os.getcwd()
+    report = Report()
+    modules: list[SourceModule] = []
+    for path in collect_files(targets):
+        try:
+            modules.append(load_module(path, root=root))
+        except (OSError, SyntaxError) as exc:
+            report.internal_errors.append(f"cannot analyze {path}: {exc}")
+    report.node_count = len(modules)
+    for name, run in (
+        ("concurrency", concurrency.run_pass),
+        ("protocol", protocol.run_pass),
+    ):
+        try:
+            run(modules, report)
+        except Exception:  # noqa: BLE001 — collected, not raised
+            tail = traceback.format_exc(limit=4)
+            report.internal_errors.append(f"pass {name!r} crashed: {tail}")
+    return report

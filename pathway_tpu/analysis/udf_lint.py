@@ -6,7 +6,7 @@ believes is deterministic (``Apply.deterministic`` defaults True) silently
 breaks replay and checkpoint parity: a replayed run recomputes different
 values for the same keys, so retractions stop matching their insertions.
 
-Three checks:
+Five checks:
 
 - ``PWA301`` (error) — calls into known nondeterminism sources
   (``random``, ``time``, ``uuid``, ``secrets``, ``os.urandom``,
@@ -15,7 +15,13 @@ Three checks:
   comprehension / ``set()`` call feeding order-sensitive construction
   (``for`` loops, ``list()``/``tuple()``/``join`` — ``sorted()`` is fine);
 - ``PWA303`` (warning) — ``global`` declarations that are assigned to,
-  i.e. ambient state mutation across rows.
+  i.e. ambient state mutation across rows;
+- ``PWA304`` (warning) — ``functools.lru_cache``/``cache`` on a UDF,
+  detected both as a decorator in source and as a live cache wrapper
+  (``cache_info``) — cached values survive retractions and replay;
+- ``PWA305`` (warning) — mutable default arguments (``list``/``dict``/
+  ``set``/``bytearray`` instances in ``__defaults__``), shared across
+  every row and run.
 
 Builtins, C extensions, and callables whose source cannot be retrieved are
 skipped silently — the lint only ever inspects what it can parse, so it
@@ -25,6 +31,7 @@ cannot produce false positives on opaque callables.
 from __future__ import annotations
 
 import ast
+import functools
 import inspect
 import textwrap
 from typing import Callable, Iterator
@@ -122,6 +129,22 @@ class _UdfVisitor(ast.NodeVisitor):
         self.set_iterations: list[str] = []
         self.global_names: set[str] = set()
         self.mutated_globals: set[str] = set()
+        self.cache_decorators: list[str] = []
+
+    def _check_decorators(self, node: ast.AST) -> None:
+        for dec in getattr(node, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = _dotted_name(target)
+            if name and name.rsplit(".", 1)[-1] in ("lru_cache", "cache"):
+                self.cache_decorators.append(name)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_decorators(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_decorators(node)
+        self.generic_visit(node)
 
     def visit_Global(self, node: ast.Global) -> None:
         self.global_names.update(node.names)
@@ -190,6 +213,9 @@ def _candidate_functions(fn: Callable, depth: int = 0) -> Iterator[Callable]:
     shells before they reach the engine)."""
     if depth > 3 or not callable(fn):
         return
+    if isinstance(fn, functools.partial):
+        yield from _candidate_functions(fn.func, depth + 1)
+        return
     seen = getattr(fn, "__wrapped__", None)
     if seen is not None:
         yield from _candidate_functions(seen, depth + 1)
@@ -204,10 +230,48 @@ def _candidate_functions(fn: Callable, depth: int = 0) -> Iterator[Callable]:
                 yield from _candidate_functions(inner, depth + 1)
     elif inspect.ismethod(fn):
         yield from _candidate_functions(fn.__func__, depth + 1)
+        # pw.udf routes BatchApplyNode.rows_fn through a bound
+        # execute_rows shell; the user's function sits on the instance
+        inner = getattr(fn.__self__, "_fn", None)
+        if callable(inner):
+            yield from _candidate_functions(inner, depth + 1)
     elif hasattr(fn, "__call__") and inspect.isfunction(
         getattr(type(fn), "__call__", None)
     ):
         yield type(fn).__call__
+
+
+def _shell_chain(fn: Callable, depth: int = 0) -> Iterator[Callable]:
+    """``fn`` plus every wrapper shell met while unwrapping it — the
+    objects a live ``cache_info`` probe must see, which candidate
+    discovery (functions only) would skip over."""
+    if depth > 4 or fn is None:
+        return
+    yield fn
+    if isinstance(fn, functools.partial):
+        yield from _shell_chain(fn.func, depth + 1)
+    elif inspect.ismethod(fn):
+        inner = getattr(fn.__self__, "_fn", None)
+        if callable(inner):
+            yield from _shell_chain(inner, depth + 1)
+    else:
+        wrapped = getattr(fn, "__wrapped__", None)
+        if wrapped is not None:
+            yield from _shell_chain(wrapped, depth + 1)
+
+
+def _default_args(fn: Callable) -> list[tuple[str, object]]:
+    """(name, default value) pairs, positional and keyword-only."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return []
+    out: list[tuple[str, object]] = []
+    defaults = fn.__defaults__ or ()
+    if defaults:
+        names = code.co_varnames[: code.co_argcount][-len(defaults):]
+        out.extend(zip(names, defaults))
+    out.extend((fn.__kwdefaults__ or {}).items())
+    return out
 
 
 def _parse(fn: Callable) -> ast.AST | None:
@@ -227,6 +291,31 @@ def lint_callable(
     what: str = "UDF",
 ) -> None:
     seen_src: set[int] = set()
+    cache_reported = False
+    # runtime route: fn (or a wrapper shell) IS an lru_cache/cache
+    # wrapper — catches `udf = lru_cache(udf)` done after definition,
+    # which never shows up in any candidate's source
+    for shell in _shell_chain(fn):
+        if hasattr(shell, "cache_info") and hasattr(shell, "cache_clear"):
+            inner = getattr(shell, "__wrapped__", shell)
+            fname = getattr(inner, "__name__", "<callable>")
+            report.add(
+                Finding(
+                    code="PWA304",
+                    message=(
+                        f"{what} {fname!r} is wrapped in functools."
+                        "lru_cache/cache — cached values survive "
+                        "retractions and replay, so recomputed rows can "
+                        "disagree with the original run"
+                    ),
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=Severity.WARNING,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
+            cache_reported = True
+            break
     for candidate in _candidate_functions(fn):
         code = getattr(candidate, "__code__", None)
         if code is not None:
@@ -238,6 +327,31 @@ def lint_callable(
         module = getattr(candidate, "__module__", "") or ""
         if module.startswith(("pathway_tpu.internals", "pathway_tpu.engine")):
             continue
+        # needs only __defaults__, so it works even when the source is
+        # unavailable (REPL / -c / generated callables)
+        mutable_defaults = [
+            name
+            for name, value in _default_args(candidate)
+            if isinstance(value, (list, dict, set, bytearray))
+        ]
+        if mutable_defaults:
+            names = ", ".join(sorted(mutable_defaults))
+            report.add(
+                Finding(
+                    code="PWA305",
+                    message=(
+                        f"{what} "
+                        f"{getattr(candidate, '__name__', '<callable>')!r} "
+                        f"has mutable default argument(s) ({names}) — the "
+                        "default is shared across every row and run, so "
+                        "any mutation leaks between keys"
+                    ),
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=Severity.WARNING,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
         tree = _parse(candidate)
         if tree is None:
             continue
@@ -277,6 +391,24 @@ def lint_callable(
                     trace=getattr(node, "trace", None) or None,
                 )
             )
+        if visitor.cache_decorators and not cache_reported:
+            decs = ", ".join(sorted(set(visitor.cache_decorators)))
+            report.add(
+                Finding(
+                    code="PWA304",
+                    message=(
+                        f"{what} {fname!r} carries caching decorator(s) "
+                        f"[{decs}] — cached values survive retractions "
+                        "and replay, so recomputed rows can disagree "
+                        "with the original run"
+                    ),
+                    node_index=node.index,
+                    node_name=node.name,
+                    severity=Severity.WARNING,
+                    trace=getattr(node, "trace", None) or None,
+                )
+            )
+            cache_reported = True
         if visitor.mutated_globals:
             names = ", ".join(sorted(visitor.mutated_globals))
             report.add(

@@ -101,12 +101,49 @@ def spawn(
     return 0
 
 
+def analyze_source(
+    targets: Sequence[str],
+    *,
+    as_json: bool = False,
+    errors_only: bool = False,
+    strict: bool = False,
+) -> int:
+    """Lint the runtime's own source (``analyze --source``): the PWC
+    concurrency + protocol passes over files/directories, same exit
+    contract as graph mode (0 clean, 1 findings, 2 analyzer failure)."""
+    from pathway_tpu.analysis import Severity
+    from pathway_tpu.analysis.source import analyze_paths
+
+    missing = [t for t in targets if not os.path.exists(t)]
+    if missing or not targets:
+        print(
+            f"analyze: no such source target(s): {missing or '(none given)'}",
+            file=sys.stderr,
+        )
+        return 2
+    report = analyze_paths(list(targets), root=os.getcwd())
+    if as_json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render())
+    if report.internal_errors or report.node_count == 0:
+        return 2
+    if strict and report.findings:
+        return 1
+    if report.error_count:
+        return 1
+    if not errors_only and report.count(Severity.WARNING):
+        return 1
+    return 0
+
+
 def analyze(
     program: str,
     arguments: Sequence[str],
     *,
     as_json: bool = False,
     errors_only: bool = False,
+    strict: bool = False,
     env: dict | None = None,
 ) -> int:
     """Run ``program`` under PATHWAY_TPU_ANALYZE=1 and report findings.
@@ -155,6 +192,8 @@ def analyze(
             print(merged.render())
         if merged.internal_errors:
             return 2
+        if strict and merged.findings:
+            return 1
         if merged.error_count:
             return 1
         if not errors_only and merged.count(Severity.WARNING):
@@ -631,6 +670,18 @@ def main(argv: Sequence[str] | None = None) -> int:
         action="store_true",
         help="exit 1 only on error-severity findings (ignore warnings)",
     )
+    p_analyze.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 on ANY finding, info included",
+    )
+    p_analyze.add_argument(
+        "--source",
+        action="store_true",
+        help="lint runtime source instead of a graph: positional "
+        "arguments are .py files/directories for the PWC concurrency "
+        "and protocol passes",
+    )
     p_analyze.add_argument("program")
     p_analyze.add_argument("arguments", nargs=argparse.REMAINDER)
 
@@ -686,11 +737,19 @@ def main(argv: Sequence[str] | None = None) -> int:
             first_port=args.first_port,
         )
     if args.command == "analyze":
+        if args.source:
+            return analyze_source(
+                [args.program, *args.arguments],
+                as_json=args.json,
+                errors_only=args.errors_only,
+                strict=args.strict,
+            )
         return analyze(
             args.program,
             args.arguments,
             as_json=args.json,
             errors_only=args.errors_only,
+            strict=args.strict,
         )
     if args.command == "rescale":
         return rescale(

@@ -228,14 +228,15 @@ class DevicePipeline:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         #: FIFO of (commit_time, handles, dispatch_perf) awaiting completion
-        self._staged: deque = deque()
-        self._active_time: int | None = None
-        self._completed_time = -1
+        self._staged: deque = deque()  # guarded-by: self._cv
+        self._active_time: int | None = None  # guarded-by: self._cv
+        self._completed_time = -1  # guarded-by: self._cv
         self._worker: threading.Thread | None = None
-        self._error: BaseException | None = None
-        self._busy_s = 0.0
-        self._occ_mark: float | None = None
-        self._occupancy = 0.0
+        self._stop = False  # guarded-by: self._cv
+        self._error: BaseException | None = None  # guarded-by: self._cv
+        self._busy_s = 0.0  # guarded-by: self._cv
+        self._occ_mark: float | None = None  # guarded-by: self._cv
+        self._occupancy = 0.0  # guarded-by: self._cv
         self.controller = AdaptiveBatchController()
         self._g_depth = _metrics.REGISTRY.gauge(
             "pathway_device_queue_depth",
@@ -273,6 +274,8 @@ class DevicePipeline:
     def _ensure_worker(self) -> None:
         w = self._worker
         if w is None or not w.is_alive():
+            with self._cv:
+                self._stop = False
             self._worker = threading.Thread(
                 target=self._run_completions,
                 name="pw-device-pipeline",
@@ -280,10 +283,31 @@ class DevicePipeline:
             )
             self._worker.start()
 
-    def _raise_pending(self) -> None:
+    def stop_worker(self, timeout: float = 5.0) -> None:
+        """Reap the completion worker (run teardown).  The worker first
+        retires anything still staged, so a clean run loses nothing; a
+        raising run must not leave the daemon behind to accumulate
+        across runs — ``_ensure_worker`` respawns it on next use."""
+        w = self._worker
+        if w is None:
+            return
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if w.is_alive():
+            w.join(timeout=timeout)
+        if not w.is_alive():
+            self._worker = None
+
+    def _take_error_locked(self) -> BaseException | None:
         err = self._error
+        self._error = None
+        return err
+
+    def _raise_pending(self) -> None:
+        with self._cv:
+            err = self._take_error_locked()
         if err is not None:
-            self._error = None
             raise err
 
     # -- staging side (scheduler thread) -------------------------------------
@@ -332,7 +356,9 @@ class DevicePipeline:
                         _time.perf_counter(),
                         inflight=len(self._staged),
                     )
-                self._raise_pending()
+                err = self._take_error_locked()
+                if err is not None:
+                    raise err
             self._staged.append((int(time), handles, t0))
             self._g_depth.value = float(
                 len(self._staged)
@@ -359,8 +385,13 @@ class DevicePipeline:
     def _run_completions(self) -> None:
         while True:
             with self._cv:
+                # bounded wait + stop flag: an untimed wait here would
+                # strand the daemon at shutdown if the final notify races
+                # the run teardown
                 while not self._staged:
-                    self._cv.wait()
+                    if self._stop:
+                        return
+                    self._cv.wait(timeout=0.5)
                 time_, handles, t_dispatch = self._staged.popleft()
                 self._active_time = time_
                 self._g_depth.value = float(len(self._staged) + 1)
@@ -483,6 +514,10 @@ def drain() -> None:
 
 def drain_until(time: int) -> None:
     PIPELINE.drain_until(time)
+
+
+def stop_worker() -> None:
+    PIPELINE.stop_worker()
 
 
 def reset() -> None:

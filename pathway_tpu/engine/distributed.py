@@ -456,10 +456,14 @@ class MeshTransport:
         self._send_locks: dict[int, threading.Lock] = {}
         self._threads: list[threading.Thread] = []
         self._closed = False
+        #: serializes the liveness state below — written by every recv
+        #: loop and by the pump thread's suspicion scan
+        self._peer_lock = threading.Lock()
         #: peers whose socket closed/errored (set by the recv loops)
-        self.dead_peers: set[int] = set()
+        self.dead_peers: set[int] = set()  # guarded-by: self._peer_lock
         #: per-peer monotonic arrival time of the most recent frame
         #: (heartbeats included) — the liveness signal suspicion reads
+        # guarded-by: self._peer_lock
         self.last_seen: dict[int, float] = {
             p: _walltime.monotonic()
             for p in range(n_processes)
@@ -594,7 +598,8 @@ class MeshTransport:
         try:
             while True:
                 frame = self._read_frame(sock)
-                self.last_seen[peer] = _walltime.monotonic()
+                with self._peer_lock:
+                    self.last_seen[peer] = _walltime.monotonic()
                 if (
                     isinstance(frame, tuple)
                     and frame
@@ -613,7 +618,8 @@ class MeshTransport:
             # A loop whose socket was replaced by reestablish() must not
             # poison the fresh link.
             if self._socks.get(peer) is sock and not self._closed:
-                self.dead_peers.add(peer)
+                with self._peer_lock:
+                    self.dead_peers.add(peer)
                 self._put(q, ("__eof__", peer))
 
     def _put(self, q: queue.Queue, frame: Any) -> None:
@@ -640,7 +646,9 @@ class MeshTransport:
             return
         if not self.dead_peers:
             now = _walltime.monotonic()
-            for peer, seen in self.last_seen.items():
+            with self._peer_lock:
+                seen_snapshot = dict(self.last_seen)
+            for peer, seen in seen_snapshot.items():
                 if peer in self._socks and now - seen > SUSPICION_TIMEOUT:
                     # a hung peer holds its socket open: close it so the
                     # recv loop marks it dead like any other lost peer
@@ -648,7 +656,8 @@ class MeshTransport:
                         self._socks[peer].close()
                     except OSError:
                         pass
-                    self.dead_peers.add(peer)
+                    with self._peer_lock:
+                        self.dead_peers.add(peer)
                     raise PeerLostError(
                         f"process {self.process_id}: peer {peer} silent "
                         f"for {now - seen:.1f}s (suspicion timeout "
@@ -674,6 +683,7 @@ class MeshTransport:
             self._socks[peer].sendall(data)
         else:
             with lock:
+                # pwc-ok: PWC403 — per-peer lock serializes socket writers
                 self._socks[peer].sendall(data)
 
     def send(self, peer: int, frame: Any) -> None:
@@ -804,8 +814,9 @@ class MeshTransport:
                     )
                 _walltime.sleep(delay)
                 delay = min(delay * 2, 0.5)
-        self.dead_peers.discard(peer)
-        self.last_seen[peer] = _walltime.monotonic()
+        with self._peer_lock:
+            self.dead_peers.discard(peer)
+            self.last_seen[peer] = _walltime.monotonic()
 
     def heartbeat(self, peer: int) -> None:
         """Best-effort idle-time liveness frame; absorbed by the peer's

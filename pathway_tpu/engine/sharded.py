@@ -28,6 +28,7 @@ for multi-host (SURVEY §2.10 mapping).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, Sequence
 
 from pathway_tpu.engine.batch import (
@@ -180,9 +181,12 @@ class ShardedScheduler:
             scope.sharded = True
         self.time = 0
         self.probe = probe
+        #: the pump thread inserts per-operator entries while the live
+        #: monitoring thread snapshots the dict — serialize the inserts
+        self._stats_lock = threading.Lock()
         #: node index -> OperatorStats aggregated ACROSS workers (the
         #: monitoring surface reads .scope/.stats like the single Scheduler)
-        self.stats: dict[int, Any] = {}
+        self.stats: dict[int, Any] = {}  # guarded-by: self._stats_lock
         if probe:
             from pathway_tpu.internals import metrics as _metrics
 
@@ -295,7 +299,8 @@ class ShardedScheduler:
 
         st = self.stats.get(node.index)
         if st is None:
-            st = self.stats[node.index] = OperatorStats()
+            with self._stats_lock:
+                st = self.stats.setdefault(node.index, OperatorStats())
         return st
 
     def propagate(self, time: int) -> None:

@@ -215,8 +215,8 @@ class Registry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._families: dict[str, _Family] = {}
-        self._collectors: list[Callable[[], Iterable[tuple]]] = []
+        self._families: dict[str, _Family] = {}  # guarded-by: self._lock
+        self._collectors: list[Callable[[], Iterable[tuple]]] = []  # guarded-by: self._lock
 
     # -- instrument handles --------------------------------------------------
 
@@ -703,9 +703,9 @@ class FlightRecorder:
             except ValueError:
                 maxlen = 256
         self._lock = threading.Lock()
-        self._events: deque = deque(maxlen=max(1, maxlen))
-        self._seq = 0
-        self._dumps = 0
+        self._events: deque = deque(maxlen=max(1, maxlen))  # guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
+        self._dumps = 0  # guarded-by: self._lock
 
     def record(self, kind: str, **fields: Any) -> None:
         event = {"kind": kind, "wall": _time.time(), **fields}

@@ -204,6 +204,11 @@ def run(
             http_server.stop()
         if query_server is not None and not kwargs.get("_keep_http_server"):
             _serving.stop_server()
+        # reap the device completion worker: a raising run must not
+        # leave the daemon behind (it respawns on next use)
+        from pathway_tpu.engine import device_pipeline as _device_pipeline
+
+        _device_pipeline.stop_worker()
         G.clear()
 
 

@@ -1797,7 +1797,10 @@ class DistributedGraphRunner:
                 # followers snapshot EVERY commit (including this one);
                 # the leader must too, or a worker that dies before the
                 # first data commit forces a rollback to a boundary the
-                # leader cannot restore
+                # leader cannot restore.  Same exactly-once seam as the
+                # data path: the barrier commit flushes static sources,
+                # which can stage device work this snapshot must contain
+                _device_pipeline.drain_until(barrier_time)
                 snapshot_mgr.on_commit(sched.scopes, drivers, barrier_time)
         last_sign_of_life = _time.monotonic()
 

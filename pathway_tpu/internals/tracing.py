@@ -210,10 +210,14 @@ class TraceRecorder:
             except ValueError:
                 maxlen = 64
         self._lock = threading.Lock()
-        self._traces: deque = deque(maxlen=max(1, maxlen))
+        #: the ring and the query counter are the cross-thread surface:
+        #: serving workers record queries and exporters snapshot the ring
+        #: while the pump appends.  _ctx/_count/_export_seq/_overhead_ema
+        #: are pump-thread-private and deliberately unguarded.
+        self._traces: deque = deque(maxlen=max(1, maxlen))  # guarded-by: self._lock
         self._ctx: TraceContext | None = None
         self._count = 0
-        self._query_count = 0
+        self._query_count = 0  # guarded-by: self._lock
         self._export_seq = 0
         self._overhead_ema: float | None = None
         self.epoch = 0

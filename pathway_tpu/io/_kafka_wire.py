@@ -283,6 +283,7 @@ class KafkaWireClient:
             _w(head, "hhi", api_key, api_version, corr)
             _w_string(head, self.client_id)
             frame = head.getvalue() + body
+            # pwc-ok: PWC403 — the lock serializes request/response pairs
             self.sock.sendall(struct.pack(">i", len(frame)) + frame)
             (length,) = struct.unpack(">i", _recv_exact(self.sock, 4))
             resp = io.BytesIO(_recv_exact(self.sock, length))

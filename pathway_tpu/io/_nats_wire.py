@@ -117,6 +117,7 @@ class NatsConnection:
 
     def _send(self, data: bytes) -> None:
         with self._lock:
+            # pwc-ok: PWC403 — this lock exists to serialize socket writers
             self.sock.sendall(data)
 
     def _await_pong(self) -> None:
@@ -335,6 +336,7 @@ class FakeNatsServer:
 
         def send(data: bytes) -> None:
             with send_lock:
+                # pwc-ok: PWC403 — the lock serializes this socket's writers
                 conn.sendall(data)
 
         with self._lock:

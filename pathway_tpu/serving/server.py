@@ -126,8 +126,8 @@ class _MicroBatcher:
         self.store = store
         self.window_s = max(0.0, window_s)
         self._cv = threading.Condition()
-        self._pending: list[dict] = []
-        self._stop = False
+        self._pending: list[dict] = []  # guarded-by: self._cv
+        self._stop = False  # guarded-by: self._cv
         self._thread: threading.Thread | None = None
         self.dispatches = 0
 
@@ -399,6 +399,7 @@ class _BoundedHTTPServer(HTTPServer):
         self.batcher = batcher
         self.started_wall = _time.time()
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, queue_size))
+        self._pool_stop = False
         self._pool = [
             threading.Thread(
                 target=self._worker, name=f"pw-serving-{i}", daemon=True
@@ -425,8 +426,16 @@ class _BoundedHTTPServer(HTTPServer):
             self.shutdown_request(request)
 
     def _worker(self) -> None:
+        # bounded get: a sentinel can be lost to a full queue during
+        # shutdown, so the stop flag — not the sentinel — is what
+        # guarantees this daemon exits
         while True:
-            item = self._queue.get()
+            try:
+                item = self._queue.get(timeout=0.25)
+            except queue.Empty:
+                if self._pool_stop:
+                    return
+                continue
             if item is None:
                 return
             request, client_address = item
@@ -438,11 +447,12 @@ class _BoundedHTTPServer(HTTPServer):
                 self.shutdown_request(request)
 
     def stop_pool(self) -> None:
+        self._pool_stop = True
         for _ in self._pool:
             try:
                 self._queue.put_nowait(None)
             except queue.Full:
-                break
+                break  # workers still exit via the stop flag
         for t in self._pool:
             t.join(timeout=2.0)
 

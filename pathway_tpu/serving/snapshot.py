@@ -111,7 +111,8 @@ class ReadSnapshot:
             _time.time() if published_wall is None else float(published_wall)
         )
         self.views: list[dict[int, dict]] | None = views
-        self._refs = 1  # the store's retention pin
+        # the store's retention pin
+        self._refs = 1  # guarded-by: self._lock
         self._lock = threading.Lock()
 
     # -- lifetime ------------------------------------------------------------
@@ -290,8 +291,8 @@ class SnapshotStore:
 
     def __init__(self, depth: int | None = None) -> None:
         self._lock = threading.Lock()
-        self._ring: list[ReadSnapshot] = []
-        self._seq = 0
+        self._ring: list[ReadSnapshot] = []  # guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
         self.depth = depth
 
     # -- write side ----------------------------------------------------------

@@ -269,6 +269,29 @@ def _global_mutating_udf(x):
     return x
 
 
+import functools  # noqa: E402
+
+
+@functools.lru_cache(maxsize=64)
+def _lru_cached_udf(x):
+    return x * 3
+
+
+@functools.cache
+def _cache_decorated_udf(x):
+    return x - 1
+
+
+def _mutable_default_udf(x, seen=[]):
+    seen.append(x)
+    return len(seen)
+
+
+def _kwonly_mutable_default_udf(x, *, acc={}):
+    acc[x] = True
+    return len(acc)
+
+
 class TestUdfLint:
     def _report_for(self, fn):
         t = pw.debug.table_from_rows(
@@ -298,6 +321,53 @@ class TestUdfLint:
     def test_global_mutation_pwa303(self):
         report = self._report_for(_global_mutating_udf)
         assert "PWA303" in codes(report)
+
+    def test_lru_cache_wrapper_pwa304(self):
+        report = self._report_for(_lru_cached_udf)
+        assert "PWA304" in codes(report)
+        # runtime + decorator detection must not double-report
+        assert codes(report).count("PWA304") == 1
+
+    def test_cache_decorator_pwa304(self):
+        report = self._report_for(_cache_decorated_udf)
+        assert "PWA304" in codes(report)
+
+    def test_post_hoc_lru_cache_pwa304(self):
+        # wrapped AFTER definition: no decorator in source, only the
+        # live wrapper betrays it
+        report = self._report_for(functools.lru_cache(_pure_udf))
+        assert "PWA304" in codes(report)
+
+    def test_mutable_default_pwa305(self):
+        report = self._report_for(_mutable_default_udf)
+        assert "PWA305" in codes(report)
+
+    def test_kwonly_mutable_default_pwa305(self):
+        report = self._report_for(_kwonly_mutable_default_udf)
+        assert "PWA305" in codes(report)
+
+    def test_immutable_defaults_not_flagged(self):
+        def fine(x, scale=2, label="ok", opts=()):
+            return x * scale
+
+        report = self._report_for(fine)
+        assert "PWA305" not in codes(report)
+        assert "PWA304" not in codes(report)
+
+    def test_pw_udf_wrapper_linted_through_graph(self):
+        # the pw.udf route hides the user function behind a
+        # functools.partial over the Udf instance's execute_rows —
+        # the lint must unwrap that shell chain
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(a=int), [(1,), (2,)]
+        )
+        out = t.select(
+            p=pw.udf(_lru_cached_udf)(t.a),
+            q=pw.udf(_mutable_default_udf)(t.a),
+        )
+        report = analyze_tables(out)
+        assert "PWA304" in codes(report)
+        assert "PWA305" in codes(report)
 
 
 # -- hard node kinds ---------------------------------------------------------
@@ -405,6 +475,35 @@ class TestOwnCodeIsClean:
         )
         report = analyze_tables(fuzzy_match_tables(left, right))
         assert report.error_count == 0
+        assert not report.internal_errors
+
+    def test_knn_index_pipeline_analyzes_clean(self, monkeypatch):
+        # device-resident operators (ExternalIndexNode.ext_index, fused
+        # interiors) + the serving plane enabled must not confuse any
+        # pass: 0 errors AND 0 warnings, like `cli analyze bench.py`
+        monkeypatch.setenv("PATHWAY_TPU_SERVING", "1")
+        from pathway_tpu.stdlib.indexing import (
+            BruteForceKnnFactory,
+            DataIndex,
+        )
+
+        docs = pw.debug.table_from_rows(
+            pw.schema_from_types(emb=tuple),
+            [((1.0, 0.0, 0.0),), ((0.0, 1.0, 0.0),)],
+        )
+        queries = pw.debug.table_from_rows(
+            pw.schema_from_types(qtext=str, qemb=tuple),
+            [("baking", (1.0, 0.05, 0.0))],
+        )
+        index = DataIndex(
+            docs, BruteForceKnnFactory(dimensions=3, capacity=8), docs.emb
+        )
+        res = index.query_as_of_now(
+            queries, queries.qemb, number_of_matches=2
+        )
+        report = analyze_tables(res)
+        assert report.error_count == 0
+        assert report.count(Severity.WARNING) == 0
         assert not report.internal_errors
 
     def test_llm_mock_udf_pipeline(self):

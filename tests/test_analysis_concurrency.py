@@ -1,0 +1,499 @@
+"""Seeded-bug corpus for the source-level concurrency (PWC4xx) and
+protocol (PWC5xx) passes.
+
+Each test writes a small module with ONE deliberately planted bug from
+the classes the analyzer polices — unguarded write, lock-order cycle,
+blocking call under a lock, unbounded daemon wait, annotation typo,
+commit-hook-before-drain, rollback that never truncates, frame-arity
+drift, missing epoch fence — and asserts the pass finds exactly that
+bug (and nothing else).  Negative twins prove the exemptions
+(``__init__``, ``*_locked``, cv aliasing, waivers, timeouts) hold, and
+the final test pins the real tree to zero errors/warnings so the gate
+in tools/check.py can never rot silently.
+"""
+
+import os
+import textwrap
+
+from pathway_tpu.analysis.findings import Severity
+from pathway_tpu.analysis.source import analyze_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _analyze(tmp_path, source: str, name: str = "mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    report = analyze_paths([str(f)], root=str(tmp_path))
+    assert not report.internal_errors, report.internal_errors
+    return report
+
+
+def _codes(report) -> list[str]:
+    return [f.code for f in report.findings]
+
+
+class TestLockDiscipline:
+    def test_unguarded_assign_pwc401(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: self._lock
+
+                def put_ok(self, x):
+                    with self._lock:
+                        self._items = self._items + [x]
+
+                def put_bad(self, x):
+                    self._items = self._items + [x]
+            """,
+        )
+        assert _codes(report) == ["PWC401"]
+        (f,) = report.findings
+        assert f.severity is Severity.ERROR
+        assert "put_bad" not in f.message  # message names the attr, not fn
+        assert "_items" in f.message and "self._lock" in f.message
+
+    def test_unguarded_mutator_call_pwc401(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: self._lock
+
+                def put(self, x):
+                    self._items.append(x)
+            """,
+        )
+        assert _codes(report) == ["PWC401"]
+        assert "append" in report.findings[0].message
+
+    def test_locked_suffix_methods_are_exempt(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: self._lock
+
+                def _put_locked(self, x):
+                    self._items.append(x)
+
+                def put(self, x):
+                    with self._lock:
+                        self._put_locked(x)
+            """,
+        )
+        assert report.findings == []
+
+    def test_condition_aliases_with_wrapped_lock(self, tmp_path):
+        # holding the Condition satisfies a guard on the inner lock
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self._items = []  # guarded-by: self._lock
+
+                def put(self, x):
+                    with self._cv:
+                        self._items.append(x)
+                        self._cv.notify()
+            """,
+        )
+        assert report.findings == []
+
+    def test_lock_order_cycle_pwc402(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Mesh:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def forward(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def reverse(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """,
+        )
+        assert _codes(report) == ["PWC402"]
+        assert "deadlock" in report.findings[0].message
+
+    def test_lock_order_cycle_through_call_pwc402(self, tmp_path):
+        # the B-side acquisition hides one call level down
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Mesh:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def _bump(self):
+                    with self._b_lock:
+                        pass
+
+                def forward(self):
+                    with self._a_lock:
+                        self._bump()
+
+                def reverse(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+            """,
+        )
+        assert "PWC402" in _codes(report)
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Mesh:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def two(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+            """,
+        )
+        assert report.findings == []
+
+    def test_sleep_under_lock_pwc403(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+            import time
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poke(self):
+                    with self._lock:
+                        time.sleep(0.1)
+            """,
+        )
+        assert _codes(report) == ["PWC403"]
+        assert report.findings[0].severity is Severity.WARNING
+
+    def test_unbounded_queue_get_under_lock_pwc403(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import queue
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def take_bad(self):
+                    with self._lock:
+                        return self._q.get()
+
+                def take_ok(self):
+                    with self._lock:
+                        return self._q.get(timeout=0.5)
+            """,
+        )
+        assert _codes(report) == ["PWC403"]
+
+    def test_wait_on_held_cv_is_exempt_foreign_wait_is_not(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self._done = threading.Event()
+
+                def wait_ok(self):
+                    with self._cv:
+                        self._cv.wait()
+
+                def wait_bad(self):
+                    with self._cv:
+                        self._done.wait()
+            """,
+        )
+        assert _codes(report) == ["PWC403"]
+        assert "_done" in report.findings[0].message
+
+    def test_pwc_ok_waiver_suppresses(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+            import time
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poke(self):
+                    with self._lock:
+                        time.sleep(0.1)  # pwc-ok: PWC403 settle before probe
+            """,
+        )
+        assert report.findings == []
+
+    def test_unbounded_daemon_loop_pwc404(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import queue
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._q = queue.Queue()
+                    self._t = threading.Thread(target=self._run, daemon=True)
+
+                def _run(self):
+                    while True:
+                        item = self._q.get()
+                        del item
+            """,
+        )
+        assert _codes(report) == ["PWC404"]
+        assert "shutdown" in report.findings[0].message
+
+    def test_bounded_daemon_loop_is_clean(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import queue
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._q = queue.Queue()
+                    self._t = threading.Thread(target=self._run, daemon=True)
+
+                def _run(self):
+                    while True:
+                        try:
+                            item = self._q.get(timeout=0.25)
+                        except queue.Empty:
+                            continue
+                        del item
+            """,
+        )
+        assert report.findings == []
+
+    def test_unknown_lock_annotation_pwc405(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0  # guarded-by: self._mu
+            """,
+        )
+        assert _codes(report) == ["PWC405"]
+        assert "_mu" in report.findings[0].message
+
+
+class TestProtocolInvariants:
+    def test_commit_hook_with_no_drain_pwc501(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            class Sched:
+                def commit(self, n):
+                    self.snapshots.on_commit(n)
+            """,
+        )
+        assert _codes(report) == ["PWC501"]
+        assert "no preceding" in report.findings[0].message
+
+    def test_commit_hook_before_drain_pwc501(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            class Sched:
+                def commit(self, n):
+                    publish_on_commit(self, n)
+                    self.pipeline.drain_until(n)
+            """,
+        )
+        assert _codes(report) == ["PWC501"]
+        assert "before the drain" in report.findings[0].message
+
+    def test_drain_then_hook_is_clean(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            class Sched:
+                def commit(self, n):
+                    self.pipeline.drain_until(n)
+                    self.snapshots.on_commit(n)
+                    publish_on_commit(self, n)
+            """,
+        )
+        assert report.findings == []
+
+    def test_rollback_without_truncate_pwc502(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            class Store:
+                def rollback_to(self, commit):
+                    self.current = commit
+            """,
+        )
+        assert _codes(report) == ["PWC502"]
+
+    def test_rollback_reaching_truncate_via_call_is_clean(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            class Store:
+                def _rewind(self, commit):
+                    self.snapshots.truncate(commit)
+
+                def rollback_to(self, commit):
+                    self.current = commit
+                    self._rewind(commit)
+            """,
+        )
+        assert report.findings == []
+
+    def test_frame_arity_drift_pwc503(self, tmp_path):
+        # encoder ships 4 fields, decoder destructures 3
+        report = _analyze(
+            tmp_path,
+            """\
+            def announce(conn, epoch, commit, digest):
+                conn.send(("round", epoch, commit, digest))
+
+            def handle(conn):
+                frame = conn.recv_frame()
+                kind, epoch, commit = frame
+                if kind == "round":
+                    return epoch, commit
+            """,
+        )
+        assert _codes(report) == ["PWC503"]
+        assert "drift" in report.findings[0].message
+
+    def test_decoder_reads_past_encoded_arity_pwc503(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            def announce(conn, epoch):
+                conn.send(("cmd", epoch))
+
+            def handle(conn):
+                frame = conn.recv_frame()
+                if frame[0] == "cmd":
+                    return frame[5]
+            """,
+        )
+        assert _codes(report) == ["PWC503"]
+        assert "[5]" in report.findings[0].message
+
+    def test_agreeing_arity_is_clean(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            def announce(conn, epoch, commit):
+                conn.send(("round", epoch, commit))
+
+            def handle(conn):
+                frame = conn.recv_frame()
+                kind, epoch, commit = frame
+                if kind == "round":
+                    return epoch, commit
+            """,
+        )
+        assert report.findings == []
+
+    def test_missing_epoch_fence_pwc504(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            def handle(frame, fence):
+                if frame[0] == "elect":
+                    return frame[1]
+            """,
+        )
+        assert _codes(report) == ["PWC504"]
+        assert "zombie" in report.findings[0].message
+
+    def test_fenced_dispatch_is_clean(self, tmp_path):
+        report = _analyze(
+            tmp_path,
+            """\
+            def handle(frame, fence):
+                if frame[0] == "elect":
+                    if not fence.admit("elect", frame[1]):
+                        return None
+                    return frame[1]
+            """,
+        )
+        assert report.findings == []
+
+
+class TestRealTree:
+    def test_runtime_source_analyzes_clean(self):
+        """The gate tools/check.py enforces on serving + device_pipeline,
+        widened to the whole package: the tree's own annotations must
+        hold with zero errors AND zero warnings."""
+        report = analyze_paths(
+            [os.path.join(REPO, "pathway_tpu")], root=REPO
+        )
+        assert not report.internal_errors, report.internal_errors
+        assert report.node_count > 20
+        bad = [
+            f.render()
+            for f in report.findings
+            if f.severity in (Severity.ERROR, Severity.WARNING)
+        ]
+        assert bad == [], "\n".join(bad)

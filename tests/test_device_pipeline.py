@@ -235,6 +235,59 @@ class TestPipelineUnit:
         assert dp.ingest_window_scale() == 1.0  # nothing in flight
 
 
+# -- unit: worker shutdown -----------------------------------------------------
+
+
+class TestWorkerShutdown:
+    def test_stop_worker_reaps_daemon(self):
+        dp.PIPELINE._ensure_worker()
+        w = dp.PIPELINE._worker
+        assert w is not None and w.is_alive()
+        dp.PIPELINE.stop_worker()
+        assert not w.is_alive()
+        assert dp.PIPELINE._worker is None
+        # next use respawns a fresh worker
+        dp.PIPELINE._ensure_worker()
+        assert dp.PIPELINE._worker.is_alive()
+        dp.PIPELINE.stop_worker()
+
+    def test_raising_run_leaves_no_leaked_threads(self, monkeypatch):
+        from pathway_tpu.internals.parse_graph import G
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        monkeypatch.setenv("PATHWAY_TPU_SERVING", "1")
+        monkeypatch.setenv("PATHWAY_TPU_SERVING_PORT_BASE", str(port))
+        G.clear()
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(x=int), [(1,), (2,)]
+        )
+
+        def boom(*a, **k):
+            raise RuntimeError("sink boom")
+
+        pw.io.subscribe(t, on_change=boom)
+        # a live completion worker going INTO the raising run: the
+        # teardown in pw.run must reap it along with the serving pool
+        dp.PIPELINE._ensure_worker()
+        with pytest.raises(RuntimeError, match="sink boom"):
+            pw.run(monitoring_level=None)
+
+        def leaked():
+            return [
+                th.name
+                for th in threading.enumerate()
+                if th.is_alive()
+                and th.name.startswith(("pw-device-pipeline", "pw-serving"))
+            ]
+
+        deadline = time.monotonic() + 5.0
+        while leaked() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert leaked() == [], f"daemons survived the run: {leaked()}"
+
+
 # -- unit: adaptive controller -------------------------------------------------
 
 

@@ -4,8 +4,10 @@ histograms, leader-aggregated /metrics, flight recorder, shutdown hygiene
 
 from __future__ import annotations
 
+import ast
 import json
 import os
+import re
 import socket
 import sys
 import textwrap
@@ -602,3 +604,109 @@ class TestNativeKernelTimers:
         native.reset_hit_counts()
         assert sum(native.hit_counts().values()) == 0
         assert sum(native.kernel_ns().values()) == 0
+
+
+# -- registry conformance over the whole tree ---------------------------------
+
+
+class TestRegistryConformance:
+    """Property test over the SOURCE tree: every `pathway_*` family any
+    module registers must have exactly one kind, an OpenMetrics-safe
+    name, and a help string at its first registration site."""
+
+    _KINDS = ("counter", "gauge", "histogram")
+    _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+    def _instrument_calls(self):
+        root = os.path.join(REPO, "pathway_tpu")
+        out = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                with open(path, encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=path)
+                for node in ast.walk(tree):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self._KINDS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.startswith("pathway_")
+                    ):
+                        continue
+                    has_help = (
+                        len(node.args) > 1
+                        and isinstance(node.args[1], ast.Constant)
+                        and bool(node.args[1].value)
+                    ) or any(
+                        kw.arg == "help"
+                        and isinstance(kw.value, ast.Constant)
+                        and bool(kw.value.value)
+                        for kw in node.keywords
+                    )
+                    out.append(
+                        (
+                            node.args[0].value,
+                            node.func.attr,
+                            os.path.relpath(path, REPO),
+                            node.lineno,
+                            has_help,
+                        )
+                    )
+        return out
+
+    def test_every_family_has_exactly_one_kind(self):
+        calls = self._instrument_calls()
+        assert len(calls) >= 10, "AST scan found too few instrument sites"
+        kinds: dict = {}
+        for name, kind, path, lineno, _help in calls:
+            kinds.setdefault(name, {}).setdefault(kind, []).append(
+                f"{path}:{lineno}"
+            )
+        conflicts = {
+            name: sites for name, sites in kinds.items() if len(sites) > 1
+        }
+        assert not conflicts, (
+            f"metric families registered under multiple kinds: {conflicts}"
+        )
+
+    def test_every_family_name_is_openmetrics_safe(self):
+        for name, _kind, path, lineno, _help in self._instrument_calls():
+            assert self._NAME_RE.match(name), f"{path}:{lineno}: {name!r}"
+            assert not name.endswith(("_bucket", "_sum", "_count")), (
+                f"{path}:{lineno}: {name!r} collides with histogram "
+                "sample suffixes"
+            )
+
+    def test_every_family_renders_valid_exposition(self):
+        calls = self._instrument_calls()
+        reg = _metrics.Registry()
+        made: set = set()
+        for name, kind, _path, _lineno, _help in calls:
+            if name in made:
+                continue
+            made.add(name)
+            handle = getattr(reg, kind)(name, "conformance probe")
+            if kind == "counter":
+                handle.inc()
+            elif kind == "gauge":
+                handle.set(1.0)
+            else:
+                handle.observe(0.5)
+        text = _metrics.render_snapshots({"": reg.snapshot()})
+        families = _metrics.validate_exposition(text)
+        assert set(families) == made
+
+    def test_at_least_one_site_passes_help(self):
+        by_name: dict = {}
+        for name, _kind, _path, _lineno, has_help in self._instrument_calls():
+            by_name[name] = by_name.get(name, False) or has_help
+        missing = sorted(n for n, ok in by_name.items() if not ok)
+        assert not missing, (
+            f"families never registered with a help string: {missing}"
+        )

@@ -11,6 +11,10 @@ problem):
 2. analyzer self-run — ``python -m pathway_tpu.cli analyze
    bench_dataflow.py`` must exit 0 (no warning/error findings on our own
    pipelines);
+2b. source lint self-run — ``python -m pathway_tpu.cli analyze --source
+   --strict pathway_tpu/serving pathway_tpu/engine/device_pipeline.py``
+   must exit 0: the lock-discipline (PWC4xx) and protocol (PWC5xx)
+   passes find nothing on the runtime's own threaded modules;
 3. optimize-off parity — the optimizer parity + engine-core suites rerun
    with ``PATHWAY_TPU_OPTIMIZE=0`` (the graph rewriter's escape hatch);
 4. async-device parity — the device-pipeline suite rerun with
@@ -45,17 +49,30 @@ problem):
 12. trace export — a small traced program runs end-to-end and the
    exported file must satisfy the Chrome trace-event schema invariants
    (complete X / matched B-E events, monotonic timestamps per track);
-13. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
+13. lockwatch overhead — the metrics-overhead leg rerun in a
+   subprocess with ``PATHWAY_TPU_LOCKWATCH=1`` (every Lock/RLock
+   wrapped by the runtime lock-order recorder) vs a plain subprocess;
+   FAILs when the lock-heavy ``metrics_on`` timing degrades more than
+   5%, or when the watched run records any lock-order cycle;
+14. chaos gate — three fixed FaultPlan seeds over a real 3-process TCP
    mesh with operator persistence: a follower SIGKILL (supervised
    restart + rollback), a LEADER SIGKILL (epoch-fenced election
    failover), and a SIGKILL injected while a live N→M rescale is
    quiescing; every leg must land the exact fault-free sink, within a
-   bounded wall budget;
-12. sanitized native build — recompile ``native/enginecore.cpp`` with
+   bounded wall budget.  The whole gate runs under
+   ``PATHWAY_TPU_LOCKWATCH=1``: any lock-order cycle recorded by any
+   process in the mesh (``pathway_lockwatch_cycle_*.json``) is a FAIL
+   even when the sinks are bit-identical;
+15. sanitized native build — recompile ``native/enginecore.cpp`` with
    ``-fsanitize=address,undefined`` and run
    ``tests/test_native_parity.py`` against the instrumented module
    (``PATHWAY_TPU_NATIVE_SO``), with the sanitizer runtimes LD_PRELOADed
-   under the Python interpreter.  Any sanitizer report fails the gate.
+   under the Python interpreter.  Any sanitizer report fails the gate;
+16. tsan native build — the same parity suite against a
+   ``-fsanitize=thread`` rebuild with ``libtsan`` LD_PRELOADed (a probe
+   first proves the runtime is usable under the uninstrumented
+   interpreter, else SKIP).  Any ``WARNING: ThreadSanitizer`` report —
+   data race, lock-order inversion, thread leak — fails the gate.
 
 Exit code 0 = every non-skipped step passed.
 """
@@ -116,6 +133,44 @@ def step_analyzer() -> str:
     status = PASS if proc.returncode == 0 else FAIL
     _report(
         "static analyzer self-run (cli analyze bench_dataflow.py)",
+        status,
+        f"exit code {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+#: the runtime's own threaded modules, linted by the concurrency
+#: (PWC4xx) and protocol (PWC5xx) passes on every check run — README's
+#: "tools/check.py runs exactly this command" points here
+SOURCE_LINT_TARGETS = [
+    "pathway_tpu/serving",
+    "pathway_tpu/engine/device_pipeline.py",
+]
+
+
+def step_source_lint() -> str:
+    """Concurrency/protocol lint self-run: the lock-discipline pass
+    (guarded-by writes, lock-order cycles, blocking calls under locks)
+    and the protocol pass (drain-before-hook, rollback/truncate
+    reachability, frame arity, epoch fences) must find NOTHING — not
+    even info — on the runtime's own threaded modules."""
+    name = "source lint (cli analyze --source --strict, serving + pipeline)"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu.cli",
+            "analyze",
+            "--source",
+            "--strict",
+            *SOURCE_LINT_TARGETS,
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        name,
         status,
         f"exit code {proc.returncode}" if status == FAIL else "",
     )
@@ -334,16 +389,18 @@ def _sanitizer_runtime(gpp: str, name: str) -> str | None:
     return None
 
 
-def build_sanitized_so(out_dir: str) -> str | None:
-    """Compile enginecore.cpp with ASan+UBSan; None when the toolchain
-    can't do it (missing compiler or sanitizer libs)."""
+def _build_instrumented_so(
+    out_dir: str, sanitize_flags: list[str], out_name: str
+) -> str | None:
+    """Compile enginecore.cpp with the given -fsanitize flags; None when
+    the toolchain can't do it (missing compiler or sanitizer libs)."""
     gpp = shutil.which("g++")
     if gpp is None:
         return None
     import numpy as np
 
     src = os.path.join(REPO, "pathway_tpu", "native", "enginecore.cpp")
-    so = os.path.join(out_dir, "_enginecore_sanitized.so")
+    so = os.path.join(out_dir, out_name)
     cmd = [
         gpp,
         "-O1",
@@ -351,8 +408,7 @@ def build_sanitized_so(out_dir: str) -> str | None:
         "-std=c++17",
         "-shared",
         "-fPIC",
-        "-fsanitize=address,undefined",
-        "-fno-sanitize-recover=all",
+        *sanitize_flags,
         f"-I{sysconfig.get_path('include')}",
         f"-I{np.get_include()}",
         src,
@@ -364,6 +420,23 @@ def build_sanitized_so(out_dir: str) -> str | None:
         print(proc.stderr[-2000:], file=sys.stderr)
         return None
     return so
+
+
+def build_sanitized_so(out_dir: str) -> str | None:
+    """Compile enginecore.cpp with ASan+UBSan; None when the toolchain
+    can't do it (missing compiler or sanitizer libs)."""
+    return _build_instrumented_so(
+        out_dir,
+        ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"],
+        "_enginecore_sanitized.so",
+    )
+
+
+def build_tsan_so(out_dir: str) -> str | None:
+    """Compile enginecore.cpp with ThreadSanitizer instrumentation."""
+    return _build_instrumented_so(
+        out_dir, ["-fsanitize=thread"], "_enginecore_tsan.so"
+    )
 
 
 def step_sanitized_native() -> str:
@@ -423,6 +496,88 @@ def step_sanitized_native() -> str:
                 name,
                 FAIL,
                 "sanitizer report" if sanitizer_hit else
+                f"pytest exit {proc.returncode}",
+            )
+            return FAIL
+    _report(name, PASS)
+    return PASS
+
+
+def step_tsan_native() -> str:
+    """ThreadSanitizer leg of the sanitized-native gate: rebuild
+    enginecore.cpp with -fsanitize=thread and run the parity suite —
+    the one place Python worker threads and the C++ kernels touch the
+    same buffers — under a preloaded libtsan.  TSan under an
+    uninstrumented interpreter is fragile, so a one-liner threading
+    probe decides SKIP vs run; once running, any ``WARNING:
+    ThreadSanitizer`` (data race, lock-order inversion, thread leak)
+    fails the gate."""
+    name = "tsan native build + parity tests"
+    gpp = shutil.which("g++")
+    if gpp is None:
+        _report(name, SKIP, "no g++ toolchain")
+        return SKIP
+    libtsan = _sanitizer_runtime(gpp, "libtsan.so")
+    if libtsan is None:
+        _report(name, SKIP, "libtsan not available to g++")
+        return SKIP
+    tsan_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # the interpreter itself is not TSan-instrumented: preload the
+        # runtime and let reports surface without killing the process,
+        # so one run collects every race instead of the first
+        "LD_PRELOAD": libtsan,
+        "TSAN_OPTIONS": "halt_on_error=0:report_bugs=1:exitcode=66",
+    }
+    probe = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import threading\n"
+            "t = threading.Thread(target=lambda: None)\n"
+            "t.start(); t.join()\n"
+            "print('TSAN_PROBE_OK')",
+        ],
+        env=tsan_env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    if probe.returncode != 0 or "TSAN_PROBE_OK" not in probe.stdout:
+        _report(name, SKIP, "tsan runtime unusable under this interpreter")
+        return SKIP
+    with tempfile.TemporaryDirectory(prefix="pathway-tsan-") as tmp:
+        so = build_tsan_so(tmp)
+        if so is None:
+            _report(name, SKIP, "tsan compile failed (toolchain)")
+            return SKIP
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytest",
+                "tests/test_native_parity.py",
+                "-q",
+                "-p",
+                "no:cacheprovider",
+            ],
+            cwd=REPO,
+            env={**tsan_env, "PATHWAY_TPU_NATIVE_SO": so},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        output = proc.stdout + proc.stderr
+        sys.stdout.write(proc.stdout[-4000:])
+        tsan_hit = "WARNING: ThreadSanitizer" in output
+        if proc.returncode != 0 or tsan_hit:
+            if tsan_hit:
+                sys.stderr.write(output[-4000:])
+            _report(
+                name,
+                FAIL,
+                "tsan report" if tsan_hit else
                 f"pytest exit {proc.returncode}",
             )
             return FAIL
@@ -716,6 +871,88 @@ def step_serving_overhead() -> str:
     return status
 
 
+def _metrics_on_seconds(extra_env: dict[str, str]) -> tuple[float | None, str]:
+    """Run the metrics-overhead leg in a subprocess and return its
+    lock-heavy ``metrics_on_s`` timing (best-of-3 inside the leg)."""
+    import json
+
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('METRICS_OVERHEAD_JSON ' + json.dumps("
+        "b.metrics_overhead_leg()()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **extra_env},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.SubprocessError as e:
+        return None, f"bench leg did not finish: {e}"
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("METRICS_OVERHEAD_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        return None, f"bench leg exit {proc.returncode}"
+    return payload["metrics_on_s"], ""
+
+
+def _lockwatch_overhead_once(tmp: str) -> tuple[float | None, str]:
+    t_off, detail = _metrics_on_seconds({})
+    if t_off is None:
+        return None, detail
+    t_on, detail = _metrics_on_seconds(
+        {"PATHWAY_TPU_LOCKWATCH": "1", "PATHWAY_TPU_LOCKWATCH_DIR": tmp}
+    )
+    if t_on is None:
+        return None, detail
+    overhead = (t_on - t_off) / t_off * 100.0
+    return overhead, (
+        f"{overhead:+.2f}% "
+        f"(plain {t_off}s, watched {t_on}s, metrics_on timing)"
+    )
+
+
+def step_lockwatch_overhead() -> str:
+    """Gate the lock-order recorder's tax: the metrics-overhead leg —
+    the most lock-acquisition-dense workload in the bench — rerun in a
+    subprocess with PATHWAY_TPU_LOCKWATCH=1 (so install precedes every
+    runtime lock's creation) vs a plain subprocess.  >5% slowdown of
+    the lock-heavy ``metrics_on`` timing is a FAIL, as is any
+    lock-order cycle the watched run records.  One retry absorbs
+    scheduler noise — two consecutive >5% readings are signal."""
+    name = "lockwatch overhead (metrics leg, PATHWAY_TPU_LOCKWATCH=1 vs off)"
+    with tempfile.TemporaryDirectory(prefix="pathway-lockwatch-") as tmp:
+        overhead, detail = _lockwatch_overhead_once(tmp)
+        if overhead is not None and overhead > 5.0:
+            overhead, detail = _lockwatch_overhead_once(tmp)
+            detail += " [retried]"
+        cycles = _lockwatch_cycle_reports(tmp)
+        if cycles:
+            _report(name, FAIL, f"lock-order cycle(s) recorded: {cycles}")
+            return FAIL
+    if overhead is None:
+        _report(name, FAIL, detail)
+        return FAIL
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
+    return status
+
+
+def _lockwatch_cycle_reports(tmp: str) -> list[str]:
+    """Cycle-report files written by any watched process under tmp."""
+    return sorted(
+        f
+        for f in os.listdir(tmp)
+        if f.startswith("pathway_lockwatch_cycle_") and f.endswith(".json")
+    )
+
+
 #: the chaos gate's three fixed-seed legs — one follower kill (seed 7),
 #: one LEADER kill exercising election + epoch fencing (seed 13), and one
 #: kill racing a live rescale's quiesce (seed 26).  All three share one
@@ -738,29 +975,49 @@ def step_chaos_gate() -> str:
     real 3-process TCP mesh with operator persistence — follower kill +
     supervised recovery, leader kill + election failover, and a kill
     injected while a live rescale is quiescing.  Every leg must land the
-    exact fault-free sink."""
-    name = "chaos gate (3 fixed seeds: kill / leader-kill / rescale+kill)"
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-m",
-                "pytest",
-                *CHAOS_GATE_NODES,
-                "-q",
-                "-p",
-                "no:cacheprovider",
-            ],
-            cwd=REPO,
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=CHAOS_GATE_BUDGET_S,
+    exact fault-free sink.  The whole gate runs under
+    PATHWAY_TPU_LOCKWATCH=1 so every process in every mesh (leader,
+    workers, supervised restarts) records its lock-acquisition order;
+    any recorded lock-order cycle is a FAIL even when the sinks are
+    bit-identical — deadlocks hide behind green tests until the
+    interleaving goes wrong in production."""
+    name = "chaos gate (3 fixed seeds + lockwatch: kill / leader / rescale)"
+    with tempfile.TemporaryDirectory(prefix="pathway-chaos-lw-") as tmp:
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PATHWAY_TPU_LOCKWATCH="1",
+            PATHWAY_TPU_LOCKWATCH_DIR=tmp,
         )
-    except subprocess.TimeoutExpired:
-        _report(name, FAIL, f"wall budget ({CHAOS_GATE_BUDGET_S}s) exceeded")
-        return FAIL
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "pytest",
+                    *CHAOS_GATE_NODES,
+                    "-q",
+                    "-p",
+                    "no:cacheprovider",
+                ],
+                cwd=REPO,
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=CHAOS_GATE_BUDGET_S,
+            )
+        except subprocess.TimeoutExpired:
+            _report(
+                name, FAIL, f"wall budget ({CHAOS_GATE_BUDGET_S}s) exceeded"
+            )
+            return FAIL
+        cycles = _lockwatch_cycle_reports(tmp)
+        if cycles:
+            for f in cycles:
+                with open(os.path.join(tmp, f)) as fh:
+                    sys.stderr.write(fh.read()[-2000:])
+            _report(name, FAIL, f"lock-order cycle(s) recorded: {cycles}")
+            return FAIL
     if proc.returncode != 0:
         sys.stdout.write((proc.stdout + proc.stderr)[-4000:])
         _report(name, FAIL, f"pytest exit {proc.returncode}")
@@ -781,6 +1038,7 @@ def main(argv=None) -> int:
     results = [
         step_ruff(),
         step_analyzer(),
+        step_source_lint(),
         step_optimize_off(),
         step_async_parity(),
         step_metrics_overhead(),
@@ -791,13 +1049,16 @@ def main(argv=None) -> int:
         step_serving_parity(),
         step_serving_overhead(),
         step_trace_export(),
+        step_lockwatch_overhead(),
         step_chaos_gate(),
     ]
     if args.skip_sanitized:
         _report("sanitized native build + parity tests", SKIP, "--skip-sanitized")
-        results.append(SKIP)
+        _report("tsan native build + parity tests", SKIP, "--skip-sanitized")
+        results.extend([SKIP, SKIP])
     else:
         results.append(step_sanitized_native())
+        results.append(step_tsan_native())
 
     failed = results.count(FAIL)
     print(
