@@ -88,6 +88,47 @@ def _emit_partial(label: str, value) -> None:
     _PARTIAL[label] = value
 
 
+def _emit_truncated(error: str) -> None:
+    """One final, valid JSON line carrying every completed leg and a
+    structured ``truncated: true`` marker — shared by the wall-budget
+    watchdog and the SIGTERM flush so a killed bench always parses
+    (the BENCH_r05 rc=124/zero-output failure mode, eliminated)."""
+    print(
+        json.dumps(
+            {
+                "metric": "streaming_rag_pipeline_docs_per_sec",
+                "value": None,
+                "unit": "docs/sec",
+                "vs_baseline": None,
+                "error": error,
+                "truncated": True,
+                "extra": dict(_PARTIAL),
+            }
+        ),
+        flush=True,
+    )
+
+
+def _install_sigterm_flush() -> None:
+    """SIGTERM (harness timeout, container stop) flushes the completed
+    legs before dying: the collector reads ``truncated: true`` plus
+    every measured number instead of a silent rc=143."""
+    import signal
+
+    def on_term(signum: int, frame) -> None:
+        _emit_truncated(
+            "SIGTERM received before the run completed"
+        )
+        os._exit(3)
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except (ValueError, OSError):
+        # not the main thread / exotic platform: the wall-budget
+        # watchdog still bounds the no-output window
+        pass
+
+
 def _install_budget_watchdog() -> None:
     """Daemon that force-emits the outage JSON at the wall deadline and
     exits 3 — the bench may produce incomplete data, never no data."""
@@ -100,22 +141,10 @@ def _install_budget_watchdog() -> None:
             if remaining <= 0:
                 break
             time.sleep(min(remaining, 5.0))
-        print(
-            json.dumps(
-                {
-                    "metric": "streaming_rag_pipeline_docs_per_sec",
-                    "value": None,
-                    "unit": "docs/sec",
-                    "vs_baseline": None,
-                    "error": (
-                        f"wall budget exhausted: BENCH_WALL_BUDGET_S="
-                        f"{WALL_BUDGET_S:.0f}s elapsed before the run "
-                        "completed"
-                    ),
-                    "extra": dict(_PARTIAL),
-                }
-            ),
-            flush=True,
+        _emit_truncated(
+            f"wall budget exhausted: BENCH_WALL_BUDGET_S="
+            f"{WALL_BUDGET_S:.0f}s elapsed before the run "
+            "completed"
         )
         os._exit(3)
 
@@ -143,7 +172,7 @@ def _install_budget_watchdog() -> None:
         "'error':'wall budget exhausted: BENCH_WALL_BUDGET_S='+budget+'s "
         "passed with the process wedged in a non-Python hang (GIL held "
         "through a C call); killed by the sentinel process',"
-        "'extra':{}}),flush=True)\n"
+        "'truncated':True,'extra':{}}),flush=True)\n"
         "try: os.kill(ppid,signal.SIGKILL)\n"
         "except ProcessLookupError: pass\n"
     )
@@ -1691,6 +1720,7 @@ def _leg_budget(name: str, default: float) -> float:
 
 
 def main() -> None:
+    _install_sigterm_flush()
     _install_budget_watchdog()
     _probe_device_retrying()
     leg_timeout = float(os.environ.get("BENCH_LEG_TIMEOUT_S", "1200"))
@@ -1735,6 +1765,16 @@ def main() -> None:
                 stuck.append(worker)
             if not _device_alive(60.0):
                 alive[0] = False
+        elif result is not None:
+            # flush the finished leg immediately: a later SIGTERM or
+            # wall-budget kill replays _PARTIAL in its truncated line,
+            # so this number survives whatever happens next
+            _emit_partial(
+                name,
+                {k: v for k, v in result.items() if not k.startswith("_")}
+                if isinstance(result, dict)
+                else result,
+            )
         return result
 
     def skipped(flag: str) -> bool:
@@ -1820,6 +1860,7 @@ def main() -> None:
                     stuck.append(worker)
             else:
                 stats["serving_plane"] = result
+                _emit_partial("serving_plane", result)
     # host dataflow workloads (wordcount/join/groupby/filter at 1M rows
     # + incremental phase) tracked in the same JSON line every round;
     # needs no device, so it runs last regardless of tunnel state (and

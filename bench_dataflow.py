@@ -26,7 +26,10 @@ from pathway_tpu.engine import (
 )
 from pathway_tpu.engine import expression as ex
 
-N = 1_000_000
+#: row count per workload; BENCH_DATAFLOW_ROWS overrides for quick
+#: local passes and for tests that need the suite to run long (the
+#: bench-kill regression pins a huge count to hold a leg mid-flight)
+N = int(os.environ.get("BENCH_DATAFLOW_ROWS", str(1_000_000)))
 
 
 def _analyze_only() -> bool:
@@ -481,6 +484,96 @@ def trace_overhead_leg():
             "trace_off_s": round(t_off, 4),
             "trace_on_s": round(t_on, 4),
             "sample_interval": _tracing.TRACER.base_interval,
+            "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
+        }
+        return out
+
+    return leg
+
+
+def profile_overhead_leg():
+    """The fused_chain workload with the sampling profiler's daemon
+    thread running at the default rate (PATHWAY_TPU_PROFILE_HZ=50) vs.
+    off entirely — the workload itself is untouched either way (the
+    sampler reads ``sys._current_frames()`` from its own thread), so
+    the measured delta is exactly what PATHWAY_TPU_PROFILE=1 steals
+    from a live run via GIL contention.  tools/check.py FAILs when the
+    overhead exceeds 5%, the same gate as metrics/trace_overhead; the
+    adaptive back-off inside the sampler targets <=2% amortized."""
+    n_stages = 8
+    n_base, n_commits, delta = 20_000, 60, 1000
+    if _analyze_only():
+        n_base, n_commits = 5_000, 1
+    rows = [(ref_scalar(i), (i, float(i) * 0.5)) for i in range(n_base)]
+
+    def once(profile_on: bool) -> float:
+        from pathway_tpu.internals import profiling as _profiling
+
+        scope = Scope()
+        sess = scope.input_session(2)
+        cur = scope.expression_table(
+            sess,
+            [
+                ex.ColumnRef(0),
+                ex.ColumnRef(1),
+                ex.Binary(">", ex.ColumnRef(0), ex.Const(100)),
+            ],
+        )
+        cur = scope.filter_table(cur, 2)
+        for _ in range(n_stages):
+            cur = scope.expression_table(
+                cur,
+                [
+                    ex.ColumnRef(0),
+                    ex.Binary(
+                        "+",
+                        ex.Binary(
+                            "*", ex.ColumnRef(1), ex.Const(1.0000001)
+                        ),
+                        ex.Const(0.5),
+                    ),
+                ],
+            )
+        sched = Scheduler(scope, probe=False)
+        # default rate, fresh aggregation per run; the off path leaves
+        # the profiler disabled so maybe_start() is one boolean test
+        _profiling.PROFILER.configure(enabled=profile_on, clear=True)
+        started = _profiling.PROFILER.maybe_start()
+        try:
+            for key, row in rows:
+                sess.insert(key, row)
+            sched.commit()
+            if _analyze_only():
+                return 1.0
+            t = 0.0
+            for c in range(n_commits):
+                base = (c * delta) % (n_base - delta)
+                for i in range(base, base + delta):
+                    key, row = rows[i]
+                    sess.remove(key, row)
+                    sess.insert(key, (row[0], row[1] + 1.0))
+                t += timed(sched.commit)
+            return t
+        finally:
+            if started:
+                _profiling.PROFILER.stop()
+            _profiling.PROFILER.configure(enabled=False, clear=True)
+
+    def leg() -> dict:
+        from pathway_tpu.internals import profiling as _profiling
+
+        # interleaved off/on pairs: machine drift during the measurement
+        # lands on both sides instead of biasing whichever ran last
+        t_off = min(once(False) for _ in range(1))
+        t_on = min(once(True) for _ in range(1))
+        for _ in range(3):
+            t_off = min(t_off, once(False))
+            t_on = min(t_on, once(True))
+        out = {
+            "rows": n_commits * 2 * delta,
+            "profile_off_s": round(t_off, 4),
+            "profile_on_s": round(t_on, 4),
+            "rate_hz": round(1.0 / _profiling.PROFILER.base_period, 1),
             "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
         }
         return out
@@ -1317,6 +1410,8 @@ def run_all(emit=None) -> dict:
     record("metrics_overhead", metrics_overhead_leg()())
     # tracing tax: sampled span recording at the default interval vs off
     record("trace_overhead", trace_overhead_leg()())
+    # profiling tax: the daemon stack sampler at its default rate vs off
+    record("profile_overhead", profile_overhead_leg()())
     # async device pipeline tax: staging/completion machinery with a
     # synchronous fake device vs the inline decay path
     record("async_device_overhead", async_device_overhead_leg()())
@@ -1426,6 +1521,7 @@ def main() -> None:
         ("pushdown_wide_source", pushdown_wide_source),
         ("metrics_overhead", metrics_overhead_leg),
         ("trace_overhead", trace_overhead_leg),
+        ("profile_overhead", profile_overhead_leg),
         ("async_device_overhead", async_device_overhead_leg),
         ("device_ops", device_ops_leg),
         ("device_ops_overhead", device_ops_overhead_leg),

@@ -24,7 +24,14 @@ snapshots, and relaunches — sink output stays bit-identical.
 ``python -m pathway_tpu.cli stats <port|host:port|url>`` scrapes a live
 monitoring endpoint (pw.run with_http_server=True; port
 20000 + process_id) and pretty-prints the mesh-wide per-worker table plus
-per-family totals. ``--raw`` dumps the exposition text untouched.
+per-family totals. ``--raw`` dumps the exposition text untouched;
+``--watch N`` re-scrapes every N seconds with /timeseries sparklines.
+
+``python -m pathway_tpu.cli profile <port|dir|file>`` merges, validates
+(validate_profile), and renders sampling-profiler output — a live
+``/profile`` endpoint, a PATHWAY_TPU_PROFILE_DIR of per-process
+exports, or one export file; ``--json`` emits speedscope JSON,
+``--folded`` collapsed-stack text.
 """
 
 from __future__ import annotations
@@ -221,12 +228,18 @@ def _stats_url(target: str) -> str:
 
 def _hist_quantile(buckets: list, q: float) -> float | None:
     """Quantile from cumulative (upper_bound, count) pairs, interpolating
-    linearly within the bucket (the usual Prometheus histogram_quantile)."""
+    linearly within the bucket (the usual Prometheus histogram_quantile).
+
+    Returns None — not a fabricated 0.0 — when the histogram carries no
+    information: zero observations, or every observation in a lone +Inf
+    bucket (no finite bound to anchor an estimate)."""
     if not buckets:
         return None
     total = buckets[-1][1]
     if total <= 0:
         return None
+    if not any(ub != float("inf") for ub, _ in buckets):
+        return None  # only a +Inf bucket: no finite bound to report
     rank = q * total
     lo_bound, lo_count = 0.0, 0.0
     for ub, c in buckets:
@@ -241,13 +254,114 @@ def _hist_quantile(buckets: list, q: float) -> float | None:
     return buckets[-1][0]
 
 
-def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
+def stats(
+    target: str,
+    *,
+    raw: bool = False,
+    timeout: float = 5.0,
+    watch: float | None = None,
+) -> int:
     """Scrape a monitoring endpoint and pretty-print the mesh-wide table.
 
     On a mesh leader the exposition carries every worker's piggybacked
     snapshot under ``worker="<process_id>"`` labels, so one scrape shows
     the whole cluster; rows without a worker label (the legacy local
-    series) print as ``(local)``."""
+    series) print as ``(local)``.  ``--watch N`` re-scrapes every N
+    seconds (clearing the screen) and adds history sparklines read off
+    the endpoint's ``/timeseries`` ring."""
+    if watch:
+        import time as _time_mod
+
+        try:
+            while True:
+                sys.stdout.write("\x1b[2J\x1b[H")
+                rc = _stats_once(target, raw=raw, timeout=timeout)
+                if rc == 0 and not raw:
+                    _print_sparklines(target, timeout=timeout)
+                sys.stdout.flush()
+                _time_mod.sleep(watch)
+        except KeyboardInterrupt:
+            return 0
+    return _stats_once(target, raw=raw, timeout=timeout)
+
+
+#: eight-level bar for terminal sparklines (history off /timeseries)
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: Sequence[float], width: int = 48) -> str:
+    vals = list(values)[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_CHARS[0] * len(vals)
+    return "".join(
+        _SPARK_CHARS[min(7, int((v - lo) / span * 8))] for v in vals
+    )
+
+
+#: families worth a sparkline row in ``stats --watch``, most
+#: operationally interesting first (missing ones are skipped)
+_WATCH_FAMILIES = (
+    "pathway_device_queue_depth",
+    "pathway_ingest_to_sink_latency_seconds",
+    "pathway_serving_latency_seconds",
+    "pathway_slo_burn_ratio",
+    "pathway_commits_total",
+    "pathway_profile_samples_total",
+)
+
+
+def _print_sparklines(
+    target: str, *, timeout: float = 5.0, window_s: float = 120.0
+) -> None:
+    """Best-effort trend rows under the ``--watch`` table: windowed
+    reads off the endpoint's ``/timeseries`` ring, one sparkline per
+    series (capped).  A run without the history ring just shows none."""
+    import urllib.request
+    from urllib.parse import urlsplit, urlunsplit
+
+    parts = urlsplit(_stats_url(target))
+    base = urlunsplit((parts[0], parts[1], "/timeseries", "", ""))
+    lines = []
+    try:
+        for family in _WATCH_FAMILIES:
+            url = f"{base}?family={family}&window={window_s:g}"
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                result = json.loads(resp.read().decode())
+            for series in result.get("series", [])[:4]:
+                pts = series.get("points") or []
+                if len(pts) < 2:
+                    continue
+                labels = series.get("labels") or {}
+                tag = ",".join(
+                    f"{k}={v}" for k, v in sorted(labels.items())
+                )
+                last = pts[-1][1]
+                last_s = (
+                    f"{last:.0f}" if float(last).is_integer()
+                    else f"{last:.4g}"
+                )
+                lines.append(
+                    f"  {family}{{{tag}}}"
+                    f"  {_sparkline([p[1] for p in pts])}  {last_s}"
+                )
+            if len(lines) >= 12:
+                break
+    except Exception:  # noqa: BLE001 — trends are advisory, never fatal
+        return
+    if lines:
+        print()
+        print(f"trends (last {window_s:g}s):")
+        for line in lines:
+            print(line)
+
+
+def _stats_once(
+    target: str, *, raw: bool = False, timeout: float = 5.0
+) -> int:
     import urllib.request
 
     url = _stats_url(target)
@@ -287,6 +401,11 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
     srv_stale: dict[str, float] = {}
     srv_seq: dict[str, float] = {}
     srv_uptime: dict[str, float] = {}
+    # continuous sampling profiler: per-worker sample counts / adaptive
+    # rate / per-tick cost histogram (internals/profiling.py)
+    prof_samples: dict[str, float] = {}
+    prof_rate: dict[str, float] = {}
+    prof_cost: dict[str, list] = {}
 
     def add(worker: str, col: str, value: float) -> None:
         sums.setdefault(worker, {})[col] = (
@@ -344,6 +463,17 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
                 srv_seq[w] = value
             elif fam_name == "pathway_serving_uptime_seconds":
                 srv_uptime[w] = value
+            elif fam_name == "pathway_profile_samples_total":
+                prof_samples[w] = prof_samples.get(w, 0.0) + value
+            elif fam_name == "pathway_profile_rate_hz":
+                prof_rate[w] = value
+            elif (
+                fam_name == "pathway_profile_sample_seconds"
+                and name.endswith("_bucket")
+            ):
+                le = labels["le"]
+                ub = float("inf") if le in ("+Inf", "inf") else float(le)
+                prof_cost.setdefault(w, []).append((ub, value))
     for w, buckets in lat.items():
         buckets.sort()
         sums.setdefault(w, {})
@@ -434,6 +564,27 @@ def stats(target: str, *, raw: bool = False, timeout: float = 5.0) -> int:
                 f"  shed={srv_shed.get(w, 0.0):.0f}"
                 f"  snapshot_seq={srv_seq.get(w, 0.0):.0f}"
                 + (f"  staleness_s={stale:.3f}" if stale is not None else "")
+            )
+
+    # -- sampling profiler ---------------------------------------------------
+    if prof_samples:
+        print()
+        print("profiler:")
+        for w in sorted(prof_samples, key=lambda k: (k != "", k)):
+            buckets = sorted(prof_cost.get(w, []))
+            quants = []
+            for q in (0.50, 0.95, 0.99):
+                qv = _hist_quantile(buckets, q) if buckets else None
+                quants.append(
+                    f"{qv * 1e6:.0f}" if qv is not None else "-"
+                )
+            rate = prof_rate.get(w)
+            rate_s = f"{rate:.1f}" if rate is not None else "-"
+            print(
+                f"  {(w or '(local)'):<10}"
+                f"  samples={prof_samples[w]:.0f}  rate_hz={rate_s}"
+                f"  tick_us: p50={quants[0]}"
+                f"  p95={quants[1]}  p99={quants[2]}"
             )
 
     # -- per-family totals ---------------------------------------------------
@@ -590,6 +741,139 @@ def trace(target: str, *, as_json: bool = False) -> int:
     return rc
 
 
+def _load_profile_document(target: str, timeout: float) -> dict:
+    """Resolve ``cli profile``'s target into one merged document: a
+    live endpoint (port / host:port / URL — fetched from ``/profile``),
+    a directory of ``pathway_profile_*.json`` exports (merged, latest
+    ``seq`` per worker wins), or a single export file.  Raises
+    ValueError with a printable message on any failure."""
+    import glob as _glob
+
+    from pathway_tpu.internals import profiling as _profiling
+
+    looks_remote = (
+        target.isdigit()
+        or "://" in target
+        or (":" in target and not os.path.exists(target))
+    )
+    if looks_remote:
+        import urllib.request
+        from urllib.parse import urlsplit, urlunsplit
+
+        parts = urlsplit(_stats_url(target))
+        url = urlunsplit((parts[0], parts[1], "/profile", "", ""))
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())
+        except Exception as exc:  # noqa: BLE001 — any fetch failure
+            raise ValueError(f"fetching {url} failed: {exc}") from exc
+    if os.path.isdir(target):
+        paths = sorted(
+            _glob.glob(os.path.join(target, "pathway_profile_*.json"))
+        )
+        if not paths:
+            raise ValueError(
+                f"no pathway_profile_*.json files in {target} "
+                "(PATHWAY_TPU_PROFILE_DIR of a profiled run)"
+            )
+        docs = []
+        for path in paths:
+            try:
+                with open(path) as fh:
+                    docs.append(json.load(fh))
+            except (OSError, ValueError) as exc:
+                raise ValueError(f"{path}: unreadable — {exc}") from exc
+        return _profiling.merge_documents(docs)
+    if os.path.exists(target):
+        try:
+            with open(target) as fh:
+                return json.load(fh)
+        except (OSError, ValueError) as exc:
+            raise ValueError(f"{target}: unreadable — {exc}") from exc
+    raise ValueError(f"no such profile target: {target!r}")
+
+
+def profile(
+    target: str,
+    *,
+    as_json: bool = False,
+    folded: bool = False,
+    out: str | None = None,
+    timeout: float = 5.0,
+) -> int:
+    """Merge, validate, and render sampling-profiler output.
+
+    ``target`` is a live monitoring endpoint (``/profile`` is fetched),
+    a directory of per-process ``pathway_profile_*.json`` exports, or a
+    single export file.  Default output is a human summary; ``--json``
+    emits speedscope JSON (load at https://www.speedscope.app),
+    ``--folded`` emits collapsed-stack text (flamegraph.pl).  Every
+    path goes through ``validate_profile`` — exit 2 on an invalid or
+    unreachable profile."""
+    from pathway_tpu.internals import profiling as _profiling
+
+    try:
+        doc = _load_profile_document(target, timeout)
+        _profiling.validate_profile(doc)
+    except ValueError as exc:
+        print(f"profile: {exc}", file=sys.stderr)
+        return 2
+
+    if folded:
+        text = _profiling.folded_text(doc)
+    elif as_json:
+        text = json.dumps(_profiling.speedscope(doc), indent=1) + "\n"
+    else:
+        lines = [f"profile: {len(doc['workers'])} worker(s)"]
+        for wid in sorted(doc["workers"], key=str):
+            p = doc["workers"][wid]
+            lines.append(
+                f"  worker {wid}: pid={p.get('pid')}  "
+                f"samples={p.get('sample_count', 0)}  "
+                f"rate_hz={p.get('rate_hz', 0)}  "
+                f"wall_s={p.get('wall_s', 0)}  "
+                f"epoch={p.get('epoch', 0)}"
+                + (
+                    f"  dropped_stacks={p['dropped_stacks']}"
+                    if p.get("dropped_stacks")
+                    else ""
+                )
+            )
+        phases = doc.get("phases") or _profiling.phase_totals(doc)
+        total = sum(phases.values()) or 1.0
+        lines.append("phases (sampled seconds):")
+        for phase, weight in sorted(
+            phases.items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(
+                f"  {phase:<10} {weight:>10.3f}s  "
+                f"{100.0 * weight / total:5.1f}%"
+            )
+        # hottest folded stacks across the mesh, leaf shown last
+        heat: dict[tuple[str, str], float] = {}
+        for p in doc["workers"].values():
+            for phase, stack, weight, _count in p.get("samples", ()):
+                key = (phase, stack)
+                heat[key] = heat.get(key, 0.0) + float(weight)
+        lines.append("hot stacks:")
+        for (phase, stack), weight in sorted(
+            heat.items(), key=lambda kv: -kv[1]
+        )[:10]:
+            leaf = stack.rsplit(";", 2)[-2:]
+            lines.append(
+                f"  {weight:>8.3f}s  [{phase}] {';'.join(leaf)}"
+            )
+        text = "\n".join(lines) + "\n"
+
+    if out:
+        with open(out, "w") as fh:
+            fh.write(text)
+        print(f"profile: wrote {out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
 def rescale(
     target_processes: int, *, supervisor_dir: str | None = None
 ) -> int:
@@ -709,7 +993,36 @@ def main(argv: Sequence[str] | None = None) -> int:
     )
     p_stats.add_argument("--timeout", type=float, default=5.0)
     p_stats.add_argument(
+        "--watch", type=float, default=None, metavar="SECONDS",
+        help="re-scrape every N seconds (clear screen) with history "
+        "sparklines from the endpoint's /timeseries ring",
+    )
+    p_stats.add_argument(
         "target", help="port, host:port, or full URL of the endpoint"
+    )
+
+    p_profile = sub.add_parser(
+        "profile",
+        help="merge + validate + render sampling-profiler output "
+        "(live /profile endpoint, a PATHWAY_TPU_PROFILE_DIR, or one "
+        "export file)",
+    )
+    p_profile.add_argument(
+        "--json", action="store_true",
+        help="emit speedscope JSON (https://www.speedscope.app)",
+    )
+    p_profile.add_argument(
+        "--folded", action="store_true",
+        help="emit collapsed-stack text (flamegraph.pl / speedscope)",
+    )
+    p_profile.add_argument(
+        "-o", "--out", default=None, help="write output to a file"
+    )
+    p_profile.add_argument("--timeout", type=float, default=5.0)
+    p_profile.add_argument(
+        "target",
+        help="port / host:port / URL of a live run, a directory of "
+        "pathway_profile_*.json exports, or one export file",
     )
 
     p_trace = sub.add_parser(
@@ -756,9 +1069,22 @@ def main(argv: Sequence[str] | None = None) -> int:
             args.target_processes, supervisor_dir=args.supervisor_dir
         )
     if args.command == "stats":
-        return stats(args.target, raw=args.raw, timeout=args.timeout)
+        return stats(
+            args.target,
+            raw=args.raw,
+            timeout=args.timeout,
+            watch=args.watch,
+        )
     if args.command == "trace":
         return trace(args.target, as_json=args.json)
+    if args.command == "profile":
+        return profile(
+            args.target,
+            as_json=args.json,
+            folded=args.folded,
+            out=args.out,
+            timeout=args.timeout,
+        )
     if args.command == "spawn-from-env":
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")
         if not spawn_args:

@@ -294,6 +294,8 @@ COLUMNAR_EXCHANGE = os.environ.get(
 #: (``distributed.EXCHANGE_STATS``) pointing at the same object.
 from pathway_tpu.engine.routing import EXCHANGE_STATS  # noqa: E402
 from pathway_tpu.internals import metrics as _metrics  # noqa: E402
+from pathway_tpu.internals import profiling as _profiling  # noqa: E402
+from pathway_tpu.internals import timeseries as _timeseries  # noqa: E402
 from pathway_tpu.internals import tracing as _tracing  # noqa: E402
 
 _FRAME_MAGIC = b"PWCF"
@@ -1304,8 +1306,15 @@ class DistributedScheduler:
     def _metrics_snapshot(self) -> dict:
         """This process's registry snapshot plus its per-operator series —
         the payload followers piggyback on round frames bound for the
-        leader (the mesh stats protocol)."""
-        return _metrics.full_snapshot(self)
+        leader (the mesh stats protocol).  When the sampling profiler is
+        running, its payload rides along under the reserved
+        ``"__profile__"`` key (popped by the leader at absorption, never
+        rendered as a metrics family) — the frame arity stays at 8, so
+        the PWC503 frame-shape contract is untouched."""
+        snap = _metrics.full_snapshot(self)
+        if _profiling.PROFILER.running:
+            snap["__profile__"] = _profiling.PROFILER.payload()
+        return snap
 
     # -- commit ------------------------------------------------------------
 
@@ -1651,6 +1660,9 @@ class DistributedScheduler:
                     else:
                         self._apply_remote(deliveries)
                     if peer_snap is not None:
+                        profile = peer_snap.pop("__profile__", None)
+                        if profile is not None:
+                            _profiling.PROFILER.absorb(peer, profile)
                         self.mesh_metrics[peer] = peer_snap
                     self.peer_heartbeats[peer] = peer_hb
                     global_busy = global_busy or bit
@@ -1746,6 +1758,13 @@ class DistributedScheduler:
         for peer in list(self.trace_peer_spans):
             if peer in gone or peer >= self.n_processes:
                 self.trace_peer_spans.pop(peer, None)
+        # same lifecycle for the other observability planes: absorbed
+        # profile payloads and the timeseries ring's worker label sets
+        # of dead/out-of-width peers must not outlive them
+        _profiling.PROFILER.prune(dead=gone, width=self.n_processes)
+        _timeseries.STORE.prune_workers(
+            dead={str(p) for p in gone}, width=self.n_processes
+        )
 
     def resync(self, epoch: int) -> None:
         """Post-rollback barrier: flush stale frames off every peer link.
@@ -1756,8 +1775,12 @@ class DistributedScheduler:
         so the barrier cannot deadlock even with bounded queues."""
         # raise the trace fence with the mesh epoch: context tuples a
         # fenced-out zombie leader stamped before this barrier are
-        # rejected by TraceRecorder.adopt
+        # rejected by TraceRecorder.adopt; the profiler fence rises in
+        # lockstep so pre-barrier profile payloads are dropped too
         _tracing.TRACER.epoch = max(_tracing.TRACER.epoch, int(epoch))
+        _profiling.PROFILER.epoch = max(
+            _profiling.PROFILER.epoch, int(epoch)
+        )
         peers = sorted(self._outbox)
         for peer in peers:
             self.transport.send(peer, ("sync", epoch))

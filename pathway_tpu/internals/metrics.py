@@ -416,11 +416,15 @@ def render_snapshots(snaps: "dict[str, dict]") -> str:
     without a ``worker`` label (the leader's legacy local series); any
     other key is added as ``worker="<key>"`` on every sample.  Each
     family name gets exactly one HELP/TYPE block even when several
-    workers report it."""
+    workers report it.  Keys starting with ``__`` are reserved for
+    piggybacked sidecar payloads (e.g. the profiler's
+    ``"__profile__"``) and are never rendered as families."""
     order: list[str] = []
     meta: dict[str, dict] = {}
     for snap in snaps.values():
         for name, fam in snap.items():
+            if name.startswith("__"):
+                continue
             if name not in meta:
                 meta[name] = fam
                 order.append(name)

@@ -265,18 +265,81 @@ class MonitoringHttpServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802
-                if self.path not in ("/metrics", "/"):
-                    self.send_response(404)
-                    self.end_headers()
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                if parsed.path in ("/metrics", "/"):
+                    body = monitor_ref.prometheus_text().encode()
+                    self._reply(200, body, "text/plain; version=0.0.4")
                     return
-                body = monitor_ref.prometheus_text().encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
-                )
+                if parsed.path == "/timeseries":
+                    self._timeseries(parse_qs(parsed.query))
+                    return
+                if parsed.path == "/profile":
+                    self._profile()
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def _reply(
+                self, code: int, body: bytes, ctype: str = "application/json"
+            ) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _timeseries(self, query: dict) -> None:
+                """``/timeseries?family=...&window=...`` — windowed reads
+                off the history ring (internals/timeseries.py); extra
+                query params filter on series labels.  Without a family,
+                an index of recorded families + ring bound stats."""
+                import json as _json
+
+                from pathway_tpu.internals import timeseries as _ts
+
+                family = (query.get("family") or [None])[0]
+                if not family:
+                    payload = {
+                        "families": _ts.STORE.families(),
+                        "stats": _ts.STORE.stats(),
+                        "slos": [s.to_dict() for s in _ts.SENTINEL.specs()],
+                    }
+                    self._reply(200, _json.dumps(payload).encode())
+                    return
+                try:
+                    window = float((query.get("window") or ["60"])[0])
+                except ValueError:
+                    self._reply(
+                        400, b'{"error": "window must be a number"}'
+                    )
+                    return
+                labels = {
+                    k: v[0]
+                    for k, v in query.items()
+                    if k not in ("family", "window") and v
+                }
+                result = _ts.STORE.query(family, window, labels)
+                self._reply(200, _json.dumps(result).encode())
+
+            def _profile(self) -> None:
+                """``/profile`` — the merged profile document (this
+                worker plus, on the leader, every absorbed peer
+                payload); 404 while the sampling profiler is off."""
+                import json as _json
+
+                from pathway_tpu.internals import profiling as _prof
+
+                doc = _prof.profile_document(_prof.PROFILER.mesh_payloads())
+                if not doc["workers"]:
+                    self._reply(
+                        404,
+                        b'{"error": "profiler not running '
+                        b'(set PATHWAY_TPU_PROFILE=1)"}',
+                    )
+                    return
+                self._reply(200, _json.dumps(doc, default=repr).encode())
 
             def log_message(self, *args: Any) -> None:
                 pass

@@ -151,6 +151,8 @@ def run(
                 )
 
     from pathway_tpu import serving as _serving
+    from pathway_tpu.internals import profiling as _profiling
+    from pathway_tpu.internals import timeseries as _timeseries
     from pathway_tpu.internals.metrics import FLIGHT
     from pathway_tpu.internals.telemetry import run_span, telemetry_enabled
 
@@ -159,6 +161,30 @@ def run(
         # the serving plane is per-process: every mesh member answers
         # queries from its own shard's snapshots on 21000 + process_id
         query_server = _serving.start_server()
+
+    profiler_started = False
+    telemetry_loop_started = False
+    if not _analysis_runtime.enabled():
+        # sampling profiler: strictly opt-in (PATHWAY_TPU_PROFILE=1) —
+        # when unset this is a boolean test, no thread, no cost
+        profiler_started = _profiling.PROFILER.maybe_start()
+        # metrics history ring: feed it whenever something can read it
+        # (an HTTP endpoint serving /timeseries) or the user asked for
+        # it explicitly (PATHWAY_TPU_TIMESERIES=1 / PATHWAY_TPU_SLO)
+        if with_http_server or _timeseries.loop_enabled():
+            if monitor is None and _timeseries.loop_enabled():
+                # SLO evaluation without a dashboard: a quiet monitor
+                # gives the loop its scheduler/mesh_snapshots views
+                from pathway_tpu.internals.monitoring import (
+                    MonitoringLevel,
+                    StatsMonitor,
+                )
+
+                monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+                runner.monitor = monitor
+            if monitor is not None:
+                _timeseries.start_loop(monitor)
+                telemetry_loop_started = True
 
     if telemetry_enabled():
         # per-operator stats feed the metrics sampler + operator spans
@@ -198,6 +224,13 @@ def run(
         FLIGHT.dump(f"pw.run raised: {exc!r}")
         raise
     finally:
+        if telemetry_loop_started:
+            # final tick inside stop_loop captures the run's last state
+            _timeseries.stop_loop()
+        if profiler_started:
+            _profiling.PROFILER.stop()
+            # best-effort forensics: export() swallows write failures
+            _profiling.PROFILER.export()
         if monitor is not None:
             monitor.stop()
         if http_server is not None and not kwargs.get("_keep_http_server"):

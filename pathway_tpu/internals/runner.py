@@ -52,6 +52,8 @@ from pathway_tpu.engine import device_pipeline as _device_pipeline
 from pathway_tpu import serving as _serving
 from pathway_tpu.internals.udfs.executors import make_kw_fn as _make_kw_fn
 from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import profiling as _profiling
+from pathway_tpu.internals import timeseries as _timeseries
 from pathway_tpu.internals import tracing as _tracing
 
 #: ingest->sink latency, observed once per delta batch weighted by the
@@ -1638,6 +1640,8 @@ class DistributedGraphRunner:
         _tracing.TRACER.drop()
         sched.mesh_metrics.pop(dead_peer, None)
         sched.trace_peer_spans.pop(dead_peer, None)
+        _profiling.PROFILER.prune(dead=(dead_peer,))
+        _timeseries.STORE.prune_workers(dead={str(dead_peer)})
         _metrics.FLIGHT.record(
             "recovery_start", peer=dead_peer, epoch=epoch
         )
@@ -2095,6 +2099,13 @@ class DistributedGraphRunner:
                         break
                 rejoin_times.append(frame[2])
                 if frame[3] is not None:
+                    # the ack carries the survivor's metrics snapshot with
+                    # an optional piggybacked profiler payload — route the
+                    # sidecar to the new leader's profile aggregation so
+                    # `cli profile` keeps covering the mesh across failover
+                    peer_profile = frame[3].pop("__profile__", None)
+                    if peer_profile is not None:
+                        _profiling.PROFILER.absorb(peer, peer_profile)
                     sched.mesh_metrics[peer] = frame[3]
             self._request_kill(0)
             _metrics.REGISTRY.counter(

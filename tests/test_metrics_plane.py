@@ -265,6 +265,147 @@ class TestRendererEdgeCases:
         assert by_worker["0"][("lat_seconds_bucket", "1")] == 3
 
 
+class TestObservabilityFamilyConformance:
+    """OpenMetrics conformance for the PR-15 observability families
+    (profiler tick cost, timeseries tick cost): zero-observation and
+    single-bucket renderings a live mesh produces, plus the ``cli
+    stats`` profiler section fed by them."""
+
+    PROFILE_BUCKETS = [1e-5, 1e-4, 1e-3, 1e-2, 0.1]
+    TS_BUCKETS = [1e-4, 1e-3, 1e-2, 0.1, 1.0]
+
+    @staticmethod
+    def _hist(bounds, counts, count, total):
+        return {
+            "kind": "histogram",
+            "help": "tick cost",
+            "buckets": list(bounds),
+            "series": [
+                {
+                    "labels": {},
+                    "counts": list(counts),
+                    "sum": total,
+                    "count": count,
+                }
+            ],
+        }
+
+    def test_zero_observation_worker_renders_conformant(self):
+        # worker 1 enabled the profiler but its sampler has not ticked
+        # yet; worker 0's recorder loop is mid-run — one exposition
+        text = _metrics.render_snapshots(
+            {
+                "0": {
+                    "pathway_profile_sample_seconds": self._hist(
+                        self.PROFILE_BUCKETS, [3, 2, 1, 0, 0], 6, 0.004
+                    ),
+                    "pathway_timeseries_tick_seconds": self._hist(
+                        self.TS_BUCKETS, [5, 1, 0, 0, 0], 6, 0.001
+                    ),
+                },
+                "1": {
+                    "pathway_profile_sample_seconds": self._hist(
+                        self.PROFILE_BUCKETS, [0] * 5, 0, 0.0
+                    ),
+                    "pathway_timeseries_tick_seconds": self._hist(
+                        self.TS_BUCKETS, [0] * 5, 0, 0.0
+                    ),
+                },
+            }
+        )
+        families = _metrics.validate_exposition(text)
+        for fam_name in (
+            "pathway_profile_sample_seconds",
+            "pathway_timeseries_tick_seconds",
+        ):
+            assert text.count(f"# TYPE {fam_name} histogram") == 1
+            by_worker: dict = {}
+            for n, la, v in families[fam_name]["samples"]:
+                by_worker.setdefault(la["worker"], {})[
+                    (n, la.get("le", ""))
+                ] = v
+            # the idle worker's series is complete and all-zero
+            assert by_worker["1"][(f"{fam_name}_count", "")] == 0
+            assert by_worker["1"][(f"{fam_name}_sum", "")] == 0
+            assert by_worker["1"][(f"{fam_name}_bucket", "+Inf")] == 0
+            assert by_worker["0"][(f"{fam_name}_count", "")] == 6
+
+    def test_single_bucket_histogram_conformant_and_quantiles(self):
+        # a family whose whole distribution lands in one finite bucket
+        text = _metrics.render_snapshots(
+            {
+                "0": {
+                    "pathway_timeseries_tick_seconds": self._hist(
+                        [0.01], [4], 4, 0.012
+                    )
+                }
+            }
+        )
+        families = _metrics.validate_exposition(text)
+        samples = families["pathway_timeseries_tick_seconds"]["samples"]
+        les = [
+            la["le"] for n, la, _v in samples if n.endswith("_bucket")
+        ]
+        assert les == ["0.01", "+Inf"]
+        from pathway_tpu.cli import _hist_quantile
+
+        # interpolated inside the lone finite bucket
+        q = _hist_quantile([(0.01, 4.0), (float("inf"), 4.0)], 0.5)
+        assert q == pytest.approx(0.005)
+        # zero observations / +Inf-only: no fabricated number
+        assert _hist_quantile([(0.01, 0.0), (float("inf"), 0.0)], 0.5) is None
+        assert _hist_quantile([(float("inf"), 4.0)], 0.5) is None
+
+    def test_cli_stats_renders_profiler_section(self, capsys):
+        from pathway_tpu import cli
+
+        _metrics.REGISTRY.counter(
+            "pathway_profile_samples_total",
+            "stack samples aggregated by the profiler",
+        ).inc(12)
+        _metrics.REGISTRY.gauge(
+            "pathway_profile_rate_hz",
+            "current (adaptive) profiler sampling rate",
+        ).set(50.0)
+        _metrics.REGISTRY.histogram(
+            "pathway_profile_sample_seconds",
+            "wall cost of one profiler sampling tick",
+            buckets=tuple(self.PROFILE_BUCKETS),
+        ).observe(5e-4)
+        _metrics.REGISTRY.histogram(
+            "pathway_timeseries_tick_seconds",
+            "wall cost of one timeseries recording pass",
+            buckets=tuple(self.TS_BUCKETS),
+        ).observe(2e-3)
+        monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+        server = MonitoringHttpServer(monitor, port=0)
+        try:
+            assert cli.main(["stats", str(server.port)]) == 0
+        finally:
+            server.stop()
+        out = capsys.readouterr().out
+        assert "profiler:" in out
+        profiler = next(
+            line for line in out.splitlines()
+            if "samples=" in line and "tick_us" in line
+        )
+        assert "rate_hz=50.0" in profiler
+        assert "tick_us: p50=" in profiler
+        assert "p50=-" not in profiler  # a real per-tick cost estimate
+        # both new families appear in the per-family percentile table
+        # with their histogram percentile columns populated
+        for fam_name in (
+            "pathway_profile_sample_seconds",
+            "pathway_timeseries_tick_seconds",
+        ):
+            row = next(
+                line for line in out.splitlines()
+                if line.startswith(fam_name)
+            )
+            assert "histogram" in row
+            assert "-" not in row.split()[-3:]
+
+
 class TestExchangeStatsAbsorption:
     def test_single_dict_alias_across_modules(self):
         from pathway_tpu.engine import distributed, routing, sharded

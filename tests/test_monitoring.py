@@ -91,6 +91,115 @@ class TestPrometheusEndpoint:
             server.stop()
 
 
+class TestTimeseriesAndProfileRoutes:
+    def _get(self, port, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ).read().decode()
+
+    def test_timeseries_index_and_family_query(self):
+        import json
+
+        from pathway_tpu.internals import timeseries
+
+        timeseries.STORE.clear()
+        now = __import__("time").time()
+        timeseries.STORE.observe(
+            "route_fam", {"worker": "0"}, 5.0, t=now - 1
+        )
+        timeseries.STORE.observe(
+            "route_fam", {"worker": "1"}, 6.0, t=now - 1
+        )
+        server = MonitoringHttpServer(StatsMonitor(), port=0)
+        try:
+            index = json.loads(self._get(server.port, "/timeseries"))
+            assert {"families", "stats", "slos"} <= set(index)
+            assert any(
+                f["family"] == "route_fam" for f in index["families"]
+            )
+            result = json.loads(
+                self._get(
+                    server.port,
+                    "/timeseries?family=route_fam&window=30&worker=1",
+                )
+            )
+            assert result["family"] == "route_fam"
+            assert result["window_s"] == 30.0
+            # the extra query param filtered on the worker label
+            assert len(result["series"]) == 1
+            assert result["series"][0]["labels"]["worker"] == "1"
+            assert result["series"][0]["points"][0][1] == 6.0
+        finally:
+            server.stop()
+            timeseries.STORE.clear()
+
+    def test_timeseries_bad_window_is_400(self):
+        import json
+        import urllib.error
+
+        server = MonitoringHttpServer(StatsMonitor(), port=0)
+        try:
+            try:
+                self._get(
+                    server.port, "/timeseries?family=x&window=soon"
+                )
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "window" in json.loads(e.read().decode())["error"]
+        finally:
+            server.stop()
+
+    def test_profile_404_when_profiler_idle(self):
+        import urllib.error
+
+        from pathway_tpu.internals.profiling import PROFILER
+
+        PROFILER.configure(enabled=False, clear=True)
+        server = MonitoringHttpServer(StatsMonitor(), port=0)
+        try:
+            try:
+                self._get(server.port, "/profile")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                assert b"PATHWAY_TPU_PROFILE" in e.read()
+        finally:
+            server.stop()
+
+    def test_profile_serves_merged_document(self):
+        import json
+
+        from pathway_tpu.internals import profiling
+
+        profiling.PROFILER.configure(enabled=False, clear=True)
+        assert profiling.PROFILER.absorb(
+            1,
+            {
+                "v": profiling.VERSION,
+                "worker": 1,
+                "pid": 999,
+                "seq": 1,
+                "epoch": 0,
+                "wall_s": 1.0,
+                "rate_hz": 50.0,
+                "samples": [["operator", "graph:process", 0.5, 5]],
+                "sample_count": 5,
+                "dropped_stacks": 0,
+                "device": {},
+            },
+        )
+        server = MonitoringHttpServer(StatsMonitor(), port=0)
+        try:
+            doc = json.loads(self._get(server.port, "/profile"))
+        finally:
+            server.stop()
+            profiling.PROFILER.configure(enabled=False, clear=True)
+        profiling.validate_profile(doc)
+        assert doc["workers"]["1"]["sample_count"] == 5
+        assert doc["phases"]["operator"] == 0.5
+
+
 class TestDashboard:
     def test_live_table_renders(self):
         import io

@@ -1,0 +1,862 @@
+"""Continuous sampling profiler: phase classification, adaptive-rate
+sampler, bounded folded-stack aggregation, mesh piggyback + epoch fence,
+speedscope/folded export, ``cli profile`` merging, and reconciliation of
+profile phase totals against PR-8 critical-path buckets (reference:
+PR "observability")."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import profiling
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENGINE = "/site/pathway_tpu/engine"
+
+
+def _free_port_base(n: int) -> int:
+    """A base port such that base..base+n-1 are currently bindable."""
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        if base + n >= 65535:
+            continue
+        ok = True
+        for i in range(n):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + i))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port range found")
+
+
+def _payload(
+    worker: int = 0,
+    seq: int = 1,
+    epoch: int = 0,
+    samples: list | None = None,
+    wall: float = 1.0,
+) -> dict:
+    if samples is None:
+        samples = [["operator", "runner:main;graph:process", 0.5, 5]]
+    return {
+        "v": profiling.VERSION,
+        "worker": worker,
+        "pid": 40000 + worker,
+        "seq": seq,
+        "epoch": epoch,
+        "wall_s": wall,
+        "rate_hz": 50.0,
+        "samples": samples,
+        "sample_count": sum(int(s[3]) for s in samples),
+        "dropped_stacks": 0,
+        "device": {},
+    }
+
+
+# -- fake frame chains for driving _ingest directly ---------------------------
+
+
+class _Code:
+    def __init__(self, filename: str, name: str) -> None:
+        self.co_filename = filename
+        self.co_name = name
+
+
+class _Frame:
+    def __init__(self, code: _Code, back: "_Frame | None") -> None:
+        self.f_code = code
+        self.f_back = back
+
+
+def _chain(*pairs: tuple[str, str]) -> _Frame:
+    """Build a frame chain from leaf-first (filename, func) pairs and
+    return the leaf frame (what sys._current_frames() hands back)."""
+    frame: _Frame | None = None
+    for filename, func in reversed(pairs):
+        frame = _Frame(_Code(filename, func), frame)
+    assert frame is not None
+    return frame
+
+
+# -- phase classification -----------------------------------------------------
+
+
+class TestClassifyStack:
+    def test_leaf_most_rule_wins_through_exchange_loop(self):
+        # an operator caught mid-process() under the exchange loop is
+        # operator time, not exchange time: leaf-first iteration
+        assert (
+            profiling.classify_stack(
+                [
+                    (f"{ENGINE}/reducers.py", "step"),
+                    (f"{ENGINE}/graph.py", "process"),
+                    (f"{ENGINE}/distributed.py", "_exchange_rounds"),
+                ]
+            )
+            == "operator"
+        )
+
+    def test_exchange_loop_itself_is_exchange(self):
+        assert (
+            profiling.classify_stack(
+                [(f"{ENGINE}/distributed.py", "_exchange_rounds")]
+            )
+            == "exchange"
+        )
+
+    def test_distributed_func_prefix_gates_the_rule(self):
+        # distributed.py helpers outside the exchange prefixes fall
+        # through to the next frame (here: none -> other)
+        assert (
+            profiling.classify_stack(
+                [(f"{ENGINE}/distributed.py", "_metrics_snapshot")]
+            )
+            == "other"
+        )
+
+    @pytest.mark.parametrize(
+        "filename,func,phase",
+        [
+            ("/x/pathway_tpu/serving/server.py", "do_GET", "serving"),
+            ("/x/pathway_tpu/serving/snapshot.py", "read", "serving"),
+            (f"{ENGINE}/device_pipeline.py", "commit", "device"),
+            (f"{ENGINE}/device_ops.py", "groupby_commit", "device"),
+            (f"{ENGINE}/connectors.py", "poll", "ingest"),
+            (f"{ENGINE}/routing.py", "route_batch", "exchange"),
+            (f"{ENGINE}/graph.py", "process", "operator"),
+            (f"{ENGINE}/temporal.py", "advance", "operator"),
+            ("/usr/lib/python3.11/threading.py", "wait", "other"),
+        ],
+    )
+    def test_single_frame_rules(self, filename, func, phase):
+        assert profiling.classify_stack([(filename, func)]) == phase
+
+    def test_windows_separators_normalize(self):
+        assert (
+            profiling.classify_stack(
+                [("C:\\x\\pathway_tpu\\engine\\graph.py", "process")]
+            )
+            == "operator"
+        )
+
+
+# -- sampler lifecycle --------------------------------------------------------
+
+
+class TestSamplerLifecycle:
+    def test_default_off_is_a_boolean_test(self, monkeypatch):
+        monkeypatch.delenv("PATHWAY_TPU_PROFILE", raising=False)
+        p = profiling.SampleProfiler()
+        assert p.enabled is False
+        assert p.maybe_start() is False
+        assert p.running is False
+        assert p._thread is None  # no sampler thread was ever created
+
+    def test_env_enables(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_PROFILE", "1")
+        monkeypatch.setenv("PATHWAY_TPU_PROFILE_HZ", "125")
+        p = profiling.SampleProfiler()
+        assert p.enabled is True
+        assert p.base_period == pytest.approx(1.0 / 125.0)
+
+    def test_live_sampler_collects_and_payload_validates(self):
+        p = profiling.SampleProfiler(enabled=True, hz=500)
+        done = threading.Event()
+
+        def burn():
+            x = 0
+            while not done.is_set():
+                x += sum(i * i for i in range(500))
+
+        worker = threading.Thread(target=burn, daemon=True)
+        worker.start()
+        try:
+            assert p.maybe_start() is True
+            assert p.maybe_start() is True  # idempotent while running
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if p._samples > 10:
+                    break
+                time.sleep(0.02)
+        finally:
+            done.set()
+            p.stop()
+            worker.join(timeout=5)
+        assert p.running is False
+        payload = p.payload()
+        assert payload["sample_count"] > 0
+        assert payload["samples"]
+        assert payload["rate_hz"] > 0
+        doc = profiling.profile_document({0: payload})
+        profiling.validate_profile(doc)
+
+    def test_stop_then_restart(self):
+        p = profiling.SampleProfiler(enabled=True, hz=200)
+        try:
+            assert p.maybe_start()
+            p.stop()
+            assert p.running is False
+            assert p.maybe_start()
+            assert p.running is True
+        finally:
+            p.stop()
+
+
+class TestAdaptiveRate:
+    def test_costly_ticks_double_the_period_capped(self):
+        p = profiling.SampleProfiler(enabled=False, hz=100)
+        base = p.base_period
+        p._adapt(base)  # duty cycle 1.0 >> 2% target
+        assert p.period == pytest.approx(base * 2)
+        for _ in range(32):
+            p._adapt(p.period)  # keep the duty cycle saturated
+        assert p.period == 2.0  # hard cap
+
+    def test_cheap_ticks_decay_back_to_base(self):
+        p = profiling.SampleProfiler(enabled=False, hz=100)
+        for _ in range(4):
+            p._adapt(p.period)  # push the period up first
+        assert p.period > p.base_period
+        for _ in range(200):
+            p._adapt(0.0)
+        assert p.period == pytest.approx(p.base_period)
+
+
+# -- bounded ingest -----------------------------------------------------------
+
+
+class TestIngest:
+    def test_own_thread_is_skipped(self):
+        p = profiling.SampleProfiler(enabled=False)
+        frame = _chain((f"{ENGINE}/graph.py", "process"))
+        assert p._ingest({7: frame}, own_tid=7, weight=0.01) == 0
+        assert not p._folded
+
+    def test_fold_accumulates_weight_and_count(self):
+        p = profiling.SampleProfiler(enabled=False)
+        frame = _chain(
+            (f"{ENGINE}/reducers.py", "step"),
+            ("/x/pathway_tpu/internals/runner.py", "run"),
+        )
+        p._ingest({1: frame}, own_tid=0, weight=0.01)
+        p._ingest({1: frame}, own_tid=0, weight=0.02)
+        assert len(p._folded) == 1
+        (phase, folded), cell = next(iter(p._folded.items()))
+        assert phase == "operator"
+        # root-first folded order, basename:func labels
+        assert folded == "runner:run;reducers:step"
+        assert cell[0] == pytest.approx(0.03)
+        assert cell[1] == 2
+
+    def test_depth_is_truncated_at_max(self):
+        p = profiling.SampleProfiler(enabled=False)
+        deep = _chain(
+            *[
+                (f"/x/mod{i}.py", f"f{i}")
+                for i in range(profiling.MAX_DEPTH + 10)
+            ]
+        )
+        p._ingest({1: deep}, own_tid=0, weight=0.01)
+        ((_, folded),) = list(p._folded)
+        assert folded.count(";") == profiling.MAX_DEPTH - 1
+
+    def test_stack_overflow_folds_into_truncated_leaf(self):
+        p = profiling.SampleProfiler(enabled=False)
+        with p._lock:
+            for i in range(profiling.MAX_STACKS):
+                p._folded[("other", f"synthetic{i}")] = [0.0, 1]
+        frame = _chain((f"{ENGINE}/graph.py", "process"))
+        p._ingest({1: frame}, own_tid=0, weight=0.25)
+        assert p._dropped == 1
+        cell = p._folded[("operator", "(truncated)")]
+        assert cell[0] == pytest.approx(0.25)  # weight kept, detail lost
+        assert p.payload()["dropped_stacks"] == 1
+
+
+# -- payloads, absorption, epoch fence ----------------------------------------
+
+
+class TestAbsorbAndFence:
+    def test_payload_seq_is_monotonic(self):
+        p = profiling.SampleProfiler(enabled=False)
+        assert p.payload()["seq"] < p.payload()["seq"]
+
+    def test_absorb_latest_seq_wins(self):
+        leader = profiling.SampleProfiler(enabled=False)
+        assert leader.absorb(1, _payload(worker=1, seq=3))
+        assert not leader.absorb(1, _payload(worker=1, seq=2))
+        assert leader.mesh_payloads()[1]["seq"] == 3
+
+    def test_zombie_epoch_is_fenced_and_counted(self):
+        leader = profiling.SampleProfiler(enabled=False)
+        leader.epoch = 2
+        fenced = _metrics.REGISTRY.counter(
+            "pathway_profile_fenced_total",
+            "stale-epoch profile payloads dropped at absorption",
+        )
+        before = fenced.value
+        assert not leader.absorb(1, _payload(worker=1, epoch=1))
+        assert fenced.value == before + 1
+        assert 1 not in leader.mesh_payloads()
+
+    def test_current_payload_raises_the_fence(self):
+        leader = profiling.SampleProfiler(enabled=False)
+        assert leader.absorb(1, _payload(worker=1, epoch=3))
+        assert leader.epoch == 3
+        # a pre-failover straggler is now a zombie
+        assert not leader.absorb(2, _payload(worker=2, epoch=2))
+
+    def test_mesh_payloads_drops_peers_behind_a_raised_fence(self):
+        leader = profiling.SampleProfiler(enabled=False)
+        assert leader.absorb(1, _payload(worker=1, epoch=0))
+        leader.epoch = 1  # failover resync raised the fence afterwards
+        assert leader.mesh_payloads() == {}
+
+    def test_prune_dead_and_width(self):
+        leader = profiling.SampleProfiler(enabled=False)
+        for peer in (1, 2, 3):
+            assert leader.absorb(peer, _payload(worker=peer))
+        leader.prune(dead=(1,))
+        assert set(leader.mesh_payloads()) == {2, 3}
+        leader.prune(width=3)  # rescale narrowed the mesh
+        assert set(leader.mesh_payloads()) == {2}
+
+
+# -- documents / renderers / validation ---------------------------------------
+
+
+class TestDocuments:
+    def test_profile_document_shape(self):
+        doc = profiling.profile_document(
+            {1: _payload(worker=1), 0: _payload(worker=0)}
+        )
+        assert doc["version"] == profiling.VERSION
+        assert list(doc["workers"]) == ["0", "1"]
+        assert doc["phases"]["operator"] == pytest.approx(1.0)
+
+    def test_merge_documents_latest_seq_wins(self):
+        older = profiling.profile_document(
+            {0: _payload(seq=1, samples=[["ingest", "a:b", 0.1, 1]])}
+        )
+        newer = profiling.profile_document(
+            {0: _payload(seq=5, samples=[["device", "c:d", 0.2, 2]])}
+        )
+        merged = profiling.merge_documents([newer, older])
+        assert merged["workers"]["0"]["seq"] == 5
+        assert merged["phases"] == {"device": 0.2}
+
+    def test_folded_text_format(self):
+        doc = profiling.profile_document(
+            {
+                0: _payload(
+                    samples=[["operator", "runner:run;graph:process", 0.5, 7]]
+                )
+            }
+        )
+        text = profiling.folded_text(doc)
+        assert text == "worker0;operator;runner:run;graph:process 7\n"
+        assert profiling.folded_text({"workers": {}}) == ""
+
+    def test_speedscope_structure(self):
+        doc = profiling.profile_document(
+            {
+                0: _payload(samples=[["operator", "a:b;c:d", 0.5, 5]]),
+                1: _payload(
+                    worker=1, samples=[["exchange", "a:b;e:f", 0.25, 2]]
+                ),
+            }
+        )
+        ss = profiling.speedscope(doc)
+        assert ss["$schema"].endswith("file-format-schema.json")
+        names = [f["name"] for f in ss["shared"]["frames"]]
+        assert "[operator]" in names and "[exchange]" in names
+        assert "a:b" in names and names.count("a:b") == 1  # shared table
+        assert len(ss["profiles"]) == 2
+        prof0 = ss["profiles"][0]
+        assert prof0["type"] == "sampled" and prof0["unit"] == "seconds"
+        # each chain is [phase] frame then root-first stack frames
+        chain = prof0["samples"][0]
+        assert names[chain[0]] == "[operator]"
+        assert [names[i] for i in chain[1:]] == ["a:b", "c:d"]
+        assert prof0["endValue"] == pytest.approx(0.5)
+
+    def test_validate_accepts_synthetic(self):
+        doc = profiling.profile_document({0: _payload()})
+        assert profiling.validate_profile(doc) is doc
+
+    @pytest.mark.parametrize(
+        "mutate,message",
+        [
+            (lambda d: d.update(version=99), "version"),
+            (lambda d: d.update(workers={}), "no workers"),
+            (
+                lambda d: d["workers"]["0"].update(
+                    samples=[["warp", "a:b", 0.1, 1]]
+                ),
+                "unknown phase",
+            ),
+            (
+                lambda d: d["workers"]["0"].update(
+                    samples=[["operator", "", 0.1, 1]]
+                ),
+                "empty stack",
+            ),
+            (
+                lambda d: d["workers"]["0"].update(
+                    samples=[["operator", "a:b", -0.1, 1]]
+                ),
+                "bad weight",
+            ),
+            (
+                lambda d: d["workers"]["0"].update(
+                    samples=[["operator", "a:b", 0.1, 0]]
+                ),
+                "< 1",
+            ),
+            (
+                lambda d: d["workers"]["0"].update(
+                    samples=[["operator", "a:b", 0.1]]
+                ),
+                "quad",
+            ),
+            (
+                lambda d: d["workers"]["0"].update(epoch=-1),
+                "epoch",
+            ),
+        ],
+    )
+    def test_validate_rejects(self, mutate, message):
+        doc = profiling.profile_document({0: _payload()})
+        mutate(doc)
+        with pytest.raises(ValueError, match=message):
+            profiling.validate_profile(doc)
+
+
+class TestExport:
+    def test_export_writes_validating_document(self, tmp_path):
+        p = profiling.SampleProfiler(enabled=False)
+        frame = _chain((f"{ENGINE}/graph.py", "process"))
+        p._ingest({1: frame}, own_tid=0, weight=0.05)
+        path = p.export(str(tmp_path))
+        assert path is not None
+        name = os.path.basename(path)
+        assert name.startswith("pathway_profile_p0_pid")
+        assert name.endswith("_001.json")
+        doc = json.loads(open(path).read())
+        profiling.validate_profile(doc)
+        # a second export supersedes, not overwrites
+        assert p.export(str(tmp_path)).endswith("_002.json")
+
+    def test_export_with_nothing_to_dump_is_none(self, tmp_path):
+        p = profiling.SampleProfiler(enabled=False)
+        assert p.export(str(tmp_path)) is None
+        assert list(tmp_path.iterdir()) == []
+
+
+# -- reconciliation against critical-path buckets -----------------------------
+
+
+class TestReconcile:
+    SAMPLES = [
+        ["ingest", "connectors:poll", 0.3, 3],
+        ["exchange", "distributed:_exchange_rounds", 0.2, 2],
+        ["device", "device_pipeline:commit", 0.1, 1],
+        ["operator", "graph:process", 0.4, 4],
+    ]
+
+    def test_synthetic_profile_matches_trace_exactly(self):
+        doc = profiling.profile_document({0: _payload(samples=self.SAMPLES)})
+        rec = profiling.reconcile_with_critical_path(
+            doc,
+            {
+                "shares": {
+                    "queue_wait": 0.3,
+                    "exchange": 0.2,
+                    "device": 0.1,
+                    "host_compute": 0.4,
+                }
+            },
+        )
+        assert rec["max_abs_diff"] == 0.0
+        assert rec["profile"] == rec["trace"]
+
+    def test_seconds_form_of_critical_path(self):
+        doc = profiling.profile_document({0: _payload(samples=self.SAMPLES)})
+        rec = profiling.reconcile_with_critical_path(
+            doc,
+            {
+                "wall_s": 2.0,
+                "queue_wait_s": 0.6,
+                "exchange_s": 0.4,
+                "device_s": 0.2,
+                "host_compute_s": 0.8,
+            },
+        )
+        assert rec["max_abs_diff"] == 0.0
+
+    def test_serving_weight_is_excluded_from_buckets(self):
+        # queries run concurrently with commits; serving samples must
+        # not skew the commit-bucket fractions
+        samples = self.SAMPLES + [["serving", "server:do_GET", 5.0, 50]]
+        doc = profiling.profile_document({0: _payload(samples=samples)})
+        rec = profiling.reconcile_with_critical_path(
+            doc,
+            {
+                "shares": {
+                    "queue_wait": 0.3,
+                    "exchange": 0.2,
+                    "device": 0.1,
+                    "host_compute": 0.4,
+                }
+            },
+        )
+        assert rec["max_abs_diff"] == 0.0
+
+
+# -- cli profile --------------------------------------------------------------
+
+
+class TestCliProfile:
+    def _export_dir(self, tmp_path):
+        d = tmp_path / "profiles"
+        d.mkdir()
+        (d / "pathway_profile_p0_pid1_001.json").write_text(
+            json.dumps(
+                profiling.profile_document(
+                    {0: _payload(samples=TestReconcile.SAMPLES)}
+                )
+            )
+        )
+        (d / "pathway_profile_p1_pid2_001.json").write_text(
+            json.dumps(
+                profiling.profile_document(
+                    {
+                        1: _payload(
+                            worker=1,
+                            samples=[["operator", "graph:process", 0.7, 7]],
+                        )
+                    }
+                )
+            )
+        )
+        return d
+
+    def test_summary_merges_directory(self, tmp_path, capsys):
+        from pathway_tpu import cli
+
+        assert cli.main(["profile", str(self._export_dir(tmp_path))]) == 0
+        out = capsys.readouterr().out
+        assert "2 worker(s)" in out
+        assert "phases (sampled seconds):" in out
+        assert "hot stacks:" in out
+
+    def test_json_mode_is_speedscope(self, tmp_path, capsys):
+        from pathway_tpu import cli
+
+        rc = cli.main(
+            ["profile", "--json", str(self._export_dir(tmp_path))]
+        )
+        assert rc == 0
+        ss = json.loads(capsys.readouterr().out)
+        assert ss["$schema"].endswith("file-format-schema.json")
+        assert len(ss["profiles"]) == 2
+
+    def test_folded_mode(self, tmp_path, capsys):
+        from pathway_tpu import cli
+
+        rc = cli.main(
+            ["profile", "--folded", str(self._export_dir(tmp_path))]
+        )
+        assert rc == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert "worker0;operator;graph:process 4" in lines
+        assert "worker1;operator;graph:process 7" in lines
+
+    def test_invalid_document_exits_2(self, tmp_path):
+        from pathway_tpu import cli
+
+        bad = tmp_path / "pathway_profile_bad.json"
+        bad.write_text(json.dumps({"version": 99, "workers": {}}))
+        assert cli.main(["profile", str(bad)]) == 2
+
+    def test_empty_directory_exits_2(self, tmp_path):
+        from pathway_tpu import cli
+
+        assert cli.main(["profile", str(tmp_path)]) == 2
+
+
+# -- mesh integration ---------------------------------------------------------
+
+PROFILED_STREAM_PROGRAM = """
+    import os
+    import pathway_tpu as pw
+    import pathway_tpu.engine.connectors as _conn
+
+    _orig_poll = _conn.FsReader.poll
+    def _poll(self):
+        entries, done = _orig_poll(self)
+        if not entries and os.path.exists({stop!r}):
+            done = True
+        return entries, done
+    _conn.FsReader.poll = _poll
+
+    words = pw.io.plaintext.read({indir!r}, mode="streaming")
+    counts = words.groupby(words.data).reduce(
+        word=words.data, cnt=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, {out!r})
+    pw.run()
+"""
+
+PROFILED_CHAOS_PROGRAM = """
+    import os
+    import pathway_tpu as pw
+    import pathway_tpu.engine.connectors as _conn
+    from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+    _orig_poll = _conn.FsReader.poll
+    def _poll(self):
+        entries, done = _orig_poll(self)
+        if not entries and os.path.exists({stop!r}):
+            done = True
+        return entries, done
+    _conn.FsReader.poll = _poll
+
+    words = pw.io.plaintext.read(
+        {indir!r}, mode="streaming", persistent_id="w"
+    )
+    counts = words.groupby(words.data).reduce(
+        word=words.data, cnt=pw.reducers.count()
+    )
+    pw.io.csv.write(counts, {out!r})
+    pw.run(
+        persistence_config=Config(
+            Backend.filesystem({store!r}),
+            persistence_mode=PersistenceMode.OPERATOR_PERSISTING,
+        ),
+    )
+"""
+
+
+def _paced_mesh_run(
+    tmp_path, program: str, env_extra: dict, n_files: int
+) -> tuple[dict, "os.PathLike"]:
+    """Spawn a 3-process mesh running ``program`` (streaming word count
+    with a stop-file), pacing ``n_files`` input files through to the
+    sink; returns the spawn result dict and the profile dir."""
+    from pathway_tpu.cli import spawn
+
+    indir = tmp_path / "in"
+    indir.mkdir()
+    out = tmp_path / "out.csv"
+    stop = tmp_path / "stop"
+    profile_dir = tmp_path / "profiles"
+    profile_dir.mkdir()
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        textwrap.dedent(
+            program.format(
+                indir=str(indir),
+                out=str(out),
+                stop=str(stop),
+                store=str(tmp_path / "store"),
+            )
+        )
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PATHWAY_PERSISTENT_STORAGE", None)
+    env["PATHWAY_TPU_PROFILE"] = "1"
+    env["PATHWAY_TPU_PROFILE_HZ"] = "200"
+    env["PATHWAY_TPU_PROFILE_DIR"] = str(profile_dir)
+    env.update(env_extra)
+    result: dict = {}
+
+    def run() -> None:
+        result["rc"] = spawn(
+            sys.executable,
+            [str(prog)],
+            threads=1,
+            processes=3,
+            first_port=_free_port_base(3),
+            env=env,
+        )
+
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        for k in range(n_files):
+            lines = [f"w{k}_{i}" for i in range(3)] + ["common"]
+            (indir / f"f{k}.txt").write_text("\n".join(lines) + "\n")
+            marker = f"w{k}_0"
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if out.exists() and marker in out.read_text():
+                    break
+                if not th.is_alive():
+                    raise AssertionError(
+                        f"mesh exited early (rc={result.get('rc')}) "
+                        f"before file {k} committed"
+                    )
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"file {k} never reached the sink "
+                    f"(rc={result.get('rc')})"
+                )
+        stop.write_text("")
+        th.join(timeout=90)
+    finally:
+        stop.write_text("")
+        th.join(timeout=10)
+    assert not th.is_alive(), "mesh did not shut down after STOP"
+    assert result.get("rc") == 0, f"mesh exited rc={result.get('rc')}"
+    return result, profile_dir
+
+
+class TestMeshProfile:
+    def test_three_process_profile_merges_and_reconciles(
+        self, tmp_path, capsys
+    ):
+        """3-process TCP mesh with profiling + tracing on: follower
+        payloads piggyback to the leader over round frames, the leader's
+        export spans >= 2 workers, ``cli profile --json`` merges the dir
+        into one speedscope-loadable document, and the profile's phase
+        mix reconciles with the traced critical-path shares within a
+        loose live bound."""
+        from pathway_tpu import cli
+
+        trace_dir = tmp_path / "traces"
+        trace_dir.mkdir()
+        _, profile_dir = _paced_mesh_run(
+            tmp_path,
+            PROFILED_STREAM_PROGRAM,
+            {
+                "PATHWAY_TPU_TRACE": "1",
+                "PATHWAY_TPU_TRACE_SAMPLE": "1",
+                "PATHWAY_TPU_TRACE_DIR": str(trace_dir),
+            },
+            n_files=4,
+        )
+
+        exports = sorted(profile_dir.glob("pathway_profile_*.json"))
+        assert exports, "no profile exports"
+        docs = [json.loads(p.read_text()) for p in exports]
+        # the leader's own export carries absorbed follower payloads
+        leader_docs = [
+            json.loads(p.read_text())
+            for p in profile_dir.glob("pathway_profile_p0_*.json")
+        ]
+        assert leader_docs, "leader exported no profile"
+        assert max(len(d["workers"]) for d in leader_docs) >= 2, (
+            "mesh piggyback delivered no follower payload to the leader"
+        )
+
+        merged = profiling.merge_documents(docs)
+        profiling.validate_profile(merged)
+        assert "0" in merged["workers"]
+        assert len(merged["workers"]) >= 2
+        assert sum(
+            p.get("sample_count", 0) for p in merged["workers"].values()
+        ) > 0
+
+        # cli profile merges the directory into speedscope JSON
+        assert cli.main(["profile", "--json", str(profile_dir)]) == 0
+        ss = json.loads(capsys.readouterr().out)
+        assert ss["$schema"].endswith("file-format-schema.json")
+        assert len(ss["profiles"]) >= 2
+
+        # phase tags reconcile with the traced critical-path shares
+        # (loose live bound: both are sampled estimates of one short run)
+        cps = []
+        for path in trace_dir.glob("pathway_trace_p0_*.json"):
+            obj = json.loads(path.read_text())
+            for t in obj.get("otherData", {}).get("traces", ()):
+                cp = t.get("critical_path")
+                if cp and not cp.get("clamped"):
+                    cps.append(cp)
+        assert cps, "no critical-path breakdowns in the trace exports"
+        wall = sum(c["wall_s"] for c in cps) or 1e-9
+        shares = {
+            "queue_wait": sum(c["queue_wait_s"] for c in cps) / wall,
+            "exchange": sum(c["exchange_s"] for c in cps) / wall,
+            "device": sum(c["device_s"] for c in cps) / wall,
+            "host_compute": sum(c["host_compute_s"] for c in cps) / wall,
+        }
+        rec = profiling.reconcile_with_critical_path(merged, {"shares": shares})
+        assert set(rec) == {"profile", "trace", "max_abs_diff"}
+        assert set(rec["profile"]) == {
+            "queue_wait",
+            "exchange",
+            "device",
+            "host_compute",
+        }
+        assert 0.0 <= rec["max_abs_diff"] <= 1.0
+
+    def test_leader_failover_merges_profiles_epoch_fenced(self, tmp_path):
+        """SIGKILL the LEADER at a commit boundary mid-profile: the mesh
+        elects a new leader, keeps streaming, and the new leader's
+        export assembles a merged profile spanning >= 2 workers whose
+        payloads all carry the post-failover epoch (the fence dropped
+        every pre-election zombie payload)."""
+        _, profile_dir = _paced_mesh_run(
+            tmp_path,
+            PROFILED_CHAOS_PROGRAM,
+            {
+                "PATHWAY_TPU_RECOVER": "1",
+                "PATHWAY_TPU_MAX_RESTARTS": "4",
+                "PATHWAY_TPU_MESH_TIMEOUT": "60",
+                "PATHWAY_TPU_RECOVER_DEADLINE": "90",
+                "PATHWAY_TPU_FAULT_PLAN": json.dumps(
+                    {
+                        "seed": 13,
+                        "faults": [
+                            {"type": "kill", "process": 0, "at_commit": 3}
+                        ],
+                    }
+                ),
+            },
+            n_files=6,
+        )
+
+        exports = sorted(profile_dir.glob("pathway_profile_*.json"))
+        assert exports, "no profile exports after failover"
+        docs = [json.loads(p.read_text()) for p in exports]
+        merged = profiling.merge_documents(docs)
+        profiling.validate_profile(merged)
+        assert len(merged["workers"]) >= 2
+
+        # the surviving leader assembled a multi-worker document, and
+        # every payload in it carries ONE post-failover epoch: absorb()
+        # fenced out anything stamped by the dead incarnation
+        multi = [d for d in docs if len(d.get("workers", {})) >= 2]
+        assert multi, "no leader export spans multiple workers"
+        fenced = False
+        for doc in multi:
+            epochs = {
+                int(p.get("epoch", 0)) for p in doc["workers"].values()
+            }
+            assert len(epochs) == 1, epochs
+            if max(epochs) >= 1:
+                fenced = True
+        assert fenced, "no multi-worker export carries a bumped epoch"

@@ -1,0 +1,604 @@
+"""Metrics history ring + SLO sentinel: tiered down-sampling rings,
+bounded store over registry snapshots, worker pruning, declarative SLO
+burn evaluation with flight-recorder events, the telemetry recorder
+loop, and the live ``/timeseries`` endpoint during a sharded run
+(reference: PR "observability")."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import timeseries
+from pathway_tpu.internals.monitoring import (
+    MonitoringHttpServer,
+    MonitoringLevel,
+    StatsMonitor,
+)
+from pathway_tpu.internals.parse_graph import G
+
+
+def _gauge_family(value: float, labels: dict | None = None) -> dict:
+    return {
+        "kind": "gauge",
+        "help": "",
+        "buckets": None,
+        "series": [{"labels": dict(labels or {}), "value": value}],
+    }
+
+
+def _hist_family(
+    bounds: list, counts: list, total: float, labels: dict | None = None
+) -> dict:
+    return {
+        "kind": "histogram",
+        "help": "",
+        "buckets": list(bounds),
+        "series": [
+            {
+                "labels": dict(labels or {}),
+                "counts": list(counts),
+                "sum": total,
+                "count": sum(counts),
+            }
+        ],
+    }
+
+
+# -- tiered series ring -------------------------------------------------------
+
+
+class TestSeriesRing:
+    def test_points_merge_tiers_finest_wins(self):
+        ring = timeseries.SeriesRing()
+        for t in (0.0, 0.4, 1.2, 10.5):
+            ring.append(t, t)
+        # first append promoted to both coarser tiers; 1.2 to mid; 10.5
+        # to both again — all still raw, so points() is raw-only
+        assert ring.points(0.0) == [
+            [0.0, 0.0],
+            [0.4, 0.4],
+            [1.2, 1.2],
+            [10.5, 10.5],
+        ]
+        assert [t for t, _ in ring.mid] == [0.0, 1.2, 10.5]
+        assert [t for t, _ in ring.coarse] == [0.0, 10.5]
+
+    def test_evicted_raw_span_is_covered_by_coarser_tiers(self):
+        ring = timeseries.SeriesRing(raw_points=4, mid_points=64,
+                                     coarse_points=64)
+        ts = [i * 0.5 for i in range(61)]  # 0..30s
+        for t in ts:
+            ring.append(t, t)
+        pts = ring.points(0.0)
+        times = [t for t, _ in pts]
+        # ascending, deduplicated
+        assert times == sorted(times)
+        assert len(times) == len(set(times))
+        # the raw ring only holds the last 4 points; the mid tier still
+        # covers the evicted span at 1s resolution
+        assert times[-4:] == [28.5, 29.0, 29.5, 30.0]
+        assert 0.0 in times and 15.0 in times
+        covered = [t for t in times if t < 28.5]
+        assert len(covered) >= 25  # ~1s resolution over the old span
+
+    def test_window_filter_and_last(self):
+        ring = timeseries.SeriesRing()
+        for t in (10.0, 20.0, 30.0):
+            ring.append(t, t * 2)
+        assert ring.points(15.0) == [[20.0, 40.0], [30.0, 60.0]]
+        assert ring.last() == (30.0, 60.0)
+        assert ring.n_points() == 3 + 3 + 3  # 10s gaps promote everywhere
+
+
+# -- bounded store ------------------------------------------------------------
+
+
+class TestTimeSeriesStore:
+    def test_observe_and_windowed_query(self):
+        store = timeseries.TimeSeriesStore(max_series=16)
+        now = 1000.0
+        for dt, v in ((-100, 1.0), (-30, 2.0), (-5, 3.0)):
+            store.observe("fam", {"worker": "0"}, v, t=now + dt)
+        res = store.query("fam", window_s=60, now=now)
+        assert res["family"] == "fam" and res["window_s"] == 60.0
+        assert [p[1] for p in res["series"][0]["points"]] == [2.0, 3.0]
+
+    def test_label_superset_filter(self):
+        store = timeseries.TimeSeriesStore(max_series=16)
+        store.observe("fam", {"worker": "0", "op": "a"}, 1.0, t=1.0)
+        store.observe("fam", {"worker": "1", "op": "a"}, 2.0, t=1.0)
+        res = store.query("fam", window_s=1e9, labels={"worker": "1"}, now=2.0)
+        assert len(res["series"]) == 1
+        assert res["series"][0]["labels"]["worker"] == "1"
+        # a label the series lacks matches nothing
+        res = store.query("fam", window_s=1e9, labels={"zone": "x"}, now=2.0)
+        assert res["series"] == []
+
+    def test_series_cap_drops_new_series_not_old_points(self):
+        store = timeseries.TimeSeriesStore(max_series=2)
+        store.observe("fam", {"worker": "0"}, 1.0, t=1.0)
+        store.observe("fam", {"worker": "1"}, 1.0, t=1.0)
+        store.observe("fam", {"worker": "2"}, 1.0, t=1.0)  # over cap
+        store.observe("fam", {"worker": "0"}, 2.0, t=2.0)  # existing: fine
+        stats = store.stats()
+        assert stats["series"] == 2
+        assert stats["dropped_series"] == 1
+        assert stats["max_points"] == 2 * (
+            timeseries.RAW_POINTS
+            + timeseries.MID_POINTS
+            + timeseries.COARSE_POINTS
+        )
+
+    def test_ingest_snapshot_scalars_histograms_and_reserved_keys(self):
+        store = timeseries.TimeSeriesStore(max_series=64)
+        snap = {
+            "pathway_queue_depth": _gauge_family(7.0, {"op": "reader"}),
+            "pathway_ingest_to_sink_latency_seconds": _hist_family(
+                [0.1, 1.0], [2, 3, 1], total=2.5
+            ),
+            "__profile__": {"v": 1},  # reserved piggyback key: skipped
+            "__trace__": [1, 2, 3],
+        }
+        store.ingest_snapshot(snap, worker="0", t=100.0)
+        fams = {f["family"] for f in store.families()}
+        assert fams == {
+            "pathway_queue_depth",
+            "pathway_ingest_to_sink_latency_seconds",
+        }
+        gauge = store.query("pathway_queue_depth", 1e9, now=101.0)
+        assert gauge["series"][0]["labels"] == {
+            "op": "reader", "worker": "0"
+        }
+        assert gauge["series"][0]["points"] == [[100.0, 7.0]]
+        # histograms become derived stat tracks, never bucket series
+        hist = store.query(
+            "pathway_ingest_to_sink_latency_seconds", 1e9, now=101.0
+        )
+        stats = {s["labels"]["stat"] for s in hist["series"]}
+        assert stats == {"count", "sum", "p50", "p95", "p99"}
+        by_stat = {
+            s["labels"]["stat"]: s["points"][0][1] for s in hist["series"]
+        }
+        assert by_stat["count"] == 6.0
+        assert by_stat["sum"] == 2.5
+        # p50: target 3 of 6 -> 1/3 into the (0.1, 1.0] bucket
+        assert by_stat["p50"] == pytest.approx(0.4, rel=1e-6)
+
+    def test_prune_workers_dead_and_width(self):
+        store = timeseries.TimeSeriesStore(max_series=16)
+        for w in ("0", "1", "5"):
+            store.observe("fam", {"worker": w}, 1.0, t=1.0)
+        store.prune_workers(dead=("1",))
+        left = {
+            s["labels"]["worker"]
+            for s in store.query("fam", 1e9, now=2.0)["series"]
+        }
+        assert left == {"0", "5"}
+        store.prune_workers(width=2)  # rescale narrowed the mesh
+        left = {
+            s["labels"]["worker"]
+            for s in store.query("fam", 1e9, now=2.0)["series"]
+        }
+        assert left == {"0"}
+
+    def test_clear(self):
+        store = timeseries.TimeSeriesStore(max_series=4)
+        store.observe("fam", {"worker": "0"}, 1.0, t=1.0)
+        store.clear()
+        assert store.stats()["series"] == 0
+        assert store.families() == []
+
+
+# -- SLO specs + sentinel -----------------------------------------------------
+
+
+class TestSloSpec:
+    def test_rejects_unknown_kind_bound_quantile(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            timeseries.SloSpec("s", "jitter", "fam", 1.0)
+        with pytest.raises(ValueError, match="bound"):
+            timeseries.SloSpec("s", "latency", "fam", 0.0)
+        with pytest.raises(ValueError, match="quantile"):
+            timeseries.SloSpec("s", "latency", "fam", 1.0, quantile="p42")
+
+    def test_budget_clamps(self):
+        assert timeseries.SloSpec(
+            "s", "latency", "fam", 1.0, budget=0.0
+        ).budget == 1e-6
+        assert timeseries.SloSpec(
+            "s", "latency", "fam", 1.0, budget=7.0
+        ).budget == 1.0
+
+    def test_dict_roundtrip(self):
+        spec = timeseries.SloSpec(
+            "lat", "latency", "fam", 0.25,
+            labels={"worker": "0"}, window_s=30.0, budget=0.05,
+            quantile="p95",
+        )
+        again = timeseries.SloSpec.from_dict(spec.to_dict())
+        assert again.to_dict() == spec.to_dict()
+
+
+class TestSloSentinel:
+    def _store_with(self, family, labels, points):
+        store = timeseries.TimeSeriesStore(max_series=16)
+        for t, v in points:
+            store.observe(family, labels, v, t=t)
+        return store
+
+    def test_latency_burn_records_one_edge_triggered_event(self):
+        name = "lat-edge-test"
+        store = self._store_with(
+            "lat_fam",
+            {"worker": "0", "stat": "p99"},
+            [(1.0, 0.5), (2.0, 0.5), (3.0, 0.5), (4.0, 0.01)],
+        )
+        spec = timeseries.SloSpec(
+            "lat-edge-test", "latency", "lat_fam", bound=0.1, budget=0.5
+        )
+        sentinel = timeseries.SloSentinel([spec])
+        events_before = len(
+            [e for e in _metrics.FLIGHT.snapshot()
+             if e.get("kind") == "slo_burn" and e.get("slo") == name]
+        )
+        reports = sentinel.evaluate(store, now=5.0)
+        # 3 of 4 points over the bound: burn = 0.75 / 0.5
+        assert reports[0]["burn"] == pytest.approx(1.5)
+        assert reports[0]["measured"] == pytest.approx(0.5)
+        burns = [
+            e for e in _metrics.FLIGHT.snapshot()
+            if e.get("kind") == "slo_burn" and e.get("slo") == name
+        ]
+        assert len(burns) == events_before + 1
+        event = burns[-1]
+        assert event["slo_kind"] == "latency"
+        assert event["family"] == "lat_fam"
+        assert event["burn"] == pytest.approx(1.5)
+        gauge = _metrics.REGISTRY.gauge(
+            "pathway_slo_burn_ratio",
+            "SLO burn ratio (> 1.0 = violating)",
+            slo=name,
+        )
+        assert gauge.value == pytest.approx(1.5)
+        # still burning: edge-triggered, no second event
+        sentinel.evaluate(store, now=5.0)
+        burns = [
+            e for e in _metrics.FLIGHT.snapshot()
+            if e.get("kind") == "slo_burn" and e.get("slo") == name
+        ]
+        assert len(burns) == events_before + 1
+        # recover (all points healthy) -> re-armed -> violate again
+        healthy = self._store_with(
+            "lat_fam", {"worker": "0", "stat": "p99"}, [(1.0, 0.01)]
+        )
+        assert sentinel.evaluate(healthy, now=5.0)[0]["burn"] < 1.0
+        sentinel.evaluate(store, now=5.0)
+        burns = [
+            e for e in _metrics.FLIGHT.snapshot()
+            if e.get("kind") == "slo_burn" and e.get("slo") == name
+        ]
+        assert len(burns) == events_before + 2
+
+    def test_queue_depth_ceiling(self):
+        store = self._store_with(
+            "depth_fam", {"worker": "0"}, [(1.0, 4.0), (2.0, 12.0)]
+        )
+        spec = timeseries.SloSpec("q", "queue_depth", "depth_fam", bound=10)
+        reports = timeseries.SloSentinel([spec]).evaluate(store, now=3.0)
+        assert reports[0]["burn"] == pytest.approx(1.2)
+        assert reports[0]["measured"] == pytest.approx(12.0)
+
+    def test_staleness_bound_reads_last_point(self):
+        store = self._store_with(
+            "stale_fam", {"worker": "0"}, [(1.0, 50.0), (2.0, 30.0)]
+        )
+        spec = timeseries.SloSpec("st", "staleness", "stale_fam", bound=10)
+        reports = timeseries.SloSentinel([spec]).evaluate(store, now=3.0)
+        assert reports[0]["burn"] == pytest.approx(3.0)
+
+    def test_throughput_floor_uses_counter_rate(self):
+        store = self._store_with(
+            "rows_fam", {"worker": "0"}, [(0.0, 0.0), (10.0, 50.0)]
+        )
+        spec = timeseries.SloSpec("tp", "throughput", "rows_fam", bound=10)
+        reports = timeseries.SloSentinel([spec]).evaluate(store, now=11.0)
+        assert reports[0]["burn"] == pytest.approx(2.0)  # 10 / (5 rows/s)
+        assert reports[0]["measured"] == pytest.approx(5.0)
+
+    def test_no_data_is_not_a_violation(self):
+        store = timeseries.TimeSeriesStore(max_series=4)
+        spec = timeseries.SloSpec("empty", "latency", "nope", bound=1.0)
+        reports = timeseries.SloSentinel([spec]).evaluate(store, now=1.0)
+        assert reports[0]["burn"] is None
+        # a single throughput point has no rate either
+        store.observe("rows_fam", {"worker": "0"}, 5.0, t=1.0)
+        spec = timeseries.SloSpec("tp1", "throughput", "rows_fam", bound=1.0)
+        reports = timeseries.SloSentinel([spec]).evaluate(store, now=2.0)
+        assert reports[0]["burn"] is None
+
+    def test_configure_from_env_inline_and_file(self, monkeypatch, tmp_path):
+        specs = [
+            {
+                "name": "lat", "kind": "latency",
+                "family": "lat_fam", "bound": 0.5,
+            }
+        ]
+        monkeypatch.setenv("PATHWAY_TPU_SLO", json.dumps(specs))
+        sentinel = timeseries.SloSentinel()
+        assert sentinel.configure() == 1
+        assert sentinel.specs()[0].name == "lat"
+        path = tmp_path / "slo.json"
+        path.write_text(json.dumps(specs * 2))
+        monkeypatch.setenv("PATHWAY_TPU_SLO", str(path))
+        assert sentinel.configure() == 2
+
+    def test_configure_bad_env_records_config_error(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_SLO", '[{"name": "broken"}]')
+        before = len(
+            [e for e in _metrics.FLIGHT.snapshot()
+             if e.get("kind") == "slo_config_error"]
+        )
+        sentinel = timeseries.SloSentinel()
+        assert sentinel.configure() == 0
+        after = len(
+            [e for e in _metrics.FLIGHT.snapshot()
+             if e.get("kind") == "slo_config_error"]
+        )
+        assert after == before + 1
+
+
+# -- telemetry recorder loop --------------------------------------------------
+
+
+class TestTelemetryLoop:
+    def test_tick_records_local_registry_under_worker_label(self):
+        _metrics.REGISTRY.gauge(
+            "test_ts_loop_gauge", "fixture", worker_kind="local"
+        ).set(42.0)
+        store = timeseries.TimeSeriesStore(max_series=4096)
+        loop = timeseries.TelemetryLoop(
+            store, timeseries.SloSentinel(), monitor=None, period_s=60.0
+        )
+        loop.tick(now=100.0)
+        res = store.query("test_ts_loop_gauge", 1e9, now=101.0)
+        assert res["series"][0]["labels"]["worker"] == "0"
+        assert res["series"][0]["points"][0][1] == 42.0
+
+    def test_tick_ingests_mesh_snapshots_with_width_filter(self):
+        # room for the full local registry snapshot plus the peers
+        store = timeseries.TimeSeriesStore(max_series=8192)
+        peer_snap = {"peer_fam": _gauge_family(1.0)}
+        monitor = SimpleNamespace(
+            scheduler=SimpleNamespace(n_processes=2, stats=None),
+            mesh_snapshots={1: peer_snap, 3: peer_snap},
+        )
+        loop = timeseries.TelemetryLoop(
+            store, timeseries.SloSentinel(), monitor=monitor, period_s=60.0
+        )
+        loop.tick(now=100.0)
+        workers = {
+            s["labels"]["worker"]
+            for s in store.query("peer_fam", 1e9, now=101.0)["series"]
+        }
+        # peer 3 is beyond the mesh width: a dead incarnation, filtered
+        assert workers == {"1"}
+
+    def test_stop_lands_a_final_tick(self):
+        _metrics.REGISTRY.gauge(
+            "test_ts_final_tick", "fixture"
+        ).set(7.0)
+        store = timeseries.TimeSeriesStore(max_series=4096)
+        loop = timeseries.TelemetryLoop(
+            store, timeseries.SloSentinel(), monitor=None, period_s=300.0
+        )
+        loop.start()
+        assert loop.running
+        loop.stop()  # period never elapsed: only the final tick records
+        assert not loop.running
+        assert store.query("test_ts_final_tick", 1e9)["series"]
+
+    def test_loop_enabled_env(self, monkeypatch):
+        monkeypatch.delenv("PATHWAY_TPU_TIMESERIES", raising=False)
+        monkeypatch.delenv("PATHWAY_TPU_SLO", raising=False)
+        assert timeseries.loop_enabled() is False
+        monkeypatch.setenv("PATHWAY_TPU_TIMESERIES", "1")
+        assert timeseries.loop_enabled() is True
+        monkeypatch.delenv("PATHWAY_TPU_TIMESERIES")
+        monkeypatch.setenv("PATHWAY_TPU_SLO", '[{"name": "x"}]')
+        assert timeseries.loop_enabled() is True
+
+    def test_start_loop_is_idempotent(self, monkeypatch):
+        monkeypatch.delenv("PATHWAY_TPU_SLO", raising=False)
+        try:
+            a = timeseries.start_loop()
+            b = timeseries.start_loop()
+            assert a is b and a.running
+        finally:
+            timeseries.stop_loop()
+            timeseries.STORE.clear()
+        timeseries.stop_loop()  # second stop is a no-op
+
+
+# -- live acceptance ----------------------------------------------------------
+
+
+class TestLiveTimeseries:
+    def test_timeseries_endpoint_during_sharded_run(self):
+        """``/timeseries`` must answer windowed queries WHILE a
+        2-worker sharded run is pumping commits, under the fixed ring
+        memory budget."""
+        from pathway_tpu.internals.runner import ShardedGraphRunner
+
+        G.clear()
+        timeseries.STORE.clear()
+        rows_out = []
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(40):
+                    self.next(k=i % 4, v=i)
+                    if i % 10 == 9:
+                        self.commit()
+                        time.sleep(0.05)
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(k=int, v=int),
+            autocommit_duration_ms=None,
+        )
+        agg = t.groupby(pw.this.k).reduce(
+            k=pw.this.k, s=pw.reducers.sum(pw.this.v)
+        )
+        pw.io.subscribe(
+            agg,
+            on_change=lambda key, row, time, is_addition: rows_out.append(
+                row
+            ),
+        )
+
+        runner = ShardedGraphRunner(2)
+        monitor = StatsMonitor(MonitoringLevel.ALL)
+        runner.monitor = monitor
+        runner.attach_sinks()
+        server = MonitoringHttpServer(monitor, port=0)
+        loop = timeseries.TelemetryLoop(
+            timeseries.STORE,
+            timeseries.SloSentinel(),
+            monitor=monitor,
+            period_s=0.05,
+        )
+        loop.start()
+        mid_run: list[dict] = []
+        done = threading.Event()
+        family = "pathway_ingest_to_sink_latency_seconds"
+
+        def poll():
+            url = (
+                f"http://127.0.0.1:{server.port}/timeseries"
+                f"?family={family}&window=60"
+            )
+            while not done.is_set():
+                try:
+                    mid_run.append(
+                        json.loads(
+                            urllib.request.urlopen(url, timeout=10)
+                            .read().decode()
+                        )
+                    )
+                except Exception:
+                    pass
+                time.sleep(0.02)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            runner.run()
+            done.set()
+            poller.join(timeout=5)
+            index = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/timeseries",
+                    timeout=10,
+                ).read().decode()
+            )
+        finally:
+            done.set()
+            loop.stop()
+            server.stop()
+            G.clear()
+            timeseries.STORE.clear()
+        assert mid_run, "no successful /timeseries query during the run"
+        live = [r for r in mid_run if r["series"]]
+        assert live, "no mid-run window carried recorded series"
+        last = live[-1]
+        assert last["family"] == family
+        stats = {s["labels"].get("stat") for s in last["series"]}
+        assert {"count", "p99"} <= stats
+        for s in last["series"]:
+            assert s["points"] == sorted(s["points"])
+        # the index view reports families + bound accounting
+        fams = {f["family"] for f in index["families"]}
+        assert family in fams
+        assert index["stats"]["series"] <= index["stats"]["max_series"]
+        assert index["stats"]["points"] <= index["stats"]["max_points"]
+
+    def test_latency_slo_burn_during_live_run(self, monkeypatch):
+        """A live run whose ingest->sink latency violates a declared
+        latency SLO must record a structured ``slo_burn`` event in the
+        flight recorder (the machine-checkable chaos-leg verdict)."""
+        G.clear()
+        timeseries.STORE.clear()
+        name = "live-ingest-latency"
+        monkeypatch.setenv(
+            "PATHWAY_TPU_SLO",
+            json.dumps(
+                [
+                    {
+                        "name": name,
+                        "kind": "latency",
+                        "family": (
+                            "pathway_ingest_to_sink_latency_seconds"
+                        ),
+                        # any real commit takes longer than 1us: the
+                        # budget burns immediately
+                        "bound": 1e-6,
+                        "budget": 0.01,
+                        "window_s": 60.0,
+                    }
+                ]
+            ),
+        )
+        monkeypatch.setenv("PATHWAY_TPU_TS_INTERVAL", "0.05")
+        before = len(
+            [e for e in _metrics.FLIGHT.snapshot()
+             if e.get("kind") == "slo_burn" and e.get("slo") == name]
+        )
+
+        class Feed(pw.io.python.ConnectorSubject):
+            def run(self):
+                for i in range(30):
+                    self.next(k=i % 3, v=i)
+                    if i % 10 == 9:
+                        self.commit()
+                        time.sleep(0.1)
+
+        t = pw.io.python.read(
+            Feed(),
+            schema=pw.schema_from_types(k=int, v=int),
+            autocommit_duration_ms=None,
+        )
+        agg = t.groupby(pw.this.k).reduce(
+            k=pw.this.k, s=pw.reducers.sum(pw.this.v)
+        )
+        pw.io.subscribe(agg, on_change=lambda *a, **k: None)
+        try:
+            pw.run(monitoring_level=MonitoringLevel.NONE)
+        finally:
+            G.clear()
+            timeseries.SENTINEL.configure([])
+            timeseries.STORE.clear()
+        burns = [
+            e for e in _metrics.FLIGHT.snapshot()
+            if e.get("kind") == "slo_burn" and e.get("slo") == name
+        ]
+        assert len(burns) == before + 1, (
+            "the live latency violation recorded no slo_burn event"
+        )
+        event = burns[-1]
+        assert event["slo_kind"] == "latency"
+        assert event["burn"] > 1.0
+        assert event["bound"] == pytest.approx(1e-6)
+        breaches = _metrics.REGISTRY.counter(
+            "pathway_slo_breaches_total",
+            "SLO burn events recorded by the sentinel",
+            slo=name,
+        )
+        assert breaches.value >= 1

@@ -12,9 +12,10 @@ problem):
    bench_dataflow.py`` must exit 0 (no warning/error findings on our own
    pipelines);
 2b. source lint self-run — ``python -m pathway_tpu.cli analyze --source
-   --strict pathway_tpu/serving pathway_tpu/engine/device_pipeline.py``
-   must exit 0: the lock-discipline (PWC4xx) and protocol (PWC5xx)
-   passes find nothing on the runtime's own threaded modules;
+   --strict`` over the runtime's own threaded modules (serving, the
+   device pipeline, the sampling profiler, the timeseries ring) must
+   exit 0: the lock-discipline (PWC4xx) and protocol (PWC5xx) passes
+   find nothing;
 3. optimize-off parity — the optimizer parity + engine-core suites rerun
    with ``PATHWAY_TPU_OPTIMIZE=0`` (the graph rewriter's escape hatch);
 4. async-device parity — the device-pipeline suite rerun with
@@ -27,6 +28,9 @@ problem):
 6. trace overhead — the same workload with sampled distributed tracing
    at the default interval vs off; FAILs when the overhead exceeds 5%
    (the same bar the metrics plane clears);
+6b. profile overhead — the same workload with the sampling profiler's
+   daemon stack sampler at its default rate vs off; FAILs when the
+   overhead exceeds 5% (the sampler's own adaptive target is 2%);
 7. async-device overhead — the same workload with a zero-cost fake
    device batch staged per commit, pipeline on vs inline decay; FAILs
    when the machinery costs more than 5%;
@@ -49,6 +53,10 @@ problem):
 12. trace export — a small traced program runs end-to-end and the
    exported file must satisfy the Chrome trace-event schema invariants
    (complete X / matched B-E events, monotonic timestamps per track);
+12b. profile export — a small PATHWAY_TPU_PROFILE=1 run exports a
+   per-process profile document and ``cli profile --json`` over the
+   export dir must validate (validate_profile) and emit structurally
+   sound speedscope JSON;
 13. lockwatch overhead — the metrics-overhead leg rerun in a
    subprocess with ``PATHWAY_TPU_LOCKWATCH=1`` (every Lock/RLock
    wrapped by the runtime lock-order recorder) vs a plain subprocess;
@@ -145,6 +153,8 @@ def step_analyzer() -> str:
 SOURCE_LINT_TARGETS = [
     "pathway_tpu/serving",
     "pathway_tpu/engine/device_pipeline.py",
+    "pathway_tpu/internals/profiling.py",
+    "pathway_tpu/internals/timeseries.py",
 ]
 
 
@@ -296,6 +306,154 @@ def step_trace_overhead() -> str:
     status = PASS if overhead <= 5.0 else FAIL
     _report(name, status, detail)
     return status
+
+
+def _profile_overhead_once() -> tuple[float | None, str]:
+    """One run of the profiler-overhead leg: (overhead_pct, detail)."""
+    import json
+
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('PROFILE_OVERHEAD_JSON ' + json.dumps("
+        "b.profile_overhead_leg()()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.SubprocessError as e:
+        return None, f"bench leg did not finish: {e}"
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROFILE_OVERHEAD_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        return None, f"bench leg exit {proc.returncode}"
+    overhead = payload["overhead_pct"]
+    detail = (
+        f"{overhead:+.2f}% "
+        f"(off {payload['profile_off_s']}s, on {payload['profile_on_s']}s, "
+        f"{payload['rate_hz']}Hz sampler)"
+    )
+    return overhead, detail
+
+
+def step_profile_overhead() -> str:
+    """Gate the sampling profiler's tax: bench_dataflow.profile_overhead_leg
+    runs the fused_chain workload with the daemon stack sampler at its
+    default rate vs off (interleaved best-of-4 each way); >5% is a FAIL —
+    the same bar every other observability plane clears, and well above
+    the sampler's own 2% adaptive target.  The sampler steals time only
+    through GIL contention, so a failure is retried once: two
+    consecutive >5% readings are signal, one is scheduler noise."""
+    name = "profile overhead (fused_chain, default-rate sampler vs off)"
+    overhead, detail = _profile_overhead_once()
+    if overhead is not None and overhead > 5.0:
+        overhead, detail = _profile_overhead_once()
+        detail += " [retried]"
+    if overhead is None:
+        _report(name, FAIL, detail)
+        return FAIL
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
+    return status
+
+
+def step_profile_export() -> str:
+    """Run a small profiled program end-to-end (PATHWAY_TPU_PROFILE=1,
+    fast sampler so even a short run lands real stacks) and hold the
+    exported document to the schema gate: ``cli profile <dir> --json``
+    must validate (validate_profile runs inside the command — exit 2 on
+    any violation) and emit structurally sound speedscope JSON."""
+    name = "profile export (cli profile --json passes validate_profile)"
+    program = (
+        "import pathway_tpu as pw\n"
+        "import os\n"
+        "d = os.environ['PROFILE_CHECK_IN']\n"
+        "t = pw.io.csv.read(d, schema=pw.schema_from_types(k=int, v=int),"
+        " mode='static')\n"
+        "t2 = t.select(pw.this.k, w=pw.this.v * 2)\n"
+        "agg = t2.groupby(pw.this.k).reduce(pw.this.k,"
+        " total=pw.reducers.sum(pw.this.w))\n"
+        "pw.io.csv.write(agg, os.path.join(d, '..', 'out.csv'))\n"
+        "pw.run(monitoring_level=pw.MonitoringLevel.NONE)\n"
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        in_dir = os.path.join(tmp, "in")
+        profile_dir = os.path.join(tmp, "profiles")
+        os.makedirs(in_dir)
+        os.makedirs(profile_dir)
+        with open(os.path.join(in_dir, "a.csv"), "w") as fh:
+            fh.write("k,v\n")
+            for i in range(20_000):
+                fh.write(f"{i % 50},{i}\n")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", program],
+                cwd=REPO,
+                env={
+                    **os.environ,
+                    "JAX_PLATFORMS": "cpu",
+                    "PATHWAY_TPU_PROFILE": "1",
+                    "PATHWAY_TPU_PROFILE_HZ": "500",
+                    "PATHWAY_TPU_PROFILE_DIR": profile_dir,
+                    "PROFILE_CHECK_IN": in_dir,
+                    "PYTHONPATH": REPO,
+                },
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+        except subprocess.SubprocessError as e:
+            _report(name, FAIL, f"profiled program did not finish: {e}")
+            return FAIL
+        if proc.returncode != 0:
+            sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+            _report(name, FAIL, f"profiled program exit {proc.returncode}")
+            return FAIL
+        try:
+            cli = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "pathway_tpu.cli",
+                    "profile",
+                    "--json",
+                    profile_dir,
+                ],
+                cwd=REPO,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
+        except subprocess.SubprocessError as e:
+            _report(name, FAIL, f"cli profile did not finish: {e}")
+            return FAIL
+        if cli.returncode != 0:
+            sys.stderr.write((cli.stdout + cli.stderr)[-2000:])
+            _report(name, FAIL, f"cli profile exit {cli.returncode}")
+            return FAIL
+        import json
+
+        try:
+            rendered = json.loads(cli.stdout)
+        except ValueError as e:
+            _report(name, FAIL, f"speedscope output is not JSON: {e}")
+            return FAIL
+        profiles = rendered.get("profiles") or []
+        if "$schema" not in rendered or not profiles:
+            _report(name, FAIL, "speedscope output missing $schema/profiles")
+            return FAIL
+        samples = sum(len(p.get("samples", [])) for p in profiles)
+        _report(name, PASS, f"{len(profiles)} profile(s), {samples} samples")
+        return PASS
 
 
 def step_trace_export() -> str:
@@ -1043,12 +1201,14 @@ def main(argv=None) -> int:
         step_async_parity(),
         step_metrics_overhead(),
         step_trace_overhead(),
+        step_profile_overhead(),
         step_async_overhead(),
         step_device_ops_parity(),
         step_device_ops_overhead(),
         step_serving_parity(),
         step_serving_overhead(),
         step_trace_export(),
+        step_profile_export(),
         step_lockwatch_overhead(),
         step_chaos_gate(),
     ]
