@@ -934,18 +934,20 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-def _mesh_groupby_once(columnar: bool, n_rows: int) -> float:
-    """One 2-process mesh commit of the groupby-sum workload, both
-    processes as threads of this interpreter over a real loopback TCP
+def _mesh_groupby_once(
+    columnar: bool, n_rows: int, n_procs: int = 2
+) -> float:
+    """One ``n_procs``-process mesh commit of the groupby-sum workload,
+    every process a thread of this interpreter over a real loopback TCP
     mesh. Returns the coordinator's commit wall time. ``columnar=False``
     forces the pickled-row-entry wire path — the baseline the dtype-tagged
     frames are measured against."""
     from pathway_tpu.engine import distributed as dist
 
-    addrs = [("127.0.0.1", p) for p in _free_ports(2)]
+    addrs = [("127.0.0.1", p) for p in _free_ports(n_procs)]
     rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(n_rows)]
-    barrier = threading.Barrier(2)
-    times = [0.0, 0.0]
+    barrier = threading.Barrier(n_procs)
+    times = [0.0] * n_procs
     errors: list[BaseException] = []
 
     def worker(pid: int) -> None:
@@ -961,9 +963,9 @@ def _mesh_groupby_once(columnar: bool, n_rows: int) -> float:
                     (make_reducer(ReducerKind.COUNT), []),
                 ],
             )
-            transport = dist.MeshTransport(pid, 2, addresses=addrs)
+            transport = dist.MeshTransport(pid, n_procs, addresses=addrs)
             sched = dist.DistributedScheduler(
-                [scope], pid, 2, transport, n_shared=len(scope.nodes)
+                [scope], pid, n_procs, transport, n_shared=len(scope.nodes)
             )
             if pid == 0:
                 sched.announce_topology()
@@ -987,7 +989,8 @@ def _mesh_groupby_once(columnar: bool, n_rows: int) -> float:
     dist.COLUMNAR_EXCHANGE = columnar
     try:
         threads = [
-            threading.Thread(target=worker, args=(pid,)) for pid in (0, 1)
+            threading.Thread(target=worker, args=(pid,))
+            for pid in range(n_procs)
         ]
         for t in threads:
             t.start()
@@ -1075,6 +1078,266 @@ def distributed_leg(n_rows: int | None = None) -> dict:
         "mesh_overhead_vs_sharded": round(t_col / t_sharded, 2),
         "mesh_overhead_vs_in_process": round(t_col / t_in, 2),
     }
+
+
+_TCP_SHARE_PROGRAM = """
+import json
+import sys
+import time
+
+from pathway_tpu.engine import ReducerKind, Scope, make_reducer, ref_scalar
+from pathway_tpu.engine import distributed as dist
+from pathway_tpu.internals import tracing as _tracing
+
+pid = int(sys.argv[1])
+n_procs = int(sys.argv[2])
+n_rows = int(sys.argv[3])
+addrs = [("127.0.0.1", int(p)) for p in sys.argv[4].split(",")]
+
+scope = Scope()
+sess = scope.input_session(2)
+scope.group_by_table(
+    sess,
+    by_cols=[0],
+    reducers=[
+        (make_reducer(ReducerKind.SUM), [1]),
+        (make_reducer(ReducerKind.COUNT), []),
+    ],
+)
+transport = dist.MeshTransport(pid, n_procs, addresses=addrs)
+sched = dist.DistributedScheduler(
+    [scope], pid, n_procs, transport, n_shared=len(scope.nodes)
+)
+if pid == 0:
+    sched.announce_topology()
+    for i in range(n_rows):
+        sess.insert(ref_scalar(i), (i % 1024, float(i)))
+else:
+    sched.receive_topology()
+_tracing.TRACER.configure(enabled=True, sample=1, clear=True)
+ctx = _tracing.TRACER.begin(sched.time, origin_mono=time.monotonic())
+sched.commit_local()
+if ctx is not None:
+    _tracing.TRACER.end(sched.time - 1)
+if pid == 0:
+    print("TCPSHARE " + json.dumps(_tracing.TRACER.summary()), flush=True)
+time.sleep(0.5)  # don't tear the mesh down under a peer mid-teardown
+transport.close()
+"""
+
+
+def _tcp_exchange_share(n_workers: int, n_rows: int) -> float:
+    """Exchange share of the coordinator's commit critical path on a
+    real ``n_workers``-process loopback TCP mesh.  Subprocesses (not
+    threads): each process owns its TRACER, so the coordinator's
+    critical-path buckets count only its own encode/apply/recv spans
+    against its own wall — a thread-sim mesh would sum every thread's
+    spans into one shared context and overshoot the wall."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False
+    ) as fh:
+        fh.write(_TCP_SHARE_PROGRAM)
+        prog = fh.name
+    ports = _free_ports(n_workers)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PATHWAY_TPU_COLLECTIVE_EXCHANGE"] = "0"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    try:
+        for pid in range(n_workers):
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        prog,
+                        str(pid),
+                        str(n_workers),
+                        str(n_rows),
+                        ",".join(str(p) for p in ports),
+                    ],
+                    env=dict(env, PATHWAY_PROCESS_ID=str(pid)),
+                    stdout=subprocess.PIPE if pid == 0 else None,
+                    text=True,
+                )
+            )
+        out0, _ = procs[0].communicate(timeout=240)
+        for p in procs[1:]:
+            p.wait(timeout=240)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        os.unlink(prog)
+    for line in (out0 or "").splitlines():
+        if line.startswith("TCPSHARE "):
+            summary = json.loads(line[len("TCPSHARE ") :])
+            mean = summary.get("critical_path_mean") or {}
+            return float((mean.get("shares") or {}).get("exchange", 0.0))
+    raise RuntimeError("mesh coordinator printed no TCPSHARE line")
+
+
+def collective_exchange_leg() -> dict:
+    """Device-colocated collective repartition
+    (engine/collective_exchange.py) vs the host exchange paths, over the
+    groupby-sum and join-inner repartition workloads:
+
+    - ``host_tcp`` — the ``n_workers``-process loopback TCP mesh (PWCF
+      frames), the wire baseline whose encode/decode/recv-blocking lands
+      in the critical path's ``exchange`` bucket;
+    - ``host`` — the in-process sharded gather/split
+      (PATHWAY_TPU_COLLECTIVE_EXCHANGE=0);
+    - ``collective`` — the shard_map + all_to_all kernel (=1) on the
+      colocated device mesh (host-platform sim in CI).
+
+    Reports rows/sec per configuration, the exchange share of commit
+    wall from the traced critical-path buckets (host-TCP vs collective —
+    the kernel moves the repartition out of the ``exchange`` bucket into
+    ``device``), and the collective event/ns/bytes counters — the bench
+    evidence the kernel engaged and the gate tools/check.py enforces."""
+    from pathway_tpu.internals import tracing as _tracing
+
+    n_rows = (
+        5_000
+        if _analyze_only()
+        else int(os.environ.get("BENCH_MESH_ROWS", "200000"))
+    )
+    gb_rows = [(ref_scalar(i), (i % 1024, float(i))) for i in range(n_rows)]
+    n_right = 1024
+    l_rows = [
+        (ref_scalar(("l", i)), (i % n_right, float(i)))
+        for i in range(n_rows // 2)
+    ]
+    r_rows = [(ref_scalar(("r", i)), (i, float(i))) for i in range(n_right)]
+
+    def _scopes(n_workers, workload):
+        from pathway_tpu.engine.sharded import ShardedScheduler
+
+        scopes, feeds = [], []
+        for _w in range(n_workers):
+            scope = Scope()
+            if workload == "groupby":
+                sess = scope.input_session(2)
+                scope.group_by_table(
+                    sess,
+                    by_cols=[0],
+                    reducers=[
+                        (make_reducer(ReducerKind.SUM), [1]),
+                        (make_reducer(ReducerKind.COUNT), []),
+                    ],
+                )
+                feeds.append((sess, None))
+            else:
+                left = scope.input_session(2)
+                right = scope.input_session(2)
+                scope.join_tables(
+                    left, right, left_on=[0], right_on=[0], kind="inner"
+                )
+                feeds.append((left, right))
+            scopes.append(scope)
+        return ShardedScheduler(scopes), feeds
+
+    def sharded_once(n_workers, workload, traced=False):
+        sched, feeds = _scopes(n_workers, workload)
+        left, right = feeds[0]
+        if workload == "groupby":
+            for key, row in gb_rows:
+                left.insert(key, row)
+        else:
+            for key, row in l_rows:
+                left.insert(key, row)
+            for key, row in r_rows:
+                right.insert(key, row)
+        t0 = time.perf_counter()
+        ctx = (
+            _tracing.TRACER.begin(sched.time, origin_mono=time.monotonic())
+            if traced
+            else None
+        )
+        sched.commit()
+        if ctx is not None:
+            _tracing.TRACER.end(sched.time - 1)
+        return time.perf_counter() - t0
+
+    def exchange_share() -> float:
+        summary = _tracing.TRACER.summary()
+        mean = summary.get("critical_path_mean") or {}
+        return float((mean.get("shares") or {}).get("exchange", 0.0))
+
+    def leg() -> dict:
+        try:
+            import jax
+        except Exception as exc:  # noqa: BLE001 — report, don't sink
+            return {"skipped": f"jax unavailable: {exc!r}"}
+        from pathway_tpu.engine import collective_exchange as _cx
+        from pathway_tpu.engine.device import device_count
+
+        n_workers = 4 if device_count() >= 4 else 2
+        if not _cx.mesh_ready(n_workers):
+            return {
+                "skipped": (
+                    f"mesh not ready: {device_count()} device(s) for "
+                    f"{n_workers} workers (set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=4)"
+                )
+            }
+        prev = os.environ.get("PATHWAY_TPU_COLLECTIVE_EXCHANGE")
+        try:
+            os.environ["PATHWAY_TPU_COLLECTIVE_EXCHANGE"] = "0"
+            gb_host = min(sharded_once(n_workers, "groupby") for _ in range(2))
+            join_host = min(sharded_once(n_workers, "join") for _ in range(2))
+            os.environ["PATHWAY_TPU_COLLECTIVE_EXCHANGE"] = "1"
+            _cx.reset_counters()
+            sharded_once(n_workers, "groupby")  # warm the jit kernels
+            sharded_once(n_workers, "join")
+            gb_col = min(sharded_once(n_workers, "groupby") for _ in range(2))
+            join_col = min(sharded_once(n_workers, "join") for _ in range(2))
+            # exchange share of commit wall, host-TCP mesh vs collective
+            _tracing.TRACER.configure(enabled=True, sample=1, clear=True)
+            try:
+                os.environ["PATHWAY_TPU_COLLECTIVE_EXCHANGE"] = "0"
+                if _analyze_only():
+                    sharded_once(n_workers, "groupby", traced=True)
+                    share_tcp = exchange_share()
+                else:
+                    # same fan-out as the collective: n_workers real mesh
+                    # processes, so the wire baseline repartitions the
+                    # same per-edge volume the kernel does
+                    share_tcp = _tcp_exchange_share(n_workers, n_rows)
+                _tracing.TRACER.configure(enabled=True, sample=1, clear=True)
+                os.environ["PATHWAY_TPU_COLLECTIVE_EXCHANGE"] = "1"
+                sharded_once(n_workers, "groupby", traced=True)
+                share_col = exchange_share()
+            finally:
+                _tracing.TRACER.configure(enabled=False, clear=True)
+            stats = _cx.stats()
+        finally:
+            if prev is None:
+                os.environ.pop("PATHWAY_TPU_COLLECTIVE_EXCHANGE", None)
+            else:
+                os.environ["PATHWAY_TPU_COLLECTIVE_EXCHANGE"] = prev
+        n_join = n_rows // 2 + n_right
+        return {
+            "rows": n_rows,
+            "workers": n_workers,
+            "backend": jax.default_backend(),
+            "groupby_host_rows_per_sec": round(n_rows / gb_host),
+            "groupby_collective_rows_per_sec": round(n_rows / gb_col),
+            "join_host_rows_per_sec": round(n_join / join_host),
+            "join_collective_rows_per_sec": round(n_join / join_col),
+            "host_tcp_exchange_share": round(share_tcp, 4),
+            "collective_exchange_share": round(share_col, 4),
+            "collective_events": stats["events"],
+            "collective_ns_total": stats["ns_total"],
+            "collective_bytes_total": stats["bytes_total"],
+        }
+
+    return leg
 
 
 _RECOVERY_PROGRAM = """
@@ -1430,6 +1693,12 @@ def run_all(emit=None) -> dict:
                 "mesh_groupby",
                 {k: v for k, v in leg.items() if k != "workload"},
             )
+        # collective repartition vs host exchange paths (+ the exchange
+        # share of commit wall each way, from the critical-path buckets)
+        try:
+            record("collective_exchange", collective_exchange_leg()())
+        except Exception as exc:
+            record("collective_exchange_error", repr(exc))
         if not _analyze_only():
             # the elastic-mesh legs each spawn a real supervised mesh:
             # follower kill + recovery, leader kill + election failover,
@@ -1531,6 +1800,14 @@ def main() -> None:
     # over a real 2-process loopback TCP mesh
     if os.environ.get("BENCH_SKIP_MESH", "").lower() not in ("1", "true"):
         print(json.dumps(distributed_leg()))
+        print(
+            json.dumps(
+                {
+                    "workload": "collective_exchange",
+                    **collective_exchange_leg()(),
+                }
+            )
+        )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,518 @@
+"""Device-colocated collective exchange: repartition delta-batch columns
+with XLA collectives instead of the host routing loop (ROADMAP item 2).
+
+When a sharded mesh's workers are all backed by devices of ONE JAX mesh
+(the in-process :class:`~pathway_tpu.engine.sharded.ShardedScheduler`, or
+a single-process :class:`~pathway_tpu.engine.distributed.DistributedScheduler`
+whose worker threads share the local device pool), the groupby/join/KNN
+repartition does not need routing.py's D2H -> PWCF-encode -> TCP ->
+decode -> H2D round-trip: the batch's raw bytes go on device ONCE, a
+``shard_map`` + ``lax.all_to_all`` kernel moves every per-destination
+bucket across the data axis, and each destination's rows come back as a
+ready :class:`~pathway_tpu.engine.batch.Columns` — the ring-collective
+idiom already used by ``pathway_tpu/parallel`` (ppermute/all-to-all over
+a named axis, per the Ragged Paged Attention TPU-kernel discipline).
+
+Mechanics (bit-exact by construction — the kernel only MOVES bytes):
+
+1. **pack** — keys (16-byte digests), the optional diff vector, and every
+   fixed-width column are viewed as raw little-endian bytes and
+   concatenated into one ``(n_rows, row_bytes)`` uint8 payload matrix.
+   Object/void columns cannot round-trip raw, so the batch *declines to
+   host* (return ``None``, the caller runs the routing.py path) — the
+   same "None IS the error channel" contract as ``columnar_shards``.
+2. **bucket** — the host-side factorized shard codes (already computed by
+   ``columnar_shards``) feed a device bucketing kernel: rows are split
+   into ``n`` contiguous source chunks (one per device), and a stable
+   argsort of ``(chunk, destination)`` builds per-chunk gather indices.
+   Variable per-destination row counts are handled by count-exchange on
+   host (the counts matrix rides along) + pad-to-max: bucket depth and
+   chunk length pad to power-of-two buckets (:func:`device_ops.bucket_size`)
+   so ragged batches reuse few compiled shapes.
+3. **exchange** — ``parallel.sharding.shard_map_norep`` maps the kernel
+   over the data axis of a :func:`parallel.mesh.make_mesh` mesh; each
+   device gathers its ``(n, depth, row_bytes)`` send buffer locally and
+   one ``lax.all_to_all`` swaps bucket ``d`` of every source to device
+   ``d``.  Dispatch is split from fetch (PR-9 overlap discipline): the
+   jitted call returns while XLA runs, the host prepares the trim
+   offsets, and the single blocking fetch happens last.
+4. **unpack** — per destination, the ``counts[s, d]``-trimmed buckets
+   concatenate in source-chunk order; chunks are contiguous ascending
+   row ranges, so the result row order equals the host path's
+   ``np.flatnonzero(shards == d)`` order exactly — sinks are
+   bit-identical with the collective on or off.
+
+Control surface (the PR-2/PR-12 parity discipline):
+
+- ``PATHWAY_TPU_COLLECTIVE_EXCHANGE=0`` — off; routing.py's host path is
+  the bit-exact fallback spec and stays the only path.
+- ``=1`` — force the collective wherever the payload is codeable and
+  enough devices exist (CI runs this under the host-platform device sim).
+- unset/auto — engage only when jax is already resident AND the default
+  backend is a real accelerator; pure-host deployments pay one cached
+  env check per delivery and nothing else.  The env is re-read per call,
+  so the knob is live mid-run.
+
+Placement is measurement-driven per edge (PR 12): a dedicated
+:class:`~pathway_tpu.optimize.placement.PlacementPolicy` instance keyed
+``("exchange", consumer_index)`` learns device-vs-host exchange ns/row
+(EMA + hysteresis + periodic re-probe), so small batches keep the cheap
+host path in auto mode; ``min_rows`` gates tiny commits outright.
+
+Observability: ``pathway_collective_exchange_events_total{kind}``
+(exchanges / declines / errors, :data:`COLLECTIVE_STATS` is the
+authoritative alias dict), ``pathway_collective_exchange_ns_total`` and
+``pathway_collective_exchange_bytes_total`` counters, plus PR-8 tracing:
+host pack/unpack time lands in the critical path's ``exchange`` bucket
+(``collective-pack`` / ``collective-unpack`` spans) and the device wall
+is recorded via :func:`device_ops.record_kernel`
+(``collective_exchange.all_to_all``) so it lands in the ``device``
+bucket — no wall second is counted twice.
+
+PR-4 composition: elided edges never reach this module — both schedulers
+check the elision set before any routing (or collective) work.  PR-6
+composition: an exchange that fails mid-flight performs NO pushes and
+returns ``None``, so the caller's host path delivers the whole batch;
+recovery/rollback never observes a half-delivered collective.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time as _time
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing as _tracing
+
+if TYPE_CHECKING:  # pragma: no cover
+    from pathway_tpu.engine.batch import Columns
+
+__all__ = [
+    "COLLECTIVE_STATS",
+    "EXCHANGE_POLICY",
+    "enabled",
+    "exchange",
+    "forced",
+    "mesh_ready",
+    "min_rows",
+    "record_host",
+    "stats",
+    "tracking",
+]
+
+_LOCK = threading.Lock()
+
+#: collective-path probe counters; the dict is the authoritative alias
+#: (same discipline as routing.EXCHANGE_STATS), mirrored into the
+#: ``pathway_collective_exchange_events_total{kind=...}`` family.
+COLLECTIVE_STATS = _metrics.MirroredCounterDict(
+    "pathway_collective_exchange_events_total",
+    "kind",
+    {
+        "exchanges": 0,            # batches repartitioned on device
+        "declined_non_codeable": 0,  # object/void column -> host path
+        "errors": 0,               # device call raised -> host path
+    },
+    help="collective exchange events by kind (mirrors COLLECTIVE_STATS)",
+)
+
+_C_NS = _metrics.REGISTRY.counter(
+    "pathway_collective_exchange_ns_total",
+    "total wall ns spent in collective exchanges (pack+kernel+unpack)",
+)
+_C_BYTES = _metrics.REGISTRY.counter(
+    "pathway_collective_exchange_bytes_total",
+    "payload bytes repartitioned through the device collective",
+)
+
+_JAX_OK: bool | None = None
+_BACKEND: str | None | bool = False  # False = not probed yet
+_ENABLED_CACHE: tuple[str, bool] | None = None
+_DEVICES_OK: dict[int, bool] = {}  # guarded-by: _LOCK — n_shards -> enough devices
+_MESH_CACHE: dict[int, Any] = {}  # guarded-by: _LOCK — n_shards -> jax Mesh
+_KERNEL_CACHE: dict[int, Any] = {}  # guarded-by: _LOCK — n_shards -> jitted all_to_all
+
+
+def _jax_ok() -> bool:
+    """jax importable (cached) — never raises."""
+    global _JAX_OK
+    if _JAX_OK is None:
+        try:
+            import jax  # noqa: F401
+            import jax.numpy  # noqa: F401
+
+            _JAX_OK = True
+        except Exception:
+            _JAX_OK = False
+    return _JAX_OK
+
+
+def _default_backend() -> str | None:
+    global _BACKEND
+    if _BACKEND is False:
+        try:
+            import jax
+
+            _BACKEND = jax.default_backend()
+        except Exception:
+            _BACKEND = None
+    return _BACKEND
+
+
+def enabled() -> bool:
+    """Whether the collective path may engage at all (env contract above).
+
+    Cached per raw env value — the delivery hot path calls this once per
+    batch, so the auto probe (backend detection) runs at most once, and
+    flipping ``PATHWAY_TPU_COLLECTIVE_EXCHANGE`` mid-run takes effect on
+    the next delivery."""
+    global _ENABLED_CACHE
+    raw = os.environ.get(
+        "PATHWAY_TPU_COLLECTIVE_EXCHANGE", ""
+    ).strip().lower()
+    cached = _ENABLED_CACHE
+    if cached is not None and cached[0] == raw:
+        return cached[1]
+    if raw in ("0", "false", "off", "no"):
+        val = False
+    elif raw in ("1", "true", "on", "yes", "force"):
+        val = _jax_ok()
+    else:
+        # auto: only with jax already resident AND a real accelerator —
+        # never silently re-route host exchanges through jax-on-CPU
+        val = (
+            "jax" in sys.modules
+            and _jax_ok()
+            and _default_backend() not in (None, "cpu")
+        )
+    _ENABLED_CACHE = (raw, val)
+    return val
+
+
+def forced() -> bool:
+    """True when ``PATHWAY_TPU_COLLECTIVE_EXCHANGE=1`` pins eligible
+    repartitions to the collective (parity CI); the per-edge policy then
+    skips measurement-driven arbitration and the ``min_rows`` gate."""
+    raw = os.environ.get(
+        "PATHWAY_TPU_COLLECTIVE_EXCHANGE", ""
+    ).strip().lower()
+    return raw in ("1", "true", "on", "yes", "force") and enabled()
+
+
+def mesh_ready(n_shards: int) -> bool:
+    """Mesh-detection rule: the collective needs one device per worker
+    shard (host-platform device sim counts — CI forces 4/8 CPU devices).
+    Cached per shard count; never raises."""
+    if n_shards < 2:
+        return False
+    with _LOCK:
+        cached = _DEVICES_OK.get(n_shards)
+    if cached is None:
+        from pathway_tpu.engine.device import device_count
+
+        cached = device_count() >= n_shards
+        with _LOCK:
+            _DEVICES_OK[n_shards] = cached
+    return cached
+
+
+def min_rows() -> int:
+    """Batches below this row count keep the host path in auto mode —
+    collective dispatch latency dominates tiny commits (forced mode
+    ignores this so CI exercises the kernel on toy batches)."""
+    try:
+        return max(
+            0,
+            int(
+                os.environ.get("PATHWAY_TPU_COLLECTIVE_MIN_ROWS", "512")
+            ),
+        )
+    except ValueError:
+        return 512
+
+
+def _policy():
+    from pathway_tpu.optimize.placement import PlacementPolicy
+
+    return PlacementPolicy(
+        enabled_fn=enabled, forced_fn=forced, min_rows_fn=min_rows
+    )
+
+
+#: per-edge device-vs-host exchange cost arbiter (PR-12 machinery with
+#: this module's gates): keyed ("exchange", consumer index), EMA ns/row
+#: per side, hysteresis + re-probe — small batches keep the host path.
+EXCHANGE_POLICY = None  # created lazily; placement imports stay off the cold path
+
+
+def _exchange_policy():
+    global EXCHANGE_POLICY
+    if EXCHANGE_POLICY is None:
+        EXCHANGE_POLICY = _policy()
+    return EXCHANGE_POLICY
+
+
+def tracking(n_shards: int) -> bool:
+    """True when the caller should time its host split and feed
+    :func:`record_host` — i.e. the collective is live for this mesh and
+    the per-edge policy is comparing sides."""
+    return enabled() and mesh_ready(n_shards)
+
+
+def record_host(edge: int, n_rows: int, ns: int) -> None:
+    """Fold one observed host-path repartition into the per-edge EMA."""
+    _exchange_policy().record("exchange", edge, False, n_rows, ns)
+
+
+# -- payload packing ----------------------------------------------------------
+
+
+def _as_bytes(arr: np.ndarray, width: int) -> np.ndarray:
+    """(n, width) raw-byte view of a contiguous fixed-width 1-D array."""
+    arr = np.ascontiguousarray(arr)
+    try:
+        return arr.view(np.uint8).reshape(len(arr), width)
+    except (TypeError, ValueError):
+        return np.frombuffer(arr.tobytes(), np.uint8).reshape(
+            len(arr), width
+        )
+
+
+def _pack_payload(columns: "Columns"):
+    """Concatenate keys | diffs | columns into one ``(n, W)`` uint8
+    payload matrix.  Returns ``(payload, layout, has_diffs)`` or
+    ``(None, None, False)`` when any column cannot round-trip raw
+    (object/void dtype) or key derivation fails — the decline channel."""
+    n = columns.n
+    try:
+        kb = np.ascontiguousarray(columns.kbytes(), np.uint8)
+    except Exception:
+        return None, None, False
+    segs = [kb.reshape(n, 16)]
+    has_diffs = columns.diffs is not None
+    if has_diffs:
+        segs.append(
+            _as_bytes(np.ascontiguousarray(columns.diffs, np.int64), 8)
+        )
+    layout: list[tuple] = []
+    for col in columns.cols:
+        if col.dtype.kind in "OV":
+            return None, None, False
+        width = col.dtype.itemsize
+        segs.append(_as_bytes(col, width))
+        layout.append((col.dtype, width))
+    return np.concatenate(segs, axis=1), layout, has_diffs
+
+
+def _unpack_rows(
+    rows: np.ndarray, layout: list, has_diffs: bool
+) -> "Columns":
+    """Inverse of :func:`_pack_payload` for one destination's row block."""
+    from pathway_tpu.engine.batch import Columns
+
+    m = len(rows)
+    kb = np.ascontiguousarray(rows[:, :16])
+    off = 16
+    diffs = None
+    if has_diffs:
+        diffs = (
+            np.ascontiguousarray(rows[:, off : off + 8])
+            .view(np.int64)
+            .ravel()
+        )
+        off += 8
+    cols = []
+    for dtype, width in layout:
+        seg = np.ascontiguousarray(rows[:, off : off + width])
+        cols.append(seg.view(dtype).ravel())
+        off += width
+    return Columns(m, cols, kbytes=kb, diffs=diffs)
+
+
+# -- the device kernel --------------------------------------------------------
+
+
+def _mesh(n: int):
+    with _LOCK:
+        mesh = _MESH_CACHE.get(n)
+    if mesh is None:
+        import jax
+
+        from pathway_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(data=n, devices=jax.devices()[:n])
+        with _LOCK:
+            _MESH_CACHE[n] = mesh
+    return mesh
+
+
+def _kernel(n: int):
+    """The jitted bucketing + all-to-all kernel for an ``n``-way mesh.
+
+    Per device: gather the local chunk's per-destination send buffer
+    ``(n, depth, W)`` from the host-built index matrix, then one
+    ``lax.all_to_all`` over the data axis delivers bucket ``d`` of every
+    source chunk to device ``d``.  Cached per worker count; jit re-specializes
+    per (chunk, depth, W) shape — all three pad to power-of-two buckets so
+    ragged batches reuse few compiled shapes."""
+    with _LOCK:
+        fn = _KERNEL_CACHE.get(n)
+    if fn is not None:
+        return fn
+    import jax
+    from jax import lax
+
+    from jax.sharding import PartitionSpec as P
+
+    from pathway_tpu.parallel.mesh import DATA_AXIS
+    from pathway_tpu.parallel.sharding import shard_map_norep
+
+    def bucket_and_swap(payload, gidx):
+        # payload: (chunk, W) local rows; gidx: (1, n, depth) local indices
+        send = payload[gidx[0]]  # (n, depth, W) per-destination buckets
+        return lax.all_to_all(
+            send, DATA_AXIS, split_axis=0, concat_axis=0
+        )
+
+    fn = jax.jit(
+        shard_map_norep(
+            bucket_and_swap,
+            mesh=_mesh(n),
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+        )
+    )
+    with _LOCK:
+        _KERNEL_CACHE[n] = fn
+    return fn
+
+
+def exchange(
+    edge: int, columns: "Columns", shards: np.ndarray, n: int
+) -> "list[Columns | None] | None":
+    """Repartition ``columns`` by the precomputed ``shards`` vector over
+    an ``n``-device collective.  Returns one :class:`Columns` per
+    destination (``None`` where a destination receives no rows), or
+    ``None`` to DECLINE — non-codeable payload, mesh not ready, policy
+    chose host, or a device error — in which case the caller runs the
+    host path and NO pushes have happened (the PR-6 rollback seam)."""
+    n_rows = columns.n
+    if n_rows == 0 or not enabled() or not mesh_ready(n):
+        return None
+    if not _exchange_policy().choose("exchange", edge, n_rows):
+        return None
+    from pathway_tpu.engine import device_ops as _device_ops
+
+    trace = _tracing.current()
+    t0 = _time.perf_counter()
+    payload, layout, has_diffs = _pack_payload(columns)
+    if payload is None:
+        COLLECTIVE_STATS["declined_non_codeable"] += 1
+        return None
+    p1 = _time.perf_counter()
+    if trace is not None:
+        # the exchange-bucket span covers ONLY the byte marshalling —
+        # the analog of the host path's pwcf-encode span; the bucketing
+        # math below is routing work (what columnar_shards/gather-split
+        # do on the host path) and stays in the host-compute residual,
+        # so the two paths' critical-path buckets compare like-for-like
+        trace.span(
+            "collective-pack",
+            "exchange",
+            t0,
+            p1,
+            rows=n_rows,
+            bytes=int(payload.nbytes),
+            edge=edge,
+        )
+    width = payload.shape[1]
+    # contiguous source chunks, padded to a power-of-two length so the
+    # jitted kernel re-specializes on few shapes (Ragged Paged Attention
+    # discipline via device_ops.bucket_size)
+    chunk = _device_ops.bucket_size(-(-n_rows // n))
+    row_chunk = np.arange(n_rows, dtype=np.int64) // chunk
+    shards64 = shards.astype(np.int64, copy=False)
+    group = row_chunk * n + shards64  # per-row (chunk, destination) code
+    counts = np.bincount(group, minlength=n * n).reshape(n, n)
+    depth = _device_ops.bucket_size(int(counts.max()))
+    padded = np.zeros((n * chunk, width), np.uint8)
+    padded[:n_rows] = payload
+    # stable argsort groups rows by (chunk, destination) with ascending
+    # original index inside each group — the exact order the host path's
+    # np.flatnonzero(shards == d) produces per destination
+    order = np.argsort(group, kind="stable")
+    sorted_group = group[order]
+    starts = np.zeros(n * n + 1, np.int64)
+    np.cumsum(counts.ravel(), out=starts[1:])
+    gidx = np.zeros((n * n, depth), np.int32)
+    gidx[sorted_group, np.arange(n_rows) - starts[sorted_group]] = (
+        order % chunk
+    ).astype(np.int32)
+    try:
+        k0 = _time.perf_counter()
+        # dispatch, then overlap: jax returns while XLA bucket-gathers and
+        # swaps; the host meanwhile derives the per-destination trim sizes,
+        # and the single blocking fetch (np.asarray) comes last — the PR-9
+        # dispatch/fetch overlap discipline
+        out_dev = _kernel(n)(padded, gidx.reshape(n, n, depth))
+        dest_counts = counts.sum(axis=0)
+        fetched = np.asarray(out_dev)
+        k1 = _time.perf_counter()
+    except Exception:
+        COLLECTIVE_STATS["errors"] += 1
+        return None
+    _device_ops.record_kernel(
+        "collective_exchange.all_to_all", int((k1 - k0) * 1e9)
+    )
+    parts: list = [None] * n
+    for d in range(n):
+        m = int(dest_counts[d])
+        if m == 0:
+            continue
+        block = fetched[d * n : (d + 1) * n]
+        rows = np.concatenate(
+            [block[s, : counts[s, d]] for s in range(n)], axis=0
+        )
+        parts[d] = _unpack_rows(rows, layout, has_diffs)
+    t1 = _time.perf_counter()
+    if trace is not None:
+        trace.span(
+            "collective-unpack",
+            "exchange",
+            k1,
+            t1,
+            rows=n_rows,
+            edge=edge,
+        )
+    total_ns = int((t1 - t0) * 1e9)
+    COLLECTIVE_STATS["exchanges"] += 1
+    _C_NS.inc(total_ns)
+    _C_BYTES.inc(float(payload.nbytes))
+    _exchange_policy().record("exchange", edge, True, n_rows, total_ns)
+    return parts
+
+
+def stats() -> dict:
+    """Structured roll-up for bench JSON / cli stats."""
+    return {
+        "enabled": enabled(),
+        "forced": forced(),
+        "events": dict(COLLECTIVE_STATS),
+        "ns_total": int(_C_NS.value),
+        "bytes_total": int(_C_BYTES.value),
+        "placement": _exchange_policy().decisions(),
+    }
+
+
+def reset_counters() -> None:
+    """Test/bench helper: zero the event counters and the per-edge policy."""
+    for key in list(COLLECTIVE_STATS):
+        COLLECTIVE_STATS[key] = 0
+    _C_NS.value = 0.0
+    _C_BYTES.value = 0.0
+    _exchange_policy().reset()

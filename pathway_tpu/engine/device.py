@@ -371,6 +371,24 @@ def segment_sum(
 # -- zero-copy device hand-off ----------------------------------------------
 
 
+_DEVICE_COUNT: int | None = None
+
+
+def device_count() -> int:
+    """Visible JAX devices, cached; 0 when jax is unavailable — never
+    raises.  Mesh-detection gates (collective exchange's one-device-per-
+    shard rule) call this on delivery hot paths, so the probe runs once."""
+    global _DEVICE_COUNT
+    if _DEVICE_COUNT is None:
+        try:
+            import jax
+
+            _DEVICE_COUNT = len(jax.devices())
+        except Exception:
+            _DEVICE_COUNT = 0
+    return _DEVICE_COUNT
+
+
 def to_device(arr: np.ndarray, sharding: Any | None = None):
     """NumPy column -> jax.Array, zero-copy where the backend allows (CPU
     dlpack aliasing; on TPU this is the single necessary host->HBM DMA)."""

@@ -63,6 +63,7 @@ from typing import Any, Sequence
 import numpy as np
 
 __all__ = [
+    "bucket_size",
     "enabled",
     "forced",
     "hit_counts",
@@ -193,14 +194,20 @@ def stats() -> dict:
 # -- shared kernel plumbing ---------------------------------------------------
 
 
-def _bucket(n: int, minimum: int = 8) -> int:
+def bucket_size(n: int, minimum: int = 8) -> int:
     """Power-of-two padding bucket — ragged batch lengths otherwise
     compile one XLA program per distinct shape (the Ragged Paged
-    Attention discipline: pad irregular segments to few static shapes)."""
+    Attention discipline: pad irregular segments to few static shapes).
+    Public: the collective exchange pads its chunk/bucket depths through
+    the same ladder so both planes share compiled-shape discipline."""
     b = minimum
     while b < n:
         b *= 2
     return b
+
+
+#: historical internal alias
+_bucket = bucket_size
 
 
 def _scatter_add():

@@ -477,6 +477,7 @@ class DevicePipeline:
 
     def stats(self) -> dict:
         """Structured roll-up for bench JSON."""
+        from pathway_tpu.engine import collective_exchange as _collective
         from pathway_tpu.engine import device_ops as _dops
 
         return {
@@ -496,6 +497,13 @@ class DevicePipeline:
             "device_ops": {
                 "enabled": _dops.enabled(),
                 "hit_counts": _dops.hit_counts(),
+            },
+            # the collective exchange dispatches through the same device
+            # (its all-to-all launches overlap host work the way staged
+            # commits do) — surface its engagement next to the pipe's
+            "collective_exchange": {
+                "enabled": _collective.enabled(),
+                "events": dict(_collective.COLLECTIVE_STATS),
             },
         }
 

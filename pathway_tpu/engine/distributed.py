@@ -1141,6 +1141,7 @@ class DistributedScheduler:
                         self.n_workers,
                     )
                 EXCHANGE_STATS["elided"] += 1
+                EXCHANGE_STATS["repartitions"] += 1
                 self.scopes[scope_idx].nodes[consumer.index].push(port, out)
                 continue
             self._route_part(consumer.index, port, consumer, out)
@@ -1149,6 +1150,8 @@ class DistributedScheduler:
         # local replica); remote processes route from the broadcast topology.
         if self.process_id != 0:
             for cons_idx, port in self.extra_consumers.get(producer.index, ()):
+                EXCHANGE_STATS["host_deliveries"] += 1
+                EXCHANGE_STATS["repartitions"] += 1
                 self._push_remote_batch(0, cons_idx, port, 0, out)
 
     def _route_part(
@@ -1162,6 +1165,8 @@ class DistributedScheduler:
             # pinned whole to worker 0 (sink chain / globally-stateful op):
             # push the batch object itself, no copy (ShardedScheduler does
             # the same — consumers never mutate received batches)
+            EXCHANGE_STATS["host_deliveries"] += 1
+            EXCHANGE_STATS["repartitions"] += 1
             if self.process_id == 0:
                 self.scopes[0].nodes[cons_idx].push(port, out)
             else:
@@ -1179,6 +1184,8 @@ class DistributedScheduler:
                 cons_idx, port, out, shards
             ):
                 return
+        EXCHANGE_STATS["host_deliveries"] += 1
+        EXCHANGE_STATS["repartitions"] += 1
         parts: list[list] = [[] for _ in range(self.n_workers)]
         shards = entry_shards(
             partition_rule(consumer, port), out.entries, self.n_workers
@@ -1218,18 +1225,51 @@ class DistributedScheduler:
         shards push gathered ``Columns`` (no serialization at all), remote
         shards ship dtype-tagged frames. Returns False — with NO pushes
         performed — when some shard must go remote but the payload cannot
-        frame-encode, so the caller's row path handles the whole batch."""
+        frame-encode, so the caller's row path handles the whole batch.
+
+        When every destination worker is local to THIS process (the
+        single-process mesh — worker threads sharing one device pool),
+        the repartition may go through the device collective instead of
+        the per-worker gather loop; declines fall through to the host
+        split below.  Cross-process destinations keep the TCP/PWCF plane:
+        device collectives only span one process's JAX mesh."""
+        from pathway_tpu.engine import collective_exchange as _collective
+
         cols = out.columns
         workers = np.unique(shards).tolist()
-        if any(
+        any_remote = any(
             self._owner(w)[0] != self.process_id for w in workers
-        ):
+        )
+        if not any_remote:
+            cparts = _collective.exchange(
+                cons_idx, cols, shards, self.n_workers
+            )
+            if cparts is not None:
+                EXCHANGE_STATS["collective_deliveries"] += 1
+                EXCHANGE_STATS["repartitions"] += 1
+                for worker, part in enumerate(cparts):
+                    if part is None:
+                        continue
+                    _process, scope_idx = self._owner(worker)
+                    batch = DeltaBatch.from_columns(
+                        part,
+                        consolidated=out._consolidated,
+                        insert_only=out._insert_only,
+                    )
+                    batch._raw_insert_only = out._raw_insert_only
+                    self.scopes[scope_idx].nodes[cons_idx].push(port, batch)
+                return True
+        if any_remote:
             if not _frame_encodable(cols):
                 return False
             try:
                 cols.kbytes()  # force lazy keys BEFORE any local push
             except Exception:
                 return False
+        EXCHANGE_STATS["host_deliveries"] += 1
+        EXCHANGE_STATS["repartitions"] += 1
+        track = not any_remote and _collective.tracking(self.n_workers)
+        t0 = _walltime.perf_counter_ns() if track else 0
         for worker in workers:
             idx = np.flatnonzero(shards == worker)
             part = cols.gather(idx)
@@ -1250,6 +1290,10 @@ class DistributedScheduler:
                     out._consolidated, out._insert_only,
                     out._raw_insert_only,
                 )
+        if track:
+            _collective.record_host(
+                cons_idx, cols.n, _walltime.perf_counter_ns() - t0
+            )
         return True
 
     def _apply_remote(self, deliveries: list[tuple]) -> bool:
@@ -1620,9 +1664,15 @@ class DistributedScheduler:
                         t0 = _walltime.perf_counter()
                     frame = self._recv_round(peer, time, round_no)
                     if ctx is not None:
+                        # blocking on a peer's round frame is wire-exchange
+                        # latency, not ingest queueing: it lands in the
+                        # critical path's exchange bucket so the host-TCP
+                        # exchange share is comparable against the device
+                        # collective (engine/collective_exchange.py), which
+                        # has no wire to wait on
                         ctx.span(
                             f"recv-wait:p{peer}",
-                            "wait",
+                            "exchange",
                             t0,
                             _walltime.perf_counter(),
                             round=round_no,

@@ -53,6 +53,13 @@ __all__ = [
 #: Writes mirror into the ``pathway_exchange_events_total{kind=...}``
 #: registry counters (internals/metrics.py) while this dict stays the
 #: authoritative alias all three import paths share.
+#:
+#: Every per-(consumer, port) delivery decision increments exactly ONE of
+#: ``elided`` / ``host_deliveries`` / ``collective_deliveries`` AND
+#: ``repartitions`` — so ``elided + host + collective == repartitions``
+#: holds at all times (cross-checked by tests/test_collective_exchange.py).
+#: The mirrored series carry a ``path`` label (elided / host / device /
+#: total) distinguishing the delivery plane per edge.
 EXCHANGE_STATS = _metrics.MirroredCounterDict(
     "pathway_exchange_events_total",
     "kind",
@@ -61,8 +68,20 @@ EXCHANGE_STATS = _metrics.MirroredCounterDict(
         "columnar_frames_received": 0,
         "row_batches_sent": 0,
         "elided": 0,
+        "host_deliveries": 0,
+        "collective_deliveries": 0,
+        "repartitions": 0,
     },
     help="exchange-path events by kind (mirrors EXCHANGE_STATS)",
+    extra_labels={
+        "columnar_frames_sent": {"path": "host"},
+        "columnar_frames_received": {"path": "host"},
+        "row_batches_sent": {"path": "host"},
+        "elided": {"path": "elided"},
+        "host_deliveries": {"path": "host"},
+        "collective_deliveries": {"path": "device"},
+        "repartitions": {"path": "total"},
+    },
 )
 
 

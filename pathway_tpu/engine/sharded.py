@@ -238,8 +238,25 @@ class ShardedScheduler:
         """Exchange step: split ``out`` per consumer and push each part to
         the consumer's replica on the owning worker. The consumer topology
         comes from worker 0's scope — the superset, since sinks attach
-        there only."""
+        there only.
+
+        Delivery planes, in decision order (every branch counts exactly
+        one of elided/host/collective plus ``repartitions``):
+
+        1. optimizer-elided edges skip all routing (PR 4) — checked
+           BEFORE the collective is even considered;
+        2. pinned consumers take the whole batch on worker 0 (host);
+        3. columnar batches on a device-colocated mesh may repartition
+           through engine/collective_exchange (one all-to-all instead of
+           n gather+push hops) — a decline falls through to
+        4. the host columnar gather split, then
+        5. the row-entry fallback.
+        """
+        import time as _walltime
+
         import numpy as np
+
+        from pathway_tpu.engine import collective_exchange as _collective
 
         elided = self._elided
         for consumer, port in self.scopes[0].nodes[producer.index].consumers:
@@ -249,16 +266,45 @@ class ShardedScheduler:
                 if _VERIFY_ELISION:
                     _assert_colocated(consumer, port, out, worker, self.n)
                 EXCHANGE_STATS["elided"] += 1
+                EXCHANGE_STATS["repartitions"] += 1
                 self.scopes[worker].nodes[consumer.index].push(port, out)
                 continue
             fn = self._partition_fn(consumer, port)
             if fn is None:
+                EXCHANGE_STATS["host_deliveries"] += 1
+                EXCHANGE_STATS["repartitions"] += 1
                 target = self.scopes[0].nodes[consumer.index]
                 target.push(port, out)
                 continue
             if out._entries is None and out.columns is not None:
                 shards = self._columnar_shards(consumer, port, out)
                 if shards is not None:
+                    cparts = _collective.exchange(
+                        consumer.index, out.columns, shards, self.n
+                    )
+                    if cparts is not None:
+                        EXCHANGE_STATS["collective_deliveries"] += 1
+                        EXCHANGE_STATS["repartitions"] += 1
+                        for w, cols in enumerate(cparts):
+                            if cols is None:
+                                continue
+                            part = DeltaBatch.from_columns(
+                                cols,
+                                consolidated=out._consolidated,
+                                insert_only=out._insert_only,
+                            )
+                            part._raw_insert_only = out._raw_insert_only
+                            self.scopes[w].nodes[consumer.index].push(
+                                port, part
+                            )
+                        continue
+                    # host gather split — timed only while the per-edge
+                    # exchange policy is comparing sides (one cached env
+                    # check otherwise)
+                    track = _collective.tracking(self.n)
+                    t0 = _walltime.perf_counter_ns() if track else 0
+                    EXCHANGE_STATS["host_deliveries"] += 1
+                    EXCHANGE_STATS["repartitions"] += 1
                     for w in range(self.n):
                         idx = np.flatnonzero(shards == w)
                         if not len(idx):
@@ -272,7 +318,15 @@ class ShardedScheduler:
                         self.scopes[w].nodes[consumer.index].push(
                             port, part
                         )
+                    if track:
+                        _collective.record_host(
+                            consumer.index,
+                            out.columns.n,
+                            _walltime.perf_counter_ns() - t0,
+                        )
                     continue
+            EXCHANGE_STATS["host_deliveries"] += 1
+            EXCHANGE_STATS["repartitions"] += 1
             parts: list[list[Entry]] = [[] for _ in range(self.n)]
             shards = entry_shards(
                 partition_rule(consumer, port), out.entries, self.n

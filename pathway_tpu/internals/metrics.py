@@ -645,15 +645,26 @@ class MirroredCounterDict(dict):
     call sites all go through ``d[key] += 1`` (or ``d[key] = 0`` from
     tests), i.e. ``__setitem__`` with the new absolute total — so the
     mirror *sets* the counter's value, keeping the historical dict alias
-    (imported by sharded.py and distributed.py) alive and authoritative."""
+    (imported by sharded.py and distributed.py) alive and authoritative.
+
+    ``extra_labels`` attaches additional constant labels per key — e.g.
+    EXCHANGE_STATS tags every kind with a ``path`` label (elided / host /
+    device / total) so the exposition can distinguish delivery planes
+    without breaking the flat dict the engine increments."""
 
     def __init__(
-        self, metric: str, label: str, initial: dict, help: str = ""
+        self,
+        metric: str,
+        label: str,
+        initial: dict,
+        help: str = "",
+        extra_labels: dict | None = None,
     ) -> None:
         super().__init__(initial)
         self._metric = metric
         self._label = label
         self._help = help
+        self._extra = dict(extra_labels or {})
         self._series: dict[Any, Counter] = {}
         for key, value in initial.items():
             self[key] = value
@@ -662,9 +673,9 @@ class MirroredCounterDict(dict):
         dict.__setitem__(self, key, value)
         c = self._series.get(key)
         if c is None:
-            c = REGISTRY.counter(
-                self._metric, self._help, **{self._label: str(key)}
-            )
+            labels = {self._label: str(key)}
+            labels.update(self._extra.get(key, {}))
+            c = REGISTRY.counter(self._metric, self._help, **labels)
             self._series[key] = c
         c.value = float(value)
 

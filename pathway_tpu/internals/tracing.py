@@ -38,12 +38,13 @@ Chrome trace-event export (:func:`chrome_trace`) needs and
 :func:`validate_chrome_trace` enforces.
 
 Critical-path attribution (:func:`critical_path`) buckets each traced
-commit's wall time into ``queue_wait`` (connector ingest wait plus
-mesh recv blocking), ``exchange`` (PWCF encode + decode/apply),
-``device`` (native ``kernel_ns`` deltas), and ``host_compute`` (the
-residual) — the four sum to the commit wall exactly, so downstream
-consumers (bench JSON, the async-device-pipeline work) can trust the
-decomposition.
+commit's wall time into ``queue_wait`` (connector ingest wait plus any
+``cat="wait"`` spans), ``exchange`` (PWCF encode + decode/apply, mesh
+recv blocking during commit exchange rounds, and the collective
+exchange's pack/unpack marshalling), ``device`` (native ``kernel_ns``
+deltas), and ``host_compute`` (the residual) — the four sum to the
+commit wall exactly, so downstream consumers (bench JSON, the
+async-device-pipeline work) can trust the decomposition.
 """
 
 from __future__ import annotations
@@ -619,8 +620,11 @@ def critical_path(trace: dict) -> dict:
     chain of significant spans in timestamp order.
 
     The buckets sum to ``wall_s`` exactly by construction: queue-wait is
-    the ingest wait (begin - origin) plus measured recv blocking,
-    exchange is measured encode/apply time, device is the native
+    the ingest wait (begin - origin) plus ``cat="wait"`` spans, exchange
+    is measured encode/apply/marshalling time plus mesh recv blocking
+    during commit exchange rounds (wire latency is exchange cost — the
+    device collective has no wire, which is exactly what the
+    collective_exchange bench leg compares), device is the native
     ``kernel_ns`` delta, and host-compute is the residual (clamped at
     zero, flagged via ``clamped``)."""
     wall = max(1e-9, trace["end_wall"] - trace["origin_wall"])

@@ -17,7 +17,10 @@ At delivery time the sharded/distributed schedulers check the returned
 ``(producer_index, consumer_index, port)`` set *before* running routing
 digests: a marked edge pushes the whole batch to the co-located replica,
 skipping ``columnar_shards``/``entry_shards`` and, on the TCP mesh, the
-PWCF encode/decode round-trip.
+PWCF encode/decode round-trip.  Elision also outranks the device
+collective plane (engine/collective_exchange.py): an elided edge never
+reaches the collective consult — the cheapest exchange is the one that
+does not happen, on host OR device.
 """
 
 from __future__ import annotations

@@ -52,7 +52,15 @@ class PlacementPolicy:
     across shards share a key, so their samples pool into one decision
     (the sharded scheduler runs replicas lockstep on one thread; the
     distributed scheduler pools per process, which is the granularity
-    that owns a device)."""
+    that owns a device).
+
+    The enable/force/min-rows gates are pluggable so other device planes
+    reuse the EMA/hysteresis machinery against their own env contract:
+    the default instance (:data:`POLICY`) gates on
+    ``PATHWAY_TPU_DEVICE_OPS``; the collective exchange
+    (``engine/collective_exchange.py``) instantiates its own policy
+    gated on ``PATHWAY_TPU_COLLECTIVE_EXCHANGE`` to learn per-edge
+    device-vs-host exchange cost."""
 
     #: calls of each side to observe before judging
     PROBE_CALLS = 3
@@ -61,9 +69,29 @@ class PlacementPolicy:
     #: re-probe the losing side every this many calls
     REPROBE_EVERY = 256
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        enabled_fn=None,
+        forced_fn=None,
+        min_rows_fn=None,
+    ) -> None:
         self._lock = threading.Lock()
         self._stats: dict = {}
+        self._enabled_fn = enabled_fn
+        self._forced_fn = forced_fn
+        self._min_rows_fn = min_rows_fn
+
+    def _gates(self):
+        """Resolve the (enabled, forced, min_rows) gate callables —
+        lazily bound to device_ops for the default instance so importing
+        this module never pulls the engine in."""
+        if self._enabled_fn is None:
+            from pathway_tpu.engine import device_ops as _dops
+
+            self._enabled_fn = _dops.enabled
+            self._forced_fn = _dops.forced
+            self._min_rows_fn = min_rows
+        return self._enabled_fn, self._forced_fn, self._min_rows_fn
 
     def _entry(self, key) -> dict:
         st = self._stats.get(key)
@@ -89,13 +117,12 @@ class PlacementPolicy:
     def choose(self, kind: str, index: int, n_rows: int) -> bool:
         """True → run this batch on device.  Called on the batch hot path,
         so the disabled case must stay one cached env check."""
-        from pathway_tpu.engine import device_ops as _dops
-
-        if not _dops.enabled():
+        enabled_fn, forced_fn, min_rows_fn = self._gates()
+        if not enabled_fn():
             return False
-        if _dops.forced():
+        if forced_fn():
             return True
-        if n_rows < min_rows():
+        if n_rows < min_rows_fn():
             return False
         with self._lock:
             st = self._entry((kind, index))

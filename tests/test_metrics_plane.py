@@ -416,8 +416,10 @@ class TestExchangeStatsAbsorption:
     def test_writes_mirror_into_registry_counter(self):
         from pathway_tpu.engine.routing import EXCHANGE_STATS
 
+        # the mirrored series carry the delivery-path label alongside
+        # the kind (elided / host / device / total)
         c = _metrics.REGISTRY.counter(
-            "pathway_exchange_events_total", kind="elided"
+            "pathway_exchange_events_total", kind="elided", path="elided"
         )
         EXCHANGE_STATS["elided"] += 1
         assert c.value == float(EXCHANGE_STATS["elided"])

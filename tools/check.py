@@ -42,6 +42,17 @@ problem):
    env check per commit) with no device present, stubbed vs live; FAILs
    when the machinery costs more than 5% (one retry absorbs timer
    noise — the hook cost is nanoseconds against millisecond commits);
+9b. collective parity — the groupby repartition leg rerun with
+   ``PATHWAY_TPU_COLLECTIVE_EXCHANGE=0`` (host gather/split spec) and
+   ``=1`` (shard_map + all_to_all on the 4-device host sim) in separate
+   processes; the merged sinks must be bit-identical and the ON run
+   must have engaged the kernel (exchanges > 0);
+9c. bench device-sim legs — ``run_all`` under
+   ``XLA_FLAGS=--xla_force_host_platform_device_count=4`` with reduced
+   rows must land a complete JSON: every leg present and non-null (or
+   ``skipped:``-marked), no ``*_error`` entries, and the
+   ``collective_exchange`` leg showing exchange critical-path share
+   strictly below the host-TCP baseline with events > 0;
 10. serving parity — the snapshot read plane's invariant corpus: a
    published view must equal a synchronous read at the same commit
    (single-worker, sharded, live KNN dataflow), COW views freeze,
@@ -152,6 +163,7 @@ def step_analyzer() -> str:
 #: "tools/check.py runs exactly this command" points here
 SOURCE_LINT_TARGETS = [
     "pathway_tpu/serving",
+    "pathway_tpu/engine/collective_exchange.py",
     "pathway_tpu/engine/device_pipeline.py",
     "pathway_tpu/internals/profiling.py",
     "pathway_tpu/internals/timeseries.py",
@@ -912,6 +924,224 @@ def step_device_ops_overhead() -> str:
     return status
 
 
+def _device_sim_env(**extra: str) -> dict[str, str]:
+    """Env for the host-platform device sim: 4 fake CPU devices, the
+    colocated-mesh configuration every collective gate runs under."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **extra}
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    return env
+
+
+#: every leg run_all must land in the device-sim config — a missing or
+#: null entry means a leg died mid-bench (the BENCH_r04/r05 rc=124 mode)
+#: instead of reporting ``skipped: <reason>``
+BENCH_REQUIRED_LEGS = [
+    "groupby_sum",
+    "filter_expr",
+    "wordcount",
+    "join_inner",
+    "join_multikey",
+    "incremental_update",
+    "fused_chain",
+    "pushdown_wide_source",
+    "metrics_overhead",
+    "trace_overhead",
+    "profile_overhead",
+    "async_device_overhead",
+    "device_ops",
+    "device_ops_overhead",
+    "mesh_groupby",
+    "collective_exchange",
+    "mesh_recovery",
+    "leader_failover",
+    "rescale",
+    "native",
+]
+
+
+def step_bench_device_sim() -> str:
+    """Bench-trajectory gate: run_all in the device-sim config
+    (4 host-platform devices, reduced row counts so the pass fits the
+    wall budget) must land a COMPLETE JSON — every leg present and
+    non-null, legs that cannot run marked ``skipped: <reason>``, no
+    ``*_error`` entries.  On top of completeness, the acceptance bar for
+    the collective exchange: its leg must actually engage the kernel
+    (events > 0) and show exchange critical-path share strictly below
+    the host-TCP baseline for the same workload."""
+    name = "bench device-sim legs (run_all, 4 host-sim devices)"
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('RUN_ALL_JSON ' + json.dumps(b.run_all()))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env=_device_sim_env(
+                BENCH_DATAFLOW_ROWS="60000", BENCH_MESH_ROWS="40000"
+            ),
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+    except subprocess.SubprocessError as e:
+        _report(name, FAIL, f"bench pass did not finish: {e}")
+        return FAIL
+    import json
+
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("RUN_ALL_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        _report(name, FAIL, f"bench pass exit {proc.returncode}")
+        return FAIL
+    problems = []
+    for key, value in payload.items():
+        if key.endswith("_error"):
+            problems.append(f"{key}: {value}")
+        elif value is None:
+            problems.append(f"{key} is null")
+        elif isinstance(value, dict) and "skipped" not in value:
+            nulls = [k for k, v in value.items() if v is None]
+            if nulls:
+                problems.append(f"{key} has null field(s) {nulls}")
+    missing = [leg for leg in BENCH_REQUIRED_LEGS if leg not in payload]
+    if missing:
+        problems.append(f"missing leg(s) {missing}")
+    col = payload.get("collective_exchange")
+    if isinstance(col, dict) and "skipped" in col:
+        # 4 sim devices were forced, so the colocated mesh must form
+        problems.append(f"collective_exchange skipped: {col['skipped']}")
+    elif isinstance(col, dict):
+        events = (col.get("collective_events") or {}).get("exchanges", 0)
+        share_tcp = col.get("host_tcp_exchange_share")
+        share_col = col.get("collective_exchange_share")
+        if not events:
+            problems.append("collective path never engaged (0 exchanges)")
+        if (
+            share_tcp is None
+            or share_col is None
+            or not share_col < share_tcp
+        ):
+            problems.append(
+                f"collective exchange share {share_col} not strictly "
+                f"below host-TCP baseline {share_tcp}"
+            )
+    if problems:
+        _report(name, FAIL, "; ".join(problems))
+        return FAIL
+    col_detail = ""
+    if isinstance(col, dict) and "skipped" not in col:
+        col_detail = (
+            f"; exchange share {col['collective_exchange_share']} vs "
+            f"host-TCP {col['host_tcp_exchange_share']}, "
+            f"{col['collective_events']['exchanges']} exchanges"
+        )
+    _report(name, PASS, f"{len(payload)} legs{col_detail}")
+    return PASS
+
+
+_COLLECTIVE_PARITY_PROGRAM = """
+import json
+
+from pathway_tpu.engine import ReducerKind, Scope, make_reducer, ref_scalar
+from pathway_tpu.engine import collective_exchange as cx
+from pathway_tpu.engine.sharded import ShardedScheduler
+
+scopes, sessions, aggs = [], [], []
+for _w in range(4):
+    sc = Scope()
+    sess = sc.input_session(2)
+    agg = sc.group_by_table(
+        sess,
+        by_cols=[0],
+        reducers=[
+            (make_reducer(ReducerKind.SUM), [1]),
+            (make_reducer(ReducerKind.COUNT), []),
+        ],
+    )
+    scopes.append(sc)
+    sessions.append(sess)
+    aggs.append(agg)
+sched = ShardedScheduler(scopes)
+sess = sessions[0]
+live = {}
+for i in range(20000):
+    live[i] = (i % 512, float(i))
+    sess.insert(ref_scalar(i), live[i])
+sched.commit()
+for i in range(0, 6000, 3):
+    sess.remove(ref_scalar(i), live.pop(i))
+sched.commit()
+merged = {}
+for agg in aggs:
+    merged.update(agg.current)
+sinks = {repr(k): [float(x) for x in v] for k, v in merged.items()}
+print("SINKS " + json.dumps(sinks, sort_keys=True))
+print("EXCHANGES " + str(cx.COLLECTIVE_STATS["exchanges"]))
+"""
+
+
+def step_collective_parity() -> str:
+    """Collective-parity gate: the groupby repartition leg reruns with
+    the collective exchange forced OFF (PATHWAY_TPU_COLLECTIVE_EXCHANGE=0,
+    host gather/split spec) and forced ON (=1, shard_map + all_to_all on
+    the 4-device sim mesh) in separate processes, and the merged sink
+    tables must diff clean — bit-identical bytes on stdout.  The ON run
+    must also prove the kernel engaged (exchanges > 0): a parity pass
+    where the collective silently declined would be vacuous."""
+    name = "collective parity (leg rerun, COLLECTIVE_EXCHANGE=0 vs 1)"
+    import json
+
+    outs = {}
+    for mode in ("0", "1"):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _COLLECTIVE_PARITY_PROGRAM],
+                cwd=REPO,
+                env=_device_sim_env(PATHWAY_TPU_COLLECTIVE_EXCHANGE=mode),
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+        except subprocess.SubprocessError as e:
+            _report(name, FAIL, f"mode {mode} did not finish: {e}")
+            return FAIL
+        if proc.returncode != 0:
+            sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+            _report(name, FAIL, f"mode {mode} exit {proc.returncode}")
+            return FAIL
+        lines = dict(
+            line.split(" ", 1)
+            for line in proc.stdout.splitlines()
+            if " " in line
+        )
+        outs[mode] = lines
+    if outs["0"].get("SINKS") != outs["1"].get("SINKS"):
+        _report(name, FAIL, "sinks differ between collective off and on")
+        return FAIL
+    if int(outs["1"].get("EXCHANGES", "0")) <= 0:
+        _report(name, FAIL, "collective-on rerun never engaged the kernel")
+        return FAIL
+    if int(outs["0"].get("EXCHANGES", "1")) != 0:
+        _report(name, FAIL, "collective-off rerun still ran the kernel")
+        return FAIL
+    n_groups = len(json.loads(outs["1"]["SINKS"]))
+    _report(
+        name,
+        PASS,
+        f"{n_groups} sink groups identical, "
+        f"{outs['1']['EXCHANGES']} exchanges on",
+    )
+    return PASS
+
+
 #: serving-parity gate: the snapshot read plane's invariant corpus —
 #: COW view freezing, refcounted reclamation, restore refusals, and the
 #: published-view == synchronous-read parity runs (single-worker,
@@ -1205,6 +1435,8 @@ def main(argv=None) -> int:
         step_async_overhead(),
         step_device_ops_parity(),
         step_device_ops_overhead(),
+        step_collective_parity(),
+        step_bench_device_sim(),
         step_serving_parity(),
         step_serving_overhead(),
         step_trace_export(),
