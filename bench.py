@@ -1487,6 +1487,46 @@ _DATAFLOW_PREFETCH: dict = {}
 _DATAFLOW_THREAD: list = []  # the live prefetch thread, if one started
 
 
+def _spawn_probe_sentinel(deadline: float, window: float):
+    """GIL-free watchdog for the first-contact probe: a child process
+    that shares no GIL with the (possibly wedged) parent, waits until
+    ``deadline``, then prints the probe-outage JSON on the inherited
+    stdout and SIGKILLs the parent. Exits silently if the parent dies
+    on its own (getppid flips to the reaper) or is disarmed via
+    ``.kill()`` once the probe loop demonstrably runs Python again."""
+    import subprocess
+
+    code = (
+        "import json,os,signal,sys,time\n"
+        "ppid=int(sys.argv[1]);deadline=float(sys.argv[2]);window=sys.argv[3]\n"
+        "while time.time()<deadline:\n"
+        "    time.sleep(1.0)\n"
+        "    if os.getppid()!=ppid: sys.exit(0)\n"
+        "if os.getppid()!=ppid: sys.exit(0)\n"
+        "print(json.dumps({'metric':'streaming_rag_pipeline_docs_per_sec',"
+        "'value':None,'unit':'docs/sec','vs_baseline':None,"
+        "'error':'accelerator unreachable: probe window '+window+'s "
+        "passed with init wedged in a non-Python hang (GIL held through "
+        "a C call); killed by the probe sentinel',"
+        "'truncated':True,'device_unreachable':True,"
+        "'extra':{'probe_window_s':float(window),'probe_sentinel':True}}"
+        "),flush=True)\n"
+        "try: os.kill(ppid,signal.SIGKILL)\n"
+        "except ProcessLookupError: pass\n"
+    )
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            code,
+            str(os.getpid()),
+            str(deadline),
+            f"{window:.0f}",
+        ],
+        stdin=subprocess.DEVNULL,
+    )
+
+
 def _probe_device_retrying() -> None:
     """Wait for first accelerator contact, reprobing ACROSS the bench
     window instead of one fixed probe (the remote-device tunnel has
@@ -1506,17 +1546,31 @@ def _probe_device_retrying() -> None:
         )
     )
     # a dead probe must not eat the whole window (BENCH_r05: rc=124 with
-    # ZERO parsed legs): with a wall budget set, probing gets at most a
-    # fraction of it — the rest stays reserved for the host dataflow
-    # legs, so the run always emits their JSON inside the deadline
+    # ZERO parsed legs): first contact gets at most BENCH_PROBE_FRACTION
+    # of the available time — a fraction of the wall budget when one is
+    # set, else a fraction of the window itself. The cap is UNCONDITIONAL:
+    # an unbudgeted run against a never-initializing backend self-bounds
+    # and emits its host-leg JSON instead of dying to an external timeout
+    fraction = max(
+        0.01,
+        min(1.0, float(os.environ.get("BENCH_PROBE_FRACTION", "0.25"))),
+    )
     if WALL_BUDGET_S > 0:
-        fraction = float(os.environ.get("BENCH_PROBE_FRACTION", "0.25"))
-        window = min(window, WALL_BUDGET_S * max(0.05, min(1.0, fraction)))
+        window = min(window, WALL_BUDGET_S * max(0.05, fraction))
+    else:
+        window = min(window, window * fraction)
     # ... and must always fit inside what remains of the budget, with
     # headroom for the outage JSON + dataflow join
     window = _budget_bounded(window, headroom=10.0)
     gap = float(os.environ.get("BENCH_REPROBE_GAP_S", "120"))
     start = time.time()
+    # the in-process timer cannot bound a C-level init hang (libtpu's
+    # metadata retry loop holds the GIL, starving this very loop — the
+    # same mode the budget watchdog documents), and with WALL_BUDGET_S
+    # unset there is no budget sentinel either: arm a probe-scoped
+    # sentinel PROCESS that emits the outage JSON and SIGKILLs once the
+    # window plus grace passes without a disarm
+    sentinel = _spawn_probe_sentinel(start + window + 15.0, window)
     failures: list = []
     attempts = [0]
 
@@ -1547,6 +1601,7 @@ def _probe_device_retrying() -> None:
         remaining = window - elapsed
         contacted = done.wait(timeout=max(0.0, min(gap, remaining)))
         if contacted and not failure:
+            sentinel.kill()
             print(
                 f"bench probe: device contact after "
                 f"{time.time() - start:.0f}s "
@@ -1593,6 +1648,9 @@ def _probe_device_retrying() -> None:
             if time.time() - start >= window:
                 break
             done, failure = start_touch()
+    # reaching here proves Python is alive: the normal outage path below
+    # emits the JSON itself (with dataflow numbers the sentinel cannot see)
+    sentinel.kill()
     error = (
         f"accelerator init failed: {failures[-1]}"
         if failures

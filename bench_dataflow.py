@@ -1340,6 +1340,142 @@ def collective_exchange_leg() -> dict:
     return leg
 
 
+def device_residency_leg() -> "Callable[[], dict]":
+    """Device-resident delta batches (engine/device_residency.py) over a
+    chained groupby->join dataflow: with residency ON, collective
+    exchange outputs bound for device-eligible consumers stay on device
+    (and re-pack without a host round trip), so the padded all-to-all
+    tail and the per-seam payload upload never cross the PCIe boundary.
+
+    Both modes force the collective exchange and the device operator
+    kernels — residency is the ONLY variable — and the leg reports the
+    ``pathway_device_transfer_*`` ledger each way: the gate
+    (tools/check.py) asserts h2d+d2h bytes strictly lower with residency
+    on, resident events engaged, and sinks bit-identical."""
+
+    n_rows = (
+        5_000
+        if _analyze_only()
+        else int(os.environ.get("BENCH_RESIDENCY_ROWS", "60000"))
+    )
+    n_groups = 512
+
+    def build():
+        import pathway_tpu as pw
+
+        t = pw.debug.table_from_rows(
+            pw.schema_from_types(k=int, v=int, w=float),
+            [(i % n_groups, i, i * 0.25) for i in range(n_rows)],
+        )
+        g = t.groupby(t.k).reduce(
+            k=t.k,
+            total=pw.reducers.sum(t.v),
+            cnt=pw.reducers.count(),
+        )
+        d = pw.debug.table_from_rows(
+            pw.schema_from_types(k2=int, label=int),
+            [(i, i % 3) for i in range(n_groups)],
+        )
+        j = g.join(d, g.k == d.k2)
+        return j.select(k=g.k, total=g.total, cnt=g.cnt, label=d.label)
+
+    def _canon(obj):
+        if isinstance(obj, (list, tuple)):
+            return tuple(_canon(x) for x in obj)
+        if isinstance(obj, float) and obj != obj:
+            return "NaN"
+        return obj
+
+    def leg() -> dict:
+        try:
+            import jax
+        except Exception as exc:  # noqa: BLE001 — report, don't sink
+            return {"skipped": f"jax unavailable: {exc!r}"}
+        from pathway_tpu.engine import collective_exchange as _cx
+        from pathway_tpu.engine import device_residency as _dres
+        from pathway_tpu.engine.device import device_count
+        from pathway_tpu.internals.parse_graph import G
+        from pathway_tpu.internals.runner import ShardedGraphRunner
+
+        n_workers = 4 if device_count() >= 4 else 2
+        if not _cx.mesh_ready(n_workers):
+            return {
+                "skipped": (
+                    f"mesh not ready: {device_count()} device(s) for "
+                    f"{n_workers} workers (set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=4)"
+                )
+            }
+
+        def run(residency_on):
+            os.environ["PATHWAY_TPU_DEVICE_RESIDENCY"] = (
+                "1" if residency_on else "0"
+            )
+            _dres.reset_counters()
+            G.clear()
+            try:
+                t0 = time.perf_counter()
+                (state,) = ShardedGraphRunner(n_workers).capture(build())
+                dt = time.perf_counter() - t0
+            finally:
+                G.clear()
+            sinks = {k: _canon(v) for k, v in state.items()}
+            return sinks, dt, _dres.stats()
+
+        prev = {
+            k: os.environ.get(k)
+            for k in (
+                "PATHWAY_TPU_COLLECTIVE_EXCHANGE",
+                "PATHWAY_TPU_DEVICE_OPS",
+                "PATHWAY_TPU_DEVICE_RESIDENCY",
+            )
+        }
+        try:
+            # the collective + device kernels run in BOTH modes so the
+            # transfer ledger isolates what residency alone saves
+            os.environ["PATHWAY_TPU_COLLECTIVE_EXCHANGE"] = "1"
+            os.environ["PATHWAY_TPU_DEVICE_OPS"] = "1"
+            run(False)  # warm the jit kernels off the clock
+            sinks_off, t_off, s_off = run(False)
+            sinks_on, t_on, s_on = run(True)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+        def _mode(stats_, dt):
+            return {
+                "rows_per_sec": round(n_rows / dt),
+                "h2d_bytes": stats_["h2d"]["bytes"],
+                "d2h_bytes": stats_["d2h"]["bytes"],
+                "transfer_bytes": (
+                    stats_["h2d"]["bytes"] + stats_["d2h"]["bytes"]
+                ),
+                "resident_batches": stats_["events"]["resident_batches"],
+                "device_consumes": stats_["events"]["device_consumes"],
+                "materializations": stats_["events"]["materializations"],
+                "declines": stats_["events"]["declines"],
+                "bytes_saved": stats_["bytes_saved"],
+            }
+
+        off, on = _mode(s_off, t_off), _mode(s_on, t_on)
+        return {
+            "rows": n_rows,
+            "workers": n_workers,
+            "backend": jax.default_backend(),
+            "residency_off": off,
+            "residency_on": on,
+            "transfer_bytes_reduction": (
+                off["transfer_bytes"] - on["transfer_bytes"]
+            ),
+            "sinks_identical": sinks_off == sinks_on,
+        }
+
+    return leg
+
+
 _RECOVERY_PROGRAM = """
 import os
 import pathway_tpu as pw
@@ -1699,6 +1835,12 @@ def run_all(emit=None) -> dict:
             record("collective_exchange", collective_exchange_leg()())
         except Exception as exc:
             record("collective_exchange_error", repr(exc))
+        # device-resident delta batches through the collective seam:
+        # transfer-ledger off vs on over the chained groupby->join
+        try:
+            record("device_residency", device_residency_leg()())
+        except Exception as exc:
+            record("device_residency_error", repr(exc))
         if not _analyze_only():
             # the elastic-mesh legs each spawn a real supervised mesh:
             # follower kill + recovery, leader kill + election failover,
@@ -1805,6 +1947,14 @@ def main() -> None:
                 {
                     "workload": "collective_exchange",
                     **collective_exchange_leg()(),
+                }
+            )
+        )
+        print(
+            json.dumps(
+                {
+                    "workload": "device_residency",
+                    **device_residency_leg()(),
                 }
             )
         )

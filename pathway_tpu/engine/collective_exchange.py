@@ -393,27 +393,66 @@ def _kernel(n: int):
 
 
 def exchange(
-    edge: int, columns: "Columns", shards: np.ndarray, n: int
+    edge: int,
+    columns: "Columns",
+    shards: np.ndarray,
+    n: int,
+    consumer=None,
 ) -> "list[Columns | None] | None":
     """Repartition ``columns`` by the precomputed ``shards`` vector over
     an ``n``-device collective.  Returns one :class:`Columns` per
     destination (``None`` where a destination receives no rows), or
     ``None`` to DECLINE — non-codeable payload, mesh not ready, policy
     chose host, or a device error — in which case the caller runs the
-    host path and NO pushes have happened (the PR-6 rollback seam)."""
+    host path and NO pushes have happened (the PR-6 rollback seam).
+
+    The device-residency plane hooks both ends of this call
+    (``engine/device_residency.py``):
+
+    - **ingress** — a still-resident :class:`DeviceResidentColumns`
+      input re-packs from its device rows (the wire layout IS the
+      resident layout), skipping the host payload upload entirely;
+    - **egress** — when ``consumer`` is a device-placed eligible
+      operator (``consumer_resident_ok``), the all-to-all output is
+      trimmed per destination ON DEVICE and delivered as resident
+      batches instead of fetching the whole padded buffer; any failure
+      in that trim falls back to the whole-buffer host fetch before a
+      single push happens, so the fallback is a clean mode switch.
+
+    Host<->device transfers are counted in BOTH modes
+    (``pathway_device_transfer_*``) so a residency-on run is directly
+    comparable against its own residency-off baseline."""
     n_rows = columns.n
     if n_rows == 0 or not enabled() or not mesh_ready(n):
         return None
     if not _exchange_policy().choose("exchange", edge, n_rows):
         return None
     from pathway_tpu.engine import device_ops as _device_ops
+    from pathway_tpu.engine import device_residency as _dres
 
     trace = _tracing.current()
     t0 = _time.perf_counter()
-    payload, layout, has_diffs = _pack_payload(columns)
-    if payload is None:
-        COLLECTIVE_STATS["declined_non_codeable"] += 1
-        return None
+    # zero-copy ingress: a still-resident device batch already holds the
+    # packed keys|diffs|cols wire rows on device — reuse them and skip
+    # the host marshalling + payload upload
+    dev_payload = None
+    payload = None
+    if isinstance(columns, _dres.DeviceResidentColumns):
+        dev_payload = columns.device_rows()
+    if dev_payload is not None:
+        layout = columns.layout
+        has_diffs = columns.has_diffs
+        width = 16 + (8 if has_diffs else 0) + sum(
+            w for _dt, w in layout
+        )
+        payload_nbytes = n_rows * width
+    else:
+        payload, layout, has_diffs = _pack_payload(columns)
+        if payload is None:
+            COLLECTIVE_STATS["declined_non_codeable"] += 1
+            return None
+        width = payload.shape[1]
+        payload_nbytes = int(payload.nbytes)
     p1 = _time.perf_counter()
     if trace is not None:
         # the exchange-bucket span covers ONLY the byte marshalling —
@@ -427,10 +466,9 @@ def exchange(
             t0,
             p1,
             rows=n_rows,
-            bytes=int(payload.nbytes),
+            bytes=payload_nbytes,
             edge=edge,
         )
-    width = payload.shape[1]
     # contiguous source chunks, padded to a power-of-two length so the
     # jitted kernel re-specializes on few shapes (Ragged Paged Attention
     # discipline via device_ops.bucket_size)
@@ -440,8 +478,6 @@ def exchange(
     group = row_chunk * n + shards64  # per-row (chunk, destination) code
     counts = np.bincount(group, minlength=n * n).reshape(n, n)
     depth = _device_ops.bucket_size(int(counts.max()))
-    padded = np.zeros((n * chunk, width), np.uint8)
-    padded[:n_rows] = payload
     # stable argsort groups rows by (chunk, destination) with ascending
     # original index inside each group — the exact order the host path's
     # np.flatnonzero(shards == d) produces per destination
@@ -453,15 +489,33 @@ def exchange(
     gidx[sorted_group, np.arange(n_rows) - starts[sorted_group]] = (
         order % chunk
     ).astype(np.int32)
+    resident_out = False
     try:
         k0 = _time.perf_counter()
+        if dev_payload is not None:
+            import jax.numpy as jnp
+
+            padded_in = jnp.zeros((n * chunk, width), jnp.uint8)
+            padded_in = padded_in.at[:n_rows].set(dev_payload)
+            _dres.record_h2d(gidx.nbytes)  # only the index matrix crosses
+            _dres.record_saved(payload_nbytes)
+            _dres.RESIDENCY_STATS["device_consumes"] += 1
+        else:
+            padded = np.zeros((n * chunk, width), np.uint8)
+            padded[:n_rows] = payload
+            padded_in = padded
+            _dres.record_h2d(padded.nbytes + gidx.nbytes)
         # dispatch, then overlap: jax returns while XLA bucket-gathers and
         # swaps; the host meanwhile derives the per-destination trim sizes,
-        # and the single blocking fetch (np.asarray) comes last — the PR-9
-        # dispatch/fetch overlap discipline
-        out_dev = _kernel(n)(padded, gidx.reshape(n, n, depth))
+        # and the blocking fetch (when one happens at all) comes last —
+        # the PR-9 dispatch/fetch overlap discipline
+        out_dev = _kernel(n)(padded_in, gidx.reshape(n, n, depth))
         dest_counts = counts.sum(axis=0)
-        fetched = np.asarray(out_dev)
+        resident_out = _dres.consumer_resident_ok(consumer)
+        fetched = None
+        if not resident_out:
+            fetched = np.asarray(out_dev)
+            _dres.record_d2h(fetched.nbytes)
         k1 = _time.perf_counter()
     except Exception:
         COLLECTIVE_STATS["errors"] += 1
@@ -470,15 +524,52 @@ def exchange(
         "collective_exchange.all_to_all", int((k1 - k0) * 1e9)
     )
     parts: list = [None] * n
-    for d in range(n):
-        m = int(dest_counts[d])
-        if m == 0:
-            continue
-        block = fetched[d * n : (d + 1) * n]
-        rows = np.concatenate(
-            [block[s, : counts[s, d]] for s in range(n)], axis=0
-        )
-        parts[d] = _unpack_rows(rows, layout, has_diffs)
+    if resident_out:
+        seam_key = _dres.consumer_seam_key(consumer)
+        try:
+            import jax.numpy as jnp
+
+            trimmed_bytes = 0
+            for d in range(n):
+                m = int(dest_counts[d])
+                if m == 0:
+                    continue
+                block = out_dev[d * n : (d + 1) * n]
+                rows_dev = jnp.concatenate(
+                    [block[s, : int(counts[s, d])] for s in range(n)],
+                    axis=0,
+                )
+                parts[d] = _dres.DeviceResidentColumns.from_device_rows(
+                    rows_dev, layout, has_diffs, seam_key=seam_key
+                )
+                trimmed_bytes += m * width
+            # the padded tail of the all-to-all buffer never crosses to
+            # host in resident mode — that is the guaranteed net saving
+            # even if every part later materializes
+            _dres.record_saved(int(out_dev.nbytes) - trimmed_bytes)
+        except Exception:
+            # resident egress failed — fetch the whole buffer and run
+            # the host decode; nothing was pushed yet, so this is a
+            # clean fallback, not a partial delivery
+            _dres.RESIDENCY_STATS["declines"] += 1
+            parts = [None] * n
+            resident_out = False
+            try:
+                fetched = np.asarray(out_dev)
+                _dres.record_d2h(fetched.nbytes)
+            except Exception:
+                COLLECTIVE_STATS["errors"] += 1
+                return None
+    if not resident_out:
+        for d in range(n):
+            m = int(dest_counts[d])
+            if m == 0:
+                continue
+            block = fetched[d * n : (d + 1) * n]
+            rows = np.concatenate(
+                [block[s, : counts[s, d]] for s in range(n)], axis=0
+            )
+            parts[d] = _unpack_rows(rows, layout, has_diffs)
     t1 = _time.perf_counter()
     if trace is not None:
         trace.span(
@@ -488,11 +579,12 @@ def exchange(
             t1,
             rows=n_rows,
             edge=edge,
+            resident=bool(resident_out),
         )
     total_ns = int((t1 - t0) * 1e9)
     COLLECTIVE_STATS["exchanges"] += 1
     _C_NS.inc(total_ns)
-    _C_BYTES.inc(float(payload.nbytes))
+    _C_BYTES.inc(float(payload_nbytes))
     _exchange_policy().record("exchange", edge, True, n_rows, total_ns)
     return parts
 

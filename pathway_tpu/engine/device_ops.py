@@ -246,11 +246,21 @@ class SegmentReduceJob:
     def fetch(self) -> tuple[np.ndarray, list]:
         """(gdiffs, deltas) with the padding sliced off — dtypes and
         values bit-identical to device.segment_count/segment_sum."""
+        from pathway_tpu.engine import device_residency as _dres
+
         nu = self._nu
-        gdiffs = np.asarray(self._gd)[:nu]
-        deltas = [
-            None if o is None else np.asarray(o)[:nu] for o in self._outs
-        ]
+        full = np.asarray(self._gd)
+        d2h = full.nbytes
+        gdiffs = full[:nu]
+        deltas = []
+        for o in self._outs:
+            if o is None:
+                deltas.append(None)
+                continue
+            arr = np.asarray(o)
+            d2h += arr.nbytes
+            deltas.append(arr[:nu])
+        _dres.record_d2h(d2h)
         record_kernel(
             "segment_reduce", _time.perf_counter_ns() - self._t0
         )
@@ -275,17 +285,21 @@ def segment_reduce_dispatch(
     import jax.numpy as jnp
     from jax.experimental import enable_x64
 
+    from pathway_tpu.engine import device_residency as _dres
+
     t0 = _time.perf_counter_ns()
     n = len(inverse)
     npad = _bucket(n)
     gpad = _bucket(n_groups)
     inv = np.zeros(npad, np.int64)
     inv[:n] = inverse
+    h2d = inv.nbytes
     with enable_x64():
         add = _scatter_add()
         inv_d = jnp.asarray(inv)
         w = np.zeros(npad, np.int64)
         w[:n] = diffs
+        h2d += w.nbytes
         gd = add(jnp.zeros(gpad, jnp.int64), inv_d, jnp.asarray(w))
         outs: list[Any] = []
         for col in vals:
@@ -295,39 +309,64 @@ def segment_reduce_dispatch(
             if col.dtype.kind in "ib":
                 w = np.zeros(npad, np.int64)
                 w[:n] = col.astype(np.int64, copy=False) * diffs
+                h2d += w.nbytes
                 outs.append(
                     add(jnp.zeros(gpad, jnp.int64), inv_d, jnp.asarray(w))
                 )
             else:
                 w = np.zeros(npad, np.float64)
                 w[:n] = col * diffs
+                h2d += w.nbytes
                 outs.append(
                     add(
                         jnp.zeros(gpad, jnp.float64), inv_d, jnp.asarray(w)
                     )
                 )
+    _dres.record_h2d(h2d)
     return SegmentReduceJob(gd, outs, n_groups, n, t0)
 
 
 # -- join: sort-based pair matcher -------------------------------------------
 
 
-def _match_pairs_device(la: np.ndarray, ra: np.ndarray):
+def _match_pairs_device(
+    la: np.ndarray, ra: np.ndarray, la_dev=None, ra_dev=None
+):
     """graph._match_join_pairs transliterated to jnp — identical swap
     rule, stable sort, and emission arithmetic, so the returned pair
-    sequence is the host matcher's pair sequence."""
+    sequence is the host matcher's pair sequence.
+
+    ``la_dev``/``ra_dev`` are optional device twins of the SAME code
+    arrays (a still-resident exchange delivery's int64 key column):
+    when present the matcher consumes them in place of re-uploading the
+    host array — values identical by construction (both views
+    reinterpret the same wire bytes), so pair output cannot differ."""
     import jax.numpy as jnp
     from jax.experimental import enable_x64
+
+    from pathway_tpu.engine import device_residency as _dres
 
     empty = np.empty(0, np.int64)
     if len(la) == 0 or len(ra) == 0:
         return empty, empty
     if len(ra) > len(la):
-        r_idx, l_idx = _match_pairs_device(ra, la)
+        r_idx, l_idx = _match_pairs_device(ra, la, ra_dev, la_dev)
         return l_idx, r_idx
     with enable_x64():
-        la_d = jnp.asarray(la)
-        ra_d = jnp.asarray(ra)
+        if la_dev is not None:
+            la_d = la_dev
+            _dres.record_saved(la.nbytes)
+            _dres.RESIDENCY_STATS["device_consumes"] += 1
+        else:
+            la_d = jnp.asarray(la)
+            _dres.record_h2d(la.nbytes)
+        if ra_dev is not None:
+            ra_d = ra_dev
+            _dres.record_saved(ra.nbytes)
+            _dres.RESIDENCY_STATS["device_consumes"] += 1
+        else:
+            ra_d = jnp.asarray(ra)
+            _dres.record_h2d(ra.nbytes)
         order = jnp.argsort(ra_d, stable=True)
         rs = ra_d[order]
         lo = jnp.searchsorted(rs, la_d, side="left")
@@ -341,14 +380,17 @@ def _match_pairs_device(la: np.ndarray, ra: np.ndarray):
         csum = jnp.cumsum(counts) - counts
         offs = jnp.arange(total) - jnp.repeat(csum, counts)
         r_idx = order[starts + offs]
-        return (
-            np.asarray(l_idx, np.int64),
-            np.asarray(r_idx, np.int64),
-        )
+        l_out = np.asarray(l_idx, np.int64)
+        r_out = np.asarray(r_idx, np.int64)
+        _dres.record_d2h(l_out.nbytes + r_out.nbytes)
+        return l_out, r_out
 
 
 def match_pairs(
-    l_arrays: "list[np.ndarray]", r_arrays: "list[np.ndarray]"
+    l_arrays: "list[np.ndarray]",
+    r_arrays: "list[np.ndarray]",
+    l_dev=None,
+    r_dev=None,
 ):
     """Device pair matcher over dtype-unified join-key columns; returns
     ``(l_idx, r_idx)`` or ``None`` when a column has no int64 code view
@@ -357,7 +399,14 @@ def match_pairs(
     Multi-column keys reduce to joint codes with the same host
     factorization the NumPy path uses; only the matcher itself (the
     sort/search dominated part) runs on device, so pair ordering is the
-    host ordering by construction."""
+    host ordering by construction.
+
+    ``l_dev``/``r_dev``: optional device twins of single-column keys (a
+    device-resident exchange delivery).  A twin is consumed ONLY when
+    the int64 code derivation was the identity on the host array it
+    twins (``_as_match_codes`` returns the same object for contiguous
+    int64 input) — float normalisation or widening would change bits,
+    so any non-identity derivation drops the twin and re-uploads."""
     from pathway_tpu.engine.graph import _as_match_codes
 
     t0 = _time.perf_counter_ns()
@@ -367,8 +416,13 @@ def match_pairs(
     rc = [_as_match_codes(a) for a in r_arrays]
     if any(c is None for c in rc):
         return None
+    la_dev = ra_dev = None
     if len(lc) == 1:
         la, ra = lc[0], rc[0]
+        if l_dev is not None and lc[0] is l_arrays[0]:
+            la_dev = l_dev
+        if r_dev is not None and rc[0] is r_arrays[0]:
+            ra_dev = r_dev
     else:
         from pathway_tpu.engine.device import factorize_multi
 
@@ -376,6 +430,6 @@ def match_pairs(
         both = [np.concatenate([l, r]) for l, r in zip(lc, rc)]
         _first, inverse = factorize_multi(both)
         la, ra = inverse[:nl], inverse[nl:]
-    out = _match_pairs_device(la, ra)
+    out = _match_pairs_device(la, ra, la_dev, ra_dev)
     record_kernel("match_pairs", _time.perf_counter_ns() - t0)
     return out

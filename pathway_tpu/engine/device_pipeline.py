@@ -321,7 +321,13 @@ class DevicePipeline:
         (blocking only when ``depth`` commits are already in flight),
         and return to the host sweep immediately."""
         from pathway_tpu.engine import device as _device
+        from pathway_tpu.engine import device_residency as _dres
 
+        # exchange outputs kept device-resident are consumed within the
+        # commit that delivered them; materialize any survivor here so
+        # HBM stays bounded by one commit and downstream persistence
+        # only ever sees host-resident state (exactly-once discipline)
+        _dres.decay_resident_batches()
         handles = _device.stage_device_batches()
         if not handles:
             return
@@ -430,6 +436,9 @@ class DevicePipeline:
         completed — THE exactly-once seam: the runner calls this before
         persistence/snapshot ``on_commit`` hooks so a checkpoint for
         commit N is only cut once N's device effects are host-resident."""
+        from pathway_tpu.engine import device_residency as _dres
+
+        _dres.decay_resident_batches()
         if self._worker is None:
             return
         with self._cv:
@@ -441,6 +450,9 @@ class DevicePipeline:
 
     def drain(self) -> None:
         """Complete everything in flight (run end, pre-snapshot, tests)."""
+        from pathway_tpu.engine import device_residency as _dres
+
+        _dres.decay_resident_batches()
         if self._worker is None:
             return
         with self._cv:
@@ -479,6 +491,7 @@ class DevicePipeline:
         """Structured roll-up for bench JSON."""
         from pathway_tpu.engine import collective_exchange as _collective
         from pathway_tpu.engine import device_ops as _dops
+        from pathway_tpu.engine import device_residency as _dres
 
         return {
             "enabled": async_enabled(),
@@ -505,6 +518,10 @@ class DevicePipeline:
                 "enabled": _collective.enabled(),
                 "events": dict(_collective.COLLECTIVE_STATS),
             },
+            # the residency plane keeps exchange outputs on that same
+            # device between operators — its transfer ledger belongs
+            # beside the planes that produce and consume the buffers
+            "device_residency": _dres.stats(),
         }
 
 

@@ -1181,7 +1181,7 @@ class DistributedScheduler:
                 partition_rule(consumer, port), out.columns, self.n_workers
             )
             if shards is not None and self._route_columnar(
-                cons_idx, port, out, shards
+                cons_idx, port, out, shards, consumer=consumer
             ):
                 return
         EXCHANGE_STATS["host_deliveries"] += 1
@@ -1220,6 +1220,7 @@ class DistributedScheduler:
         port: int,
         out: DeltaBatch,
         shards: np.ndarray,
+        consumer: "Node | None" = None,
     ) -> bool:
         """Route a columnar batch by a precomputed shard vector: local
         shards push gathered ``Columns`` (no serialization at all), remote
@@ -1242,7 +1243,11 @@ class DistributedScheduler:
         )
         if not any_remote:
             cparts = _collective.exchange(
-                cons_idx, cols, shards, self.n_workers
+                cons_idx,
+                cols,
+                shards,
+                self.n_workers,
+                consumer=consumer,
             )
             if cparts is not None:
                 EXCHANGE_STATS["collective_deliveries"] += 1

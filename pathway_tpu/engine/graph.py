@@ -800,13 +800,19 @@ class _JoinSide:
     cached per side AND per key column, so probing a long-lived block
     costs the cast/scan once, not once per commit."""
 
-    __slots__ = ("n", "jks", "kb", "cols", "_jk_int", "_jk_f64", "_nan")
+    __slots__ = (
+        "n", "jks", "kb", "cols", "dev_jks", "_jk_int", "_jk_f64", "_nan"
+    )
 
-    def __init__(self, n, jks, kb, cols) -> None:
+    def __init__(self, n, jks, kb, cols, dev_jks=None) -> None:
         self.n = n
         self.jks = jks
         self.kb = kb
         self.cols = cols
+        #: device twins of the join-key arrays (one per key column, or
+        #: None) — set only when the batch arrived device-resident with
+        #: int64 keys, so the device matcher can skip the H2D re-upload
+        self.dev_jks = dev_jks
         self._jk_int: dict[int, np.ndarray] = {}
         self._jk_f64: dict[int, Any] = {}  # False = not representable
         self._nan: dict[int, bool] = {}
@@ -1122,7 +1128,27 @@ class JoinNode(Node):
                 return None
             if not batch._insert_only and not _keys_unique(kb, n):
                 return None
-            return _JoinSide(n, jks, kb, list(payload.cols))
+            # a device-resident delivery with a single int64 key column
+            # carries a device twin of the join keys: the matcher can
+            # consume it in place of re-uploading (int64 only — float
+            # code derivation normalises bits, so twins there are unsafe
+            # and match_pairs re-validates by object identity anyway)
+            dev_jks = None
+            if (
+                len(on_cols) == 1
+                and jks[0].dtype == np.int64
+                and getattr(payload, "resident", None) is not None
+                and payload.resident()
+            ):
+                try:
+                    twin = payload.device_column(on_cols[0])
+                except Exception:
+                    twin = None
+                if twin is not None:
+                    dev_jks = [twin]
+            return _JoinSide(
+                n, jks, kb, list(payload.cols), dev_jks=dev_jks
+            )
         entries = batch.entries
         if _native is not None and hasattr(_native, "entries_to_side"):
             # one pass over the rows screens diffs/keys and fills every
@@ -1237,8 +1263,26 @@ class JoinNode(Node):
                 return None
             got = None
             if use_device:
+                # hand the matcher any device key twins whose host array
+                # IS the unified array (identity — unification that cast
+                # or copied invalidates the twin)
+                l_dev = r_dev = None
+                if (
+                    l.dev_jks is not None
+                    and len(l.jks) == 1
+                    and uni[0][0] is l.jks[0]
+                ):
+                    l_dev = l.dev_jks[0]
+                if (
+                    r.dev_jks is not None
+                    and len(r.jks) == 1
+                    and uni[1][0] is r.jks[0]
+                ):
+                    r_dev = r.dev_jks[0]
                 try:
-                    got = _dops.match_pairs(*uni)
+                    got = _dops.match_pairs(
+                        uni[0], uni[1], l_dev=l_dev, r_dev=r_dev
+                    )
                 except Exception:
                     got = None  # device trouble: host matcher is the spec
             if got is None:

@@ -280,7 +280,11 @@ class ShardedScheduler:
                 shards = self._columnar_shards(consumer, port, out)
                 if shards is not None:
                     cparts = _collective.exchange(
-                        consumer.index, out.columns, shards, self.n
+                        consumer.index,
+                        out.columns,
+                        shards,
+                        self.n,
+                        consumer=consumer,
                     )
                     if cparts is not None:
                         EXCHANGE_STATS["collective_deliveries"] += 1

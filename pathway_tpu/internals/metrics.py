@@ -68,6 +68,22 @@ The async device pipeline (engine/device_pipeline.py) adds:
 - ``pathway_device_knn_updates_total`` / ``pathway_device_knn_queries_total``
   — mutation and query volume dispatched to the device KNN index.
 
+The device-residency plane (engine/device_residency.py) adds:
+
+- ``pathway_device_transfer_h2d_events_total`` /
+  ``pathway_device_transfer_h2d_bytes_total`` — host→device uploads on
+  the exchange/operator seam (counted in both residency modes, so
+  on/off runs are directly comparable);
+- ``pathway_device_transfer_d2h_events_total`` /
+  ``pathway_device_transfer_d2h_bytes_total`` — device→host fetches on
+  the same seam, including decline-path whole-buffer materializations;
+- ``pathway_device_residency_bytes_saved_total`` — payload bytes that
+  stayed on device instead of round-tripping at the seam;
+- ``pathway_device_residency_events_total`` — labelled ``kind=`` with
+  ``resident_batches``, ``device_consumes``, ``materializations``,
+  ``declines`` — lifecycle volume of the resident delta-batch plane
+  (mirrors ``device_residency.RESIDENCY_STATS``).
+
 Each family renders on the leader ``/metrics`` with exactly one
 HELP/TYPE block (the registry keys families by name).
 

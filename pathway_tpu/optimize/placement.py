@@ -14,6 +14,18 @@ cheaper side with hysteresis (a side must win by 20% to flip the
 decision) and a periodic re-probe of the losing side so a placement can
 recover when batch shapes drift.
 
+Chain-aware placement (the device-residency seam): per-operator EMAs
+alone under-place chains — a device groupby feeding a device join saves
+a host materialization at the exchange seam between them, but neither
+operator's own ns/row sees that saving.  The pass therefore links
+adjacent device-eligible operators (:meth:`PlacementPolicy.link`), the
+residency plane reports what each materialization at a consumer's seam
+actually cost (:meth:`PlacementPolicy.record_seam`), and ``choose()``
+credits that measured seam cost against the device side whenever a
+linked neighbor currently sits on device and residency is enabled — so
+consecutive device-eligible operators converge onto the device
+together instead of each flapping on its solo margin.
+
 The pass is annotation-only on purpose: it runs even for graphs the
 rewriting passes skip (external-index operators shadow ``node.index``,
 which disables index-keyed rewrites — exactly the graphs the KNN
@@ -76,7 +88,8 @@ class PlacementPolicy:
         min_rows_fn=None,
     ) -> None:
         self._lock = threading.Lock()
-        self._stats: dict = {}
+        self._stats: dict = {}  # guarded-by: _lock
+        self._links: dict = {}  # guarded-by: _lock — key -> set of adjacent keys
         self._enabled_fn = enabled_fn
         self._forced_fn = forced_fn
         self._min_rows_fn = min_rows_fn
@@ -93,7 +106,7 @@ class PlacementPolicy:
             self._min_rows_fn = min_rows
         return self._enabled_fn, self._forced_fn, self._min_rows_fn
 
-    def _entry(self, key) -> dict:
+    def _entry_locked(self, key) -> dict:
         st = self._stats.get(key)
         if st is None:
             st = self._stats[key] = {
@@ -101,6 +114,8 @@ class PlacementPolicy:
                 "device_calls": 0,
                 "host_ns_per_row": None,
                 "device_ns_per_row": None,
+                "seam_ns_per_row": None,
+                "seam_events": 0,
                 "rows": 0,
                 "device": False,
             }
@@ -110,9 +125,72 @@ class PlacementPolicy:
         """Register an eligible operator (the optimizer pass calls this so
         ``decisions()`` lists every candidate before the first batch)."""
         with self._lock:
-            st = self._entry((kind, index))
+            st = self._entry_locked((kind, index))
             if device is not None:
                 st["device"] = device
+
+    def link(
+        self, kind_a: str, index_a: int, kind_b: str, index_b: int
+    ) -> None:
+        """Declare two eligible operators adjacent (producer feeds
+        consumer through an exchange seam).  Links are symmetric: either
+        end being on device makes residency possible across the seam, so
+        either end earns the chain credit for joining it."""
+        a, b = (kind_a, index_a), (kind_b, index_b)
+        if a == b:
+            return
+        with self._lock:
+            self._entry_locked(a)
+            self._entry_locked(b)
+            self._links.setdefault(a, set()).add(b)
+            self._links.setdefault(b, set()).add(a)
+
+    def record_seam(
+        self, kind: str, index: int, n_rows: int, ns: int
+    ) -> None:
+        """Fold one observed seam materialization (a resident batch
+        fetched to host at this consumer) into the seam-cost EMA — this
+        is the transfer a colocated device placement would have saved."""
+        per_row = float(ns) / max(1, n_rows)
+        with self._lock:
+            st = self._entry_locked((kind, index))
+            st["seam_events"] += 1
+            prev = st["seam_ns_per_row"]
+            st["seam_ns_per_row"] = (
+                per_row
+                if prev is None
+                else (1.0 - _ALPHA) * prev + _ALPHA * per_row
+            )
+
+    def is_device(self, kind: str, index: int) -> bool:
+        """Current placement of an operator (False for unknown keys) —
+        the residency plane consults this in auto mode so exchange
+        outputs only stay resident for consumers that will actually run
+        on device."""
+        with self._lock:
+            st = self._stats.get((kind, index))
+            return bool(st and st["device"])
+
+    def _chain_credit(self, key: tuple, st: dict) -> float:
+        """ns/row credited to the device side of ``key`` for seam
+        transfers residency would save.  Non-zero only when residency is
+        enabled and a linked neighbor currently sits on device; the
+        magnitude is this operator's own measured seam EMA (what each
+        host materialization at its input actually cost).  Caller holds
+        ``self._lock``."""
+        links = self._links.get(key)
+        if not links:
+            return 0.0
+        seam = st["seam_ns_per_row"]
+        if not seam:
+            return 0.0
+        if not any(
+            n in self._stats and self._stats[n]["device"] for n in links
+        ):
+            return 0.0
+        if not _residency_on():
+            return 0.0
+        return float(seam)
 
     def choose(self, kind: str, index: int, n_rows: int) -> bool:
         """True → run this batch on device.  Called on the batch hot path,
@@ -125,7 +203,7 @@ class PlacementPolicy:
         if n_rows < min_rows_fn():
             return False
         with self._lock:
-            st = self._entry((kind, index))
+            st = self._entry_locked((kind, index))
             # bootstrap: measure both sides before judging
             if st["device_calls"] < self.PROBE_CALLS:
                 return True
@@ -136,6 +214,10 @@ class PlacementPolicy:
                 return not st["device"]  # refresh the losing side's EMA
             d = st["device_ns_per_row"]
             h = st["host_ns_per_row"]
+            if d is not None:
+                # chain-aware: device placement next to a device-placed
+                # neighbor saves the seam materialization — score it in
+                d = max(0.0, d - self._chain_credit((kind, index), st))
             if st["device"]:
                 if d is not None and h is not None and h * self.HYSTERESIS < d:
                     st["device"] = False
@@ -150,7 +232,7 @@ class PlacementPolicy:
         """Fold one observed execution into the EMA for its side."""
         per_row = float(ns) / max(1, n_rows)
         with self._lock:
-            st = self._entry((kind, index))
+            st = self._entry_locked((kind, index))
             side = "device" if device else "host"
             st[side + "_calls"] += 1
             key = side + "_ns_per_row"
@@ -186,6 +268,16 @@ class PlacementPolicy:
                         if st["device_ns_per_row"] is None
                         else round(st["device_ns_per_row"], 1)
                     ),
+                    "seam_ns_per_row": (
+                        None
+                        if st["seam_ns_per_row"] is None
+                        else round(st["seam_ns_per_row"], 1)
+                    ),
+                    "seam_events": st["seam_events"],
+                    "links": sorted(
+                        f"{k}:{i}"
+                        for (k, i) in self._links.get((kind, index), ())
+                    ),
                     "rows": st["rows"],
                 }
         return out
@@ -193,6 +285,18 @@ class PlacementPolicy:
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._links.clear()
+
+
+def _residency_on() -> bool:
+    """Lazy gate on the residency plane (import-cycle-free: residency
+    imports this module's POLICY inside functions only)."""
+    try:
+        from pathway_tpu.engine import device_residency as _dres
+
+        return _dres.enabled()
+    except Exception:  # pragma: no cover — residency plane unavailable
+        return False
 
 
 #: the process-wide policy every operator hook consults
@@ -242,4 +346,52 @@ def run_pass(scopes: list) -> tuple[int, int]:
             POLICY.seed(kind, pos, device=device or None)
             if device:
                 placed += 1
+    # second walk (after every operator is annotated): link each eligible
+    # operator to the next eligible operator downstream — through any
+    # non-eligible pass-through nodes, bounded because seams are local —
+    # so choose() can credit the residency saving across the exchange
+    # seam between them.  The same sweep marks each traversed
+    # intermediate with its downstream eligible operator
+    # (``_device_residency_downstream``): repartitions often land on a
+    # row-local expression/filter stage directly feeding the stateful
+    # operator (the pushdown pass moves the exchange above them), and a
+    # resident delivery into that stage belongs to the operator's seam.
+    # Later fusion mutates a chain tail's ``__class__`` in place, so the
+    # attribute survives onto the FusedChainNode the scheduler delivers
+    # to.
+    for scope in scopes:
+        for node in scope.nodes:
+            kind = getattr(node, "_device_ops_eligible", None)
+            if kind is None:
+                continue
+            # upstream: mark feeders of this operator (bounded)
+            up = [(inp, 0) for inp in node.inputs]
+            seen_up: set = set()
+            while up:
+                prev, depth = up.pop()
+                if id(prev) in seen_up or depth > 4:
+                    continue
+                seen_up.add(id(prev))
+                if getattr(prev, "_device_ops_eligible", None) is not None:
+                    continue
+                if getattr(
+                    prev, "_device_residency_downstream", None
+                ) is None:
+                    prev._device_residency_downstream = (kind, node.index)
+                up.extend((i, depth + 1) for i in prev.inputs)
+            # downstream: link to the next eligible operator (bounded)
+            frontier = [(c, 0) for c, _port in node.consumers]
+            visited: set = set()
+            while frontier:
+                nxt, depth = frontier.pop()
+                if id(nxt) in visited or depth > 4:
+                    continue
+                visited.add(id(nxt))
+                ckind = getattr(nxt, "_device_ops_eligible", None)
+                if ckind is not None:
+                    POLICY.link(kind, node.index, ckind, nxt.index)
+                    continue
+                frontier.extend(
+                    (c, depth + 1) for c, _port in nxt.consumers
+                )
     return eligible, placed

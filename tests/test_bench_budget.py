@@ -90,6 +90,68 @@ def test_outage_json_lands_within_wall_budget():
     assert "accelerator" in err or "wall budget" in err, outage
 
 
+def test_probe_fraction_caps_first_contact_without_wall_budget():
+    """BENCH_r05 regression: with NO wall budget set, a never-initializing
+    backend must still be bounded by ``BENCH_PROBE_FRACTION`` — the cap
+    applies to attempt 1 itself, not only to budget-clamped reprobes — so
+    the run self-terminates with a valid outage JSON line instead of
+    looping until an external harness kill (rc=124, zero parsed legs)."""
+    env = dict(os.environ)
+    for knob in (
+        "BENCH_PROBE_WINDOW_S",
+        "BENCH_DEVICE_PROBE_S",
+        "BENCH_WALL_BUDGET_S",
+        "BENCH_REPROBE_GAP_S",
+        "BENCH_PROBE_FRACTION",
+    ):
+        env.pop(knob, None)
+    env.update(
+        # unreachable accelerator platform: init raises (or hangs) on
+        # this CPU-only container — the never-initializing backend
+        JAX_PLATFORMS="tpu",
+        # deliberately NO BENCH_WALL_BUDGET_S: only the fraction cap can
+        # bound the window
+        BENCH_PROBE_WINDOW_S="600",
+        BENCH_PROBE_FRACTION="0.02",  # 600s * 0.02 = 12s hard cap
+        BENCH_REPROBE_GAP_S="1",
+        BENCH_SKIP_DATAFLOW="1",
+        BENCH_SKIP_HOST_FALLBACK="1",
+        PYTHONPATH=str(REPO),
+    )
+    start = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env=env,
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=240,  # outer net only — the fraction cap must do the work
+    )
+    elapsed = time.time() - start
+    assert proc.returncode in (3, -9, 137), (
+        proc.returncode,
+        proc.stdout,
+        proc.stderr,
+    )
+    # 12s capped window + interpreter startup/teardown + JSON flush; far
+    # below the uncapped 600s window that would have required a harness
+    # kill to stop
+    assert elapsed < 90.0, (elapsed, proc.stderr[-2000:])
+    verdicts = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert verdicts, proc.stdout
+    outage = verdicts[-1]
+    assert outage.get("value") is None, outage
+    assert outage.get("device_unreachable") is True, outage
+    # the emitted window proves the fraction cap (not the raw 600s
+    # window) bounded the probe
+    window = (outage.get("extra") or {}).get("probe_window_s")
+    assert window is not None and window <= 600 * 0.02 + 1.0, outage
+
+
 def test_sigterm_mid_leg_flushes_completed_partials():
     """Killing bench.py mid-leg (SIGTERM, the harness-timeout signal)
     must still land one final VALID JSON line carrying ``truncated:

@@ -29,3 +29,15 @@ def test_sharded_wordcount_with_optimizer_off(n_workers, monkeypatch):
     # the dry-run harness runs whichever mode the environment picks
     monkeypatch.setenv("PATHWAY_TPU_OPTIMIZE", "0")
     graft._run_sharded_wordcount(n_workers)
+
+
+@pytest.mark.parametrize("n_workers", [2, 4])
+def test_sharded_wordcount_with_device_planes_forced(n_workers, monkeypatch):
+    # the dry-run harness may run with every device plane live: the same
+    # parity must hold through the collective exchange with the
+    # delta-batch residency plane keeping outputs on device
+    pytest.importorskip("jax")
+    monkeypatch.setenv("PATHWAY_TPU_COLLECTIVE_EXCHANGE", "1")
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_OPS", "1")
+    monkeypatch.setenv("PATHWAY_TPU_DEVICE_RESIDENCY", "1")
+    graft._run_sharded_wordcount(n_workers)

@@ -165,8 +165,10 @@ SOURCE_LINT_TARGETS = [
     "pathway_tpu/serving",
     "pathway_tpu/engine/collective_exchange.py",
     "pathway_tpu/engine/device_pipeline.py",
+    "pathway_tpu/engine/device_residency.py",
     "pathway_tpu/internals/profiling.py",
     "pathway_tpu/internals/timeseries.py",
+    "pathway_tpu/optimize/placement.py",
 ]
 
 
@@ -956,6 +958,7 @@ BENCH_REQUIRED_LEGS = [
     "device_ops_overhead",
     "mesh_groupby",
     "collective_exchange",
+    "device_residency",
     "mesh_recovery",
     "leader_failover",
     "rescale",
@@ -1033,6 +1036,24 @@ def step_bench_device_sim() -> str:
                 f"collective exchange share {share_col} not strictly "
                 f"below host-TCP baseline {share_tcp}"
             )
+    res = payload.get("device_residency")
+    if isinstance(res, dict) and "skipped" in res:
+        # 4 sim devices were forced, so the residency leg must run too
+        problems.append(f"device_residency skipped: {res['skipped']}")
+    elif isinstance(res, dict):
+        r_off = res.get("residency_off") or {}
+        r_on = res.get("residency_on") or {}
+        if not r_on.get("resident_batches"):
+            problems.append("residency never engaged (0 resident batches)")
+        t_off = r_off.get("transfer_bytes")
+        t_on = r_on.get("transfer_bytes")
+        if t_off is None or t_on is None or not t_on < t_off:
+            problems.append(
+                f"residency-on transfer bytes {t_on} not strictly below "
+                f"residency-off baseline {t_off}"
+            )
+        if res.get("sinks_identical") is not True:
+            problems.append("residency-on sinks diverged from off")
     if problems:
         _report(name, FAIL, "; ".join(problems))
         return FAIL
@@ -1042,6 +1063,12 @@ def step_bench_device_sim() -> str:
             f"; exchange share {col['collective_exchange_share']} vs "
             f"host-TCP {col['host_tcp_exchange_share']}, "
             f"{col['collective_events']['exchanges']} exchanges"
+        )
+    if isinstance(res, dict) and "skipped" not in res:
+        col_detail += (
+            f"; residency transfer bytes "
+            f"{res['residency_on']['transfer_bytes']} vs "
+            f"{res['residency_off']['transfer_bytes']} off"
         )
     _report(name, PASS, f"{len(payload)} legs{col_detail}")
     return PASS
@@ -1138,6 +1165,121 @@ def step_collective_parity() -> str:
         PASS,
         f"{n_groups} sink groups identical, "
         f"{outs['1']['EXCHANGES']} exchanges on",
+    )
+    return PASS
+
+
+_RESIDENCY_PARITY_PROGRAM = """
+import json
+
+from pathway_tpu.engine import ReducerKind, Scope, make_reducer, ref_scalar
+from pathway_tpu.engine import device_residency as dres
+from pathway_tpu.engine.sharded import ShardedScheduler
+
+scopes, sessions, aggs = [], [], []
+for _w in range(4):
+    sc = Scope()
+    sess = sc.input_session(2)
+    agg = sc.group_by_table(
+        sess,
+        by_cols=[0],
+        reducers=[
+            (make_reducer(ReducerKind.SUM), [1]),
+            (make_reducer(ReducerKind.COUNT), []),
+        ],
+    )
+    # raw scopes bypass the optimizer: stamp the eligibility annotation
+    # the placement pass would have written
+    agg._device_ops_eligible = "groupby"
+    scopes.append(sc)
+    sessions.append(sess)
+    aggs.append(agg)
+sched = ShardedScheduler(scopes)
+sess = sessions[0]
+live = {}
+for i in range(20000):
+    live[i] = (i % 512, float(i))
+    sess.insert(ref_scalar(i), live[i])
+sched.commit()
+for i in range(0, 6000, 3):
+    sess.remove(ref_scalar(i), live.pop(i))
+sched.commit()
+merged = {}
+for agg in aggs:
+    merged.update(agg.current)
+sinks = {repr(k): [float(x) for x in v] for k, v in merged.items()}
+s = dres.stats()
+print("SINKS " + json.dumps(sinks, sort_keys=True))
+print("TRANSFER_BYTES " + str(s["h2d"]["bytes"] + s["d2h"]["bytes"]))
+print("RESIDENT " + str(s["events"]["resident_batches"]))
+"""
+
+
+def step_residency_parity() -> str:
+    """Residency-parity gate: the chained groupby repartition leg reruns
+    with device residency OFF (PATHWAY_TPU_DEVICE_RESIDENCY=0, every
+    exchange output materialized to host — the bit-exact fallback spec)
+    and ON (=1, outputs stay device-resident for the eligible consumer)
+    in separate processes — the collective exchange forced on in BOTH so
+    residency is the only variable — and the merged sink tables must
+    diff clean bit for bit.  The ON run must also prove the plane
+    engaged (resident batches > 0) and move strictly fewer h2d+d2h
+    bytes than the OFF baseline."""
+    name = "device-residency parity (leg rerun, DEVICE_RESIDENCY=0 vs 1)"
+    import json
+
+    outs = {}
+    for mode in ("0", "1"):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _RESIDENCY_PARITY_PROGRAM],
+                cwd=REPO,
+                env=_device_sim_env(
+                    PATHWAY_TPU_COLLECTIVE_EXCHANGE="1",
+                    PATHWAY_TPU_DEVICE_RESIDENCY=mode,
+                ),
+                capture_output=True,
+                text=True,
+                timeout=300,
+            )
+        except subprocess.SubprocessError as e:
+            _report(name, FAIL, f"mode {mode} did not finish: {e}")
+            return FAIL
+        if proc.returncode != 0:
+            sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+            _report(name, FAIL, f"mode {mode} exit {proc.returncode}")
+            return FAIL
+        lines = dict(
+            line.split(" ", 1)
+            for line in proc.stdout.splitlines()
+            if " " in line
+        )
+        outs[mode] = lines
+    if outs["0"].get("SINKS") != outs["1"].get("SINKS"):
+        _report(name, FAIL, "sinks differ between residency off and on")
+        return FAIL
+    if int(outs["1"].get("RESIDENT", "0")) <= 0:
+        _report(name, FAIL, "residency-on rerun never kept a batch resident")
+        return FAIL
+    if int(outs["0"].get("RESIDENT", "1")) != 0:
+        _report(name, FAIL, "residency-off rerun still kept batches resident")
+        return FAIL
+    bytes_off = int(outs["0"].get("TRANSFER_BYTES", "0"))
+    bytes_on = int(outs["1"].get("TRANSFER_BYTES", "0"))
+    if not 0 < bytes_on < bytes_off:
+        _report(
+            name,
+            FAIL,
+            f"residency-on moved {bytes_on} transfer bytes, not strictly "
+            f"below the off baseline {bytes_off}",
+        )
+        return FAIL
+    n_groups = len(json.loads(outs["1"]["SINKS"]))
+    _report(
+        name,
+        PASS,
+        f"{n_groups} sink groups identical, {outs['1']['RESIDENT']} "
+        f"resident batches, {bytes_on}/{bytes_off} transfer bytes on/off",
     )
     return PASS
 
@@ -1436,6 +1578,7 @@ def main(argv=None) -> int:
         step_device_ops_parity(),
         step_device_ops_overhead(),
         step_collective_parity(),
+        step_residency_parity(),
         step_bench_device_sim(),
         step_serving_parity(),
         step_serving_overhead(),
