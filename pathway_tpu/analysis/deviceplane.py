@@ -1,0 +1,769 @@
+"""Device-plane discipline lint (``PWD601``–``PWD607``).
+
+The collective exchange (PR 16), device residency (PR 17), device
+kernels (PR 12), and async pipeline (PR 9) live or die by conventions
+that are stated in prose and enforced by hand.  This pass turns them
+into findings over the runtime's own source:
+
+- **PWD601** implicit device sync in a hot path — ``.item()`` /
+  ``.tolist()`` / ``float()`` / ``int()`` / ``np.asarray()`` applied to
+  a jnp-produced value inside operator ``process``/exchange/kernel code
+  paths, outside an explicit materialize/fetch helper.  Each such call
+  blocks the host on the device stream mid-path.
+- **PWD602** recompile hazard — Python branching or loop bounds on a
+  traced function's runtime array values or shapes.  Value branches
+  raise at trace time; shape branches recompile per shape (the padding
+  / bucketed-shape discipline exists to avoid exactly this).
+- **PWD603** uncounted transfer — a ``jax.device_put`` / host
+  materialization site in ``engine/`` whose function never touches the
+  ``pathway_device_transfer_*`` ledger (``record_h2d``/``record_d2h``),
+  violating PR 17's "counted in BOTH modes" rule.
+- **PWD604** partial-push hazard — a decline or ``except`` path in
+  exchange/residency delivery code that reaches a ``push``/deliver call
+  without first materializing the whole buffer (the PR-6/16/17
+  no-partial-push rollback invariant).
+- **PWD605** residency leak — constructing a device-resident columns
+  object whose class never registers instances for
+  ``decay_resident_batches`` retirement, and with no registration at
+  the construction site either.
+- **PWD606** flag-liveness violation — a ``PATHWAY_*`` flag registered
+  as ``live`` in :mod:`pathway_tpu.analysis.flags` read and cached at
+  module or class scope.
+- **PWD607** metric-family discipline — a ``pathway_*`` family name
+  registered twice with different label sets, or used at an
+  increment-style site without any registration in the analyzed set.
+
+Waive intended exceptions with ``# pwd-ok: PWD6xx reason`` on the line
+(or the line above); bare ``# pwd-ok`` waives every PWD code on that
+line.  Findings use the shared source-lint provenance: ``node_name`` is
+the relative file path, ``node_index`` the 1-based line.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from pathway_tpu.analysis.findings import Report
+from pathway_tpu.analysis.flags import LIVE_FLAGS
+from pathway_tpu.analysis.source import SourceModule, emit
+
+# -- shared AST helpers ----------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _own_nodes(func: ast.AST) -> list[ast.AST]:
+    """All nodes in ``func``'s own scope, not descending into nested
+    function/lambda scopes (those are analyzed as their own units)."""
+    out: list[ast.AST] = []
+    stack = list(getattr(func, "body", []))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPES):
+                continue
+            stack.append(child)
+    return out
+
+
+def _all_funcs(tree: ast.Module) -> list[tuple[ast.AST, str | None]]:
+    """Every function/method in the module as ``(node, class_name)``."""
+    out: list[tuple[ast.AST, str | None]] = []
+
+    def visit(body: list[ast.stmt], cls: str | None) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((st, cls))
+                visit(st.body, cls)
+            elif isinstance(st, ast.ClassDef):
+                visit(st.body, st.name)
+    visit(tree.body, None)
+    return out
+
+
+def _calls(func: ast.AST) -> list[ast.Call]:
+    return [n for n in _own_nodes(func) if isinstance(n, ast.Call)]
+
+
+def _call_name(call: ast.Call) -> str:
+    """Last path component of the call target (``a.b.c()`` -> ``c``)."""
+    dotted = _dotted(call.func)
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+# -- PWD601: implicit device sync in hot paths -----------------------------
+
+_HOT_MARKERS = ("exchange", "kernel", "deliver", "dispatch", "push")
+_EXEMPT_MARKERS = ("materialize", "fetch", "decay", "host", "to_numpy")
+_LEDGER_CALLS = {"record_h2d", "record_d2h", "record_saved"}
+_DEVICE_PREFIXES = ("jnp.", "lax.", "jax.")
+_SYNC_METHODS = {"item", "tolist"}
+
+
+def _is_hot_path(name: str) -> bool:
+    if name == "process":
+        return True
+    low = name.lower()
+    if any(m in low for m in _EXEMPT_MARKERS):
+        return False
+    return any(m in low for m in _HOT_MARKERS)
+
+
+def _device_producing(call: ast.Call) -> bool:
+    dotted = _dotted(call.func)
+    if any(dotted.startswith(p) for p in _DEVICE_PREFIXES):
+        return True
+    return "kernel" in dotted.lower()
+
+
+def _device_vars(func: ast.AST) -> set[str]:
+    """Names assigned (in ``func``'s own scope) from jnp/lax/kernel calls."""
+    out: set[str] = set()
+    for node in _own_nodes(func):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _device_producing(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.value, ast.Call
+        ):
+            if _device_producing(node.value) and isinstance(
+                node.target, ast.Name
+            ):
+                out.add(node.target.id)
+    return out
+
+
+def _check_hot_sync(
+    mod: SourceModule, func: ast.AST, report: Report
+) -> None:
+    if not _is_hot_path(func.name):
+        return
+    if any(_call_name(c) in _LEDGER_CALLS for c in _calls(func)):
+        return  # explicit counted fetch — PWD603's jurisdiction
+    dev = _device_vars(func)
+    if not dev:
+        return
+    for call in _calls(func):
+        line = call.lineno
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _SYNC_METHODS and isinstance(
+                call.func.value, ast.Name
+            ):
+                if call.func.value.id in dev:
+                    emit(
+                        report,
+                        mod,
+                        "PWD601",
+                        line,
+                        f"hot path {func.name!r} syncs on device value "
+                        f"{call.func.value.id!r} via "
+                        f".{call.func.attr}() — move to a materialize/"
+                        "fetch helper or batch the readback",
+                    )
+            continue
+        dotted = _dotted(call.func)
+        if dotted in ("float", "int") and call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Name) and arg.id in dev:
+                emit(
+                    report,
+                    mod,
+                    "PWD601",
+                    line,
+                    f"hot path {func.name!r} forces device value "
+                    f"{arg.id!r} to host via {dotted}() — implicit sync",
+                )
+        elif dotted in ("np.asarray", "numpy.asarray") and call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Name) and arg.id in dev:
+                emit(
+                    report,
+                    mod,
+                    "PWD601",
+                    line,
+                    f"hot path {func.name!r} materializes device value "
+                    f"{arg.id!r} via {dotted}() outside a counted "
+                    "materialize/fetch helper",
+                )
+
+
+# -- PWD602: recompile hazard in traced functions --------------------------
+
+_TRACE_WRAPPERS = ("jit", "shard_map", "shard_map_norep", "pmap", "xmap")
+
+
+def _is_trace_wrapper(dotted: str) -> bool:
+    last = dotted.rsplit(".", 1)[-1]
+    return last in _TRACE_WRAPPERS
+
+
+def _traced_names(mod: SourceModule) -> set[str]:
+    """Local function names passed to jit/shard_map wrappers, plus names
+    decorated with them."""
+    traced: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _is_trace_wrapper(
+            _dotted(node.func)
+        ):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    traced.add(arg.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    # @jax.jit(...) or @partial(jax.jit, ...)
+                    if _is_trace_wrapper(_dotted(dec.func)) or any(
+                        _is_trace_wrapper(_dotted(a)) for a in dec.args
+                    ):
+                        traced.add(node.name)
+                elif _is_trace_wrapper(_dotted(dec)):
+                    traced.add(node.name)
+    return traced
+
+
+def _shape_ref(node: ast.AST, params: set[str]) -> str | None:
+    """Param whose ``.shape``/``.ndim``/``.size``/``len()`` ``node``
+    reads, if any."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+            "shape",
+            "ndim",
+            "size",
+        ):
+            base = sub.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in params:
+                return base.id
+        if (
+            isinstance(sub, ast.Call)
+            and _dotted(sub.func) == "len"
+            and sub.args
+            and isinstance(sub.args[0], ast.Name)
+            and sub.args[0].id in params
+        ):
+            return sub.args[0].id
+    return None
+
+
+def _value_branch_ref(test: ast.AST, params: set[str]) -> str | None:
+    """Param used as a runtime truth value / numeric comparison in a
+    branch test (``if x:``, ``while x > 0:``).  Comparisons against
+    string constants and ``is None`` checks are static config, not
+    traced-value branches."""
+    if isinstance(test, ast.Name) and test.id in params:
+        return test.id
+    if isinstance(test, ast.UnaryOp):
+        return _value_branch_ref(test.operand, params)
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            hit = _value_branch_ref(v, params)
+            if hit:
+                return hit
+        return None
+    if isinstance(test, ast.Compare):
+        sides = [test.left, *test.comparators]
+        names = [
+            s.id for s in sides if isinstance(s, ast.Name) and s.id in params
+        ]
+        if not names:
+            return None
+        static = any(
+            isinstance(s, ast.Constant)
+            and (s.value is None or isinstance(s.value, str))
+            for s in sides
+        )
+        if static or any(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return None
+        return names[0]
+    return None
+
+
+def _check_recompile(
+    mod: SourceModule, func: ast.AST, traced: set[str], report: Report
+) -> None:
+    if func.name not in traced:
+        return
+    params = {
+        a.arg
+        for a in [
+            *func.args.posonlyargs,
+            *func.args.args,
+            *func.args.kwonlyargs,
+        ]
+        if a.arg not in ("self", "cls")
+    }
+    if not params:
+        return
+    for node in _own_nodes(func):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = _value_branch_ref(node.test, params)
+            kind = "value"
+            if hit is None:
+                hit = _shape_ref(node.test, params)
+                kind = "shape"
+            if hit:
+                emit(
+                    report,
+                    mod,
+                    "PWD602",
+                    node.lineno,
+                    f"traced function {func.name!r} branches on runtime "
+                    f"{kind} of parameter {hit!r} — trace error or "
+                    "per-shape recompile; pad to bucketed shapes instead",
+                )
+        elif isinstance(node, ast.For):
+            it = node.iter
+            if isinstance(it, ast.Call) and _dotted(it.func) == "range":
+                hit = None
+                for arg in it.args:
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        hit = arg.id
+                    hit = hit or _shape_ref(arg, params)
+                if hit:
+                    emit(
+                        report,
+                        mod,
+                        "PWD602",
+                        node.lineno,
+                        f"traced function {func.name!r} unrolls a Python "
+                        f"loop bounded by parameter {hit!r} — recompiles "
+                        "per bound; use lax.fori_loop/scan or a fixed "
+                        "bucket",
+                    )
+
+
+# -- PWD603: uncounted transfer in engine/ ---------------------------------
+
+_UPLOAD_CALLS = ("device_put",)
+_UPLOAD_DOTTED = {"jnp.asarray", "jnp.array", "jax.numpy.asarray"}
+
+
+def _in_engine(mod: SourceModule) -> bool:
+    rel = mod.rel.replace("\\", "/")
+    return "/engine/" in rel or rel.startswith("engine/")
+
+
+def _local_func_map(mod: SourceModule) -> dict[str, ast.AST]:
+    return {f.name: f for f, _cls in _all_funcs(mod.tree)}
+
+
+def _touches_ledger(
+    func: ast.AST, local: dict[str, ast.AST], depth: int = 0
+) -> bool:
+    for call in _calls(func):
+        name = _call_name(call)
+        if name in _LEDGER_CALLS:
+            return True
+        if depth < 1 and name in local and local[name] is not func:
+            if _touches_ledger(local[name], local, depth + 1):
+                return True
+    return False
+
+
+def _check_uncounted_transfer(
+    mod: SourceModule,
+    func: ast.AST,
+    traced: set[str],
+    local: dict[str, ast.AST],
+    report: Report,
+) -> None:
+    if not _in_engine(mod) or func.name in traced:
+        return
+    sites: list[tuple[int, str]] = []
+    dev = _device_vars(func)
+    for call in _calls(func):
+        dotted = _dotted(call.func)
+        last = dotted.rsplit(".", 1)[-1]
+        if last in _UPLOAD_CALLS or dotted in _UPLOAD_DOTTED:
+            sites.append((call.lineno, f"{dotted or last}() upload"))
+        elif dotted in ("np.asarray", "numpy.asarray") and call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Name) and arg.id in dev:
+                sites.append(
+                    (call.lineno, f"{dotted}({arg.id}) materialization")
+                )
+            elif isinstance(arg, ast.Attribute) and "dev" in arg.attr.lower():
+                sites.append(
+                    (
+                        call.lineno,
+                        f"{dotted}(.{arg.attr}) materialization",
+                    )
+                )
+    if not sites:
+        return
+    if _touches_ledger(func, local):
+        return
+    for line, what in sites:
+        emit(
+            report,
+            mod,
+            "PWD603",
+            line,
+            f"{what} in {func.name!r} without a pathway_device_transfer_* "
+            "ledger increment (record_h2d/record_d2h) in the same "
+            "function — transfers must be counted in BOTH modes",
+        )
+
+
+# -- PWD604: partial push on decline/except paths --------------------------
+
+_MATERIALIZE_MARKERS = ("materialize", "asarray", "fetch", "to_numpy", "host")
+
+
+def _delivery_scope(mod: SourceModule, func: ast.AST) -> bool:
+    rel = mod.rel.replace("\\", "/").lower()
+    if "exchange" in rel or "residency" in rel:
+        return True
+    low = func.name.lower()
+    return "deliver" in low or "push" in low
+
+
+def _stmt_calls(stmt: ast.stmt) -> list[ast.Call]:
+    out = []
+    for node in ast.walk(stmt):
+        if isinstance(node, _SCOPES):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+    return out
+
+
+def _is_push_call(call: ast.Call) -> bool:
+    name = _call_name(call).lower()
+    return name == "push" or "deliver" in name
+
+
+def _is_materialize_call(call: ast.Call) -> bool:
+    name = _call_name(call).lower()
+    return any(m in name for m in _MATERIALIZE_MARKERS)
+
+
+def _is_decline_stmt(stmt: ast.stmt) -> bool:
+    """``STATS["declined_*"] += 1`` / ``.inc()`` on a declined counter."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.slice, ast.Constant
+        ):
+            if (
+                isinstance(node.slice.value, str)
+                and "declin" in node.slice.value
+            ):
+                return True
+        if isinstance(node, ast.Attribute) and "declin" in node.attr.lower():
+            return True
+    return False
+
+
+def _scan_block(
+    mod: SourceModule,
+    func: ast.AST,
+    block: list[ast.stmt],
+    armed: bool,
+    why: str,
+    report: Report,
+) -> None:
+    """Walk ``block`` statement-by-statement; once ``armed`` (decline or
+    except path), a push/deliver before any whole-buffer materialization
+    is a PWD604."""
+    materialized = False
+    for stmt in block:
+        if isinstance(stmt, ast.Try):
+            _scan_block(mod, func, stmt.body, armed, why, report)
+            for handler in stmt.handlers:
+                _scan_block(
+                    mod, func, handler.body, True, "except path", report
+                )
+            _scan_block(mod, func, stmt.finalbody, armed, why, report)
+            continue
+        if isinstance(stmt, (ast.If, ast.While, ast.For, ast.With)):
+            for sub in (
+                getattr(stmt, "body", []),
+                getattr(stmt, "orelse", []),
+            ):
+                _scan_block(mod, func, sub, armed, why, report)
+            continue
+        for call in _stmt_calls(stmt):
+            if _is_materialize_call(call):
+                materialized = True
+            elif armed and not materialized and _is_push_call(call):
+                emit(
+                    report,
+                    mod,
+                    "PWD604",
+                    call.lineno,
+                    f"{func.name!r} reaches {_call_name(call)}() on a "
+                    f"{why} before whole-buffer materialization — "
+                    "declines must materialize whole or push nothing",
+                )
+        if not armed and _is_decline_stmt(stmt):
+            armed, why = True, "decline path"
+
+
+def _check_partial_push(
+    mod: SourceModule, func: ast.AST, report: Report
+) -> None:
+    if not _delivery_scope(mod, func):
+        return
+    _scan_block(mod, func, func.body, False, "", report)
+
+
+# -- PWD605: residency leak ------------------------------------------------
+
+_RESIDENT_CLASS_MARKERS = ("resident", "devicebatch")
+_REGISTRY_NAME_MARKERS = ("live", "resident", "handle", "staged")
+
+
+def _registers_instances(cls: ast.ClassDef) -> bool:
+    """Does any method of ``cls`` add instances to a live-set registry
+    (``_LIVE_RESIDENT.add(self)`` style) or call a register helper?"""
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for call in _calls(stmt):
+            name = _call_name(call).lower()
+            if name == "add" and isinstance(call.func, ast.Attribute):
+                holder = _dotted(call.func.value).lower()
+                if any(m in holder for m in _REGISTRY_NAME_MARKERS):
+                    return True
+            if "register" in name or "stage" in name:
+                return True
+    return False
+
+
+def _resident_classes(
+    modules: list[SourceModule],
+) -> dict[str, bool]:
+    """class name -> registers-for-decay, for device-resident classes."""
+    out: dict[str, bool] = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                m in node.name.lower() for m in _RESIDENT_CLASS_MARKERS
+            ):
+                out[node.name] = out.get(node.name, False) or (
+                    _registers_instances(node)
+                )
+    return out
+
+
+def _check_residency_leak(
+    mod: SourceModule,
+    func: ast.AST,
+    resident_classes: dict[str, bool],
+    report: Report,
+) -> None:
+    site_registers = False
+    sites: list[tuple[int, str]] = []
+    for call in _calls(func):
+        name = _call_name(call)
+        low = name.lower()
+        if name in resident_classes:
+            if not resident_classes[name]:
+                sites.append((call.lineno, name))
+        elif low == "add" and isinstance(call.func, ast.Attribute):
+            holder = _dotted(call.func.value).lower()
+            if any(m in holder for m in _REGISTRY_NAME_MARKERS):
+                site_registers = True
+        elif "register" in low or "stage_device" in low:
+            site_registers = True
+    if site_registers:
+        return
+    for line, cls in sites:
+        emit(
+            report,
+            mod,
+            "PWD605",
+            line,
+            f"{func.name!r} constructs {cls} but neither the class nor "
+            "the construction site registers it for "
+            "decay_resident_batches/drain_until retirement — resident "
+            "batches would outlive the commit boundary",
+        )
+
+
+# -- PWD606: flag-liveness violation ---------------------------------------
+
+
+def _env_flag(call_or_sub: ast.AST) -> str | None:
+    """Flag name if the node reads an env var with a constant key."""
+    if isinstance(call_or_sub, ast.Call):
+        dotted = _dotted(call_or_sub.func)
+        if dotted.endswith("environ.get") or dotted.endswith("getenv"):
+            if call_or_sub.args and isinstance(
+                call_or_sub.args[0], ast.Constant
+            ):
+                v = call_or_sub.args[0].value
+                return v if isinstance(v, str) else None
+    if isinstance(call_or_sub, ast.Subscript):
+        if _dotted(call_or_sub.value).endswith("environ") and isinstance(
+            call_or_sub.slice, ast.Constant
+        ):
+            v = call_or_sub.slice.value
+            return v if isinstance(v, str) else None
+    return None
+
+
+def _check_flag_liveness(mod: SourceModule, report: Report) -> None:
+    def scan(stmts: list[ast.stmt], scope: str) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                scan(stmt.body, f"class {stmt.name}")
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, _SCOPES):
+                    continue
+                flag = _env_flag(node)
+                if flag and flag in LIVE_FLAGS:
+                    emit(
+                        report,
+                        mod,
+                        "PWD606",
+                        node.lineno,
+                        f"live-per-call flag {flag} read at {scope} scope "
+                        "— cached at import, so runtime flips are "
+                        "silently ignored; re-read it inside the call "
+                        "path (see analysis/flags.py)",
+                    )
+
+    scan(mod.tree.body, "module")
+
+
+# -- PWD607: metric-family discipline --------------------------------------
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_USE_METHODS = {"inc", "observe", "set", "labels"}
+_NON_LABEL_KWARGS = {"help", "buckets", "initial", "unit"}
+
+
+@dataclass
+class _Registration:
+    mod: SourceModule
+    line: int
+    kind: str
+    labels: frozenset[str]
+
+
+@dataclass
+class _MetricIndex:
+    families: dict[str, list[_Registration]] = field(default_factory=dict)
+
+
+def _collect_metrics(modules: list[SourceModule]) -> _MetricIndex:
+    idx = _MetricIndex()
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            last = dotted.rsplit(".", 1)[-1]
+            family = None
+            labels: frozenset[str] = frozenset()
+            if (
+                last in _REG_METHODS
+                and "registry" in dotted.lower()
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("pathway_")
+            ):
+                family = node.args[0].value
+                labels = frozenset(
+                    kw.arg
+                    for kw in node.keywords
+                    if kw.arg and kw.arg not in _NON_LABEL_KWARGS
+                )
+            elif (
+                last == "MirroredCounterDict"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("pathway_")
+            ):
+                family = node.args[0].value
+                if len(node.args) > 1 and isinstance(
+                    node.args[1], ast.Constant
+                ):
+                    labels = frozenset({str(node.args[1].value)})
+            if family is not None:
+                idx.families.setdefault(family, []).append(
+                    _Registration(mod, node.lineno, last, labels)
+                )
+    return idx
+
+
+def _check_metric_families(
+    modules: list[SourceModule], idx: _MetricIndex, report: Report
+) -> None:
+    for family, regs in sorted(idx.families.items()):
+        base = regs[0]
+        for reg in regs[1:]:
+            if reg.labels != base.labels:
+                emit(
+                    report,
+                    reg.mod,
+                    "PWD607",
+                    reg.line,
+                    f"metric family {family!r} registered with labels "
+                    f"{sorted(reg.labels)} but first registered at "
+                    f"{base.mod.rel}:{base.line} with "
+                    f"{sorted(base.labels)} — label sets must agree",
+                )
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            last = dotted.rsplit(".", 1)[-1]
+            if (
+                last in _USE_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("pathway_")
+                and node.args[0].value not in idx.families
+            ):
+                emit(
+                    report,
+                    mod,
+                    "PWD607",
+                    node.lineno,
+                    f"metric family {node.args[0].value!r} used at an "
+                    f"increment site (.{last}) but never registered on "
+                    "the metrics registry in the analyzed set",
+                )
+
+
+# -- driver ----------------------------------------------------------------
+
+
+def run_pass(modules: list[SourceModule], report: Report) -> None:
+    resident_classes = _resident_classes(modules)
+    metric_idx = _collect_metrics(modules)
+    for mod in modules:
+        traced = _traced_names(mod)
+        local = _local_func_map(mod)
+        _check_flag_liveness(mod, report)
+        for func, _cls in _all_funcs(mod.tree):
+            _check_hot_sync(mod, func, report)
+            _check_recompile(mod, func, traced, report)
+            _check_uncounted_transfer(mod, func, traced, local, report)
+            _check_partial_push(mod, func, report)
+            _check_residency_leak(mod, func, resident_classes, report)
+    _check_metric_families(modules, metric_idx, report)

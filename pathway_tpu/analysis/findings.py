@@ -15,10 +15,12 @@ Code ranges:
 - ``PWA3xx`` — UDF determinism & purity lint
 - ``PWC4xx`` — runtime lock-discipline lint (source-level, ``analysis.concurrency``)
 - ``PWC5xx`` — scheduler/mesh protocol invariants (source-level, ``analysis.protocol``)
+- ``PWD6xx`` — device-plane discipline: transfers, tracing safety,
+  residency lifecycle (source-level, ``analysis.deviceplane``)
 
-``PWC`` findings come from the *source tree*, not a built graph, so their
-provenance fields are reinterpreted: ``node_name`` is the relative file
-path and ``node_index`` the 1-based line number.
+``PWC``/``PWD`` findings come from the *source tree*, not a built graph,
+so their provenance fields are reinterpreted: ``node_name`` is the
+relative file path and ``node_index`` the 1-based line number.
 """
 
 from __future__ import annotations
@@ -63,6 +65,13 @@ FINDING_CODES: dict[str, tuple[Severity, str]] = {
     "PWC502": (Severity.ERROR, "rollback path cannot reach snapshot truncate"),
     "PWC503": (Severity.ERROR, "mesh frame arity drift between encode/decode"),
     "PWC504": (Severity.ERROR, "follower frame handler missing epoch fence"),
+    "PWD601": (Severity.WARNING, "implicit device sync in hot path"),
+    "PWD602": (Severity.ERROR, "recompile hazard: branch on traced shape/value"),
+    "PWD603": (Severity.ERROR, "device transfer not counted in ledger"),
+    "PWD604": (Severity.ERROR, "partial push on decline/except path"),
+    "PWD605": (Severity.ERROR, "device-resident state never registered for decay"),
+    "PWD606": (Severity.ERROR, "live-per-call flag cached at import scope"),
+    "PWD607": (Severity.WARNING, "metric family unregistered or label drift"),
 }
 
 
@@ -75,6 +84,10 @@ class Finding:
     severity: Severity = Severity.ERROR
     column: int | None = None
     trace: str | None = None
+    #: True when a ``# pwc-ok``/``# pwd-ok`` comment waived this finding —
+    #: kept out of :attr:`Report.findings` (and every count) but surfaced
+    #: in ``--json`` output so CI can diff waivers, not just failures.
+    waived: bool = False
 
     def __post_init__(self) -> None:
         assert self.code in FINDING_CODES, f"unknown finding code {self.code}"
@@ -101,6 +114,7 @@ class Finding:
             "node_name": self.node_name,
             "column": self.column,
             "trace": self.trace,
+            "waived": self.waived,
         }
 
     @classmethod
@@ -113,6 +127,7 @@ class Finding:
             severity=Severity(d["severity"]),
             column=d.get("column"),
             trace=d.get("trace"),
+            waived=d.get("waived", False),
         )
 
 
@@ -129,6 +144,10 @@ class Report:
     #: ``findings``; any entry here means the analysis is incomplete
     internal_errors: list[str] = field(default_factory=list)
     node_count: int = 0
+    #: findings suppressed by ``# pwc-ok``/``# pwd-ok`` waiver comments
+    #: (``waived=True`` on each) — excluded from counts and exit codes,
+    #: but emitted in machine-readable output so waivers stay auditable
+    waived: list[Finding] = field(default_factory=list)
 
     def add(self, finding: Finding) -> None:
         self.findings.append(finding)
@@ -153,6 +172,7 @@ class Report:
         self.findings.extend(other.findings)
         self.internal_errors.extend(other.internal_errors)
         self.node_count += other.node_count
+        self.waived.extend(other.waived)
 
     def render(self) -> str:
         lines = [f"analyzed {self.node_count} operator(s)"]
@@ -173,6 +193,7 @@ class Report:
             "node_count": self.node_count,
             "findings": [f.to_dict() for f in self.findings],
             "internal_errors": list(self.internal_errors),
+            "waived": [f.to_dict() for f in self.waived],
         }
 
     @classmethod
@@ -181,6 +202,7 @@ class Report:
             findings=[Finding.from_dict(f) for f in d.get("findings", [])],
             internal_errors=list(d.get("internal_errors", [])),
             node_count=d.get("node_count", 0),
+            waived=[Finding.from_dict(f) for f in d.get("waived", [])],
         )
 
 

@@ -1,17 +1,21 @@
-"""Source-tree analysis driver for the runtime concurrency & protocol
-passes.
+"""Source-tree analysis driver for the runtime concurrency, protocol,
+and device-plane passes.
 
 The graph passes (``analysis.dtypes`` … ``analysis.udf_lint``) need a
-built :class:`~pathway_tpu.engine.graph.Scope`; the ``PWC`` passes lint
-the *runtime's own source* instead — the threads, locks, and mesh
-protocol that execute the graph.  This module owns the shared plumbing:
+built :class:`~pathway_tpu.engine.graph.Scope`; the ``PWC``/``PWD``
+passes lint the *runtime's own source* instead — the threads, locks,
+mesh protocol, and device planes that execute the graph.  This module
+owns the shared plumbing:
 
 - collecting ``.py`` files from a mix of file and directory targets,
-- parsing them once into :class:`SourceModule` records shared by both
-  passes (``analysis.concurrency`` and ``analysis.protocol``),
+- parsing them once into :class:`SourceModule` records shared by the
+  passes (``analysis.concurrency``, ``analysis.protocol``, and
+  ``analysis.deviceplane``),
 - per-line suppression comments (``# pwc-ok: PWC403`` waives one code on
-  that line, bare ``# pwc-ok`` waives them all — every waiver should
-  carry a reason in the trailing text),
+  that line, bare ``# pwc-ok`` waives them all; ``# pwd-ok: PWD603``
+  likewise for the device-plane family, bare ``# pwd-ok`` waives every
+  PWD code — every waiver should carry a reason in the trailing text;
+  waived findings are kept on ``report.waived`` for ``--json`` audit),
 - the same crash isolation as :func:`analyze_scope`: a pass that raises
   lands in ``report.internal_errors`` (CLI exit 2), never in findings.
 
@@ -31,6 +35,9 @@ from pathway_tpu.analysis.findings import Finding, Report, Severity
 
 GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
 SUPPRESS_RE = re.compile(r"#\s*pwc-ok(?::\s*([A-Z0-9, ]+))?")
+#: bare ``# pwd-ok`` waives only the PWD family (recorded as ``PWD*``),
+#: unlike bare ``# pwc-ok`` which predates PWD and waives everything
+PWD_SUPPRESS_RE = re.compile(r"#\s*pwd-ok(?::\s*([A-Z0-9, ]+))?")
 
 
 @dataclass
@@ -91,14 +98,15 @@ def load_module(path: str, root: str | None = None) -> SourceModule:
         g = GUARD_RE.search(line)
         if g:
             mod.guard_comments[i] = g.group(1)
-        s = SUPPRESS_RE.search(line)
-        if s:
-            codes = s.group(1)
-            mod.suppress[i] = (
-                {c.strip() for c in codes.split(",") if c.strip()}
-                if codes
-                else {"*"}
-            )
+        for regex, bare in ((SUPPRESS_RE, "*"), (PWD_SUPPRESS_RE, "PWD*")):
+            m = regex.search(line)
+            if not m:
+                continue
+            codes = m.group(1) or ""
+            parsed = {c.strip() for c in codes.split(",") if c.strip()}
+            # "# pwd-ok: some lowercase reason" parses no codes — that is
+            # the bare form with a reason, not an empty waiver
+            mod.suppress.setdefault(i, set()).update(parsed or {bare})
     return mod
 
 
@@ -111,21 +119,29 @@ def emit(
     severity: Severity | None = None,
 ) -> None:
     """Add a finding unless the line (or a standalone waiver comment on
-    the line above) carries a matching waiver."""
-    waived = mod.suppress.get(line, set()) | mod.suppress.get(line - 1, set())
-    if "*" in waived or code in waived:
-        return
+    the line above) carries a matching waiver.  Waived findings are kept
+    on ``report.waived`` (flagged ``waived=True``) so machine-readable
+    output can audit them; they never affect counts or exit codes."""
+    waivers = mod.suppress.get(line, set()) | mod.suppress.get(line - 1, set())
     from pathway_tpu.analysis.findings import FINDING_CODES
 
-    report.add(
-        Finding(
-            code=code,
-            message=message,
-            node_index=line,
-            node_name=mod.rel,
-            severity=severity or FINDING_CODES[code][0],
-        )
+    is_waived = (
+        "*" in waivers
+        or code in waivers
+        or ("PWD*" in waivers and code.startswith("PWD"))
     )
+    finding = Finding(
+        code=code,
+        message=message,
+        node_index=line,
+        node_name=mod.rel,
+        severity=severity or FINDING_CODES[code][0],
+        waived=is_waived,
+    )
+    if is_waived:
+        report.waived.append(finding)
+    else:
+        report.add(finding)
 
 
 def analyze_paths(targets: list[str], root: str | None = None) -> Report:
@@ -135,7 +151,7 @@ def analyze_paths(targets: list[str], root: str | None = None) -> Report:
     crash-isolated into ``internal_errors``; ``node_count`` counts the
     files analyzed.
     """
-    from pathway_tpu.analysis import concurrency, protocol
+    from pathway_tpu.analysis import concurrency, deviceplane, protocol
 
     if root is None:
         root = os.getcwd()
@@ -150,6 +166,7 @@ def analyze_paths(targets: list[str], root: str | None = None) -> Report:
     for name, run in (
         ("concurrency", concurrency.run_pass),
         ("protocol", protocol.run_pass),
+        ("deviceplane", deviceplane.run_pass),
     ):
         try:
             run(modules, report)

@@ -116,8 +116,15 @@ def analyze_source(
     strict: bool = False,
 ) -> int:
     """Lint the runtime's own source (``analyze --source``): the PWC
-    concurrency + protocol passes over files/directories, same exit
-    contract as graph mode (0 clean, 1 findings, 2 analyzer failure)."""
+    concurrency/protocol and PWD device-plane passes over files or
+    directories, same exit contract as graph mode (0 clean, 1 findings,
+    2 analyzer failure).
+
+    ``--json`` emits a machine-readable document for CI diffing: one
+    record per finding — ``code``, ``path``, ``line``, ``column``,
+    ``severity``, ``message``, ``waived`` — with waived findings
+    included (``waived: true``) but never counted toward the exit code.
+    """
     from pathway_tpu.analysis import Severity
     from pathway_tpu.analysis.source import analyze_paths
 
@@ -130,7 +137,31 @@ def analyze_source(
         return 2
     report = analyze_paths(list(targets), root=os.getcwd())
     if as_json:
-        print(json.dumps(report.to_dict(), indent=2))
+        def _rec(f):
+            return {
+                "code": f.code,
+                "path": f.node_name,
+                "line": f.node_index,
+                "column": f.column,
+                "severity": f.severity.value,
+                "message": f.message,
+                "waived": f.waived,
+            }
+
+        doc = {
+            "mode": "source",
+            "files": report.node_count,
+            "findings": [_rec(f) for f in report.sorted_findings()]
+            + [_rec(f) for f in report.waived],
+            "internal_errors": list(report.internal_errors),
+            "summary": {
+                "errors": report.count(Severity.ERROR),
+                "warnings": report.count(Severity.WARNING),
+                "info": report.count(Severity.INFO),
+                "waived": len(report.waived),
+            },
+        }
+        print(json.dumps(doc, indent=2))
     else:
         print(report.render())
     if report.internal_errors or report.node_count == 0:

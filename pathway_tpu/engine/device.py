@@ -391,9 +391,16 @@ def device_count() -> int:
 
 def to_device(arr: np.ndarray, sharding: Any | None = None):
     """NumPy column -> jax.Array, zero-copy where the backend allows (CPU
-    dlpack aliasing; on TPU this is the single necessary host->HBM DMA)."""
+    dlpack aliasing; on TPU this is the single necessary host->HBM DMA).
+
+    Counted on the ``pathway_device_transfer_*`` ledger in both modes —
+    zero-copy backends over-count by the aliased bytes, which is the
+    conservative direction for the transfer-reduction gates."""
     import jax
 
+    from pathway_tpu.engine import device_residency as _dres
+
+    _dres.record_h2d(int(getattr(arr, "nbytes", 0)))
     if sharding is not None:
         return jax.device_put(arr, sharding)
     return jax.numpy.asarray(arr)
@@ -454,7 +461,10 @@ class DeviceBatchHandle:
 
     def host(self) -> np.ndarray:
         if self._host is None:
+            from pathway_tpu.engine import device_residency as _dres
+
             self._host = np.asarray(self.dev)
+            _dres.record_d2h(int(self._host.nbytes))
         return self._host
 
     def decay(self) -> None:

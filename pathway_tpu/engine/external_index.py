@@ -164,6 +164,7 @@ class DeviceKnnIndex:
     ) -> None:
         import jax.numpy as jnp
 
+        from pathway_tpu.engine import device_residency as _dres
         from pathway_tpu.ops import knn_update
 
         n = len(slots)
@@ -179,6 +180,10 @@ class DeviceKnnIndex:
         valid_arr[:n] = set_valid
         enabled = np.zeros((b,), bool)
         enabled[:n] = True
+        _dres.record_h2d(
+            slots_arr.nbytes + vec_arr.nbytes + valid_arr.nbytes
+            + enabled.nbytes
+        )
         self.state = knn_update(
             self.state,
             jnp.asarray(slots_arr),
@@ -272,6 +277,7 @@ class DeviceKnnIndex:
 
         import jax.numpy as jnp
 
+        from pathway_tpu.engine import device_residency as _dres
         from pathway_tpu.ops import knn_update
 
         n = len(keys)
@@ -292,6 +298,10 @@ class DeviceKnnIndex:
         idx_pad = np.zeros((b,), np.int32)
         idx_pad[:n] = indices
         t0 = _time.perf_counter_ns()
+        # only the control arrays go up — the vectors are already resident
+        _dres.record_h2d(
+            slots_arr.nbytes + enabled.nbytes + idx_pad.nbytes
+        )
         enabled_dev = jnp.asarray(enabled)
         gathered = _gather_pad(
             dev, jnp.asarray(idx_pad), enabled_dev
@@ -338,6 +348,7 @@ class DeviceKnnIndex:
     def restore_op_state(self, state: dict) -> None:
         import jax.numpy as jnp
 
+        from pathway_tpu.engine import device_residency as _dres
         from pathway_tpu.ops.knn import DeviceKnnState
 
         self.capacity = state["capacity"]
@@ -345,6 +356,11 @@ class DeviceKnnIndex:
             vectors=jnp.asarray(state["vectors"]),
             valid=jnp.asarray(state["valid"]),
             norms=jnp.asarray(state["norms"]),
+        )
+        _dres.record_h2d(
+            int(self.state.vectors.nbytes)
+            + int(self.state.valid.nbytes)
+            + int(self.state.norms.nbytes)
         )
         self.key_to_slot = dict(state["key_to_slot"])
         self.slot_to_key = {s: k for k, s in self.key_to_slot.items()}
@@ -387,6 +403,7 @@ class DeviceKnnIndex:
     ) -> list[list[tuple[Pointer, float]]]:
         import jax.numpy as jnp
 
+        from pathway_tpu.engine import device_residency as _dres
         from pathway_tpu.ops import knn_search
         from pathway_tpu.ops.knn import knn_search_sharded
 
@@ -416,6 +433,7 @@ class DeviceKnnIndex:
             q = np.zeros((b, self.dim), np.float32)
             for i, vec in enumerate(queries):
                 q[i] = np.asarray(vec, np.float32).reshape(self.dim)
+            _dres.record_h2d(q.nbytes)
             q_dev = jnp.asarray(q)
         t0 = _time.perf_counter_ns()
         if self.mesh is not None:
@@ -427,6 +445,7 @@ class DeviceKnnIndex:
                 self.state, q_dev, k_eff, self.metric
             )
         packed = np.asarray(_pack_results(scores, slots))
+        _dres.record_d2h(packed.nbytes)
         _dops.record_kernel(
             "knn_search", _time.perf_counter_ns() - t0, hits=n
         )

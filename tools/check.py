@@ -158,27 +158,25 @@ def step_analyzer() -> str:
     return status
 
 
-#: the runtime's own threaded modules, linted by the concurrency
-#: (PWC4xx) and protocol (PWC5xx) passes on every check run — README's
-#: "tools/check.py runs exactly this command" points here
+#: the whole runtime tree, linted by the concurrency (PWC4xx), protocol
+#: (PWC5xx), and device-plane (PWD6xx) passes on every check run —
+#: promoted from a hand-maintained module list so new modules can't
+#: silently dodge the lint; README's "tools/check.py runs exactly this
+#: command" points here, and tests/test_analysis_deviceplane.py pins the
+#: same whole-tree zero
 SOURCE_LINT_TARGETS = [
-    "pathway_tpu/serving",
-    "pathway_tpu/engine/collective_exchange.py",
-    "pathway_tpu/engine/device_pipeline.py",
-    "pathway_tpu/engine/device_residency.py",
-    "pathway_tpu/internals/profiling.py",
-    "pathway_tpu/internals/timeseries.py",
-    "pathway_tpu/optimize/placement.py",
+    "pathway_tpu",
 ]
 
 
 def step_source_lint() -> str:
-    """Concurrency/protocol lint self-run: the lock-discipline pass
-    (guarded-by writes, lock-order cycles, blocking calls under locks)
-    and the protocol pass (drain-before-hook, rollback/truncate
-    reachability, frame arity, epoch fences) must find NOTHING — not
-    even info — on the runtime's own threaded modules."""
-    name = "source lint (cli analyze --source --strict, serving + pipeline)"
+    """Source lint self-run over the WHOLE runtime tree: lock discipline
+    (guarded-by writes, lock-order cycles, blocking calls under locks),
+    protocol invariants (drain-before-hook, rollback/truncate
+    reachability, frame arity, epoch fences), and device-plane
+    discipline (PWD601–607) must find NOTHING — not even info —
+    anywhere under pathway_tpu/."""
+    name = "source lint (cli analyze --source --strict pathway_tpu/)"
     proc = subprocess.run(
         [
             sys.executable,
@@ -188,6 +186,38 @@ def step_source_lint() -> str:
             "--source",
             "--strict",
             *SOURCE_LINT_TARGETS,
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        name,
+        status,
+        f"exit code {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+def step_deviceplane_lint() -> str:
+    """Device-plane lint gate on the accelerator-facing packages:
+    `cli analyze --source --strict` over engine/ + optimize/ must stay
+    PWD-clean (uncounted transfers, recompile hazards, partial pushes,
+    residency leaks, flag-liveness, metric-family drift).  Narrower than
+    step_source_lint so a regression names the plane that broke; item-1
+    autoscaler and item-4 tiered-state device code land behind this
+    gate (ROADMAP)."""
+    name = "deviceplane lint (analyze --source --strict engine+optimize)"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pathway_tpu.cli",
+            "analyze",
+            "--source",
+            "--strict",
+            "pathway_tpu/engine",
+            "pathway_tpu/optimize",
         ],
         cwd=REPO,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
@@ -1569,6 +1599,7 @@ def main(argv=None) -> int:
         step_ruff(),
         step_analyzer(),
         step_source_lint(),
+        step_deviceplane_lint(),
         step_optimize_off(),
         step_async_parity(),
         step_metrics_overhead(),
