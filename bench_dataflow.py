@@ -1763,6 +1763,455 @@ def rescale_leg() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+#: read-tier bench worker: one ingest+serve process.  Builds a HostKnn
+#: pipeline into a private SnapshotStore, serves queries on port argv[1]
+#: and the snapshot stream on argv[2], then follows a line protocol on
+#: stdin so the leg can interleave timed ingest with query load:
+#:   bench_ingest <n> <pace_ms> <rows>  time n PACED commit+publish
+#:       cycles (a live source has its own arrival cadence: the overhead
+#:       question is whether streaming stalls it) -> INGEST json
+#:   ingest_on <pace_ms> <rows>         background ingest loop
+#:   ingest_off                         stop it
+#:   quit                               exit
+_READ_TIER_WORKER = '''
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+from pathway_tpu.engine.external_index import ExternalIndexNode, HostKnnIndex
+from pathway_tpu.engine.graph import Scheduler, Scope
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.serving.server import QueryServer
+from pathway_tpu.serving.snapshot import SnapshotStore
+from pathway_tpu.serving.stream import SnapshotStreamServer
+
+DIM, CAP, BATCH = 32, 512, 128
+wport, sport = int(sys.argv[1]), int(sys.argv[2])
+sc = Scope()
+index_in = sc.input_session(arity=1)
+query_in = sc.input_session(arity=1)
+ExternalIndexNode(
+    sc, index_in, query_in, HostKnnIndex(dim=DIM, capacity=CAP),
+    index_col=0, query_col=0, k=8,
+)
+sched = Scheduler(sc)
+store = SnapshotStore()
+stream = SnapshotStreamServer(store=store, port=sport, process_id=0)
+rng = np.random.default_rng(7)
+key = [0]
+
+
+def ingest_once(rows=BATCH):
+    for _ in range(rows):
+        i = key[0]
+        key[0] += 1
+        vec = rng.standard_normal(DIM).astype(np.float32)
+        index_in.insert(ref_scalar(i % CAP), (tuple(float(x) for x in vec),))
+    t = sched.commit()
+    stream.publish(store.publish([sc], t))
+
+
+ingest_once()
+server = QueryServer(store=store, port=wport).start()
+stream.start()
+stop_bg = threading.Event()
+bg = [None]
+
+
+def bg_loop(pace_s, rows):
+    while not stop_bg.is_set():
+        t0 = time.perf_counter()
+        ingest_once(rows)
+        delay = pace_s - (time.perf_counter() - t0)
+        if delay > 0:
+            stop_bg.wait(delay)
+
+
+print("READY " + json.dumps({"port": wport, "stream_port": sport}),
+      flush=True)
+for line in sys.stdin:
+    cmd = line.split()
+    if not cmd:
+        continue
+    if cmd[0] == "bench_ingest":
+        n, pace_s, rows = int(cmd[1]), float(cmd[2]) / 1000.0, int(cmd[3])
+        for _ in range(3):
+            ingest_once(rows)
+        t0 = time.perf_counter()
+        for i in range(n):
+            ingest_once(rows)
+            delay = t0 + (i + 1) * pace_s - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        print("INGEST " + json.dumps({
+            "s": time.perf_counter() - t0,
+            "rows": n * rows,
+            "subscribers": stream.subscriber_count(),
+        }), flush=True)
+    elif cmd[0] == "ingest_on":
+        pace_s, rows = float(cmd[1]) / 1000.0, int(cmd[2])
+        stop_bg.clear()
+        bg[0] = threading.Thread(
+            target=bg_loop, args=(pace_s, rows), daemon=True
+        )
+        bg[0].start()
+        print("OK", flush=True)
+    elif cmd[0] == "ingest_off":
+        stop_bg.set()
+        if bg[0] is not None:
+            bg[0].join(timeout=10.0)
+        print("OK", flush=True)
+    elif cmd[0] == "quit":
+        break
+stream.stop()
+server.stop()
+'''
+
+
+def _proc_expect(proc, prefix: str, timeout: float) -> dict:
+    """Read the worker's stdout until a ``prefix`` protocol line (or the
+    pipe closes / the deadline passes).  The read runs on a daemon
+    thread so a wedged subprocess cannot hang the whole bench."""
+    result: list = []
+
+    def read() -> None:
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                result.append(None)
+                return
+            line = line.strip()
+            if line.startswith(prefix):
+                result.append(line[len(prefix):].strip())
+                return
+
+    th = threading.Thread(target=read, daemon=True)
+    th.start()
+    th.join(timeout)
+    if not result or result[0] is None:
+        raise RuntimeError(
+            f"read-tier worker: no {prefix!r} line within {timeout}s "
+            f"(rc={proc.poll()})"
+        )
+    return json.loads(result[0]) if result[0] else {}
+
+
+def _wait_health(port: int, timeout: float, need_commit: bool) -> dict:
+    """Poll ``/serving/health`` until 200 (and, for replicas, until a
+    first consistent cut exists — ``commit_time`` non-null)."""
+    import urllib.error
+    import urllib.request
+
+    deadline = time.perf_counter() + timeout
+    last: object = None
+    while time.perf_counter() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/serving/health", timeout=2.0
+            ) as resp:
+                payload = json.loads(resp.read())
+            if not need_commit or payload.get("commit_time") is not None:
+                return payload
+            last = payload
+        except (OSError, ValueError) as exc:
+            last = repr(exc)
+        time.sleep(0.05)
+    raise RuntimeError(f"port {port} never became healthy: {last!r}")
+
+
+def _qps_run(
+    port: int, secs: float, n_clients: int, qvecs: list, k: int
+) -> tuple[float, dict]:
+    """Closed-loop query capacity probe: ``n_clients`` threads hammer
+    ``/serving/query`` with distinct vectors for ``secs``; returns
+    (answered-per-second, status counts)."""
+    import urllib.error
+    import urllib.request
+
+    counts = {"ok": 0, "shed": 0, "err": 0}
+    lock = threading.Lock()
+    start = time.perf_counter()
+    stop_at = start + secs
+
+    def client(cid: int) -> None:
+        i = cid
+        while time.perf_counter() < stop_at:
+            body = json.dumps(
+                {"vector": qvecs[i % len(qvecs)], "k": k}
+            ).encode()
+            i += n_clients
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/serving/query",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    resp.read()
+                    code = resp.status
+            except urllib.error.HTTPError as exc:
+                code = exc.code
+            except OSError:
+                with lock:
+                    counts["err"] += 1
+                time.sleep(0.02)
+                continue
+            with lock:
+                if code == 200:
+                    counts["ok"] += 1
+                elif code == 503:
+                    counts["shed"] += 1
+                else:
+                    counts["err"] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(cid,), daemon=True)
+        for cid in range(n_clients)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=secs + 15.0)
+    return counts["ok"] / secs, counts
+
+
+def read_tier_leg() -> dict:
+    """Read tier end to end: one ingest+serve worker subprocess streams
+    commit-stamped snapshots to two ``cli replica`` subprocesses behind
+    an in-process federation front.  Reports (a) the ingest tax of two
+    stream subscribers (timed publish loop with 0 vs 2 replicas, gate
+    <= 5%), (b) query capacity WHILE the worker ingests — direct worker
+    hits vs the federated replica pool, whose capacity is independent of
+    the ingest process — and (c) the commit-stamped result cache's
+    hot-query p99 vs the uncached full path (same query, live
+    PATHWAY_TPU_RESULT_CACHE flip)."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    secs = float(os.environ.get("BENCH_READ_TIER_QPS_SECS", "1.2"))
+    n_clients = int(os.environ.get("BENCH_READ_TIER_CLIENTS", "8"))
+    n_commits = int(os.environ.get("BENCH_READ_TIER_COMMITS", "40"))
+    cache_reqs = int(os.environ.get("BENCH_READ_TIER_CACHE_REQS", "200"))
+    dim, k = 32, 8
+    # paced ingest cadence for the overhead gate (16k rows/s target)...
+    pace_ms, rows_per_commit = 8, 128
+    # ...and a full-tilt background ingest for the capacity passes: the
+    # commit takes longer than the pace, so the serving worker is
+    # saturated with write work during both QPS windows
+    bg_pace_ms, bg_rows = 8, 128
+    rng = np.random.default_rng(11)
+    qvecs = [
+        [float(x) for x in rng.standard_normal(dim)] for _ in range(64)
+    ]
+
+    root = tempfile.mkdtemp(prefix="pathway-bench-readtier-")
+    prog = os.path.join(root, "worker.py")
+    with open(prog, "w") as fh:
+        fh.write(_READ_TIER_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PATHWAY_EXCHANGE_SECRET", "bench-read-tier")
+    # the QPS passes measure serving capacity, not cache hits: every
+    # request carries a distinct vector and caching stays off in every
+    # process until the dedicated cache phase below
+    env["PATHWAY_TPU_RESULT_CACHE"] = "0"
+    old_cache_flag = os.environ.get("PATHWAY_TPU_RESULT_CACHE")
+    os.environ["PATHWAY_TPU_RESULT_CACHE"] = "0"
+
+    wport, sport, fport, r1port, r2port, cport = _free_ports(6)
+    worker = None
+    replicas: list = []
+    front = None
+    cache_server = None
+    try:
+        worker = subprocess.Popen(
+            [sys.executable, prog, str(wport), str(sport)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env=env,
+        )
+
+        def send(cmd: str) -> None:
+            worker.stdin.write(cmd + "\n")
+            worker.stdin.flush()
+
+        _proc_expect(worker, "READY ", 120.0)
+        # (a) ingest baseline: paced publish loop (a live source has its
+        # own arrival cadence — the gate asks whether snapshot streaming
+        # stalls it), zero subscribers
+        send(f"bench_ingest {n_commits} {pace_ms} {rows_per_commit}")
+        base = _proc_expect(worker, "INGEST ", 300.0)
+        # (b1) direct query capacity while the same process ingests at
+        # full tilt — the single-worker baseline pays the ingest tax
+        # inside the serving process
+        send(f"ingest_on {bg_pace_ms} {bg_rows}")
+        _proc_expect(worker, "OK", 30.0)
+        _qps_run(wport, 0.2, n_clients, qvecs, k)  # warm sockets/pool
+        single_qps, single_counts = _qps_run(
+            wport, secs, n_clients, qvecs, k
+        )
+        send("ingest_off")
+        _proc_expect(worker, "OK", 30.0)
+        # attach two replica processes to the snapshot stream
+        for rid, rport in enumerate((r1port, r2port)):
+            replicas.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "pathway_tpu.cli", "replica",
+                        "--port", str(rport), "--replica-id", str(rid),
+                        "--sources", f"127.0.0.1:{sport}",
+                    ],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    env=env,
+                )
+            )
+        for rport in (r1port, r2port):
+            _wait_health(rport, 60.0, need_commit=True)
+        # (a2) the same paced publish loop, now with 2 stream subscribers
+        send(f"bench_ingest {n_commits} {pace_ms} {rows_per_commit}")
+        withr = _proc_expect(worker, "INGEST ", 300.0)
+        if withr.get("subscribers") != 2:
+            raise RuntimeError(
+                f"expected 2 stream subscribers, saw {withr!r}"
+            )
+        # (b2) federated capacity: the front (own process, like the
+        # replicas — the client threads must not share its interpreter)
+        # routes to the replica pool; the worker keeps ingesting but
+        # serves no queries
+        front = subprocess.Popen(
+            [
+                sys.executable, "-m", "pathway_tpu.cli", "federation",
+                "--port", str(fport), "--workers", str(wport),
+                "--replicas", f"127.0.0.1:{r1port},127.0.0.1:{r2port}",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=env,
+        )
+        _wait_health(fport, 30.0, need_commit=False)
+        send(f"ingest_on {bg_pace_ms} {bg_rows}")
+        _proc_expect(worker, "OK", 30.0)
+        _qps_run(fport, 0.2, n_clients, qvecs, k)
+        fed_qps, fed_counts = _qps_run(fport, secs, n_clients, qvecs, k)
+        send("ingest_off")
+        _proc_expect(worker, "OK", 30.0)
+        send("quit")
+        # (c) result cache: hot query against an in-process server,
+        # cache on (hits skip batcher+search) vs off (full path)
+        from pathway_tpu.engine.external_index import (
+            ExternalIndexNode,
+            HostKnnIndex,
+        )
+        from pathway_tpu.serving.server import QueryServer
+        from pathway_tpu.serving.snapshot import SnapshotStore
+
+        cache_dim, cache_rows = 64, 4096
+        sc = Scope()
+        index_in = sc.input_session(1)
+        query_in = sc.input_session(1)
+        ExternalIndexNode(
+            sc, index_in, query_in,
+            HostKnnIndex(dim=cache_dim, capacity=cache_rows),
+            index_col=0, query_col=0, k=k,
+        )
+        sched = Scheduler(sc)
+        for i in range(cache_rows):
+            index_in.insert(
+                ref_scalar(i),
+                (tuple(float(x) for x in rng.standard_normal(cache_dim)),),
+            )
+        cache_store = SnapshotStore()
+        cache_store.publish([sc], sched.commit())
+        cache_server = QueryServer(store=cache_store, port=cport).start()
+        hot_vec = [float(x) for x in rng.standard_normal(cache_dim)]
+
+        def hot_p99(flag: str) -> float:
+            import urllib.request
+
+            os.environ["PATHWAY_TPU_RESULT_CACHE"] = flag
+            body = json.dumps({"vector": hot_vec, "k": k}).encode()
+            lats: list[float] = []
+            for i in range(cache_reqs + 10):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{cport}/serving/query",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    resp.read()
+                if i >= 10:  # warm-up excluded
+                    lats.append(time.perf_counter() - t0)
+            lats.sort()
+            return 1000.0 * lats[int(0.99 * (len(lats) - 1))]
+
+        uncached_p99 = hot_p99("0")
+        cached_p99 = hot_p99("1")
+        base_s, with_s = float(base["s"]), float(withr["s"])
+        return {
+            "ingest_base_rows_per_sec": round(base["rows"] / base_s, 1),
+            "ingest_with_replicas_rows_per_sec": round(
+                withr["rows"] / with_s, 1
+            ),
+            "ingest_overhead_pct": round(
+                100.0 * (with_s - base_s) / base_s, 2
+            ),
+            "single_worker_qps": round(single_qps, 1),
+            "single_worker_counts": single_counts,
+            "federated_qps": round(fed_qps, 1),
+            "federated_counts": fed_counts,
+            "qps_scaling": (
+                round(fed_qps / single_qps, 2) if single_qps else None
+            ),
+            # the federated path spreads query work over 3 extra
+            # processes (front + 2 replicas): its scaling headroom is
+            # core-count-bound, so record what this host had to offer
+            "cpu_cores": os.cpu_count(),
+            "uncached_hot_p99_ms": round(uncached_p99, 3),
+            "cached_hot_p99_ms": round(cached_p99, 3),
+            "cache_hot_speedup": (
+                round(uncached_p99 / cached_p99, 2) if cached_p99 else None
+            ),
+            "replicas": 2,
+            "clients": n_clients,
+        }
+    finally:
+        if cache_server is not None:
+            cache_server.stop()
+        if front is not None:
+            front.terminate()
+        for proc in replicas:
+            proc.terminate()
+        if worker is not None:
+            worker.terminate()
+        procs = replicas + [p for p in (front, worker) if p is not None]
+        for proc in procs:
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if old_cache_flag is None:
+            os.environ.pop("PATHWAY_TPU_RESULT_CACHE", None)
+        else:
+            os.environ["PATHWAY_TPU_RESULT_CACHE"] = old_cache_flag
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_all(emit=None) -> dict:
     """One pass over every workload -> {name: rows_per_sec}; consumed by
     bench.py so the dataflow line is tracked in BENCH_r{N}.json every
@@ -1846,10 +2295,15 @@ def run_all(emit=None) -> dict:
             # follower kill + recovery, leader kill + election failover,
             # and a live 3->2 rescale; each reports its detection /
             # election / state-transfer wall times
+            # ...and the read tier: snapshot-streamed replicas + the
+            # federation front + the commit-stamped result cache, with
+            # its ingest-overhead / capacity-scaling / cache-speedup
+            # measurements
             for leg_name, make_leg in (
                 ("mesh_recovery", mesh_recovery_leg),
                 ("leader_failover", leader_failover_leg),
                 ("rescale", rescale_leg),
+                ("read_tier", read_tier_leg),
             ):
                 try:
                     leg = make_leg()

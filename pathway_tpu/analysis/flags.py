@@ -76,6 +76,24 @@ REGISTRY: dict[str, FlagSpec] = {
             "optimize",
             "0/1 — graph rewriter escape hatch, re-read per run() start",
         ),
+        _spec(
+            "PATHWAY_TPU_RESULT_CACHE",
+            LIVE,
+            "serving.result_cache",
+            "0/1 — serving result cache, re-read per lookup and insert",
+        ),
+        _spec(
+            "PATHWAY_TPU_RESULT_CACHE_BYTES",
+            LIVE,
+            "serving.result_cache",
+            "result-cache byte budget (64 MiB), re-read per insert",
+        ),
+        _spec(
+            "PATHWAY_TPU_REPLICA_MAX_STALENESS_S",
+            LIVE,
+            "serving.replica",
+            "replica staleness bound in seconds (5), re-read per query",
+        ),
         # -- startup-scoped configuration -------------------------------
         _spec(
             "PATHWAY_TPU_VERIFY_ELISION",
@@ -142,6 +160,42 @@ REGISTRY: dict[str, FlagSpec] = {
             STARTUP,
             "serving.server",
             "KNN micro-batch window",
+        ),
+        _spec(
+            "PATHWAY_TPU_SERVING_PORT_BASE",
+            STARTUP,
+            "serving.server",
+            "query-server port base (21000 + process id)",
+        ),
+        _spec(
+            "PATHWAY_TPU_SERVING_STREAM_PORT_BASE",
+            STARTUP,
+            "serving.stream",
+            "snapshot-stream port base (22000 + process id)",
+        ),
+        _spec(
+            "PATHWAY_TPU_SERVING_FEDERATION",
+            STARTUP,
+            "serving.federation",
+            "1 — leader-side federation front over the whole mesh",
+        ),
+        _spec(
+            "PATHWAY_TPU_FEDERATION_PORT",
+            STARTUP,
+            "serving.federation",
+            "federation front port (23000)",
+        ),
+        _spec(
+            "PATHWAY_TPU_REPLICAS",
+            STARTUP,
+            "serving.federation",
+            "replica pool: a count (port scheme) or host:port list",
+        ),
+        _spec(
+            "PATHWAY_TPU_REPLICA_PORT_BASE",
+            STARTUP,
+            "serving.replica",
+            "replica query port base (24000 + replica id)",
         ),
         _spec(
             "PATHWAY_TPU_LOCKWATCH",

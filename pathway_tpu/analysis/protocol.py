@@ -46,7 +46,10 @@ _SNAPSHOT_RECV = "snapshot"
 _DRAIN_CALLS = {"drain_until", "drain"}
 
 _SEND_CALLS = {"send", "_send", "broadcast"}
-_FENCED_KINDS = {"recover", "rollback", "elect"}
+#: frame kinds whose dispatch sites must consult an epoch fence: mesh
+#: control commands plus the read tier's snapshot-stream data/rollback
+#: frames (a zombie publisher's snapshots must never be restored)
+_FENCED_KINDS = {"recover", "rollback", "elect", "snap", "snap-rollback"}
 
 
 def _dotted(node: ast.AST) -> str | None:

@@ -432,6 +432,12 @@ def _stats_once(
     srv_stale: dict[str, float] = {}
     srv_seq: dict[str, float] = {}
     srv_uptime: dict[str, float] = {}
+    # read tier: result cache, replica lag, federation fan-out
+    cache_events: dict[tuple[str, str], float] = {}
+    replica_lag: dict[tuple[str, str], float] = {}
+    fed_reqs: dict[str, float] = {}
+    fed_fanout_sum: dict[str, float] = {}
+    fed_fanout_count: dict[str, float] = {}
     # continuous sampling profiler: per-worker sample counts / adaptive
     # rate / per-tick cost histogram (internals/profiling.py)
     prof_samples: dict[str, float] = {}
@@ -494,6 +500,18 @@ def _stats_once(
                 srv_seq[w] = value
             elif fam_name == "pathway_serving_uptime_seconds":
                 srv_uptime[w] = value
+            elif fam_name == "pathway_serving_cache_events_total":
+                key = (w, labels.get("kind", "?"))
+                cache_events[key] = cache_events.get(key, 0.0) + value
+            elif fam_name == "pathway_serving_replica_lag_seconds":
+                replica_lag[(w, labels.get("replica", "?"))] = value
+            elif fam_name == "pathway_serving_federation_requests_total":
+                fed_reqs[w] = fed_reqs.get(w, 0.0) + value
+            elif fam_name == "pathway_serving_federation_fanout":
+                if name.endswith("_sum"):
+                    fed_fanout_sum[w] = value
+                elif name.endswith("_count"):
+                    fed_fanout_count[w] = value
             elif fam_name == "pathway_profile_samples_total":
                 prof_samples[w] = prof_samples.get(w, 0.0) + value
             elif fam_name == "pathway_profile_rate_hz":
@@ -595,6 +613,41 @@ def _stats_once(
                 f"  shed={srv_shed.get(w, 0.0):.0f}"
                 f"  snapshot_seq={srv_seq.get(w, 0.0):.0f}"
                 + (f"  staleness_s={stale:.3f}" if stale is not None else "")
+            )
+
+    # -- read tier: result cache / replicas / federation ---------------------
+    if cache_events or replica_lag or fed_reqs:
+        print()
+        print("read tier:")
+        for w in sorted(
+            {w for (w, _k) in cache_events}, key=lambda k: (k != "", k)
+        ):
+            hits = cache_events.get((w, "hit"), 0.0)
+            misses = cache_events.get((w, "miss"), 0.0)
+            total = hits + misses
+            rate = f"{hits / total * 100.0:.1f}%" if total else "-"
+            print(
+                f"  {(w or '(local)'):<10}"
+                f"  cache hit_rate={rate}"
+                f"  hits={hits:.0f}  misses={misses:.0f}"
+                f"  evict={cache_events.get((w, 'evict'), 0.0):.0f}"
+                f"  invalidate="
+                f"{cache_events.get((w, 'invalidate'), 0.0):.0f}"
+            )
+        for (w, rid) in sorted(replica_lag):
+            print(
+                f"  {(w or '(local)'):<10}"
+                f"  replica {rid}  lag_s={replica_lag[(w, rid)]:.3f}"
+            )
+        for w in sorted(fed_reqs, key=lambda k: (k != "", k)):
+            count = fed_fanout_count.get(w, 0.0)
+            mean = (
+                f"{fed_fanout_sum.get(w, 0.0) / count:.1f}" if count else "-"
+            )
+            print(
+                f"  {(w or '(local)'):<10}"
+                f"  federation reqs={fed_reqs[w]:.0f}"
+                f"  fan_out_mean={mean}"
             )
 
     # -- sampling profiler ---------------------------------------------------
@@ -1013,6 +1066,39 @@ def main(argv: Sequence[str] | None = None) -> int:
     )
     p_rescale.add_argument("target_processes", type=int)
 
+    p_replica = sub.add_parser(
+        "replica",
+        help="run a read-only serving replica subscribed to a mesh's "
+        "snapshot streams (scales query capacity without widening "
+        "ingest)",
+    )
+    p_replica.add_argument("--port", type=int, default=None)
+    p_replica.add_argument("--replica-id", type=int, default=0)
+    p_replica.add_argument(
+        "--sources", default=None,
+        help="host:port list of worker stream endpoints (default: "
+        "derive from --width and the 22000+pid port scheme)",
+    )
+    p_replica.add_argument("--width", type=int, default=None)
+    p_replica.add_argument("--host", default="127.0.0.1")
+    p_replica.add_argument("--max-staleness-s", type=float, default=None)
+
+    p_fed = sub.add_parser(
+        "federation",
+        help="run a federation front: one read endpoint scattering to "
+        "worker query servers and round-robining replica pools",
+    )
+    p_fed.add_argument("--port", type=int, default=None)
+    p_fed.add_argument(
+        "--workers", default=None,
+        help="comma list of worker query ports (default: derive from "
+        "PATHWAY_PROCESSES and the 21000+pid port scheme)",
+    )
+    p_fed.add_argument(
+        "--replicas", default=None,
+        help="replica count or host:port list (default: none)",
+    )
+
     p_stats = sub.add_parser(
         "stats",
         help="scrape a /metrics endpoint and pretty-print the "
@@ -1099,6 +1185,32 @@ def main(argv: Sequence[str] | None = None) -> int:
         return rescale(
             args.target_processes, supervisor_dir=args.supervisor_dir
         )
+    if args.command == "replica":
+        from pathway_tpu.serving import replica as _replica
+
+        replica_args = []
+        if args.port is not None:
+            replica_args += ["--port", str(args.port)]
+        replica_args += ["--replica-id", str(args.replica_id)]
+        if args.sources:
+            replica_args += ["--sources", args.sources]
+        if args.width is not None:
+            replica_args += ["--width", str(args.width)]
+        replica_args += ["--host", args.host]
+        if args.max_staleness_s is not None:
+            replica_args += ["--max-staleness-s", str(args.max_staleness_s)]
+        return _replica.main(replica_args)
+    if args.command == "federation":
+        from pathway_tpu.serving import federation as _federation
+
+        fed_args = []
+        if args.port is not None:
+            fed_args += ["--port", str(args.port)]
+        if args.workers:
+            fed_args += ["--workers", args.workers]
+        if args.replicas:
+            fed_args += ["--replicas", args.replicas]
+        return _federation.main(fed_args)
     if args.command == "stats":
         return stats(
             args.target,

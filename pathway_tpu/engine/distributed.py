@@ -266,6 +266,88 @@ class EpochFence:
         return self._last.get(kind, -1)
 
 
+# -- snapshot-stream wire protocol (read tier) -----------------------------
+#
+# The serving read tier (pathway_tpu/serving/stream.py + replica.py)
+# ships commit-stamped ReadSnapshot payloads from each worker to read-
+# only replica processes over the SAME wire format as exchange frames:
+# length prefix, HMAC-SHA256 over (length || payload), pickled body.
+# Frame kinds (all fixed 4-tuples, epoch-stamped for fencing):
+#
+# - ``("snap-sub",      epoch, from_seq,  replica_id)`` replica -> worker
+# - ``("snap-hello",    epoch, width,     process_id)`` worker  -> replica
+# - ``("snap",          epoch, seq,       payload)``    worker  -> replica
+# - ``("snap-rollback", epoch, to_time,   process_id)`` worker  -> replica
+# - ``("snap-stats",    epoch, replica_id, snapshot)``  replica -> worker
+#
+# Replicas run an :class:`EpochFence` over the stream: ``snap`` frames
+# from an epoch below the fence floor are a zombie publisher's and are
+# dropped; ``snap-rollback`` is a control command admitted exactly once
+# per epoch (re-running a truncate is harmless, but the fence keeps the
+# duplicate/zombie semantics identical to the mesh control plane).
+
+#: snapshot-stream frame kinds (subset of the mesh frame namespace)
+SNAP_STREAM_KINDS = (
+    "snap-sub",
+    "snap-hello",
+    "snap",
+    "snap-rollback",
+    "snap-stats",
+)
+
+
+def send_stream_frame(
+    sock: socket.socket, frame: Any, secret: bytes | None = None
+) -> None:
+    """Authenticated frame write for the snapshot stream (same wire
+    format as :meth:`MeshTransport._send`, usable without a mesh)."""
+    if secret is None:
+        secret = _mesh_secret()
+    payload = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
+    len_bytes = _LEN.pack(len(payload))
+    mac = hmac.new(secret, len_bytes + payload, hashlib.sha256).digest()
+    sock.sendall(len_bytes + mac + payload)
+
+
+def recv_stream_frame(
+    sock: socket.socket, secret: bytes | None = None
+) -> Any:
+    """Authenticated frame read for the snapshot stream.  Verifies the
+    HMAC BEFORE deserializing — a forged frame must never reach
+    ``pickle.loads`` (same contract as :meth:`MeshTransport._read_frame`)."""
+    if secret is None:
+        secret = _mesh_secret()
+
+    def read_exact(n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("stream peer closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    len_bytes = read_exact(_LEN.size)
+    (length,) = _LEN.unpack(len_bytes)
+    if length > _MAX_FRAME:
+        raise ConnectionError(
+            f"snapshot-stream frame of {length} bytes exceeds "
+            f"PATHWAY_EXCHANGE_MAX_FRAME={_MAX_FRAME}"
+        )
+    mac = read_exact(_MAC_LEN)
+    payload = read_exact(length)
+    expected = hmac.new(
+        secret, len_bytes + payload, hashlib.sha256
+    ).digest()
+    if not hmac.compare_digest(mac, expected):
+        raise ConnectionError(
+            "snapshot-stream frame failed HMAC authentication "
+            "(PATHWAY_EXCHANGE_SECRET mismatch or foreign traffic)"
+        )
+    return pickle.loads(payload)
+
+
 class PeerLostError(RuntimeError):
     """A peer's socket died, its frames timed out, or it announced an
     abort mid-round.  Recoverable when a MeshSupervisor + operator
@@ -1836,6 +1918,13 @@ class DistributedScheduler:
         _profiling.PROFILER.epoch = max(
             _profiling.PROFILER.epoch, int(epoch)
         )
+        from pathway_tpu import serving as _serving
+
+        if _serving.enabled():
+            # the snapshot stream rises in lockstep too: replicas fence
+            # out any ``snap`` frame a zombie publisher stamped before
+            # this barrier (PWC504 semantics on the read tier)
+            _serving.set_stream_epoch(int(epoch))
         peers = sorted(self._outbox)
         for peer in peers:
             self.transport.send(peer, ("sync", epoch))
@@ -1875,8 +1964,13 @@ class DistributedScheduler:
             # Readers must never observe commits the mesh rolled back
             # past; publish() self-heals at the next commit, but the
             # window between rollback and re-commit would otherwise
-            # serve retracted state.
+            # serve retracted state.  truncate() also invalidates the
+            # commit-stamped result cache above ``to_time`` (commit
+            # times are re-used with different content after recovery)
+            # and stream_truncate() fans the same command out to every
+            # subscribed replica as an epoch-fenced ``snap-rollback``.
             _serving.STORE.truncate(to_time)
+            _serving.stream_truncate(to_time)
 
     # -- monitoring surface parity ----------------------------------------
 

@@ -246,6 +246,21 @@ class StatsMonitor:
             if width is not None and peer >= width:
                 continue
             snaps[str(peer)] = self.mesh_snapshots[peer]
+        # read-tier replicas piggyback their registries over the
+        # snapshot stream; they render under worker="r<id>" (a namespace
+        # integer peer ids can never collide with) and disappear from
+        # the exposition the moment they disconnect
+        try:
+            from pathway_tpu import serving as _serving
+
+            stream = _serving.stream_server()
+        except Exception:
+            stream = None
+        if stream is not None:
+            for rid, rsnap in sorted(
+                stream.replica_metrics_snapshot().items()
+            ):
+                snaps[f"r{rid}"] = rsnap
         return _metrics.render_snapshots(snaps)
 
 

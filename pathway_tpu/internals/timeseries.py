@@ -615,6 +615,21 @@ class TelemetryLoop:
                 continue  # dead-incarnation filter, as prometheus_text
             if isinstance(peer_snap, dict):
                 self.store.ingest_snapshot(peer_snap, str(peer), t=now)
+        # read-tier replicas ride the snapshot stream under "r<id>"
+        # labels; SnapshotStreamServer._drop_subscriber prunes their
+        # ring series on disconnect (string ids bypass the width filter)
+        try:
+            from pathway_tpu import serving as _serving
+
+            stream = _serving.stream_server()
+        except Exception:
+            stream = None
+        if stream is not None:
+            for rid, rsnap in sorted(
+                stream.replica_metrics_snapshot().items()
+            ):
+                if isinstance(rsnap, dict):
+                    self.store.ingest_snapshot(rsnap, f"r{rid}", t=now)
         self.sentinel.evaluate(self.store, now=now)
 
     def _run(self) -> None:

@@ -9,6 +9,19 @@ the exactly-once seam — and ``pw.run`` starts a
 :class:`~pathway_tpu.serving.server.QueryServer` on
 ``21000 + PATHWAY_PROCESS_ID``.
 
+The read tier scales past the worker processes themselves:
+
+- each worker also streams its published snapshots to read-only
+  **replicas** (:mod:`pathway_tpu.serving.stream` publisher on
+  ``22000 + pid``, :mod:`pathway_tpu.serving.replica` consumers on
+  ``24000 + replica_id``), so query capacity grows without widening
+  ingest;
+- the leader can front the whole mesh with one **federation** endpoint
+  (:mod:`pathway_tpu.serving.federation` on ``23000``) that scatters,
+  merges, and round-robins across replicas;
+- all of them answer through the commit-stamped
+  :mod:`result cache <pathway_tpu.serving.result_cache>`.
+
 Env knobs:
 
 - ``PATHWAY_TPU_SERVING`` — enable the plane (default off)
@@ -18,6 +31,16 @@ Env knobs:
 - ``PATHWAY_TPU_SERVING_THREADS`` — worker pool size (default 8)
 - ``PATHWAY_TPU_SERVING_BATCH_WINDOW_MS`` — KNN micro-batch packing
   window (default 2 ms)
+- ``PATHWAY_TPU_SERVING_STREAM_PORT_BASE`` — snapshot-stream base
+  (default 22000)
+- ``PATHWAY_TPU_SERVING_FEDERATION`` / ``PATHWAY_TPU_FEDERATION_PORT``
+  — leader federation front (default off / 23000)
+- ``PATHWAY_TPU_REPLICAS`` / ``PATHWAY_TPU_REPLICA_PORT_BASE`` /
+  ``PATHWAY_TPU_REPLICA_MAX_STALENESS_S`` — replica pool for the front
+  (count or host:port list), replica port base (24000), staleness
+  bound (5 s, live)
+- ``PATHWAY_TPU_RESULT_CACHE`` / ``PATHWAY_TPU_RESULT_CACHE_BYTES`` —
+  result cache toggle (on) and byte budget (64 MiB), both live
 """
 
 from __future__ import annotations
@@ -26,21 +49,33 @@ import os
 import threading
 from typing import Any
 
-from pathway_tpu.serving.snapshot import STORE, ReadSnapshot, SnapshotStore
+from pathway_tpu.serving.snapshot import (
+    STORE,
+    ReadSnapshot,
+    SnapshotStore,
+    StaleReadError,
+)
 
 __all__ = [
     "STORE",
     "ReadSnapshot",
     "SnapshotStore",
+    "StaleReadError",
     "enabled",
     "publish_on_commit",
     "start_server",
     "stop_server",
     "query_server",
+    "stream_server",
+    "federation_front",
+    "set_stream_epoch",
+    "stream_truncate",
 ]
 
 _lock = threading.Lock()
 _server: Any = None
+_stream: Any = None
+_front: Any = None
 
 
 def enabled() -> bool:
@@ -53,34 +88,67 @@ def enabled() -> bool:
 
 def publish_on_commit(scopes: list, time: int) -> None:
     """Runner-side publication hook (call only when :func:`enabled`,
-    after the device pipeline drained through ``time``)."""
-    STORE.publish(scopes, time)
+    after the device pipeline drained through ``time``).  Also fans the
+    fresh snapshot out to any subscribed replicas — a pin + enqueue per
+    subscriber, serialization happens on their sender threads."""
+    snap = STORE.publish(scopes, time)
+    stream = _stream
+    if stream is not None:
+        stream.publish(snap)
 
 
 def start_server() -> Any:
-    """Start (or return) this process's query server.  A bind failure is
-    recorded and swallowed: serving is an accessory plane and must never
-    take the dataflow down."""
-    global _server
+    """Start (or return) this process's query server, the snapshot
+    stream publisher for replicas, and — on the leader, when
+    ``PATHWAY_TPU_SERVING_FEDERATION=1`` — the federation front.  A bind
+    failure is recorded and swallowed: serving is an accessory plane and
+    must never take the dataflow down."""
+    global _server, _stream, _front
     with _lock:
         if _server is not None:
             return _server
+        from pathway_tpu.internals.metrics import FLIGHT
+
         try:
             from pathway_tpu.serving.server import QueryServer
 
             _server = QueryServer().start()
         except OSError as exc:
-            from pathway_tpu.internals.metrics import FLIGHT
-
             FLIGHT.record("serving_bind_failed", error=repr(exc))
             _server = None
+        if _server is not None and _stream is None:
+            try:
+                from pathway_tpu.serving.stream import SnapshotStreamServer
+
+                _stream = SnapshotStreamServer().start()
+            except OSError as exc:
+                FLIGHT.record("snapstream_bind_failed", error=repr(exc))
+                _stream = None
+        process_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        if _server is not None and _front is None and process_id == 0:
+            from pathway_tpu.serving import federation as _federation
+
+            if _federation.enabled():
+                try:
+                    _front = _federation.FederationFront().start()
+                except OSError as exc:
+                    FLIGHT.record(
+                        "federation_bind_failed", error=repr(exc)
+                    )
+                    _front = None
         return _server
 
 
 def stop_server() -> None:
-    global _server
+    global _server, _stream, _front
     with _lock:
         srv, _server = _server, None
+        stream, _stream = _stream, None
+        front, _front = _front, None
+    if front is not None:
+        front.stop()
+    if stream is not None:
+        stream.stop()
     if srv is not None:
         srv.stop()
 
@@ -90,3 +158,30 @@ def query_server() -> Any:
     package attribute ``serving.server`` — the submodule — which Python
     binds on first import.)"""
     return _server
+
+
+def stream_server() -> Any:
+    """The live :class:`SnapshotStreamServer` or None."""
+    return _stream
+
+
+def federation_front() -> Any:
+    """The live :class:`FederationFront` or None (leader only)."""
+    return _front
+
+
+def set_stream_epoch(epoch: int) -> None:
+    """Mesh resync hook: raise the snapshot stream's epoch floor so
+    frames from a pre-resync publisher are fenced at the replicas."""
+    stream = _stream
+    if stream is not None:
+        stream.set_epoch(epoch)
+
+
+def stream_truncate(to_time: int) -> None:
+    """Mesh rollback hook: fan the truncation out to replicas as an
+    epoch-fenced ``snap-rollback`` command (the local store's own
+    truncation — and the result cache's — ride the truncate hooks)."""
+    stream = _stream
+    if stream is not None:
+        stream.on_truncate(to_time)

@@ -44,7 +44,9 @@ import numpy as np
 
 from pathway_tpu.internals import metrics as _metrics
 from pathway_tpu.internals import tracing as _tracing
+from pathway_tpu.serving import result_cache as _result_cache
 from pathway_tpu.serving import snapshot as _snapshot
+from pathway_tpu.serving.snapshot import StaleReadError
 
 __all__ = ["QueryServer", "BASE_PORT", "serving_port"]
 
@@ -80,6 +82,11 @@ _BATCHED = _metrics.REGISTRY.histogram(
 _EMPTY = _metrics.REGISTRY.counter(
     "pathway_serving_no_snapshot_total",
     "admitted queries answered 200-with-empty because no snapshot exists yet",
+)
+_STALE = _metrics.REGISTRY.counter(
+    "pathway_serving_stale_503_total",
+    "admitted requests answered 503 because the store's freshest "
+    "consistent view exceeded its staleness bound",
 )
 
 _started_wall: list[float] = []  # first QueryServer.start() in this process
@@ -200,8 +207,11 @@ class _MicroBatcher:
 
     def _dispatch(self, pending: list[dict]) -> None:
         t0 = _time.perf_counter()
-        snap = self.store.acquire_latest()
+        snap = None
         try:
+            # inside the try: a raising store (a replica past its
+            # staleness bound) must fail the waiters, not this thread
+            snap = self.store.acquire_latest()
             n = sum(len(i["vecs"]) for i in pending)
             if snap is None:
                 for item in pending:
@@ -220,6 +230,10 @@ class _MicroBatcher:
                 "seq": snap.seq,
                 "commit_time": snap.commit_time,
                 "staleness_s": round(snap.staleness_s(), 6),
+                # stripped by the handler before serialization: the
+                # result cache only inserts when the snapshot actually
+                # answered matches the stamp it keyed the lookup on
+                "cache_stamp": snap.cache_stamp(),
             }
             self.dispatches += 1
             _BATCHED.observe_n(float(n), 1)
@@ -259,13 +273,31 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- helpers -------------------------------------------------------------
 
-    def _json(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+    def _json(
+        self, code: int, payload: dict, headers: dict | None = None
+    ) -> None:
+        self._raw_json(code, json.dumps(payload).encode(), headers)
+
+    def _raw_json(
+        self, code: int, body: bytes, headers: dict | None = None
+    ) -> None:
+        """Send pre-serialized JSON bytes — the result-cache hit path
+        writes the cached body verbatim, skipping re-serialization."""
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
+
+    def _stale(self, exc: StaleReadError) -> None:
+        _STALE.inc()
+        self._json(
+            503,
+            {"error": str(exc), "stale": True},
+            headers={"Retry-After": "1"},
+        )
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -299,12 +331,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._query(t0)
             elif self.path.startswith("/serving/lookup"):
                 _REQS["lookup"].inc()
-                self._lookup()
+                self._lookup(t0)
             else:
                 _REQS["other"].inc()
                 self._json(404, {"error": f"unknown path {self.path}"})
         except (BrokenPipeError, ConnectionResetError):
             pass
+        except StaleReadError as exc:
+            # a replica past its staleness bound: refuse loudly rather
+            # than answer wrong — 503 + Retry-After, never a 5xx crash
+            try:
+                self._stale(exc)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
         except (ValueError, KeyError, TypeError) as exc:
             # malformed request — a client error, not a serving failure
             try:
@@ -323,6 +362,20 @@ class _Handler(BaseHTTPRequestHandler):
         if vecs.ndim != 2:
             raise ValueError("vector(s) must be rank-1 / rank-2")
         k = int(req.get("k", 10))
+        key = self._cache_key(
+            "query",
+            vecs.tobytes() + b"|" + repr((vecs.shape, k)).encode(),
+        )
+        if key is not None:
+            cached = _result_cache.CACHE.get(key)
+            if cached is not None:
+                # hot path: cached answers never touch the batcher or
+                # pin a snapshot — serialized bytes straight back out
+                self._raw_json(200, cached, {"X-Pathway-Cache": "hit"})
+                _result_cache.CACHE.observe_hit_latency(
+                    _time.perf_counter() - t0
+                )
+                return
         hits, meta = self.server.batcher.submit(vecs, k)
         if hits is None:
             # admitted before the first commit: answer empty-but-valid
@@ -333,47 +386,97 @@ class _Handler(BaseHTTPRequestHandler):
                 {"hits": [[] for _ in range(len(vecs))], "snapshot": None},
             )
             return
-        self._json(
-            200,
+        answered = meta.pop("cache_stamp", None)
+        body = json.dumps(
             {
                 "hits": [
-                    [[repr(key), score] for key, score in row]
+                    [[repr(key_), score] for key_, score in row]
                     for row in hits
                 ],
                 "snapshot": meta,
-            },
+            }
+        ).encode()
+        self._maybe_insert(key, answered, body)
+        self._raw_json(200, body)
+
+    def _cache_key(self, endpoint: str, material: bytes):
+        """Commit-stamped cache key, or None when caching is off or no
+        snapshot exists yet.  The stamp embeds commit time, seq, and
+        the rewrite fingerprint — invalidation by publication."""
+        if not _result_cache.enabled():
+            return None
+        stamp = self.server.store.stamp()
+        if stamp is None:
+            return None
+        # the port disambiguates servers sharing one process-wide cache
+        # (in-process meshes/tests run several stores side by side)
+        return (
+            endpoint,
+            stamp,
+            _result_cache.query_digest(endpoint, material),
+            self.server.server_port,
         )
 
-    def _lookup(self) -> None:
+    def _maybe_insert(self, key, answered_stamp, body: bytes) -> None:
+        """Insert only when the snapshot that actually answered is the
+        one the key was stamped with — a publication racing between the
+        stamp peek and the dispatch must not be cached under the old
+        stamp (its recompute would differ bit-for-bit)."""
+        if key is None or answered_stamp is None:
+            return
+        if answered_stamp != key[1]:
+            return
+        _result_cache.CACHE.put(
+            key, body, len(body), commit_time=answered_stamp[0]
+        )
+
+    def _lookup(self, t0: float | None = None) -> None:
+        if t0 is None:
+            t0 = _time.perf_counter()
         req = self._body()
         keys = [str(key) for key in req.get("keys", [])]
         node = req.get("node")
+        key = self._cache_key(
+            "lookup",
+            json.dumps({"keys": keys, "node": node}, sort_keys=True).encode(),
+        )
+        if key is not None:
+            cached = _result_cache.CACHE.get(key)
+            if cached is not None:
+                self._raw_json(200, cached, {"X-Pathway-Cache": "hit"})
+                _result_cache.CACHE.observe_hit_latency(
+                    _time.perf_counter() - t0
+                )
+                return
         snap = self.server.store.acquire_latest()
         if snap is None:
             _EMPTY.inc()
             self._json(200, {"rows": {}, "snapshot": None})
             return
         try:
-            t0 = _time.perf_counter()
-            table = {repr(key): row for key, row in snap.table(node).items()}
+            t1 = _time.perf_counter()
+            table = {repr(key_): row for key_, row in snap.table(node).items()}
             rows = (
-                {key: table.get(key) for key in keys} if keys else table
+                {key_: table.get(key_) for key_ in keys} if keys else table
             )
             meta = {
                 "seq": snap.seq,
                 "commit_time": snap.commit_time,
                 "staleness_s": round(snap.staleness_s(), 6),
             }
+            answered = snap.cache_stamp()
             _tracing.TRACER.record_query(
                 "table-lookup",
-                t0,
+                t1,
                 _time.perf_counter(),
                 commit_time=snap.commit_time,
                 keys=len(keys),
             )
         finally:
             snap.release()
-        self._json(200, {"rows": rows, "snapshot": meta})
+        body = json.dumps({"rows": rows, "snapshot": meta}).encode()
+        self._maybe_insert(key, answered, body)
+        self._raw_json(200, body)
 
 
 class _BoundedHTTPServer(HTTPServer):
@@ -475,6 +578,8 @@ class _BoundedHTTPServer(HTTPServer):
                 "dispatches": self.batcher.dispatches,
                 "queries": _BATCHED.sum,
             },
+            "stale_503": _STALE.value,
+            "cache": _result_cache.CACHE.stats(),
             "snapshot": self.store.stats(),
         }
 

@@ -45,7 +45,15 @@ from pathway_tpu.engine.graph import GroupbyNode, JoinNode
 from pathway_tpu.engine.persistence import STATE_FORMAT
 from pathway_tpu.internals import metrics as _metrics
 
-__all__ = ["ReadSnapshot", "SnapshotStore", "STORE"]
+__all__ = ["ReadSnapshot", "SnapshotStore", "STORE", "StaleReadError"]
+
+
+class StaleReadError(RuntimeError):
+    """A read-tier store's freshest consistent view is older than the
+    configured staleness bound.  The HTTP layer maps this to ``503`` +
+    ``Retry-After`` — a replica that has fallen too far behind refuses
+    to answer rather than silently serving unboundedly stale rows (the
+    plane's contract is stale-*within-bound*-but-never-wrong)."""
 
 #: how many published snapshots the store pins (readers can pin more)
 DEFAULT_DEPTH = 3
@@ -219,6 +227,13 @@ class ReadSnapshot:
     def staleness_s(self, now: float | None = None) -> float:
         return max(0.0, (now or _time.time()) - self.published_wall)
 
+    def cache_stamp(self) -> tuple:
+        """This snapshot's result-cache identity — the same shape
+        :meth:`SnapshotStore.stamp` peeks, so the handler can detect a
+        publication racing between its stamp peek and the batcher's
+        dispatch (insert only when they agree)."""
+        return (self.commit_time, self.seq, self.fingerprint)
+
     # -- handoff -------------------------------------------------------------
 
     def payload(self) -> dict:
@@ -294,6 +309,25 @@ class SnapshotStore:
         self._ring: list[ReadSnapshot] = []  # guarded-by: self._lock
         self._seq = 0  # guarded-by: self._lock
         self.depth = depth
+        #: called with the truncation time whenever published commits
+        #: are dropped (rollback / republication): the result cache
+        #: invalidates its stamps, the snapshot stream fans the command
+        #: out to replicas.  Registration happens at import/startup.
+        self._truncate_hooks: list = []
+
+    def register_truncate_hook(self, fn) -> None:
+        if fn not in self._truncate_hooks:
+            self._truncate_hooks.append(fn)
+
+    def _fire_truncate_hooks(self, time: int) -> None:
+        # called AFTER self._lock is released: hooks take their own
+        # locks (cache, stream subscriber registry) and must not nest
+        # under the store's
+        for fn in list(self._truncate_hooks):
+            try:
+                fn(int(time))
+            except Exception:  # noqa: BLE001 — an observer must not break publish
+                pass
 
     # -- write side ----------------------------------------------------------
 
@@ -308,13 +342,17 @@ class SnapshotStore:
         fingerprint = tuple(getattr(scopes[0], "_pw_opt_fingerprint", ()))
         views = [_capture_scope(scope) for scope in scopes]
         with self._lock:
-            self._truncate_locked(int(time) - 1)
+            dropped = self._truncate_locked(int(time) - 1)
             self._seq += 1
             snap = ReadSnapshot(time, self._seq, fingerprint, views)
             self._ring.append(snap)
             depth = self.depth or _depth()
             while len(self._ring) > depth:
                 self._ring.pop(0).release()
+        if dropped:
+            # a republication below an existing commit is a rollback in
+            # disguise — cached answers stamped past it must go too
+            self._fire_truncate_hooks(int(time) - 1)
         _PUBLISHED.inc()
         _PUBLISH_S.observe(_time.perf_counter() - t0)
         return snap
@@ -323,15 +361,18 @@ class SnapshotStore:
         """Drop every snapshot with ``commit_time > time`` (recovery
         rolled the scheduler back to ``time``)."""
         with self._lock:
-            self._truncate_locked(time)
+            dropped = self._truncate_locked(time)
+        if dropped:
+            self._fire_truncate_hooks(time)
 
-    def _truncate_locked(self, time: int) -> None:
+    def _truncate_locked(self, time: int) -> int:
         keep, drop = [], []
         for snap in self._ring:
             (drop if snap.commit_time > time else keep).append(snap)
         self._ring = keep
         for snap in drop:
             snap.release()
+        return len(drop)
 
     def clear(self) -> None:
         with self._lock:
@@ -355,6 +396,17 @@ class SnapshotStore:
             for snap in reversed(self._ring):
                 if snap.acquire():
                     return snap
+        return None
+
+    def stamp(self) -> tuple | None:
+        """Identity of the newest live snapshot for result-cache keying:
+        ``(commit_time, seq, fingerprint)``.  Two equal stamps always
+        name the same immutable bytes (the rollback seam, where commit
+        times are re-used, is covered by the truncate hooks)."""
+        with self._lock:
+            for snap in reversed(self._ring):
+                if not snap.closed:
+                    return (snap.commit_time, snap.seq, snap.fingerprint)
         return None
 
     def acquire_at(self, time: int) -> ReadSnapshot | None:
@@ -432,12 +484,14 @@ class SnapshotStore:
             published_wall=payload.get("published"),
         )
         with self._lock:
-            self._truncate_locked(snap.commit_time - 1)
+            dropped = self._truncate_locked(snap.commit_time - 1)
             self._ring.append(snap)
             self._seq = max(self._seq, snap.seq)
             depth = self.depth or _depth()
             while len(self._ring) > depth:
                 self._ring.pop(0).release()
+        if dropped:
+            self._fire_truncate_hooks(snap.commit_time - 1)
         return snap
 
 

@@ -1,0 +1,707 @@
+"""Read tier (ISSUE 19): federation scatter-gather, snapshot replicas,
+and the commit-stamped result cache.
+
+Invariants under test:
+
+- a cache hit is BIT-IDENTICAL to the miss recompute it memoized, and a
+  publication boundary forces a miss (the stamp changes) so the cache
+  can never serve a pre-publication answer afterwards;
+- the cache is LRU-bounded by bytes, refuses oversized inserts, and
+  drops rollback-invalidated stamps via ``invalidate_above``;
+- a replica's served answer is bit-identical to a direct read of the
+  worker's snapshot at the same commit, converges after further
+  publications, follows stream truncations, and refuses with
+  503 + Retry-After past its staleness bound — stale-never-wrong;
+- a federated scatter answer is bit-identical to a client-side fan-out
+  merge (concat in worker port order, stable sort on descending score,
+  truncate to k) and is stamped at the minimum common commit; a partial
+  scatter is NEVER served (503 + Retry-After);
+- chaos: replicas keep answering (only 200/503, staleness bounded)
+  through a publisher failover and a width rescale under paced load,
+  and a disconnected replica's piggybacked metrics are pruned.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine.external_index import ExternalIndexNode, HostKnnIndex
+from pathway_tpu.engine.graph import Scheduler, Scope
+from pathway_tpu.engine.value import ref_scalar
+from pathway_tpu.serving import result_cache as rc
+from pathway_tpu.serving.federation import FederationFront
+from pathway_tpu.serving.replica import Replica, parse_sources
+from pathway_tpu.serving.server import QueryServer
+from pathway_tpu.serving.snapshot import SnapshotStore
+from pathway_tpu.serving.stream import SnapshotStreamServer
+
+
+def _vec(i: int, dim: int = 6) -> np.ndarray:
+    rng = np.random.RandomState(1000 + i)
+    v = rng.rand(dim).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(port: int, path: str, payload: dict, timeout: float = 10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", path, body=json.dumps(payload),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _get(port: int, path: str, timeout: float = 10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+class _Pipeline:
+    """One worker's KNN pipeline + private snapshot store."""
+
+    def __init__(self, keys, dim: int = 6, k: int = 3, depth: int = 4):
+        self.sc = Scope()
+        self.index_in = self.sc.input_session(arity=1)
+        self.query_in = self.sc.input_session(arity=1)
+        ExternalIndexNode(
+            self.sc, self.index_in, self.query_in,
+            HostKnnIndex(dim=dim, capacity=64),
+            index_col=0, query_col=0, k=k,
+        )
+        self.sched = Scheduler(self.sc)
+        self.store = SnapshotStore(depth=depth)
+        self.insert_commit(keys)
+
+    def insert_commit(self, keys) -> int:
+        for i in keys:
+            self.index_in.insert(ref_scalar(i), (tuple(_vec(i).tolist()),))
+        t = self.sched.commit()
+        self.store.publish([self.sc], t)
+        return t
+
+    def publish_to(self, stream: SnapshotStreamServer) -> None:
+        snap = self.store.acquire_latest()
+        if snap is not None:
+            stream.publish(snap)
+            snap.release()
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache():
+    rc.CACHE.clear()
+    yield
+    rc.CACHE.clear()
+
+
+# -- result cache unit behavior ----------------------------------------------
+
+
+class TestResultCache:
+    def test_lru_bounded_by_bytes(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "1")
+        cache = rc.ResultCache(max_bytes=100)
+        for i in range(5):
+            cache.put(("q", i), f"v{i}", 30, commit_time=i)
+        stats = cache.stats()
+        assert stats["bytes"] <= 100
+        assert stats["entries"] == 3
+        # LRU: the two oldest were evicted
+        assert cache.get(("q", 0)) is None
+        assert cache.get(("q", 1)) is None
+        assert cache.get(("q", 4)) == "v4"
+
+    def test_get_refreshes_lru_position(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "1")
+        cache = rc.ResultCache(max_bytes=90)
+        for i in range(3):
+            cache.put(("q", i), f"v{i}", 30, commit_time=i)
+        assert cache.get(("q", 0)) == "v0"  # refresh
+        cache.put(("q", 3), "v3", 30, commit_time=3)
+        assert cache.get(("q", 0)) == "v0"  # survived: 1 was evicted
+        assert cache.get(("q", 1)) is None
+
+    def test_oversized_insert_refused(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "1")
+        cache = rc.ResultCache(max_bytes=100)
+        cache.put(("q", "small"), "v", 10, commit_time=1)
+        cache.put(("q", "huge"), "x" * 200, 200, commit_time=1)
+        assert cache.get(("q", "huge")) is None
+        assert cache.get(("q", "small")) == "v"  # not wiped
+
+    def test_invalidate_above_drops_rolled_back_stamps(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "1")
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        for t in (1, 2, 3, 4):
+            cache.put(("q", t), f"v{t}", 10, commit_time=t)
+        assert cache.invalidate_above(2) == 2
+        assert cache.get(("q", 1)) == "v1"
+        assert cache.get(("q", 2)) == "v2"
+        assert cache.get(("q", 3)) is None
+        assert cache.get(("q", 4)) is None
+
+    def test_disabled_knob_blocks_inserts(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        cache = rc.ResultCache(max_bytes=100)
+        cache.put(("q", 1), "v", 10, commit_time=1)
+        assert cache.stats()["entries"] == 0
+        assert not cache.stats()["enabled"]
+
+    def test_byte_budget_env_knob(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "1")
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE_BYTES", "50")
+        cache = rc.ResultCache()  # live env budget
+        cache.put(("q", 1), "a", 30, commit_time=1)
+        cache.put(("q", 2), "b", 30, commit_time=2)
+        assert cache.stats()["entries"] == 1
+        assert cache.stats()["max_bytes"] == 50
+
+
+# -- cache correctness over the HTTP front ------------------------------------
+
+
+def _sans_staleness(body: bytes) -> dict:
+    answer = json.loads(body)
+    if answer.get("snapshot"):
+        answer["snapshot"].pop("staleness_s", None)
+    return answer
+
+
+class TestCacheCorrectness:
+    def test_hit_bit_identical_across_publication(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "1")
+        pipe = _Pipeline(range(16))
+        srv = QueryServer(
+            store=pipe.store, port=_free_port(), batch_window_ms=0.5
+        ).start()
+        try:
+            payload = {"vector": _vec(2).tolist(), "k": 3}
+            status, headers1, body1 = _post(
+                srv.port, "/serving/query", payload
+            )
+            assert status == 200
+            assert "X-Pathway-Cache" not in headers1  # miss recompute
+            status, headers2, body2 = _post(
+                srv.port, "/serving/query", payload
+            )
+            assert status == 200
+            assert headers2.get("X-Pathway-Cache") == "hit"
+            assert body2 == body1  # hit is bit-identical to the miss
+            # publication boundary: stamp changes, first read misses
+            pipe.insert_commit(range(16, 24))
+            status, headers3, body3 = _post(
+                srv.port, "/serving/query", payload
+            )
+            assert status == 200
+            assert "X-Pathway-Cache" not in headers3
+            assert (
+                json.loads(body3)["snapshot"]["commit_time"]
+                > json.loads(body1)["snapshot"]["commit_time"]
+            )
+            status, headers4, body4 = _post(
+                srv.port, "/serving/query", payload
+            )
+            assert headers4.get("X-Pathway-Cache") == "hit"
+            assert body4 == body3
+            # the hit equals what an uncached recompute serves (staleness
+            # is wall-clock age, the only field a recompute may differ in)
+            monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+            status, headers5, body5 = _post(
+                srv.port, "/serving/query", payload
+            )
+            assert "X-Pathway-Cache" not in headers5
+            assert _sans_staleness(body5) == _sans_staleness(body3)
+        finally:
+            srv.stop()
+
+    def test_store_truncate_invalidates_cache(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "1")
+        pipe = _Pipeline(range(8))
+        # the global STORE registers this hook at import; private stores
+        # (tests, replicas) wire the same seam explicitly
+        pipe.store.register_truncate_hook(rc.CACHE.invalidate_above)
+        t0 = pipe.store.latest().commit_time
+        pipe.insert_commit(range(8, 12))
+        srv = QueryServer(
+            store=pipe.store, port=_free_port(), batch_window_ms=0.5
+        ).start()
+        try:
+            payload = {"vector": _vec(1).tolist(), "k": 3}
+            _post(srv.port, "/serving/query", payload)
+            assert rc.CACHE.stats()["entries"] >= 1
+            before = rc.CACHE.stats()["invalidations"]
+            # rollback: recovery re-drives commit times, so every answer
+            # stamped past the truncation point must leave the cache
+            pipe.store.truncate(t0)
+            assert rc.CACHE.stats()["invalidations"] > before
+            assert rc.CACHE.stats()["entries"] == 0
+        finally:
+            srv.stop()
+
+
+# -- snapshot replicas --------------------------------------------------------
+
+
+class TestReplica:
+    def test_parse_sources(self):
+        assert parse_sources("9001, host2:9002") == [
+            ("127.0.0.1", 9001), ("host2", 9002),
+        ]
+
+    def test_replica_bit_identical_and_converges(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        pipe = _Pipeline(range(16))
+        sport = _free_port()
+        stream = SnapshotStreamServer(store=pipe.store, port=sport).start()
+        rep = Replica(
+            sources=[("127.0.0.1", sport)], port=_free_port(), replica_id=0
+        ).start()
+        try:
+            assert rep.wait_ready(10.0)
+            payload = {"vector": _vec(3).tolist(), "k": 3}
+            status, _, rep_body = _post(rep.port, "/serving/query", payload)
+            assert status == 200
+            snap = pipe.store.acquire_latest()
+            try:
+                direct = snap.search(
+                    np.asarray([payload["vector"]], np.float32), 3
+                )[0]
+                commit = snap.commit_time
+            finally:
+                snap.release()
+            answer = json.loads(rep_body)
+            assert answer["snapshot"]["commit_time"] == commit
+            assert answer["hits"][0] == [
+                [repr(key), score] for key, score in direct
+            ]
+            # convergence: a further publication reaches the replica
+            t2 = pipe.insert_commit(range(16, 24))
+            pipe.publish_to(stream)
+            health: dict = {}
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                _, health = _get(rep.port, "/serving/health")
+                if health.get("cut_commit_time") == t2:
+                    break
+                time.sleep(0.05)
+            assert health.get("cut_commit_time") == t2
+        finally:
+            rep.stop()
+            stream.stop()
+
+    def test_replica_follows_stream_truncation(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        pipe = _Pipeline(range(8))
+        t1 = pipe.insert_commit(range(8, 12))
+        t2 = pipe.insert_commit(range(12, 16))
+        sport = _free_port()
+        stream = SnapshotStreamServer(store=pipe.store, port=sport).start()
+        rep = Replica(
+            sources=[("127.0.0.1", sport)], port=_free_port(), replica_id=1
+        ).start()
+        try:
+            assert rep.wait_ready(10.0)
+            _, health = _get(rep.port, "/serving/health")
+            assert health["cut_commit_time"] == t2
+            stream.on_truncate(t1)
+            # the rolled-back commit must leave the replica's cut (the
+            # catch-up frame only carried t2, so the cut empties until a
+            # republication arrives — readers can never see past t1)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                _, health = _get(rep.port, "/serving/health")
+                cut = health.get("cut_commit_time")
+                if cut is None or cut <= t1:
+                    break
+                time.sleep(0.05)
+            cut = health.get("cut_commit_time")
+            assert cut is None or cut <= t1
+            # republication past the rollback point converges the replica
+            # and it keeps answering (bounded-stale, 200)
+            t3 = pipe.insert_commit(range(16, 20))
+            pipe.publish_to(stream)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                _, health = _get(rep.port, "/serving/health")
+                if health.get("cut_commit_time") == t3:
+                    break
+                time.sleep(0.05)
+            assert health.get("cut_commit_time") == t3
+            status, _, _body = _post(
+                rep.port, "/serving/query",
+                {"vector": _vec(1).tolist(), "k": 3},
+            )
+            assert status == 200
+        finally:
+            rep.stop()
+            stream.stop()
+
+    def test_replica_staleness_refusal_503(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        pipe = _Pipeline(range(8))
+        sport = _free_port()
+        stream = SnapshotStreamServer(store=pipe.store, port=sport).start()
+        rep = Replica(
+            sources=[("127.0.0.1", sport)], port=_free_port(),
+            replica_id=2, max_staleness=0.2,
+        ).start()
+        try:
+            assert rep.wait_ready(10.0)
+            time.sleep(0.4)  # let the cut age past the bound
+            status, headers, _body = _post(
+                rep.port, "/serving/query",
+                {"vector": _vec(1).tolist(), "k": 3},
+            )
+            assert status == 503
+            assert "Retry-After" in headers
+            # a fresh publication heals it
+            pipe.insert_commit(range(8, 10))
+            pipe.publish_to(stream)
+            deadline = time.monotonic() + 10.0
+            status = 503
+            while time.monotonic() < deadline and status != 200:
+                status, _, _body = _post(
+                    rep.port, "/serving/query",
+                    {"vector": _vec(1).tolist(), "k": 3},
+                )
+                time.sleep(0.05)
+            assert status == 200
+        finally:
+            rep.stop()
+            stream.stop()
+
+
+# -- federation ---------------------------------------------------------------
+
+
+def _client_side_merge(ports: list, payload: dict, k: int):
+    """The documented client-side fan-out merge the front must match
+    bit-for-bit: concat per-worker hits in port order, stable sort on
+    descending score, truncate to k."""
+    rows: list = []
+    commits: list = []
+    for port in ports:
+        status, _, body = _post(port, "/serving/query", payload)
+        assert status == 200
+        answer = json.loads(body)
+        rows.extend(answer["hits"][0])
+        commits.append(answer["snapshot"]["commit_time"])
+    rows.sort(key=lambda hit: -hit[1])
+    return rows[:k], min(commits)
+
+
+class TestFederation:
+    def test_scatter_parity_bit_identical(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        pipe_a = _Pipeline(range(0, 12))
+        pipe_b = _Pipeline(range(12, 24))
+        srv_a = QueryServer(
+            store=pipe_a.store, port=_free_port(), batch_window_ms=0.5
+        ).start()
+        srv_b = QueryServer(
+            store=pipe_b.store, port=_free_port(), batch_window_ms=0.5
+        ).start()
+        front = FederationFront(
+            port=_free_port(), worker_ports=[srv_a.port, srv_b.port],
+            replicas=[],
+        ).start()
+        try:
+            payload = {"vector": _vec(5).tolist(), "k": 3}
+            status, _, body = _post(front.port, "/serving/query", payload)
+            assert status == 200
+            fed = json.loads(body)
+            merged, min_commit = _client_side_merge(
+                [srv_a.port, srv_b.port], payload, 3
+            )
+            assert fed["hits"][0] == merged
+            assert fed["snapshot"]["commit_time"] == min_commit
+            assert fed["snapshot"]["route"] == "scatter"
+            assert fed["snapshot"]["fan_out"] == 2
+        finally:
+            front.stop()
+            srv_a.stop()
+            srv_b.stop()
+
+    def test_partial_scatter_never_served(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        pipe = _Pipeline(range(12))
+        srv = QueryServer(
+            store=pipe.store, port=_free_port(), batch_window_ms=0.5
+        ).start()
+        dead = _free_port()  # nothing listens here
+        front = FederationFront(
+            port=_free_port(), worker_ports=[srv.port, dead], replicas=[]
+        ).start()
+        try:
+            status, headers, _body = _post(
+                front.port, "/serving/query",
+                {"vector": _vec(5).tolist(), "k": 3},
+            )
+            assert status == 503
+            assert "Retry-After" in headers
+        finally:
+            front.stop()
+            srv.stop()
+
+    def test_replica_route_then_scatter_fallback(self, monkeypatch):
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        pipe = _Pipeline(range(16))
+        srv = QueryServer(
+            store=pipe.store, port=_free_port(), batch_window_ms=0.5
+        ).start()
+        sport = _free_port()
+        stream = SnapshotStreamServer(store=pipe.store, port=sport).start()
+        rep = Replica(
+            sources=[("127.0.0.1", sport)], port=_free_port(), replica_id=3
+        ).start()
+        front = FederationFront(
+            port=_free_port(), worker_ports=[srv.port],
+            replicas=[("127.0.0.1", rep.port)],
+        ).start()
+        try:
+            assert rep.wait_ready(10.0)
+            payload = {"vector": _vec(7).tolist(), "k": 3}
+            status, _, body = _post(front.port, "/serving/query", payload)
+            assert status == 200
+            via_replica = json.loads(body)
+            assert via_replica["snapshot"]["route"] == "replica"
+            assert front.stats()["routes"]["replica"] >= 1
+            # the one-hop replica answer matches the worker's own
+            status, _, direct = _post(srv.port, "/serving/query", payload)
+            assert via_replica["hits"] == json.loads(direct)["hits"]
+            # replica death degrades to the worker scatter, not to 5xx
+            rep.stop()
+            status, _, body = _post(front.port, "/serving/query", payload)
+            assert status == 200
+            assert json.loads(body)["snapshot"]["route"] == "scatter"
+        finally:
+            front.stop()
+            rep.stop()
+            stream.stop()
+            srv.stop()
+
+
+# -- chaos: failover + rescale under paced load -------------------------------
+
+
+class TestReadTierChaos:
+    def test_bounded_staleness_through_failover_and_rescale(
+        self, monkeypatch
+    ):
+        """Paced query load against a replica while the publisher (a)
+        dies and is replaced on the same port at a higher epoch and (b)
+        the stream width rescales 1 -> 2.  Every response is 200 or
+        503 (+Retry-After), never a 5xx; served staleness stays inside
+        the bound; the disconnected replica's piggybacked metrics are
+        pruned from the worker's stream registry."""
+        monkeypatch.setenv("PATHWAY_TPU_RESULT_CACHE", "0")
+        monkeypatch.setenv("PATHWAY_PROCESSES", "1")
+        # two adjacent ports for the 1 -> 2 rescale port scheme
+        base = _free_port()
+        for _ in range(64):
+            probe = socket.socket()
+            try:
+                probe.bind(("127.0.0.1", base + 1))
+                break
+            except OSError:
+                base = _free_port()
+            finally:
+                probe.close()
+        monkeypatch.setenv(
+            "PATHWAY_TPU_SERVING_STREAM_PORT_BASE", str(base)
+        )
+        bound = 30.0
+        streams: list[SnapshotStreamServer] = []
+        pipe0 = _Pipeline(range(12))
+        stream0 = SnapshotStreamServer(
+            store=pipe0.store, port=base, process_id=0
+        ).start()
+        streams.append(stream0)
+        rep = Replica(
+            width=1, port=_free_port(), replica_id=0, max_staleness=bound
+        ).start()
+        statuses: list = []
+        staleness: list = []
+        stop = threading.Event()
+
+        def load() -> None:
+            while not stop.is_set():
+                try:
+                    status, _, body = _post(
+                        rep.port, "/serving/query",
+                        {"vector": _vec(2).tolist(), "k": 3},
+                        timeout=5.0,
+                    )
+                except OSError:
+                    stop.wait(0.05)
+                    continue
+                statuses.append(status)
+                if status == 200:
+                    answer = json.loads(body)
+                    if answer.get("snapshot"):
+                        staleness.append(
+                            answer["snapshot"]["staleness_s"]
+                        )
+                stop.wait(0.02)
+
+        loader = threading.Thread(target=load, daemon=True)
+        try:
+            assert rep.wait_ready(10.0)
+            loader.start()
+            next_key = [24]
+
+            def publish(pipe, stream) -> int:
+                t = pipe.insert_commit(
+                    [next_key[0] % 60, next_key[0] % 60 + 1]
+                )
+                next_key[0] += 2
+                pipe.publish_to(stream)
+                return t
+
+            for _ in range(5):
+                publish(pipe0, stream0)
+                time.sleep(0.05)
+            # the replica piggybacks its metrics registry upstream on
+            # source-0 recv timeouts (~1.5s cadence): go quiet and wait
+            deadline = time.monotonic() + 8.0
+            while (
+                time.monotonic() < deadline
+                and not stream0.replica_metrics_snapshot()
+            ):
+                time.sleep(0.1)
+            assert 0 in stream0.replica_metrics_snapshot()
+            # (a) publisher failover: the stream dies mid-run and a new
+            # incarnation takes the same port at a bumped epoch
+            epoch0 = stream0.epoch()
+            stream0.stop()
+            time.sleep(0.3)
+            stream0b = SnapshotStreamServer(
+                store=pipe0.store, port=base, process_id=0
+            ).start()
+            streams.append(stream0b)
+            stream0b.set_epoch(epoch0 + 1)
+            target = publish(pipe0, stream0b)
+            deadline = time.monotonic() + 15.0
+            converged = False
+            while time.monotonic() < deadline:
+                _, health = _get(rep.port, "/serving/health")
+                cut = health.get("cut_commit_time")
+                if cut is not None and cut >= target:
+                    converged = True
+                    break
+                publish(pipe0, stream0b)
+                time.sleep(0.1)
+            assert converged, "replica never re-converged after failover"
+            # (b) rescale 1 -> 2: a second worker joins.  Mesh commits
+            # share one coordinator-driven clock; march the new worker's
+            # scheduler up to the incumbent's commit time to model that.
+            pipe1 = _Pipeline(range(30, 42))
+            while pipe1.insert_commit([]) < pipe0.store.latest().commit_time:
+                pass
+            stream1 = SnapshotStreamServer(
+                store=pipe1.store, port=base + 1, process_id=1
+            ).start()
+            streams.append(stream1)
+            monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+            rep.on_width(2)
+            deadline = time.monotonic() + 15.0
+            widened = False
+            while time.monotonic() < deadline:
+                publish(pipe0, stream0b)
+                publish(pipe1, stream1)
+                _, health = _get(rep.port, "/serving/health")
+                if (
+                    health.get("sources") == 2
+                    and health.get("cut_commit_time") is not None
+                ):
+                    widened = True
+                    break
+                time.sleep(0.1)
+            assert widened, "replica never served the 2-source cut"
+            stop.set()
+            loader.join(timeout=10.0)
+            # chaos contract: only 200/503 ever, staleness bounded
+            assert statuses, "no load was applied"
+            assert set(statuses) <= {200, 503}
+            assert statuses.count(200) > 0
+            assert all(s <= bound for s in staleness)
+            # satellite: a replica disconnect prunes its piggybacked
+            # metrics from the stream registry (no dead /metrics rows)
+            rep.stop()
+            deadline = time.monotonic() + 10.0
+            while (
+                time.monotonic() < deadline
+                and stream0b.replica_metrics_snapshot()
+            ):
+                time.sleep(0.1)
+            assert stream0b.replica_metrics_snapshot() == {}
+        finally:
+            stop.set()
+            rep.stop()
+            for stream in streams:
+                stream.stop()
+
+
+# -- cli stats read-tier section ----------------------------------------------
+
+
+class TestCliStats:
+    def test_stats_renders_read_tier_section(self, capsys):
+        from pathway_tpu import cli
+        from pathway_tpu.internals.monitoring import (
+            MonitoringHttpServer,
+            MonitoringLevel,
+            StatsMonitor,
+        )
+        from pathway_tpu.serving import federation as fed
+
+        rc._EVENTS["hit"].inc(3)
+        rc._EVENTS["miss"].inc(1)
+        fed._FED_REQS["query"].inc(4)
+        for _ in range(4):
+            fed._FED_FANOUT.observe(2.0)
+        # counters are process-global and monotonic: compute the section
+        # text the renderer must produce from their live values
+        hits = rc._EVENTS["hit"].value
+        total = hits + rc._EVENTS["miss"].value
+        want_rate = f"cache hit_rate={hits / total * 100.0:.1f}%"
+        want_mean = (
+            f"fan_out_mean={fed._FED_FANOUT.sum / fed._FED_FANOUT.count:.1f}"
+        )
+        monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+        server = MonitoringHttpServer(monitor, port=0)
+        try:
+            assert cli.main(["stats", str(server.port)]) == 0
+        finally:
+            server.stop()
+        out = capsys.readouterr().out
+        assert "read tier:" in out
+        assert want_rate in out
+        assert "federation reqs=" in out
+        assert want_mean in out

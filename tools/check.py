@@ -61,6 +61,20 @@ problem):
 11. serving ingest overhead — bench.serving_plane_leg with paced HTTP
    query load vs no serving; FAILs when serving costs ingest more than
    5% or the client latency histogram is degenerate;
+11b. federation parity — the federation front's scatter-merge must be
+   bit-identical to a client-side per-worker fan-out merge (same
+   stable-sort contract as ReadSnapshot.search), stamped at the min
+   common commit, never serving a partial scatter, and a replica's
+   one-hop answer must match the worker's own;
+11c. cache correctness — a result-cache hit must serve the exact bytes
+   of the miss recompute it memoized, a publication boundary must force
+   a miss (stamped keying), and store truncation must invalidate every
+   entry stamped past the rollback point;
+11d. read-tier ingest overhead — bench_dataflow.read_tier_leg paces the
+   same ingest cadence with zero vs two snapshot-stream subscribers;
+   FAILs when the replica streams cost the paced ingest loop more than
+   5%, the cache shows no hot-path speedup, or the federated window
+   answers nothing;
 12. trace export — a small traced program runs end-to-end and the
    exported file must satisfy the Chrome trace-event schema invariants
    (complete X / matched B-E events, monotonic timestamps per track);
@@ -992,6 +1006,7 @@ BENCH_REQUIRED_LEGS = [
     "mesh_recovery",
     "leader_failover",
     "rescale",
+    "read_tier",
     "native",
 ]
 
@@ -1431,6 +1446,142 @@ def step_serving_overhead() -> str:
     return status
 
 
+#: federation-parity gate: a federated scatter answer must be
+#: bit-identical to a client-side per-worker fan-out merge at the same
+#: commits, partial scatters must never be served, and a replica's
+#: one-hop answer must match the worker's own
+FEDERATION_PARITY_NODES = [
+    "tests/test_read_tier.py::TestFederation",
+    "tests/test_read_tier.py::TestReplica::test_replica_bit_identical_and_converges",
+]
+
+#: cache-correctness gate: a result-cache hit must be bit-identical to
+#: the miss recompute it memoized, a publication boundary must force a
+#: miss, and rollback must invalidate stamped entries
+CACHE_CORRECTNESS_NODES = [
+    "tests/test_read_tier.py::TestResultCache",
+    "tests/test_read_tier.py::TestCacheCorrectness",
+]
+
+
+def _read_tier_pytest(name: str, nodes: list[str]) -> str:
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            *nodes,
+            "-q",
+            "-p",
+            "no:cacheprovider",
+        ],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=600,
+    )
+    status = PASS if proc.returncode == 0 else FAIL
+    _report(
+        name,
+        status,
+        f"pytest exit {proc.returncode}" if status == FAIL else "",
+    )
+    return status
+
+
+def step_federation_parity() -> str:
+    """Federation-parity: the front's scatter-merge must match the
+    client-side fan-out merge bit-for-bit (same stable-sort contract as
+    ReadSnapshot.search), stamp at the min common commit, and never
+    serve a partial scatter."""
+    return _read_tier_pytest(
+        "federation parity (scatter merge == client-side merge)",
+        FEDERATION_PARITY_NODES,
+    )
+
+
+def step_cache_correctness() -> str:
+    """Cache-correctness: a hit serves the exact bytes of the miss it
+    memoized, publication changes the stamp (hit can never cross a
+    publication boundary), and store truncation drops rolled-back
+    stamps."""
+    return _read_tier_pytest(
+        "cache correctness (hit == miss recompute, stamped invalidation)",
+        CACHE_CORRECTNESS_NODES,
+    )
+
+
+def _read_tier_overhead_once() -> tuple[float | None, str]:
+    """One small read_tier_leg run: (ingest_overhead_pct, detail)."""
+    import json
+
+    code = (
+        "import json, bench_dataflow as b;"
+        "print('READ_TIER_JSON ' + json.dumps(b.read_tier_leg()))"
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # small-but-real: paced ingest spanning enough commits that two
+        # replica subscriptions would show up as cadence slippage
+        "BENCH_READ_TIER_COMMITS": "25",
+        "BENCH_READ_TIER_QPS_SECS": "1.0",
+        "BENCH_READ_TIER_CACHE_REQS": "120",
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+    except subprocess.SubprocessError as e:
+        return None, f"bench leg did not finish: {e}"
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("READ_TIER_JSON "):
+            payload = json.loads(line.split(" ", 1)[1])
+    if proc.returncode != 0 or payload is None:
+        sys.stderr.write((proc.stdout + proc.stderr)[-2000:])
+        return None, f"bench leg exit {proc.returncode}"
+    speedup = payload.get("cache_hot_speedup")
+    if not isinstance(speedup, (int, float)) or speedup <= 1.0:
+        return None, f"cache smoke failed: cache_hot_speedup={speedup!r}"
+    if not payload.get("federated_qps"):
+        return None, "federated window answered nothing"
+    overhead = payload.get("ingest_overhead_pct")
+    if overhead is None:
+        return None, "no baseline ingest rate"
+    detail = (
+        f"{overhead:+.2f}% ingest overhead with "
+        f"{payload.get('replicas')} replica streams "
+        f"(baseline {payload['ingest_base_rows_per_sec']} -> "
+        f"{payload['ingest_with_replicas_rows_per_sec']} rows/s), "
+        f"cache hot speedup {speedup}x"
+    )
+    return overhead, detail
+
+
+def step_read_tier_overhead() -> str:
+    """Gate the read tier's ingest tax: bench_dataflow.read_tier_leg
+    paces the same ingest cadence with zero vs two snapshot-stream
+    subscribers; >5% cadence slippage is a FAIL, as is a dead cache
+    (speedup <= 1) or an empty federated window.  One retry absorbs
+    scheduler noise — two consecutive failures are signal."""
+    name = "read-tier ingest overhead (replica streams vs none)"
+    overhead, detail = _read_tier_overhead_once()
+    if overhead is not None and overhead > 5.0:
+        overhead, detail = _read_tier_overhead_once()
+        detail += " [retried]"
+    if overhead is None:
+        _report(name, FAIL, detail)
+        return FAIL
+    status = PASS if overhead <= 5.0 else FAIL
+    _report(name, status, detail)
+    return status
+
+
 def _metrics_on_seconds(extra_env: dict[str, str]) -> tuple[float | None, str]:
     """Run the metrics-overhead leg in a subprocess and return its
     lock-heavy ``metrics_on_s`` timing (best-of-3 inside the leg)."""
@@ -1613,6 +1764,9 @@ def main(argv=None) -> int:
         step_bench_device_sim(),
         step_serving_parity(),
         step_serving_overhead(),
+        step_federation_parity(),
+        step_cache_correctness(),
+        step_read_tier_overhead(),
         step_trace_export(),
         step_profile_export(),
         step_lockwatch_overhead(),
