@@ -2030,10 +2030,11 @@ def read_tier_leg() -> dict:
     old_cache_flag = os.environ.get("PATHWAY_TPU_RESULT_CACHE")
     os.environ["PATHWAY_TPU_RESULT_CACHE"] = "0"
 
-    wport, sport, fport, r1port, r2port, cport = _free_ports(6)
+    wport, sport, fport, tfport, r1port, r2port, cport = _free_ports(7)
     worker = None
     replicas: list = []
     front = None
+    tfront = None
     cache_server = None
     try:
         worker = subprocess.Popen(
@@ -2108,6 +2109,28 @@ def read_tier_leg() -> dict:
         _proc_expect(worker, "OK", 30.0)
         _qps_run(fport, 0.2, n_clients, qvecs, k)
         fed_qps, fed_counts = _qps_run(fport, secs, n_clients, qvecs, k)
+        # (b3) the same federated leg with request tracing sampling 1/4
+        # of requests — the propagation tax (header parse/emit + span
+        # records + assembly on sampled requests) must stay <= 5%
+        tenv = dict(env)
+        tenv["PATHWAY_TPU_REQUEST_TRACE"] = "1"
+        tenv["PATHWAY_TPU_REQUEST_TRACE_SAMPLE"] = "4"
+        tfront = subprocess.Popen(
+            [
+                sys.executable, "-m", "pathway_tpu.cli", "federation",
+                "--port", str(tfport), "--workers", str(wport),
+                "--replicas", f"127.0.0.1:{r1port},127.0.0.1:{r2port}",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=tenv,
+        )
+        _wait_health(tfport, 30.0, need_commit=False)
+        _qps_run(tfport, 0.2, n_clients, qvecs, k)
+        traced_qps, traced_counts = _qps_run(
+            tfport, secs, n_clients, qvecs, k
+        )
+        tfront.terminate()
         send("ingest_off")
         _proc_expect(worker, "OK", 30.0)
         send("quit")
@@ -2175,6 +2198,13 @@ def read_tier_leg() -> dict:
             "single_worker_counts": single_counts,
             "federated_qps": round(fed_qps, 1),
             "federated_counts": fed_counts,
+            "federated_qps_traced": round(traced_qps, 1),
+            "federated_counts_traced": traced_counts,
+            "request_trace_overhead_pct": (
+                max(0.0, round(100.0 * (fed_qps - traced_qps) / fed_qps, 2))
+                if fed_qps
+                else None
+            ),
             "qps_scaling": (
                 round(fed_qps / single_qps, 2) if single_qps else None
             ),
@@ -2195,11 +2225,15 @@ def read_tier_leg() -> dict:
             cache_server.stop()
         if front is not None:
             front.terminate()
+        if tfront is not None:
+            tfront.terminate()
         for proc in replicas:
             proc.terminate()
         if worker is not None:
             worker.terminate()
-        procs = replicas + [p for p in (front, worker) if p is not None]
+        procs = replicas + [
+            p for p in (front, tfront, worker) if p is not None
+        ]
         for proc in procs:
             try:
                 proc.wait(timeout=10.0)

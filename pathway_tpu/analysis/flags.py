@@ -246,6 +246,24 @@ REGISTRY: dict[str, FlagSpec] = {
             "trace sampling ratio",
         ),
         _spec(
+            "PATHWAY_TPU_REQUEST_TRACE",
+            STARTUP,
+            "internals.tracing",
+            "1 — read-tier request tracing (X-Pathway-Trace)",
+        ),
+        _spec(
+            "PATHWAY_TPU_REQUEST_TRACE_SAMPLE",
+            STARTUP,
+            "internals.tracing",
+            "request-trace sampling interval",
+        ),
+        _spec(
+            "PATHWAY_TPU_REQUEST_TRACE_RING",
+            STARTUP,
+            "internals.metrics",
+            "wide-event request ring capacity",
+        ),
+        _spec(
             "PATHWAY_TPU_SLO",
             STARTUP,
             "internals.timeseries",

@@ -523,6 +523,22 @@ def _stats_once(
                 le = labels["le"]
                 ub = float("inf") if le in ("+Inf", "inf") else float(le)
                 prof_cost.setdefault(w, []).append((ub, value))
+    # p99 exemplars: the trace id piggybacked on the deepest serving
+    # latency bucket (internals/metrics.py) — joins a slow request seen
+    # here straight to its assembled trace in ``cli trace --request``
+    srv_exemplar: dict[str, tuple[float, str]] = {}
+    for fam_name in (
+        "pathway_serving_latency_seconds",
+        "pathway_serving_federation_latency_seconds",
+    ):
+        fam = families.get(fam_name) or {}
+        for _name, labels, exlabels, exvalue in fam.get("exemplars", []):
+            w = worker_of(labels)
+            tid = exlabels.get("trace_id")
+            if tid and (
+                w not in srv_exemplar or exvalue >= srv_exemplar[w][0]
+            ):
+                srv_exemplar[w] = (float(exvalue), str(tid))
     for w, buckets in lat.items():
         buckets.sort()
         sums.setdefault(w, {})
@@ -588,11 +604,12 @@ def _stats_once(
             )
 
     # -- snapshot read plane -------------------------------------------------
-    if srv_reqs or srv_shed or srv_stale:
+    if srv_reqs or srv_shed or srv_stale or srv_exemplar:
         print()
         print("serving:")
         workers = sorted(
-            set(srv_reqs) | set(srv_shed) | set(srv_stale) | set(srv_lat),
+            set(srv_reqs) | set(srv_shed) | set(srv_stale) | set(srv_lat)
+            | set(srv_exemplar),
             key=lambda k: (k != "", k),
         )
         for w in workers:
@@ -614,6 +631,12 @@ def _stats_once(
                 f"  snapshot_seq={srv_seq.get(w, 0.0):.0f}"
                 + (f"  staleness_s={stale:.3f}" if stale is not None else "")
             )
+            ex = srv_exemplar.get(w)
+            if ex is not None:
+                print(
+                    f"  {'':<10}  p99 exemplar: {ex[1]}"
+                    f"  ({ex[0] * 1000.0:.2f}ms)"
+                )
 
     # -- read tier: result cache / replicas / federation ---------------------
     if cache_events or replica_lag or fed_reqs:
@@ -733,20 +756,142 @@ def _family_percentiles(
     return out
 
 
-def trace(target: str, *, as_json: bool = False) -> int:
+def _request_tree(spans: list) -> list:
+    """Parent/child forest over request-span ``args.sid``/``args.parent``
+    links: a fan-out leg allocates its sid before the RPC and every
+    remote span adopts it as a parent, so the forest IS the scatter
+    tree.  Returns serializable nodes (name/cat/track/dur_ms/children),
+    siblings ordered by start time."""
+    nodes: list[tuple[dict, dict]] = []
+    by_sid: dict[str, dict] = {}
+    for s in spans:
+        args = s.get("args") or {}
+        node = {
+            "name": s.get("name", "?"),
+            "cat": s.get("cat", ""),
+            "track": s.get("pid"),
+            "ts": s.get("ts", 0),
+            "dur_ms": round(s.get("dur", 0) / 1000.0, 3),
+            "children": [],
+        }
+        nodes.append((node, args))
+        sid = args.get("sid")
+        if sid is not None:
+            by_sid.setdefault(str(sid), node)
+    roots = []
+    for node, args in nodes:
+        parent = args.get("parent")
+        pnode = by_sid.get(str(parent)) if parent is not None else None
+        if pnode is not None and pnode is not node:
+            pnode["children"].append(node)
+        else:
+            roots.append(node)
+    for node, _args in nodes:
+        node["children"].sort(key=lambda n: n["ts"])
+    roots.sort(key=lambda n: n["ts"])
+    return roots
+
+
+def _assemble_requests(reports: list, want_id: str | None) -> list:
+    """Merge request-trace ring entries across exported files into one
+    summary per trace id.  The root process's entry holds the full
+    assembly (remote spans ride the response-header piggyback); any
+    hop-side leftover entry contributes spans the piggyback dropped."""
+    by_id: dict[str, list[dict]] = {}
+    files: dict[str, list[str]] = {}
+    for rep in reports:
+        for t in rep.get("traces", []):
+            if t.get("kind") != "request":
+                continue
+            tid = str(t.get("trace_id"))
+            if want_id is not None and tid != want_id:
+                continue
+            by_id.setdefault(tid, []).append(t)
+            files.setdefault(tid, []).append(rep["file"])
+    out = []
+    for tid, entries in sorted(by_id.items()):
+        base = max(entries, key=lambda t: len(t.get("spans") or []))
+        spans = list(base.get("spans") or [])
+        seen = {
+            (s.get("name"), s.get("ts"), s.get("pid")) for s in spans
+        }
+        for t in entries:
+            if t is base:
+                continue
+            for s in t.get("spans") or []:
+                key = (s.get("name"), s.get("ts"), s.get("pid"))
+                if key not in seen:
+                    seen.add(key)
+                    spans.append(s)
+        cp = base.get("critical_path") or {}
+        out.append(
+            {
+                "trace_id": tid,
+                "endpoint": base.get("endpoint"),
+                "status": base.get("status"),
+                "files": sorted(set(files[tid])),
+                "spans": len(spans),
+                "tracks": sorted(
+                    {s.get("pid") for s in spans if s.get("pid") is not None}
+                ),
+                "wall_ms": round(cp.get("wall_s", 0.0) * 1000.0, 3),
+                "critical_path": cp,
+                "request": dict(base.get("request") or {}),
+                "tree": _request_tree(spans),
+            }
+        )
+    return out
+
+
+def _print_request_tree(node: dict, depth: int) -> None:
+    print(
+        f"    {'  ' * depth}{node['name']}"
+        f"  {node['dur_ms']:.2f}ms"
+        f"  [{node['cat']}]"
+        f"  track={node['track']}"
+    )
+    for child in node["children"]:
+        _print_request_tree(child, depth + 1)
+
+
+def trace(
+    target: str | None = None,
+    *,
+    as_json: bool = False,
+    request: str | None = None,
+) -> int:
     """Validate and summarize exported Chrome trace files.
 
     ``target`` is one ``pathway_trace_*.json`` file or a directory of
     them (a run's ``PATHWAY_TPU_TRACE_DIR``).  Each file is checked
     against the Chrome trace-event invariants (complete X events or
     matched B/E pairs, monotonic timestamps per track) and its
-    per-commit critical-path summaries are printed.  Exit 2 when any
-    file fails validation — the timeline itself is for Perfetto
-    (https://ui.perfetto.dev) or chrome://tracing."""
+    per-commit critical-path summaries are printed.  With ``request``
+    (``--request [TRACE_ID]``), read-tier request traces are assembled
+    across files instead — fan-out tree plus per-hop critical path —
+    optionally filtered to one trace id.  Exit 2 when any file fails
+    validation (or a requested trace id is missing) — the timeline
+    itself is for Perfetto (https://ui.perfetto.dev) or
+    chrome://tracing."""
     import glob as _glob
 
     from pathway_tpu.internals import tracing as _tracing
 
+    # `cli trace --request <dir>` reads naturally: a --request value
+    # that names an existing path is the target, not a trace id
+    if request is not None and request and os.path.exists(request):
+        if target is None:
+            target = request
+        request = ""
+    if target is None:
+        target = os.environ.get("PATHWAY_TPU_TRACE_DIR", "")
+        if not target:
+            print(
+                "trace: no target (pass a file/dir or set "
+                "PATHWAY_TPU_TRACE_DIR)",
+                file=sys.stderr,
+            )
+            return 2
     if os.path.isdir(target):
         paths = sorted(
             _glob.glob(os.path.join(target, "pathway_trace_*.json"))
@@ -777,19 +922,62 @@ def trace(target: str, *, as_json: bool = False) -> int:
                 "traces": other.get("traces", []),
             }
         )
+    if request is not None:
+        summaries = _assemble_requests(reports, request or None)
+        if as_json:
+            print(json.dumps(summaries, indent=1))
+            return rc if summaries else 2
+        if not summaries:
+            what = f"trace id {request}" if request else "request traces"
+            print(f"no {what} in {target}", file=sys.stderr)
+            return 2
+        for s in summaries:
+            print(
+                f"request {s['trace_id']}  endpoint={s['endpoint']}  "
+                f"status={s['status']}  wall={s['wall_ms']:.2f}ms  "
+                f"tracks={len(s['tracks'])}  spans={s['spans']}"
+            )
+            cp = s["critical_path"]
+            print(
+                f"  per-hop: queue={cp.get('queue_wait_s', 0) * 1000:.2f}ms"
+                f"  exchange={cp.get('exchange_s', 0) * 1000:.2f}ms"
+                f"  host={cp.get('host_compute_s', 0) * 1000:.2f}ms"
+                f"  device={cp.get('device_s', 0) * 1000:.2f}ms"
+            )
+            chain = cp.get("chain", [])
+            if chain:
+                head = " -> ".join(sp["name"] for sp in chain[:8])
+                if len(chain) > 8:
+                    head += " -> ..."
+                print(f"  critical path: {head}")
+            if s["request"]:
+                kv = "  ".join(
+                    f"{k}={v}" for k, v in sorted(s["request"].items())
+                )
+                print(f"  wide event: {kv}")
+            print("  fan-out tree:")
+            for node in s["tree"]:
+                _print_request_tree(node, 0)
+        return rc
     if as_json:
         print(json.dumps(reports, indent=1))
         return rc
     for rep in reports:
         commits = [
-            t for t in rep["traces"] if t.get("kind", "commit") != "serving"
+            t
+            for t in rep["traces"]
+            if t.get("kind", "commit") not in ("serving", "request")
         ]
         queries = [
             t for t in rep["traces"] if t.get("kind") == "serving"
         ]
+        requests_n = len(
+            [t for t in rep["traces"] if t.get("kind") == "request"]
+        )
         print(f"{rep['file']}: {rep['events']} events, "
               f"{len(commits)} commit trace(s), "
-              f"{len(queries)} query trace(s)")
+              f"{len(queries)} query trace(s), "
+              f"{requests_n} request trace(s)")
         for t in commits:
             cp = t.get("critical_path", {})
             chain = cp.get("chain", [])
@@ -1152,9 +1340,16 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="emit the per-trace summaries as JSON",
     )
     p_trace.add_argument(
-        "target",
+        "--request", nargs="?", const="", default=None,
+        metavar="TRACE_ID",
+        help="assemble read-tier request traces across the exported "
+        "files (fan-out tree + per-hop critical path), optionally "
+        "filtered to one trace id",
+    )
+    p_trace.add_argument(
+        "target", nargs="?", default=None,
         help="a trace file, or a directory of pathway_trace_*.json "
-        "dumps (PATHWAY_TPU_TRACE_DIR)",
+        "dumps (defaults to PATHWAY_TPU_TRACE_DIR)",
     )
 
     args = parser.parse_args(argv)
@@ -1219,7 +1414,9 @@ def main(argv: Sequence[str] | None = None) -> int:
             watch=args.watch,
         )
     if args.command == "trace":
-        return trace(args.target, as_json=args.json)
+        return trace(
+            args.target, as_json=args.json, request=args.request
+        )
     if args.command == "profile":
         return profile(
             args.target,

@@ -115,6 +115,8 @@ __all__ = [
     "REGISTRY",
     "FLIGHT",
     "FlightRecorder",
+    "REQUESTS",
+    "RequestLog",
     "MirroredCounterDict",
     "DEFAULT_LATENCY_BUCKETS",
     "full_snapshot",
@@ -171,7 +173,7 @@ class Histogram:
     last one is +Inf), a running sum and a total count.  ``observe`` is a
     bisect plus three adds — no allocation, no lock."""
 
-    __slots__ = ("bounds", "counts", "sum", "count")
+    __slots__ = ("bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, bounds: Iterable[float]) -> None:
         self.bounds = tuple(float(b) for b in bounds)
@@ -180,11 +182,24 @@ class Histogram:
         self.counts = [0] * (len(self.bounds) + 1)
         self.sum = 0.0
         self.count = 0
+        #: bucket index -> (trace_id, value); written only for sampled
+        #: requests, read at scrape — whole-tuple replacement per slot,
+        #: so concurrent writers/readers see either value, never a tear
+        self.exemplars: dict[int, tuple] = {}
 
     def observe(self, v: float) -> None:
         self.counts[bisect_left(self.bounds, v)] += 1
         self.sum += v
         self.count += 1
+
+    def exemplar(self, v: float, trace_id: str) -> None:
+        """Attach a trace-id exemplar to the bucket ``v`` falls in —
+        called only for SAMPLED requests (off the unsampled hot path),
+        so ``observe`` itself stays a bisect plus three adds."""
+        self.exemplars[bisect_left(self.bounds, v)] = (
+            str(trace_id),
+            float(v),
+        )
 
     def observe_n(self, v: float, n: int) -> None:
         """One value standing for ``n`` events (e.g. every row of a delta
@@ -308,6 +323,12 @@ class Registry:
                     entry["counts"] = list(inst.counts)
                     entry["sum"] = inst.sum
                     entry["count"] = inst.count
+                    ex = getattr(inst, "exemplars", None)
+                    if ex:
+                        entry["exemplars"] = {
+                            str(i): [tid, v]
+                            for i, (tid, v) in sorted(ex.items())
+                        }
                 else:
                     entry["value"] = inst.value
                 fam["series"].append(entry)
@@ -427,6 +448,18 @@ def _label_str(labels: dict) -> str:
     return "{" + inner + "}"
 
 
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar suffix for one bucket line
+    (`` # {trace_id="..."} <value>``), or "" when the bucket has none."""
+    if not ex:
+        return ""
+    tid, value = ex[0], ex[1]
+    return (
+        f' # {{trace_id="{escape_label_value(str(tid))}"}}'
+        f" {_fmt_value(float(value))}"
+    )
+
+
 def render_snapshots(snaps: "dict[str, dict]") -> str:
     """Exposition text for worker-keyed snapshots.  Key ``""`` renders
     without a ``worker`` label (the leader's legacy local series); any
@@ -461,18 +494,21 @@ def render_snapshots(snaps: "dict[str, dict]") -> str:
                 if fam["kind"] == "histogram":
                     bounds = list(wfam.get("buckets") or [])
                     counts = entry["counts"]
+                    exemplars = entry.get("exemplars") or {}
                     cum = 0
-                    for bound, c in zip(bounds, counts):
+                    for i, (bound, c) in enumerate(zip(bounds, counts)):
                         cum += c
                         blabels = dict(labels)
                         blabels["le"] = _fmt_bound(bound)
                         lines.append(
                             f"{name}_bucket{_label_str(blabels)} {cum}"
+                            f"{_fmt_exemplar(exemplars.get(str(i)))}"
                         )
                     blabels = dict(labels)
                     blabels["le"] = "+Inf"
                     lines.append(
                         f"{name}_bucket{_label_str(blabels)} {entry['count']}"
+                        f"{_fmt_exemplar(exemplars.get(str(len(bounds))))}"
                     )
                     lines.append(
                         f"{name}_sum{_label_str(labels)} "
@@ -566,6 +602,10 @@ def parse_prometheus_text(text: str) -> dict:
             else:
                 fam(name)["help"] = parts[3] if len(parts) > 3 else ""
             continue
+        # OpenMetrics exemplar suffix: `<sample> # {labels} <value>` —
+        # split it off FIRST so rfind("}") sees the sample's own braces
+        line, _sep, exemplar_part = line.partition(" # ")
+        exemplar_part = exemplar_part.strip()
         brace = line.find("{")
         if brace >= 0:
             close = line.rfind("}")
@@ -594,6 +634,29 @@ def parse_prometheus_text(text: str) -> dict:
                 base = cand
                 break
         fam(base)["samples"].append((sample_name, labels, value))
+        if exemplar_part:
+            if not exemplar_part.startswith("{"):
+                raise ValueError(
+                    f"line {lineno}: bad exemplar {exemplar_part!r}"
+                )
+            ex_close = exemplar_part.find("}")
+            if ex_close < 0:
+                raise ValueError(
+                    f"line {lineno}: unterminated exemplar labels"
+                )
+            ex_labels = _parse_labels(exemplar_part[1:ex_close], lineno)
+            ex_rest = exemplar_part[ex_close + 1 :].split()
+            if not ex_rest:
+                raise ValueError(f"line {lineno}: exemplar without value")
+            try:
+                ex_value = float(ex_rest[0])
+            except ValueError:
+                raise ValueError(
+                    f"line {lineno}: bad exemplar value {ex_rest[0]!r}"
+                ) from None
+            fam(base).setdefault("exemplars", []).append(
+                (sample_name, labels, ex_labels, ex_value)
+            )
     return families
 
 
@@ -792,11 +855,56 @@ class FlightRecorder:
             return None
 
 
+class RequestLog:
+    """Bounded ring of per-request WIDE EVENTS: one structured record
+    per served read-tier request (endpoint, status, stamp vector, cache
+    disposition, fan-out width, shed/refusal reason, per-hop ns, trace
+    id), served raw at ``/requests`` on the monitoring port.
+
+    Same shape as the :class:`FlightRecorder` but a separate ring: the
+    flight ring is crash forensics (commits, exchanges, errors) and a
+    query flood must not evict it."""
+
+    def __init__(self, maxlen: int | None = None) -> None:
+        if maxlen is None:
+            try:
+                maxlen = int(
+                    os.environ.get("PATHWAY_TPU_REQUEST_TRACE_RING", "256")
+                )
+            except ValueError:
+                maxlen = 256
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(1, maxlen))  # guarded-by: self._lock
+        self._seq = 0  # guarded-by: self._lock
+
+    def record(self, **fields: Any) -> None:
+        event = dict(fields)
+        event.setdefault("wall", _time.time())
+        trace_id = _active_trace_id()
+        if trace_id is not None:
+            event.setdefault("trace_id", trace_id)
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._events.append(event)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
 #: the process-wide registry every engine hot path bumps
 REGISTRY = Registry()
 
 #: the process-wide flight recorder ``pw.run`` dumps on a raising run
 FLIGHT = FlightRecorder()
+
+#: the process-wide per-request wide-event ring behind ``/requests``
+REQUESTS = RequestLog()
 
 
 # -- built-in pull collectors (imports deferred to scrape time) ---------------

@@ -290,6 +290,9 @@ class MonitoringHttpServer:
                 if parsed.path == "/timeseries":
                     self._timeseries(parse_qs(parsed.query))
                     return
+                if parsed.path == "/requests":
+                    self._requests()
+                    return
                 if parsed.path == "/profile":
                     self._profile()
                     return
@@ -337,6 +340,20 @@ class MonitoringHttpServer:
                 }
                 result = _ts.STORE.query(family, window, labels)
                 self._reply(200, _json.dumps(result).encode())
+
+            def _requests(self) -> None:
+                """``/requests`` — the bounded per-request wide-event
+                ring (one structured record per served read-tier
+                request, newest last)."""
+                import json as _json
+
+                from pathway_tpu.internals import metrics as _m
+
+                events = _m.REQUESTS.snapshot()
+                payload = {"requests": events, "count": len(events)}
+                self._reply(
+                    200, _json.dumps(payload, default=repr).encode()
+                )
 
             def _profile(self) -> None:
                 """``/profile`` — the merged profile document (this

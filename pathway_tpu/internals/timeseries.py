@@ -237,6 +237,73 @@ class TimeSeriesStore:
                 else:
                     self.observe(family, labels, entry.get("value", 0.0), t)
 
+    def ingest_read_tier(
+        self, snap: dict, worker: str, t: float | None = None
+    ) -> None:
+        """Derive the read-tier health families from one registry
+        snapshot and record them under ``worker`` — the PR-19 read-path
+        metrics reduced to the three numbers an operator watches:
+
+        - ``pathway_read_cache_hit_rate`` — hits / (hits + misses) of
+          the result cache (skipped until the first lookup);
+        - ``pathway_read_federation_fanout_mean`` — mean backend
+          requests per federated query (sum/count of the fan-out
+          histogram);
+        - ``pathway_read_replica_lag_seconds`` — freshest-cut age per
+          replica, re-labelled so replica series prune with their
+          ``r<id>`` worker label on disconnect.
+        """
+        if t is None:
+            t = _time.time()
+        derived: list[tuple[str, dict, float]] = []
+        cache = snap.get("pathway_serving_cache_events_total")
+        if isinstance(cache, dict):
+            counts = {
+                (entry.get("labels") or {}).get("kind"): float(
+                    entry.get("value", 0.0)
+                )
+                for entry in cache.get("series") or []
+            }
+            total = counts.get("hit", 0.0) + counts.get("miss", 0.0)
+            if total > 0:
+                derived.append(
+                    (
+                        "pathway_read_cache_hit_rate",
+                        {},
+                        counts.get("hit", 0.0) / total,
+                    )
+                )
+        fanout = snap.get("pathway_serving_federation_fanout")
+        if isinstance(fanout, dict):
+            for entry in fanout.get("series") or []:
+                count = float(entry.get("count", 0.0))
+                if count > 0:
+                    derived.append(
+                        (
+                            "pathway_read_federation_fanout_mean",
+                            dict(entry.get("labels") or {}),
+                            float(entry.get("sum", 0.0)) / count,
+                        )
+                    )
+        lag = snap.get("pathway_serving_replica_lag_seconds")
+        if isinstance(lag, dict):
+            for entry in lag.get("series") or []:
+                derived.append(
+                    (
+                        "pathway_read_replica_lag_seconds",
+                        dict(entry.get("labels") or {}),
+                        float(entry.get("value", 0.0)),
+                    )
+                )
+        if not derived:
+            return
+        with self._lock:
+            for family, _labels, _value in derived:
+                self._kinds.setdefault(family, "gauge")
+        for family, labels, value in derived:
+            labels["worker"] = worker
+            self.observe(family, labels, value, t)
+
     def prune_workers(
         self, dead: Iterable[str] = (), width: int | None = None
     ) -> None:
@@ -608,6 +675,7 @@ class TelemetryLoop:
         scheduler = getattr(self.monitor, "scheduler", None)
         snap = _metrics.full_snapshot(scheduler)
         self.store.ingest_snapshot(snap, str(self.worker_id), t=now)
+        self.store.ingest_read_tier(snap, str(self.worker_id), t=now)
         mesh = getattr(self.monitor, "mesh_snapshots", None) or {}
         width = getattr(scheduler, "n_processes", None)
         for peer, peer_snap in sorted(mesh.items()):
@@ -630,6 +698,7 @@ class TelemetryLoop:
             ):
                 if isinstance(rsnap, dict):
                     self.store.ingest_snapshot(rsnap, f"r{rid}", t=now)
+                    self.store.ingest_read_tier(rsnap, f"r{rid}", t=now)
         self.sentinel.evaluate(self.store, now=now)
 
     def _run(self) -> None:

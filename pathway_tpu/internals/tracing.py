@@ -61,9 +61,15 @@ from pathway_tpu.internals import metrics as _metrics
 
 __all__ = [
     "TraceContext",
+    "RequestTrace",
     "TraceRecorder",
     "TRACER",
+    "TRACE_HEADER",
+    "SPANS_HEADER",
     "current",
+    "parse_trace_header",
+    "encode_spans",
+    "decode_spans",
     "critical_path",
     "chrome_trace",
     "validate_chrome_trace",
@@ -75,6 +81,68 @@ MAX_SPANS = 2048
 #: amortized (overhead / interval) share of commit wall that triggers an
 #: interval doubling — half the 5% observability gate, for headroom
 OVERHEAD_TARGET = 0.02
+
+#: request header carrying the read-tier trace context across HTTP hops:
+#: ``"<trace_id>;<parent_span_id>;<0|1 sampling bit>"``
+TRACE_HEADER = "X-Pathway-Trace"
+
+#: response header piggybacking a remote hop's span list back to its
+#: caller (compact JSON; dropped rather than split when oversized)
+SPANS_HEADER = "X-Pathway-Trace-Spans"
+
+#: span-piggyback budget — one HTTP header line; an oversized payload is
+#: dropped (the caller keeps its own leg span, so the trace stays valid)
+MAX_SPANS_HEADER_BYTES = 16384
+
+
+def parse_trace_header(value: str | None) -> tuple[str, str, bool] | None:
+    """Decode an ``X-Pathway-Trace`` value into
+    ``(trace_id, parent_span_id, sampled)``; ``None`` when absent or
+    garbled — a skewed peer must never break the request path."""
+    if not value:
+        return None
+    parts = str(value).split(";")
+    if len(parts) != 3 or not parts[0] or not parts[1]:
+        return None
+    return parts[0], parts[1], parts[2] == "1"
+
+
+def encode_spans(spans: list[dict]) -> str | None:
+    """Compact JSON for the response-header span piggyback, or ``None``
+    when there is nothing to send or the payload would blow the header
+    budget."""
+    if not spans:
+        return None
+    try:
+        payload = json.dumps(spans, separators=(",", ":"), default=repr)
+    except (TypeError, ValueError):
+        return None
+    if len(payload) > MAX_SPANS_HEADER_BYTES:
+        return None
+    return payload
+
+
+def decode_spans(value: str | None) -> list[dict]:
+    """Parse a piggybacked span list defensively: malformed input yields
+    ``[]``, and only dict entries with a string name and numeric ``ts``
+    survive (the shape :func:`chrome_trace` depends on)."""
+    if not value:
+        return []
+    try:
+        spans = json.loads(value)
+    except (TypeError, ValueError):
+        return []
+    if not isinstance(spans, list):
+        return []
+    out: list[dict] = []
+    for s in spans:
+        if (
+            isinstance(s, dict)
+            and isinstance(s.get("name"), str)
+            and isinstance(s.get("ts"), (int, float))
+        ):
+            out.append(s)
+    return out
 
 # one per-process clock anchor: wall time is captured once, every span
 # timestamp is the anchor plus a perf_counter/monotonic delta — so per-
@@ -191,6 +259,114 @@ class TraceContext:
         self.sink_rows += int(rows)
 
 
+class RequestTrace:
+    """One in-flight read-tier request: identity plus span accumulator.
+
+    Unlike :class:`TraceContext` (single-slot, pump-thread-private), a
+    request trace is born on an HTTP handler thread and accumulates
+    spans from the federation scatter pool concurrently, so its span
+    list and span-id counter are lock-guarded.  ``track`` is the OS
+    pid: every process a request crosses renders on its own Chrome
+    track, so per-track timestamps stay monotonic even though each
+    process stamps spans off its own clock anchor."""
+
+    __slots__ = (
+        "trace_id",
+        "parent_span",
+        "endpoint",
+        "remote",
+        "track",
+        "origin_wall",
+        "begin_wall",
+        "spans",
+        "dropped",
+        "overhead_s",
+        "_lock",
+        "_sid",
+    )
+
+    def __init__(
+        self,
+        trace_id: str,
+        endpoint: str,
+        parent_span: str | None = None,
+        remote: bool = False,
+    ) -> None:
+        self.trace_id = trace_id
+        self.parent_span = parent_span
+        self.endpoint = endpoint
+        self.remote = remote
+        self.track = os.getpid()
+        self.begin_wall = perf_to_wall(_time.perf_counter())
+        self.origin_wall = self.begin_wall
+        self._lock = threading.Lock()
+        self.spans: list[dict] = []  # guarded-by: self._lock
+        self._sid = 0  # guarded-by: self._lock
+        self.dropped = 0  # guarded-by: self._lock
+        self.overhead_s = 0.0
+
+    def alloc_sid(self) -> str:
+        """Reserve a span id BEFORE the RPC it will name, so the
+        outbound trace header can carry it as the callee's parent."""
+        with self._lock:
+            self._sid += 1
+            return f"{self.track:x}.{self._sid}"
+
+    def span(
+        self,
+        name: str,
+        cat: str,
+        t0: float,
+        t1: float,
+        sid: str | None = None,
+        **args: Any,
+    ) -> None:
+        """Record one completed span from perf_counter stamps; safe to
+        call from any thread holding a reference to this context."""
+        ev: dict = {
+            "name": name,
+            "cat": cat,
+            "ts": _us(perf_to_wall(t0)),
+            "dur": max(0, int((t1 - t0) * 1e6)),
+            "pid": self.track,
+        }
+        if sid is not None:
+            args["sid"] = sid
+        if self.parent_span is not None:
+            args.setdefault("parent", self.parent_span)
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS:
+                self.dropped += 1
+                return
+            self.spans.append(ev)
+
+    def add_remote_spans(
+        self, spans: list[dict], parent_sid: str
+    ) -> None:
+        """Merge a callee's piggybacked spans.  Each span keeps the
+        ``pid`` track its own process stamped; spans that did not carry
+        a parent (older peers) are adopted under this leg's sid."""
+        with self._lock:
+            for s in spans:
+                if len(self.spans) >= MAX_SPANS:
+                    self.dropped += 1
+                    continue
+                args = dict(s.get("args") or {})
+                args.setdefault("parent", parent_sid)
+                self.spans.append(dict(s, args=args))
+
+    def header(self, parent_sid: str) -> str:
+        """The outbound ``X-Pathway-Trace`` value for one hop — only
+        sampled requests ever propagate, so the bit is always 1."""
+        return f"{self.trace_id};{parent_sid};1"
+
+    def take_spans(self) -> list[dict]:
+        with self._lock:
+            return list(self.spans)
+
+
 class TraceRecorder:
     """Process-wide sampling trace recorder (singleton: :data:`TRACER`).
 
@@ -219,8 +395,13 @@ class TraceRecorder:
         self._ctx: TraceContext | None = None
         self._count = 0
         self._query_count = 0  # guarded-by: self._lock
+        self._request_count = 0  # guarded-by: self._lock
+        #: per-HTTP-handler-thread request context slot; thread-local so
+        #: concurrent requests on the serving pool never share a trace
+        self._req_local = threading.local()
         self._export_seq = 0
         self._overhead_ema: float | None = None
+        self._req_overhead_ema: float | None = None
         self.epoch = 0
         self.configure(enabled=enabled, sample=sample)
 
@@ -231,6 +412,8 @@ class TraceRecorder:
         enabled: bool | None = None,
         sample: int | None = None,
         clear: bool = False,
+        request_enabled: bool | None = None,
+        request_sample: int | None = None,
     ) -> None:
         """(Re)read the knobs; tests and benches call this directly
         instead of mutating the environment."""
@@ -247,18 +430,38 @@ class TraceRecorder:
                 )
             except ValueError:
                 sample = 16
+        if request_enabled is None:
+            request_enabled = os.environ.get(
+                "PATHWAY_TPU_REQUEST_TRACE", ""
+            ).lower() in ("1", "true", "yes")
+        if request_sample is None:
+            try:
+                request_sample = int(
+                    os.environ.get(
+                        "PATHWAY_TPU_REQUEST_TRACE_SAMPLE", "16"
+                    )
+                )
+            except ValueError:
+                request_sample = 16
         self.enabled = bool(enabled)
         self.base_interval = max(1, int(sample))
         self.interval = self.base_interval
+        self.request_enabled = bool(request_enabled)
+        self.request_base_interval = max(1, int(request_sample))
+        self.request_interval = self.request_base_interval
         try:
             self.worker_id = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
         except ValueError:
             self.worker_id = 0
         self._ctx = None
+        self._req_local = threading.local()
         self._overhead_ema = None
+        self._req_overhead_ema = None
         if clear:
             with self._lock:
                 self._traces.clear()
+                self._query_count = 0
+                self._request_count = 0
             self._count = 0
             self._export_seq = 0
 
@@ -488,6 +691,134 @@ class TraceRecorder:
             self._traces.append(trace)
         return trace
 
+    # -- read-tier request traces --------------------------------------------
+
+    def begin_request(self, endpoint: str) -> RequestTrace | None:
+        """Root-side sampling decision for one read-tier request.
+
+        The first request is always sampled (a single smoke query must
+        yield a trace), then every ``request_interval``-th; the counter
+        is lock-guarded because requests land on concurrent handler
+        threads.  The context lives in a thread-local slot for the
+        handler's duration."""
+        if not self.request_enabled:
+            return None
+        t0 = _time.perf_counter()
+        with self._lock:
+            self._request_count += 1
+            count = self._request_count
+        if (count - 1) % self.request_interval:
+            return None
+        ctx = RequestTrace(
+            trace_id=f"r{self.worker_id:02d}-{os.getpid():x}-{count:06x}",
+            endpoint=endpoint,
+        )
+        self._req_local.ctx = ctx
+        ctx.overhead_s += _time.perf_counter() - t0
+        return ctx
+
+    def adopt_request(
+        self, header_value: str | None, endpoint: str = ""
+    ) -> RequestTrace | None:
+        """Downstream-hop side: adopt the caller's trace context from an
+        ``X-Pathway-Trace`` header.  The ROOT owns the sampling
+        decision, so a sampled header is honored even when this
+        process's own request tracing is off (a traced federation
+        front can stitch through untraced workers)."""
+        parsed = parse_trace_header(header_value)
+        if parsed is None or not parsed[2]:
+            return None
+        ctx = RequestTrace(
+            trace_id=parsed[0],
+            endpoint=endpoint,
+            parent_span=parsed[1],
+            remote=True,
+        )
+        self._req_local.ctx = ctx
+        return ctx
+
+    def current_request(self) -> RequestTrace | None:
+        """This thread's in-flight request trace, or None — the guard
+        every read-tier instrumentation site checks first."""
+        return getattr(self._req_local, "ctx", None)
+
+    def take_request_spans(self) -> list[dict]:
+        """A remote hop's accumulated spans, for the response-header
+        piggyback back to the caller."""
+        ctx = self.current_request()
+        return ctx.take_spans() if ctx is not None else []
+
+    def drop_request(self) -> None:
+        """Clear this thread's request slot — called unconditionally in
+        handler ``finally`` blocks so pooled serving threads never leak
+        a context into the next request they pick up."""
+        self._req_local.ctx = None
+
+    def end_request(
+        self, ctx: RequestTrace | None, status: int = 200, **fields: Any
+    ) -> dict | None:
+        """Root-side request end: assemble the trace (local + merged
+        remote spans, each on its own per-process track), attribute the
+        critical path, ring it, and feed the request sampler."""
+        self._req_local.ctx = None
+        if ctx is None or ctx.remote:
+            return None
+        t_end = _time.perf_counter()
+        end_wall = perf_to_wall(t_end)
+        with ctx._lock:
+            spans = list(ctx.spans)
+            dropped = ctx.dropped
+        trace: dict = {
+            "kind": "request",
+            "trace_id": ctx.trace_id,
+            "endpoint": ctx.endpoint,
+            "status": int(status),
+            "commit_time": int(fields.pop("commit_time", 0) or 0),
+            "epoch": self.epoch,
+            "worker": ctx.track,
+            "origin_wall": ctx.origin_wall,
+            "begin_wall": ctx.begin_wall,
+            "end_wall": end_wall,
+            "spans": spans,
+            "workers": {},
+            "sink_rows": 0,
+            "dropped_spans": dropped,
+            "device_kernel_ns": {},
+            "device_s": 0.0,
+        }
+        if fields:
+            trace["request"] = dict(fields)
+        trace["critical_path"] = critical_path(trace)
+        with self._lock:
+            self._traces.append(trace)
+        overhead = ctx.overhead_s + (_time.perf_counter() - t_end)
+        self._adapt_request(
+            overhead, max(end_wall - ctx.begin_wall, 0.0)
+        )
+        return trace
+
+    def _adapt_request(self, overhead_s: float, wall_s: float) -> None:
+        """Same EMA-doubling discipline as :meth:`_adapt`, on the
+        request sampler's own interval so query floods cannot push the
+        commit sampler around (and vice versa)."""
+        amortized = overhead_s / max(1, self.request_interval)
+        ratio = amortized / max(wall_s, 1e-6)
+        ema = self._req_overhead_ema
+        self._req_overhead_ema = (
+            ratio if ema is None else 0.5 * ema + 0.5 * ratio
+        )
+        if self._req_overhead_ema > OVERHEAD_TARGET:
+            self.request_interval = min(self.request_interval * 2, 4096)
+            self._req_overhead_ema /= 2.0
+        elif (
+            self.request_interval > self.request_base_interval
+            and self._req_overhead_ema < OVERHEAD_TARGET / 4.0
+        ):
+            self.request_interval = max(
+                self.request_base_interval, self.request_interval // 2
+            )
+            self._req_overhead_ema *= 2.0
+
     # -- read side -----------------------------------------------------------
 
     def traces(self) -> list[dict]:
@@ -506,7 +837,12 @@ class TraceRecorder:
         skew the commit critical-path means."""
         all_traces = self.traces()
         queries = [t for t in all_traces if t.get("kind") == "serving"]
-        traces = [t for t in all_traces if t.get("kind") != "serving"]
+        requests = [t for t in all_traces if t.get("kind") == "request"]
+        traces = [
+            t
+            for t in all_traces
+            if t.get("kind") not in ("serving", "request")
+        ]
         query_summary: dict = {}
         if queries:
             query_summary = {
@@ -520,6 +856,15 @@ class TraceRecorder:
                     3,
                 ),
             }
+        if requests:
+            query_summary["request_traces"] = len(requests)
+            query_summary["request_ms_mean"] = round(
+                sum((t["end_wall"] - t["origin_wall"]) for t in requests)
+                / len(requests)
+                * 1000.0,
+                3,
+            )
+            query_summary["request_sample_interval"] = self.request_interval
         if not traces:
             return {
                 "traces": 0,
@@ -597,7 +942,16 @@ class TraceRecorder:
                         "critical_path": t["critical_path"],
                         **(
                             {"spans": t["spans"]}
-                            if t.get("kind") == "serving"
+                            if t.get("kind") in ("serving", "request")
+                            else {}
+                        ),
+                        **(
+                            {
+                                "endpoint": t.get("endpoint", ""),
+                                "status": t.get("status", 0),
+                                "request": t.get("request", {}),
+                            }
+                            if t.get("kind") == "request"
                             else {}
                         ),
                     }
@@ -730,6 +1084,8 @@ def chrome_trace(traces: list[dict]) -> dict:
                     "name": (
                         f"query @{trace['commit_time']}"
                         if trace.get("kind") == "serving"
+                        else f"request {trace.get('endpoint') or '?'}"
+                        if trace.get("kind") == "request"
                         else f"commit {trace['commit_time']}"
                     ),
                     "cat": "commit",
@@ -840,6 +1196,9 @@ def current() -> TraceContext | None:
 
 
 def _active_trace_id() -> str | None:
+    rctx = TRACER.current_request()
+    if rctx is not None:
+        return rctx.trace_id
     ctx = TRACER._ctx
     return ctx.trace_id if ctx is not None else None
 

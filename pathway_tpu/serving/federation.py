@@ -45,8 +45,10 @@ import time as _time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
+from typing import Any
 
 from pathway_tpu.internals import metrics as _metrics
+from pathway_tpu.internals import tracing as _tracing
 from pathway_tpu.serving import result_cache as _result_cache
 from pathway_tpu.serving import server as _server
 from pathway_tpu.serving.replica import parse_sources, replica_port
@@ -113,22 +115,53 @@ def replica_endpoints() -> list[tuple[str, int]]:
     return [("127.0.0.1", replica_port(i)) for i in range(max(0, count))]
 
 
-def _post_json(url: str, payload: dict, timeout: float) -> tuple[int, dict]:
+def _post_json(
+    url: str,
+    payload: dict,
+    timeout: float,
+    headers: dict | None = None,
+) -> tuple[int, dict, Any]:
+    """POST JSON; returns ``(status, body, response_headers)`` — the
+    headers carry the trace-span piggyback on instrumented backends."""
+    all_headers = {"Content-Type": "application/json"}
+    if headers:
+        all_headers.update(headers)
     req = urllib.request.Request(
         url,
         data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
+        headers=all_headers,
         method="POST",
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, json.loads(resp.read())
+            return resp.status, json.loads(resp.read()), resp.headers
     except urllib.error.HTTPError as exc:
         try:
             body = json.loads(exc.read() or b"{}")
         except ValueError:
             body = {}
-        return exc.code, body
+        return exc.code, body, exc.headers
+
+
+def _stamp_header(answered: tuple | None, meta: dict | None) -> str | None:
+    """``X-Pathway-Stamp`` value for a federated answer: the full
+    per-worker stamp vector when the scatter produced one (compact
+    JSON, so a cache hit and a recompute at the same vector carry
+    byte-identical headers), else the replica answer's commit
+    identity."""
+    if answered is not None:
+        try:
+            return json.dumps(
+                list(answered), separators=(",", ":"), default=repr
+            )
+        except (TypeError, ValueError):
+            return repr(answered)
+    if meta and meta.get("commit_time") is not None:
+        return json.dumps(
+            [meta["commit_time"], meta.get("seq", 0)],
+            separators=(",", ":"),
+        )
+    return None
 
 
 def _get_json(url: str, timeout: float) -> tuple[int, dict]:
@@ -178,12 +211,32 @@ class _FedHandler(_server._Handler):
 
     def do_POST(self) -> None:  # noqa: N802 — http.server contract
         t0 = _time.perf_counter()
+        self._wide = {}
+        path = self.path
+        if "/query" in path:
+            endpoint = "fed-query"
+        elif "/lookup" in path:
+            endpoint = "fed-lookup"
+        else:
+            endpoint = "other"
+        tracer = _tracing.TRACER
+        rctx = tracer.adopt_request(
+            self.headers.get(_tracing.TRACE_HEADER), endpoint
+        )
+        if rctx is None and endpoint != "other":
+            rctx = tracer.begin_request(endpoint)
+        self._rctx = rctx
+        if rctx is not None:
+            admit = getattr(self.server, "_admit_local", None)
+            enq = getattr(admit, "enq", None)
+            deq = getattr(admit, "deq", None)
+            if enq is not None and deq is not None and deq > enq:
+                rctx.span("admission-queue", "wait", enq, deq)
         try:
-            path = self.path
-            if "/query" in path:
+            if endpoint == "fed-query":
                 _FED_REQS["query"].inc()
                 self._fed_query(t0)
-            elif "/lookup" in path:
+            elif endpoint == "fed-lookup":
                 _FED_REQS["lookup"].inc()
                 self._fed_lookup()
             else:
@@ -193,6 +246,7 @@ class _FedHandler(_server._Handler):
             pass
         except FederationUnavailable as exc:
             _FED_ROUTE["unavailable"].inc()
+            self._wide["refusal"] = "partial-scatter"
             try:
                 self._json(
                     503,
@@ -207,7 +261,23 @@ class _FedHandler(_server._Handler):
             except (BrokenPipeError, ConnectionResetError):
                 pass
         finally:
-            _FED_LATENCY.observe(_time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            _FED_LATENCY.observe(dt)
+            if rctx is not None:
+                _FED_LATENCY.exemplar(dt, rctx.trace_id)
+                # wide event BEFORE teardown so the trace-id provider
+                # still sees the context
+                _metrics.REQUESTS.record(
+                    endpoint=endpoint,
+                    status=self._last_status,
+                    port=self.server.server_port,
+                    ns=int(dt * 1e9),
+                    **self._wide,
+                )
+                tracer.end_request(
+                    rctx, status=self._last_status, **self._wide
+                )
+            tracer.drop_request()
 
     def _fed_query(self, t0: float) -> None:
         req = self._body()
@@ -217,21 +287,44 @@ class _FedHandler(_server._Handler):
             vectors = [list(map(float, req["vector"]))]
         k = int(req.get("k", 10))
         front = self.server.front
+        rctx = self._rctx
         key = front.cache_key(
             "fed-query",
             json.dumps({"vectors": vectors, "k": k}, sort_keys=True).encode(),
         )
         if key is not None:
+            tc0 = _time.perf_counter()
             cached = _result_cache.CACHE.get(key)
+            disposition = "hit" if cached is not None else "miss"
+            self._wide["cache"] = disposition
+            self._wide["stamp"] = repr(key[1])
+            if rctx is not None:
+                rctx.span(
+                    "result-cache",
+                    "serving",
+                    tc0,
+                    _time.perf_counter(),
+                    disposition=disposition,
+                )
             if cached is not None:
                 _FED_ROUTE["cache"].inc()
                 _FED_FANOUT.observe(0.0)
-                self._raw_json(200, cached, {"X-Pathway-Cache": "hit"})
+                self._wide["fan_out"] = 0
+                self._raw_json(
+                    200,
+                    cached,
+                    {
+                        "X-Pathway-Cache": "hit",
+                        "X-Pathway-Stamp": _stamp_header(key[1], None),
+                    },
+                )
                 _result_cache.CACHE.observe_hit_latency(
                     _time.perf_counter() - t0
                 )
                 return
-        body, answered = front.query(vectors, k)
+        else:
+            self._wide["cache"] = "miss"
+        body, answered = front.query(vectors, k, rctx=rctx)
         raw = json.dumps(body).encode()
         if key is not None and answered is not None and answered == key[1]:
             _result_cache.CACHE.put(
@@ -242,13 +335,24 @@ class _FedHandler(_server._Handler):
                 # invalidation drops it with the worker-level entries
                 commit_time=min(part[1] for part in answered),
             )
-        self._raw_json(200, raw)
+        meta = body.get("snapshot") or {}
+        self._wide["route"] = meta.get("route")
+        self._wide["fan_out"] = meta.get("fan_out", 0)
+        self._wide["commit_time"] = meta.get("commit_time")
+        headers = {"X-Pathway-Cache": "miss"}
+        stamp_value = _stamp_header(answered, meta)
+        if stamp_value is not None:
+            headers["X-Pathway-Stamp"] = stamp_value
+        self._raw_json(200, raw, headers)
 
     def _fed_lookup(self) -> None:
         req = self._body()
         keys = [str(key) for key in req.get("keys", [])]
         node = req.get("node")
-        body = self.server.front.lookup(keys, node)
+        body = self.server.front.lookup(keys, node, rctx=self._rctx)
+        meta = body.get("snapshot") or {}
+        self._wide["fan_out"] = meta.get("fan_out", 0)
+        self._wide["commit_time"] = meta.get("commit_time")
         self._json(200, body)
 
 
@@ -393,20 +497,62 @@ class FederationFront:
 
     # -- routing -------------------------------------------------------------
 
-    def query(self, vectors: list, k: int) -> tuple[dict, tuple | None]:
+    def query(
+        self, vectors: list, k: int, rctx=None
+    ) -> tuple[dict, tuple | None]:
         """Answer one federated KNN request.  Returns ``(body,
         answered_stamp_vector)``; the stamp vector is None on replica
-        routes (replica answers are cached in the replica process)."""
+        routes (replica answers are cached in the replica process).
+        ``rctx`` is the handler's request-trace context, passed
+        explicitly because the scatter pool threads don't share the
+        handler's thread-local slot."""
         payload = {"vectors": vectors, "k": k}
         for host, port in self._next_replica():
+            sid = rctx.alloc_sid() if rctx is not None else None
+            hdrs = (
+                {_tracing.TRACE_HEADER: rctx.header(sid)}
+                if rctx is not None
+                else None
+            )
+            t_leg = _time.perf_counter()
             try:
-                status, body = _post_json(
+                status, body, rhdrs = _post_json(
                     f"http://{host}:{port}/serving/query",
                     payload,
                     timeout=5.0,
+                    headers=hdrs,
                 )
-            except (OSError, ValueError):
+            except (OSError, ValueError) as exc:
+                if rctx is not None:
+                    # the dead leg stays visible in the assembled trace
+                    # as the reason the request fell through to scatter
+                    rctx.span(
+                        f"replica {host}:{port}",
+                        "exchange",
+                        t_leg,
+                        _time.perf_counter(),
+                        sid=sid,
+                        port=port,
+                        error=repr(exc),
+                    )
                 continue
+            if rctx is not None:
+                rctx.span(
+                    f"replica {host}:{port}",
+                    "exchange",
+                    t_leg,
+                    _time.perf_counter(),
+                    sid=sid,
+                    port=port,
+                    status=status,
+                )
+                remote = _tracing.decode_spans(
+                    rhdrs.get(_tracing.SPANS_HEADER)
+                    if rhdrs is not None
+                    else None
+                )
+                if remote:
+                    rctx.add_remote_spans(remote, sid)
             if status == 200 and body.get("snapshot") is not None:
                 _FED_ROUTE["replica"].inc()
                 _FED_FANOUT.observe(1.0)
@@ -414,13 +560,13 @@ class FederationFront:
                 meta["route"] = "replica"
                 meta["fan_out"] = 1
                 return body, None
-        return self._scatter_query(payload, k)
+        return self._scatter_query(payload, k, rctx)
 
     def _scatter_query(
-        self, payload: dict, k: int
+        self, payload: dict, k: int, rctx=None
     ) -> tuple[dict, tuple | None]:
         ports = self.worker_ports()
-        shard_bodies = self._scatter("/serving/query", payload, ports)
+        shard_bodies = self._scatter("/serving/query", payload, ports, rctx)
         _FED_ROUTE["scatter"].inc()
         _FED_FANOUT.observe(float(len(ports)))
         answered = []
@@ -454,12 +600,12 @@ class FederationFront:
         }
         return {"hits": merged_hits, "snapshot": meta}, tuple(answered)
 
-    def lookup(self, keys: list[str], node) -> dict:
+    def lookup(self, keys: list[str], node, rctx=None) -> dict:
         payload = {"keys": keys}
         if node is not None:
             payload["node"] = node
         ports = self.worker_ports()
-        shard_bodies = self._scatter("/serving/lookup", payload, ports)
+        shard_bodies = self._scatter("/serving/lookup", payload, ports, rctx)
         _FED_FANOUT.observe(float(len(ports)))
         rows: dict = {}
         metas = []
@@ -485,28 +631,83 @@ class FederationFront:
         }
 
     def _scatter(
-        self, path: str, payload: dict, ports: list[int]
+        self, path: str, payload: dict, ports: list[int], rctx=None
     ) -> list[dict]:
         """POST to every worker concurrently; ALL must answer 200 or the
-        whole request degrades (partial merges are never served)."""
-        futures = [
-            self._pool.submit(
-                _post_json,
-                f"http://127.0.0.1:{port}{path}",
-                payload,
-                5.0,
+        whole request degrades (partial merges are never served).  One
+        child span per leg when the request is traced; each leg's
+        outbound header carries its pre-allocated span id so the
+        worker's piggybacked spans parent under it."""
+        legs = []
+        for port in ports:
+            sid = rctx.alloc_sid() if rctx is not None else None
+            hdrs = (
+                {_tracing.TRACE_HEADER: rctx.header(sid)}
+                if rctx is not None
+                else None
             )
-            for port in ports
-        ]
+            legs.append(
+                (
+                    port,
+                    sid,
+                    _time.perf_counter(),
+                    self._pool.submit(
+                        _post_json,
+                        f"http://127.0.0.1:{port}{path}",
+                        payload,
+                        5.0,
+                        hdrs,
+                    ),
+                )
+            )
         bodies = []
-        for port, future in zip(ports, futures):
+        for port, sid, t_leg, future in legs:
             try:
-                status, body = future.result(timeout=6.0)
+                status, body, rhdrs = future.result(timeout=6.0)
             except Exception as exc:  # noqa: BLE001 — degrade, never partial-merge
+                if rctx is not None:
+                    rctx.span(
+                        f"scatter :{port}",
+                        "exchange",
+                        t_leg,
+                        _time.perf_counter(),
+                        sid=sid,
+                        port=port,
+                        error=repr(exc),
+                    )
+                # recorded on the handler thread, so the FLIGHT event
+                # carries the request's trace id via the provider
+                _metrics.FLIGHT.record(
+                    "federation_partial_scatter",
+                    port=port,
+                    error=repr(exc),
+                )
                 raise FederationUnavailable(
                     f"worker :{port} unreachable during scatter: {exc!r}"
                 ) from exc
+            if rctx is not None:
+                rctx.span(
+                    f"scatter :{port}",
+                    "exchange",
+                    t_leg,
+                    _time.perf_counter(),
+                    sid=sid,
+                    port=port,
+                    status=status,
+                )
+                remote = _tracing.decode_spans(
+                    rhdrs.get(_tracing.SPANS_HEADER)
+                    if rhdrs is not None
+                    else None
+                )
+                if remote:
+                    rctx.add_remote_spans(remote, sid)
             if status != 200:
+                _metrics.FLIGHT.record(
+                    "federation_partial_scatter",
+                    port=port,
+                    status=status,
+                )
                 raise FederationUnavailable(
                     f"worker :{port} answered {status} during scatter"
                 )

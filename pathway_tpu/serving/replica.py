@@ -251,6 +251,14 @@ class ReplicaStore:
         if staleness > bound:
             cut.release()
             _STALE_503.inc()
+            # cut-level detail; the request-side 503 (with the refused
+            # request's trace id) is recorded by the server's _stale
+            _metrics.FLIGHT.record(
+                "replica_stale_cut",
+                commit_time=cut.commit_time,
+                staleness_s=round(staleness, 6),
+                bound_s=bound,
+            )
             raise StaleReadError(
                 f"replica cut at commit {cut.commit_time} is "
                 f"{staleness:.3f}s stale (bound {bound:g}s) — refusing "

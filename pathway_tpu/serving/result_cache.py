@@ -147,6 +147,11 @@ class ResultCache:
                 evicted += 1
         if evicted:
             _EVENTS["evict"].inc(evicted)
+            # recorded on the inserting request's handler thread, so the
+            # event carries that request's trace id when it is sampled
+            _metrics.FLIGHT.record(
+                "cache_evict", evicted=evicted, bytes=self._bytes
+            )
 
     def invalidate_above(self, commit_time: int) -> int:
         """Drop every entry stamped with ``commit_time > time`` — the

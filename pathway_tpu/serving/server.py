@@ -113,6 +113,19 @@ def serving_port(process_id: int | None = None) -> int:
     return base + process_id
 
 
+def stamp_header_value(stamp) -> str:
+    """Deterministic ``X-Pathway-Stamp`` value from a cache stamp
+    (``(commit_time, seq-or-cut, fingerprint)``): the commit identity
+    without the fingerprint, compact-JSON so a hit and a miss answered
+    at the same stamp carry byte-identical headers."""
+    try:
+        return json.dumps(
+            list(stamp[:2]), separators=(",", ":"), default=repr
+        )
+    except Exception:
+        return repr(stamp)
+
+
 def _suggested_batch() -> int:
     """Micro-batch capacity from the device pipeline's adaptive
     controller — when the device side is backpressured the controller
@@ -212,6 +225,7 @@ class _MicroBatcher:
             # inside the try: a raising store (a replica past its
             # staleness bound) must fail the waiters, not this thread
             snap = self.store.acquire_latest()
+            t_pin = _time.perf_counter()
             n = sum(len(i["vecs"]) for i in pending)
             if snap is None:
                 for item in pending:
@@ -226,6 +240,7 @@ class _MicroBatcher:
                 for item in pending:
                     item["error"] = exc
                 return
+            t_search = _time.perf_counter()
             meta = {
                 "seq": snap.seq,
                 "commit_time": snap.commit_time,
@@ -234,6 +249,31 @@ class _MicroBatcher:
                 # result cache only inserts when the snapshot actually
                 # answered matches the stamp it keyed the lookup on
                 "cache_stamp": snap.cache_stamp(),
+                # stripped likewise: (name, cat, t0, t1, args) tuples the
+                # handler replays into its request trace — the batcher
+                # thread has no request context, the waiters do
+                "_req_spans": [
+                    (
+                        # a ReplicaStore pin waits for a consistent cut;
+                        # a plain SnapshotStore pin is a refcount bump
+                        (
+                            "cut-wait"
+                            if hasattr(self.store, "lag_s")
+                            else "snapshot-pin"
+                        ),
+                        "wait",
+                        t0,
+                        t_pin,
+                        {"seq": snap.seq, "commit_time": snap.commit_time},
+                    ),
+                    (
+                        "search",
+                        "serving",
+                        t_pin,
+                        t_search,
+                        {"queries": n, "k": max_k},
+                    ),
+                ],
             }
             self.dispatches += 1
             _BATCHED.observe_n(float(n), 1)
@@ -241,7 +281,10 @@ class _MicroBatcher:
             for item in pending:
                 rows = results[pos : pos + len(item["vecs"])]
                 item["hits"] = [r[: item["k"]] for r in rows]
-                item["meta"] = meta
+                # a COPY per waiter: handlers pop cache_stamp/_req_spans
+                # from their own meta, so concurrent batch-mates never
+                # race on one shared dict
+                item["meta"] = dict(meta)
                 pos += len(item["vecs"])
             _tracing.TRACER.record_query(
                 "knn-batch",
@@ -268,6 +311,11 @@ class _Handler(BaseHTTPRequestHandler):
     # connection, so admission control maps 1:1 to requests
     server_version = "PathwayServing/1.0"
 
+    #: per-request trace context / wide-event state; handler instances
+    #: are per-connection (HTTP/1.0 + close => per-request)
+    _rctx = None
+    _last_status = 0
+
     def log_message(self, fmt: str, *args) -> None:  # noqa: A003
         pass  # the metrics registry is the access log
 
@@ -283,16 +331,35 @@ class _Handler(BaseHTTPRequestHandler):
     ) -> None:
         """Send pre-serialized JSON bytes — the result-cache hit path
         writes the cached body verbatim, skipping re-serialization."""
+        self._last_status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         for name, value in (headers or {}).items():
             self.send_header(name, value)
+        rctx = self._rctx
+        if rctx is not None:
+            if rctx.remote:
+                # downstream hop: piggyback this hop's spans back to
+                # the caller that owns the trace
+                payload = _tracing.encode_spans(rctx.take_spans())
+                if payload is not None:
+                    self.send_header(_tracing.SPANS_HEADER, payload)
+            else:
+                # root: echo the trace id so clients/benches can join
+                # the response to the exported trace
+                self.send_header(_tracing.TRACE_HEADER, rctx.trace_id)
         self.end_headers()
         self.wfile.write(body)
 
     def _stale(self, exc: StaleReadError) -> None:
         _STALE.inc()
+        _metrics.FLIGHT.record(
+            "serving_stale_503",
+            port=self.server.server_port,
+            error=str(exc),
+        )
+        self._wide["refusal"] = "stale"
         self._json(
             503,
             {"error": str(exc), "stale": True},
@@ -325,11 +392,33 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802 — http.server contract
         t0 = _time.perf_counter()
+        self._wide = {}
+        if self.path.startswith("/serving/query"):
+            endpoint = "query"
+        elif self.path.startswith("/serving/lookup"):
+            endpoint = "lookup"
+        else:
+            endpoint = "other"
+        tracer = _tracing.TRACER
+        # a sampled upstream header wins (the root owns the sampling
+        # decision); otherwise this hop is its own root candidate
+        rctx = tracer.adopt_request(
+            self.headers.get(_tracing.TRACE_HEADER), endpoint
+        )
+        if rctx is None and endpoint != "other":
+            rctx = tracer.begin_request(endpoint)
+        self._rctx = rctx
+        if rctx is not None:
+            admit = getattr(self.server, "_admit_local", None)
+            enq = getattr(admit, "enq", None)
+            deq = getattr(admit, "deq", None)
+            if enq is not None and deq is not None and deq > enq:
+                rctx.span("admission-queue", "wait", enq, deq)
         try:
-            if self.path.startswith("/serving/query"):
+            if endpoint == "query":
                 _REQS["query"].inc()
                 self._query(t0)
-            elif self.path.startswith("/serving/lookup"):
+            elif endpoint == "lookup":
                 _REQS["lookup"].inc()
                 self._lookup(t0)
             else:
@@ -351,7 +440,23 @@ class _Handler(BaseHTTPRequestHandler):
             except (BrokenPipeError, ConnectionResetError):
                 pass
         finally:
-            _LATENCY.observe(_time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            _LATENCY.observe(dt)
+            if rctx is not None:
+                _LATENCY.exemplar(dt, rctx.trace_id)
+                # wide event BEFORE the context is torn down, so the
+                # trace-id provider still sees it
+                _metrics.REQUESTS.record(
+                    endpoint=endpoint,
+                    status=self._last_status,
+                    port=self.server.server_port,
+                    ns=int(dt * 1e9),
+                    **self._wide,
+                )
+                tracer.end_request(
+                    rctx, status=self._last_status, **self._wide
+                )
+            tracer.drop_request()
 
     def _query(self, t0: float) -> None:
         req = self._body()
@@ -367,16 +472,28 @@ class _Handler(BaseHTTPRequestHandler):
             vecs.tobytes() + b"|" + repr((vecs.shape, k)).encode(),
         )
         if key is not None:
+            tc0 = _time.perf_counter()
             cached = _result_cache.CACHE.get(key)
+            self._note_cache(
+                "hit" if cached is not None else "miss", key[1], tc0
+            )
             if cached is not None:
                 # hot path: cached answers never touch the batcher or
                 # pin a snapshot — serialized bytes straight back out
-                self._raw_json(200, cached, {"X-Pathway-Cache": "hit"})
+                self._raw_json(
+                    200,
+                    cached,
+                    {
+                        "X-Pathway-Cache": "hit",
+                        "X-Pathway-Stamp": stamp_header_value(key[1]),
+                    },
+                )
                 _result_cache.CACHE.observe_hit_latency(
                     _time.perf_counter() - t0
                 )
                 return
         hits, meta = self.server.batcher.submit(vecs, k)
+        self._replay_batch_spans(meta)
         if hits is None:
             # admitted before the first commit: answer empty-but-valid
             # (stale by definition), never a 5xx
@@ -384,6 +501,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(
                 200,
                 {"hits": [[] for _ in range(len(vecs))], "snapshot": None},
+                headers={"X-Pathway-Cache": "miss"},
             )
             return
         answered = meta.pop("cache_stamp", None)
@@ -397,7 +515,35 @@ class _Handler(BaseHTTPRequestHandler):
             }
         ).encode()
         self._maybe_insert(key, answered, body)
-        self._raw_json(200, body)
+        self._wide["commit_time"] = meta.get("commit_time")
+        headers = {"X-Pathway-Cache": "miss"}
+        if answered is not None:
+            headers["X-Pathway-Stamp"] = stamp_header_value(answered)
+        self._raw_json(200, body, headers)
+
+    def _note_cache(self, disposition: str, stamp, t0: float) -> None:
+        """Cache-disposition span + wide-event fields for one lookup."""
+        self._wide["cache"] = disposition
+        self._wide["stamp"] = repr(stamp[:2])
+        rctx = self._rctx
+        if rctx is not None:
+            rctx.span(
+                "result-cache",
+                "serving",
+                t0,
+                _time.perf_counter(),
+                disposition=disposition,
+            )
+
+    def _replay_batch_spans(self, meta: dict | None) -> None:
+        """Pull the batcher's span tuples out of this waiter's meta copy
+        and replay them into the request trace (the batcher thread has
+        no request context; the handler thread does)."""
+        spans = meta.pop("_req_spans", None) if meta else None
+        rctx = self._rctx
+        if rctx is not None and spans:
+            for name, cat, s0, s1, sargs in spans:
+                rctx.span(name, cat, s0, s1, **sargs)
 
     def _cache_key(self, endpoint: str, material: bytes):
         """Commit-stamped cache key, or None when caching is off or no
@@ -441,18 +587,48 @@ class _Handler(BaseHTTPRequestHandler):
             json.dumps({"keys": keys, "node": node}, sort_keys=True).encode(),
         )
         if key is not None:
+            tc0 = _time.perf_counter()
             cached = _result_cache.CACHE.get(key)
+            self._note_cache(
+                "hit" if cached is not None else "miss", key[1], tc0
+            )
             if cached is not None:
-                self._raw_json(200, cached, {"X-Pathway-Cache": "hit"})
+                self._raw_json(
+                    200,
+                    cached,
+                    {
+                        "X-Pathway-Cache": "hit",
+                        "X-Pathway-Stamp": stamp_header_value(key[1]),
+                    },
+                )
                 _result_cache.CACHE.observe_hit_latency(
                     _time.perf_counter() - t0
                 )
                 return
+        t_pin0 = _time.perf_counter()
         snap = self.server.store.acquire_latest()
         if snap is None:
             _EMPTY.inc()
-            self._json(200, {"rows": {}, "snapshot": None})
+            self._json(
+                200,
+                {"rows": {}, "snapshot": None},
+                headers={"X-Pathway-Cache": "miss"},
+            )
             return
+        rctx = self._rctx
+        if rctx is not None:
+            rctx.span(
+                (
+                    "cut-wait"
+                    if hasattr(self.server.store, "lag_s")
+                    else "snapshot-pin"
+                ),
+                "wait",
+                t_pin0,
+                _time.perf_counter(),
+                seq=snap.seq,
+                commit_time=snap.commit_time,
+            )
         try:
             t1 = _time.perf_counter()
             table = {repr(key_): row for key_, row in snap.table(node).items()}
@@ -465,18 +641,27 @@ class _Handler(BaseHTTPRequestHandler):
                 "staleness_s": round(snap.staleness_s(), 6),
             }
             answered = snap.cache_stamp()
+            t2 = _time.perf_counter()
             _tracing.TRACER.record_query(
                 "table-lookup",
                 t1,
-                _time.perf_counter(),
+                t2,
                 commit_time=snap.commit_time,
                 keys=len(keys),
             )
+            if rctx is not None:
+                rctx.span(
+                    "table-lookup", "serving", t1, t2, keys=len(keys)
+                )
         finally:
             snap.release()
         body = json.dumps({"rows": rows, "snapshot": meta}).encode()
         self._maybe_insert(key, answered, body)
-        self._raw_json(200, body)
+        self._wide["commit_time"] = meta.get("commit_time")
+        headers = {"X-Pathway-Cache": "miss"}
+        if answered is not None:
+            headers["X-Pathway-Stamp"] = stamp_header_value(answered)
+        self._raw_json(200, body, headers)
 
 
 class _BoundedHTTPServer(HTTPServer):
@@ -502,6 +687,10 @@ class _BoundedHTTPServer(HTTPServer):
         self.batcher = batcher
         self.started_wall = _time.time()
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, queue_size))
+        #: per-worker-thread admission timestamps (enq/deq perf stamps
+        #: of the request the thread is currently handling) — read by
+        #: the handler, which runs on the same pool thread
+        self._admit_local = threading.local()
         self._pool_stop = False
         self._pool = [
             threading.Thread(
@@ -514,9 +703,23 @@ class _BoundedHTTPServer(HTTPServer):
 
     def process_request(self, request, client_address) -> None:
         try:
-            self._queue.put_nowait((request, client_address))
+            self._queue.put_nowait(
+                (request, client_address, _time.perf_counter())
+            )
         except queue.Full:
             _SHED.inc()
+            # shed before the headers are ever read, so no trace id can
+            # exist for this connection — the wide event records the
+            # refusal without one
+            _metrics.FLIGHT.record(
+                "serving_shed", port=self.server_port
+            )
+            _metrics.REQUESTS.record(
+                endpoint="admission",
+                status=503,
+                port=self.server_port,
+                refusal="shed",
+            )
             try:
                 request.sendall(
                     b"HTTP/1.1 503 Service Unavailable\r\n"
@@ -541,7 +744,9 @@ class _BoundedHTTPServer(HTTPServer):
                 continue
             if item is None:
                 return
-            request, client_address = item
+            request, client_address, t_enq = item
+            self._admit_local.enq = t_enq
+            self._admit_local.deq = _time.perf_counter()
             try:
                 self.finish_request(request, client_address)
             except Exception:  # noqa: BLE001 — one bad socket, not the pool

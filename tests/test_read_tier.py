@@ -206,20 +206,30 @@ class TestCacheCorrectness:
                 srv.port, "/serving/query", payload
             )
             assert status == 200
-            assert "X-Pathway-Cache" not in headers1  # miss recompute
+            assert headers1.get("X-Pathway-Cache") == "miss"  # recompute
             status, headers2, body2 = _post(
                 srv.port, "/serving/query", payload
             )
             assert status == 200
             assert headers2.get("X-Pathway-Cache") == "hit"
             assert body2 == body1  # hit is bit-identical to the miss
+            # ...and the stamp header is too: hit and miss answered at
+            # the same commit stamp are indistinguishable but for the
+            # cache disposition itself
+            assert "X-Pathway-Stamp" in headers1
+            assert headers2.get("X-Pathway-Stamp") == headers1.get(
+                "X-Pathway-Stamp"
+            )
             # publication boundary: stamp changes, first read misses
             pipe.insert_commit(range(16, 24))
             status, headers3, body3 = _post(
                 srv.port, "/serving/query", payload
             )
             assert status == 200
-            assert "X-Pathway-Cache" not in headers3
+            assert headers3.get("X-Pathway-Cache") == "miss"
+            assert headers3.get("X-Pathway-Stamp") != headers1.get(
+                "X-Pathway-Stamp"
+            )  # publication moved the stamp
             assert (
                 json.loads(body3)["snapshot"]["commit_time"]
                 > json.loads(body1)["snapshot"]["commit_time"]
@@ -235,7 +245,7 @@ class TestCacheCorrectness:
             status, headers5, body5 = _post(
                 srv.port, "/serving/query", payload
             )
-            assert "X-Pathway-Cache" not in headers5
+            assert headers5.get("X-Pathway-Cache") == "miss"
             assert _sans_staleness(body5) == _sans_staleness(body3)
         finally:
             srv.stop()
